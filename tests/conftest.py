@@ -1,0 +1,42 @@
+"""Test harness configuration.
+
+Mirrors the reference's distributed test recipe (``tests/unittests/conftest.py:25-56``):
+instead of a 2-process gloo pool we use an 8-virtual-device CPU mesh
+(``--xla_force_host_platform_device_count=8``; SURVEY §4 "TPU-build translation") so
+mesh-collective sync paths run for real without hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+# Fixture scale constants — match reference ``tests/unittests/conftest.py:25-30``.
+NUM_PROCESSES = 2
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(42)
+    yield
+
+
+@pytest.fixture()
+def mesh8():
+    from torchmetrics_tpu.parallel import EvalMesh
+
+    return EvalMesh(8)

@@ -1,0 +1,415 @@
+"""Core Metric kernel behavior tests (modeled on reference ``tests/unittests/bases/test_metric.py``)."""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricMultiOutput, DummyMetricSum
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_on_step` to be a `bool`"):
+        DummyMetric(dist_sync_on_step=None)
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_fn` to be an callable"):
+        DummyMetric(dist_sync_fn=[2, 3])
+    with pytest.raises(ValueError, match="Expected keyword argument `compute_on_cpu` to be a `bool`"):
+        DummyMetric(compute_on_cpu=None)
+    with pytest.raises(ValueError, match="Unexpected keyword arguments: `foo`"):
+        DummyMetric(foo=True)
+    with pytest.raises(ValueError, match="Unexpected keyword arguments: `bar`, `foo`"):
+        DummyMetric(foo=True, bar=42)
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    m = DummyMetric()
+
+    m.add_state("a", jnp.asarray(0.0), "sum")
+    assert np.allclose(m._reductions["a"](jnp.asarray([1.0, 1.0])), 2.0)
+
+    m.add_state("b", jnp.asarray(0.0), "mean")
+    assert np.allclose(m._reductions["b"](jnp.asarray([1.0, 2.0])), 1.5)
+
+    m.add_state("c", jnp.asarray(0.0), "cat")
+    assert m._reductions["c"]([jnp.asarray([1.0]), jnp.asarray([1.0])]).shape == (2,)
+
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable or one of"):
+        m.add_state("d1", jnp.asarray(0.0), "xyz")
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable or one of"):
+        m.add_state("d2", jnp.asarray(0.0), 42)
+    with pytest.raises(ValueError, match="state variable must be a jax array or any empty list"):
+        m.add_state("d3", [jnp.asarray(0.0)], "sum")
+    with pytest.raises(ValueError, match="state variable must be a jax array or any empty list"):
+        m.add_state("d4", 42.0j, "sum")
+
+    def custom_fx(_):
+        return -1
+
+    m.add_state("e", jnp.asarray(0.0), custom_fx)
+    assert m._reductions["e"](jnp.asarray([1.0, 1.0])) == -1
+
+
+def test_add_state_persistent():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    assert "a" in m.state_dict()
+    m.add_state("b", jnp.asarray(0.0), "sum", persistent=False)
+    assert "b" not in m.state_dict()
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    metric = A()
+    metric.x = jnp.asarray(5.0)
+    metric.reset()
+    assert metric.x == 0
+
+    metric = B()
+    metric.x = [jnp.asarray(5.0)]
+    metric.reset()
+    assert isinstance(metric.x, list) and len(metric.x) == 0
+
+
+def test_reset_compute():
+    metric = DummyMetricSum()
+    metric.update(jnp.asarray(8.0))
+    assert metric.compute() == 8
+    metric.reset()
+    assert metric.compute() == 0
+
+
+def test_update():
+    metric = DummyMetricSum()
+    assert metric.x == 0
+    assert metric._computed is None
+    metric.update(1)
+    assert metric._computed is None
+    assert metric.x == 1
+    metric.update(2)
+    assert metric.x == 3
+    assert metric._computed is None
+    assert metric.update_count == 2
+    assert metric.update_called
+
+
+def test_compute():
+    metric = DummyMetricSum()
+    metric.update(1)
+    assert metric.compute() == 1
+    metric.update(1)
+    assert metric.compute() == 2
+
+    # called without update, should warn and return 0
+    metric.reset()
+    with pytest.warns(UserWarning, match="was called before the ``update`` method"):
+        metric.compute()
+
+
+def test_compute_cache():
+    metric = DummyMetricSum()
+    metric.update(1)
+    assert metric.compute() == 1
+    # cached
+    assert metric._computed == 1
+    metric.update(1)
+    assert metric._computed is None
+
+
+def test_no_cache():
+    metric = DummyMetricSum(compute_with_cache=False)
+    metric.update(1)
+    assert metric.compute() == 1
+    assert metric._computed is None
+
+
+def test_forward_full_state():
+    metric = DummyMetricSum()  # full_state_update=True
+    val = metric(jnp.asarray(1.0))
+    assert val == 1
+    assert metric.x == 1
+    val = metric(jnp.asarray(2.0))
+    assert val == 2  # batch value
+    assert metric.x == 3  # global accumulation
+    assert metric.compute() == 3
+
+
+def test_forward_reduce_state():
+    class Fast(DummyMetricSum):
+        full_state_update = False
+
+    metric = Fast()
+    val = metric(jnp.asarray(1.0))
+    assert val == 1
+    assert metric.x == 1
+    val = metric(jnp.asarray(2.0))
+    assert val == 2
+    assert metric.x == 3
+    assert metric.compute() == 3
+    assert metric.update_count == 2
+
+
+def test_forward_reduce_all_reductions():
+    class M(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.asarray(0.0), "sum")
+            self.add_state("m", jnp.asarray(0.0), "mean")
+            self.add_state("mx", jnp.asarray(-1e9), "max")
+            self.add_state("mn", jnp.asarray(1e9), "min")
+            self.add_state("c", [], "cat")
+
+        def update(self, x):
+            self.s = self.s + x
+            self.m = x
+            self.mx = jnp.maximum(self.mx, x)
+            self.mn = jnp.minimum(self.mn, x)
+            self.c.append(x)
+
+        def compute(self):
+            return self.s
+
+    metric = M()
+    metric(jnp.asarray(2.0))
+    metric(jnp.asarray(4.0))
+    assert metric.s == 6
+    assert metric.m == 3.0  # running mean of [2, 4]
+    assert metric.mx == 4
+    assert metric.mn == 2
+    assert len(metric.c) == 2
+
+
+def test_pickle():
+    metric = DummyMetricSum()
+    metric.update(1)
+    pickled = pickle.dumps(metric)
+    restored = pickle.loads(pickled)
+    assert restored.x == 1
+    restored.update(2)
+    assert restored.compute() == 3
+
+
+def test_clone():
+    metric = DummyMetricSum()
+    metric.update(2)
+    m2 = metric.clone()
+    m2.update(3)
+    assert metric.x == 2
+    assert m2.x == 5
+
+
+def test_hash():
+    m1 = DummyMetric()
+    m2 = DummyMetric()
+    assert hash(m1) != hash(m2)
+
+    m1 = DummyListMetric()
+    m2 = DummyListMetric()
+    assert hash(m1) != hash(m2)
+    assert isinstance(m1.x, list) and len(m1.x) == 0
+    m1.x.append(jnp.asarray(5))
+    hash(m1)  # hashing with state must not fail
+
+
+def test_metadata_immutable():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.higher_is_better = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.full_state_update = False
+
+
+def test_metric_scripts():
+    """set_dtype casts states; float()/half() are no-ops (reference semantics)."""
+    metric = DummyMetricSum()
+    metric.update(jnp.asarray(2.0))
+    dtype_before = metric.x.dtype
+    metric.half()
+    assert metric.x.dtype == dtype_before
+    metric.set_dtype(jnp.bfloat16)
+    assert metric.x.dtype == jnp.bfloat16
+
+
+def test_filter_kwargs():
+    class M(DummyMetric):
+        def update(self, preds, target):
+            pass
+
+    m = M()
+    assert m._filter_kwargs(preds=1, target=2, other=3) == {"preds": 1, "target": 2}
+
+
+def test_composition():
+    m1 = DummyMetricSum()
+    m2 = DummyMetricSum()
+    comp = m1 + m2
+    m1.update(2)
+    m2.update(3)
+    assert comp.compute() == 5
+
+    comp2 = m1 + 10.0
+    assert comp2.compute() == 12
+
+    comp3 = abs(-1.0 * m1)
+    assert comp3.compute() == 2
+
+    comp4 = m1**2
+    assert comp4.compute() == 4
+
+
+def test_composition_forward():
+    m1 = DummyMetricSum(compute_with_cache=False)
+    m2 = DummyMetricSum(compute_with_cache=False)
+    comp = m1 + m2
+    out = comp(jnp.asarray(1.0))
+    assert out == 2
+    comp.reset()
+    assert m1.compute() == 0
+
+
+def test_error_on_compute_before_unsync():
+    metric = DummyMetricSum()
+    metric.update(2)
+
+    def fake_gather(x, group=None):
+        return [x, x]
+
+    metric.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+    assert metric._is_synced
+    assert metric.x == 4  # 2 ranks each with 2
+
+    with pytest.raises(TorchMetricsUserError, match="The Metric shouldn't be synced when performing"):
+        metric(jnp.asarray(1.0))
+
+    metric.unsync()
+    assert metric.x == 2
+    with pytest.raises(TorchMetricsUserError, match="has already been un-synced"):
+        metric.unsync()
+
+
+def test_sync_context():
+    metric = DummyMetricSum()
+    metric.update(3)
+
+    def fake_gather(x, group=None):
+        return [x, x, x]
+
+    with metric.sync_context(dist_sync_fn=fake_gather, distributed_available=lambda: True):
+        assert metric.x == 9
+    assert metric.x == 3
+
+
+def test_sync_list_state():
+    metric = DummyListMetric()
+    metric.update(jnp.asarray([1.0, 2.0]))
+    metric.update(jnp.asarray([3.0]))
+
+    def fake_gather(x, group=None):
+        return [x, x]
+
+    with metric.sync_context(dist_sync_fn=fake_gather, distributed_available=lambda: True):
+        cat = jnp.concatenate([jnp.atleast_1d(v) for v in metric.x]) if isinstance(metric.x, list) else metric.x
+        assert cat.shape == (6,)
+    assert len(metric.x) == 2
+
+
+def test_compute_uses_sync(monkeypatch):
+    metric = DummyMetricSum(
+        dist_sync_fn=lambda x, group=None: [x, x],
+        distributed_available_fn=lambda: True,
+    )
+    metric.update(5)
+    assert metric.compute() == 10  # synced across 2 fake ranks
+    assert metric.x == 5  # unsynced after compute
+
+
+def test_sync_on_compute_off():
+    metric = DummyMetricSum(
+        sync_on_compute=False,
+        dist_sync_fn=lambda x, group=None: [x, x],
+        distributed_available_fn=lambda: True,
+    )
+    metric.update(5)
+    assert metric.compute() == 5
+
+
+def test_multioutput():
+    m = DummyMetricMultiOutput()
+    m.update(jnp.asarray(3.0))
+    out = m.compute()
+    assert len(out) == 2
+    assert out[0] == 3 and out[1] == 3
+
+
+def test_state_dict_roundtrip():
+    m = DummyMetricSum()
+    m.persistent(True)
+    m.update(jnp.asarray(7.0))
+    sd = m.state_dict()
+    m2 = DummyMetricSum()
+    m2.load_state_dict(sd)
+    assert m2.compute() == 7
+
+
+def test_device_placement():
+    import jax
+
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m.to(jax.devices()[0])
+    assert m.compute() == 1
+
+
+def test_merge_state():
+    a = DummyMetricSum()
+    b = DummyMetricSum()
+    a.update(2)
+    b.update(3)
+    a.merge_state(b)
+    assert a.compute() == 5
+    assert a.update_count == 2
+
+    a = DummyListMetric()
+    b = DummyListMetric()
+    a.update(jnp.asarray([1.0]))
+    b.update(jnp.asarray([2.0]))
+    a.merge_state({"x": b.x})
+    assert len(a.x) == 2
+
+
+def test_merge_state_mean_weighted():
+    """Mean states merge weighted by update counts (3 updates of mean 4 + 1 of mean 10 -> 5.5)."""
+
+    class MeanState(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("m", jnp.asarray(0.0), "mean")
+
+        def update(self, x):
+            self.m = jnp.asarray(x, dtype=jnp.float32)
+
+        def compute(self):
+            return self.m
+
+    a = MeanState()
+    for _ in range(3):
+        a.update(4.0)
+    a.m = jnp.asarray(4.0)
+    b = MeanState()
+    b.update(10.0)
+    a.merge_state(b)
+    assert np.allclose(a.m, (3 * 4.0 + 1 * 10.0) / 4)
+    assert a.update_count == 4

@@ -1,0 +1,6 @@
+__version__ = "1.0.0rc0"
+__author__ = "torchmetrics-tpu contributors"
+__license__ = "Apache-2.0"
+__docs__ = "TPU-native (JAX/XLA) metrics framework with torchmetrics capability parity"
+
+__all__ = ["__author__", "__docs__", "__license__", "__version__"]

@@ -1,0 +1,14 @@
+"""TPU-native metrics framework (capability parity with the torchmetrics reference).
+
+Flat public API mirroring reference ``src/torchmetrics/__init__.py`` — grows as domains
+land.
+"""
+
+from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu.metric import CompositionalMetric, Metric
+
+__all__ = [
+    "CompositionalMetric",
+    "Metric",
+    "__version__",
+]

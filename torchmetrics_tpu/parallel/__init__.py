@@ -1,0 +1,23 @@
+"""TPU-native communication backend (mesh collectives; SURVEY §5.8)."""
+
+from torchmetrics_tpu.parallel.sync import (
+    EvalMesh,
+    axis_gather,
+    axis_max,
+    axis_mean,
+    axis_min,
+    axis_sum,
+    gather_all_tensors,
+    jit_distributed_available,
+)
+
+__all__ = [
+    "EvalMesh",
+    "axis_gather",
+    "axis_max",
+    "axis_mean",
+    "axis_min",
+    "axis_sum",
+    "gather_all_tensors",
+    "jit_distributed_available",
+]

@@ -1,0 +1,161 @@
+"""Cross-chip state synchronization — the communication backend.
+
+Capability parity: reference ``src/torchmetrics/utilities/distributed.py:90-146`` +
+``metric.py:386-416``, whose single primitive is ``torch.distributed.all_gather`` over
+NCCL/gloo process groups, with ragged tensors handled by gather-shapes → pad → gather →
+trim.
+
+TPU-native design (three sync modes, §5.8 of SURVEY):
+
+1. **In-graph mesh-axis collectives** (`axis_gather`/`axis_sum`/...): for metric states
+   living inside ``shard_map``/``pmap`` over a ``jax.sharding.Mesh`` — lowers to XLA
+   ``all-gather``/``all-reduce`` riding the ICI. Sum-reducible states use ``psum``
+   (one all-reduce) instead of the reference's gather-then-sum (world-size bandwidth).
+2. **Host/process collectives** (`gather_all_tensors`): for multi-process (multi-host
+   pod) programs outside jit — built on ``jax.experimental.multihost_utils``. The
+   ``process_group`` concept generalizes to a sub-mesh of processes.
+3. **Global-array mode**: with pjit + globally-sharded inputs, XLA inserts the
+   collectives automatically — no explicit sync is needed; ``distributed_available``
+   then reports False and sync is a no-op, which is correct by construction.
+
+Pluggable exactly like the reference: ``Metric(dist_sync_fn=...)`` receives any
+callable ``(tensor, group) -> list[tensor]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+__all__ = [
+    "jit_distributed_available",
+    "gather_all_tensors",
+    "axis_gather",
+    "axis_sum",
+    "axis_mean",
+    "axis_max",
+    "axis_min",
+    "EvalMesh",
+]
+
+
+def jit_distributed_available() -> bool:
+    """Is there more than one process? (reference ``metric.py:41-43``)."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------------------------------
+# Mode 1 — in-graph collectives over a named mesh axis (ICI path)
+# --------------------------------------------------------------------------------------
+
+def axis_gather(x: Array, axis_name: str) -> Array:
+    """``all_gather`` over a mesh axis; result has a new leading world dim."""
+    return lax.all_gather(x, axis_name)
+
+
+def axis_sum(x: Array, axis_name: str) -> Array:
+    return lax.psum(x, axis_name)
+
+
+def axis_mean(x: Array, axis_name: str) -> Array:
+    return lax.pmean(x, axis_name)
+
+
+def axis_max(x: Array, axis_name: str) -> Array:
+    return lax.pmax(x, axis_name)
+
+
+def axis_min(x: Array, axis_name: str) -> Array:
+    return lax.pmin(x, axis_name)
+
+
+# --------------------------------------------------------------------------------------
+# Mode 2 — host-level process collectives (DCN / multi-host path)
+# --------------------------------------------------------------------------------------
+
+def _simple_gather_all_tensors(result: Array, group: Any, world_size: int) -> List[Array]:
+    """Equal-shape gather (reference ``distributed.py:90-94``)."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(result, tiled=False)
+    return [gathered[i] for i in range(world_size)]
+
+
+def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """Gather one (possibly ragged along dim 0) array from every process.
+
+    Mirrors reference ``utilities/distributed.py:96-146``: gather shapes first; if all
+    equal do the plain gather; otherwise pad every local tensor to the elementwise max
+    shape, gather, and trim each result back to its true shape. Works on any pytree
+    leaf; assumes equal rank across processes (as the reference does).
+
+    ``group`` (the reference's ``process_group``) may be a sequence of process indices
+    defining a sub-world: the gather still rides the full-world collective (DCN
+    bandwidth is the same), but only the group's members are returned, so reductions
+    see exactly the sub-world state.
+    """
+    if not jit_distributed_available():
+        return [result]
+    from jax.experimental import multihost_utils
+
+    world_size = jax.process_count()
+    members = list(range(world_size)) if group is None else [int(i) for i in group]
+    result = jnp.asarray(result)
+
+    local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
+    all_shapes = multihost_utils.process_allgather(local_shape, tiled=False)
+    all_shapes = [tuple(int(d) for d in all_shapes[i]) for i in range(world_size)]
+
+    if all(all_shapes[i] == all_shapes[members[0]] for i in members):
+        gathered = multihost_utils.process_allgather(result, tiled=False)
+        return [gathered[i] for i in members]
+
+    max_shape = tuple(max(all_shapes[i][d] for i in members) for d in range(result.ndim))
+    pad = [(0, m - s) for m, s in zip(max_shape, result.shape)]
+    padded = jnp.pad(result, pad)
+    gathered = multihost_utils.process_allgather(padded, tiled=False)
+    out = []
+    for i in members:
+        slices = tuple(slice(0, d) for d in all_shapes[i])
+        out.append(gathered[i][slices])
+    return out
+
+
+# --------------------------------------------------------------------------------------
+# Mode 3 helper — single-process multi-device evaluation mesh
+# --------------------------------------------------------------------------------------
+
+class EvalMesh:
+    """Convenience wrapper producing a 1-D data-parallel mesh over local devices.
+
+    Used by tests and benches to emulate an N-chip pod: 8 virtual CPU devices via
+    ``--xla_force_host_platform_device_count=8`` (SURVEY §4 "TPU-build translation").
+    """
+
+    def __init__(self, n_devices: Optional[int] = None, axis: str = "data"):
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+        self.axis = axis
+        self.mesh = jax.sharding.Mesh(devices, (axis,))
+
+    @property
+    def size(self) -> int:
+        return self.mesh.devices.size
+
+    def shard_batch(self, x: Array) -> Array:
+        """Shard dim 0 of a host array across the mesh."""
+        sharding = jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec(self.axis))
+        return jax.device_put(x, sharding)
+
+    def replicate(self, x: Array) -> Array:
+        sharding = jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
+        return jax.device_put(x, sharding)

@@ -1,0 +1,35 @@
+"""Core utilities (reference ``src/torchmetrics/utilities/__init__.py``)."""
+
+from torchmetrics_tpu.utilities.checks import check_forward_full_state_property
+from torchmetrics_tpu.utilities.data import (
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from torchmetrics_tpu.utilities.distributed import class_reduce, gather_all_tensors, reduce
+from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "apply_to_collection",
+    "check_forward_full_state_property",
+    "class_reduce",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "gather_all_tensors",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+    "reduce",
+    "select_topk",
+    "to_categorical",
+    "to_onehot",
+]

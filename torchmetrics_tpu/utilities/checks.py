@@ -1,0 +1,137 @@
+"""Input validation helpers.
+
+Capability parity: reference ``src/torchmetrics/utilities/checks.py`` (790 LoC). All
+checks here run on the host *outside* jit (they raise Python exceptions); metrics gate
+them behind ``validate_args`` exactly like the reference so the jitted hot path carries
+zero validation overhead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ (reference ``checks.py:39-44``)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _is_floating(x: Array) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _is_integral(x: Array) -> bool:
+    d = jnp.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.integer) or jnp.issubdtype(d, jnp.bool_)
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Check and flatten retrieval functional inputs (reference ``checks.py:478-508``)."""
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0:
+        raise ValueError("`preds` and `target` must be non-empty")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+
+
+def _check_retrieval_inputs(
+    indexes: Array, preds: Array, target: Array, allow_non_binary_target: bool = False, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Check retrieval (indexes, preds, target) triple (reference ``checks.py:535-580``)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if indexes.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+    if not _is_integral(indexes) or jnp.issubdtype(indexes.dtype, jnp.bool_):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if ignore_index is not None:
+        valid = target != ignore_index
+        indexes, preds, target = indexes[valid], preds[valid], target[valid]
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+    return indexes.reshape(-1).astype(jnp.int32), preds, target
+
+
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool
+) -> Tuple[Array, Array]:
+    """Reference ``checks.py:583-607``."""
+    if _is_floating(target):
+        if not allow_non_binary_target:
+            raise ValueError("`target` must be a tensor of booleans or integers")
+    elif not _is_integral(target):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not allow_non_binary_target and bool(jnp.any((target > 1) | (target < 0))):
+        raise ValueError("`target` must contain `binary` values")
+    t = target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
+    return preds.reshape(-1).astype(jnp.float32), t.reshape(-1)
+
+
+def check_forward_full_state_property(
+    metric_class: type,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare: Tuple[int, ...] = (10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Empirically compare full-state vs reduced-state ``forward`` (reference ``checks.py:629-759``).
+
+    Checks that the two forward paths agree numerically and reports which is faster, so
+    metric authors can set ``full_state_update`` correctly.
+    """
+    import time
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    fullstate = type("_FullState", (metric_class,), {"full_state_update": True})(**init_args)
+    partstate = type("_PartState", (metric_class,), {"full_state_update": False})(**init_args)
+
+    equal = True
+    for _ in range(max(num_update_to_compare)):
+        out1 = fullstate(**input_args)
+        out2 = partstate(**input_args)
+        equal = equal and bool(
+            jax.tree_util.tree_all(
+                jax.tree_util.tree_map(lambda a, b: np.allclose(np.asarray(a), np.asarray(b), atol=1e-6), out1, out2)
+            )
+        )
+    if not equal:
+        print("Full state and reduced state `forward` disagree: `full_state_update=True` is required.")
+        return
+
+    res = [[], []]
+    for i, metric in enumerate([fullstate, partstate]):
+        for _ in range(reps):
+            start = time.perf_counter()
+            for _ in range(min(num_update_to_compare)):
+                metric(**input_args)
+            res[i].append(time.perf_counter() - start)
+    faster = bool(np.mean(res[1]) < np.mean(res[0]))
+    print(
+        f"Full state update: {np.mean(res[0]):.4g}s, reduced state update: {np.mean(res[1]):.4g}s."
+        f" Recommended setting: `full_state_update={not faster}`."
+    )
+
+
+def _allclose_recursive(res1: Any, res2: Any, atol: float = 1e-6) -> bool:
+    """Pytree-recursive allclose (reference ``checks.py:612-626``)."""
+    leaves1 = jax.tree_util.tree_leaves(res1)
+    leaves2 = jax.tree_util.tree_leaves(res2)
+    if len(leaves1) != len(leaves2):
+        return False
+    return all(np.allclose(np.asarray(a), np.asarray(b), atol=atol) for a, b in zip(leaves1, leaves2))

@@ -1,0 +1,169 @@
+"""Core data/reduction primitives on jax.numpy.
+
+Capability parity: reference ``src/torchmetrics/utilities/data.py`` (278 LoC). Key
+TPU-first divergences:
+
+* ``_bincount`` — the reference falls back to a Python loop under XLA
+  (``data.py:211-241``); here bincount is a single ``scatter-add`` (``.at[].add``),
+  which XLA lowers deterministically and tiles onto the VPU. No fallback needed.
+* ``dim_zero_cat`` accepts tuples/lists of arrays (our "cat" states are host-managed
+  lists of device arrays) and concatenates with one XLA op.
+* ``apply_to_collection`` is implemented on ``jax.tree_util`` so arbitrary pytrees of
+  states map in one pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+METRIC_EPS = 1e-6
+
+
+def dim_zero_cat(x: Union[Array, Sequence[Array]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0 (reference ``data.py:28-38``)."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    x = [y if y.ndim else y.reshape(1) for y in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    """Summation along dim 0 (reference ``data.py:41-43``)."""
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    """Average along dim 0 (reference ``data.py:46-48``)."""
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    """Max along dim 0 (reference ``data.py:51-53``)."""
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    """Min along dim 0 (reference ``data.py:56-58``)."""
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists into one list (reference ``data.py:61-63``)."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: dict) -> Tuple[dict, bool]:
+    """Flatten dict of dicts into one level (reference ``data.py:66-72``)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert integer labels ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Reference ``data.py:75-106`` uses ``scatter_``; here ``jax.nn.one_hot`` emits a
+    compare-broadcast that XLA fuses (MXU/VPU friendly, no scatter at all).
+    """
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int64 if label_tensor.dtype == jnp.int64 else jnp.int32)
+    # (N, ..., C) -> (N, C, ...)
+    return jnp.moveaxis(oh, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference ``data.py:109-132``)."""
+    if topk == 1:  # argmax fast path — single reduce, no sort
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/logits to categorical labels via argmax (reference ``data.py:135-150``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all elements of ``dtype`` (reference ``data.py:153-200``)."""
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, dict):
+        return type(data)({k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()})
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, (list, tuple)):
+        return type(data)(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data)
+    return data
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.reshape(()) if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Squeeze size-1 arrays in a collection to scalars (reference ``data.py:207-208``)."""
+    return apply_to_collection(data, (jnp.ndarray, jax.Array), _squeeze_scalar_element_tensor)
+
+
+def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
+    """Deterministic bincount as one scatter-add.
+
+    The reference needs a loop fallback on XLA/MPS/deterministic-CUDA
+    (``data.py:211-241``); on TPU ``zeros.at[x].add(1)`` is already deterministic and
+    compiles to a single fused scatter. ``minlength`` must be static for XLA.
+    """
+    if minlength is None:
+        minlength = int(jnp.max(x)) + 1 if x.size else 1
+    return jnp.zeros(minlength, dtype=jnp.int32).at[x].add(1, mode="drop")
+
+
+def _cumsum(x: Array, dim: int = 0) -> Array:
+    """Cumulative sum; XLA is deterministic so no CPU round-trip (reference ``data.py:244-253``)."""
+    return jnp.cumsum(x, axis=dim)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Bincount over the *unique values present* (reference ``data.py:256-271``).
+
+    Returns counts for each unique value in sorted order — used by retrieval group-by.
+    """
+    _, inverse, counts = jnp.unique(x, return_inverse=True, return_counts=True)
+    del inverse
+    return counts
+
+
+def allclose(tensor1: Array, tensor2: Array, atol: float = 1e-8, rtol: float = 1e-5) -> bool:
+    """Shape-aware allclose (reference ``data.py:274-278``)."""
+    if tensor1.shape != tensor2.shape:
+        return False
+    return bool(jnp.allclose(tensor1, tensor2, atol=atol, rtol=rtol))

@@ -1,0 +1,51 @@
+"""Distributed helpers + generic reductions.
+
+Capability parity: reference ``src/torchmetrics/utilities/distributed.py`` (146 LoC):
+``reduce:20``, ``class_reduce:46``, ``gather_all_tensors:96``. The gather itself lives
+in ``torchmetrics_tpu.parallel.sync`` (the XLA-collective communication backend) and is
+re-exported here so reference import paths keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.parallel.sync import (  # noqa: F401  (re-export)
+    EvalMesh,
+    _simple_gather_all_tensors,
+    gather_all_tensors,
+    jit_distributed_available,
+)
+
+Array = jax.Array
+
+
+def reduce(x: Array, reduction: Optional[str]) -> Array:
+    """Reduce a tensor by 'elementwise_mean' | 'sum' | 'none' (reference ``distributed.py:20-43``)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "none" or reduction is None:
+        return x
+    if reduction == "sum":
+        return jnp.sum(x)
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Per-class fraction reduction 'micro'|'macro'|'weighted'|'none' (reference ``distributed.py:46-87``)."""
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+    # We need to take care of instances where the denom can be 0 — for some classes the fraction becomes nan
+    fraction = jnp.where(jnp.isnan(fraction), 0.0, fraction)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")

@@ -1,0 +1,100 @@
+"""String enums used across the metric packages.
+
+Capability parity: reference ``src/torchmetrics/utilities/enums.py:20-148``.
+Implemented on plain ``str``-``Enum`` (no lightning_utilities dependency): values
+compare case-insensitively against strings and ``from_str`` resolves user input.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """Case-insensitive string enum base (reference ``enums.py:20-52``)."""
+
+    @classmethod
+    def _name(cls) -> str:
+        return "Task"
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> "EnumStr":
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            pass
+        try:
+            return cls(value.lower())
+        except ValueError:
+            raise ValueError(
+                f"Invalid {cls._name()}: expected one of {[e.value for e in cls]}, but got {value}."
+            ) from None
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Type-category of classification inputs (reference ``enums.py:55-70``)."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+    @classmethod
+    def _name(cls) -> str:
+        return "Data type"
+
+
+class AverageMethod(EnumStr):
+    """Reduction over classes (reference ``enums.py:73-94``)."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+    @classmethod
+    def _name(cls) -> str:
+        return "Average method"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class reduction (reference ``enums.py:97-104``)."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """Task router values (reference ``enums.py:107-125``)."""
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoBinary(EnumStr):
+    """Reference ``enums.py:128-137``."""
+
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    """Reference ``enums.py:140-148``."""
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+
+
+def _str_or_none(value: Optional[str]) -> Optional[str]:
+    return None if value is None else str(value)

@@ -1,0 +1,12 @@
+"""Framework exceptions.
+
+Capability parity: reference ``src/torchmetrics/utilities/exceptions.py:1-21``.
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised when a user misuses the metric API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised on suspicious-but-legal metric API usage."""

@@ -1,0 +1,49 @@
+"""Optional-dependency availability flags.
+
+Capability parity: reference ``src/torchmetrics/utilities/imports.py:23-55`` keeps ~25
+flags gating optional metric exports. The TPU build's hard deps are jax/flax/optax
+(baked in); everything else is probed lazily so the framework imports with zero optional
+packages installed.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import operator
+import sys
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_PYTHON_GREATER_EQUAL_3_8 = sys.version_info >= (3, 8)
+
+_JAX_AVAILABLE = _package_available("jax")
+_FLAX_AVAILABLE = _package_available("flax")
+_TORCH_AVAILABLE = _package_available("torch")
+_NUMPY_AVAILABLE = _package_available("numpy")
+_SCIPY_AVAILABLE = _package_available("scipy")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_MATPLOTLIB_AVAILABLE = _package_available("matplotlib")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_NLTK_AVAILABLE = _package_available("nltk")
+_REGEX_AVAILABLE = _package_available("regex")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")
+_TORCHVISION_AVAILABLE = _package_available("torchvision")
+_TORCH_FIDELITY_AVAILABLE = _package_available("torch_fidelity")
+_LPIPS_AVAILABLE = _package_available("lpips")
+_FAST_BSS_EVAL_AVAILABLE = _package_available("fast_bss_eval")
+_MECAB_AVAILABLE = _package_available("MeCab")
+_IPADIC_AVAILABLE = _package_available("ipadic")
+_SENTENCEPIECE_AVAILABLE = _package_available("sentencepiece")
+_PANDAS_AVAILABLE = _package_available("pandas")
+_MULTIPROCESSING_AVAILABLE = True
+
+# The reference special-cases XLA (``imports.py:53``); for us XLA *is* the substrate.
+_XLA_AVAILABLE = True

@@ -1,0 +1,71 @@
+"""Rank-zero-gated printing helpers.
+
+Capability parity: reference ``src/torchmetrics/utilities/prints.py:22-71``. On TPU the
+process index comes from ``jax.process_index()`` (falling back to the ``LOCAL_RANK`` env
+var so launcher scripts behave identically), not ``torch.distributed``.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+
+def _get_rank() -> int:
+    rank = os.environ.get("LOCAL_RANK", None)
+    if rank is not None:
+        return int(rank)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on global rank zero (reference ``prints.py:22-38``)."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, category: type = UserWarning, stacklevel: int = 5, **kwargs: Any) -> None:
+    warnings.warn(message, category=category, stacklevel=stacklevel, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, **kwargs: Any) -> None:
+    print(message, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, **kwargs: Any) -> None:
+    if os.environ.get("TM_TPU_DEBUG"):
+        print(message, **kwargs)
+
+
+def _deprecated_root_import_class(name: str, domain: str) -> None:
+    """Warn that root import of a domain metric class is deprecated (ref ``prints.py:59-65``)."""
+    rank_zero_warn(
+        f"`torchmetrics_tpu.{name}` was deprecated and will be removed in 2.0."
+        f" Import `torchmetrics_tpu.{domain}.{name}` instead.",
+        DeprecationWarning,
+    )
+
+
+def _deprecated_root_import_func(name: str, domain: str) -> None:
+    """Warn that root import of a domain functional is deprecated (ref ``prints.py:66-71``)."""
+    rank_zero_warn(
+        f"`torchmetrics_tpu.functional.{name}` was deprecated and will be removed in 2.0."
+        f" Import `torchmetrics_tpu.functional.{domain}.{name}` instead.",
+        DeprecationWarning,
+    )
