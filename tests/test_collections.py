@@ -1,0 +1,124 @@
+"""MetricCollection tests (modeled on reference ``tests/unittests/bases/test_collections.py``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+
+NUM_CLASSES = 5
+
+
+def _data(n_batches=3, batch=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        [jnp.asarray(rng.randint(0, NUM_CLASSES, batch)) for _ in range(n_batches)],
+        [jnp.asarray(rng.randint(0, NUM_CLASSES, batch)) for _ in range(n_batches)],
+    )
+
+
+def test_compute_groups_share_state_and_match_individual():
+    preds, targets = _data()
+    mc = MetricCollection(
+        [
+            MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            MulticlassPrecision(NUM_CLASSES, average="macro"),
+            MulticlassRecall(NUM_CLASSES, average="macro"),
+            MulticlassF1Score(NUM_CLASSES, average="macro"),
+        ]
+    )
+    singles = {
+        "MulticlassAccuracy": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+        "MulticlassPrecision": MulticlassPrecision(NUM_CLASSES, average="macro"),
+        "MulticlassRecall": MulticlassRecall(NUM_CLASSES, average="macro"),
+        "MulticlassF1Score": MulticlassF1Score(NUM_CLASSES, average="macro"),
+    }
+    for p, t in zip(preds, targets):
+        mc.update(p, t)
+        for m in singles.values():
+            m.update(p, t)
+    # all 4 share identical stat-score states → one compute group
+    assert len(mc.compute_groups) == 1
+    res = mc.compute()
+    for k, m in singles.items():
+        np.testing.assert_allclose(np.asarray(res[k]), np.asarray(m.compute()), atol=1e-7)
+
+
+def test_forward_returns_batch_values():
+    preds, targets = _data(seed=1)
+    mc = MetricCollection([MulticlassAccuracy(NUM_CLASSES, average="micro")])
+    out = mc(preds[0], targets[0])
+    single = MulticlassAccuracy(NUM_CLASSES, average="micro")
+    expected = single(preds[0], targets[0])
+    np.testing.assert_allclose(np.asarray(out["MulticlassAccuracy"]), np.asarray(expected))
+
+
+def test_prefix_postfix_and_clone():
+    preds, targets = _data(seed=2)
+    mc = MetricCollection([MulticlassAccuracy(NUM_CLASSES)], prefix="train_")
+    mc.update(preds[0], targets[0])
+    assert "train_MulticlassAccuracy" in mc.compute()
+    mc2 = mc.clone(prefix="val_")
+    assert "val_MulticlassAccuracy" in mc2.compute()
+
+
+def test_dict_input_and_duplicate_names():
+    mc = MetricCollection(
+        {
+            "micro": MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "macro": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+        }
+    )
+    preds, targets = _data(seed=3)
+    mc.update(preds[0], targets[0])
+    res = mc.compute()
+    assert set(res) == {"micro", "macro"}
+    with pytest.raises(ValueError, match="both named"):
+        MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassAccuracy(NUM_CLASSES)])
+
+
+def test_user_specified_compute_groups():
+    mc = MetricCollection(
+        MulticlassRecall(NUM_CLASSES, average="macro"),
+        MulticlassPrecision(NUM_CLASSES, average="macro"),
+        MulticlassAccuracy(NUM_CLASSES, average="micro"),
+        compute_groups=[["MulticlassRecall", "MulticlassPrecision"], ["MulticlassAccuracy"]],
+    )
+    preds, targets = _data(seed=4)
+    for p, t in zip(preds, targets):
+        mc.update(p, t)
+    assert mc.compute_groups == {0: ["MulticlassRecall", "MulticlassPrecision"], 1: ["MulticlassAccuracy"]}
+    singles = MulticlassPrecision(NUM_CLASSES, average="macro")
+    for p, t in zip(preds, targets):
+        singles.update(p, t)
+    np.testing.assert_allclose(
+        np.asarray(mc.compute()["MulticlassPrecision"]), np.asarray(singles.compute()), atol=1e-7
+    )
+
+
+def test_items_values_break_state_sharing_safely():
+    mc = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")]
+    )
+    preds, targets = _data(seed=5)
+    mc.update(preds[0], targets[0])
+    for _, m in mc.items():  # triggers copy_state path
+        assert m.update_count >= 1
+    mc.update(preds[1], targets[1])  # re-establishes refs
+    res = mc.compute()
+    assert set(res) == {"MulticlassPrecision", "MulticlassRecall"}
+
+
+def test_reset():
+    mc = MetricCollection([MulticlassAccuracy(NUM_CLASSES)])
+    preds, targets = _data(seed=6)
+    mc.update(preds[0], targets[0])
+    mc.reset()
+    for m in mc.values(copy_state=False):
+        assert m.update_count == 0

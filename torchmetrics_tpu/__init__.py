@@ -5,10 +5,86 @@ land.
 """
 
 from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from torchmetrics_tpu.classification import (
+    AUROC,
+    Accuracy,
+    AveragePrecision,
+    CalibrationError,
+    CohenKappa,
+    Dice,
+    ExactMatch,
+    HingeLoss,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    PrecisionRecallCurve,
+    ROC,
+    ConfusionMatrix,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
+from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
+from torchmetrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
 
 __all__ = [
+    "AUROC",
+    "Accuracy",
+    "AveragePrecision",
+    "CalibrationError",
+    "CohenKappa",
+    "Dice",
+    "ExactMatch",
+    "HingeLoss",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "PrecisionRecallCurve",
+    "ROC",
+    "CatMetric",
     "CompositionalMetric",
+    "ConfusionMatrix",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "MaxMetric",
+    "MeanMetric",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "Running",
     "Metric",
+    "MetricCollection",
+    "MinMetric",
+    "Precision",
+    "Recall",
+    "RunningMean",
+    "RunningSum",
+    "Specificity",
+    "StatScores",
+    "SumMetric",
     "__version__",
 ]

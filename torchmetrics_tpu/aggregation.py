@@ -1,0 +1,178 @@
+"""Scalar-stream aggregators with NaN policy.
+
+Capability parity: reference ``src/torchmetrics/aggregation.py`` (``BaseAggregator:30``,
+``MaxMetric:100``, ``MinMetric:200``, ``SumMetric:300``, ``CatMetric:399``,
+``MeanMetric:459``, ``RunningMean:573``, ``RunningSum:629``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+from torchmetrics_tpu.wrappers.running import Running
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base aggregator: one ``value`` state + NaN strategy (reference ``aggregation.py:30-97``).
+
+    ``nan_strategy``: ``'error'`` raises, ``'warn'`` warns and removes, ``'ignore'``
+    silently removes, a float imputes.
+    """
+
+    value: Array
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy}"
+                f" but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
+        """To float array + NaN policy (reference ``aggregation.py:70-97``).
+
+        NaN detection/removal is an eager host-side step (aggregator updates are tiny);
+        the float-impute path stays branch-free device code.
+        """
+        x = jnp.asarray(x, dtype=jnp.float32)
+        if isinstance(self.nan_strategy, float):
+            return jnp.nan_to_num(x, nan=self.nan_strategy)
+        nans = np.isnan(np.asarray(x))
+        if nans.any():
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encounted `nan` values in tensor")
+            if self.nan_strategy == "warn":
+                rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+            x = jnp.asarray(np.asarray(x).flatten()[~nans.flatten()], dtype=jnp.float32)
+        return x
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Overwrite in child class."""
+
+    def compute(self) -> Array:
+        """Return the aggregated value."""
+        return self.value
+
+    def plot(self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MaxMetric(BaseAggregator):
+    """Running max of a value stream (reference ``aggregation.py:100``)."""
+
+    full_state_update: bool = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Fold batch max into state."""
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min of a value stream (reference ``aggregation.py:200``)."""
+
+    full_state_update: bool = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Fold batch min into state."""
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum of a value stream (reference ``aggregation.py:300``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Add batch sum into state."""
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = self.value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference ``aggregation.py:399``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Append batch values."""
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        """Concatenated values."""
+        if isinstance(self.value, list) and self.value:
+            return jnp.concatenate([jnp.atleast_1d(v) for v in self.value])
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:459-560``)."""
+
+    weight: Array
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        """Accumulate weighted sum + weight total; ``weight`` broadcasts to ``value``."""
+        value = self._cast_and_nan_check_input(value)
+        weight = self._cast_and_nan_check_input(weight)
+        if value.size == 0:
+            return
+        weight = jnp.broadcast_to(weight, value.shape)
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        """Weighted mean."""
+        return self.value / self.weight
+
+
+class RunningMean(Running):
+    """Mean over a running window (reference ``aggregation.py:573``)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)
+
+
+class RunningSum(Running):
+    """Sum over a running window (reference ``aggregation.py:629``)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

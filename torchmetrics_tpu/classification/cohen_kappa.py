@@ -1,0 +1,100 @@
+"""Modular CohenKappa metrics (reference ``classification/cohen_kappa.py``) — ConfusionMatrix subclasses."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from torchmetrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
+from torchmetrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce, _validate_weights
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Kappa for binary tasks (reference ``cohen_kappa.py``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(threshold, ignore_index, normalize=None, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_weights(weights)
+        self.weights = weights
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        """Kappa from the accumulated confmat."""
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    """Kappa for multiclass tasks (reference ``cohen_kappa.py``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, ignore_index, normalize=None, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_weights(weights)
+        self.weights = weights
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        """Kappa from the accumulated confmat."""
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class CohenKappa:
+    """Task router (reference ``cohen_kappa.py`` legacy class)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        weights: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"weights": weights, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCohenKappa(threshold, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCohenKappa(num_classes, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

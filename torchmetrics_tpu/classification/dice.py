@@ -1,0 +1,93 @@
+"""Modular Dice metric (reference ``classification/dice.py`` — legacy-format metric)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.dice import (
+    _dice_compute,
+    _dice_format,
+    _dice_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+class Dice(Metric):
+    """Dice score with legacy auto-format inputs (reference ``dice.py``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        zero_division: float = 0.0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed:
+            raise ValueError(f"The `average` has to be one of {allowed}, got {average}.")
+        if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.mdmc_average = mdmc_average
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+        self._samplewise = mdmc_average == "samplewise" or average == "samples"
+        if self._samplewise:
+            for name in ("tp", "fp", "fn"):
+                self.add_state(name, [], dist_reduce_fx="cat")
+        else:
+            size = num_classes if num_classes else 2
+            for name in ("tp", "fp", "fn"):
+                self.add_state(name, jnp.zeros(size, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate tp/fp/fn counts."""
+        preds_oh, target_oh = _dice_format(preds, target, self.threshold, self.top_k, self.num_classes)
+        tp, fp, fn = _dice_update(
+            preds_oh, target_oh, self.ignore_index, "samplewise" if self._samplewise else None
+        )
+        if self._samplewise:
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.fn.append(fn)
+        else:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.fn = self.fn + fn
+
+    def compute(self) -> Array:
+        """Averaged dice score."""
+        from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+        tp = dim_zero_cat(self.tp) if isinstance(self.tp, list) else self.tp
+        fp = dim_zero_cat(self.fp) if isinstance(self.fp, list) else self.fp
+        fn = dim_zero_cat(self.fn) if isinstance(self.fn, list) else self.fn
+        if self.mdmc_average == "samplewise" and self.average != "samples":
+            per_sample = _safe_divide(2 * tp.sum(-1), 2 * tp.sum(-1) + fp.sum(-1) + fn.sum(-1), self.zero_division)
+            return per_sample.mean()
+        return _dice_compute(tp, fp, fn, average=self.average, zero_division=self.zero_division)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,139 @@
+"""Modular group-fairness metrics (reference ``classification/group_fairness.py``).
+
+State: per-group tp/fp/tn/fn sum tensors of fixed shape (num_groups,) — one psum each
+at sync (reference ``_AbstractGroupStatScores:35``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_reduce,
+    _groups_stat_transform,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _AbstractGroupStatScores(Metric):
+    """Shared per-group counter states (reference ``group_fairness.py:35-52``)."""
+
+    tp: Array
+    fp: Array
+    tn: Array
+    fn: Array
+
+    def _create_states(self, num_groups: int) -> None:
+        default = lambda: jnp.zeros(num_groups, dtype=jnp.int32)  # noqa: E731
+        for name in ("tp", "fp", "tn", "fn"):
+            self.add_state(name, default(), dist_reduce_fx="sum")
+
+    def _update_states(self, group_stats) -> None:
+        self.tp = self.tp + jnp.stack([s[0] for s in group_stats])
+        self.fp = self.fp + jnp.stack([s[1] for s in group_stats])
+        self.tn = self.tn + jnp.stack([s[2] for s in group_stats])
+        self.fn = self.fn + jnp.stack([s[3] for s in group_stats])
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """Per-group stat rates (reference ``group_fairness.py:54-146``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        """Accumulate per-group counters."""
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        """Per-group [tp, fp, tn, fn] rates."""
+        group_stats = [(self.tp[i], self.fp[i], self.tn[i], self.fn[i]) for i in range(self.num_groups)]
+        return _groups_reduce(group_stats)
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity (reference ``group_fairness.py:149-286``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ["demographic_parity", "equal_opportunity", "all"]:
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.task = task
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Optional[Array] = None, groups: Optional[Array] = None) -> None:
+        """Accumulate per-group counters (``target`` ignored for demographic parity)."""
+        if groups is None:
+            raise ValueError("Expected argument `groups` to be provided")
+        if self.task == "demographic_parity":
+            if target is not None:
+                from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+            target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        """Fairness ratios keyed by min/max group ids."""
+        transformed = _groups_stat_transform(
+            [(self.tp[i], self.fp[i], self.tn[i], self.fn[i]) for i in range(self.num_groups)]
+        )
+        out: Dict[str, Array] = {}
+        if self.task in ("demographic_parity", "all"):
+            out.update(_compute_binary_demographic_parity(**transformed))
+        if self.task in ("equal_opportunity", "all"):
+            out.update(_compute_binary_equal_opportunity(**transformed))
+        return out

@@ -1,0 +1,91 @@
+"""Modular multilabel ranking metrics (reference ``classification/ranking.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import _multilabel_confusion_matrix_format
+from torchmetrics_tpu.functional.classification.ranking import (
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+    _ranking_reduce,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _AbstractRanking(Metric):
+    """Shared measure/total state plumbing (reference ``ranking.py`` modular classes)."""
+
+    is_differentiable: bool = False
+    full_state_update: bool = False
+
+    measure: Array
+    total: Array
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_confusion_matrix_arg_validation(num_labels, threshold=0.0, ignore_index=ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    _update_fn = None  # set in subclasses
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate one batch."""
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target = _multilabel_confusion_matrix_format(
+            preds, target, self.num_labels, threshold=0.0, ignore_index=self.ignore_index, should_threshold=False
+        )
+        measure, total = type(self)._update_fn(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Averaged ranking measure."""
+        return _ranking_reduce(self.measure, self.total)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class MultilabelCoverageError(_AbstractRanking):
+    """Coverage error (reference ``ranking.py``)."""
+
+    higher_is_better: bool = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_AbstractRanking):
+    """Label ranking average precision (reference ``ranking.py``)."""
+
+    higher_is_better: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_AbstractRanking):
+    """Label ranking loss (reference ``ranking.py``)."""
+
+    higher_is_better: bool = False
+    plot_lower_bound: float = 0.0
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

@@ -1,0 +1,374 @@
+"""MetricCollection with compute groups.
+
+Capability parity: reference ``src/torchmetrics/collections.py`` (618 LoC):
+``update:182``, ``_merge_compute_groups:209``, ``_equal_metric_states:244``,
+``_compute_groups_create_state_ref:269``, ``_compute_and_reduce:292``,
+``add_metrics:356``, group-aware ``keys/items/values:467-494``.
+
+TPU-first twist: states are immutable ``jax.Array``s, so "sharing by reference" is a
+cheap copy of array references from the group leader into members — no aliasing
+hazards, and ``copy_state`` semantics (reference breaks aliasing via deepcopy) are
+automatic because members can never mutate the leader's arrays.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import allclose
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+
+class MetricCollection:
+    """Dict of metrics sharing one call pattern, with automatic compute groups (reference ``collections.py:34``).
+
+    Metrics with identical states (e.g. accuracy/precision/recall over the same
+    stat-scores) form a compute group: only the group leader runs ``update``; members
+    receive the leader's state (array references) lazily.
+    """
+
+    _groups: Dict[int, List[str]]
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._state_is_copy: bool = False
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------------ update paths
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-metric ``forward`` (batch values); kwargs filtered per signature (reference ``:153-160``)."""
+        return self._compute_and_reduce("forward", *args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each compute group's leader only (reference ``collections.py:182-207``)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+            if self._state_is_copy:
+                self._compute_groups_create_state_ref()
+                self._state_is_copy = False
+        else:
+            for m in self.values(copy_state=False):
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._compute_groups_create_state_ref()
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """O(n²) state-equality scan merging groups (reference ``collections.py:209-242``)."""
+        n_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                if len(self._groups) != n_groups:
+                    break
+            if len(self._groups) == n_groups:
+                break
+            n_groups = len(self._groups)
+        self._groups = dict(enumerate(list(self._groups.values())))
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Shape+allclose equality of two metrics' states (reference ``collections.py:244-267``)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) is not type(state2):
+                return False
+            if isinstance(state1, list) and isinstance(state2, list):
+                return len(state1) == len(state2) and all(
+                    s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)
+                )
+            if state1.shape != state2.shape or not allclose(state1, state2):
+                return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Propagate leader state (array refs) to group members (reference ``collections.py:269-286``).
+
+        Arrays are immutable so ``copy`` only matters for list states (shallow-copied).
+        """
+        if not self._state_is_copy:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                for i in range(1, len(cg)):
+                    mi = self._modules[cg[i]]
+                    for state in m0._defaults:
+                        m0_state = getattr(m0, state)
+                        setattr(mi, state, list(m0_state) if copy and isinstance(m0_state, list) else m0_state)
+                    mi._update_count = m0._update_count
+                    mi._computed = None
+        self._state_is_copy = copy
+
+    # ------------------------------------------------------------------ compute
+
+    def compute(self) -> Dict[str, Any]:
+        """Per-metric compute into one flat dict (reference ``collections.py:288-291``)."""
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Reference ``collections.py:292-326``."""
+        result = {}
+        for k, m in self.items(keep_base=True, copy_state=False):
+            if method_name == "compute":
+                res = m.compute()
+            elif method_name == "forward":
+                res = m(*args, **m._filter_kwargs(**kwargs))
+            else:
+                raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
+            if isinstance(res, dict):
+                for key, v in res.items():
+                    if getattr(m, "prefix", None) is not None:
+                        key = f"{m.prefix}{key}"
+                    if getattr(m, "postfix", None) is not None:
+                        key = f"{key}{m.postfix}"
+                    result[key] = v
+            else:
+                result[k] = res
+        return {self._set_name(k): v for k, v in result.items()}
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def reset(self) -> None:
+        """Reset every metric (reference ``collections.py:328-334``)."""
+        for m in self.values(copy_state=False):
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._compute_groups_create_state_ref()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Deep copy, optionally re-prefixed (reference ``collections.py:336-349``)."""
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        """Toggle state persistence for all metrics (reference ``collections.py:351-354``)."""
+        for m in self.values(copy_state=False):
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Flat state dict keyed by metric name."""
+        destination: Dict[str, Any] = {}
+        for k, m in self.items(keep_base=True, copy_state=False):
+            m.state_dict(destination, prefix=f"{k}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        """Restore from ``state_dict``."""
+        for k, m in self.items(keep_base=True, copy_state=False):
+            m.load_state_dict(state_dict, prefix=f"{k}.")
+
+    # ------------------------------------------------------------------ membership
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Register metrics from dict/sequence/instance (reference ``collections.py:356-420``)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `torchmetrics_tpu.Metric` or `torchmetrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `torchmetrics_tpu.Metric` or `torchmetrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        self._modules[k] = v
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected, `Metric`, `MetricCollection` or `dict`/`sequence` of the"
+                f" previous, but got {metrics}"
+            )
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _init_compute_groups(self) -> None:
+        """User-specified or singleton groups (reference ``collections.py:422-441``)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the"
+                            f" collection. Please make sure that {self._enable_compute_groups} matches"
+                            f" {list(self._modules.keys())}"
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self._modules.keys())}
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Current compute groups (reference ``collections.py:443-446``)."""
+        return self._groups
+
+    # ------------------------------------------------------------------ dict protocol
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> OrderedDict:
+        od = OrderedDict()
+        for k, v in self._modules.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        """Metric names (reference ``collections.py:467-475``)."""
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        """(name, metric) pairs; propagates group state first (reference ``collections.py:477-488``)."""
+        self._compute_groups_create_state_ref(copy_state)
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_ordered_dict().items()
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        """Metrics; propagates group state first (reference ``collections.py:490-498``)."""
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
+        """Metric by (renamed) key (reference ``collections.py:500-514``)."""
+        self._compute_groups_create_state_ref(copy_state)
+        if self.prefix or self.postfix:
+            key = key.removeprefix(self.prefix or "").removesuffix(self.postfix or "")
+        return self._modules[key]
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for k, v in self._modules.items():
+            repr_str += f"\n  {k}: {v!r}"
+        if self.prefix:
+            repr_str += f",\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f",\n  postfix={self.postfix}"
+        return repr_str + "\n)"
+
+    def set_dtype(self, dst_type: Any) -> "MetricCollection":
+        """Cast all metric states (reference ``collections.py`` dtype transfer)."""
+        for m in self.values(copy_state=False):
+            m.set_dtype(dst_type)
+        return self
+
+    def to(self, device: Any) -> "MetricCollection":
+        """Move all metric states to ``device``."""
+        for m in self.values(copy_state=False):
+            m.to(device)
+        return self
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None, together: bool = False) -> Any:
+        """Plot all metrics (reference ``collections.py`` plot)."""
+        import matplotlib.pyplot as plt
+
+        if val is None:
+            val = self.compute()
+        if together:
+            from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+            return plot_single_or_multi_val(val, ax=ax)
+        fig_axs = []
+        for k, m in self.items(keep_base=False, copy_state=False):
+            f, a = m.plot(val[k] if isinstance(val, dict) and k in val else None)
+            fig_axs.append((f, a))
+        del plt
+        return fig_axs

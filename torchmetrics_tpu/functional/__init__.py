@@ -1,0 +1,10 @@
+"""Stateless functional metric API (reference ``src/torchmetrics/functional/__init__.py``).
+
+Flat re-export of all domain functionals so ``from torchmetrics_tpu.functional import
+accuracy`` works like the reference's ``torchmetrics.functional`` namespace.
+"""
+
+from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+
+__all__ = list(_classification_all)

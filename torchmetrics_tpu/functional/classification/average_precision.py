@@ -1,0 +1,233 @@
+"""Average precision (reference ``functional/classification/average_precision.py``).
+
+AP = Σ (R_n − R_{n−1}) · P_n over the PR curve — shares the PR-curve dual state.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import _is_state_tensor
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.data import _bincount
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _ap_from_curve(precision: Array, recall: Array) -> Array:
+    return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+
+def _reduce_average_precision(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Reduce per-class APs (reference ``average_precision.py:43-67``)."""
+    if isinstance(precision, (jnp.ndarray, jax.Array)) and not isinstance(precision, (list, tuple)):
+        res = -jnp.sum((recall[:, 1:] - recall[:, :-1]) * precision[:, :-1], axis=1)
+    else:
+        res = jnp.stack([_ap_from_curve(p, r) for p, r in zip(precision, recall)])
+    if average is None or average == "none":
+        return res
+    if bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.sum(jnp.where(idx, res, 0.0)) / jnp.sum(idx)
+    if average == "weighted" and weights is not None:
+        w = jnp.where(idx, weights, 0.0)
+        w = _safe_divide(w, jnp.sum(w))
+        return jnp.sum(jnp.where(idx, res, 0.0) * w)
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Array:
+    """Reference ``average_precision.py:70-77``."""
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return _ap_from_curve(precision, recall)
+
+
+def binary_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """AP for binary tasks (reference ``average_precision.py:80-148``)."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_average_precision_compute(state, thresholds)
+
+
+def _multiclass_average_precision_arg_validation(
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``average_precision.py:151-160``."""
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if average not in ("macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None) but got {average}"
+        )
+
+
+def _multiclass_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Array] = None,
+) -> Array:
+    """Reference ``average_precision.py:163-175``."""
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if thresholds is None:
+        target = state[1]
+        keep = np.asarray(target) >= 0
+        weights = _bincount(jnp.asarray(np.asarray(target)[keep]), minlength=num_classes).astype(jnp.float32)
+    else:
+        weights = state[0][:, 1, :].sum(-1).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=weights)
+
+
+def multiclass_average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """AP for multiclass tasks (reference ``average_precision.py:178-267``)."""
+    if validate_args:
+        _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_average_precision_compute(state, num_classes, average, thresholds)
+
+
+def _multilabel_average_precision_arg_validation(
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``average_precision.py:270-279``."""
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None) but got {average}"
+        )
+
+
+def _multilabel_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Reference ``average_precision.py:282-309``."""
+    if average == "micro":
+        if _is_state_tensor(state) and thresholds is not None:
+            return _binary_average_precision_compute(state.sum(1), thresholds)
+        preds = state[0].flatten()
+        target = state[1].flatten()
+        if ignore_index is not None:
+            keep = np.asarray(target) != ignore_index
+            preds = jnp.asarray(np.asarray(preds)[keep])
+            target = jnp.asarray(np.asarray(target)[keep])
+        return _binary_average_precision_compute((preds, target), thresholds)
+
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    if thresholds is None:
+        weights = jnp.sum(state[1] == 1, axis=0).astype(jnp.float32)
+    else:
+        weights = state[0][:, 1, :].sum(-1).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=weights)
+
+
+def multilabel_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """AP for multilabel tasks (reference ``average_precision.py:312-...``)."""
+    if validate_args:
+        _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_average_precision_compute(state, num_labels, average, thresholds, ignore_index)
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-routing wrapper (reference legacy API)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_average_precision(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_average_precision(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,195 @@
+"""Expected calibration error (reference ``functional/classification/calibration_error.py``).
+
+States are the raw (confidence, accuracy) streams (cat lists in the modular class);
+binning happens in compute via one scatter-add per statistic — deterministic on XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from torchmetrics_tpu.functional.classification.stat_scores import _is_floating
+from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array
+) -> Tuple[Array, Array, Array]:
+    """Per-bin accuracy/confidence/proportion via scatter-adds (reference ``calibration_error.py:29-58``)."""
+    accuracies = accuracies.astype(confidences.dtype)
+    n_bins = bin_boundaries.shape[0]
+    indices = jnp.searchsorted(bin_boundaries, confidences, side="right") - 1
+    count_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(1.0)
+    conf_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(confidences)
+    acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies)
+    conf_bin = jnp.nan_to_num(conf_bin / count_bin)
+    acc_bin = jnp.nan_to_num(acc_bin / count_bin)
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Union[Array, int],
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Calibration error under l1/l2/max norms (reference ``calibration_error.py:61-107``)."""
+    if isinstance(bin_boundaries, int):
+        bin_boundaries = jnp.linspace(0, 1, bin_boundaries + 1, dtype=jnp.float32)
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * confidences.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``calibration_error.py:110-121``."""
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Expected argument `norm` to be one of ('l1', 'l2', 'max'), but got {norm}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    """Reference ``calibration_error.py:124-131``."""
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """confidence = p(positive), accuracy = target (reference ``calibration_error.py:134-136``)."""
+    return preds, target
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """ECE for binary tasks (reference ``calibration_error.py:139-...``)."""
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(
+        preds, target, threshold=0.0, ignore_index=ignore_index, convert_to_labels=False
+    )
+    keep = np.asarray(target) >= 0
+    if not keep.all():
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    confidences, accuracies = _binary_calibration_error_update(preds, target)
+    return _ce_compute(confidences.astype(jnp.float32), accuracies.astype(jnp.float32), n_bins, norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int,
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``calibration_error.py:223-232``."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence + correctness (reference ``calibration_error.py:235-243``)."""
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.softmax(preds, axis=1)
+    confidences = jnp.max(preds, axis=1)
+    predictions = jnp.argmax(preds, axis=1)
+    accuracies = (predictions == target).astype(jnp.float32)
+    return confidences.astype(jnp.float32), accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label ECE for multiclass tasks (reference ``calibration_error.py:246-...``)."""
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index, convert_to_labels=False)
+    keep = np.asarray(target) >= 0
+    if not keep.all():
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-routing wrapper (reference legacy API)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,131 @@
+"""Dice score (reference ``functional/classification/dice.py`` — legacy-format metric).
+
+Dice = 2·tp / (2·tp + fp + fn). Supports the legacy input auto-formats the reference
+routes through ``_input_format_classification`` (labels, probabilities + threshold,
+logits + argmax) for binary and multiclass inputs, with
+``average ∈ {micro, macro, weighted, none, samples}`` and
+``mdmc_average ∈ {None, global, samplewise}``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import _is_floating, _sigmoid_if_logits
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _dice_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Legacy auto-format to one-hot (N, C, [X]) masks (≙ ``_input_format_classification``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        # (N, C, ...) scores vs (N, ...) labels
+        num_classes = preds.shape[1]
+        if top_k is not None and top_k > 1:
+            from torchmetrics_tpu.utilities.data import select_topk
+
+            preds_oh = select_topk(preds, topk=top_k, dim=1)
+        else:
+            preds_oh = jax.nn.one_hot(jnp.argmax(preds, axis=1), num_classes, dtype=jnp.int32)
+            preds_oh = jnp.moveaxis(preds_oh, -1, 1)
+        target_oh = jnp.moveaxis(jax.nn.one_hot(target, num_classes, dtype=jnp.int32), -1, 1)
+        return preds_oh, target_oh
+    if _is_floating(preds):
+        # same-shape probabilities/logits → binary masks
+        preds = (_sigmoid_if_logits(preds) > threshold).astype(jnp.int32)
+    if num_classes is not None and num_classes > 1 and preds.ndim == target.ndim and not _is_floating(preds):
+        mx = max(int(preds.max()) if preds.size else 0, int(target.max()) if target.size else 0)
+        if mx > 1 or num_classes > 2:
+            preds_oh = jnp.moveaxis(jax.nn.one_hot(preds, num_classes, dtype=jnp.int32), -1, 1)
+            target_oh = jnp.moveaxis(jax.nn.one_hot(target, num_classes, dtype=jnp.int32), -1, 1)
+            return preds_oh, target_oh
+    # binary labels: treat as 2-class one-hot over {0,1} → stack [1-x, x]
+    preds_2 = jnp.stack([1 - preds, preds], axis=1)
+    target_2 = jnp.stack([1 - target, target], axis=1)
+    return preds_2.astype(jnp.int32), target_2.astype(jnp.int32)
+
+
+def _dice_update(
+    preds_oh: Array,
+    target_oh: Array,
+    ignore_index: Optional[int] = None,
+    mdmc_average: Optional[str] = None,
+) -> Tuple[Array, Array, Array]:
+    """Per-class (or per-sample-per-class) tp/fp/fn counts."""
+    if ignore_index is not None and 0 <= ignore_index < target_oh.shape[1]:
+        mask = jnp.ones(target_oh.shape[1], dtype=jnp.int32).at[ignore_index].set(0)
+        shape = [1, -1] + [1] * (target_oh.ndim - 2)
+        preds_oh = preds_oh * mask.reshape(shape)
+        target_oh = target_oh * mask.reshape(shape)
+    if mdmc_average == "samplewise" and preds_oh.ndim > 2:
+        axes = tuple(range(2, preds_oh.ndim))  # keep (N, C)
+    else:
+        preds_oh = preds_oh.reshape(preds_oh.shape[0], preds_oh.shape[1], -1)
+        target_oh = target_oh.reshape(target_oh.shape[0], target_oh.shape[1], -1)
+        axes = (0, 2)
+    tp = jnp.sum((preds_oh == 1) & (target_oh == 1), axis=axes)
+    fp = jnp.sum((preds_oh == 1) & (target_oh == 0), axis=axes)
+    fn = jnp.sum((preds_oh == 0) & (target_oh == 1), axis=axes)
+    return tp, fp, fn
+
+
+def _dice_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str] = "micro",
+    zero_division: float = 0.0,
+) -> Array:
+    """Reduce tp/fp/fn into a dice score (reference ``dice.py:24-70``)."""
+    if average == "micro":
+        tp, fp, fn = tp.sum(), fp.sum(), fn.sum()
+        return _safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
+    score = _safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
+    if average in (None, "none"):
+        return score
+    if average == "samples":
+        # per-sample micro over the class axis
+        return _safe_divide(2 * tp.sum(-1), 2 * tp.sum(-1) + fp.sum(-1) + fn.sum(-1), zero_division).mean()
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+        return jnp.sum(score * _safe_divide(weights, weights.sum()))
+    if average == "macro":
+        present = (tp + fp + fn) > 0
+        return jnp.sum(jnp.where(present, score, 0.0)) / jnp.maximum(jnp.sum(present), 1)
+    raise ValueError(f"Unsupported average: {average}")
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice score (reference ``dice.py:73-...``)."""
+    allowed = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed:
+        raise ValueError(f"The `average` has to be one of {allowed}, got {average}.")
+    preds_oh, target_oh = _dice_format(preds, target, threshold, top_k, num_classes)
+    samplewise = mdmc_average == "samplewise" or average == "samples"
+    tp, fp, fn = _dice_update(preds_oh, target_oh, ignore_index, "samplewise" if samplewise else None)
+    if mdmc_average == "samplewise" and average != "samples":
+        per_sample = _safe_divide(2 * tp.sum(-1), 2 * tp.sum(-1) + fp.sum(-1) + fn.sum(-1), zero_division)
+        return per_sample.mean()
+    return _dice_compute(tp, fp, fn, average=average, zero_division=zero_division)

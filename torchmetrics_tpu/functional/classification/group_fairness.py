@@ -1,0 +1,171 @@
+"""Group fairness metrics (reference ``functional/classification/group_fairness.py``).
+
+TPU-first: the reference sorts by group and splits into ragged per-group tensors
+(``group_fairness.py:51-81``); here the per-group tp/fp/tn/fn are one **vectorized
+masked count** over a fixed ``num_groups`` axis — static shapes, single fused graph.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    """Reference ``group_fairness.py:29-43``."""
+    if np.asarray(groups).max() > num_groups - 1 or np.asarray(groups).min() < 0:
+        raise ValueError(f"The largest number in the groups tensor is {int(np.asarray(groups).max())}, which is larger than the specified number of groups {num_groups}.")
+    if not jnp.issubdtype(jnp.asarray(groups).dtype, jnp.integer):
+        raise ValueError(f"Excepted groups to be of integer type but got {groups.dtype}")
+
+
+def _groups_format(groups: Array) -> Array:
+    """Reference ``group_fairness.py:46-48``."""
+    return jnp.asarray(groups).reshape(groups.shape[0], -1)
+
+
+def _binary_groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> List[Tuple[Array, Array, Array, Array]]:
+    """Per-group tp/fp/tn/fn via masked counts (reference sorts+splits, ``:51-81``)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    groups = _groups_format(groups)
+
+    g = groups.flatten()
+    p = preds.flatten()
+    t = target.flatten()
+    group_oh = jax.nn.one_hot(g, num_groups, dtype=jnp.int32).T  # (G, N)
+    tp = group_oh @ ((t == p) & (t == 1)).astype(jnp.int32)
+    fn = group_oh @ ((t != p) & (t == 1)).astype(jnp.int32)
+    fp = group_oh @ ((t != p) & (t == 0)).astype(jnp.int32)
+    tn = group_oh @ ((t == p) & (t == 0)).astype(jnp.int32)
+    return [(tp[i], fp[i], tn[i], fn[i]) for i in range(num_groups)]
+
+
+def _groups_reduce(
+    group_stats: List[Tuple[Array, Array, Array, Array]]
+) -> Dict[str, Array]:
+    """Rates per group (reference ``group_fairness.py:84-88``)."""
+    return {
+        f"group_{group}": jnp.stack(stats) / jnp.stack(stats).sum() for group, stats in enumerate(group_stats)
+    }
+
+
+def _groups_stat_transform(
+    group_stats: List[Tuple[Array, Array, Array, Array]]
+) -> Dict[str, Array]:
+    """Stack per-statistic tensors (reference ``group_fairness.py:91-100``)."""
+    return {
+        "tp": jnp.stack([s[0] for s in group_stats]),
+        "fp": jnp.stack([s[1] for s in group_stats]),
+        "tn": jnp.stack([s[2] for s in group_stats]),
+        "fn": jnp.stack([s[3] for s in group_stats]),
+    }
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Per-group tp/fp/tn/fn rates (reference ``group_fairness.py:103-158``)."""
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _groups_reduce(group_stats)
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Reference ``group_fairness.py:161-171``."""
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_id = int(jnp.argmin(pos_rates))
+    max_id = int(jnp.argmax(pos_rates))
+    return {f"DP_{min_id}_{max_id}": _safe_divide(pos_rates[min_id], pos_rates[max_id])}
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """min/max positivity-rate ratio across groups (reference ``group_fairness.py:174-237``)."""
+    num_groups = len(np.unique(np.asarray(groups)))
+    target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _compute_binary_demographic_parity(**_groups_stat_transform(group_stats))
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Reference ``group_fairness.py:239-251``."""
+    tprs = _safe_divide(tp, tp + fn)
+    min_id = int(jnp.argmin(tprs))
+    max_id = int(jnp.argmax(tprs))
+    return {f"EO_{min_id}_{max_id}": _safe_divide(tprs[min_id], tprs[max_id])}
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """min/max TPR ratio across groups (reference ``group_fairness.py:254-319``)."""
+    num_groups = len(np.unique(np.asarray(groups)))
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _compute_binary_equal_opportunity(**_groups_stat_transform(group_stats))
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity (reference ``group_fairness.py:322-...``)."""
+    if task not in ["demographic_parity", "equal_opportunity", "all"]:
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    num_groups = len(np.unique(np.asarray(groups)))
+    if task == "demographic_parity":
+        target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    transformed = _groups_stat_transform(group_stats)
+    out: Dict[str, Array] = {}
+    if task in ("demographic_parity", "all"):
+        out.update(_compute_binary_demographic_parity(**transformed))
+    if task in ("equal_opportunity", "all"):
+        out.update(_compute_binary_equal_opportunity(**transformed))
+    return out

@@ -1,0 +1,186 @@
+"""Precision & Recall — binary / multiclass / multilabel (+ task routers).
+
+Capability parity: reference ``functional/classification/precision_recall.py``
+(reduce ``:38-59``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_pipeline,
+    _multiclass_stat_scores_pipeline,
+    _multilabel_stat_scores_pipeline,
+)
+from torchmetrics_tpu.utilities.compute import _adjust_weights_safe_divide, _safe_divide, _sum_axis
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    """Reference ``precision_recall.py:38-59``: precision divides by fp, recall by fn."""
+    different_stat = fp if stat == "precision" else fn
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp = _sum_axis(tp, axis)
+        fn = _sum_axis(fn, axis)
+        different_stat = _sum_axis(different_stat, axis)
+        return _safe_divide(tp, tp + different_stat)
+    score = _safe_divide(tp, tp + different_stat)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _make_prf(stat: str):
+    def binary_fn(
+        preds: Array,
+        target: Array,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        tp, fp, tn, fn = _binary_stat_scores_pipeline(
+            preds, target, threshold, multidim_average, ignore_index, validate_args
+        )
+        return _precision_recall_reduce(stat, tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
+
+    def multiclass_fn(
+        preds: Array,
+        target: Array,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        top_k: int = 1,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        tp, fp, tn, fn = _multiclass_stat_scores_pipeline(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+        return _precision_recall_reduce(stat, tp, fp, tn, fn, average=average, multidim_average=multidim_average)
+
+    def multilabel_fn(
+        preds: Array,
+        target: Array,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        tp, fp, tn, fn = _multilabel_stat_scores_pipeline(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+        return _precision_recall_reduce(
+            stat, tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True
+        )
+
+    return binary_fn, multiclass_fn, multilabel_fn
+
+
+binary_precision, multiclass_precision, multilabel_precision = _make_prf("precision")
+binary_precision.__name__ = "binary_precision"
+multiclass_precision.__name__ = "multiclass_precision"
+multilabel_precision.__name__ = "multilabel_precision"
+binary_precision.__doc__ = "Precision = tp / (tp + fp) for binary tasks (reference ``precision_recall.py``)."
+multiclass_precision.__doc__ = "Precision for multiclass tasks (reference ``precision_recall.py``)."
+multilabel_precision.__doc__ = "Precision for multilabel tasks (reference ``precision_recall.py``)."
+
+binary_recall, multiclass_recall, multilabel_recall = _make_prf("recall")
+binary_recall.__name__ = "binary_recall"
+multiclass_recall.__name__ = "multiclass_recall"
+multilabel_recall.__name__ = "multilabel_recall"
+binary_recall.__doc__ = "Recall = tp / (tp + fn) for binary tasks (reference ``precision_recall.py``)."
+multiclass_recall.__doc__ = "Recall for multiclass tasks (reference ``precision_recall.py``)."
+multilabel_recall.__doc__ = "Recall for multilabel tasks (reference ``precision_recall.py``)."
+
+
+def _route(
+    stat: str,
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float,
+    num_classes: Optional[int],
+    num_labels: Optional[int],
+    average: Optional[str],
+    multidim_average: str,
+    top_k: int,
+    ignore_index: Optional[int],
+    validate_args: bool,
+) -> Array:
+    binary_fn, multiclass_fn, multilabel_fn = (
+        (binary_precision, multiclass_precision, multilabel_precision)
+        if stat == "precision"
+        else (binary_recall, multiclass_recall, multilabel_recall)
+    )
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_fn(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_fn(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_fn(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")
+
+
+def precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-routing precision (reference ``precision_recall.py`` legacy API)."""
+    return _route(
+        "precision", preds, target, task, threshold, num_classes, num_labels,
+        average, multidim_average, top_k, ignore_index, validate_args,
+    )
+
+
+def recall(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-routing recall (reference ``precision_recall.py`` legacy API)."""
+    return _route(
+        "recall", preds, target, task, threshold, num_classes, num_labels,
+        average, multidim_average, top_k, ignore_index, validate_args,
+    )

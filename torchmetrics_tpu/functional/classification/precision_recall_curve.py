@@ -1,0 +1,486 @@
+"""Precision-recall curves — the dual-state base of the curve family (ROC/AUROC/AP).
+
+Capability parity: reference ``functional/classification/precision_recall_curve.py``
+(``_binary_clf_curve:28``, binned updates ``:205-243``, compute ``:246-275``). Two modes:
+
+* **binned** (``thresholds`` given) — state is a fixed ``(len_t, [C,] 2, 2)`` confusion
+  tensor built by one weighted scatter-add; fully jit-safe and the TPU-preferred mode
+  (static shapes, constant memory, single psum at sync).
+* **exact** (``thresholds=None``) — sort-based curve over all scores, computed eagerly
+  at epoch end (dynamic output length is inherent to the algorithm; the reference is
+  also host-bound here).
+
+``ignore_index`` in binned mode maps ignored samples to negative bins dropped by the
+scatter — no boolean filtering, static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.stat_scores import _is_floating
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.data import _cumsum
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps at every distinct prediction value (reference ``precision_recall_curve.py:28-79``).
+
+    Eager (host-synced) — output length is data-dependent by construction.
+    """
+    if sample_weights is not None and not isinstance(sample_weights, (jnp.ndarray, jax.Array)):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc_score_indices = jnp.argsort(-preds)
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    distinct_value_indices = np.nonzero(np.asarray(preds[1:] - preds[:-1]))[0]
+    threshold_idxs = jnp.asarray(np.concatenate([distinct_value_indices, [target.shape[0] - 1]]), dtype=jnp.int32)
+    target = (target == pos_label).astype(jnp.int32)
+    tps = _cumsum(target * weight, dim=0)[threshold_idxs]
+    if sample_weights is not None:
+        fps = _cumsum((1 - target) * weight, dim=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+def _adjust_threshold_arg(
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+) -> Optional[Array]:
+    """int → linspace, list → array (reference ``precision_recall_curve.py:82-89``)."""
+    if isinstance(thresholds, int):
+        return jnp.linspace(0, 1, thresholds)
+    if isinstance(thresholds, list):
+        return jnp.asarray(thresholds)
+    return thresholds
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``precision_recall_curve.py:92-120``."""
+    if thresholds is not None and not isinstance(thresholds, (list, int, jnp.ndarray, jax.Array)):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or"
+            f" tensor of floats, but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(
+            f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}"
+        )
+    if isinstance(thresholds, list) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            "If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range,"
+            f" but got {thresholds}"
+        )
+    if isinstance(thresholds, (jnp.ndarray, jax.Array)) and not thresholds.ndim == 1:
+        raise ValueError("If argument `thresholds` is an tensor, expected the tensor to be 1d")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    """Reference ``precision_recall_curve.py:123-156``."""
+    _check_same_shape(preds, target)
+    if _is_floating(target):
+        raise ValueError(
+            "Expected argument `target` to be an int or long tensor with ground truth labels"
+            f" but got tensor with dtype {target.dtype}"
+        )
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be an floating tensor with probability/logit scores,"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+    unique_values = np.unique(np.asarray(target))
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not set(unique_values.tolist()).issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {unique_values} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """Flatten, auto-sigmoid, mask ignored targets → -1 (reference ``:159-186``)."""
+    preds = jnp.asarray(preds).flatten()
+    target = jnp.asarray(target).flatten()
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.sigmoid(preds)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (len_t, 2, 2) multi-threshold confmat via one scatter-add (reference ``:189-243``)."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    valid = target >= 0
+    preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.int32)  # (N, len_t)
+    safe_target = jnp.where(valid, target, 0)
+    unique_mapping = preds_t + 2 * safe_target[:, None] + 4 * jnp.arange(len_t)[None, :]
+    unique_mapping = jnp.where(valid[:, None], unique_mapping, -1)
+    bins = jnp.zeros(4 * len_t, dtype=jnp.int32).at[unique_mapping.flatten()].add(
+        valid[:, None].astype(jnp.int32).repeat(len_t, axis=1).flatten(), mode="drop"
+    )
+    return bins.reshape(len_t, 2, 2)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Final curve (reference ``:246-275``)."""
+    if isinstance(state, (jnp.ndarray, jax.Array)) and not isinstance(state, tuple):
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+
+    preds, target = state
+    # exact mode: drop ignored (-1) targets eagerly — dynamic size is inherent here
+    keep = np.asarray(target) >= 0
+    if not keep.all():
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    fps, tps, thresh = _binary_clf_curve(preds, target, pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+    precision = jnp.concatenate([precision[::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresh = thresh[::-1]
+    return precision, recall, thresh
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """PR curve for binary tasks (reference ``precision_recall_curve.py:278-...``)."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# --------------------------------------------------------------------------- multiclass
+
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``precision_recall_curve.py:355-368``."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Reference ``precision_recall_curve.py:371-409``."""
+    if not preds.ndim == target.ndim + 1:
+        raise ValueError(
+            f"Expected `preds` to have one more dimension than `target` but got {preds.ndim} and {target.ndim}"
+        )
+    if _is_floating(target):
+        raise ValueError(
+            f"Expected argument `target` to be an int or long tensor, but got tensor with dtype {target.dtype}"
+        )
+    if not _is_floating(preds):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(
+            "Expected `preds.shape[1]` to be equal to the number of classes but"
+            f" got {preds.shape[1]} and {num_classes}."
+        )
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError(
+            "Expected the shape of `preds` should be (N, C, ...) and the shape of `target` should be (N, ...)"
+            f" but got {preds.shape} and {target.shape}"
+        )
+    num_unique_values = len(np.unique(np.asarray(target)))
+    check = num_unique_values > num_classes if ignore_index is None else num_unique_values > num_classes + 1
+    if check:
+        raise RuntimeError(
+            "Detected more unique values in `target` than `num_classes`. Expected only "
+            f"{num_classes if ignore_index is None else num_classes + 1} but found "
+            f"{num_unique_values} in `target`."
+        )
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """To (N, C) scores + flat targets; ignored → -1 (reference ``:411-442``)."""
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
+    target = jnp.asarray(target).flatten()
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.softmax(preds, axis=1)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (len_t, C, 2, 2) via one scatter-add (reference ``:445-501``)."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    valid = target >= 0
+    safe_target = jnp.where(valid, target, 0)
+    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)  # (N, C, T)
+    target_t = jax.nn.one_hot(safe_target, num_classes, dtype=jnp.int32)  # (N, C)
+    unique_mapping = preds_t + 2 * target_t[:, :, None]
+    unique_mapping = unique_mapping + 4 * jnp.arange(num_classes)[None, :, None]
+    unique_mapping = unique_mapping + 4 * num_classes * jnp.arange(len_t)[None, None, :]
+    unique_mapping = jnp.where(valid[:, None, None], unique_mapping, -1)
+    weights = jnp.broadcast_to(valid[:, None, None], unique_mapping.shape).astype(jnp.int32)
+    bins = jnp.zeros(4 * num_classes * len_t, dtype=jnp.int32).at[unique_mapping.flatten()].add(
+        weights.flatten(), mode="drop"
+    )
+    return bins.reshape(len_t, num_classes, 2, 2)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Final per-class curves (reference ``:504-531``)."""
+    if isinstance(state, (jnp.ndarray, jax.Array)) and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)])
+        return precision.T, recall.T, thresholds
+
+    precision, recall, thresh = [], [], []
+    for i in range(num_classes):
+        res = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresh.append(res[2])
+    return precision, recall, thresh
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """PR curves for multiclass tasks (reference ``precision_recall_curve.py:534-...``)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+
+
+# --------------------------------------------------------------------------- multilabel
+
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``precision_recall_curve.py:640-650``."""
+    _multiclass_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    """Reference ``precision_recall_curve.py:653-668``."""
+    _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            "Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """To (num_samples, L) layout; ignored → negative sentinel (reference ``:671-700``)."""
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(jnp.asarray(target), 1, -1).reshape(-1, num_labels)
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.sigmoid(preds)
+    thresholds = _adjust_threshold_arg(thresholds)
+    if ignore_index is not None:
+        idx = target == ignore_index
+        sentinel = -4 * num_labels * (thresholds.shape[0] if thresholds is not None else 1)
+        preds = jnp.where(idx, sentinel, preds)
+        target = jnp.where(idx, sentinel, target)
+    return preds, target, thresholds
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (len_t, L, 2, 2) via one scatter-add (reference ``:700-722``)."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    valid = target >= 0
+    safe_target = jnp.where(valid, target, 0)
+    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)
+    unique_mapping = preds_t + 2 * safe_target[:, :, None]
+    unique_mapping = unique_mapping + 4 * jnp.arange(num_labels)[None, :, None]
+    unique_mapping = unique_mapping + 4 * num_labels * jnp.arange(len_t)[None, None, :]
+    unique_mapping = jnp.where(valid[:, None, None] if valid.ndim == 1 else valid[:, :, None], unique_mapping, -1)
+    weights = (unique_mapping >= 0).astype(jnp.int32)
+    bins = jnp.zeros(4 * num_labels * len_t, dtype=jnp.int32).at[unique_mapping.flatten()].add(
+        weights.flatten(), mode="drop"
+    )
+    return bins.reshape(len_t, num_labels, 2, 2)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Final per-label curves (reference ``:724-758``)."""
+    if isinstance(state, (jnp.ndarray, jax.Array)) and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_labels), dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)])
+        return precision.T, recall.T, thresholds
+
+    precision, recall, thresh = [], [], []
+    for i in range(num_labels):
+        preds_i = state[0][:, i]
+        target_i = state[1][:, i]
+        if ignore_index is not None:
+            keep = np.asarray(target_i) != ignore_index
+            preds_i = jnp.asarray(np.asarray(preds_i)[keep])
+            target_i = jnp.asarray(np.asarray(target_i)[keep])
+        res = _binary_precision_recall_curve_compute((preds_i, target_i), thresholds=None, pos_label=1)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresh.append(res[2])
+    return precision, recall, thresh
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """PR curves for multilabel tasks (reference ``precision_recall_curve.py:761-...``)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-routing wrapper (reference legacy API)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_recall_curve(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

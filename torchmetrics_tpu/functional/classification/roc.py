@@ -1,0 +1,217 @@
+"""ROC curves (reference ``functional/classification/roc.py``).
+
+Shares the PR-curve dual state: binned confmats (jit-safe) or exact sort-based curves.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _is_state_tensor(state) -> bool:
+    return isinstance(state, (jnp.ndarray, jax.Array)) and not isinstance(state, tuple)
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fpr/tpr/thresholds (reference ``roc.py:40-81``)."""
+    if _is_state_tensor(state) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1]
+        fpr = _safe_divide(fps, fps + tns)[::-1]
+        return fpr, tpr, thresholds[::-1]
+
+    preds, target = state
+    keep = np.asarray(target) >= 0
+    if not keep.all():
+        preds = jnp.asarray(np.asarray(preds)[keep])
+        target = jnp.asarray(np.asarray(target)[keep])
+    fps, tps, thresh = _binary_clf_curve(preds, target, pos_label=pos_label)
+    # prepend a point so the curve starts at (0, 0)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thresh = jnp.concatenate([jnp.ones(1, dtype=thresh.dtype), thresh])
+    if float(fps[-1]) <= 0:
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = jnp.zeros_like(thresh)
+    else:
+        fpr = fps / fps[-1]
+    if float(tps[-1]) <= 0:
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = jnp.zeros_like(thresh)
+    else:
+        tpr = tps / tps[-1]
+    return fpr, tpr, thresh
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """ROC for binary tasks (reference ``roc.py:84-...``)."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Per-class fpr/tpr (reference ``roc.py:161-190``)."""
+    if _is_state_tensor(state) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1].T
+        fpr = _safe_divide(fps, fps + tns)[::-1].T
+        return fpr, tpr, thresholds[::-1]
+
+    fpr, tpr, thresh = [], [], []
+    for i in range(num_classes):
+        res = _binary_roc_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresh.append(res[2])
+    return fpr, tpr, thresh
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """ROC for multiclass tasks (reference ``roc.py:193-...``)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_roc_compute(state, num_classes, thresholds)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Per-label fpr/tpr (reference ``roc.py:292-325``)."""
+    if _is_state_tensor(state) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1].T
+        fpr = _safe_divide(fps, fps + tns)[::-1].T
+        return fpr, tpr, thresholds[::-1]
+
+    fpr, tpr, thresh = [], [], []
+    for i in range(num_labels):
+        preds_i = state[0][:, i]
+        target_i = state[1][:, i]
+        if ignore_index is not None:
+            keep = np.asarray(target_i) != ignore_index
+            preds_i = jnp.asarray(np.asarray(preds_i)[keep])
+            target_i = jnp.asarray(np.asarray(target_i)[keep])
+        res = _binary_roc_compute((preds_i, target_i), thresholds=None, pos_label=1)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresh.append(res[2])
+    return fpr, tpr, thresh
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """ROC for multilabel tasks (reference ``roc.py:328-...``)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-routing wrapper (reference legacy API)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,584 @@
+"""Stat-scores (tp/fp/tn/fn) — the base of the classification family.
+
+Capability parity: reference ``src/torchmetrics/functional/classification/stat_scores.py``
+(binary ``:25-211``, multiclass ``:213-553``, multilabel ``:555-803``). Same staged
+decomposition (``_arg_validation`` → ``_tensor_validation`` → ``_format`` → ``_update`` →
+``_compute``) but TPU-first:
+
+* ``ignore_index`` is handled by **masking, never boolean filtering** — every stage keeps
+  static shapes so the whole update lowers to one XLA graph. The sentinel trick: ignored
+  targets become ``-1``, which matches neither the positive (``==1``) nor negative
+  (``==0``) comparisons, so they drop out of all four counters for free.
+* The multiclass confusion-matrix path is a single weighted scatter-add (deterministic on
+  XLA by construction — the reference needs a loop fallback, ``utilities/data.py:211-241``).
+* Logit auto-normalization (sigmoid when outside [0,1]) is branch-free via ``jnp.where``
+  on an ``all()`` predicate, so it works under ``jit``.
+
+Tensor validation runs host-side (numpy) and only when ``validate_args=True`` — keep it
+outside ``jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.data import select_topk
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _is_floating(x: Array) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _sigmoid_if_logits(preds: Array) -> Array:
+    """Apply sigmoid iff any value falls outside [0, 1] — branch-free, jit-safe.
+
+    Reference semantics (``stat_scores.py:100-104``): float preds outside the unit
+    interval are treated as logits.
+    """
+    is_probs = jnp.all((preds >= 0) & (preds <= 1))
+    return jnp.where(is_probs, preds, jax.nn.sigmoid(preds))
+
+
+def _count_stats(preds: Array, target: Array, sum_axis) -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn counters; targets masked to -1 contribute to none of them."""
+    tp = jnp.sum((target == preds) & (target == 1), axis=sum_axis).squeeze()
+    fn = jnp.sum((target != preds) & (target == 1), axis=sum_axis).squeeze()
+    fp = jnp.sum((target != preds) & (target == 0), axis=sum_axis).squeeze()
+    tn = jnp.sum((target == preds) & (target == 0), axis=sum_axis).squeeze()
+    return tp, fp, tn, fn
+
+
+# ------------------------------------------------------------------------------ binary
+
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``stat_scores.py:25-44``."""
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Host-side checks (reference ``stat_scores.py:47-85``)."""
+    _check_same_shape(preds, target)
+    unique_values = np.unique(np.asarray(target))
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not set(unique_values.tolist()).issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {unique_values} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    if not _is_floating(preds):
+        unique_values = np.unique(np.asarray(preds))
+        if not set(unique_values.tolist()).issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {unique_values} but expected only"
+                " the following values [0,1] since `preds` is a label tensor."
+            )
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be atleast 2D when multidim_average is set to `samplewise`")
+
+
+def _binary_stat_scores_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """To label format: auto-sigmoid, threshold, flatten, mask ignored → -1 (reference ``stat_scores.py:88-114``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if _is_floating(preds):
+        preds = (_sigmoid_if_logits(preds) > threshold).astype(jnp.int32)
+    preds = preds.reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _binary_stat_scores_update(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Reference ``stat_scores.py:117-128``."""
+    sum_axis = (0, 1) if multidim_average == "global" else 1
+    return _count_stats(preds, target, sum_axis)
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    """Stack [tp, fp, tn, fn, support] (reference ``stat_scores.py:131-135``)."""
+    return jnp.stack([tp, fp, tn, fn, tp + fn], axis=0 if multidim_average == "global" else 1).squeeze()
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """tp/fp/tn/fn/support for binary tasks (reference ``stat_scores.py:138-210``)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# --------------------------------------------------------------------------- multiclass
+
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``stat_scores.py:213-245``."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not isinstance(top_k, int) or top_k < 1:
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None), but got {average}"
+        )
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Host-side checks (reference ``stat_scores.py:248-316``)."""
+    if preds.ndim == target.ndim + 1:
+        if not _is_floating(preds):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                " equal to number of classes."
+            )
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        if multidim_average != "global" and preds.ndim < 3:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should "
+                " atleast 3D when multidim_average is set to `samplewise`"
+            )
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError(
+                "When `preds` and `target` have the same shape, the shape of `preds` should "
+                " atleast 2D when multidim_average is set to `samplewise`"
+            )
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+
+    num_unique_values = len(np.unique(np.asarray(target)))
+    check = num_unique_values > num_classes if ignore_index is None else num_unique_values > num_classes + 1
+    if check:
+        raise RuntimeError(
+            "Detected more unique values in `target` than `num_classes`. Expected only"
+            f" {num_classes if ignore_index is None else num_classes + 1} but found"
+            f" {num_unique_values} in `target`."
+        )
+    if not _is_floating(preds):
+        unique_values = np.unique(np.asarray(preds))
+        if len(unique_values) > num_classes:
+            raise RuntimeError(
+                "Detected more unique values in `preds` than `num_classes`. Expected only"
+                f" {num_classes} but found {len(unique_values)} in `preds`."
+            )
+
+
+def _multiclass_stat_scores_format(
+    preds: Array,
+    target: Array,
+    top_k: int = 1,
+) -> Tuple[Array, Array]:
+    """Argmax logits (when top_k==1), flatten extra dims (reference ``stat_scores.py:319-334``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1 and top_k == 1:
+        preds = jnp.argmax(preds, axis=1)
+    preds = preds.reshape(*preds.shape[:2], -1) if top_k != 1 else preds.reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    return preds, target
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn (reference ``stat_scores.py:337-411``), mask-based.
+
+    Three static paths chosen at trace time (all jit-safe):
+    1. samplewise / top-k — one-hot comparison with ignored rows masked to -1;
+    2. micro — direct equality counting with a validity mask;
+    3. otherwise — confusion matrix as one weighted scatter-add, stats from its diagonal.
+    """
+    valid = jnp.ones(target.shape, dtype=bool) if ignore_index is None else target != ignore_index
+
+    if multidim_average == "samplewise" or top_k != 1:
+        if top_k > 1:
+            preds_oh = jnp.moveaxis(select_topk(preds, topk=top_k, dim=1), 1, -1)
+        else:
+            safe_preds = jnp.clip(preds, 0, num_classes - 1)
+            preds_oh = jax.nn.one_hot(safe_preds, num_classes, dtype=jnp.int32)
+            # out-of-range ignored preds one-hot to nothing (ref drops the extra column)
+            pred_valid = (preds >= 0) & (preds < num_classes)
+            preds_oh = preds_oh * pred_valid[..., None].astype(jnp.int32)
+        safe_target = jnp.clip(target, 0, num_classes - 1)
+        target_oh = jax.nn.one_hot(safe_target, num_classes, dtype=jnp.int32)
+        # ignored rows → -1 sentinel: matches neither ==1 nor ==0 in any counter
+        target_oh = jnp.where(valid[..., None], target_oh, -1)
+        sum_axis = (0, 1) if multidim_average == "global" else (1,)
+        tp = jnp.sum((target_oh == preds_oh) & (target_oh == 1), axis=sum_axis)
+        fn = jnp.sum((target_oh != preds_oh) & (target_oh == 1), axis=sum_axis)
+        fp = jnp.sum((target_oh != preds_oh) & (target_oh == 0), axis=sum_axis)
+        tn = jnp.sum((target_oh == preds_oh) & (target_oh == 0), axis=sum_axis)
+        return tp, fp, tn, fn
+
+    preds = preds.flatten()
+    target = target.flatten()
+    valid = valid.flatten()
+    if average == "micro":
+        n_valid = jnp.sum(valid)
+        tp = jnp.sum((preds == target) & valid)
+        fp = n_valid - tp
+        fn = n_valid - tp
+        tn = num_classes * n_valid - (fp + fn + tp)
+        return tp, fp, tn, fn
+
+    # confusion-matrix path: one deterministic scatter-add; invalid rows get weight 0
+    unique_mapping = target * num_classes + preds
+    unique_mapping = jnp.where(valid, unique_mapping, -1)  # -1 → dropped by scatter
+    bins = jnp.zeros(num_classes * num_classes, dtype=jnp.int32).at[unique_mapping].add(
+        valid.astype(jnp.int32), mode="drop"
+    )
+    confmat = bins.reshape(num_classes, num_classes)
+    tp = jnp.diag(confmat)
+    fp = confmat.sum(0) - tp
+    fn = confmat.sum(1) - tp
+    tn = confmat.sum() - (fp + fn + tp)
+    return tp, fp, tn, fn
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+) -> Array:
+    """Stack + apply average strategy (reference ``stat_scores.py:414-439``)."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_axis = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        return res.sum(axis=sum_axis) if res.ndim > 1 else res
+    if average == "macro":
+        return res.astype(jnp.float32).mean(axis=sum_axis)
+    if average == "weighted":
+        weight = (tp + fn).astype(jnp.float32)
+        if multidim_average == "global":
+            return (res * (weight / weight.sum()).reshape(*weight.shape, 1)).sum(axis=sum_axis)
+        return (res * (weight / weight.sum(-1, keepdims=True)).reshape(*weight.shape, 1)).sum(axis=sum_axis)
+    if average is None or average == "none":
+        return res
+    return None
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """tp/fp/tn/fn/support for multiclass tasks (reference ``stat_scores.py:442-552``)."""
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# --------------------------------------------------------------------------- multilabel
+
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference ``stat_scores.py:555-583``."""
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None), but got {average}"
+        )
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Host-side checks (reference ``stat_scores.py:586-632``)."""
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            "Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+    unique_values = np.unique(np.asarray(target))
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not set(unique_values.tolist()).issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {unique_values} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    if not _is_floating(preds):
+        unique_values = np.unique(np.asarray(preds))
+        if not set(unique_values.tolist()).issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {unique_values} but expected only"
+                " the following values [0,1] since preds is a label tensor."
+            )
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be atleast 3D when multidim_average is set to `samplewise`")
+
+
+def _multilabel_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """To label format (reference ``stat_scores.py:635-656``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if _is_floating(preds):
+        preds = (_sigmoid_if_logits(preds) > threshold).astype(jnp.int32)
+    preds = preds.reshape(*preds.shape[:2], -1)
+    target = target.reshape(*target.shape[:2], -1)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _multilabel_stat_scores_update(
+    preds: Array, target: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array, Array, Array]:
+    """Reference ``stat_scores.py:659-668``."""
+    sum_axis = (0, -1) if multidim_average == "global" else (-1,)
+    return _count_stats(preds, target, sum_axis)
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+) -> Array:
+    """Reference ``stat_scores.py:671-694``."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_axis = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        return res.sum(axis=sum_axis)
+    if average == "macro":
+        return res.astype(jnp.float32).mean(axis=sum_axis)
+    if average == "weighted":
+        w = (tp + fn).astype(jnp.float32)
+        return (res * (w / w.sum()).reshape(*w.shape, 1)).sum(axis=sum_axis)
+    if average is None or average == "none":
+        return res
+    return None
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """tp/fp/tn/fn/support for multilabel tasks (reference ``stat_scores.py:697-802``)."""
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ------------------------------------------------------------------- shared pipelines
+# The whole StatScores-derived family (accuracy / precision / recall / f-beta /
+# specificity / hamming / ...) differs only in its final reduce. These pipelines give
+# each family a one-line validate→format→update stage (the reference repeats this
+# plumbing per family; factoring it out is a deliberate divergence).
+
+
+def _binary_stat_scores_pipeline(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    multidim_average: str,
+    ignore_index: Optional[int],
+    validate_args: bool,
+) -> Tuple[Array, Array, Array, Array]:
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    return _binary_stat_scores_update(preds, target, multidim_average)
+
+
+def _multiclass_stat_scores_pipeline(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str],
+    top_k: int,
+    multidim_average: str,
+    ignore_index: Optional[int],
+    validate_args: bool,
+) -> Tuple[Array, Array, Array, Array]:
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    return _multiclass_stat_scores_update(preds, target, num_classes, top_k, average, multidim_average, ignore_index)
+
+
+def _multilabel_stat_scores_pipeline(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float,
+    average: Optional[str],
+    multidim_average: str,
+    ignore_index: Optional[int],
+    validate_args: bool,
+) -> Tuple[Array, Array, Array, Array]:
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    return _multilabel_stat_scores_update(preds, target, multidim_average)
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-routing wrapper (reference ``stat_scores.py:805-...``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_stat_scores(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

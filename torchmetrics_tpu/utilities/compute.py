@@ -34,6 +34,11 @@ def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
     return jnp.where(denom == 0, jnp.full_like(num / denom_safe, zero_division), num / denom_safe)
 
 
+def _sum_axis(x: Array, axis: int) -> Array:
+    """``x.sum(axis)`` that is a no-op on 0-d arrays (torch allows dim=0 on scalars; jnp doesn't)."""
+    return jnp.sum(x, axis=axis) if jnp.ndim(x) else x
+
+
 def _adjust_weights_safe_divide(
     score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array
 ) -> Array:
@@ -46,7 +51,8 @@ def _adjust_weights_safe_divide(
         weights = jnp.ones_like(score)
         if not multilabel:
             weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
-    return jnp.sum(score * _safe_divide(weights, jnp.sum(weights)))
+    # reduce over the class axis only — samplewise inputs are (N, C) and keep their N
+    return jnp.sum(_safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)), axis=-1)
 
 
 def interp(x: Array, xp: Array, fp: Array) -> Array:

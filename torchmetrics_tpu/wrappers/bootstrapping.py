@@ -1,0 +1,113 @@
+"""Bootstrapped confidence intervals for any metric.
+
+Capability parity: reference ``wrappers/bootstrapping.py:30-52`` (sampler ``:30``).
+Resampling indices are drawn host-side (numpy) per update — same as the reference's
+eager ``torch.distributions`` draw — then the gather runs on device.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(
+    size: int,
+    sampling_strategy: str = "poisson",
+    rng: Optional[np.random.RandomState] = None,
+) -> Array:
+    """Resample indices with replacement (reference ``bootstrapping.py:30-50``)."""
+    rng = rng or np.random
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1, size)
+        return jnp.asarray(np.repeat(np.arange(size), n))
+    if sampling_strategy == "multinomial":
+        return jnp.asarray(rng.randint(0, size, size))
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    """Keep ``num_bootstraps`` copies of a metric, each updated on a resampled batch (reference ``bootstrapping.py:52``)."""
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.RandomState()
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample inputs along dim 0 per bootstrap copy, then update each copy."""
+        args_sizes = apply_to_collection(args, (jnp.ndarray, jax.Array), lambda x: x.shape[0])
+        kwargs_sizes = apply_to_collection(kwargs, (jnp.ndarray, jax.Array), lambda x: x.shape[0])
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = next(iter(kwargs_sizes.values()))
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            new_args = apply_to_collection(args, (jnp.ndarray, jax.Array), jnp.take, sample_idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, (jnp.ndarray, jax.Array), jnp.take, sample_idx, axis=0)
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """mean/std/quantile/raw over bootstrap values (reference ``bootstrapping.py``)."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        """Reset all bootstrap copies."""
+        for m in self.metrics:
+            m.reset()
+        super().reset()
+
+    def plot(self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

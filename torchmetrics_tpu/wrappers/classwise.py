@@ -1,0 +1,53 @@
+"""Classwise output dict wrapper (reference ``wrappers/classwise.py:26``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(Metric):
+    """Split a per-class metric output into a labeled dict (reference ``classwise.py:26``)."""
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+        self._update_count = 1
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch value as labeled dict."""
+        return self._convert(self.metric(*args, **kwargs))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Forward to the wrapped metric."""
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Final value as labeled dict."""
+        return self._convert(self.metric.compute())
+
+    def reset(self) -> None:
+        """Reset the wrapped metric."""
+        self.metric.reset()
+
+    def _wrap_update(self, update: Any) -> Any:
+        return update
+
+    def _wrap_compute(self, compute: Any) -> Any:
+        return compute

@@ -1,0 +1,61 @@
+"""Min/max tracking wrapper (reference ``wrappers/minmax.py:28``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MinMaxMetric(Metric):
+    """Track min/max of a scalar metric across compute calls (reference ``minmax.py:28``)."""
+
+    full_state_update: Optional[bool] = True
+    min_val: Array
+    max_val: Array
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `torchmetrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the underlying metric."""
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """{'raw', 'min', 'max'}; min/max updated here (reference ``minmax.py``)."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        self.max_val = jnp.where(self.max_val < val, val, self.max_val)
+        self.min_val = jnp.where(self.min_val > val, val, self.min_val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        """Reset the underlying metric and the min/max trackers."""
+        super().reset()
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+
+    @staticmethod
+    def _is_suitable_val(val: Union[float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jnp.ndarray, jax.Array)) and not isinstance(val, (list, tuple)):
+            return val.size == 1
+        return False
+
+    def plot(self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

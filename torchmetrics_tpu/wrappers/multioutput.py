@@ -1,0 +1,98 @@
+"""Multioutput wrapper (reference ``wrappers/multioutput.py:29``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where any tensor has a NaN (reference ``multioutput.py:16-26``)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for tensor in tensors:
+        permuted_tensor = tensor.reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted_tensor), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """One metric clone per output column (reference ``multioutput.py:29``)."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+    ) -> None:
+        super().__init__()
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice inputs per output (reference ``multioutput.py:93-113``)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = apply_to_collection(
+                args, (jnp.ndarray, jax.Array), jnp.take, jnp.asarray([i]), axis=self.output_dim
+            )
+            selected_kwargs = apply_to_collection(
+                kwargs, (jnp.ndarray, jax.Array), jnp.take, jnp.asarray([i]), axis=self.output_dim
+            )
+            if self.remove_nans:
+                args_kwargs = selected_args + tuple(selected_kwargs.values())
+                nan_idxs = np.asarray(_get_nan_indices(*args_kwargs))
+                selected_args = [jnp.asarray(np.asarray(arg)[~nan_idxs]) for arg in selected_args]
+                selected_kwargs = {k: jnp.asarray(np.asarray(v)[~nan_idxs]) for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [arg.squeeze(self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: v.squeeze(self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each underlying metric with its output slice."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        """Stacked per-output values."""
+        return jnp.stack([m.compute() for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Per-output batch values."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs)
+        ]
+        if results[0] is None:
+            return None
+        return jnp.stack(results, 0)
+
+    def reset(self) -> None:
+        """Reset all underlying metrics."""
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()
+
+    def plot(self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

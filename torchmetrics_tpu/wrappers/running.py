@@ -1,0 +1,87 @@
+"""Running-window wrapper.
+
+Capability parity: reference ``src/torchmetrics/wrappers/running.py:26-130``: duplicates
+each base-metric state ``window`` times as ``key_{i}`` ring slots; ``compute`` folds all
+slots back into the base metric via ``_reduce_states`` (the merge primitive).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class Running(Metric):
+    """Compute a metric over a fixed running window of recent updates (reference ``running.py:26``).
+
+    ``forward`` still returns the current-batch value; ``compute`` returns the windowed
+    value. Memory grows linearly with ``window`` (one state copy per slot).
+    """
+
+    def __init__(self, base_metric: Metric, window: int = 5) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update is not False:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._num_vals_seen = 0
+
+        for key in base_metric._defaults:
+            for i in range(window):
+                self.add_state(
+                    name=key + f"_{i}", default=base_metric._defaults[key], dist_reduce_fx=base_metric._reductions[key]
+                )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the underlying metric, then snapshot its state into the current ring slot."""
+        val = self._num_vals_seen % self.window
+        self.base_metric.update(*args, **kwargs)
+        for key in self.base_metric._defaults:
+            setattr(self, key + f"_{val}", getattr(self.base_metric, key))
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward to the underlying metric (batch value), then snapshot the slot."""
+        val = self._num_vals_seen % self.window
+        res = self.base_metric.forward(*args, **kwargs)
+        for key in self.base_metric._defaults:
+            setattr(self, key + f"_{val}", getattr(self.base_metric, key))
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+        self._computed = None
+        return res
+
+    def compute(self) -> Any:
+        """Fold every window slot into the base metric and compute (reference ``running.py:118-126``)."""
+        for i in range(self.window):
+            self.base_metric._reduce_states(
+                {key: getattr(self, key + f"_{i}") for key in self.base_metric._defaults}
+            )
+        val = self.base_metric.compute()
+        self.base_metric.reset()
+        return val
+
+    def reset(self) -> None:
+        """Reset the ring and the base metric."""
+        super().reset()
+        self.base_metric.reset()
+        self._num_vals_seen = 0
+
+    def plot(
+        self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Optional[Any] = None
+    ) -> Any:
+        return self._plot(val, ax)

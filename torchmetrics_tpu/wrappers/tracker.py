@@ -1,0 +1,146 @@
+"""MetricTracker — historical per-step clones (reference ``wrappers/tracker.py:31``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Track a metric (or collection) across steps/epochs (reference ``tracker.py:31``).
+
+    ``increment()`` snapshots a fresh clone; ``best_metric()`` scans history.
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(metric, Metric) and not isinstance(maximize, bool):
+            raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of tracked steps."""
+        return len(self._metrics)
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __getitem__(self, idx: int) -> Union[Metric, MetricCollection]:
+        return self._metrics[idx]
+
+    def increment(self) -> None:
+        """Start tracking a new step with a fresh clone (reference ``tracker.py:130-133``)."""
+        self._increment_called = True
+        self._metrics.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward on the current step's metric."""
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the current step's metric."""
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Compute the current step's metric."""
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Stacked values across all steps (reference ``tracker.py:150-168``)."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._metrics]
+        try:
+            if isinstance(res[0], dict):
+                keys = res[0].keys()
+                return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+            if isinstance(res[0], list):
+                return jnp.stack([jnp.stack([jnp.asarray(r2) for r2 in r], axis=0) for r in res], 0)
+            return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+        except TypeError:
+            return res
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        """Reset every tracked metric."""
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[
+        None, float, Tuple[float, int], Tuple[None, None],
+        Dict[str, Optional[float]], Tuple[Dict[str, Optional[float]], Dict[str, Optional[int]]],
+    ]:
+        """Best value (and optionally step) across history (reference ``tracker.py:184-260``)."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    arr = np.asarray(v)
+                    best = arr.argmax(0) if maximize[i] else arr.argmin(0)
+                    value[k] = float(arr[int(best)])
+                    idx[k] = int(best)
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{error}. Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k] = None
+                    idx[k] = None
+            return (value, idx) if return_step else value
+        try:
+            arr = np.asarray(res)
+            best = int(arr.argmax(0) if self.maximize else arr.argmin(0))
+            return (float(arr[best]), best) if return_step else float(arr[best])
+        except (ValueError, TypeError) as error:
+            rank_zero_warn(
+                f"Encountered the following error when trying to get the best metric: {error}."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            return (None, None) if return_step else None
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        """Plot the tracked values over steps (reference ``tracker.py:270``)."""
+        from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+        val = val if val is not None else [self._metrics[i].compute() for i in range(self.n_steps)]
+        return plot_single_or_multi_val(val, ax=ax, name=self._base_metric.__class__.__name__)
