@@ -1,0 +1,44 @@
+"""Log-cosh error (reference ``functional/regression/log_cosh.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``log_cosh.py:23-26``."""
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    """Σ log(cosh(err)) per output + count (reference ``log_cosh.py:29-49``)."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(preds, target)
+    diff = preds - target
+    # numerically-stable log(cosh(x)) = x + softplus(-2x) - log(2)
+    sum_log_cosh_error = jnp.sum(diff + jax.nn.softplus(-2 * diff) - jnp.log(2.0), axis=0).squeeze()
+    return sum_log_cosh_error, jnp.asarray(preds.shape[0], dtype=jnp.int32)
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, n_obs: Array) -> Array:
+    """Reference ``log_cosh.py:52-55``."""
+    return (sum_log_cosh_error / n_obs).squeeze()
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """Log-cosh error (reference ``log_cosh.py:58-85``)."""
+    sum_log_cosh_error, n_obs = _log_cosh_error_update(
+        preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[-1]
+    )
+    return _log_cosh_error_compute(sum_log_cosh_error, n_obs)

@@ -1,0 +1,32 @@
+"""Mean absolute error (reference ``functional/regression/mae.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Sum of absolute errors + count (reference ``mae.py:22-34``)."""
+    _check_same_shape(preds, target)
+    preds = preds if jnp.issubdtype(preds.dtype, jnp.floating) else preds.astype(jnp.float32)
+    target = target if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.float32)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Union[int, Array]) -> Array:
+    """Reference ``mae.py:37-50``."""
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """MAE (reference ``mae.py:53-72``)."""
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -1,0 +1,33 @@
+"""Relative squared error (reference ``functional/regression/rse.py``)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.r2 import _r2_score_update
+
+Array = jax.Array
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    sum_squared_error: Array,
+    n_obs: Union[int, Array],
+    squared: bool = True,
+) -> Array:
+    """RSE = Σ(y−ŷ)² / Σ(y−ȳ)² (reference ``rse.py:22-45``)."""
+    epsilon = jnp.finfo(jnp.float32).eps
+    rse = sum_squared_error / jnp.clip(sum_squared_obs - sum_obs * sum_obs / n_obs, epsilon, None)
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """RSE (reference ``rse.py:48-77``)."""
+    sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, n_obs, squared=squared)

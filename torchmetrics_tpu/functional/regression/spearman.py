@@ -1,0 +1,66 @@
+"""Spearman rank correlation (reference ``functional/regression/spearman.py``).
+
+Tie-aware average ranks computed with a fully vectorized sort/searchsorted formulation
+(the reference loops over repeated values, ``spearman.py:23-53``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rank_data(data: Array) -> Array:
+    """Average rank of each element, ties share the mean rank (reference ``spearman.py:36-53``).
+
+    (count of values < x) + (count of values <= x) + 1, halved — a closed form for the
+    average of the positions a tied group occupies. Branch-free and O(n log n).
+    """
+    sorted_data = jnp.sort(data)
+    lower = jnp.searchsorted(sorted_data, data, side="left")
+    upper = jnp.searchsorted(sorted_data, data, side="right")
+    return (lower + upper + 1) / 2.0
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    """Validate + pass through raw values (list states; reference ``spearman.py:56-73``)."""
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
+        raise TypeError(
+            "Expected `preds` and `target` both to be floating point tensors, but got"
+            f" {preds.dtype} and {target.dtype}"
+        )
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    return preds, target
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    """Rank then Pearson on ranks (reference ``spearman.py:76-96``)."""
+    if preds.ndim == 1:
+        preds = _rank_data(preds)
+        target = _rank_data(target)
+    else:
+        preds = jnp.stack([_rank_data(preds[:, i]) for i in range(preds.shape[1])]).T
+        target = jnp.stack([_rank_data(target[:, i]) for i in range(target.shape[1])]).T
+    preds_diff = preds - preds.mean(0)
+    target_diff = target - target.mean(0)
+    cov = (preds_diff * target_diff).mean(0)
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean(0))
+    target_std = jnp.sqrt((target_diff * target_diff).mean(0))
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman ρ (reference ``spearman.py:99-125``)."""
+    preds, target = _spearman_corrcoef_update(
+        preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[-1]
+    )
+    return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,36 @@
+"""Symmetric MAPE (reference ``functional/regression/symmetric_mape.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    """Reference ``symmetric_mape.py:22-41``."""
+    _check_same_shape(preds, target)
+    abs_diff = jnp.abs(preds - target)
+    arr = jnp.clip(jnp.abs(target) + jnp.abs(preds), epsilon, None)
+    sum_abs_per_error = jnp.sum(2 * abs_diff / arr)
+    return sum_abs_per_error, target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(
+    sum_abs_per_error: Array, num_obs: Union[int, Array]
+) -> Array:
+    """Reference ``symmetric_mape.py:44-58``."""
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE (reference ``symmetric_mape.py:61-85``)."""
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

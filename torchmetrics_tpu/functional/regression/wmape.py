@@ -1,0 +1,33 @@
+"""Weighted MAPE (reference ``functional/regression/wmape.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Σ|err| and Σ|target| (reference ``wmape.py:22-36``)."""
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs((preds - target).flatten()))
+    sum_scale = jnp.sum(jnp.abs(target.flatten()))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06
+) -> Array:
+    """Reference ``wmape.py:39-50``."""
+    return sum_abs_error / jnp.clip(sum_scale, epsilon, None)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE (reference ``wmape.py:53-79``)."""
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)
