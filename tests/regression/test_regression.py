@@ -1,0 +1,387 @@
+"""Regression suite: sklearn/scipy goldens through the three-level MetricTester protocol.
+
+Mirrors the reference's per-metric test modules under
+``tests/unittests/regression/`` (golden = sklearn/scipy on host numpy, reference
+``test_mean_error.py:33-60`` et al.).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from scipy.stats import kendalltau, pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance,
+    r2_score as sk_r2,
+)
+
+from tests.testers import MetricTester
+from torchmetrics_tpu.functional import (
+    concordance_corrcoef,
+    cosine_similarity,
+    explained_variance,
+    kendall_rank_corrcoef,
+    kl_divergence,
+    log_cosh_error,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    minkowski_distance,
+    pearson_corrcoef,
+    r2_score,
+    relative_squared_error,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+
+rng = np.random.default_rng(1234)
+_preds = rng.uniform(0.1, 2.0, size=(NUM_BATCHES, BATCH_SIZE))
+_target = _preds * 0.7 + rng.uniform(0.1, 1.0, size=(NUM_BATCHES, BATCH_SIZE))
+_preds_2d = rng.uniform(0.1, 2.0, size=(NUM_BATCHES, BATCH_SIZE, 3))
+_target_2d = _preds_2d * 0.5 + rng.uniform(0.1, 1.0, size=(NUM_BATCHES, BATCH_SIZE, 3))
+
+
+def _batches(arr):
+    return [jnp.asarray(a) for a in arr]
+
+
+# ---------------------------------------------------------------- golden refs
+
+
+def _sk_smape(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    return np.mean(2 * np.abs(p - t) / np.clip(np.abs(p) + np.abs(t), 1.17e-6, None))
+
+
+def _sk_mape(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    return np.mean(np.abs(p - t) / np.clip(np.abs(t), 1.17e-6, None))
+
+
+def _sk_wmape(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    return np.sum(np.abs(p - t)) / np.clip(np.sum(np.abs(t)), 1.17e-6, None)
+
+
+def _sk_logcosh(p, t):
+    d = np.asarray(p) - np.asarray(t)
+    return np.mean(np.log(np.cosh(d)))
+
+
+def _sk_minkowski5(p, t):
+    return np.power(np.sum(np.abs(np.asarray(p) - np.asarray(t)) ** 5.0), 1 / 5.0)
+
+
+def _sk_rse(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    return np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2)
+
+
+def _sk_concordance(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    sx, sy = p.var(ddof=1), t.var(ddof=1)
+    sxy = np.cov(p, t, ddof=1)[0, 1]
+    return 2 * sxy / (sx + sy + (p.mean() - t.mean()) ** 2)
+
+
+def _sk_cosine_mean(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    sim = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+    return sim.mean()
+
+
+def _sk_kld(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    p = p / p.sum(-1, keepdims=True)
+    t = t / t.sum(-1, keepdims=True)
+    return np.mean(np.sum(p * np.log(p / t), axis=-1))
+
+
+SUM_COUNTER_CASES = [
+    ("mse", MeanSquaredError, mean_squared_error, {}, lambda p, t: sk_mse(np.asarray(t), np.asarray(p))),
+    (
+        "rmse",
+        MeanSquaredError,
+        mean_squared_error,
+        {"squared": False},
+        lambda p, t: np.sqrt(sk_mse(np.asarray(t), np.asarray(p))),
+    ),
+    ("mae", MeanAbsoluteError, mean_absolute_error, {}, lambda p, t: sk_mae(np.asarray(t), np.asarray(p))),
+    ("mape", MeanAbsolutePercentageError, mean_absolute_percentage_error, {}, _sk_mape),
+    ("smape", SymmetricMeanAbsolutePercentageError, symmetric_mean_absolute_percentage_error, {}, _sk_smape),
+    ("wmape", WeightedMeanAbsolutePercentageError, weighted_mean_absolute_percentage_error, {}, _sk_wmape),
+    ("msle", MeanSquaredLogError, mean_squared_log_error, {}, lambda p, t: sk_msle(np.asarray(t), np.asarray(p))),
+    ("log_cosh", LogCoshError, log_cosh_error, {}, _sk_logcosh),
+    ("minkowski_p5", MinkowskiDistance, minkowski_distance, {"p": 5.0}, _sk_minkowski5),
+    (
+        "tweedie_p0",
+        TweedieDevianceScore,
+        tweedie_deviance_score,
+        {"power": 0.0},
+        lambda p, t: mean_tweedie_deviance(np.asarray(t), np.asarray(p), power=0),
+    ),
+    (
+        "tweedie_p1",
+        TweedieDevianceScore,
+        tweedie_deviance_score,
+        {"power": 1.0},
+        lambda p, t: mean_tweedie_deviance(np.asarray(t), np.asarray(p), power=1),
+    ),
+    (
+        "tweedie_p15",
+        TweedieDevianceScore,
+        tweedie_deviance_score,
+        {"power": 1.5},
+        lambda p, t: mean_tweedie_deviance(np.asarray(t), np.asarray(p), power=1.5),
+    ),
+]
+
+
+class TestSumCounterMetrics(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("name,cls,fn,args,golden", SUM_COUNTER_CASES, ids=[c[0] for c in SUM_COUNTER_CASES])
+    def test_class(self, name, cls, fn, args, golden):
+        kwargs = {k: v for k, v in args.items()}
+        self.run_class_metric_test(_batches(_preds), _batches(_target), cls, golden, metric_args=kwargs)
+
+    @pytest.mark.parametrize("name,cls,fn,args,golden", SUM_COUNTER_CASES, ids=[c[0] for c in SUM_COUNTER_CASES])
+    def test_functional(self, name, cls, fn, args, golden):
+        fn_args = {"p": args["p"]} if "p" in args else {k: v for k, v in args.items()}
+        self.run_functional_metric_test(_batches(_preds), _batches(_target), fn, golden, metric_args=fn_args)
+
+
+class TestMultioutputMSE(MetricTester):
+    def test_multioutput(self):
+        self.run_class_metric_test(
+            _batches(_preds_2d),
+            _batches(_target_2d),
+            MeanSquaredError,
+            lambda p, t: sk_mse(
+                np.asarray(t).reshape(-1, 3), np.asarray(p).reshape(-1, 3), multioutput="raw_values"
+            ),
+            metric_args={"num_outputs": 3},
+        )
+
+
+class TestVarianceFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+    def test_explained_variance(self, multioutput):
+        self.run_class_metric_test(
+            _batches(_preds),
+            _batches(_target),
+            ExplainedVariance,
+            lambda p, t: explained_variance_score(np.asarray(t), np.asarray(p), multioutput=multioutput),
+            metric_args={"multioutput": multioutput},
+        )
+
+    def test_explained_variance_functional(self):
+        self.run_functional_metric_test(
+            _batches(_preds),
+            _batches(_target),
+            explained_variance,
+            lambda p, t: explained_variance_score(np.asarray(t), np.asarray(p)),
+        )
+
+    def test_r2(self):
+        self.run_class_metric_test(
+            _batches(_preds),
+            _batches(_target),
+            R2Score,
+            lambda p, t: sk_r2(np.asarray(t), np.asarray(p)),
+        )
+
+    def test_r2_adjusted(self):
+        n, k = _preds.size, 2
+
+        def golden(p, t):
+            r2 = sk_r2(np.asarray(t), np.asarray(p))
+            n_obs = np.asarray(p).size
+            return 1 - (1 - r2) * (n_obs - 1) / (n_obs - k - 1)
+
+        self.run_class_metric_test(
+            _batches(_preds), _batches(_target), R2Score, golden, metric_args={"adjusted": k},
+            check_batch=True,
+        )
+
+    def test_r2_functional(self):
+        self.run_functional_metric_test(
+            _batches(_preds), _batches(_target), r2_score, lambda p, t: sk_r2(np.asarray(t), np.asarray(p))
+        )
+
+    def test_rse(self):
+        self.run_class_metric_test(_batches(_preds), _batches(_target), RelativeSquaredError, _sk_rse)
+        self.run_functional_metric_test(_batches(_preds), _batches(_target), relative_squared_error, _sk_rse)
+
+
+class TestCorrelationFamily(MetricTester):
+    atol = 1e-5
+
+    def test_pearson(self):
+        self.run_class_metric_test(
+            _batches(_preds),
+            _batches(_target),
+            PearsonCorrCoef,
+            lambda p, t: pearsonr(np.asarray(p), np.asarray(t))[0],
+        )
+
+    def test_pearson_functional_jit(self):
+        self.run_functional_metric_test(
+            _batches(_preds), _batches(_target), pearson_corrcoef,
+            lambda p, t: pearsonr(np.asarray(p), np.asarray(t))[0],
+        )
+
+    def test_pearson_multioutput(self):
+        def golden(p, t):
+            p, t = np.asarray(p), np.asarray(t)
+            return np.array([pearsonr(p[:, i], t[:, i])[0] for i in range(p.shape[1])])
+
+        self.run_class_metric_test(
+            _batches(_preds_2d[:, :, :2].reshape(NUM_BATCHES, BATCH_SIZE, 2)),
+            _batches(_target_2d[:, :, :2].reshape(NUM_BATCHES, BATCH_SIZE, 2)),
+            PearsonCorrCoef,
+            golden,
+            metric_args={"num_outputs": 2},
+        )
+
+    def test_concordance(self):
+        self.run_class_metric_test(_batches(_preds), _batches(_target), ConcordanceCorrCoef, _sk_concordance)
+        self.run_functional_metric_test(_batches(_preds), _batches(_target), concordance_corrcoef, _sk_concordance)
+
+    def test_spearman(self):
+        self.run_class_metric_test(
+            _batches(_preds),
+            _batches(_target),
+            SpearmanCorrCoef,
+            lambda p, t: spearmanr(np.asarray(p), np.asarray(t))[0],
+        )
+        self.run_functional_metric_test(
+            _batches(_preds), _batches(_target), spearman_corrcoef,
+            lambda p, t: spearmanr(np.asarray(p), np.asarray(t))[0],
+        )
+
+    @pytest.mark.parametrize("variant", ["a", "b", "c"])
+    def test_kendall(self, variant):
+        # scipy kendalltau implements variants b and c; for continuous data with no
+        # ties tau-a == tau-b.
+        scipy_variant = {"a": "b", "b": "b", "c": "c"}[variant]
+
+        def golden(p, t):
+            return kendalltau(np.asarray(p), np.asarray(t), variant=scipy_variant)[0]
+
+        self.run_class_metric_test(
+            _batches(_preds), _batches(_target), KendallRankCorrCoef, golden,
+            metric_args={"variant": variant},
+        )
+
+    def test_kendall_pvalue(self):
+        def golden(p, t):
+            tau, pv = kendalltau(np.asarray(p), np.asarray(t))
+            return [tau, pv]
+
+        self.run_class_metric_test(
+            _batches(_preds), _batches(_target), KendallRankCorrCoef, golden,
+            metric_args={"t_test": True, "alternative": "two-sided"},
+            atol=1e-4,
+            check_structural=False,
+        )
+
+    def test_kendall_functional(self):
+        self.run_functional_metric_test(
+            _batches(_preds), _batches(_target), kendall_rank_corrcoef,
+            lambda p, t: kendalltau(np.asarray(p), np.asarray(t))[0],
+        )
+
+
+class TestPairStreamMetrics(MetricTester):
+    atol = 1e-6
+
+    def test_cosine_similarity(self):
+        self.run_class_metric_test(
+            _batches(_preds_2d),
+            _batches(_target_2d),
+            CosineSimilarity,
+            _sk_cosine_mean,
+            metric_args={"reduction": "mean"},
+        )
+        self.run_functional_metric_test(
+            _batches(_preds_2d), _batches(_target_2d), cosine_similarity, _sk_cosine_mean,
+            metric_args={"reduction": "mean"},
+        )
+
+    def test_kl_divergence(self):
+        self.run_class_metric_test(_batches(_preds_2d), _batches(_target_2d), KLDivergence, _sk_kld)
+        self.run_functional_metric_test(_batches(_preds_2d), _batches(_target_2d), kl_divergence, _sk_kld)
+
+
+class TestJitSafety:
+    """Every regression update must lower to a single XLA graph (SURVEY §7 thesis 4)."""
+
+    @pytest.mark.parametrize(
+        "fn,extra",
+        [
+            (pearson_corrcoef, {}),
+            (tweedie_deviance_score, {"power": 1.5}),
+            (concordance_corrcoef, {}),
+            (spearman_corrcoef, {}),
+            (kendall_rank_corrcoef, {}),
+        ],
+        ids=["pearson", "tweedie", "concordance", "spearman", "kendall"],
+    )
+    def test_jittable(self, fn, extra):
+        p = jnp.asarray(_preds[0])
+        t = jnp.asarray(_target[0])
+        eager = fn(p, t, **extra)
+        jitted = jax.jit(lambda a, b: fn(a, b, **extra))(p, t)
+        np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+    def test_modular_update_jits(self):
+        """jit a full (state → state) update step of PearsonCorrCoef."""
+        from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_update
+
+        @jax.jit
+        def step(state, p, t):
+            return _pearson_corrcoef_update(p, t, *state, num_outputs=1)
+
+        state = tuple(jnp.zeros(1) for _ in range(6))
+        state = step(state, jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        state = step(state, jnp.asarray(_preds[1]), jnp.asarray(_target[1]))
+        from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute
+
+        got = _pearson_corrcoef_compute(state[2], state[3], state[4], state[5])
+        want = pearsonr(_preds[:2].ravel(), _target[:2].ravel())[0]
+        np.testing.assert_allclose(float(got), want, atol=1e-6)

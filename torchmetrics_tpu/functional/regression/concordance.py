@@ -1,0 +1,48 @@
+"""Concordance correlation coefficient (reference ``functional/regression/concordance.py``).
+
+Reuses the Pearson streaming-moment state; CCC = 2ρσ_xσ_y / (σ_x² + σ_y² + (μ_x−μ_y)²).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.pearson import (
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+
+Array = jax.Array
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    nb: Array,
+) -> Array:
+    """CCC from accumulated moments (reference ``concordance.py:21-31``).
+
+    Uses sample variances (÷(n−1)); the reference reaches the same numbers via an
+    in-place ``/=`` inside ``_pearson_corrcoef_compute`` mutating its caller's tensors.
+    """
+    pearson = _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    return 2.0 * pearson * jnp.sqrt(var_x) * jnp.sqrt(var_y) / (var_x + var_y + (mean_x - mean_y) ** 2)
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Concordance correlation (reference ``concordance.py:34-69``)."""
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    _temp = jnp.zeros(d, dtype=jnp.result_type(preds, jnp.float32)).squeeze()
+    mean_x, mean_y, var_x = _temp, _temp, _temp
+    var_y, corr_xy, nb = _temp, _temp, _temp
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb,
+        num_outputs=1 if preds.ndim == 1 else preds.shape[-1],
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

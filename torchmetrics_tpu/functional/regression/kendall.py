@@ -1,0 +1,209 @@
+"""Kendall rank correlation (reference ``functional/regression/kendall.py``).
+
+TPU-first redesign: the reference counts concordant/discordant pairs with a Python
+loop over elements (``kendall.py:61-87``) and computes tie statistics with per-column
+``bincount`` loops (``kendall.py:100-113``). Here everything is one O(n²) masked
+sign-product reduction over the pairwise difference matrix, vmapped over outputs —
+branch-free, static shapes, single XLA graph. Tie-group statistics Σt(t−1)(t−2) and
+Σt(t−1)(2t+5) come from the pairwise equality matrix: every element of a tie group of
+size t has row-count c_i = t, so Σ_groups f(t) = Σ_i f(c_i)/c_i without any grouping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.stats import norm
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.enums import EnumStr
+
+Array = jax.Array
+
+
+class _MetricVariant(EnumStr):
+    """Tau variant selector (reference ``kendall.py:26-34``)."""
+
+    A = "a"
+    B = "b"
+    C = "c"
+
+    @staticmethod
+    def _name() -> str:
+        return "variant"
+
+
+class _TestAlternative(EnumStr):
+    """Hypothesis-test alternative (reference ``kendall.py:37-46``)."""
+
+    TWO_SIDED = "two-sided"
+    LESS = "less"
+    GREATER = "greater"
+
+    @staticmethod
+    def _name() -> str:
+        return "alternative"
+
+
+_PAIR_BLOCK = 512
+
+
+def _kendall_stats_1d(x: Array, y: Array) -> Tuple[Array, ...]:
+    """All pairwise statistics for a single (n,) pair, blocked to O(block·n) memory.
+
+    A ``lax.scan`` over row blocks compares each block against all n columns, so the
+    O(n²) pair comparisons never materialize an (n,n) matrix (peak memory is
+    ``_PAIR_BLOCK × n`` — a 50k-sample stream peaks at ~100 MB instead of ~10 GB).
+    Returns (concordant, discordant, ties_x_pairs, ties_y_pairs, x_p1, x_p2, y_p1,
+    y_p2, n_unique_x, n_unique_y); every value is a 0-d array so the whole thing
+    vmaps over the outputs axis.
+    """
+    n = x.shape[0]
+    block = min(_PAIR_BLOCK, n)
+    pad = (-n) % block
+    # pad with +inf so padded entries never tie with real data; masked out anyway
+    xp = jnp.concatenate([x, jnp.full((pad,), jnp.inf, dtype=x.dtype)])
+    yp = jnp.concatenate([y, jnp.full((pad,), jnp.inf, dtype=y.dtype)])
+    idx = jnp.arange(n + pad)
+    valid = idx < n
+    # Accumulate in the widest float the backend allows (f64 under x64, f32 on TPU).
+    acc_dtype = jnp.result_type(jnp.float32, jnp.float64)
+
+    row_starts = jnp.arange(0, n + pad, block)
+
+    def body(carry, start):
+        rows = start + jnp.arange(block)
+        xi = xp[rows]
+        yi = yp[rows]
+        vi = valid[rows]
+        dx = xi[:, None] - xp[None, :]
+        dy = yi[:, None] - yp[None, :]
+        pair_mask = vi[:, None] & valid[None, :] & (rows[:, None] < idx[None, :])
+        prod = jnp.sign(dx) * jnp.sign(dy)
+        con = jnp.sum((prod > 0) & pair_mask)
+        dis = jnp.sum((prod < 0) & pair_mask)
+        # c_i = size of the tie group row i belongs to (count over all valid columns)
+        cx = jnp.sum((dx == 0) & valid[None, :], axis=1).astype(acc_dtype)
+        cy = jnp.sum((dy == 0) & valid[None, :], axis=1).astype(acc_dtype)
+        vrow = vi.astype(acc_dtype)
+        sums = jnp.stack([
+            jnp.sum(vrow * (cx - 1)) / 2,  # Σ_groups t(t-1)/2 (per-row halves)
+            jnp.sum(vrow * (cy - 1)) / 2,
+            jnp.sum(vrow * (cx - 1) * (cx - 2)),  # Σ_groups t(t-1)(t-2)
+            jnp.sum(vrow * (cy - 1) * (cy - 2)),
+            jnp.sum(vrow * (cx - 1) * (2 * cx + 5)),  # Σ_groups t(t-1)(2t+5)
+            jnp.sum(vrow * (cy - 1) * (2 * cy + 5)),
+            jnp.sum(vrow / jnp.maximum(cx, 1.0)),  # Σ 1/t = #unique
+            jnp.sum(vrow / jnp.maximum(cy, 1.0)),
+        ])
+        c_con, c_dis, c_sums = carry
+        return (c_con + con, c_dis + dis, c_sums + sums), None
+
+    init = (jnp.asarray(0), jnp.asarray(0), jnp.zeros(8, dtype=acc_dtype))
+    (concordant, discordant, sums), _ = jax.lax.scan(body, init, row_starts)
+    ties_x, ties_y, x_p1, y_p1, x_p2, y_p2, n_unique_x, n_unique_y = sums
+    return concordant, discordant, ties_x, ties_y, x_p1, x_p2, y_p1, y_p2, n_unique_x, n_unique_y
+
+
+def _calculate_tau(
+    stats: Tuple[Array, ...],
+    n_total: Array,
+    variant: _MetricVariant,
+) -> Array:
+    """Tau from pairwise statistics (formulas per reference ``kendall.py:152-175``)."""
+    con, dis, ties_x, ties_y, _, _, _, _, nux, nuy = stats
+    con_min_dis = (con - dis).astype(ties_x.dtype)
+    if variant == _MetricVariant.A:
+        return con_min_dis / (con + dis)
+    if variant == _MetricVariant.B:
+        n0 = n_total * (n_total - 1) / 2
+        return con_min_dis / jnp.sqrt((n0 - ties_x) * (n0 - ties_y))
+    min_classes = jnp.minimum(nux, nuy)
+    return 2 * con_min_dis / ((min_classes - 1) / min_classes * n_total**2)
+
+
+def _calculate_p_value(
+    stats: Tuple[Array, ...],
+    n_total: Array,
+    variant: _MetricVariant,
+    alternative: Optional[_TestAlternative],
+) -> Array:
+    """Asymptotic-normal p-value with tie correction (reference ``kendall.py:193-224``)."""
+    con, dis, ties_x, ties_y, x_p1, x_p2, y_p1, y_p2, _, _ = stats
+    con_min_dis = (con - dis).astype(ties_x.dtype)
+    base = n_total * (n_total - 1) * (2 * n_total + 5)
+    if variant == _MetricVariant.A:
+        t_value = 3 * con_min_dis / jnp.sqrt(base / 2)
+    else:
+        m = n_total * (n_total - 1)
+        denom = (base - x_p2 - y_p2) / 18
+        denom = denom + (2 * ties_x * ties_y) / m
+        denom = denom + x_p1 * y_p1 / (9 * m * (n_total - 2))
+        t_value = con_min_dis / jnp.sqrt(denom)
+
+    if alternative == _TestAlternative.TWO_SIDED:
+        t_value = jnp.abs(t_value)
+    if alternative in (_TestAlternative.TWO_SIDED, _TestAlternative.GREATER):
+        t_value = -t_value
+    p_value = norm.cdf(t_value)
+    if alternative == _TestAlternative.TWO_SIDED:
+        p_value = p_value * 2
+    return p_value
+
+
+def _kendall_corrcoef_update(
+    preds: Array,
+    target: Array,
+    num_outputs: int = 1,
+) -> Tuple[Array, Array]:
+    """Validate and shape batch for the (cat) list states (reference ``kendall.py:227-258``)."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    if num_outputs == 1 and preds.ndim == 1:
+        preds = preds[:, None]
+        target = target[:, None]
+    return preds, target
+
+
+def _kendall_corrcoef_compute(
+    preds: Array,
+    target: Array,
+    variant: _MetricVariant,
+    alternative: Optional[_TestAlternative] = None,
+) -> Tuple[Array, Optional[Array]]:
+    """Tau (+ optional p-value) over the concatenated data (reference ``kendall.py:261-291``)."""
+    n_total = jnp.asarray(preds.shape[0], dtype=jnp.result_type(jnp.float32, jnp.float64))
+    stats = jax.vmap(_kendall_stats_1d, in_axes=1, out_axes=0)(preds, target)
+    tau = _calculate_tau(stats, n_total, variant)
+    p_value = _calculate_p_value(stats, n_total, variant, alternative) if alternative is not None else None
+    tau = jnp.clip(tau.squeeze(), -1.0, 1.0)
+    if p_value is not None:
+        p_value = p_value.squeeze()
+    return tau, p_value
+
+
+def kendall_rank_corrcoef(
+    preds: Array,
+    target: Array,
+    variant: str = "b",
+    t_test: bool = False,
+    alternative: Optional[str] = "two-sided",
+) -> Union[Array, Tuple[Array, Array]]:
+    """Kendall's tau (reference ``kendall.py:294-355``)."""
+    if not isinstance(t_test, bool):
+        raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {type(t_test)}.")
+    if t_test and alternative is None:
+        raise ValueError("Argument `alternative` is required if `t_test=True` but got `None`.")
+    _variant = _MetricVariant.from_str(str(variant))
+    _alternative = _TestAlternative.from_str(str(alternative)) if t_test else None
+
+    preds2, target2 = _kendall_corrcoef_update(
+        preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[-1]
+    )
+    tau, p_value = _kendall_corrcoef_compute(preds2, target2, _variant, _alternative)
+    if p_value is not None:
+        return tau, p_value
+    return tau

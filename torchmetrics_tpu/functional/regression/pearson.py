@@ -30,26 +30,39 @@ def _pearson_corrcoef_update(
     n_prior: Array,
     num_outputs: int,
 ) -> Tuple[Array, Array, Array, Array, Array, Array]:
-    """One streaming-moment step (reference ``pearson.py:23-76``)."""
+    """One streaming-moment step (reference ``pearson.py:23-76``).
+
+    Jit-safe: the reference's Python ``if n_prior > 0`` on a traced value becomes a
+    ``jnp.where`` select between the running-moment increment and the numerically
+    stable two-pass (centered-at-batch-mean) first-batch increment. Computing both
+    branches costs a few elementwise ops; dropping the two-pass branch would suffer
+    catastrophic cancellation in f32 for large-mean data (Σ(x−m)·x ≈ Σx² − …).
+    """
     _check_same_shape(preds, target)
     _check_data_shape_to_num_outputs(preds, target, num_outputs)
-    cond = bool(n_prior.mean() > 0)
+    cond = n_prior > 0
     n_obs = preds.shape[0]
-    if cond:
-        mx_new = (n_prior * mean_x + preds.sum(0)) / (n_prior + n_obs)
-        my_new = (n_prior * mean_y + target.sum(0)) / (n_prior + n_obs)
-    else:
-        mx_new = preds.mean(0)
-        my_new = target.mean(0)
-    n_prior = n_prior + n_obs
-    if cond:
-        var_x = var_x + ((preds - mx_new) * (preds - mean_x)).sum(0)
-        var_y = var_y + ((target - my_new) * (target - mean_y)).sum(0)
-    else:
-        var_x = var_x + preds.var(0, ddof=1) * (n_obs - 1)
-        var_y = var_y + target.var(0, ddof=1) * (n_obs - 1)
-    corr_xy = corr_xy + ((preds - mx_new) * (target - mean_y)).sum(0)
-    return mx_new, my_new, var_x, var_y, corr_xy, n_prior
+    n_total = n_prior + n_obs
+    mx_batch = preds.mean(0)
+    my_batch = target.mean(0)
+    mx_new = jnp.where(cond, (n_prior * mean_x + preds.sum(0)) / n_total, mx_batch)
+    my_new = jnp.where(cond, (n_prior * mean_y + target.sum(0)) / n_total, my_batch)
+    var_x = var_x + jnp.where(
+        cond,
+        ((preds - mx_new) * (preds - mean_x)).sum(0),
+        ((preds - mx_batch) ** 2).sum(0),
+    )
+    var_y = var_y + jnp.where(
+        cond,
+        ((target - my_new) * (target - mean_y)).sum(0),
+        ((target - my_batch) ** 2).sum(0),
+    )
+    corr_xy = corr_xy + jnp.where(
+        cond,
+        ((preds - mx_new) * (target - mean_y)).sum(0),
+        ((preds - mx_batch) * (target - my_batch)).sum(0),
+    )
+    return mx_new, my_new, var_x, var_y, corr_xy, n_total
 
 
 def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:

@@ -14,36 +14,44 @@ from torchmetrics_tpu.utilities.compute import _safe_xlogy
 Array = jax.Array
 
 
+def _tweedie_tensor_validation(preds: Array, targets: Array, power: float) -> None:
+    """Host-side domain checks (reference ``tweedie_deviance.py:37-76``).
+
+    Skipped automatically under ``jax.jit`` tracing — value checks need concrete data,
+    and the update itself must stay jit-compilable (SURVEY §7 thesis 4).
+    """
+    if isinstance(preds, jax.core.Tracer) or isinstance(targets, jax.core.Tracer):
+        return
+    if power == 1 and (bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) < 0))):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+    if power == 2 and (bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) <= 0))):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+    if power < 0 and bool(np.any(np.asarray(preds) <= 0)):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+    if 1 < power < 2 and (bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) < 0))):
+        raise ValueError(f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative.")
+    if power >= 2 and power != 2 and (bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) <= 0))):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+
 def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
-    """Σ deviance + count for the given power (reference ``tweedie_deviance.py:23-83``)."""
+    """Σ deviance + count for the given power (reference ``tweedie_deviance.py:23-83``).
+
+    Pure tensor math — all data-dependent domain checks live in
+    ``_tweedie_tensor_validation`` so this lowers to one XLA graph.
+    """
     _check_same_shape(preds, targets)
     if 0 < power < 1:
         raise ValueError(f"Deviance Score is not defined for power={power}.")
+    _tweedie_tensor_validation(preds, targets, power)
 
     if power == 0:
         deviance_score = (targets - preds) ** 2
     elif power == 1:
-        if bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) < 0)):
-            raise ValueError(
-                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
-            )
         deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
     elif power == 2:
-        if bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) <= 0)):
-            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
         deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
     else:
-        if power < 0:
-            if bool(np.any(np.asarray(preds) <= 0)):
-                raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
-        elif 1 < power < 2:
-            if bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) < 0)):
-                raise ValueError(
-                    f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
-                )
-        else:
-            if bool(np.any(np.asarray(preds) <= 0)) or bool(np.any(np.asarray(targets) <= 0)):
-                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
         term_1 = jnp.power(jnp.maximum(targets, 0.0), 2 - power) / ((1 - power) * (2 - power))
         term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
         term_3 = jnp.power(preds, 2 - power) / (2 - power)

@@ -1,0 +1,33 @@
+"""Modular ConcordanceCorrCoef (reference ``src/torchmetrics/regression/concordance.py``).
+
+Subclasses PearsonCorrCoef: identical moment states (and raw-gather merge), only the
+final formula differs — which also lets MetricCollection put both in one compute group.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from torchmetrics_tpu.functional.regression.concordance import _concordance_corrcoef_compute
+from torchmetrics_tpu.regression.pearson import PearsonCorrCoef
+
+Array = jax.Array
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """CCC from the Pearson moment states (reference ``concordance.py:19-100``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        """Concordance correlation; merges raw gathered per-chip moments first."""
+        return _concordance_corrcoef_compute(*self._merged_moments())
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,65 @@
+"""Modular ExplainedVariance (reference ``src/torchmetrics/regression/explained_variance.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.explained_variance import (
+    ALLOWED_MULTIOUTPUT,
+    _explained_variance_compute,
+    _explained_variance_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ExplainedVariance(Metric):
+    """Explained variance from streaming sums (reference ``explained_variance.py:26-125``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if multioutput not in ALLOWED_MULTIOUTPUT:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}"
+            )
+        self.multioutput = multioutput
+        self.add_state("sum_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_target", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_obs", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the five moment sums."""
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(
+            preds, target
+        )
+        self.n_obs = self.n_obs + n_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> Array:
+        """Explained variance under the chosen multioutput reduction."""
+        return _explained_variance_compute(
+            self.n_obs,
+            self.sum_error,
+            self.sum_squared_error,
+            self.sum_target,
+            self.sum_squared_target,
+            self.multioutput,
+        )
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,61 @@
+"""Modular KLDivergence (reference ``src/torchmetrics/regression/kl_divergence.py``).
+
+Sum state for mean/sum reductions, cat list state when per-row values are requested.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.kl_divergence import _kld_compute, _kld_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class KLDivergence(Metric):
+    """KL(P‖Q) (reference ``kl_divergence.py:26-130``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: str = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ("mean", "sum", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        """Accumulate per-row KL measures."""
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + measures.sum()
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """KL divergence under the chosen reduction."""
+        if self.reduction in ("mean", "sum"):
+            return _kld_compute(jnp.atleast_1d(self.measures), self.total, self.reduction)
+        measures = dim_zero_cat(self.measures)
+        return _kld_compute(measures, self.total, self.reduction)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,43 @@
+"""Modular LogCoshError (reference ``src/torchmetrics/regression/log_cosh.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.log_cosh import _log_cosh_error_compute, _log_cosh_error_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LogCoshError(Metric):
+    """Log-cosh error (reference ``log_cosh.py:25-109``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(1), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate log-cosh error and count."""
+        sum_log_cosh_error, n_obs = _log_cosh_error_update(preds, target, self.num_outputs)
+        self.sum_log_cosh_error = self.sum_log_cosh_error + sum_log_cosh_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        """Mean log-cosh error."""
+        return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,45 @@
+"""Modular MinkowskiDistance (reference ``src/torchmetrics/regression/minkowski.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.minkowski import (
+    _minkowski_distance_compute,
+    _minkowski_distance_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+class MinkowskiDistance(Metric):
+    """Minkowski distance of order p (reference ``minkowski.py:25-102``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Accumulate Σ|err|^p."""
+        minkowski_dist_sum = _minkowski_distance_update(preds, targets, self.p)
+        self.minkowski_dist_sum = self.minkowski_dist_sum + minkowski_dist_sum
+
+    def compute(self) -> Array:
+        """p-th root of the accumulated sum."""
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,81 @@
+"""Modular PearsonCorrCoef (reference ``src/torchmetrics/regression/pearson.py``).
+
+The canonical ``dist_reduce_fx=None`` metric: per-chip streaming moments are gathered
+*raw* (stacked, never pre-reduced) and merged with the pairwise-moment algorithm
+``_final_aggregation`` at compute — exactly the reference's ``pearson.py:28-70,135-140``
+semantics, with the merge promoted to ``functional/regression/pearson.py`` so
+``merge_state`` and the sync path share one implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson r from streaming moments (reference ``pearson.py:72-163``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: Optional[bool] = None  # both +1 and -1 are "good"
+    full_state_update: bool = True
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("mean_x", jnp.zeros(num_outputs), dist_reduce_fx=None)
+        self.add_state("mean_y", jnp.zeros(num_outputs), dist_reduce_fx=None)
+        self.add_state("var_x", jnp.zeros(num_outputs), dist_reduce_fx=None)
+        self.add_state("var_y", jnp.zeros(num_outputs), dist_reduce_fx=None)
+        self.add_state("corr_xy", jnp.zeros(num_outputs), dist_reduce_fx=None)
+        self.add_state("n_total", jnp.zeros(num_outputs), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """One streaming-moment step."""
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds,
+            target,
+            self.mean_x,
+            self.mean_y,
+            self.var_x,
+            self.var_y,
+            self.corr_xy,
+            self.n_total,
+            self.num_outputs,
+        )
+
+    def _merged_moments(self) -> tuple:
+        """States as one set of moments, folding raw gathered per-chip rows if present.
+
+        After a ``dist_reduce_fx=None`` sync (or ``merge_state``) each state is stacked
+        to ``(world, num_outputs)``; detect that and run ``_final_aggregation``.
+        Shared by :class:`ConcordanceCorrCoef`.
+        """
+        if (self.num_outputs == 1 and self.mean_x.size > 1) or (self.num_outputs > 1 and self.mean_x.ndim > 1):
+            return _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        """Correlation; merges raw gathered per-chip moments first when present."""
+        _, _, var_x, var_y, corr_xy, n_total = self._merged_moments()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

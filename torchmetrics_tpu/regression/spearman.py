@@ -1,0 +1,55 @@
+"""Modular SpearmanCorrCoef (reference ``src/torchmetrics/regression/spearman.py``).
+
+Raw values kept in cat list states; ranking (needs the full sequence) runs in compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from torchmetrics_tpu.functional.regression.spearman import (
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpearmanCorrCoef(Metric):
+    """Spearman ρ (reference ``spearman.py:25-112``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append one batch of raw values."""
+        preds, target = _spearman_corrcoef_update(preds, target, self.num_outputs)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Rank the full stream and correlate."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
