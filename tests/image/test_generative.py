@@ -1,0 +1,207 @@
+"""FID / IS / KID / LPIPS with pluggable toy extractors, vs scipy.linalg goldens.
+
+The metric cores are exactly the reference algorithms (``image/fid.py:160-179,315-339``
+etc.); pretrained backbones are injection points, so a deterministic linear extractor
+exercises every state/sync/compute path and scipy provides the matrix-sqrt golden.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import scipy.linalg
+
+from torchmetrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+)
+from torchmetrics_tpu.functional.image.lpips import make_lpips_net
+from torchmetrics_tpu.image.kid import poly_mmd
+
+rng = np.random.default_rng(7)
+D = 16
+_proj = jnp.asarray(rng.normal(size=(3 * 8 * 8, D)) / 8.0)
+
+
+def toy_extractor(imgs):
+    """Deterministic (N, D) feature extractor: bilinear 8x8 resize + fixed projection."""
+    imgs = jnp.asarray(imgs, dtype=jnp.float32)
+    n = imgs.shape[0]
+    small = jax.image.resize(imgs, (n, 3, 8, 8), method="bilinear")
+    return small.reshape(n, -1) @ _proj
+
+
+def _np_fid(feat_r, feat_f):
+    mu1, mu2 = feat_r.mean(0), feat_f.mean(0)
+    s1 = np.cov(feat_r, rowvar=False)
+    s2 = np.cov(feat_f, rowvar=False)
+    covmean = scipy.linalg.sqrtm(s1 @ s2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return ((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean)
+
+
+def _images(n, seed):
+    r = np.random.default_rng(seed)
+    return r.integers(0, 255, size=(n, 3, 24, 24), dtype=np.uint8)
+
+
+class TestFID:
+    def test_vs_scipy_golden(self):
+        fid = FrechetInceptionDistance(feature=toy_extractor)
+        real = _images(64, 1)
+        fake = _images(64, 2)
+        for chunk in np.array_split(real, 4):
+            fid.update(jnp.asarray(chunk), real=True)
+        for chunk in np.array_split(fake, 4):
+            fid.update(jnp.asarray(chunk), real=False)
+        got = float(fid.compute())
+
+        feat_r = np.asarray(toy_extractor(jnp.asarray(real)))
+        feat_f = np.asarray(toy_extractor(jnp.asarray(fake)))
+        want = _np_fid(feat_r, feat_f)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_identical_distributions_near_zero(self):
+        fid = FrechetInceptionDistance(feature=toy_extractor)
+        imgs = _images(128, 3)
+        fid.update(jnp.asarray(imgs), real=True)
+        fid.update(jnp.asarray(imgs), real=False)
+        assert abs(float(fid.compute())) < 1e-4
+
+    def test_reset_real_features_false(self):
+        fid = FrechetInceptionDistance(feature=toy_extractor, reset_real_features=False)
+        fid.update(jnp.asarray(_images(32, 4)), real=True)
+        n_before = int(fid.real_features_num_samples)
+        fid.update(jnp.asarray(_images(32, 5)), real=False)
+        fid.reset()
+        assert int(fid.real_features_num_samples) == n_before
+        assert int(fid.fake_features_num_samples) == 0
+
+    def test_normalize_flag(self):
+        fid = FrechetInceptionDistance(feature=toy_extractor, normalize=True)
+        imgs = jnp.asarray(_images(8, 6).astype(np.float32) / 255.0)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        assert int(fid.real_features_num_samples) == 8
+
+    def test_default_feature_raises_without_weights(self):
+        with pytest.raises(ModuleNotFoundError, match="pretrained"):
+            FrechetInceptionDistance()
+
+    def test_merge_state_parity(self):
+        """World-2 emulation: two replicas merged == single stream (psum sync path)."""
+        real = _images(64, 1)
+        fake = _images(64, 2)
+        whole = FrechetInceptionDistance(feature=toy_extractor)
+        reps = [FrechetInceptionDistance(feature=toy_extractor) for _ in range(2)]
+        for i, chunk in enumerate(np.array_split(real, 2)):
+            whole.update(jnp.asarray(chunk), real=True)
+            reps[i].update(jnp.asarray(chunk), real=True)
+        for i, chunk in enumerate(np.array_split(fake, 2)):
+            whole.update(jnp.asarray(chunk), real=False)
+            reps[i].update(jnp.asarray(chunk), real=False)
+        reps[0].merge_state(reps[1])
+        np.testing.assert_allclose(float(reps[0].compute()), float(whole.compute()), rtol=1e-6)
+
+
+class TestInceptionScore:
+    def test_vs_numpy_golden(self):
+        np.random.seed(0)
+        isc = InceptionScore(feature=toy_extractor, splits=2)
+        imgs = _images(40, 10)
+        isc.update(jnp.asarray(imgs))
+        mean, std = isc.compute()
+
+        feats = np.asarray(toy_extractor(jnp.asarray(imgs)), dtype=np.float64)
+        np.random.seed(0)
+        idx = np.random.permutation(feats.shape[0])
+        feats = feats[idx]
+        e = np.exp(feats - feats.max(axis=1, keepdims=True))
+        prob = e / e.sum(axis=1, keepdims=True)
+        scores = []
+        for chunk in np.array_split(prob, 2):
+            marg = chunk.mean(0, keepdims=True)
+            kl = (chunk * (np.log(chunk) - np.log(marg))).sum(1).mean()
+            scores.append(np.exp(kl))
+        np.testing.assert_allclose(float(mean), np.mean(scores), rtol=1e-4)
+        np.testing.assert_allclose(float(std), np.std(scores, ddof=1), rtol=1e-3)
+
+
+class TestKID:
+    def test_vs_numpy_golden_full_subset(self):
+        """subset_size == n and subsets=1 makes the subset draw deterministic."""
+        kid = KernelInceptionDistance(feature=toy_extractor, subsets=1, subset_size=32)
+        real = _images(32, 20)
+        fake = _images(32, 21)
+        kid.update(jnp.asarray(real), real=True)
+        kid.update(jnp.asarray(fake), real=False)
+        mean, std = kid.compute()
+
+        fr = np.asarray(toy_extractor(jnp.asarray(real)), dtype=np.float64)
+        ff = np.asarray(toy_extractor(jnp.asarray(fake)), dtype=np.float64)
+
+        def k(a, b):
+            return (a @ b.T / a.shape[1] + 1.0) ** 3
+
+        m = fr.shape[0]
+        kxx, kyy, kxy = k(fr, fr), k(ff, ff), k(fr, ff)
+        want = (kxx.sum() - np.trace(kxx) + kyy.sum() - np.trace(kyy)) / (m * (m - 1)) - 2 * kxy.sum() / m**2
+        np.testing.assert_allclose(float(mean), want, rtol=1e-4)
+        np.testing.assert_allclose(float(std), 0.0, atol=1e-7)
+
+    def test_subset_size_guard(self):
+        kid = KernelInceptionDistance(feature=toy_extractor, subset_size=1000)
+        kid.update(jnp.asarray(_images(8, 22)), real=True)
+        kid.update(jnp.asarray(_images(8, 23)), real=False)
+        with pytest.raises(ValueError, match="subset_size"):
+            kid.compute()
+
+
+class TestLPIPS:
+    def _toy_net(self):
+        conv_w = jnp.asarray(rng.normal(size=(8, 3, 3, 3)) * 0.2)
+
+        def feats_fn(img):
+            h1 = jax.nn.relu(
+                jax.lax.conv_general_dilated(img, conv_w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            )
+            h2 = jax.lax.reduce_window(h1, -jnp.inf, jax.lax.max, (1, 1, 2, 2), (1, 1, 2, 2), "VALID")
+            return [h1, h2]
+
+        lin = [jnp.abs(jnp.asarray(rng.normal(size=(8,)))), jnp.abs(jnp.asarray(rng.normal(size=(8,))))]
+        return make_lpips_net(feats_fn, lin)
+
+    def test_zero_for_identical(self):
+        net = self._toy_net()
+        m = LearnedPerceptualImagePatchSimilarity(net_type=net, normalize=True)
+        img = jnp.asarray(rng.uniform(0, 1, size=(4, 3, 16, 16)))
+        m.update(img, img)
+        np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-7)
+
+    def test_monotone_and_accumulation(self):
+        net = self._toy_net()
+        img = jnp.asarray(rng.uniform(0, 1, size=(4, 3, 16, 16)))
+        near = jnp.clip(img + 0.01, 0, 1)
+        far = jnp.clip(img + 0.3, 0, 1)
+        m = LearnedPerceptualImagePatchSimilarity(net_type=net, normalize=True)
+        m.update(img, near)
+        v_near = float(m.compute())
+        m.reset()
+        m.update(img, far)
+        v_far = float(m.compute())
+        assert v_far > v_near > 0
+
+    def test_string_backbone_raises(self):
+        with pytest.raises(ModuleNotFoundError, match="pretrained"):
+            LearnedPerceptualImagePatchSimilarity(net_type="alex")
+
+    def test_invalid_range_raises(self):
+        net = self._toy_net()
+        m = LearnedPerceptualImagePatchSimilarity(net_type=net, normalize=True)
+        with pytest.raises(ValueError, match="normalized tensors"):
+            m.update(jnp.ones((2, 3, 8, 8)) * 2.0, jnp.ones((2, 3, 8, 8)))

@@ -1,0 +1,54 @@
+"""ERGAS — relative global dimensionless synthesis error (reference ``functional/image/ergas.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helper import _check_image_shape
+from torchmetrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _ergas_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate BxCxHxW inputs (reference ``ergas.py:24-46``)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    return _check_image_shape(preds, target)
+
+
+def _ergas_compute(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Per-image ERGAS (reference ``ergas.py:49-92``)."""
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS (reference ``ergas.py:95-133``)."""
+    preds, target = _ergas_update(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

@@ -1,0 +1,33 @@
+"""Image gradients by finite difference (reference ``functional/image/gradients.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    """Validate 4D input (reference ``gradients.py:21-27``)."""
+    if not isinstance(img, (jax.Array, jnp.ndarray)):
+        raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """(dy, dx), last row/col zero-padded (reference ``gradients.py:30-48``)."""
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Finite-difference image gradients (reference ``gradients.py:51-88``)."""
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,135 @@
+"""LPIPS distance pipeline (reference ``functional/image/lpips.py``).
+
+The reference vendors torchvision AlexNet/VGG/SqueezeNet backbones plus bundled linear
+heads (``lpips_models/*.pth``). This environment bundles no pretrained weights, so the
+TPU build ships the full distance *pipeline* (input scaling, per-layer unit
+normalization, squared diff, 1×1 linear heads, spatial averaging, layer sum) with the
+backbone injected as a callable: ``feats_fn(img) -> [feature_map, ...]`` plus optional
+per-layer head weights. ``make_lpips_net`` composes them into the ``net(img1, img2,
+normalize)`` callable the modular metric consumes — a user with converted weights gets
+exact LPIPS; tests drive the pipeline with toy backbones.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# ImageNet-derived scaling constants (reference ``lpips.py:196-203``)
+_SHIFT = jnp.asarray([-0.030, -0.088, -0.188])[None, :, None, None]
+_SCALE = jnp.asarray([0.458, 0.448, 0.450])[None, :, None, None]
+
+
+def normalize_tensor(in_feat: Array, eps: float = 1e-10) -> Array:
+    """Unit-normalize along channels (reference ``lpips.py:187-190``)."""
+    norm_factor = jnp.sqrt(jnp.sum(in_feat**2, axis=1, keepdims=True))
+    return in_feat / (norm_factor + eps)
+
+
+def spatial_average(in_tens: Array, keepdim: bool = True) -> Array:
+    """Mean over H, W (reference ``lpips.py:177-179``)."""
+    return in_tens.mean(axis=(2, 3), keepdims=keepdim)
+
+
+def upsample(in_tens: Array, out_hw: Tuple[int, int] = (64, 64)) -> Array:
+    """Bilinear upsample to ``out_hw`` (reference ``lpips.py:182-184``)."""
+    b, c = in_tens.shape[:2]
+    return jax.image.resize(in_tens, (b, c, *out_hw), method="bilinear")
+
+
+def scaling_layer(inp: Array) -> Array:
+    """Shift/scale RGB input (reference ``lpips.py:193-203``)."""
+    return (inp - _SHIFT) / _SCALE
+
+
+def _lpips_distance(
+    feats_fn: Callable[[Array], Sequence[Array]],
+    img1: Array,
+    img2: Array,
+    lin_weights: Optional[Sequence[Array]] = None,
+    normalize: bool = False,
+    spatial: bool = False,
+) -> Array:
+    """Full LPIPS forward for a backbone (reference ``_LPIPS.forward``, ``lpips.py:291-320``)."""
+    if normalize:  # [0,1] -> [-1,1]
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+    in0, in1 = scaling_layer(img1), scaling_layer(img2)
+    outs0, outs1 = feats_fn(in0), feats_fn(in1)
+
+    res: List[Array] = []
+    for kk in range(len(outs0)):
+        feats0 = normalize_tensor(outs0[kk])
+        feats1 = normalize_tensor(outs1[kk])
+        diff = (feats0 - feats1) ** 2
+        if lin_weights is not None:
+            w = lin_weights[kk].reshape(1, -1, 1, 1)
+            lin_out = (diff * w).sum(axis=1, keepdims=True)
+        else:
+            lin_out = diff.sum(axis=1, keepdims=True)
+        if spatial:
+            res.append(upsample(lin_out, out_hw=img1.shape[2:]))
+        else:
+            res.append(spatial_average(lin_out, keepdim=True))
+    val = res[0]
+    for layer in res[1:]:
+        val = val + layer
+    return val
+
+
+def make_lpips_net(
+    feats_fn: Callable[[Array], Sequence[Array]],
+    lin_weights: Optional[Sequence[Array]] = None,
+    spatial: bool = False,
+) -> Callable[..., Array]:
+    """Compose a backbone + heads into the ``net(img1, img2, normalize=...)`` callable."""
+
+    def net(img1: Array, img2: Array, normalize: bool = False) -> Array:
+        return _lpips_distance(feats_fn, img1, img2, lin_weights, normalize, spatial)
+
+    return net
+
+
+def _valid_img(img: Array, normalize: bool) -> bool:
+    """Input domain check (reference ``lpips.py:331-334``)."""
+    value_check = bool(img.max() <= 1.0 and img.min() >= 0.0) if normalize else bool(img.min() >= -1)
+    return img.ndim == 4 and img.shape[1] == 3 and value_check
+
+
+def _lpips_update(img1: Array, img2: Array, net: Callable[..., Array], normalize: bool) -> Tuple[Array, int]:
+    """Per-batch distances + count (reference ``lpips.py:337-346``)."""
+    if not (_valid_img(img1, normalize) and _valid_img(img2, normalize)):
+        raise ValueError(
+            "Expected both input arguments to be normalized tensors with shape [N, 3, H, W]."
+            f" Got input with shape {img1.shape} and {img2.shape} and values in range"
+            f" {[img1.min(), img1.max()]} and {[img2.min(), img2.max()]} when all values are"
+            f" expected to be in the {[0, 1] if normalize else [-1, 1]} range."
+        )
+    loss = net(img1, img2, normalize=normalize).squeeze()
+    return loss, img1.shape[0]
+
+
+def _lpips_compute(sum_scores: Array, total: Union[Array, int], reduction: str = "mean") -> Array:
+    """Reduce accumulated scores (reference ``lpips.py:349-350``)."""
+    return sum_scores / total if reduction == "mean" else sum_scores
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net: Callable[..., Array],
+    reduction: str = "mean",
+    normalize: bool = False,
+) -> Array:
+    """LPIPS with an injected backbone net (reference ``lpips.py:353-401``)."""
+    if not callable(net):
+        raise ModuleNotFoundError(
+            f"Argument `net={net!r}`: string backbones require pretrained weights, which are not bundled."
+            " Build one with `make_lpips_net(feats_fn, lin_weights)` from converted weights."
+        )
+    loss, total = _lpips_update(img1, img2, net, normalize)
+    return _lpips_compute(loss.sum(), total, reduction)

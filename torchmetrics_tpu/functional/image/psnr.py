@@ -1,0 +1,76 @@
+"""Peak signal-to-noise ratio (reference ``functional/image/psnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    n_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """PSNR from accumulated SSE (reference ``psnr.py:23-55``)."""
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction=reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    """SSE + count, optionally per reduced-dim slice (reference ``psnr.py:58-87``)."""
+    if dim is None:
+        diff = preds - target
+        sum_squared_error = jnp.sum(diff * diff)
+        n_obs = jnp.asarray(target.size)
+        return sum_squared_error, n_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        n_obs = jnp.asarray(target.size)
+    else:
+        n_obs = jnp.prod(jnp.asarray([target.shape[d] for d in dim_list]))
+        n_obs = jnp.broadcast_to(n_obs, sum_squared_error.shape)
+    return sum_squared_error, n_obs
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR (reference ``psnr.py:90-142``)."""
+    if dim is None and reduction != "elementwise_mean":
+        from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = target.max() - target.min()
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = jnp.asarray(data_range[1] - data_range[0])
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

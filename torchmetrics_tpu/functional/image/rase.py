@@ -1,0 +1,50 @@
+"""RASE — relative average spectral error (reference ``functional/image/rase.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helper import _uniform_filter
+from torchmetrics_tpu.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+
+Array = jax.Array
+
+
+def _rase_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_map: Optional[Array],
+    target_sum: Optional[Array],
+    total_images: Optional[Array],
+) -> Tuple[Array, Array, Array]:
+    """Accumulate windowed RMSE map + windowed target mean (reference ``rase.py:22-49``)."""
+    _, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images
+    )
+    this_target_sum = jnp.sum(_uniform_filter(target, window_size) / (window_size**2), axis=0)
+    target_sum = (target_sum if target_sum is not None else 0.0) + this_target_sum
+    return rmse_map, target_sum, total_images
+
+
+def _rase_compute(rmse_map: Array, target_sum: Array, total_images: Array, window_size: int) -> Array:
+    """RASE from accumulated maps (reference ``rase.py:52-72``)."""
+    _, rmse_map = _rmse_sw_compute(rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images)
+    target_mean = target_sum / total_images
+    target_mean = target_mean.mean(0)  # mean over image channels
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    crop_slide = round(window_size / 2)
+    return jnp.mean(rase_map[crop_slide:-crop_slide, crop_slide:-crop_slide])
+
+
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """RASE (reference ``rase.py:75-107``)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    rmse_map, target_sum, total_images = _rase_update(
+        preds, target, window_size, rmse_map=None, target_sum=None, total_images=None
+    )
+    return _rase_compute(rmse_map, target_sum, total_images, window_size)

@@ -1,0 +1,44 @@
+"""Spectral Angle Mapper (reference ``functional/image/sam.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helper import _check_image_shape
+from torchmetrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _sam_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate multispectral BxCxHxW inputs (reference ``sam.py:24-48``)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_image_shape(preds, target)
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """Per-pixel spectral angle (reference ``sam.py:51-77``)."""
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1.0, 1.0))
+    return reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """SAM (reference ``sam.py:80-118``)."""
+    preds, target = _sam_update(preds, target)
+    return _sam_compute(preds, target, reduction)

@@ -83,8 +83,10 @@ def _kendall_stats_1d(x: Array, y: Array) -> Tuple[Array, ...]:
         dy = yi[:, None] - yp[None, :]
         pair_mask = vi[:, None] & valid[None, :] & (rows[:, None] < idx[None, :])
         prod = jnp.sign(dx) * jnp.sign(dy)
-        con = jnp.sum((prod > 0) & pair_mask)
-        dis = jnp.sum((prod < 0) & pair_mask)
+        # accumulate counts in acc_dtype — int32 would overflow at n(n-1)/2 pairs
+        # (~65.5k samples); f64 is exact far beyond any realistic stream
+        con = jnp.sum((prod > 0) & pair_mask, dtype=acc_dtype)
+        dis = jnp.sum((prod < 0) & pair_mask, dtype=acc_dtype)
         # c_i = size of the tie group row i belongs to (count over all valid columns)
         cx = jnp.sum((dx == 0) & valid[None, :], axis=1).astype(acc_dtype)
         cy = jnp.sum((dy == 0) & valid[None, :], axis=1).astype(acc_dtype)
@@ -102,7 +104,7 @@ def _kendall_stats_1d(x: Array, y: Array) -> Tuple[Array, ...]:
         c_con, c_dis, c_sums = carry
         return (c_con + con, c_dis + dis, c_sums + sums), None
 
-    init = (jnp.asarray(0), jnp.asarray(0), jnp.zeros(8, dtype=acc_dtype))
+    init = (jnp.zeros((), dtype=acc_dtype), jnp.zeros((), dtype=acc_dtype), jnp.zeros(8, dtype=acc_dtype))
     (concordant, discordant, sums), _ = jax.lax.scan(body, init, row_starts)
     ties_x, ties_y, x_p1, y_p1, x_p2, y_p2, n_unique_x, n_unique_y = sums
     return concordant, discordant, ties_x, ties_y, x_p1, x_p2, y_p1, y_p2, n_unique_x, n_unique_y

@@ -1,0 +1,37 @@
+"""Modular image metrics (reference ``src/torchmetrics/image/__init__.py``)."""
+
+from torchmetrics_tpu.image.d_lambda import SpectralDistortionIndex
+from torchmetrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance
+from torchmetrics_tpu.image.inception import InceptionScore
+from torchmetrics_tpu.image.kid import KernelInceptionDistance
+from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+from torchmetrics_tpu.image.psnr import PeakSignalNoiseRatio
+from torchmetrics_tpu.image.psnrb import PeakSignalNoiseRatioWithBlockedEffect
+from torchmetrics_tpu.image.rase import RelativeAverageSpectralError
+from torchmetrics_tpu.image.rmse_sw import RootMeanSquaredErrorUsingSlidingWindow
+from torchmetrics_tpu.image.sam import SpectralAngleMapper
+from torchmetrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from torchmetrics_tpu.image.tv import TotalVariation
+from torchmetrics_tpu.image.uqi import UniversalImageQualityIndex
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
+    "RelativeAverageSpectralError",
+    "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+]

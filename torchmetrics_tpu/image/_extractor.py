@@ -1,0 +1,46 @@
+"""Pluggable feature-extractor resolution for model-backed image metrics.
+
+The reference builds its extractors from ``torch-fidelity``'s pretrained InceptionV3
+(``image/fid.py:52-157``). This environment has no bundled weights and no egress, so
+the extractor is an injection point instead: any callable ``imgs -> (N, d) features``
+(a Flax module's apply, a jitted function, …). Passing the reference's integer feature
+sizes raises the same kind of actionable error the reference raises when
+``torch-fidelity`` is missing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def resolve_feature_extractor(
+    feature,
+    num_features: Optional[int] = None,
+    probe_shape: Tuple[int, ...] = (1, 3, 299, 299),
+) -> Tuple[Callable[[Array], Array], int]:
+    """Return ``(extractor, num_features)`` for a pluggable ``feature`` argument.
+
+    Args:
+        feature: a callable ``imgs -> (N, d)`` feature extractor, or one of the
+            reference's integer/str defaults (which require pretrained weights and
+            therefore raise here with guidance).
+        num_features: feature dimensionality; probed with a dummy forward if ``None``.
+        probe_shape: shape of the dummy input used to probe ``num_features``.
+    """
+    if isinstance(feature, (int, str)):
+        raise ModuleNotFoundError(
+            f"Default feature extractor `feature={feature!r}` requires pretrained InceptionV3 weights, which are"
+            " not bundled. Pass a callable `imgs -> (N, d)` feature extractor instead (e.g. a Flax module apply"
+            " with converted weights)."
+        )
+    if not callable(feature):
+        raise TypeError("Got unknown input to argument `feature`")
+    if num_features is None:
+        probe = jnp.zeros(probe_shape, dtype=jnp.uint8)
+        num_features = int(feature(probe).shape[-1])
+    return feature, num_features

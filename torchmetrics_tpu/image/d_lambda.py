@@ -1,0 +1,56 @@
+"""Modular Spectral Distortion Index (reference ``src/torchmetrics/image/d_lambda.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from torchmetrics_tpu.functional.image.d_lambda import (
+    _spectral_distortion_index_compute,
+    _spectral_distortion_index_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpectralDistortionIndex(Metric):
+    """D_lambda (reference ``d_lambda.py:26-123``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Buffer one batch of image pairs."""
+        preds, target = _spectral_distortion_index_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """D_lambda over all buffered images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spectral_distortion_index_compute(preds, target, self.p, self.reduction)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

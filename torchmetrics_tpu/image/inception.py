@@ -1,0 +1,85 @@
+"""Inception Score (reference ``src/torchmetrics/image/inception.py``).
+
+List state of logits features (``dist_reduce_fx=None`` — raw gather at sync, like the
+reference ``inception.py:140``); split-KL computed at epoch end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.image._extractor import resolve_feature_extractor
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    """IS = exp(E[KL(p(y|x) ‖ p(y))]) over splits (reference ``inception.py:30-185``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    features: List[Array]
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable[[Array], Array]] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        num_features: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            "Metric `InceptionScore` will save all extracted features in buffer."
+            " For large datasets this may lead to large memory footprint.",
+            UserWarning,
+        )
+        self.inception, _ = resolve_feature_extractor(feature, num_features)
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError("Integer input to argument `splits` must be positive")
+        self.splits = splits
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self.add_state("features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:
+        """Extract and buffer logits (reference ``inception.py:152-156``)."""
+        imgs = (imgs * 255).astype(jnp.uint8) if self.normalize else imgs
+        features = self.inception(imgs)
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Mean/std of per-split exp(KL) (reference ``inception.py:158-180``)."""
+        features = dim_zero_cat(self.features)
+        # random permutation on host — compute runs once per epoch
+        idx = np.random.permutation(features.shape[0])
+        features = features[jnp.asarray(idx)]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+
+        kl_ = []
+        for p, log_p in zip(prob_chunks, log_prob_chunks):
+            mean_prob = p.mean(axis=0, keepdims=True)
+            kl = p * (log_p - jnp.log(mean_prob))
+            kl_.append(jnp.exp(kl.sum(axis=1).mean()))
+        kl_stack = jnp.stack(kl_)
+        return kl_stack.mean(), kl_stack.std(ddof=1)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        val = val if val is not None else self.compute()[0]
+        return self._plot(val, ax)

@@ -1,0 +1,80 @@
+"""Modular LPIPS (reference ``src/torchmetrics/image/lpip.py``).
+
+Sum-of-distances + count states; backbone injected as a callable (see
+``functional/image/lpips.py`` for why — no bundled pretrained weights).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.lpips import _lpips_compute, _lpips_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS (reference ``lpip.py:30-142``).
+
+    Args:
+        net_type: a ``net(img1, img2, normalize=...) -> (N,)`` callable (build with
+            :func:`torchmetrics_tpu.functional.image.lpips.make_lpips_net`); the
+            reference's string backbones raise — their weights are not bundled.
+        reduction: 'mean' or 'sum' over accumulated per-sample distances.
+        normalize: True if inputs are in [0,1] (scaled to [-1,1] internally).
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        net_type: Union[str, Callable[..., Array]] = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(net_type, str):
+            valid_net_type = ("vgg", "alex", "squeeze")
+            if net_type not in valid_net_type:
+                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+            raise ModuleNotFoundError(
+                f"Backbone `net_type={net_type!r}` requires pretrained weights, which are not bundled."
+                " Pass a callable net built with `make_lpips_net(feats_fn, lin_weights)` instead."
+            )
+        if not callable(net_type):
+            raise ValueError("Argument `net_type` must be a string or a callable net.")
+        self.net = net_type
+
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be an bool but got {normalize}")
+        self.normalize = normalize
+
+        self.add_state("sum_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Accumulate per-batch LPIPS distances."""
+        loss, total = _lpips_update(img1, img2, net=self.net, normalize=self.normalize)
+        self.sum_scores = self.sum_scores + loss.sum()
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Reduced LPIPS."""
+        return _lpips_compute(self.sum_scores, self.total, self.reduction)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

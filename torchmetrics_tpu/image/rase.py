@@ -1,0 +1,50 @@
+"""Modular RASE (reference ``src/torchmetrics/image/rase.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from torchmetrics_tpu.functional.image.rase import _rase_compute, _rase_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RelativeAverageSpectralError(Metric):
+    """RASE (reference ``rase.py:25-108``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Buffer one batch of image pairs."""
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """RASE over all buffered images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        rmse_map, target_sum, total_images = _rase_update(
+            preds, target, self.window_size, rmse_map=None, target_sum=None, total_images=None
+        )
+        return _rase_compute(rmse_map, target_sum, total_images, self.window_size)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

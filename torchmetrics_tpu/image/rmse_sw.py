@@ -1,0 +1,46 @@
+"""Modular windowed RMSE (reference ``src/torchmetrics/image/rmse_sw.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """Windowed RMSE (reference ``rmse_sw.py:24-99``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self.add_state("rmse_val_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-batch windowed RMSE sums."""
+        rmse_val_sum, _, total_images = _rmse_sw_update(
+            preds, target, self.window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+        )
+        self.rmse_val_sum = self.rmse_val_sum + rmse_val_sum
+        self.total_images = self.total_images + total_images
+
+    def compute(self) -> Optional[Array]:
+        """Mean windowed RMSE."""
+        rmse, _ = _rmse_sw_compute(self.rmse_val_sum, rmse_map=None, total_images=self.total_images)
+        return rmse
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

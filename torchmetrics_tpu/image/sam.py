@@ -1,0 +1,46 @@
+"""Modular SAM (reference ``src/torchmetrics/image/sam.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from torchmetrics_tpu.functional.image.sam import _sam_compute, _sam_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpectralAngleMapper(Metric):
+    """SAM (reference ``sam.py:26-123``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Buffer one batch of image pairs."""
+        preds, target = _sam_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """SAM over all buffered images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _sam_compute(preds, target, self.reduction)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

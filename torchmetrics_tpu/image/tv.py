@@ -1,0 +1,53 @@
+"""Modular TotalVariation (reference ``src/torchmetrics/image/tv.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.tv import _total_variation_compute, _total_variation_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TotalVariation(Metric):
+    """TV (reference ``tv.py:26-113``)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+
+        if self.reduction is None or self.reduction == "none":
+            self.add_state("score", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_elements", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        """Accumulate per-image TV."""
+        score, num_elements = _total_variation_update(img)
+        if self.reduction is None or self.reduction == "none":
+            self.score.append(score)
+        else:
+            self.score = self.score + score.sum()
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Union[Array, List[Array]]:
+        """Reduced TV."""
+        if self.reduction is None or self.reduction == "none":
+            return dim_zero_cat(self.score)
+        return _total_variation_compute(jnp.atleast_1d(self.score), self.num_elements, self.reduction)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

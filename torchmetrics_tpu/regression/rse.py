@@ -1,6 +1,7 @@
 """Modular RelativeSquaredError (reference ``src/torchmetrics/regression/rse.py``).
 
-Shares the R² moment states (Σy², Σy, RSS, n).
+Subclasses :class:`R2Score`: identical moment states (Σy², Σy, RSS, n), only the final
+formula differs — which also lets MetricCollection put both in one compute group.
 """
 
 from __future__ import annotations
@@ -8,39 +9,25 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
-import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.regression.r2 import _r2_score_update
 from torchmetrics_tpu.functional.regression.rse import _relative_squared_error_compute
-from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.regression.r2 import R2Score
 
 Array = jax.Array
 
 
-class RelativeSquaredError(Metric):
+class RelativeSquaredError(R2Score):
     """RSE (reference ``rse.py:24-105``)."""
 
     is_differentiable: bool = True
     higher_is_better: bool = False
     full_state_update: bool = False
     plot_lower_bound: float = 0.0
+    plot_upper_bound: Optional[float] = None
 
     def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
-        self.num_outputs = num_outputs
-        self.add_state("sum_squared_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
-        self.add_state("sum_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
-        self.add_state("residual", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+        super().__init__(num_outputs=num_outputs, **kwargs)
         self.squared = squared
-
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate Σy², Σy, RSS, n."""
-        sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
-        self.sum_error = self.sum_error + sum_obs
-        self.residual = self.residual + rss
-        self.total = self.total + n_obs
 
     def compute(self) -> Array:
         """Relative squared error."""
