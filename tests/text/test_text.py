@@ -1,0 +1,397 @@
+"""Text suite: sacrebleu / nltk / rouge_score goldens (the reference's own golden libs,
+``tests/unittests/text/``) plus hand-rolled counters, through the MetricTester protocol.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from functools import lru_cache
+
+import sacrebleu as sb
+from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu
+from rouge_score.rouge_scorer import RougeScorer
+
+from tests.testers import MetricTester
+from torchmetrics_tpu.functional import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    extended_edit_distance,
+    match_error_rate,
+    perplexity,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from torchmetrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+# WMT-style fixture pairs (reference uses WMT16 samples)
+PREDS_A = ["the cat sat on the mat", "there is a big tree near the house"]
+TARGET_A = ["the cat sat on the mat", "there is a large tree near the house"]
+PREDS_B = ["a quick brown fox jumps over the dog", "hello world this is a test"]
+TARGET_B = ["the quick brown fox jumps over the lazy dog", "hello world it is a test"]
+
+BATCHES_PREDS = [PREDS_A, PREDS_B]
+BATCHES_TARGET = [TARGET_A, TARGET_B]
+# multi-reference versions
+BATCHES_TARGET_MULTI = [[[t, t.upper()] for t in TARGET_A], [[t, t.upper()] for t in TARGET_B]]
+
+
+def _edit_golden(a, b):
+    """Independent recursive-memo Levenshtein."""
+
+    @lru_cache(maxsize=None)
+    def d(i, j):
+        if i == 0:
+            return j
+        if j == 0:
+            return i
+        return min(
+            d(i - 1, j) + 1,
+            d(i, j - 1) + 1,
+            d(i - 1, j - 1) + (a[i - 1] != b[j - 1]),
+        )
+
+    return d(len(a), len(b))
+
+
+def _wer_golden(preds, target):
+    errs = sum(_edit_golden(tuple(p.split()), tuple(t.split())) for p, t in zip(preds, target))
+    total = sum(len(t.split()) for t in target)
+    return errs / total
+
+
+def _cer_golden(preds, target):
+    errs = sum(_edit_golden(tuple(p), tuple(t)) for p, t in zip(preds, target))
+    total = sum(len(t) for t in target)
+    return errs / total
+
+
+def _mer_golden(preds, target):
+    errs = sum(_edit_golden(tuple(p.split()), tuple(t.split())) for p, t in zip(preds, target))
+    total = sum(max(len(t.split()), len(p.split())) for p, t in zip(preds, target))
+    return errs / total
+
+
+def _wil_wip_stats(preds, target):
+    errs = sum(_edit_golden(tuple(p.split()), tuple(t.split())) for p, t in zip(preds, target))
+    total = sum(max(len(t.split()), len(p.split())) for p, t in zip(preds, target))
+    tt = sum(len(t.split()) for t in target)
+    pt = sum(len(p.split()) for p in preds)
+    h = errs - total  # the reference's (errors - total) statistic
+    return h, tt, pt
+
+
+def _wil_golden(preds, target):
+    h, tt, pt = _wil_wip_stats(preds, target)
+    return 1 - (h / tt) * (h / pt)
+
+
+def _wip_golden(preds, target):
+    h, tt, pt = _wil_wip_stats(preds, target)
+    return (h / tt) * (h / pt)
+
+
+class TestWerFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "cls,fn,golden",
+        [
+            (WordErrorRate, word_error_rate, _wer_golden),
+            (CharErrorRate, char_error_rate, _cer_golden),
+            (MatchErrorRate, match_error_rate, _mer_golden),
+            (WordInfoLost, word_information_lost, _wil_golden),
+            (WordInfoPreserved, word_information_preserved, _wip_golden),
+        ],
+        ids=["wer", "cer", "mer", "wil", "wip"],
+    )
+    def test_class_and_functional(self, cls, fn, golden):
+        self.run_class_metric_test(BATCHES_PREDS, BATCHES_TARGET, cls, golden)
+        self.run_functional_metric_test(BATCHES_PREDS, BATCHES_TARGET, fn, golden, check_jit=False)
+
+
+class TestBLEU(MetricTester):
+    atol = 1e-5
+
+    def test_vs_nltk(self):
+        def golden(preds, target):
+            refs = [[t.split()] for t in target]
+            hyps = [p.split() for p in preds]
+            return corpus_bleu(refs, hyps)
+
+        self.run_class_metric_test(BATCHES_PREDS, BATCHES_TARGET, BLEUScore, golden)
+        self.run_functional_metric_test(BATCHES_PREDS, BATCHES_TARGET, bleu_score, golden, check_jit=False)
+
+    def test_smooth_vs_nltk(self):
+        def golden(preds, target):
+            refs = [[t.split()] for t in target]
+            hyps = [p.split() for p in preds]
+            return corpus_bleu(refs, hyps, smoothing_function=SmoothingFunction().method2)
+
+        self.run_class_metric_test(
+            BATCHES_PREDS, BATCHES_TARGET, BLEUScore, golden, metric_args={"smooth": True}
+        )
+
+    def test_multi_reference(self):
+        all_preds = PREDS_A + PREDS_B
+        all_targets = [[t] for t in TARGET_A] + [[t] for t in TARGET_B]
+        got = float(bleu_score(all_preds, all_targets))
+        refs = [[t[0].split()] for t in all_targets]
+        want = corpus_bleu(refs, [p.split() for p in all_preds])
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+class TestSacreBLEU(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_vs_sacrebleu(self, tokenize, lowercase):
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        got = float(
+            sacre_bleu_score(all_preds, [[t] for t in all_targets], tokenize=tokenize, lowercase=lowercase)
+        )
+        metric = sb.metrics.BLEU(tokenize=tokenize, lowercase=lowercase, effective_order=False)
+        want = metric.corpus_score(all_preds, [all_targets]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_class_accumulation(self):
+        m = SacreBLEUScore()
+        for preds, target in zip(BATCHES_PREDS, BATCHES_TARGET):
+            m.update(preds, [[t] for t in target])
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        want = sb.metrics.BLEU(effective_order=False).corpus_score(all_preds, [all_targets]).score / 100
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
+
+
+class TestCHRF(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("n_word_order", [0, 2])
+    def test_vs_sacrebleu(self, n_word_order):
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        got = float(chrf_score(all_preds, [[t] for t in all_targets], n_word_order=n_word_order))
+        metric = sb.metrics.CHRF(word_order=n_word_order)
+        want = metric.corpus_score(all_preds, [all_targets]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_class_accumulation_matches_corpus(self):
+        m = CHRFScore()
+        for preds, target in zip(BATCHES_PREDS, BATCHES_TARGET):
+            m.update(preds, [[t] for t in target])
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        want = float(chrf_score(all_preds, [[t] for t in all_targets]))
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+
+    def test_sentence_level_scores(self):
+        score, sentences = chrf_score(PREDS_A, [[t] for t in TARGET_A], return_sentence_level_score=True)
+        assert sentences.shape == (2,)
+        assert float(sentences[0]) == pytest.approx(1.0, abs=1e-6)  # identical pair
+
+
+class TestTER(MetricTester):
+    atol = 1e-5
+
+    def test_vs_sacrebleu(self):
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        got = float(translation_edit_rate(all_preds, [[t] for t in all_targets]))
+        metric = sb.metrics.TER()
+        want = metric.corpus_score(all_preds, [all_targets]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("kwargs", [{"normalize": True}, {"lowercase": False}, {"no_punctuation": True}])
+    def test_vs_sacrebleu_options(self, kwargs):
+        preds = ["A Quick, brown fox! jumped?", "the cat; sat. on the mat"]
+        target = ["a quick brown fox jumped", "The cat sat on the Mat."]
+        got = float(translation_edit_rate(preds, [[t] for t in target], **kwargs))
+        sb_kwargs = {
+            "normalized": kwargs.get("normalize", False),
+            "no_punct": kwargs.get("no_punctuation", False),
+            "case_sensitive": not kwargs.get("lowercase", True),
+        }
+        want = sb.metrics.TER(**sb_kwargs).corpus_score(preds, [target]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_class_accumulation(self):
+        m = TranslationEditRate()
+        for preds, target in zip(BATCHES_PREDS, BATCHES_TARGET):
+            m.update(preds, [[t] for t in target])
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        want = sb.metrics.TER().corpus_score(all_preds, [all_targets]).score / 100
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
+
+
+class TestROUGE(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("key", ["rouge1", "rouge2", "rougeL"])
+    def test_vs_rouge_score(self, key):
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        got = rouge_score(all_preds, all_targets, rouge_keys=key)
+        scorer = RougeScorer([key], use_stemmer=False)
+        scores = [scorer.score(t, p)[key] for p, t in zip(all_preds, all_targets)]
+        np.testing.assert_allclose(
+            float(got[f"{key}_fmeasure"]), np.mean([s.fmeasure for s in scores]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(got[f"{key}_precision"]), np.mean([s.precision for s in scores]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(got[f"{key}_recall"]), np.mean([s.recall for s in scores]), atol=1e-5
+        )
+
+    def test_rouge_lsum(self):
+        # newline-pre-split summaries: rouge_score's default Lsum path splits on "\n"
+        preds = ["the cat sat\nthe dog barked loudly"]
+        target = ["the cat sat on the mat\na dog barked"]
+        got = rouge_score(preds, target, rouge_keys="rougeLsum")
+        scorer = RougeScorer(["rougeLsum"], use_stemmer=False)
+        want = scorer.score(target[0], preds[0])["rougeLsum"]
+        np.testing.assert_allclose(float(got["rougeLsum_fmeasure"]), want.fmeasure, atol=1e-5)
+
+    def test_class_accumulation(self):
+        m = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+        for preds, target in zip(BATCHES_PREDS, BATCHES_TARGET):
+            m.update(preds, target)
+        out = m.compute()
+        all_preds = PREDS_A + PREDS_B
+        all_targets = TARGET_A + TARGET_B
+        want = rouge_score(all_preds, all_targets, rouge_keys=("rouge1", "rougeL"))
+        for k in out:
+            np.testing.assert_allclose(float(out[k]), float(want[k]), atol=1e-6)
+
+
+class TestPerplexity(MetricTester):
+    atol = 1e-4
+
+    def test_vs_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        rng = np.random.default_rng(3)
+        logits = rng.normal(size=(2, 8, 16)).astype(np.float32)
+        target = rng.integers(0, 16, size=(2, 8))
+
+        got = float(perplexity(jnp.asarray(logits), jnp.asarray(target)))
+        t_logits = torch.tensor(logits).reshape(-1, 16)
+        t_target = torch.tensor(target).reshape(-1)
+        want = torch.exp(F.cross_entropy(t_logits, t_target)).item()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_ignore_index(self):
+        rng = np.random.default_rng(4)
+        logits = rng.normal(size=(2, 8, 16)).astype(np.float32)
+        target = rng.integers(0, 16, size=(2, 8))
+        target[0, :4] = -100
+
+        import torch
+        import torch.nn.functional as F
+
+        got = float(perplexity(jnp.asarray(logits), jnp.asarray(target), ignore_index=-100))
+        want = torch.exp(
+            F.cross_entropy(torch.tensor(logits).reshape(-1, 16), torch.tensor(target).reshape(-1), ignore_index=-100)
+        ).item()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_class_accumulation(self):
+        rng = np.random.default_rng(5)
+        m = Perplexity()
+        all_logits, all_targets = [], []
+        for _ in range(3):
+            logits = rng.normal(size=(2, 6, 10)).astype(np.float32)
+            target = rng.integers(0, 10, size=(2, 6))
+            all_logits.append(logits)
+            all_targets.append(target)
+            m.update(jnp.asarray(logits), jnp.asarray(target))
+        want = float(
+            perplexity(jnp.asarray(np.concatenate(all_logits)), jnp.asarray(np.concatenate(all_targets)))
+        )
+        np.testing.assert_allclose(float(m.compute()), want, rtol=1e-6)
+
+
+class TestSquad(MetricTester):
+    def test_known_values(self):
+        preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        out = squad(preds, target)
+        assert float(out["exact_match"]) == 100.0
+        assert float(out["f1"]) == 100.0
+
+    def test_partial_match(self):
+        preds = [{"prediction_text": "large green tree", "id": "a"}]
+        target = [{"answers": {"answer_start": [0], "text": ["green tree"]}, "id": "a"}]
+        out = squad(preds, target)
+        assert float(out["exact_match"]) == 0.0
+        np.testing.assert_allclose(float(out["f1"]), 2 * (2 / 3) * (2 / 2) / ((2 / 3) + 1.0) * 100, atol=1e-4)
+
+    def test_class(self):
+        m = SQuAD()
+        m.update(
+            [{"prediction_text": "1976", "id": "x"}],
+            [{"answers": {"text": ["1976"]}, "id": "x"}],
+        )
+        m.update(
+            [{"prediction_text": "wrong", "id": "y"}],
+            [{"answers": {"text": ["right"]}, "id": "y"}],
+        )
+        out = m.compute()
+        assert float(out["exact_match"]) == 50.0
+
+
+class TestEED(MetricTester):
+    def test_identical_pair_coverage_floor(self):
+        """Identical strings score rho/(L+rho) — the coverage cost of the unvisited cell."""
+        sent = PREDS_A[0]
+        got = float(extended_edit_distance([sent], [[sent]]))
+        length = len(f" {sent} ")  # en preprocessing pads with spaces
+        want = 0.3 / (length + 0.3)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_monotone(self):
+        close = float(extended_edit_distance(["the cat sat on the mat"], [["the cat sat on a mat"]]))
+        far = float(extended_edit_distance(["the cat sat on the mat"], [["completely different words here"]]))
+        assert 0 < close < far <= 1
+
+    def test_reference_doctest_value(self):
+        # reference eed.py doctest: hyps/refs below -> 0.3078
+        preds = ["this is the prediction", "here is an other sample"]
+        target = ["this is the reference", "here is another one"]
+        got = float(extended_edit_distance(preds, target))
+        np.testing.assert_allclose(got, 0.3078, atol=1e-3)
+
+    def test_class_accumulation(self):
+        m = ExtendedEditDistance()
+        for preds, target in zip(BATCHES_PREDS, BATCHES_TARGET):
+            m.update(preds, [[t] for t in target])
+        all_preds = PREDS_A + PREDS_B
+        all_targets = [[t] for t in TARGET_A + TARGET_B]
+        want = float(extended_edit_distance(all_preds, all_targets))
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)

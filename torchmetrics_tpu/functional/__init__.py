@@ -10,5 +10,7 @@ from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.text import __all__ as _text_all
 
-__all__ = list(_classification_all) + list(_regression_all) + list(_image_all)
+__all__ = list(_classification_all) + list(_regression_all) + list(_image_all) + list(_text_all)

@@ -1,0 +1,145 @@
+"""BERTScore (reference ``functional/text/bert.py``).
+
+The greedy cosine-matching core is pure jnp — one (L_p, L_t) matmul per pair, vmapped
+over the batch (MXU path). The transformer is an injection point: pass
+``user_tokenizer`` (sentences → {input_ids, attention_mask}) and ``model``
+(input_ids, attention_mask → (N, L, D) embeddings) exactly like the reference's
+own-model path (``examples/bert_score-own_model.py``); HF model-name strings raise —
+no pretrained weights are bundled in this environment.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _validate_model_inputs(model: Any, user_tokenizer: Any) -> None:
+    if model is None or isinstance(model, str):
+        raise ModuleNotFoundError(
+            f"Default transformer backbones (`model_name_or_path={model!r}`) require downloadable pretrained"
+            " weights, which are not available. Pass a callable `model(input_ids, attention_mask) -> embeddings`"
+            " plus a `user_tokenizer`, as in the reference's own-model example."
+        )
+    if not callable(model):
+        raise ValueError("Argument `model` must be a callable embedding model.")
+    if user_tokenizer is None or not callable(user_tokenizer):
+        raise ValueError("A callable `user_tokenizer` returning {'input_ids', 'attention_mask'} is required.")
+
+
+def _compute_idf(token_batches: List[Array], mask_batches: List[Array]) -> Dict[int, float]:
+    """Inverse document frequency over the target corpus (reference ``bert.py`` idf path)."""
+    import numpy as np
+
+    doc_counts: Counter = Counter()
+    num_docs = 0
+    for ids, mask in zip(token_batches, mask_batches):
+        ids_np = np.asarray(ids)
+        mask_np = np.asarray(mask).astype(bool)
+        for row, mrow in zip(ids_np, mask_np):
+            num_docs += 1
+            doc_counts.update(set(row[mrow].tolist()))
+    import math
+
+    return {tok: math.log((num_docs + 1) / (cnt + 1)) for tok, cnt in doc_counts.items()}
+
+
+def _idf_weights(ids: Array, mask: Array, idf: Optional[Dict[int, float]]) -> Array:
+    """Per-token weights: idf lookup or uniform."""
+    import numpy as np
+
+    if idf is None:
+        return jnp.asarray(np.asarray(mask), dtype=jnp.float32)
+    ids_np = np.asarray(ids)
+    default = 0.0
+    w = np.vectorize(lambda t: idf.get(int(t), default))(ids_np).astype(np.float32)
+    return jnp.asarray(w) * jnp.asarray(np.asarray(mask), dtype=jnp.float32)
+
+
+def _greedy_cosine_scores(
+    pred_emb: Array, pred_mask: Array, tgt_emb: Array, tgt_mask: Array, pred_w: Array, tgt_w: Array
+) -> Tuple[Array, Array, Array]:
+    """Batched precision/recall/F1 from greedy token matching.
+
+    pred_emb: (N, Lp, D); tgt_emb: (N, Lt, D); masks/weights (N, L*).
+    """
+
+    def _norm(e):
+        return e / jnp.clip(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-12)
+
+    pred_n = _norm(pred_emb)
+    tgt_n = _norm(tgt_emb)
+
+    def _one(pe, pm, te, tm, pw, tw):
+        sim = pe @ te.T  # (Lp, Lt)
+        neg = -jnp.inf
+        sim_masked = jnp.where(pm[:, None] * tm[None, :] > 0, sim, neg)
+        best_for_pred = jnp.where(pm > 0, jnp.max(sim_masked, axis=1), 0.0)
+        best_for_tgt = jnp.where(tm > 0, jnp.max(sim_masked, axis=0), 0.0)
+        precision = jnp.sum(best_for_pred * pw) / jnp.clip(jnp.sum(pw), 1e-12)
+        recall = jnp.sum(best_for_tgt * tw) / jnp.clip(jnp.sum(tw), 1e-12)
+        f1 = 2 * precision * recall / jnp.clip(precision + recall, 1e-12)
+        return precision, recall, f1
+
+    return jax.vmap(_one)(pred_n, pred_mask, tgt_n, tgt_mask, pred_w, tgt_w)
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Optional[Callable] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 4,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Dict[str, Union[Array, List[float], str]]:
+    """BERTScore with an injected embedding model (reference ``bert.py:...``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+    if rescale_with_baseline:
+        raise ValueError("Baseline rescaling requires downloadable baseline files, which are unavailable.")
+    _validate_model_inputs(model if model is not None else model_name_or_path, user_tokenizer)
+
+    pred_tok = user_tokenizer(preds)
+    tgt_tok = user_tokenizer(target)
+    forward = user_forward_fn if user_forward_fn is not None else model
+
+    pred_emb = forward(pred_tok["input_ids"], pred_tok["attention_mask"])
+    tgt_emb = forward(tgt_tok["input_ids"], tgt_tok["attention_mask"])
+
+    idf_map = (
+        _compute_idf([tgt_tok["input_ids"]], [tgt_tok["attention_mask"]]) if idf else None
+    )
+    pred_w = _idf_weights(pred_tok["input_ids"], pred_tok["attention_mask"], idf_map)
+    tgt_w = _idf_weights(tgt_tok["input_ids"], tgt_tok["attention_mask"], idf_map)
+
+    precision, recall, f1 = _greedy_cosine_scores(
+        pred_emb,
+        jnp.asarray(pred_tok["attention_mask"], dtype=jnp.float32),
+        tgt_emb,
+        jnp.asarray(tgt_tok["attention_mask"], dtype=jnp.float32),
+        pred_w,
+        tgt_w,
+    )
+    return {"precision": precision, "recall": recall, "f1": f1}

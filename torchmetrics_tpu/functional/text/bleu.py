@@ -1,0 +1,129 @@
+"""BLEU score (reference ``functional/text/bleu.py``).
+
+N-gram counting on host tokens → fixed per-order tensor states (numerator/denominator
+of shape (n_gram,), sum-reduced — one psum at sync, like the reference
+``text/bleu.py:90-93``); the geometric-mean/brevity-penalty compute is jnp.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """Counter over all 1..n grams (reference ``bleu.py:21-37``)."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j : i + j])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    """Whitespace tokenizer (reference ``bleu.py:40-49``)."""
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: Array,
+    denominator: Array,
+    preds_len: Array,
+    target_len: Array,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Fold one batch of corpora into the four states (reference ``bleu.py:52-98``).
+
+    Returns all four updated states (the reference mutates numerator/denominator in
+    place; immutable arrays here).
+    """
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+
+    num_add = [0.0] * n_gram
+    den_add = [0.0] * n_gram
+    preds_len_add = 0.0
+    target_len_add = 0.0
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len_add += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        target_len_add += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter: Counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+
+        ngram_counter_clip = preds_counter & target_counter
+        for counter_clip in ngram_counter_clip:
+            num_add[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in preds_counter:
+            den_add[len(counter) - 1] += preds_counter[counter]
+
+    numerator = numerator + jnp.asarray(num_add)
+    denominator = denominator + jnp.asarray(den_add)
+    preds_len = preds_len + preds_len_add
+    target_len = target_len + target_len_add
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Weighted-log-precision BLEU with brevity penalty (reference ``bleu.py:101-135``)."""
+    # Stay on-device: a float() fetch here would poison the axon stream for every
+    # subsequent op in a forward() loop. Mask the zero-count branch with where instead.
+    min_numerator = jnp.min(numerator)
+    denominator_safe = jnp.where(denominator == 0, 1.0, denominator)
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / denominator_safe[0])
+    else:
+        precision_scores = numerator / denominator_safe
+
+    precision_safe = jnp.where(precision_scores > 0, precision_scores, 1.0)
+    log_precision_scores = jnp.asarray(weights) * jnp.log(precision_safe)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - (target_len / preds_len)))
+    return jnp.where(min_numerator == 0, jnp.asarray(0.0), brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU (reference ``bleu.py:138-195``)."""
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    preds_len = jnp.asarray(0.0)
+    target_len = jnp.asarray(0.0)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, preds_len, target_len, n_gram
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

@@ -1,0 +1,39 @@
+"""Character error rate (reference ``functional/text/cer.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Σ char edit ops + Σ reference chars (reference ``cer.py:22-48``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = list(pred)
+        tgt_tokens = list(tgt)
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    """Reference ``cer.py:51-61``."""
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """CER (reference ``cer.py:64-87``)."""
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

@@ -1,0 +1,235 @@
+"""chrF / chrF++ (reference ``functional/text/chrf.py``).
+
+The reference keeps per-order counter *dicts* as dynamically-named states
+(``chrf.py:48-77``); here each statistic is one fixed-shape array indexed by n-gram
+order — (n_char_order,) and (n_word_order,) sum states, two psums at sync.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_EPS_SMOOTHING = 1e-16
+_PUNCTUATIONS = set("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+
+def _get_characters(sentence: str, whitespace: bool) -> List[str]:
+    """Character stream, optionally stripping whitespace (reference ``chrf.py:80-92``)."""
+    if whitespace:
+        return list(sentence)
+    return list("".join(sentence.split()))
+
+
+def _separate_word_and_punctuation(word: str) -> List[str]:
+    """Split leading/trailing punctuation (reference ``chrf.py:95-114``)."""
+    if len(word) == 1:
+        return [word]
+    if word[-1] in _PUNCTUATIONS:
+        return [word[:-1], word[-1]]
+    if word[0] in _PUNCTUATIONS:
+        return [word[0], word[1:]]
+    return [word]
+
+
+def _get_words_and_punctuation(sentence: str) -> List[str]:
+    """Tokenize into words with separated punctuation (reference ``chrf.py:117-126``)."""
+    return sum((_separate_word_and_punctuation(word) for word in sentence.strip().split()), [])
+
+
+def _ngram_counts(char_or_word_list: List[str], n_gram_order: int) -> Dict[int, Counter]:
+    """Counters of n-grams per order 1..n (reference ``chrf.py:129-143``)."""
+    ngrams: Dict[int, Counter] = {}
+    for n in range(1, n_gram_order + 1):
+        ngrams[n] = Counter(
+            tuple(char_or_word_list[i : i + n]) for i in range(len(char_or_word_list) - n + 1)
+        )
+    return ngrams
+
+
+def _sentence_statistics(
+    sentence: str, n_char_order: int, n_word_order: int, lowercase: bool, whitespace: bool
+) -> Tuple[Dict[int, Counter], Dict[int, Counter], np.ndarray, np.ndarray]:
+    """Char/word n-gram counts + per-order totals (reference ``chrf.py:146-193``)."""
+    if lowercase:
+        sentence = sentence.lower()
+    char_n_grams = _ngram_counts(_get_characters(sentence, whitespace), n_char_order)
+    word_n_grams = _ngram_counts(_get_words_and_punctuation(sentence), n_word_order)
+    char_totals = np.asarray([sum(char_n_grams[n].values()) for n in range(1, n_char_order + 1)], dtype=np.float64)
+    word_totals = np.asarray([sum(word_n_grams[n].values()) for n in range(1, n_word_order + 1)], dtype=np.float64)
+    return char_n_grams, word_n_grams, char_totals, word_totals
+
+
+def _matches(hyp: Dict[int, Counter], ref: Dict[int, Counter]) -> np.ndarray:
+    """Per-order clipped match counts (reference ``chrf.py:196-217``)."""
+    return np.asarray(
+        [sum((hyp[n] & ref[n]).values()) for n in sorted(hyp)], dtype=np.float64
+    )
+
+
+def _fscore_from_arrays(
+    matching_char: np.ndarray,
+    matching_word: np.ndarray,
+    hyp_char: np.ndarray,
+    hyp_word: np.ndarray,
+    ref_char: np.ndarray,
+    ref_word: np.ndarray,
+    n_order: float,
+    beta: float,
+) -> float:
+    """chrF score from per-order totals (reference ``chrf.py:235-288``)."""
+
+    def _f(matching, hyp, ref):
+        precision = np.where(hyp > 0, matching / np.where(hyp > 0, hyp, 1.0), 0.0)
+        recall = np.where(ref > 0, matching / np.where(ref > 0, ref, 1.0), 0.0)
+        denom = np.maximum(beta**2 * precision + recall, _EPS_SMOOTHING)
+        return (1 + beta**2) * precision * recall / denom
+
+    return float((_f(matching_char, hyp_char, ref_char).sum() + _f(matching_word, hyp_word, ref_word).sum()) / n_order)
+
+
+def _chrf_score_update(
+    preds: Union[str, Sequence[str]],
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    total_preds_char_n_grams: Array,
+    total_preds_word_n_grams: Array,
+    total_target_char_n_grams: Array,
+    total_target_word_n_grams: Array,
+    total_matching_char_n_grams: Array,
+    total_matching_word_n_grams: Array,
+    n_char_order: int,
+    n_word_order: int,
+    n_order: float,
+    beta: float,
+    lowercase: bool,
+    whitespace: bool,
+    sentence_chrf_score: Optional[List[Array]] = None,
+) -> Tuple[Array, Array, Array, Array, Array, Array, Optional[List[Array]]]:
+    """Fold one batch of corpora into the six array states (reference ``chrf.py:376-483``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    target_: Sequence[Sequence[str]] = [[t] if isinstance(t, str) else t for t in target]
+
+    p_char_add = np.zeros(n_char_order)
+    p_word_add = np.zeros(n_word_order)
+    t_char_add = np.zeros(n_char_order)
+    t_word_add = np.zeros(n_word_order)
+    m_char_add = np.zeros(n_char_order)
+    m_word_add = np.zeros(n_word_order)
+
+    for pred, targets in zip(preds, target_):
+        pred_char_counts, pred_word_counts, pred_char_totals, pred_word_totals = _sentence_statistics(
+            pred, n_char_order, n_word_order, lowercase, whitespace
+        )
+        p_char_add += pred_char_totals
+        p_word_add += pred_word_totals
+
+        # Start below any attainable f-score so the first reference's statistics are
+        # always recorded, even at zero overlap (else its totals vanish from the corpus
+        # recall denominator).
+        best_f_score = -1.0
+        best_matching_char = np.zeros(n_char_order)
+        best_matching_word = np.zeros(n_word_order)
+        best_target_char = np.zeros(n_char_order)
+        best_target_word = np.zeros(n_word_order)
+
+        for tgt in targets:
+            tgt_char_counts, tgt_word_counts, tgt_char_totals, tgt_word_totals = _sentence_statistics(
+                tgt, n_char_order, n_word_order, lowercase, whitespace
+            )
+            matching_char = _matches(pred_char_counts, tgt_char_counts)
+            matching_word = _matches(pred_word_counts, tgt_word_counts)
+            f_score = _fscore_from_arrays(
+                matching_char, matching_word, pred_char_totals, pred_word_totals,
+                tgt_char_totals, tgt_word_totals, n_order, beta,
+            )
+            if f_score > best_f_score:
+                best_f_score = f_score
+                best_matching_char = matching_char
+                best_matching_word = matching_word
+                best_target_char = tgt_char_totals
+                best_target_word = tgt_word_totals
+
+        t_char_add += best_target_char
+        t_word_add += best_target_word
+        m_char_add += best_matching_char
+        m_word_add += best_matching_word
+        if sentence_chrf_score is not None:
+            sentence_chrf_score.append(jnp.asarray(best_f_score))
+
+    return (
+        total_preds_char_n_grams + jnp.asarray(p_char_add),
+        total_preds_word_n_grams + jnp.asarray(p_word_add),
+        total_target_char_n_grams + jnp.asarray(t_char_add),
+        total_target_word_n_grams + jnp.asarray(t_word_add),
+        total_matching_char_n_grams + jnp.asarray(m_char_add),
+        total_matching_word_n_grams + jnp.asarray(m_word_add),
+        sentence_chrf_score,
+    )
+
+
+def _chrf_score_compute(
+    total_preds_char_n_grams: Array,
+    total_preds_word_n_grams: Array,
+    total_target_char_n_grams: Array,
+    total_target_word_n_grams: Array,
+    total_matching_char_n_grams: Array,
+    total_matching_word_n_grams: Array,
+    n_order: float,
+    beta: float,
+) -> Array:
+    """Corpus-level chrF from the accumulated totals (reference ``chrf.py:486-521``)."""
+    score = _fscore_from_arrays(
+        np.asarray(total_matching_char_n_grams),
+        np.asarray(total_matching_word_n_grams),
+        np.asarray(total_preds_char_n_grams),
+        np.asarray(total_preds_word_n_grams),
+        np.asarray(total_target_char_n_grams),
+        np.asarray(total_target_word_n_grams),
+        n_order,
+        beta,
+    )
+    return jnp.asarray(score)
+
+
+def chrf_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    n_char_order: int = 6,
+    n_word_order: int = 2,
+    beta: float = 2.0,
+    lowercase: bool = False,
+    whitespace: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """chrF / chrF++ (reference ``chrf.py:524-612``)."""
+    if not isinstance(n_char_order, int) or n_char_order < 1:
+        raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+    if not isinstance(n_word_order, int) or n_word_order < 0:
+        raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+    if beta < 0:
+        raise ValueError("Expected argument `beta` to be greater than 0.")
+    n_order = float(n_char_order + n_word_order)
+
+    states = [
+        jnp.zeros(n_char_order),
+        jnp.zeros(n_word_order),
+        jnp.zeros(n_char_order),
+        jnp.zeros(n_word_order),
+        jnp.zeros(n_char_order),
+        jnp.zeros(n_word_order),
+    ]
+    sentence_scores: Optional[List[Array]] = [] if return_sentence_level_score else None
+    *states, sentence_scores = _chrf_score_update(
+        preds, target, *states, n_char_order, n_word_order, n_order, beta, lowercase, whitespace, sentence_scores
+    )
+    score = _chrf_score_compute(*states, n_order, beta)
+    if sentence_scores is not None:
+        return score, jnp.stack(sentence_scores)
+    return score

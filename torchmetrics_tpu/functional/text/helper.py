@@ -1,0 +1,44 @@
+"""Edit-distance core for text metrics (reference ``functional/text/helper.py``).
+
+Host-side by design: tokenization and DP over ragged token sequences are string work
+the reference also keeps on host (``helper.py:64``); only the resulting counters land
+in device states. The row recurrence is vectorized with numpy — the in-row dependency
+``dp[j] = min(dp[j-1]+1, …)`` is a min-plus prefix scan, computed as
+``min.accumulate(candidate − j) + j`` — so each row is O(n) numpy ops instead of the
+reference's pure-Python O(n) inner loop per cell.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _token_ids(tokens: Sequence[str], vocab: dict) -> np.ndarray:
+    """Map tokens to integer codes (shared vocab dict mutated in place)."""
+    return np.asarray([vocab.setdefault(t, len(vocab)) for t in tokens], dtype=np.int64)
+
+
+def _edit_distance(prediction_tokens: Sequence[str], reference_tokens: Sequence[str]) -> int:
+    """Levenshtein distance between token sequences (reference ``helper.py:445-467``)."""
+    if len(prediction_tokens) == 0:
+        return len(reference_tokens)
+    if len(reference_tokens) == 0:
+        return len(prediction_tokens)
+    vocab: dict = {}
+    a = _token_ids(prediction_tokens, vocab)
+    b = _token_ids(reference_tokens, vocab)
+
+    n = b.shape[0]
+    j_range = np.arange(n + 1)
+    prev = j_range.copy()
+    for i, ca in enumerate(a, start=1):
+        cost = (b != ca).astype(np.int64)
+        m = np.empty(n + 1, dtype=np.int64)
+        m[0] = i
+        np.minimum(prev[1:] + 1, prev[:-1] + cost, out=m[1:])
+        # deletion chain: dp[j] = min_{k<=j} m[k] + (j-k)  — min-plus prefix scan
+        cur = np.minimum.accumulate(m - j_range) + j_range
+        prev = cur
+    return int(prev[-1])

@@ -1,0 +1,150 @@
+"""InfoLM (reference ``functional/text/infolm.py``).
+
+All nine information measures are implemented as pure jnp functions over masked-LM
+token distributions; the masked language model itself is an injection point (callable
+``model(sentences) -> (probs, mask)`` giving per-sentence aggregated token
+distributions), since no pretrained weights are downloadable here. HF model-name
+strings raise with guidance, mirroring the pluggable-extractor policy of the image
+domain.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+_EPS = 1e-12
+
+
+class _InformationMeasure:
+    """Dispatcher over the nine measures (reference ``infolm.py:57-231``)."""
+
+    def __init__(
+        self,
+        information_measure: str = "kl_divergence",
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+    ) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` expected to be one of {_ALLOWED_INFORMATION_MEASURE}"
+                f" but got {information_measure}."
+            )
+        if information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence") and not isinstance(
+            alpha, float
+        ):
+            raise ValueError(f"Argument `alpha` is expected to be defined for {information_measure}.")
+        if information_measure in ("beta_divergence", "ab_divergence") and not isinstance(beta, float):
+            raise ValueError(f"Argument `beta` is expected to be defined for {information_measure}.")
+        if information_measure == "alpha_divergence" and alpha in (0.0, 1.0):
+            raise ValueError(f"Parameter `alpha` is expected to be differened from 0 and 1 for {information_measure}.")
+        if information_measure == "beta_divergence" and beta in (0.0, -1.0):
+            raise ValueError(f"Parameter `beta` is expected to be differened from 0 and -1 for {information_measure}.")
+        if information_measure == "ab_divergence" and any(p in (0.0,) for p in (alpha, beta)) or (
+            information_measure == "ab_divergence" and alpha is not None and beta is not None and alpha + beta == 0
+        ):
+            raise ValueError(
+                f"Parameters `alpha`, `beta` and their sum are expected to differ from 0 for {information_measure}."
+            )
+        self.information_measure = information_measure
+        self.alpha = alpha
+        self.beta = beta
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        return getattr(self, f"_calculate_{self.information_measure}")(preds_distribution, target_distribution)
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, q: Array) -> Array:
+        return jnp.sum(p * (jnp.log(p + _EPS) - jnp.log(q + _EPS)), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, q: Array) -> Array:
+        a = self.alpha
+        return (1.0 / (a * (a - 1))) * (jnp.sum(q**a * p ** (1 - a), axis=-1) - 1)
+
+    def _calculate_beta_divergence(self, p: Array, q: Array) -> Array:
+        b = self.beta
+        term1 = 1.0 / (b * (b + 1)) * jnp.sum(p ** (b + 1), axis=-1)
+        term2 = 1.0 / b * jnp.sum(q * p**b, axis=-1)
+        term3 = 1.0 / (b + 1) * jnp.sum(q ** (b + 1), axis=-1)
+        return term1 - term2 + term3
+
+    def _calculate_ab_divergence(self, p: Array, q: Array) -> Array:
+        a, b = self.alpha, self.beta
+        term1 = 1.0 / (b * (a + b)) * jnp.sum(q ** (a + b), axis=-1)
+        term2 = 1.0 / (a * b) * jnp.sum(q**a * p**b, axis=-1)
+        term3 = 1.0 / (a * (a + b)) * jnp.sum(p ** (a + b), axis=-1)
+        return term1 - term2 + term3
+
+    def _calculate_renyi_divergence(self, p: Array, q: Array) -> Array:
+        a = self.alpha
+        return jnp.log(jnp.sum(q**a * p ** (1 - a), axis=-1)) / (a - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, q: Array) -> Array:
+        return jnp.sum(jnp.abs(p - q), axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, q: Array) -> Array:
+        return jnp.sqrt(jnp.sum((p - q) ** 2, axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, q: Array) -> Array:
+        return jnp.max(jnp.abs(p - q), axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, q: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * q), axis=-1), 0.0, 1.0))
+
+
+def infolm(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    model: Optional[Callable] = None,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM with an injected masked-LM (reference ``infolm.py:234-375``).
+
+    ``model`` must be a callable ``(sentences: List[str]) -> (N, V) distributions``
+    over the vocabulary (already temperature-scaled and idf-aggregated if desired).
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+    if model is None or isinstance(model, str) or not callable(model):
+        raise ModuleNotFoundError(
+            f"Default masked-LM backbones (`model_name_or_path={model_name_or_path!r}`) require downloadable"
+            " pretrained weights, which are not available. Pass a callable"
+            " `model(sentences) -> (N, V) distributions` instead."
+        )
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    preds_distribution = model(preds)
+    target_distribution = model(target)
+    scores = measure(preds_distribution, target_distribution)
+    if return_sentence_level_score:
+        return scores.mean(), scores
+    return scores.mean()

@@ -1,0 +1,39 @@
+"""Match error rate (reference ``functional/text/mer.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Σ edit ops + Σ max(len_ref, len_pred) (reference ``mer.py:23-50``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    """Reference ``mer.py:53-63``."""
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """MER (reference ``mer.py:66-90``)."""
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

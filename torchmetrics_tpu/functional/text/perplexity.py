@@ -1,0 +1,72 @@
+"""Perplexity (reference ``functional/text/perplexity.py``).
+
+Fully tensor-native — the one text metric whose hot path belongs on the TPU. Uses
+``log_softmax`` + ``take_along_axis`` (numerically stable, single fused XLA graph)
+where the reference materializes the full softmax then indexes a diagonal
+(``perplexity.py:75-84``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_FLOAT_OR_DOUBLE = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    """Validate [B, T, V] logits vs [B, T] targets (reference ``perplexity.py:21-64``)."""
+    if preds.ndim != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not any(preds.dtype == d for d in _FLOAT_OR_DOUBLE):
+        raise TypeError(
+            f"Input tensor `preds` is expected to be of floating point type but got {preds.dtype}."
+        )
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """Σ −log p(target) + valid-token count (reference ``perplexity.py:67-96``)."""
+    _check_shape_and_type_consistency(preds, target)
+
+    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=1)
+    target = target.reshape(-1)
+
+    if ignore_index is not None:
+        mask = target != ignore_index
+        target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+
+    picked = jnp.take_along_axis(log_probs, target[:, None], axis=1).squeeze(1)
+    total_log_probs = -jnp.sum(picked * mask)
+    count = mask.sum()
+    return total_log_probs, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    """exp of mean negative log likelihood (reference ``perplexity.py:99-108``)."""
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity (reference ``perplexity.py:111-140``)."""
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

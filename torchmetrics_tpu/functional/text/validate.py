@@ -1,0 +1,21 @@
+"""Corpus-format validation shared by text metrics (reference ``functional/text/helper.py:293-343``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+
+def _validate_inputs(
+    ref_corpus: Union[Sequence[str], Sequence[Sequence[str]]],
+    hypothesis_corpus: Union[str, Sequence[str]],
+) -> Tuple[Sequence[Sequence[str]], Sequence[str]]:
+    """Normalize hypothesis/reference corpora shapes (reference ``helper.py:293-343``)."""
+    if isinstance(hypothesis_corpus, str):
+        hypothesis_corpus = [hypothesis_corpus]
+
+    if all(isinstance(ref, str) for ref in ref_corpus):
+        ref_corpus = [ref_corpus] if len(hypothesis_corpus) == 1 else [[ref] for ref in ref_corpus]
+
+    if hypothesis_corpus and all(ref for ref in ref_corpus) and len(ref_corpus) != len(hypothesis_corpus):
+        raise ValueError(f"Corpus has different size {len(ref_corpus)} != {len(hypothesis_corpus)}")
+    return ref_corpus, hypothesis_corpus

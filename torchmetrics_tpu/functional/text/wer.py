@@ -1,0 +1,39 @@
+"""Word error rate (reference ``functional/text/wer.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Σ edit ops + Σ reference words (reference ``wer.py:23-48``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    """Reference ``wer.py:51-61``."""
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WER (reference ``wer.py:64-88``)."""
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

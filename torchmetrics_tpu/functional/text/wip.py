@@ -1,0 +1,43 @@
+"""Word information preserved (reference ``functional/text/wip.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _wip_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array, Array]:
+    """(Σedit − Σmax_len, Σ ref words, Σ pred words) (reference ``wip.py:23-56``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    total = 0
+    errors = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        target_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, target_tokens)
+        target_total += len(target_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(target_tokens), len(pred_tokens))
+    return jnp.asarray(float(errors - total)), jnp.asarray(float(target_total)), jnp.asarray(float(preds_total))
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """Reference ``wip.py:59-71``."""
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIP (reference ``wip.py:74-97``)."""
+    errors, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)

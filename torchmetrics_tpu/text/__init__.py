@@ -1,0 +1,37 @@
+"""Modular text metrics (reference ``src/torchmetrics/text/__init__.py``)."""
+
+from torchmetrics_tpu.text.bert import BERTScore
+from torchmetrics_tpu.text.bleu import BLEUScore
+from torchmetrics_tpu.text.chrf import CHRFScore
+from torchmetrics_tpu.text.eed import ExtendedEditDistance
+from torchmetrics_tpu.text.infolm import InfoLM
+from torchmetrics_tpu.text.perplexity import Perplexity
+from torchmetrics_tpu.text.rouge import ROUGEScore
+from torchmetrics_tpu.text.sacre_bleu import SacreBLEUScore
+from torchmetrics_tpu.text.squad import SQuAD
+from torchmetrics_tpu.text.ter import TranslationEditRate
+from torchmetrics_tpu.text.wer import (
+    CharErrorRate,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CHRFScore",
+    "CharErrorRate",
+    "ExtendedEditDistance",
+    "InfoLM",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SQuAD",
+    "SacreBLEUScore",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

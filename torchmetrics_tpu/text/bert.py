@@ -1,0 +1,93 @@
+"""Modular BERTScore (reference ``src/torchmetrics/text/bert.py``).
+
+Raw sentence list states (cat) — tokenization/model forward deferred to compute, like
+the reference which stores tokenized tensors and runs the model at compute
+(``bert.py:192-195``). The embedding model is an injection point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+
+from torchmetrics_tpu.functional.text.bert import bert_score
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """BERTScore with injected embedder (reference ``bert.py:56-232``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[str]
+    target: List[str]
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Optional[Callable] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.model = model
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        # Strings are host data, not arrays — raw (None) states pass through sync
+        # untouched; the array-only gather path cannot concatenate them. Cross-host
+        # aggregation therefore happens per-host (the reference avoids this by storing
+        # tokenized tensors instead; with an injected tokenizer users can do the same).
+        self.add_state("preds", [], dist_reduce_fx=None)
+        self.add_state("target", [], dist_reduce_fx=None)
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Buffer raw sentences."""
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        self.preds.extend(preds)
+        self.target.extend(target)
+
+    def compute(self) -> Dict[str, Array]:
+        """Run the injected model over all buffered sentences and match greedily."""
+        return bert_score(
+            preds=self.preds,
+            target=self.target,
+            model_name_or_path=self.model_name_or_path,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
+            user_forward_fn=self.user_forward_fn,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+        )
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,111 @@
+"""Modular CHRFScore (reference ``src/torchmetrics/text/chrf.py``).
+
+Six fixed-shape per-order arrays instead of the reference's dozens of dynamically
+named scalar states (``text/chrf.py:96-110``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """chrF / chrF++ (reference ``chrf.py:30-178``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        self.n_char_order = n_char_order
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        self.n_word_order = n_word_order
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+
+        self.add_state("total_preds_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_preds_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_target_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_target_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        """Accumulate n-gram statistics of one batch of corpora."""
+        (
+            self.total_preds_char_n_grams,
+            self.total_preds_word_n_grams,
+            self.total_target_char_n_grams,
+            self.total_target_word_n_grams,
+            self.total_matching_char_n_grams,
+            self.total_matching_word_n_grams,
+            sentence_scores,
+        ) = _chrf_score_update(
+            preds,
+            target,
+            self.total_preds_char_n_grams,
+            self.total_preds_word_n_grams,
+            self.total_target_char_n_grams,
+            self.total_target_word_n_grams,
+            self.total_matching_char_n_grams,
+            self.total_matching_word_n_grams,
+            self.n_char_order,
+            self.n_word_order,
+            self.n_order,
+            self.beta,
+            self.lowercase,
+            self.whitespace,
+            [] if self.return_sentence_level_score else None,
+        )
+        if self.return_sentence_level_score and sentence_scores:
+            self.sentence_chrf_score.extend(sentence_scores)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Corpus chrF (plus per-sentence scores when requested)."""
+        score = _chrf_score_compute(
+            self.total_preds_char_n_grams,
+            self.total_preds_word_n_grams,
+            self.total_target_char_n_grams,
+            self.total_target_word_n_grams,
+            self.total_matching_char_n_grams,
+            self.total_matching_word_n_grams,
+            self.n_order,
+            self.beta,
+        )
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat([jnp.atleast_1d(s) for s in self.sentence_chrf_score])
+        return score
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,81 @@
+"""Modular ExtendedEditDistance (reference ``src/torchmetrics/text/eed.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.eed import _eed_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """EED with a per-sentence score list state (reference ``eed.py:26-123``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    sentence_eed: List[Array]
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param_name, param in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        """Append per-sentence scores for one batch of corpora."""
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, None
+        )
+        self.sentence_eed.extend(jnp.atleast_1d(s) for s in scores)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Average EED (plus per-sentence scores when requested)."""
+        # After a distributed sync the cat state is a single Array, not a list — avoid
+        # truth-testing it (ambiguous for >1 element).
+        state = self.sentence_eed
+        is_empty = (len(state) == 0) if isinstance(state, list) else (state.size == 0)
+        if is_empty:
+            average = jnp.asarray(0.0)
+            scores = jnp.zeros((0,))
+        else:
+            scores = dim_zero_cat(state if isinstance(state, list) else [state])
+            average = scores.mean()
+        if self.return_sentence_level_score:
+            return average, scores
+        return average
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,76 @@
+"""Modular InfoLM (reference ``src/torchmetrics/text/infolm.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.infolm import infolm
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class InfoLM(Metric):
+    """InfoLM with injected masked-LM (reference ``infolm.py:33-222``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    preds: List[str]
+    target: List[str]
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        model: Optional[Callable] = None,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.model = model
+        self.return_sentence_level_score = return_sentence_level_score
+        # String buffers: raw (None) states — arrays-only sync cannot cat host strings.
+        self.add_state("preds", [], dist_reduce_fx=None)
+        self.add_state("target", [], dist_reduce_fx=None)
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Buffer raw sentences."""
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        self.preds.extend(preds)
+        self.target.extend(target)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Score all buffered sentences with the injected model."""
+        return infolm(
+            self.preds,
+            self.target,
+            model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature,
+            information_measure=self.information_measure,
+            idf=self.idf,
+            alpha=self.alpha,
+            beta=self.beta,
+            model=self.model,
+            return_sentence_level_score=self.return_sentence_level_score,
+        )
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
