@@ -1,0 +1,442 @@
+"""Detection domain tests.
+
+Goldens: reference doctest values (themselves torchvision-derived) for the IoU family,
+and official pycocotools numbers for the COCO-fixture mAP test (the values documented in
+reference ``tests/unittests/detection/test_map.py:258-292``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from torchmetrics_tpu.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+
+_B1 = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+_B2 = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+
+
+class TestBoxKernels:
+    def test_iou_reference_value(self):
+        assert float(intersection_over_union(_B1, _B2)) == pytest.approx(0.6807, abs=1e-4)
+
+    def test_ciou_reference_value(self):
+        assert float(complete_intersection_over_union(_B1, _B2)) == pytest.approx(0.6724, abs=1e-4)
+
+    def test_giou_le_iou(self):
+        giou = float(generalized_intersection_over_union(_B1, _B2))
+        iou = float(intersection_over_union(_B1, _B2))
+        assert giou <= iou
+
+    def test_diou_penalty(self):
+        # identical boxes: all variants equal 1
+        for fn in (
+            intersection_over_union,
+            generalized_intersection_over_union,
+            distance_intersection_over_union,
+            complete_intersection_over_union,
+        ):
+            assert float(fn(_B1, _B1)) == pytest.approx(1.0, abs=1e-5)
+
+    def test_disjoint_boxes(self):
+        far = jnp.array([[500.0, 500.0, 600.0, 600.0]])
+        assert float(intersection_over_union(_B1, far)) == 0.0
+        assert float(generalized_intersection_over_union(_B1, far)) < 0.0
+        assert float(distance_intersection_over_union(_B1, far)) < 0.0
+
+    def test_matrix_mode_and_threshold(self):
+        preds = jnp.concatenate([_B1, _B2])
+        mat = intersection_over_union(preds, preds, aggregate=False)
+        assert mat.shape == (2, 2)
+        thresholded = intersection_over_union(preds, preds, iou_threshold=0.9, replacement_val=-1.0, aggregate=False)
+        assert float(thresholded[0, 1]) == -1.0
+        assert float(thresholded[0, 0]) == pytest.approx(1.0)
+
+    def test_jit_and_vmap(self):
+        jitted = jax.jit(lambda p, t: intersection_over_union(p, t, aggregate=False))
+        mat = jitted(_B1, _B2)
+        assert mat.shape == (1, 1)
+        batched = jax.vmap(lambda p, t: complete_intersection_over_union(p, t, aggregate=False))(
+            jnp.stack([_B1, _B2]), jnp.stack([_B2, _B1])
+        )
+        assert batched.shape == (2, 1, 1)
+
+
+class TestIoUModular:
+    def _doctest_inputs(self):
+        preds = [
+            {
+                "boxes": jnp.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+                "scores": jnp.array([0.236, 0.56]),
+                "labels": jnp.array([4, 5]),
+            }
+        ]
+        target = [
+            {
+                "boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+                "labels": jnp.array([5]),
+            }
+        ]
+        return preds, target
+
+    def test_iou_class(self):
+        preds, target = self._doctest_inputs()
+        metric = IntersectionOverUnion()
+        out = metric(preds, target)
+        assert float(out["iou"]) == pytest.approx(0.4307, abs=1e-4)
+
+    def test_giou_class(self):
+        preds, target = self._doctest_inputs()
+        assert float(GeneralizedIntersectionOverUnion()(preds, target)["giou"]) == pytest.approx(-0.0694, abs=1e-4)
+
+    def test_diou_class(self):
+        preds, target = self._doctest_inputs()
+        assert float(DistanceIntersectionOverUnion()(preds, target)["diou"]) == pytest.approx(-0.0694, abs=1e-4)
+
+    def test_ciou_class(self):
+        preds, target = self._doctest_inputs()
+        assert float(CompleteIntersectionOverUnion()(preds, target)["ciou"]) == pytest.approx(-0.5694, abs=1e-4)
+
+    def test_class_metrics(self):
+        preds, target = self._doctest_inputs()
+        metric = IntersectionOverUnion(class_metrics=True)
+        out = metric(preds, target)
+        assert "iou/cl_5" in out
+
+    def test_box_format_conversion(self):
+        # the same physical boxes expressed in each layout must agree
+        xyxy = [{"boxes": _B1, "scores": jnp.array([0.9]), "labels": jnp.array([0])}]
+        xywh = [{"boxes": jnp.array([[100.0, 100.0, 100.0, 100.0]]), "scores": jnp.array([0.9]), "labels": jnp.array([0])}]
+        tgt_xyxy = [{"boxes": _B2, "labels": jnp.array([0])}]
+        tgt_xywh = [{"boxes": jnp.array([[110.0, 110.0, 100.0, 100.0]]), "labels": jnp.array([0])}]
+        a = IntersectionOverUnion()(xyxy, tgt_xyxy)
+        b = IntersectionOverUnion(box_format="xywh")(xywh, tgt_xywh)
+        assert float(a["iou"]) == pytest.approx(float(b["iou"]), abs=1e-6)
+
+    def test_empty_image_does_not_poison(self):
+        # an object-free image must not turn the epoch metric into NaN
+        match = [{"boxes": _B1, "scores": jnp.array([0.9]), "labels": jnp.array([0])}]
+        match_t = [{"boxes": _B1, "labels": jnp.array([0])}]
+        empty = [{"boxes": jnp.zeros((0, 4)), "scores": jnp.zeros((0,)), "labels": jnp.zeros((0,), dtype=jnp.int32)}]
+        empty_t = [{"boxes": jnp.zeros((0, 4)), "labels": jnp.zeros((0,), dtype=jnp.int32)}]
+        metric = IntersectionOverUnion()
+        metric.update(match, match_t)
+        metric.update(empty, empty_t)
+        assert float(metric.compute()["iou"]) == pytest.approx(1.0, abs=1e-5)
+
+    def test_input_validation(self):
+        metric = IntersectionOverUnion()
+        with pytest.raises(ValueError, match="Expected all dicts in `preds`"):
+            metric.update([{"boxes": _B1}], [{"boxes": _B2, "labels": jnp.array([0])}])
+
+
+def _coco_fixture():
+    """COCO-subset fixture mirrored from reference test inputs (image ids 42/73/74/987)."""
+    preds = [
+        {
+            "boxes": jnp.array([[258.15, 41.29, 606.41, 285.07]]),
+            "scores": jnp.array([0.236]),
+            "labels": jnp.array([4]),
+        },
+        {
+            "boxes": jnp.array([[61.00, 22.75, 565.00, 632.42], [12.66, 3.32, 281.26, 275.23]]),
+            "scores": jnp.array([0.318, 0.726]),
+            "labels": jnp.array([3, 2]),
+        },
+        {
+            "boxes": jnp.array(
+                [
+                    [87.87, 276.25, 384.29, 379.43],
+                    [0.00, 3.66, 142.15, 316.06],
+                    [296.55, 93.96, 314.97, 152.79],
+                    [328.94, 97.05, 342.49, 122.98],
+                    [356.62, 95.47, 372.33, 147.55],
+                    [464.08, 105.09, 495.74, 146.99],
+                    [276.11, 103.84, 291.44, 150.72],
+                ]
+            ),
+            "scores": jnp.array([0.546, 0.3, 0.407, 0.611, 0.335, 0.805, 0.953]),
+            "labels": jnp.array([4, 1, 0, 0, 0, 0, 0]),
+        },
+        {
+            "boxes": jnp.array(
+                [
+                    [72.92, 45.96, 91.23, 80.57],
+                    [45.17, 45.34, 66.28, 79.83],
+                    [82.28, 47.04, 99.66, 78.50],
+                    [59.96, 46.17, 80.35, 80.48],
+                    [75.29, 23.01, 91.85, 50.85],
+                    [71.14, 1.10, 96.96, 28.33],
+                    [61.34, 55.23, 77.14, 79.57],
+                    [41.17, 45.78, 60.99, 78.48],
+                    [56.18, 44.80, 64.42, 56.25],
+                ]
+            ),
+            "scores": jnp.array([0.532, 0.204, 0.782, 0.202, 0.883, 0.271, 0.561, 0.204, 0.349]),
+            "labels": jnp.array([49] * 9),
+        },
+    ]
+    target = [
+        {
+            "boxes": jnp.array([[214.1500, 41.2900, 562.4100, 285.0700]]),
+            "labels": jnp.array([4]),
+        },
+        {
+            "boxes": jnp.array([[13.00, 22.75, 548.98, 632.42], [1.66, 3.32, 270.26, 275.23]]),
+            "labels": jnp.array([2, 2]),
+        },
+        {
+            "boxes": jnp.array(
+                [
+                    [61.87, 276.25, 358.29, 379.43],
+                    [2.75, 3.66, 162.15, 316.06],
+                    [295.55, 93.96, 313.97, 152.79],
+                    [326.94, 97.05, 340.49, 122.98],
+                    [356.62, 95.47, 372.33, 147.55],
+                    [462.08, 105.09, 493.74, 146.99],
+                    [277.11, 103.84, 292.44, 150.72],
+                ]
+            ),
+            "labels": jnp.array([4, 1, 0, 0, 0, 0, 0]),
+        },
+        {
+            "boxes": jnp.array(
+                [
+                    [72.92, 45.96, 91.23, 80.57],
+                    [50.17, 45.34, 71.28, 79.83],
+                    [81.28, 47.04, 98.66, 78.50],
+                    [63.96, 46.17, 84.35, 80.48],
+                    [75.29, 23.01, 91.85, 50.85],
+                    [56.39, 21.65, 75.66, 45.54],
+                    [73.14, 1.10, 98.96, 28.33],
+                    [62.34, 55.23, 78.14, 79.57],
+                    [44.17, 45.78, 63.99, 78.48],
+                    [58.18, 44.80, 66.42, 56.25],
+                ]
+            ),
+            "labels": jnp.array([49] * 10),
+        },
+    ]
+    return preds, target
+
+
+_PYCOCO_EXPECTED = {
+    "map": 0.637,
+    "map_50": 0.859,
+    "map_75": 0.761,
+    "map_small": 0.622,
+    "map_medium": 0.800,
+    "map_large": 0.635,
+    "mar_1": 0.432,
+    "mar_10": 0.652,
+    "mar_100": 0.652,
+    "mar_small": 0.673,
+    "mar_medium": 0.800,
+    "mar_large": 0.633,
+}
+
+
+class TestMeanAveragePrecision:
+    def test_single_box_doctest(self):
+        preds = [dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0]))]
+        target = [dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0]))]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        out = m.compute()
+        assert float(out["map"]) == pytest.approx(0.6, abs=1e-4)
+        assert float(out["map_50"]) == pytest.approx(1.0, abs=1e-4)
+        assert float(out["map_75"]) == pytest.approx(1.0, abs=1e-4)
+        assert float(out["map_small"]) == -1.0
+        assert float(out["mar_1"]) == pytest.approx(0.6, abs=1e-4)
+
+    def test_coco_fixture_vs_pycocotools(self):
+        preds, target = _coco_fixture()
+        m = MeanAveragePrecision(class_metrics=True)
+        m.update(preds[:2], target[:2])
+        m.update(preds[2:], target[2:])
+        out = m.compute()
+        for key, expected in _PYCOCO_EXPECTED.items():
+            assert float(out[key]) == pytest.approx(expected, abs=0.015), key
+        np.testing.assert_allclose(
+            np.asarray(out["map_per_class"]), [0.725, 0.800, 0.454, -1.000, 0.650, 0.556], atol=0.015
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["mar_100_per_class"]), [0.780, 0.800, 0.450, -1.000, 0.650, 0.580], atol=0.015
+        )
+        np.testing.assert_array_equal(np.asarray(out["classes"]), [0, 1, 2, 3, 4, 49])
+
+    def test_empty_target_image(self):
+        preds = [
+            dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0])),
+            dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0])),
+        ]
+        target = [
+            dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0])),
+            dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,), dtype=jnp.int32)),
+        ]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        out = m.compute()
+        # COCO-interpolated precision at recall 1.0 is reached before the trailing FP,
+        # so map_50 stays 1.0 and map keeps the matched-pair value
+        assert float(out["map_50"]) == pytest.approx(1.0, abs=1e-6)
+        assert float(out["map"]) == pytest.approx(0.6, abs=1e-4)
+
+    def test_empty_preds_image(self):
+        preds = [
+            dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0])),
+            dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), dtype=jnp.int32)),
+        ]
+        target = [
+            dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0])),
+            dict(boxes=jnp.array([[1.0, 2.0, 3.0, 4.0]]), labels=jnp.array([1])),
+        ]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        out = m.compute()
+        assert float(out["map"]) >= 0.0
+
+    def test_segm_iou_type(self):
+        # two 10x10 canvases; pred mask overlaps gt mask 50 of 100 pixels
+        pred_mask = np.zeros((1, 10, 20), dtype=bool)
+        pred_mask[0, :, :10] = True
+        gt_mask = np.zeros((1, 10, 20), dtype=bool)
+        gt_mask[0, :, 5:15] = True
+        preds = [dict(masks=jnp.asarray(pred_mask), scores=jnp.array([0.9]), labels=jnp.array([0]))]
+        target = [dict(masks=jnp.asarray(gt_mask), labels=jnp.array([0]))]
+        m = MeanAveragePrecision(iou_type="segm")
+        m.update(preds, target)
+        out = m.compute()
+        # IoU = 50/150 = 1/3 -> below every threshold in [0.5, 0.95]: no matches
+        assert float(out["map"]) == pytest.approx(0.0, abs=1e-6)
+        # now shift so IoU = 0.6 -> matched at thresholds .5 and .55 only
+        gt_mask2 = np.zeros((1, 10, 20), dtype=bool)
+        gt_mask2[0, :, 1:11] = True  # inter 90, union 110 -> iou 0.818
+        m2 = MeanAveragePrecision(iou_type="segm")
+        m2.update(
+            [dict(masks=jnp.asarray(pred_mask), scores=jnp.array([0.9]), labels=jnp.array([0]))],
+            [dict(masks=jnp.asarray(gt_mask2), labels=jnp.array([0]))],
+        )
+        out2 = m2.compute()
+        # matched at 0.5..0.8 (7 of 10 thresholds)
+        assert float(out2["map"]) == pytest.approx(0.7, abs=1e-6)
+
+    def test_merge_state_raw_lists(self):
+        preds, target = _coco_fixture()
+        full = MeanAveragePrecision()
+        full.update(preds, target)
+        a = MeanAveragePrecision()
+        a.update(preds[:2], target[:2])
+        b = MeanAveragePrecision()
+        b.update(preds[2:], target[2:])
+        a.merge_state(b)
+        out_a = a.compute()
+        out_full = full.compute()
+        assert float(out_a["map"]) == pytest.approx(float(out_full["map"]), abs=1e-6)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError, match="box_format"):
+            MeanAveragePrecision(box_format="bad")
+        with pytest.raises(ValueError, match="iou_type"):
+            MeanAveragePrecision(iou_type="bad")
+        with pytest.raises(ValueError, match="class_metrics"):
+            MeanAveragePrecision(class_metrics="yes")
+
+
+_PQ_PREDS = np.array(
+    [
+        [
+            [[6, 0], [0, 0], [6, 0], [6, 0]],
+            [[0, 0], [0, 0], [6, 0], [0, 1]],
+            [[0, 0], [0, 0], [6, 0], [0, 1]],
+            [[0, 0], [7, 0], [6, 0], [1, 0]],
+            [[0, 0], [7, 0], [7, 0], [7, 0]],
+        ]
+    ]
+)
+_PQ_TARGET = np.array(
+    [
+        [
+            [[6, 0], [0, 1], [6, 0], [0, 1]],
+            [[0, 1], [0, 1], [6, 0], [0, 1]],
+            [[0, 1], [0, 1], [6, 0], [1, 0]],
+            [[0, 1], [7, 0], [1, 0], [1, 0]],
+            [[0, 1], [7, 0], [7, 0], [7, 0]],
+        ]
+    ]
+)
+
+
+class TestPanopticQuality:
+    def test_functional_reference_value(self):
+        val = panoptic_quality(_PQ_PREDS, _PQ_TARGET, things={0, 1}, stuffs={6, 7})
+        assert float(val) == pytest.approx(0.5463, abs=1e-4)
+
+    def test_modified_functional_reference_value(self):
+        preds = np.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        target = np.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        val = modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+        assert float(val) == pytest.approx(0.7667, abs=1e-4)
+
+    def test_modular_accumulates(self):
+        metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        metric.update(jnp.asarray(_PQ_PREDS), jnp.asarray(_PQ_TARGET))
+        assert float(metric.compute()) == pytest.approx(0.5463, abs=1e-4)
+        # two identical updates leave the category-ratio unchanged
+        metric.update(jnp.asarray(_PQ_PREDS), jnp.asarray(_PQ_TARGET))
+        assert float(metric.compute()) == pytest.approx(0.5463, abs=1e-4)
+
+    def test_modified_modular(self):
+        metric = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+        preds = jnp.asarray([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        target = jnp.asarray([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        metric.update(preds, target)
+        assert float(metric.compute()) == pytest.approx(0.7667, abs=1e-4)
+
+    def test_sum_state_sync(self):
+        metric = PanopticQuality(
+            things={0, 1},
+            stuffs={6, 7},
+            dist_sync_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+        metric.update(jnp.asarray(_PQ_PREDS), jnp.asarray(_PQ_TARGET))
+        single = float(metric.compute())  # syncs: doubles every count
+        assert single == pytest.approx(0.5463, abs=1e-4)
+
+    def test_huge_instance_ids_no_overflow(self):
+        # COCO panoptic encodes instance ids as RGB-packed ints up to 2^24; a perfect
+        # prediction must still score 1.0 (guards the int64 key-packing path)
+        big = 2**24 - 1
+        sample = np.array([[[200, big], [200, big], [3, 7], [3, 7]]])
+        val = panoptic_quality(sample, sample, things={200, 3}, stuffs=set())
+        assert float(val) == pytest.approx(1.0, abs=1e-6)
+
+    def test_category_validation(self):
+        with pytest.raises(ValueError, match="distinct"):
+            PanopticQuality(things={0, 1}, stuffs={1, 2})
+        with pytest.raises(ValueError, match="Unknown categories"):
+            pq = PanopticQuality(things={0}, stuffs={6})
+            pq.update(jnp.asarray([[[5, 0]]]), jnp.asarray([[[0, 0]]]))
+
+
+def test_exported_from_root():
+    assert tm.MeanAveragePrecision is MeanAveragePrecision
+    assert tm.functional.intersection_over_union is intersection_over_union

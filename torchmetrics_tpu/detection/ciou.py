@@ -1,0 +1,16 @@
+"""Modular CompleteIntersectionOverUnion (reference ``detection/ciou.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from torchmetrics_tpu.detection.iou import IntersectionOverUnion
+from torchmetrics_tpu.functional.detection.helpers import _box_ciou
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """Mean CIoU over matched boxes; invalid pairs get the reference's -2 floor."""
+
+    _iou_type: str = "ciou"
+    _invalid_val: float = -2.0
+    _iou_kernel: Callable = staticmethod(_box_ciou)

@@ -1,0 +1,16 @@
+"""Modular DistanceIntersectionOverUnion (reference ``detection/diou.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from torchmetrics_tpu.detection.iou import IntersectionOverUnion
+from torchmetrics_tpu.functional.detection.helpers import _box_diou
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """Mean DIoU over matched boxes; DIoU ranges in [-1, 1] so invalid pairs get -1."""
+
+    _iou_type: str = "diou"
+    _invalid_val: float = -1.0
+    _iou_kernel: Callable = staticmethod(_box_diou)

@@ -1,0 +1,16 @@
+"""Modular GeneralizedIntersectionOverUnion (reference ``detection/giou.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from torchmetrics_tpu.detection.iou import IntersectionOverUnion
+from torchmetrics_tpu.functional.detection.helpers import _box_giou
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """Mean GIoU over matched boxes; GIoU ranges in [-1, 1] so invalid pairs get -1."""
+
+    _iou_type: str = "giou"
+    _invalid_val: float = -1.0
+    _iou_kernel: Callable = staticmethod(_box_giou)

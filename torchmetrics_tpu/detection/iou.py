@@ -1,0 +1,128 @@
+"""Modular IntersectionOverUnion (reference ``detection/iou.py:38-230``).
+
+The GIoU/DIoU/CIoU modular metrics subclass this one, swapping the pairwise kernel —
+the reference repeats the class four times instead (``detection/{giou,diou,ciou}.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from torchmetrics_tpu.functional.detection._iou_variants import _variant_compute, _variant_update
+from torchmetrics_tpu.functional.detection.helpers import _box_convert, _box_iou
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class IntersectionOverUnion(Metric):
+    """Mean IoU over matched detection/ground-truth boxes (reference ``iou.py:38``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    detection_labels: List[Array]
+    groundtruth_labels: List[Array]
+    results: List[Array]
+
+    _iou_type: str = "iou"
+    _invalid_val: float = 0.0
+    _iou_kernel: Callable[[Array, Array], Array] = staticmethod(_box_iou)
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("results", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        """Score one batch of per-image box dicts (reference ``iou.py:167-212``)."""
+        _input_validator(preds, target)
+
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            p_labels = jnp.asarray(p["labels"])
+            t_labels = jnp.asarray(t["labels"])
+            self.detection_labels.append(p_labels)
+            self.groundtruth_labels.append(t_labels)
+
+            ious = _variant_update(
+                type(self)._iou_kernel, det_boxes, gt_boxes, self.iou_threshold, self._invalid_val
+            )
+            if self.respect_labels and ious.size > 0:
+                # applied unconditionally on-device: when labels agree the mask is all
+                # False and this is the identity — no host sync in the hot loop
+                labels_not_eq = p_labels[:, None] != t_labels[None, :]
+                ious = jnp.where(labels_not_eq, self._invalid_val, ious)
+            self.results.append(ious.astype(jnp.float32))
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(jnp.asarray(boxes, dtype=jnp.float32))
+        if boxes.size > 0:
+            boxes = _box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _get_gt_classes(self) -> List[int]:
+        if len(self.groundtruth_labels) > 0:
+            return np.unique(np.concatenate([np.asarray(x).reshape(-1) for x in self.groundtruth_labels])).astype(
+                int
+            ).tolist()
+        return []
+
+    def compute(self) -> Dict[str, Array]:
+        """Aggregate the per-image score matrices (reference ``iou.py:226-248``)."""
+        per_image = []
+        for iou_mat, d_labels, g_labels in zip(self.results, self.detection_labels, self.groundtruth_labels):
+            if iou_mat.size == 0:
+                continue  # object-free image: nothing to average, don't poison with NaN
+            d_np = np.asarray(d_labels).reshape(-1)
+            g_np = np.asarray(g_labels).reshape(-1)
+            labels_eq = d_np.shape == g_np.shape and bool((d_np == g_np).all())
+            per_image.append(jnp.atleast_1d(_variant_compute(iou_mat, labels_eq)))
+        aggregated = dim_zero_cat(per_image) if per_image else jnp.zeros((0,))
+        results: Dict[str, Array] = {self._iou_type: aggregated.mean() if aggregated.size else jnp.asarray(0.0)}
+
+        if self.class_metrics:
+            gt_classes = self._get_gt_classes()
+            for cl in gt_classes:
+                masked_scores, observed = [], 0
+                for iou_mat, d_labels, g_labels in zip(self.results, self.detection_labels, self.groundtruth_labels):
+                    if iou_mat.size == 0:
+                        continue
+                    sel = (np.asarray(d_labels).reshape(-1, 1) == cl) & (np.asarray(g_labels).reshape(1, -1) == cl)
+                    if sel.any():
+                        masked_scores.append(jnp.asarray(np.asarray(iou_mat)[sel]).reshape(-1))
+                        observed += 1
+                if masked_scores:
+                    results[f"{self._iou_type}/cl_{cl}"] = dim_zero_cat(masked_scores).mean()
+        return results
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,455 @@
+"""MeanAveragePrecision (reference ``detection/mean_ap.py:150-970``).
+
+Architecture: the metric streams raw per-image arrays into five ``dist_reduce_fx=None``
+list states (reference ``mean_ap.py:358-362``), exactly the shape the kernel's raw-state
+sync path handles. ``compute()`` is an epoch-end evaluation with COCOeval semantics:
+
+- box IoU matrices come from the vectorized jnp kernel in
+  ``functional/detection/helpers.py`` (one broadcasted pass per image/class);
+- for ``iou_type="segm"`` masks are dense booleans and the IoU reduces to a
+  flatten-and-matmul — MXU-friendly, unlike the reference's pycocotools RLE C path
+  (``mean_ap.py:38,131``);
+- the greedy best-GT matching and PR accumulation run on host numpy: they are
+  data-dependent ragged loops over tens of detections, which the reference also keeps
+  off-accelerator (``_move_list_states_to_cpu``, ``mean_ap.py:380``). States are
+  fetched from device exactly once, at the top of ``compute``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from torchmetrics_tpu.functional.detection.helpers import _box_convert, _box_iou
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Host-side pairwise IoU used inside the ragged evaluation loops."""
+    if det.size == 0 or gt.size == 0:
+        return np.zeros((det.shape[0], gt.shape[0]))
+    area1 = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+    area2 = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+    rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / np.where(union == 0, 1.0, union)
+
+
+def _np_mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Dense-mask pairwise IoU: one flattened matmul (the jnp twin runs on MXU)."""
+    if det.size == 0 or gt.size == 0:
+        return np.zeros((det.shape[0], gt.shape[0]))
+    d = det.reshape(det.shape[0], -1).astype(np.float64)
+    g = gt.reshape(gt.shape[0], -1).astype(np.float64)
+    inter = d @ g.T
+    union = d.sum(axis=1)[:, None] + g.sum(axis=1)[None, :] - inter
+    return inter / np.where(union == 0, 1.0, union)
+
+
+def _area(values: np.ndarray, iou_type: str) -> np.ndarray:
+    """Box or mask areas for the ignore-range logic."""
+    if values.size == 0:
+        return np.zeros((values.shape[0],))
+    if iou_type == "bbox":
+        return (values[:, 2] - values[:, 0]) * (values[:, 3] - values[:, 1])
+    return values.reshape(values.shape[0], -1).sum(axis=1)
+
+
+class MeanAveragePrecision(Metric):
+    """mAP/mAR for object detection with COCOeval semantics (reference ``mean_ap.py:150``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    detections: List[Array]
+    detection_scores: List[Array]
+    detection_labels: List[Array]
+    groundtruths: List[Array]
+    groundtruth_labels: List[Array]
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: str = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        allowed_iou_types = ("segm", "bbox")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+        if iou_type not in allowed_iou_types:
+            raise ValueError(f"Expected argument `iou_type` to be one of {allowed_iou_types} but got {iou_type}")
+        self.iou_type = iou_type
+        self.bbox_area_ranges = {
+            "all": (float(0**2), float(1e5**2)),
+            "small": (float(0**2), float(32**2)),
+            "medium": (float(32**2), float(96**2)),
+            "large": (float(96**2), float(1e5**2)),
+        }
+
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+
+        self.add_state("detections", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruths", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        """Buffer one batch of per-image prediction/target dicts (reference ``mean_ap.py:364-378``)."""
+        _input_validator(preds, target, iou_type=self.iou_type)
+
+        for item in preds:
+            self.detections.append(self._get_safe_item_values(item))
+            self.detection_labels.append(jnp.asarray(item["labels"]))
+            self.detection_scores.append(jnp.asarray(item["scores"]))
+
+        for item in target:
+            self.groundtruths.append(self._get_safe_item_values(item))
+            self.groundtruth_labels.append(jnp.asarray(item["labels"]))
+
+    def _get_safe_item_values(self, item: Dict[str, Any]) -> Array:
+        if self.iou_type == "bbox":
+            boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
+            if boxes.size > 0:
+                boxes = _box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+            return boxes
+        # segm: dense boolean masks (num_boxes, H, W)
+        return jnp.asarray(item["masks"], dtype=bool)
+
+    @staticmethod
+    def _get_classes(det_labels: List[np.ndarray], gt_labels: List[np.ndarray]) -> List[int]:
+        """Unique classes present in either stream (reference ``mean_ap.py:406-410``)."""
+        if len(det_labels) > 0 or len(gt_labels) > 0:
+            return np.unique(np.concatenate(det_labels + gt_labels)).astype(int).tolist()
+        return []
+
+    # ---------------------------------------------------------------- compute
+
+    def compute(self) -> Dict[str, Array]:
+        """COCOeval over the buffered epoch (reference ``mean_ap.py:846-875``)."""
+        # single D2H fetch of all raw states
+        dets = [np.asarray(d) for d in self.detections]
+        det_scores = [np.asarray(s) for s in self.detection_scores]
+        det_labels = [np.asarray(l).reshape(-1) for l in self.detection_labels]
+        gts = [np.asarray(g) for g in self.groundtruths]
+        gt_labels = [np.asarray(l).reshape(-1) for l in self.groundtruth_labels]
+
+        classes = self._get_classes(det_labels, gt_labels)
+        precisions, recalls = self._calculate(classes, dets, det_scores, det_labels, gts, gt_labels)
+        map_val, mar_val = self._summarize_results(precisions, recalls)
+
+        map_per_class: Any = np.array([-1.0])
+        mar_max_per_class: Any = np.array([-1.0])
+        if self.class_metrics:
+            map_list, mar_list = [], []
+            for class_idx, _ in enumerate(classes):
+                cls_prec = precisions[:, :, class_idx][:, :, None]
+                cls_rec = recalls[:, class_idx][:, None]
+                cls_map, cls_mar = self._summarize_results(cls_prec, cls_rec)
+                map_list.append(cls_map["map"])
+                mar_list.append(cls_mar[f"mar_{self.max_detection_thresholds[-1]}"])
+            map_per_class = np.array(map_list, dtype=np.float32)
+            mar_max_per_class = np.array(mar_list, dtype=np.float32)
+
+        metrics: Dict[str, Array] = {}
+        metrics.update({k: jnp.asarray(v, dtype=jnp.float32) for k, v in map_val.items()})
+        metrics.update({k: jnp.asarray(v, dtype=jnp.float32) for k, v in mar_val.items()})
+        metrics["map_per_class"] = jnp.asarray(map_per_class, dtype=jnp.float32).squeeze()
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(
+            mar_max_per_class, dtype=jnp.float32
+        ).squeeze()
+        metrics["classes"] = jnp.asarray(np.array(classes), dtype=jnp.int32).squeeze()
+        return metrics
+
+    def _compute_iou_matrix(
+        self,
+        idx: int,
+        class_id: int,
+        max_det: int,
+        dets: List[np.ndarray],
+        det_scores: List[np.ndarray],
+        det_labels: List[np.ndarray],
+        gts: List[np.ndarray],
+        gt_labels: List[np.ndarray],
+    ) -> np.ndarray:
+        """IoU of score-sorted detections vs ground truths for one image+class (reference ``:412-450``)."""
+        gt_mask = gt_labels[idx] == class_id
+        det_mask = det_labels[idx] == class_id
+        if not gt_mask.any() or not det_mask.any():
+            return np.zeros((0, 0))
+        gt = gts[idx][gt_mask]
+        det = dets[idx][det_mask]
+        scores = det_scores[idx][det_mask]
+        order = np.argsort(-scores, kind="stable")
+        det = det[order][:max_det]
+        if self.iou_type == "bbox":
+            return _np_box_iou(det, gt)
+        return _np_mask_iou(det, gt)
+
+    def _evaluate_image(
+        self,
+        idx: int,
+        class_id: int,
+        area_range: Tuple[float, float],
+        max_det: int,
+        ious: Dict[Tuple[int, int], np.ndarray],
+        dets: List[np.ndarray],
+        det_scores: List[np.ndarray],
+        det_labels: List[np.ndarray],
+        gts: List[np.ndarray],
+        gt_labels: List[np.ndarray],
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Greedy matching for one image/class/area (reference ``:510-607``)."""
+        gt_mask = gt_labels[idx] == class_id
+        det_mask = det_labels[idx] == class_id
+        nb_iou_thrs = len(self.iou_thresholds)
+
+        n_gt_cls = int(gt_mask.sum())
+        n_det_cls = int(det_mask.sum())
+        if n_gt_cls == 0 and n_det_cls == 0:
+            return None
+
+        if n_gt_cls > 0 and n_det_cls == 0:
+            areas = _area(gts[idx][gt_mask], self.iou_type)
+            ignore = (areas < area_range[0]) | (areas > area_range[1])
+            return {
+                "dtMatches": np.zeros((nb_iou_thrs, 0), dtype=bool),
+                "dtScores": np.zeros(0),
+                "gtIgnore": np.sort(ignore),
+                "dtIgnore": np.zeros((nb_iou_thrs, 0), dtype=bool),
+            }
+
+        scores = det_scores[idx][det_mask]
+        order = np.argsort(-scores, kind="stable")
+        scores_sorted = scores[order][:max_det]
+        det = dets[idx][det_mask][order][:max_det]
+        nb_det = det.shape[0]
+
+        if n_gt_cls == 0:
+            det_areas = _area(det, self.iou_type)
+            ignore = (det_areas < area_range[0]) | (det_areas > area_range[1])
+            return {
+                "dtMatches": np.zeros((nb_iou_thrs, nb_det), dtype=bool),
+                "dtScores": scores_sorted,
+                "gtIgnore": np.zeros(0, dtype=bool),
+                "dtIgnore": np.tile(ignore[None, :], (nb_iou_thrs, 1)),
+            }
+
+        gt = gts[idx][gt_mask]
+        areas = _area(gt, self.iou_type)
+        ignore_area = (areas < area_range[0]) | (areas > area_range[1])
+        gtind = np.argsort(ignore_area.astype(np.uint8), kind="stable")  # ignored gts last
+        gt_ignore = ignore_area[gtind]
+        nb_gt = gt.shape[0]
+
+        iou_mat = ious[idx, class_id]
+        iou_mat = iou_mat[:, gtind] if iou_mat.size > 0 else iou_mat
+
+        gt_matches = np.zeros((nb_iou_thrs, nb_gt), dtype=bool)
+        det_matches = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
+        det_ignore = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
+
+        if iou_mat.size > 0:
+            for idx_iou, thr in enumerate(self.iou_thresholds):
+                for idx_det in range(nb_det):
+                    # best still-unmatched, non-ignored gt above threshold (reference ``:609-635``)
+                    masked = iou_mat[idx_det] * ~(gt_matches[idx_iou] | gt_ignore)
+                    m = int(masked.argmax())
+                    if masked[m] <= thr:
+                        continue
+                    det_ignore[idx_iou, idx_det] = gt_ignore[m]
+                    det_matches[idx_iou, idx_det] = True
+                    gt_matches[idx_iou, m] = True
+
+        # unmatched detections outside the area range are ignored
+        det_areas = _area(det, self.iou_type)
+        det_out_of_range = (det_areas < area_range[0]) | (det_areas > area_range[1])
+        det_ignore = det_ignore | (~det_matches & det_out_of_range[None, :])
+
+        return {
+            "dtMatches": det_matches,
+            "dtScores": scores_sorted,
+            "gtIgnore": gt_ignore,
+            "dtIgnore": det_ignore,
+        }
+
+    def _calculate(
+        self,
+        class_ids: List[int],
+        dets: List[np.ndarray],
+        det_scores: List[np.ndarray],
+        det_labels: List[np.ndarray],
+        gts: List[np.ndarray],
+        gt_labels: List[np.ndarray],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Precision/recall accumulation over classes x areas x max-dets (reference ``:676-737``)."""
+        nb_imgs = len(gts)
+        max_detections = self.max_detection_thresholds[-1]
+
+        ious = {
+            (idx, class_id): self._compute_iou_matrix(
+                idx, class_id, max_detections, dets, det_scores, det_labels, gts, gt_labels
+            )
+            for idx in range(nb_imgs)
+            for class_id in class_ids
+        }
+
+        nb_iou_thrs = len(self.iou_thresholds)
+        nb_rec_thrs = len(self.rec_thresholds)
+        nb_classes = len(class_ids)
+        nb_areas = len(self.bbox_area_ranges)
+        nb_max_det_thrs = len(self.max_detection_thresholds)
+        precision = -np.ones((nb_iou_thrs, nb_rec_thrs, nb_classes, nb_areas, nb_max_det_thrs))
+        recall = -np.ones((nb_iou_thrs, nb_classes, nb_areas, nb_max_det_thrs))
+
+        rec_thresholds = np.asarray(self.rec_thresholds)
+
+        for idx_cls, class_id in enumerate(class_ids):
+            for idx_area, area_range in enumerate(self.bbox_area_ranges.values()):
+                evals = [
+                    self._evaluate_image(
+                        img_id, class_id, area_range, max_detections, ious,
+                        dets, det_scores, det_labels, gts, gt_labels,
+                    )
+                    for img_id in range(nb_imgs)
+                ]
+                evals = [e for e in evals if e is not None]
+                if not evals:
+                    continue
+                for idx_max_det, max_det in enumerate(self.max_detection_thresholds):
+                    self._accumulate(
+                        precision, recall, evals, rec_thresholds,
+                        idx_cls, idx_area, idx_max_det, max_det,
+                    )
+        return precision, recall
+
+    def _accumulate(
+        self,
+        precision: np.ndarray,
+        recall: np.ndarray,
+        evals: List[Dict[str, np.ndarray]],
+        rec_thresholds: np.ndarray,
+        idx_cls: int,
+        idx_area: int,
+        idx_max_det: int,
+        max_det: int,
+    ) -> None:
+        """PR curve for one (class, area, max_det) cell (reference ``:773-844``)."""
+        det_scores = np.concatenate([e["dtScores"][:max_det] for e in evals])
+        # stable descending sort keeps COCO/Matlab tie order
+        inds = np.argsort(-det_scores, kind="stable")
+        det_scores_sorted = det_scores[inds]
+
+        det_matches = np.concatenate([e["dtMatches"][:, :max_det] for e in evals], axis=1)[:, inds]
+        det_ignore = np.concatenate([e["dtIgnore"][:, :max_det] for e in evals], axis=1)[:, inds]
+        gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
+        npig = int((~gt_ignore).sum())
+        if npig == 0:
+            return
+        tps = det_matches & ~det_ignore
+        fps = ~det_matches & ~det_ignore
+
+        tp_sum = np.cumsum(tps, axis=1).astype(np.float64)
+        fp_sum = np.cumsum(fps, axis=1).astype(np.float64)
+        nb_rec_thrs = len(rec_thresholds)
+
+        for idx_iou, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
+            nd = len(tp)
+            rc = tp / npig
+            pr = tp / (fp + tp + np.finfo(np.float64).eps)
+            recall[idx_iou, idx_cls, idx_area, idx_max_det] = rc[-1] if nd else 0
+
+            # monotone envelope removes PR zigzags before interpolation
+            pr = np.maximum.accumulate(pr[::-1])[::-1]
+
+            inds_rec = np.searchsorted(rc, rec_thresholds, side="left")
+            prec_at = np.zeros((nb_rec_thrs,))
+            num_inds = int(inds_rec.argmax()) if inds_rec.max(initial=0) >= nd else nb_rec_thrs
+            valid = inds_rec[:num_inds]
+            prec_at[:num_inds] = pr[valid]
+            precision[idx_iou, :, idx_cls, idx_area, idx_max_det] = prec_at
+
+    def _summarize(
+        self,
+        results: Dict[str, np.ndarray],
+        avg_prec: bool = True,
+        iou_threshold: Optional[float] = None,
+        area_range: str = "all",
+        max_dets: int = 100,
+    ) -> np.ndarray:
+        """Mean of the selected precision/recall cells, -1 when empty (reference ``:637-674``)."""
+        area_inds = [i for i, k in enumerate(self.bbox_area_ranges.keys()) if k == area_range]
+        mdet_inds = [i for i, k in enumerate(self.max_detection_thresholds) if k == max_dets]
+        if avg_prec:
+            prec = results["precision"]
+            if iou_threshold is not None:
+                thr = self.iou_thresholds.index(iou_threshold)
+                prec = prec[thr, :, :, area_inds, mdet_inds]
+            else:
+                prec = prec[:, :, :, area_inds, mdet_inds]
+        else:
+            prec = results["recall"]
+            if iou_threshold is not None:
+                thr = self.iou_thresholds.index(iou_threshold)
+                prec = prec[thr, :, area_inds, mdet_inds]
+            else:
+                prec = prec[:, :, area_inds, mdet_inds]
+        valid = prec[prec > -1]
+        return np.array(-1.0) if valid.size == 0 else valid.mean()
+
+    def _summarize_results(
+        self, precisions: np.ndarray, recalls: np.ndarray
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        """The standard COCO headline numbers (reference ``:739-771``)."""
+        results = {"precision": precisions, "recall": recalls}
+        last_max_det = self.max_detection_thresholds[-1]
+        map_val = {
+            "map": self._summarize(results, True, max_dets=last_max_det),
+            "map_50": (
+                self._summarize(results, True, iou_threshold=0.5, max_dets=last_max_det)
+                if 0.5 in self.iou_thresholds
+                else np.array(-1.0)
+            ),
+            "map_75": (
+                self._summarize(results, True, iou_threshold=0.75, max_dets=last_max_det)
+                if 0.75 in self.iou_thresholds
+                else np.array(-1.0)
+            ),
+            "map_small": self._summarize(results, True, area_range="small", max_dets=last_max_det),
+            "map_medium": self._summarize(results, True, area_range="medium", max_dets=last_max_det),
+            "map_large": self._summarize(results, True, area_range="large", max_dets=last_max_det),
+        }
+        mar_val = {f"mar_{max_det}": self._summarize(results, False, max_dets=max_det)
+                   for max_det in self.max_detection_thresholds}
+        mar_val["mar_small"] = self._summarize(results, False, area_range="small", max_dets=last_max_det)
+        mar_val["mar_medium"] = self._summarize(results, False, area_range="medium", max_dets=last_max_det)
+        mar_val["mar_large"] = self._summarize(results, False, area_range="large", max_dets=last_max_det)
+        return map_val, mar_val
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,97 @@
+"""Modular PanopticQuality / ModifiedPanopticQuality (reference ``detection/panoptic_qualities.py``).
+
+Dense per-category sum states ride the ordinary psum sync path — PQ is the one
+detection metric whose state is mesh-friendly by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Collection, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.detection._panoptic_common import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+    _validate_inputs,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PanopticQuality(Metric):
+    """Panoptic Quality with per-category sum states (reference ``panoptic_qualities.py:27-215``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    iou_sum: Array
+    true_positives: Array
+    false_positives: Array
+    false_negatives: Array
+
+    _modified_variant: bool = False
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things, stuffs = _parse_categories(things, stuffs)
+        self.things = things
+        self.stuffs = stuffs
+        self.void_color = _get_void_color(things, stuffs)
+        self.cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+
+        n_categories = len(things) + len(stuffs)
+        self.add_state("iou_sum", default=jnp.zeros(n_categories), dist_reduce_fx="sum")
+        self.add_state("true_positives", default=jnp.zeros(n_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", default=jnp.zeros(n_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", default=jnp.zeros(n_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold one batch of (category, instance) maps into the category stats."""
+        _validate_inputs(preds, target)
+        flatten_preds = _preprocess_inputs(
+            self.things, self.stuffs, preds, self.void_color, self.allow_unknown_preds_category
+        )
+        flatten_target = _preprocess_inputs(self.things, self.stuffs, target, self.void_color, True)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            flatten_preds,
+            flatten_target,
+            self.cat_id_to_continuous_id,
+            self.void_color,
+            modified_metric_stuffs=self.stuffs if self._modified_variant else None,
+        )
+        self.iou_sum = self.iou_sum + iou_sum
+        self.true_positives = self.true_positives + tp.astype(self.true_positives.dtype)
+        self.false_positives = self.false_positives + fp.astype(self.false_positives.dtype)
+        self.false_negatives = self.false_negatives + fn.astype(self.false_negatives.dtype)
+
+    def compute(self) -> Array:
+        """Category-averaged PQ."""
+        return _panoptic_quality_compute(
+            self.iou_sum, self.true_positives, self.false_positives, self.false_negatives
+        )
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """PQ variant with per-segment stuff scoring (reference ``panoptic_qualities.py:218-355``)."""
+
+    _modified_variant: bool = True

@@ -6,6 +6,8 @@ accuracy`` works like the reference's ``torchmetrics.functional`` namespace.
 
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.detection import __all__ as _detection_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
@@ -13,4 +15,10 @@ from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
-__all__ = list(_classification_all) + list(_regression_all) + list(_image_all) + list(_text_all)
+__all__ = (
+    list(_classification_all)
+    + list(_detection_all)
+    + list(_regression_all)
+    + list(_image_all)
+    + list(_text_all)
+)

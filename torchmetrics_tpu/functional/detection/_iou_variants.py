@@ -1,0 +1,72 @@
+"""Shared machinery for the four box-overlap functionals.
+
+The reference ships four near-identical files (``functional/detection/{iou,giou,diou,
+ciou}.py``), each deferring to a torchvision op. Here one factory builds all four from
+the jnp pairwise kernels in ``helpers.py``; thresholding uses ``jnp.where`` so the
+public functions stay jit-safe (no boolean indexing).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.detection.helpers import _box_ciou, _box_diou, _box_giou, _box_iou
+
+Array = jax.Array
+
+
+def _variant_update(
+    kernel: Callable[[Array, Array], Array],
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float],
+    replacement_val: float = 0,
+) -> Array:
+    """Pairwise score matrix with sub-threshold entries replaced (reference ``iou.py:29-35``)."""
+    scores = kernel(jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32))
+    if iou_threshold is not None:
+        scores = jnp.where(scores < iou_threshold, replacement_val, scores)
+    return scores
+
+
+def _variant_compute(scores: Array, labels_eq: bool = True) -> Array:
+    """Mean of the matched diagonal — or of all pairs when labels differ (reference ``iou.py:38-41``)."""
+    if labels_eq:
+        return jnp.diagonal(scores).mean()
+    return scores.mean()
+
+
+def _make_variant(kernel: Callable[[Array, Array], Array], public_name: str) -> Callable:
+    def fn(
+        preds: Array,
+        target: Array,
+        iou_threshold: Optional[float] = None,
+        replacement_val: float = 0,
+        aggregate: bool = True,
+    ) -> Array:
+        scores = _variant_update(kernel, preds, target, iou_threshold, replacement_val)
+        return _variant_compute(scores) if aggregate else scores
+
+    fn.__name__ = public_name
+    fn.__qualname__ = public_name
+    fn.__doc__ = (
+        f"Compute ``{public_name}`` between two sets of xyxy boxes.\n\n"
+        "Args:\n"
+        "    preds: ``(N, 4)`` predicted boxes, ``(x1, y1, x2, y2)`` with ``x1 < x2``, ``y1 < y2``.\n"
+        "    target: ``(M, 4)`` ground-truth boxes in the same layout.\n"
+        "    iou_threshold: optional floor; entries below it become ``replacement_val``.\n"
+        "    replacement_val: value written for sub-threshold pairs.\n"
+        "    aggregate: return the matched-pair mean instead of the full ``(N, M)`` matrix.\n\n"
+        f"Own jnp kernels (reference ``functional/detection/{public_name.split('_')[0]}``-family "
+        "delegates to torchvision; see ``helpers.py`` here)."
+    )
+    return fn
+
+
+intersection_over_union = _make_variant(_box_iou, "intersection_over_union")
+generalized_intersection_over_union = _make_variant(_box_giou, "generalized_intersection_over_union")
+distance_intersection_over_union = _make_variant(_box_diou, "distance_intersection_over_union")
+complete_intersection_over_union = _make_variant(_box_ciou, "complete_intersection_over_union")

@@ -1,0 +1,5 @@
+"""complete_intersection_over_union (reference ``functional/detection/ciou.py``) — jnp kernel, no torchvision."""
+
+from torchmetrics_tpu.functional.detection._iou_variants import complete_intersection_over_union
+
+__all__ = ["complete_intersection_over_union"]

@@ -1,0 +1,5 @@
+"""distance_intersection_over_union (reference ``functional/detection/diou.py``) — jnp kernel, no torchvision."""
+
+from torchmetrics_tpu.functional.detection._iou_variants import distance_intersection_over_union
+
+__all__ = ["distance_intersection_over_union"]

@@ -1,0 +1,5 @@
+"""generalized_intersection_over_union (reference ``functional/detection/giou.py``) — jnp kernel, no torchvision."""
+
+from torchmetrics_tpu.functional.detection._iou_variants import generalized_intersection_over_union
+
+__all__ = ["generalized_intersection_over_union"]

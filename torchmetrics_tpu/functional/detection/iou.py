@@ -1,0 +1,5 @@
+"""intersection_over_union (reference ``functional/detection/iou.py``) — jnp kernel, no torchvision."""
+
+from torchmetrics_tpu.functional.detection._iou_variants import intersection_over_union
+
+__all__ = ["intersection_over_union"]

@@ -1,0 +1,71 @@
+"""Panoptic quality functionals (reference ``functional/detection/panoptic_qualities.py``)."""
+
+from __future__ import annotations
+
+from typing import Collection
+
+import jax
+
+from torchmetrics_tpu.functional.detection._panoptic_common import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+    _validate_inputs,
+)
+
+Array = jax.Array
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    r"""Compute Panoptic Quality for panoptic segmentations (reference ``panoptic_qualities.py:30-105``).
+
+    ``PQ = IoU_sum / (TP + 0.5 FP + 0.5 FN)`` per category, averaged over seen categories.
+
+    Args:
+        preds: ``(B, *spatial, 2)`` array of ``(category_id, instance_id)`` pairs per pixel.
+        target: ground truth of the same shape.
+        things: category ids of countable things (instances distinguished).
+        stuffs: category ids of uncountable stuffs (instance id ignored).
+        allow_unknown_preds_category: map unknown predicted categories to void instead of raising.
+    """
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _preprocess_inputs(things, stuffs, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _preprocess_inputs(things, stuffs, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(flatten_preds, flatten_target, cat_id_to_continuous_id, void_color)
+    return _panoptic_quality_compute(iou_sum, tp, fp, fn)
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    r"""Modified Panoptic Quality: stuff classes scored per-segment at IoU > 0 (reference ``:108-180``).
+
+    Adaptation from the Seamless Scene Segmentation paper where each stuff class
+    contributes its summed IoU over target segments rather than 0.5-thresholded matches.
+    """
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _preprocess_inputs(things, stuffs, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _preprocess_inputs(things, stuffs, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(
+        flatten_preds, flatten_target, cat_id_to_continuous_id, void_color, modified_metric_stuffs=stuffs
+    )
+    return _panoptic_quality_compute(iou_sum, tp, fp, fn)
