@@ -1,0 +1,287 @@
+"""Retrieval domain tests.
+
+Goldens: reference doctest values, sklearn (``ndcg_score``, ``average_precision_score``),
+and cross-consistency between the batched dense compute and a per-query functional loop.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMetric,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+)
+
+_P = jnp.array([0.2, 0.3, 0.5])
+_T = jnp.array([True, False, True])
+
+
+class TestFunctionalDoctestValues:
+    def test_average_precision(self):
+        assert float(retrieval_average_precision(_P, _T)) == pytest.approx(0.8333, abs=1e-4)
+
+    def test_fall_out(self):
+        assert float(retrieval_fall_out(_P, _T, top_k=2)) == pytest.approx(1.0)
+
+    def test_hit_rate(self):
+        assert float(retrieval_hit_rate(_P, _T, top_k=2)) == pytest.approx(1.0)
+
+    def test_ndcg(self):
+        preds = jnp.array([0.1, 0.2, 0.3, 4.0, 70.0])
+        target = jnp.array([10, 0, 0, 1, 5])
+        assert float(retrieval_normalized_dcg(preds, target)) == pytest.approx(0.6957, abs=1e-4)
+
+    def test_precision(self):
+        assert float(retrieval_precision(_P, _T, top_k=2)) == pytest.approx(0.5)
+
+    def test_r_precision(self):
+        assert float(retrieval_r_precision(_P, _T)) == pytest.approx(0.5)
+
+    def test_recall(self):
+        assert float(retrieval_recall(_P, _T, top_k=2)) == pytest.approx(0.5)
+
+    def test_reciprocal_rank(self):
+        assert float(retrieval_reciprocal_rank(_P, jnp.array([False, True, False]))) == pytest.approx(0.5)
+
+    def test_precision_recall_curve(self):
+        prec, rec, topk = retrieval_precision_recall_curve(_P, _T, max_k=2)
+        np.testing.assert_allclose(np.asarray(prec), [1.0, 0.5], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), [0.5, 0.5], atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(topk), [1, 2])
+
+
+class TestVsSklearn:
+    def test_ap_matches_sklearn(self):
+        rng = np.random.RandomState(7)
+        for _ in range(5):
+            preds = rng.rand(40)
+            target = rng.randint(0, 2, 40)
+            if target.sum() == 0:
+                target[0] = 1
+            ours = float(retrieval_average_precision(jnp.asarray(preds), jnp.asarray(target)))
+            assert ours == pytest.approx(average_precision_score(target, preds), abs=1e-5)
+
+    def test_ndcg_matches_sklearn(self):
+        rng = np.random.RandomState(3)
+        for _ in range(5):
+            preds = rng.rand(25)
+            target = rng.randint(0, 5, 25)
+            ours = float(retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target)))
+            ref = ndcg_score(target[None, :], preds[None, :])
+            assert ours == pytest.approx(ref, abs=1e-5)
+
+    def test_ndcg_top_k_matches_sklearn(self):
+        rng = np.random.RandomState(4)
+        preds = rng.rand(30)
+        target = rng.randint(0, 4, 30)
+        ours = float(retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(target), top_k=10))
+        assert ours == pytest.approx(ndcg_score(target[None, :], preds[None, :], k=10), abs=1e-5)
+
+
+def _random_queries(seed=0, n=120, n_queries=7):
+    rng = np.random.RandomState(seed)
+    indexes = rng.randint(0, n_queries, n)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n)
+    return jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target)
+
+
+_MODULAR_VS_FUNCTIONAL = [
+    (RetrievalMAP, retrieval_average_precision, {}),
+    (RetrievalMAP, retrieval_average_precision, {"top_k": 3}),
+    (RetrievalMRR, retrieval_reciprocal_rank, {}),
+    (RetrievalRPrecision, retrieval_r_precision, {}),
+    (RetrievalPrecision, retrieval_precision, {"top_k": 4}),
+    (RetrievalPrecision, retrieval_precision, {"top_k": 50, "adaptive_k": True}),
+    (RetrievalRecall, retrieval_recall, {"top_k": 4}),
+    (RetrievalHitRate, retrieval_hit_rate, {"top_k": 3}),
+    (RetrievalNormalizedDCG, retrieval_normalized_dcg, {"top_k": 5}),
+    (RetrievalFallOut, retrieval_fall_out, {"top_k": 4}),
+]
+
+
+class TestModularMatchesPerQueryLoop:
+    """The batched dense compute must equal a per-query loop over the functional."""
+
+    @pytest.mark.parametrize("metric_cls,fn,kwargs", _MODULAR_VS_FUNCTIONAL)
+    def test_parity(self, metric_cls, fn, kwargs):
+        indexes, preds, target = _random_queries()
+        metric = metric_cls(**kwargs)
+        metric.update(preds, target, indexes=indexes)
+        ours = float(metric.compute())
+
+        idx_np, p_np, t_np = np.asarray(indexes), np.asarray(preds), np.asarray(target)
+        empty_on_neg = metric_cls is RetrievalFallOut
+        scores = []
+        for q in np.unique(idx_np):
+            sel = idx_np == q
+            count = (1 - t_np[sel]).sum() if empty_on_neg else t_np[sel].sum()
+            if count == 0:
+                scores.append(1.0 if metric.empty_target_action == "pos" else 0.0)
+            else:
+                scores.append(float(fn(jnp.asarray(p_np[sel]), jnp.asarray(t_np[sel]), **kwargs)))
+        assert ours == pytest.approx(float(np.mean(scores)), abs=1e-5)
+
+
+class TestEmptyTargetAction:
+    def _empty_query_inputs(self):
+        indexes = jnp.array([0, 0, 1, 1])
+        preds = jnp.array([0.9, 0.1, 0.8, 0.2])
+        target = jnp.array([1, 0, 0, 0])  # query 1 has no positives
+        return indexes, preds, target
+
+    def test_neg(self):
+        indexes, preds, target = self._empty_query_inputs()
+        m = RetrievalMAP(empty_target_action="neg")
+        m.update(preds, target, indexes=indexes)
+        assert float(m.compute()) == pytest.approx(0.5)
+
+    def test_pos(self):
+        indexes, preds, target = self._empty_query_inputs()
+        m = RetrievalMAP(empty_target_action="pos")
+        m.update(preds, target, indexes=indexes)
+        assert float(m.compute()) == pytest.approx(1.0)
+
+    def test_skip(self):
+        indexes, preds, target = self._empty_query_inputs()
+        m = RetrievalMAP(empty_target_action="skip")
+        m.update(preds, target, indexes=indexes)
+        assert float(m.compute()) == pytest.approx(1.0)
+
+    def test_error(self):
+        indexes, preds, target = self._empty_query_inputs()
+        m = RetrievalMAP(empty_target_action="error")
+        m.update(preds, target, indexes=indexes)
+        with pytest.raises(ValueError, match="no positive target"):
+            m.compute()
+
+    def test_invalid_action(self):
+        with pytest.raises(ValueError, match="empty_target_action"):
+            RetrievalMAP(empty_target_action="bad")
+
+    def test_ignore_index(self):
+        indexes = jnp.array([0, 0, 0])
+        preds = jnp.array([0.9, 0.5, 0.1])
+        target = jnp.array([1, -100, 0])
+        m = RetrievalMAP(ignore_index=-100)
+        m.update(preds, target, indexes=indexes)
+        assert float(m.compute()) == pytest.approx(1.0)
+
+
+class TestCustomSubclassFallback:
+    """Reference-style subclasses overriding per-query `_metric` still work."""
+
+    def test_custom_metric(self):
+        class FirstDocRelevance(RetrievalMetric):
+            def _metric(self, preds, target):
+                return target[0].astype(jnp.float32)
+
+        indexes, preds, target = _random_queries(seed=2)
+        m = FirstDocRelevance()
+        m.update(preds, target, indexes=indexes)
+        value = float(m.compute())
+        assert 0.0 <= value <= 1.0
+
+    def test_custom_metric_delegating_to_functional(self):
+        # the advertised compatibility path: a reference-style subclass whose _metric
+        # calls a public functional (which validates binary-target dtypes)
+        class MyAP(RetrievalMetric):
+            def _metric(self, preds, target):
+                return retrieval_average_precision(preds, target)
+
+        indexes, preds, target = _random_queries(seed=13)
+        custom = MyAP()
+        custom.update(preds, target, indexes=indexes)
+        builtin = RetrievalMAP()
+        builtin.update(preds, target, indexes=indexes)
+        assert float(custom.compute()) == pytest.approx(float(builtin.compute()), abs=1e-6)
+
+    def test_ap_top_k_zero_raises(self):
+        with pytest.raises(ValueError, match="top_k"):
+            retrieval_average_precision(_P, _T, top_k=0)
+
+
+class TestCurveAndFixedPrecision:
+    def test_curve_shapes(self):
+        indexes, preds, target = _random_queries(seed=5)
+        m = RetrievalPrecisionRecallCurve(max_k=6)
+        m.update(preds, target, indexes=indexes)
+        prec, rec, topk = m.compute()
+        assert prec.shape == (6,) and rec.shape == (6,)
+        np.testing.assert_array_equal(np.asarray(topk), np.arange(1, 7))
+        # recall@k is monotone non-decreasing in k
+        assert bool(jnp.all(jnp.diff(rec) >= -1e-6))
+
+    def test_recall_at_fixed_precision(self):
+        indexes, preds, target = _random_queries(seed=6)
+        m = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=8)
+        m.update(preds, target, indexes=indexes)
+        max_recall, best_k = m.compute()
+        assert 0.0 <= float(max_recall) <= 1.0
+        assert 1 <= int(best_k) <= 8
+
+    def test_fixed_precision_exact(self):
+        # single query: ranks -> rel [1, 0, 1]; P@k = [1, .5, .667], R@k = [.5, .5, 1]
+        indexes = jnp.array([0, 0, 0])
+        m = RetrievalRecallAtFixedPrecision(min_precision=0.6)
+        m.update(_P, _T, indexes=indexes)
+        max_recall, best_k = m.compute()
+        assert float(max_recall) == pytest.approx(1.0)
+        assert int(best_k) == 3
+
+
+class TestRawStateSync:
+    def test_dist_sync_duplicates_queries(self):
+        # indexes are global query ids: a 2-process gather of identical shards must
+        # equal a single process seeing the same rows twice (groups merge by id)
+        indexes, preds, target = _random_queries(seed=9)
+        twice = RetrievalMAP()
+        twice.update(preds, target, indexes=indexes)
+        twice.update(preds, target, indexes=indexes)
+        expected = float(twice.compute())
+
+        synced = RetrievalMAP(
+            dist_sync_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+        synced.update(preds, target, indexes=indexes)
+        assert float(synced.compute()) == pytest.approx(expected, abs=1e-6)
+
+    def test_merge_state(self):
+        indexes, preds, target = _random_queries(seed=11)
+        full = RetrievalMAP()
+        full.update(preds, target, indexes=indexes)
+        a = RetrievalMAP()
+        a.update(preds[:60], target[:60], indexes=indexes[:60])
+        b = RetrievalMAP()
+        b.update(preds[60:], target[60:], indexes=indexes[60:])
+        a.merge_state(b)
+        assert float(a.compute()) == pytest.approx(float(full.compute()), abs=1e-6)
+
+
+def test_exported_from_root():
+    assert tm.RetrievalMAP is RetrievalMAP
+    assert tm.functional.retrieval_average_precision is retrieval_average_precision

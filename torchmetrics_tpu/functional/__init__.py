@@ -12,6 +12,8 @@ from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
@@ -19,6 +21,7 @@ __all__ = (
     list(_classification_all)
     + list(_detection_all)
     + list(_regression_all)
+    + list(_retrieval_all)
     + list(_image_all)
     + list(_text_all)
 )

@@ -1,0 +1,35 @@
+"""Retrieval average precision (reference ``functional/retrieval/average_precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """AP over a single query: mean over relevant top-k docs of (j / rank_j).
+
+    Branch-free form: with documents sorted by score, ``j = cumsum(rel)`` and the sum of
+    ``rel * j / rank`` divided by the number of relevant retrieved docs equals the
+    reference's loop over relevant positions (``average_precision.py:22-60``).
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    top_k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError(f"Argument ``top_k`` has to be a positive integer or None, but got {top_k}.")
+
+    k = min(top_k, preds.shape[-1])
+    order = jnp.argsort(-preds)
+    rel = target[order][:k].astype(jnp.float32)
+    ranks = jnp.arange(1, k + 1, dtype=jnp.float32)
+    j = jnp.cumsum(rel)
+    n_rel = rel.sum()
+    ap = jnp.sum(rel * j / ranks) / jnp.where(n_rel == 0, 1.0, n_rel)
+    return jnp.where(n_rel == 0, 0.0, ap)

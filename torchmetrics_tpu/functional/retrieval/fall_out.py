@@ -1,0 +1,26 @@
+"""Retrieval fall-out@k (reference ``functional/retrieval/fall_out.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fraction of the non-relevant documents retrieved in the top k (reference ``fall_out.py:22-60``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    top_k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+    negative = 1 - target
+    n_neg = negative.sum()
+    retrieved_neg = negative[jnp.argsort(-preds)][:top_k].sum().astype(jnp.float32)
+    return jnp.where(n_neg == 0, 0.0, retrieved_neg / jnp.where(n_neg == 0, 1, n_neg))

@@ -1,0 +1,25 @@
+"""Retrieval hit rate@k (reference ``functional/retrieval/hit_rate.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """1.0 when any relevant document lands in the top k (reference ``hit_rate.py:22-57``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    if top_k is None:
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+    relevant = target[jnp.argsort(-preds)][:top_k].sum()
+    return (relevant > 0).astype(jnp.float32)

@@ -1,0 +1,35 @@
+"""Retrieval normalized DCG (reference ``functional/retrieval/ndcg.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _dcg(target: Array) -> Array:
+    """Discounted cumulative gain along the last axis (reference ``ndcg.py:21-24``)."""
+    denom = jnp.log2(jnp.arange(target.shape[-1]) + 2.0)
+    return (target / denom).sum(axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """nDCG over a single query; graded (non-binary) relevance allowed (reference ``ndcg.py:27-74``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+
+    top_k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+    k = min(top_k, preds.shape[-1])
+    sorted_target = target[jnp.argsort(-preds)][:k].astype(jnp.float32)
+    ideal_target = -jnp.sort(-target.astype(jnp.float32))[:k]
+
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    return jnp.where(ideal_dcg == 0, 0.0, target_dcg / jnp.where(ideal_dcg == 0, 1.0, ideal_dcg))

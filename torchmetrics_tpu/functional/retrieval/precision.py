@@ -1,0 +1,30 @@
+"""Retrieval precision@k (reference ``functional/retrieval/precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Fraction of the top-k retrieved documents that are relevant (reference ``precision.py:22-63``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if top_k is None or (adaptive_k and top_k > preds.shape[-1]):
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+    order = jnp.argsort(-preds)
+    relevant = target[order][: min(top_k, preds.shape[-1])].sum().astype(jnp.float32)
+    return jnp.where(target.sum() == 0, 0.0, relevant / top_k)

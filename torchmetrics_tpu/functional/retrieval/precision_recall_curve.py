@@ -1,0 +1,41 @@
+"""Retrieval precision-recall curve (reference ``functional/retrieval/precision_recall_curve.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision@k and recall@k for every k in [1, max_k] (reference ``:24-120``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+
+    n = preds.shape[-1]
+    if adaptive_k and max_k > n:
+        topk = jnp.concatenate([jnp.arange(1, n + 1), jnp.full((max_k - n,), n)])
+    else:
+        topk = jnp.arange(1, max_k + 1)
+
+    relevant = target[jnp.argsort(-preds)][: min(max_k, n)].astype(jnp.float32)
+    relevant = jnp.pad(relevant, (0, max(0, max_k - relevant.shape[0])))
+    relevant = jnp.cumsum(relevant)
+
+    n_pos = target.sum()
+    recall = jnp.where(n_pos == 0, 0.0, relevant / jnp.where(n_pos == 0, 1, n_pos))
+    precision = jnp.where(n_pos == 0, 0.0, relevant / topk)
+    return precision, recall, topk

@@ -1,0 +1,26 @@
+"""Retrieval R-precision (reference ``functional/retrieval/r_precision.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Precision at the R-th position, R = number of relevant docs (reference ``r_precision.py:22-55``).
+
+    Branch-free: with docs sorted by score, the count of relevant docs in the first R
+    slots is ``sum(rel * (rank <= R))`` — no dynamic slicing by a traced R.
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    rel = target[jnp.argsort(-preds)].astype(jnp.float32)
+    n_rel = rel.sum()
+    ranks = jnp.arange(1, rel.shape[-1] + 1)
+    in_first_r = (ranks <= n_rel).astype(jnp.float32)
+    hit = jnp.sum(rel * in_first_r)
+    return jnp.where(n_rel == 0, 0.0, hit / jnp.where(n_rel == 0, 1.0, n_rel))

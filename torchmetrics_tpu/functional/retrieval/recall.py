@@ -1,0 +1,26 @@
+"""Retrieval recall@k (reference ``functional/retrieval/recall.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fraction of the relevant documents retrieved in the top k (reference ``recall.py:22-58``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    if top_k is None:
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+    n_pos = target.sum()
+    relevant = target[jnp.argsort(-preds)][:top_k].sum().astype(jnp.float32)
+    return jnp.where(n_pos == 0, 0.0, relevant / jnp.where(n_pos == 0, 1, n_pos))

@@ -1,0 +1,23 @@
+"""Retrieval mean reciprocal rank (reference ``functional/retrieval/reciprocal_rank.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """1 / rank of the first relevant document (reference ``reciprocal_rank.py:22-52``).
+
+    ``argmax`` over the rank-sorted binary relevance returns the first hit — no
+    ``nonzero`` host sync.
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    rel = target[jnp.argsort(-preds)]
+    first = jnp.argmax(rel)
+    return jnp.where(rel.sum() == 0, 0.0, 1.0 / (first + 1.0))

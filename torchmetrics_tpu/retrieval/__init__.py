@@ -1,0 +1,29 @@
+"""Retrieval metrics (reference ``src/torchmetrics/retrieval/__init__.py``)."""
+
+from torchmetrics_tpu.retrieval.average_precision import RetrievalMAP
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+from torchmetrics_tpu.retrieval.fall_out import RetrievalFallOut
+from torchmetrics_tpu.retrieval.hit_rate import RetrievalHitRate
+from torchmetrics_tpu.retrieval.ndcg import RetrievalNormalizedDCG
+from torchmetrics_tpu.retrieval.precision import RetrievalPrecision
+from torchmetrics_tpu.retrieval.precision_recall_curve import (
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecallAtFixedPrecision,
+)
+from torchmetrics_tpu.retrieval.r_precision import RetrievalRPrecision
+from torchmetrics_tpu.retrieval.recall import RetrievalRecall
+from torchmetrics_tpu.retrieval.reciprocal_rank import RetrievalMRR
+
+__all__ = [
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMetric",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision",
+]

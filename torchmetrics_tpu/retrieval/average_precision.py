@@ -1,0 +1,31 @@
+"""RetrievalMAP (reference ``retrieval/average_precision.py:27``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries, batched over the dense rank matrix."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.top_k = self._validate_top_k(top_k)
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        max_len = target_mat.shape[-1]
+        positions = jnp.arange(max_len)
+        rel = target_mat * self._in_topk(valid)
+        j = jnp.cumsum(rel, axis=-1)
+        ranks = positions + 1.0
+        n_rel = rel.sum(axis=-1)
+        ap = jnp.sum(rel * j / ranks, axis=-1) / jnp.where(n_rel == 0, 1.0, n_rel)
+        return jnp.where(n_rel == 0, 0.0, ap)

@@ -1,0 +1,170 @@
+"""RetrievalMetric base (reference ``retrieval/base.py:25-160``).
+
+TPU-first redesign of the grouped compute: instead of the reference's per-query Python
+loop over ``torch.split`` slices, the epoch's ragged ``(indexes, preds, target)`` rows
+are packed once into dense rank-ordered ``(num_queries, max_len)`` matrices (pads score
+``-inf`` / relevance 0), and every built-in metric evaluates as batched ``axis=-1``
+reductions over the whole matrix — one XLA computation for the entire epoch, no
+data-dependent control flow. Custom subclasses that override the reference-style
+per-query ``_metric`` hook still work: the base falls back to the grouped loop for them.
+"""
+
+from __future__ import annotations
+
+from abc import ABC
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _check_retrieval_inputs
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _pack_query_groups(indexes: Array, preds: Array, target: Array) -> Tuple[Array, Array, Array]:
+    """Rank-sorted dense matrices from flat grouped rows.
+
+    Rows are queries, columns are within-query descending-score rank. Returns
+    ``(preds_mat, target_mat, valid)`` with pads at ``-inf`` / 0 / False.
+    """
+    idx = np.asarray(indexes)
+    p = np.asarray(preds)
+    t = np.asarray(target)
+    order = np.lexsort((-p, idx))
+    idx, p, t = idx[order], p[order], t[order]
+    _, counts = np.unique(idx, return_counts=True)
+    n_queries, max_len = len(counts), int(counts.max())
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    ranks = np.arange(len(idx)) - np.repeat(starts, counts)
+    rows = np.repeat(np.arange(n_queries), counts)
+
+    preds_mat = np.full((n_queries, max_len), -np.inf, dtype=np.float32)
+    preds_mat[rows, ranks] = p
+    target_mat = np.zeros((n_queries, max_len), dtype=np.float32)
+    target_mat[rows, ranks] = t
+    valid = np.zeros((n_queries, max_len), dtype=bool)
+    valid[rows, ranks] = True
+    return jnp.asarray(preds_mat), jnp.asarray(target_mat), jnp.asarray(valid)
+
+
+class RetrievalMetric(Metric, ABC):
+    """Query-grouped retrieval metric over float scores and binary relevance."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    indexes: List[Array]
+    preds: List[Array]
+    target: List[Array]
+
+    # which side defines an "empty" query: positives for every metric except fall-out
+    _empty_on_negatives: bool = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Check shape/dtypes, flatten, and buffer (reference ``base.py:100-112``)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            jnp.asarray(indexes),
+            jnp.asarray(preds),
+            jnp.asarray(target),
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Group by query and fold per-query scores by ``empty_target_action``."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        preds_mat, target_mat, valid = _pack_query_groups(indexes, preds, target)
+        scores = self._metric_dense(preds_mat, target_mat, valid)
+
+        if self._empty_on_negatives:
+            empty = ((1 - target_mat) * valid).sum(axis=-1) == 0
+        else:
+            empty = target_mat.sum(axis=-1) == 0
+
+        if self.empty_target_action == "error" and bool(empty.any()):
+            side = "negative" if self._empty_on_negatives else "positive"
+            raise ValueError(f"`compute` method was provided with a query with no {side} target.")
+        if self.empty_target_action == "skip":
+            kept = jnp.where(~empty, scores, 0.0)
+            n_kept = (~empty).sum()
+            return jnp.where(n_kept == 0, 0.0, kept.sum() / jnp.where(n_kept == 0, 1, n_kept))
+        fill = 1.0 if self.empty_target_action == "pos" else 0.0
+        return jnp.where(empty, fill, scores).mean()
+
+    @staticmethod
+    def _validate_top_k(top_k: Optional[int]) -> Optional[int]:
+        """Shared ``top_k`` argument check for the @k subclasses."""
+        if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+            raise ValueError("`top_k` has to be a positive integer or None")
+        return top_k
+
+    def _in_topk(self, valid: Array) -> Array:
+        """Mask of slots inside this metric's top-k cut (all valid slots when unset)."""
+        top_k = getattr(self, "top_k", None)
+        if top_k is None:
+            return valid
+        return valid & (jnp.arange(valid.shape[-1]) < top_k)
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        """Batched per-query scores ``(num_queries,)`` over rank-sorted dense rows.
+
+        Built-ins override this. The default bridges to the reference-style per-query
+        ``_metric`` hook so user subclasses keep working, at python-loop cost.
+        """
+        scores = []
+        for row in range(preds_mat.shape[0]):
+            keep = valid[row]
+            n = int(np.asarray(keep).sum())
+            target_row = target_mat[row, :n]
+            if not self.allow_non_binary_target:
+                # the dense pack stores float32; hand binary metrics ints back so a
+                # `_metric` delegating to the public functionals passes their checks
+                target_row = target_row.astype(jnp.int32)
+            scores.append(self._metric(preds_mat[row, :n], target_row))
+        return jnp.stack([jnp.asarray(s, dtype=jnp.float32) for s in scores]) if scores else jnp.zeros((0,))
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Per-query metric over rank-sorted 1-D slices (reference ``base.py:152-158``)."""
+        raise NotImplementedError
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
+
+
+# re-exported for subclasses
+__all__ = ["RetrievalMetric", "_pack_query_groups"]

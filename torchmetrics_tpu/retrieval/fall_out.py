@@ -1,0 +1,30 @@
+"""RetrievalFallOut (reference ``retrieval/fall_out.py:29``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Fall-out@k per query; queries with no *negative* target are the empty ones."""
+
+    higher_is_better: bool = False
+    _empty_on_negatives: bool = True
+
+    def __init__(self, empty_target_action: str = "pos", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.top_k = self._validate_top_k(top_k)
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        negative = (1 - target_mat) * valid
+        retrieved_neg = (negative * self._in_topk(valid)).sum(axis=-1)
+        n_neg = negative.sum(axis=-1)
+        return jnp.where(n_neg == 0, 0.0, retrieved_neg / jnp.where(n_neg == 0, 1.0, n_neg))

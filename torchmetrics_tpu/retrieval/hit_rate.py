@@ -1,0 +1,24 @@
+"""RetrievalHitRate (reference ``retrieval/hit_rate.py:27``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """Probability the top k contains at least one relevant document."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.top_k = self._validate_top_k(top_k)
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        return ((target_mat * self._in_topk(valid)).sum(axis=-1) > 0).astype(jnp.float32)

@@ -1,0 +1,32 @@
+"""RetrievalNormalizedDCG (reference ``retrieval/ndcg.py:27``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """nDCG@k per query with graded relevance, batched over the dense rank matrix."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.top_k = self._validate_top_k(top_k)
+        self.allow_non_binary_target = True
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        max_len = target_mat.shape[-1]
+        k = min(self.top_k, max_len) if self.top_k is not None else max_len
+        positions = jnp.arange(max_len)
+        discount = 1.0 / jnp.log2(positions + 2.0)
+        dcg = (target_mat * self._in_topk(valid) * discount).sum(axis=-1)
+        ideal = -jnp.sort(-(target_mat * valid), axis=-1)
+        idcg = (ideal * (positions < k) * discount).sum(axis=-1)
+        return jnp.where(idcg == 0, 0.0, dcg / jnp.where(idcg == 0, 1.0, idcg))

@@ -1,0 +1,47 @@
+"""RetrievalPrecision (reference ``retrieval/precision.py:27``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k per query, averaged (reference semantics incl. ``adaptive_k``)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.top_k = self._validate_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        max_len = target_mat.shape[-1]
+        positions = jnp.arange(max_len)
+        n_valid = valid.sum(axis=-1)
+        if self.top_k is None:
+            k_den = n_valid.astype(jnp.float32)
+            in_topk = valid
+        else:
+            if self.adaptive_k:
+                # clamp per query to its own document count
+                k_den = jnp.minimum(self.top_k, n_valid).astype(jnp.float32)
+            else:
+                k_den = jnp.full(n_valid.shape, float(self.top_k))
+            in_topk = valid & (positions < self.top_k)
+        relevant = (target_mat * in_topk).sum(axis=-1)
+        return relevant / k_den

@@ -1,0 +1,123 @@
+"""RetrievalPrecisionRecallCurve and RetrievalRecallAtFixedPrecision
+(reference ``retrieval/precision_recall_curve.py:60,265``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric, _pack_query_groups
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.utilities.plot import plot_curve
+
+Array = jax.Array
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Highest recall (and its k) among points with precision >= min_precision (reference ``:33-57``)."""
+    p = np.asarray(precision)
+    r = np.asarray(recall)
+    k = np.asarray(top_k)
+    candidates = [(rr, kk) for pp, rr, kk in zip(p, r, k) if pp >= min_precision]
+    if candidates:
+        max_recall, best_k = max(candidates)
+    else:
+        max_recall, best_k = 0.0, len(k)
+    if max_recall == 0.0:
+        best_k = len(k)
+    return jnp.asarray(max_recall, dtype=jnp.float32), jnp.asarray(best_k, dtype=jnp.int32)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged precision@k / recall@k curves over queries, k in [1, max_k]."""
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.max_k = self._validate_top_k(max_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def compute(self) -> Tuple[Array, Array, Array]:  # type: ignore[override]
+        """Batched curves over the dense rank matrix (one XLA reduction per point set)."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        preds_mat, target_mat, valid = _pack_query_groups(indexes, preds, target)
+        _, max_len = target_mat.shape
+        max_k = self.max_k if self.max_k is not None else max_len
+
+        positions = jnp.arange(max_k)
+        # cumulative relevant count in the first k ranks, truncated to each row's docs
+        padded_t = jnp.pad(target_mat * valid, ((0, 0), (0, max(0, max_k - max_len))))[:, :max_k]
+        relevant = jnp.cumsum(padded_t, axis=-1)
+
+        n_valid = valid.sum(axis=-1, keepdims=True)
+        if self.adaptive_k:
+            topk = jnp.minimum(positions + 1, n_valid).astype(jnp.float32)
+        else:
+            topk = jnp.broadcast_to((positions + 1).astype(jnp.float32), relevant.shape)
+
+        n_pos = (target_mat * valid).sum(axis=-1, keepdims=True)
+        recalls = jnp.where(n_pos == 0, 0.0, relevant / jnp.where(n_pos == 0, 1.0, n_pos))
+        precisions = jnp.where(n_pos == 0, 0.0, relevant / topk)
+
+        empty = n_pos.squeeze(-1) == 0
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "skip":
+            keep = ~empty
+            n_kept = int(np.asarray(keep).sum())
+            if n_kept == 0:
+                zero = jnp.zeros((max_k,))
+                return zero, zero, jnp.arange(1, max_k + 1)
+            precision = (precisions * keep[:, None]).sum(axis=0) / n_kept
+            recall = (recalls * keep[:, None]).sum(axis=0) / n_kept
+        else:
+            fill = 1.0 if self.empty_target_action == "pos" else 0.0
+            precision = jnp.where(empty[:, None], fill, precisions).mean(axis=0)
+            recall = jnp.where(empty[:, None], fill, recalls).mean(axis=0)
+
+        return precision, recall, jnp.arange(1, max_k + 1)
+
+    def plot(self, curve: Optional[Tuple[Array, Array, Array]] = None, ax: Optional[Any] = None) -> Any:
+        curve = curve or self.compute()
+        return plot_curve(curve, ax=ax, label_names=("Recall", "Precision"), name=type(self).__name__)
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall@k whose precision@k clears ``min_precision`` (reference ``:265-354``)."""
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k, adaptive_k=adaptive_k, empty_target_action=empty_target_action,
+            ignore_index=ignore_index, **kwargs,
+        )
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precision, recall, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)

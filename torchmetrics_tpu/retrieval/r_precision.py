@@ -1,0 +1,22 @@
+"""RetrievalRPrecision (reference ``retrieval/r_precision.py:27``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Precision at the R-th rank, R = per-query relevant count (branch-free mask form)."""
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        ranks = jnp.arange(1, target_mat.shape[-1] + 1)
+        n_rel = (target_mat * valid).sum(axis=-1, keepdims=True)
+        in_first_r = (ranks <= n_rel) & valid
+        hit = (target_mat * in_first_r).sum(axis=-1)
+        n_rel = n_rel.squeeze(-1)
+        return jnp.where(n_rel == 0, 0.0, hit / jnp.where(n_rel == 0, 1.0, n_rel))

@@ -1,0 +1,26 @@
+"""RetrievalRecall (reference ``retrieval/recall.py:27``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Recall@k per query, averaged."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        self.top_k = self._validate_top_k(top_k)
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        relevant = (target_mat * self._in_topk(valid)).sum(axis=-1)
+        n_pos = (target_mat * valid).sum(axis=-1)
+        return jnp.where(n_pos == 0, 0.0, relevant / jnp.where(n_pos == 0, 1.0, n_pos))

@@ -1,0 +1,20 @@
+"""RetrievalMRR (reference ``retrieval/reciprocal_rank.py:27``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank: ``argmax`` over the rank-sorted relevance picks the first hit."""
+
+    def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
+        rel = target_mat * valid
+        first = jnp.argmax(rel > 0, axis=-1)
+        hit_exists = rel.sum(axis=-1) > 0
+        return jnp.where(hit_exists, 1.0 / (first + 1.0), 0.0)
