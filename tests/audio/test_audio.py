@@ -1,0 +1,269 @@
+"""Audio domain tests.
+
+Goldens: reference doctest values; torch-seeded signals reproduce the reference SDR
+fixture; PIT is checked against a brute-force permutation search in numpy.
+"""
+
+from itertools import permutations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from torchmetrics_tpu.functional.audio import (
+    complex_scale_invariant_signal_noise_ratio,
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+
+_TARGET = jnp.array([3.0, -0.5, 2.0, 7.0])
+_PREDS = jnp.array([2.5, 0.0, 2.0, 8.0])
+
+
+class TestClosedForms:
+    def test_snr_doctest(self):
+        assert float(signal_noise_ratio(_PREDS, _TARGET)) == pytest.approx(16.1805, abs=1e-3)
+
+    def test_si_snr_doctest(self):
+        assert float(scale_invariant_signal_noise_ratio(_PREDS, _TARGET)) == pytest.approx(15.0918, abs=1e-3)
+
+    def test_si_sdr_doctest(self):
+        assert float(scale_invariant_signal_distortion_ratio(_PREDS, _TARGET)) == pytest.approx(18.4030, abs=1e-3)
+
+    def test_si_sdr_zero_mean_invariance(self):
+        # with zero_mean, a DC offset on preds must not change the result
+        a = float(scale_invariant_signal_distortion_ratio(_PREDS + 5.0, _TARGET, zero_mean=True))
+        b = float(scale_invariant_signal_distortion_ratio(_PREDS, _TARGET, zero_mean=True))
+        assert a == pytest.approx(b, abs=1e-4)
+
+    def test_quiet_signals_dtype_eps(self):
+        # eps must scale with the input dtype: quiet float64 signals keep their SNR
+        rng = np.random.RandomState(0)
+        target = rng.randn(4000) * 1e-5
+        noise = rng.randn(4000) * 1e-7
+        val = float(signal_noise_ratio(jnp.asarray(target + noise), jnp.asarray(target)))
+        expected = 10 * np.log10((target**2).sum() / (noise**2).sum())
+        assert val == pytest.approx(expected, abs=0.1)
+
+    def test_si_sdr_scale_invariance(self):
+        # scaling preds must not change SI-SDR
+        a = float(scale_invariant_signal_distortion_ratio(_PREDS * 7.3, _TARGET))
+        b = float(scale_invariant_signal_distortion_ratio(_PREDS, _TARGET))
+        assert a == pytest.approx(b, abs=1e-3)
+
+    def test_snr_batched_shape(self):
+        preds = jnp.ones((4, 3, 100))
+        target = jnp.ones((4, 3, 100)) * 1.1
+        out = signal_noise_ratio(preds, target)
+        assert out.shape == (4, 3)
+
+    def test_complex_si_snr(self):
+        rng = np.random.RandomState(0)
+        spec = rng.randn(1, 129, 20, 2).astype(np.float32)
+        val = complex_scale_invariant_signal_noise_ratio(jnp.asarray(spec), jnp.asarray(spec))
+        assert float(val[0]) > 50  # perfect prediction -> huge ratio
+        with pytest.raises(RuntimeError, match="expected to have the shape"):
+            complex_scale_invariant_signal_noise_ratio(jnp.zeros((3, 5)), jnp.zeros((3, 5)))
+
+    def test_jit(self):
+        jitted = jax.jit(signal_noise_ratio)
+        assert float(jitted(_PREDS, _TARGET)) == pytest.approx(16.1805, abs=1e-3)
+        jitted_si = jax.jit(scale_invariant_signal_distortion_ratio)
+        assert float(jitted_si(_PREDS, _TARGET)) == pytest.approx(18.4030, abs=1e-3)
+
+
+class TestSDR:
+    def test_reference_fixture(self):
+        # the reference doctest: torch.manual_seed(1); randn(8000) twice -> -12.0589
+        torch.manual_seed(1)
+        preds = torch.randn(8000)
+        target = torch.randn(8000)
+        val = signal_distortion_ratio(jnp.asarray(preds.numpy()), jnp.asarray(target.numpy()))
+        assert float(val) == pytest.approx(-12.0589, abs=5e-3)
+
+    def test_perfect_prediction(self):
+        torch.manual_seed(0)
+        sig = jnp.asarray(torch.randn(4000).numpy())
+        assert float(signal_distortion_ratio(sig, sig)) > 40
+
+    def test_filtered_prediction_high_sdr(self):
+        # SDR projects onto 512 shifts of target: a small-delay echo is fully explainable
+        torch.manual_seed(2)
+        target = torch.randn(4000)
+        echo = 0.7 * target + 0.3 * torch.roll(target, 5)
+        val = signal_distortion_ratio(jnp.asarray(echo.numpy()), jnp.asarray(target.numpy()))
+        assert float(val) > 30
+
+    def test_load_diag(self):
+        torch.manual_seed(3)
+        preds = jnp.asarray(torch.randn(2000).numpy())
+        target = jnp.asarray(torch.randn(2000).numpy())
+        plain = float(signal_distortion_ratio(preds, target))
+        loaded = float(signal_distortion_ratio(preds, target, load_diag=0.01))
+        assert plain == pytest.approx(loaded, abs=1.0)
+
+
+class TestPIT:
+    def test_doctest_fixture(self):
+        preds = jnp.array([[[-0.0579, 0.3560, -0.9604], [-0.1719, 0.3205, 0.2951]]])
+        target = jnp.array([[[1.0958, -0.1648, 0.5228], [-0.4100, 1.1942, -0.5103]]])
+        best_metric, best_perm = permutation_invariant_training(
+            preds, target, scale_invariant_signal_distortion_ratio, eval_func="max"
+        )
+        assert float(best_metric[0]) == pytest.approx(-5.1091, abs=1e-3)
+        np.testing.assert_array_equal(np.asarray(best_perm[0]), [0, 1])
+
+    def test_vs_bruteforce(self):
+        rng = np.random.RandomState(11)
+        batch, spk, time = 3, 3, 50
+        preds = jnp.asarray(rng.randn(batch, spk, time).astype(np.float32))
+        target = jnp.asarray(rng.randn(batch, spk, time).astype(np.float32))
+        best_metric, best_perm = permutation_invariant_training(
+            preds, target, signal_noise_ratio, eval_func="max"
+        )
+        for b in range(batch):
+            scores = {}
+            for perm in permutations(range(spk)):
+                vals = [float(signal_noise_ratio(preds[b, p], target[b, s])) for s, p in enumerate(perm)]
+                scores[perm] = np.mean(vals)
+            expected_perm = max(scores, key=scores.get)
+            assert float(best_metric[b]) == pytest.approx(scores[expected_perm], abs=1e-4)
+            np.testing.assert_array_equal(np.asarray(best_perm[b]), expected_perm)
+
+    def test_permutation_wise_mode(self):
+        rng = np.random.RandomState(5)
+        preds = jnp.asarray(rng.randn(2, 2, 30).astype(np.float32))
+        target = jnp.asarray(rng.randn(2, 2, 30).astype(np.float32))
+        m_speaker, p_speaker = permutation_invariant_training(
+            preds, target, signal_noise_ratio, mode="speaker-wise", eval_func="max"
+        )
+        m_perm, p_perm = permutation_invariant_training(
+            preds, target, signal_noise_ratio, mode="permutation-wise", eval_func="max"
+        )
+        np.testing.assert_allclose(np.asarray(m_speaker), np.asarray(m_perm), atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(p_speaker), np.asarray(p_perm))
+
+    def test_pit_permutate(self):
+        preds = jnp.arange(12.0).reshape(2, 3, 2)
+        perm = jnp.array([[2, 0, 1], [0, 1, 2]])
+        out = pit_permutate(preds, perm)
+        np.testing.assert_array_equal(np.asarray(out[0, 0]), np.asarray(preds[0, 2]))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(preds[1]))
+
+    def test_min_mode(self):
+        rng = np.random.RandomState(8)
+        preds = jnp.asarray(rng.randn(2, 2, 40).astype(np.float32))
+        target = jnp.asarray(rng.randn(2, 2, 40).astype(np.float32))
+        bm_max, _ = permutation_invariant_training(preds, target, signal_noise_ratio, eval_func="max")
+        bm_min, _ = permutation_invariant_training(preds, target, signal_noise_ratio, eval_func="min")
+        assert float(bm_min.sum()) <= float(bm_max.sum())
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="eval_func"):
+            permutation_invariant_training(jnp.zeros((1, 2, 5)), jnp.zeros((1, 2, 5)), signal_noise_ratio, eval_func="bad")
+        with pytest.raises(ValueError, match="mode"):
+            permutation_invariant_training(jnp.zeros((1, 2, 5)), jnp.zeros((1, 2, 5)), signal_noise_ratio, mode="bad")
+        with pytest.raises(RuntimeError, match="same shape"):
+            permutation_invariant_training(jnp.zeros((1, 2, 5)), jnp.zeros((1, 3, 5)), signal_noise_ratio)
+
+
+class TestModular:
+    def test_snr_accumulates(self):
+        metric = SignalNoiseRatio()
+        metric.update(_PREDS, _TARGET)
+        metric.update(_PREDS, _TARGET)
+        assert float(metric.compute()) == pytest.approx(16.1805, abs=1e-3)
+
+    def test_si_sdr_batches_average(self):
+        metric = ScaleInvariantSignalDistortionRatio()
+        rng = np.random.RandomState(1)
+        a_p, a_t = rng.randn(3, 64), rng.randn(3, 64)
+        b_p, b_t = rng.randn(2, 64), rng.randn(2, 64)
+        metric.update(jnp.asarray(a_p), jnp.asarray(a_t))
+        metric.update(jnp.asarray(b_p), jnp.asarray(b_t))
+        all_vals = np.concatenate(
+            [
+                np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(a_p), jnp.asarray(a_t))),
+                np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(b_p), jnp.asarray(b_t))),
+            ]
+        )
+        assert float(metric.compute()) == pytest.approx(float(all_vals.mean()), abs=1e-4)
+
+    def test_sum_state_sync(self):
+        metric = SignalNoiseRatio(
+            dist_sync_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+        metric.update(_PREDS, _TARGET)
+        assert float(metric.compute()) == pytest.approx(16.1805, abs=1e-3)
+
+    def test_pit_modular(self):
+        rng = np.random.RandomState(4)
+        preds = jnp.asarray(rng.randn(2, 2, 30).astype(np.float32))
+        target = jnp.asarray(rng.randn(2, 2, 30).astype(np.float32))
+        metric = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, eval_func="max")
+        metric.update(preds, target)
+        expected = float(
+            permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio)[0].mean()
+        )
+        assert float(metric.compute()) == pytest.approx(expected, abs=1e-4)
+
+    def test_sdr_modular(self):
+        torch.manual_seed(1)
+        preds = jnp.asarray(torch.randn(8000).numpy())
+        target = jnp.asarray(torch.randn(8000).numpy())
+        metric = SignalDistortionRatio()
+        metric.update(preds, target)
+        assert float(metric.compute()) == pytest.approx(-12.0589, abs=5e-3)
+
+    def test_si_snr_modular(self):
+        metric = ScaleInvariantSignalNoiseRatio()
+        metric.update(_PREDS, _TARGET)
+        assert float(metric.compute()) == pytest.approx(15.0918, abs=1e-3)
+
+    def test_pit_routes_metric_options_to_base(self):
+        # kernel Metric options must not leak into metric_func kwargs
+        metric = PermutationInvariantTraining(
+            signal_noise_ratio, eval_func="max", sync_on_compute=False, compute_with_cache=False
+        )
+        assert metric.sync_on_compute is False
+        rng = np.random.RandomState(0)
+        metric.update(jnp.asarray(rng.randn(1, 2, 20)), jnp.asarray(rng.randn(1, 2, 20)))
+        float(metric.compute())
+        # while metric_func kwargs still flow through
+        metric2 = PermutationInvariantTraining(signal_noise_ratio, eval_func="max", zero_mean=True)
+        metric2.update(jnp.asarray(rng.randn(1, 2, 20)), jnp.asarray(rng.randn(1, 2, 20)))
+        float(metric2.compute())
+
+    def test_pesq_stoi_gated(self):
+        from torchmetrics_tpu.utilities.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+        if not _PESQ_AVAILABLE:
+            from torchmetrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
+
+            with pytest.raises(ModuleNotFoundError, match="pesq"):
+                PerceptualEvaluationSpeechQuality(8000, "nb")
+        if not _PYSTOI_AVAILABLE:
+            from torchmetrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility
+
+            with pytest.raises(ModuleNotFoundError, match="pystoi"):
+                ShortTimeObjectiveIntelligibility(8000)
+
+
+def test_exported_from_root():
+    assert tm.SignalNoiseRatio is SignalNoiseRatio
+    assert tm.functional.signal_noise_ratio is signal_noise_ratio

@@ -18,6 +18,10 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The axon site customization force-registers its TPU backend and sets
+# jax_platforms="axon,cpu", overriding the JAX_PLATFORMS env var — pin the config
+# itself so the suite is hermetic on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # Persistent compile cache — repeated test runs skip XLA recompilation.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")

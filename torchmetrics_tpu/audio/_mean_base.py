@@ -1,0 +1,44 @@
+"""Shared running-mean base for the audio metrics.
+
+Every reference audio modular metric keeps the same two sum states and averages at
+compute (``audio/snr.py:86-98``, ``sdr.py:107-121``, ``pit.py:101-115``); this base
+holds that pattern once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _MeanOfBatchValues(Metric):
+    """Accumulate ``value.sum()`` / ``value.size`` sum states and average at compute."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    sum_value: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_value", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update_from_values(self, values: Array) -> None:
+        self.sum_value = self.sum_value + values.sum()
+        self.total = self.total + values.size
+
+    def compute(self) -> Array:
+        """Average over every element seen."""
+        return self.sum_value / self.total
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

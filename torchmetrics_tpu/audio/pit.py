@@ -1,0 +1,48 @@
+"""Modular PermutationInvariantTraining (reference ``audio/pit.py:30-147``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+
+from torchmetrics_tpu.audio._mean_base import _MeanOfBatchValues
+from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(_MeanOfBatchValues):
+    """Average best-permutation metric value; extra kwargs flow to ``metric_func``."""
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        # route every kernel Metric option to the base; the rest feed metric_func
+        _metric_option_names = (
+            "compute_on_cpu",
+            "dist_sync_on_step",
+            "process_group",
+            "dist_sync_fn",
+            "distributed_available_fn",
+            "sync_on_compute",
+            "compute_with_cache",
+        )
+        base_kwargs: Dict[str, Any] = {
+            name: kwargs.pop(name) for name in _metric_option_names if name in kwargs
+        }
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+
+    def update(self, preds: Array, target: Array) -> None:
+        best_metric = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.kwargs
+        )[0]
+        self._update_from_values(best_metric)

@@ -1,0 +1,53 @@
+"""Modular SDR metrics (reference ``audio/sdr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from torchmetrics_tpu.audio._mean_base import _MeanOfBatchValues
+from torchmetrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+
+Array = jax.Array
+
+
+class SignalDistortionRatio(_MeanOfBatchValues):
+    """Average SDR (reference ``sdr.py:29-162``)."""
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_from_values(
+            signal_distortion_ratio(
+                preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+            )
+        )
+
+
+class ScaleInvariantSignalDistortionRatio(_MeanOfBatchValues):
+    """Average SI-SDR (reference ``sdr.py:163-246``)."""
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_from_values(
+            scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        )

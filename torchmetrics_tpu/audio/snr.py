@@ -1,0 +1,52 @@
+"""Modular SNR metrics (reference ``audio/snr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.audio._mean_base import _MeanOfBatchValues
+from torchmetrics_tpu.functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(_MeanOfBatchValues):
+    """Average SNR over all seen samples (reference ``snr.py:35-139``)."""
+
+    plot_lower_bound = None
+    plot_upper_bound = None
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_from_values(signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean))
+
+
+class ScaleInvariantSignalNoiseRatio(_MeanOfBatchValues):
+    """Average SI-SNR (reference ``snr.py:142-237``)."""
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_from_values(scale_invariant_signal_noise_ratio(preds=preds, target=target))
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_MeanOfBatchValues):
+    """Average C-SI-SNR (reference ``snr.py:239-330``)."""
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_from_values(
+            complex_scale_invariant_signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        )

@@ -1,0 +1,34 @@
+"""Modular STOI (reference ``audio/stoi.py:29-157``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.audio._mean_base import _MeanOfBatchValues
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+from torchmetrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(_MeanOfBatchValues):
+    """Average STOI via the external ``pystoi`` package (host DSP, as in the reference)."""
+
+    is_differentiable = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
+                " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+
+    def update(self, preds: Array, target: Array) -> None:
+        self._update_from_values(short_time_objective_intelligibility(preds, target, self.fs, self.extended, False))

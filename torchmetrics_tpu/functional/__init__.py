@@ -4,6 +4,8 @@ Flat re-export of all domain functionals so ``from torchmetrics_tpu.functional i
 accuracy`` works like the reference's ``torchmetrics.functional`` namespace.
 """
 
+from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.audio import __all__ as _audio_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
@@ -18,7 +20,8 @@ from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = (
-    list(_classification_all)
+    list(_audio_all)
+    + list(_classification_all)
     + list(_detection_all)
     + list(_regression_all)
     + list(_retrieval_all)

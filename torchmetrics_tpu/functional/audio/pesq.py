@@ -1,0 +1,58 @@
+"""PESQ wrapper (reference ``functional/audio/pesq.py``).
+
+Like the reference, this delegates to the external ``pesq`` C extension on host — the
+ITU-T P.862 pipeline is a fixed DSP spec, not accelerator math. Gated on availability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+__doctest_requires__ = {("perceptual_evaluation_speech_quality",): ["pesq"]}
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """PESQ score per sample via the ``pesq`` package (reference ``pesq.py:24-91``)."""
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+
+    if preds.ndim == 1:
+        pesq_val_np = pesq_backend.pesq(fs, np.asarray(target), np.asarray(preds), mode)
+        pesq_val = jnp.asarray(pesq_val_np)
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        if n_processes != 1:
+            pesq_val_np = pesq_backend.pesq_batch(fs, target_np, preds_np, mode, n_processor=n_processes)
+            pesq_val_np = np.array(pesq_val_np)
+        else:
+            pesq_val_np = np.empty(shape=(preds_np.shape[0]))
+            for b in range(preds_np.shape[0]):
+                pesq_val_np[b] = pesq_backend.pesq(fs, target_np[b, :], preds_np[b, :], mode)
+        pesq_val = jnp.asarray(pesq_val_np).reshape(preds.shape[:-1])
+
+    return pesq_val

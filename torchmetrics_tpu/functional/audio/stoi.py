@@ -1,0 +1,42 @@
+"""STOI wrapper (reference ``functional/audio/stoi.py``).
+
+Delegates to the external ``pystoi`` package on host, like the reference. Gated on
+availability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+__doctest_requires__ = {("short_time_objective_intelligibility",): ["pystoi"]}
+
+
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> Array:
+    """STOI score per sample via ``pystoi`` (reference ``stoi.py:22-86``)."""
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that pystoi is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    _check_same_shape(preds, target)
+
+    if preds.ndim == 1:
+        stoi_val_np = stoi_backend(np.asarray(target), np.asarray(preds), fs, extended)
+        return jnp.asarray(stoi_val_np)
+    preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+    target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+    stoi_val_np = np.empty(shape=(preds_np.shape[0]))
+    for b in range(preds_np.shape[0]):
+        stoi_val_np[b] = stoi_backend(target_np[b, :], preds_np[b, :], fs, extended)
+    return jnp.asarray(stoi_val_np).reshape(preds.shape[:-1])
