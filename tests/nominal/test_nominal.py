@@ -1,0 +1,261 @@
+"""Nominal domain tests.
+
+Goldens: scipy.stats.contingency.association for the chi-square family (with matching
+correction settings), reference doctest fixtures reproduced via torch seeds, and the
+statsmodels-style Fleiss kappa closed form recomputed independently.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from scipy.stats.contingency import association, crosstab
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    pearsons_contingency_coefficient_matrix,
+    theils_u,
+    theils_u_matrix,
+    tschuprows_t,
+    tschuprows_t_matrix,
+)
+from torchmetrics_tpu.nominal import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+
+def _doctest_pair():
+    torch.manual_seed(42)
+    preds = torch.randint(0, 4, (100,))
+    target = torch.round(preds + torch.randn(100)).clamp(0, 4)
+    return jnp.asarray(preds.numpy()), jnp.asarray(target.numpy().astype(np.int64))
+
+
+class TestVsScipy:
+    """bias_correction=False matches scipy association(correction=False) exactly."""
+
+    def _random_pair(self, seed=0, n=300, k=5):
+        rng = np.random.RandomState(seed)
+        return rng.randint(0, k, n), rng.randint(0, k, n)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_cramers(self, seed):
+        x, y = self._random_pair(seed)
+        ours = float(cramers_v(jnp.asarray(x), jnp.asarray(y), bias_correction=False))
+        table = crosstab(x, y).count
+        assert ours == pytest.approx(association(table, method="cramer", correction=False), abs=1e-5)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_tschuprow(self, seed):
+        x, y = self._random_pair(seed)
+        ours = float(tschuprows_t(jnp.asarray(x), jnp.asarray(y), bias_correction=False))
+        table = crosstab(x, y).count
+        assert ours == pytest.approx(association(table, method="tschuprow", correction=False), abs=1e-5)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_pearson(self, seed):
+        x, y = self._random_pair(seed)
+        ours = float(pearsons_contingency_coefficient(jnp.asarray(x), jnp.asarray(y)))
+        table = crosstab(x, y).count
+        assert ours == pytest.approx(association(table, method="pearson", correction=False), abs=1e-5)
+
+
+class TestDoctestFixtures:
+    def test_cramers_doctest(self):
+        preds, target = _doctest_pair()
+        assert float(cramers_v(preds, target)) == pytest.approx(0.5284, abs=1e-3)
+
+    def test_pearson_doctest(self):
+        preds, target = _doctest_pair()
+        assert float(pearsons_contingency_coefficient(preds, target)) == pytest.approx(0.6948, abs=1e-3)
+
+    def test_tschuprow_doctest(self):
+        preds, target = _doctest_pair()
+        assert float(tschuprows_t(preds, target)) == pytest.approx(0.4930, abs=1e-3)
+
+    def test_theils_u_doctest(self):
+        torch.manual_seed(42)
+        preds = torch.randint(10, (10,))
+        target = torch.randint(10, (10,))
+        val = float(theils_u(jnp.asarray(preds.numpy()), jnp.asarray(target.numpy())))
+        assert val == pytest.approx(0.8530, abs=1e-3)
+
+    def test_fleiss_counts_doctest(self):
+        torch.manual_seed(42)
+        ratings = torch.randint(0, 10, size=(100, 5)).long()
+        assert float(fleiss_kappa(jnp.asarray(ratings.numpy()))) == pytest.approx(0.0089, abs=1e-3)
+
+    def test_fleiss_probs_doctest(self):
+        torch.manual_seed(42)
+        ratings = torch.randn(100, 5, 10).softmax(dim=1)
+        val = float(fleiss_kappa(jnp.asarray(ratings.numpy()), mode="probs"))
+        assert val == pytest.approx(-0.0105, abs=2e-3)
+
+
+class TestFleissClosedForm:
+    def test_perfect_agreement(self):
+        # raters agree perfectly while categories vary across samples -> kappa ~ 1
+        counts = np.zeros((20, 4), dtype=np.int64)
+        counts[:10, 0] = 10
+        counts[10:, 1] = 10
+        assert float(fleiss_kappa(jnp.asarray(counts))) == pytest.approx(1.0, abs=1e-3)
+
+    def test_degenerate_single_category_is_zero(self):
+        # every rater picks the same single category: kappa is 0/0, and the
+        # reference's +1e-5 guard resolves it to 0
+        counts = np.zeros((20, 4), dtype=np.int64)
+        counts[:, 0] = 10
+        assert float(fleiss_kappa(jnp.asarray(counts))) == pytest.approx(0.0, abs=1e-3)
+
+    def test_wikipedia_example(self):
+        # the classic Fleiss 1971 worked example: kappa = 0.210
+        counts = np.array(
+            [
+                [0, 0, 0, 0, 14],
+                [0, 2, 6, 4, 2],
+                [0, 0, 3, 5, 6],
+                [0, 3, 9, 2, 0],
+                [2, 2, 8, 1, 1],
+                [7, 7, 0, 0, 0],
+                [3, 2, 6, 3, 0],
+                [2, 5, 3, 2, 2],
+                [6, 5, 2, 1, 0],
+                [0, 2, 2, 3, 7],
+            ]
+        )
+        assert float(fleiss_kappa(jnp.asarray(counts))) == pytest.approx(0.210, abs=1e-3)
+
+    def test_mode_validation(self):
+        with pytest.raises(ValueError, match="mode"):
+            fleiss_kappa(jnp.zeros((5, 3), dtype=jnp.int32), mode="bad")
+        with pytest.raises(ValueError, match="probs"):
+            fleiss_kappa(jnp.zeros((5, 3)), mode="probs")
+        with pytest.raises(ValueError, match="counts"):
+            fleiss_kappa(jnp.zeros((5, 3, 2)), mode="counts")
+
+
+class TestMatrixVariants:
+    def _matrix(self, seed=3):
+        rng = np.random.RandomState(seed)
+        return jnp.asarray(rng.randint(0, 4, (200, 4)))
+
+    def test_cramers_matrix(self):
+        mat = self._matrix()
+        out = cramers_v_matrix(mat, bias_correction=False)
+        assert out.shape == (4, 4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out).T, atol=1e-6)
+        assert float(out[0, 0]) == 1.0
+        expected = float(cramers_v(mat[:, 0], mat[:, 1], bias_correction=False))
+        assert float(out[0, 1]) == pytest.approx(expected, abs=1e-5)
+
+    def test_theils_matrix_asymmetric(self):
+        mat = self._matrix(seed=4)
+        out = theils_u_matrix(mat)
+        assert out.shape == (4, 4)
+        expected_01 = float(theils_u(mat[:, 0], mat[:, 1]))
+        assert float(out[0, 1]) == pytest.approx(expected_01, abs=1e-5)
+
+    def test_pearson_and_tschuprow_matrix(self):
+        mat = self._matrix(seed=5)
+        p = pearsons_contingency_coefficient_matrix(mat)
+        t = tschuprows_t_matrix(mat, bias_correction=False)
+        assert p.shape == t.shape == (4, 4)
+
+
+class TestModular:
+    def test_cramers_accumulates(self):
+        preds, target = _doctest_pair()
+        metric = CramersV(num_classes=5)
+        metric.update(preds[:50], target[:50])
+        metric.update(preds[50:], target[50:])
+        assert float(metric.compute()) == pytest.approx(float(cramers_v(preds, target)), abs=1e-5)
+
+    def test_theils_modular(self):
+        preds, target = _doctest_pair()
+        metric = TheilsU(num_classes=5)
+        metric.update(preds, target)
+        assert float(metric.compute()) == pytest.approx(float(theils_u(preds, target)), abs=1e-4)
+
+    def test_pearson_modular(self):
+        preds, target = _doctest_pair()
+        metric = PearsonsContingencyCoefficient(num_classes=5)
+        metric.update(preds, target)
+        assert float(metric.compute()) == pytest.approx(0.6948, abs=1e-3)
+
+    def test_tschuprow_modular(self):
+        preds, target = _doctest_pair()
+        metric = TschuprowsT(num_classes=5)
+        metric.update(preds, target)
+        assert float(metric.compute()) == pytest.approx(0.4930, abs=1e-3)
+
+    def test_fleiss_modular(self):
+        torch.manual_seed(42)
+        ratings = torch.randint(0, 10, size=(100, 5)).long().numpy()
+        metric = FleissKappa()
+        metric.update(jnp.asarray(ratings[:40]))
+        metric.update(jnp.asarray(ratings[40:]))
+        assert float(metric.compute()) == pytest.approx(0.0089, abs=1e-3)
+
+    def test_confmat_sum_sync(self):
+        # a 2-way gather of identical shards equals seeing the data twice locally
+        preds, target = _doctest_pair()
+        twice = CramersV(num_classes=5)
+        twice.update(preds, target)
+        twice.update(preds, target)
+        expected = float(twice.compute())
+        synced = CramersV(
+            num_classes=5,
+            dist_sync_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+        synced.update(preds, target)
+        assert float(synced.compute()) == pytest.approx(expected, abs=1e-6)
+
+    def test_non_contiguous_labels(self):
+        # arbitrary category codings must give identical statistics to dense codings
+        rng = np.random.RandomState(7)
+        dense_p, dense_t = rng.randint(0, 4, 200), rng.randint(0, 4, 200)
+        for offset, scale in ((1, 1), (0, 5), (10, 3)):
+            shifted_p = jnp.asarray(dense_p * scale + offset)
+            shifted_t = jnp.asarray(dense_t * scale + offset)
+            for fn in (cramers_v, tschuprows_t):
+                a = float(fn(jnp.asarray(dense_p), jnp.asarray(dense_t), bias_correction=False))
+                b = float(fn(shifted_p, shifted_t, bias_correction=False))
+                assert a == pytest.approx(b, abs=1e-6), (fn.__name__, offset, scale)
+            a = float(theils_u(jnp.asarray(dense_p), jnp.asarray(dense_t)))
+            b = float(theils_u(shifted_p, shifted_t))
+            assert a == pytest.approx(b, abs=1e-6)
+
+    def test_theils_matrix_matches_transpose_identity(self):
+        rng = np.random.RandomState(9)
+        mat = jnp.asarray(rng.randint(0, 3, (150, 3)))
+        out = theils_u_matrix(mat)
+        # U(j|i) must equal theils_u called with swapped columns
+        for i, j in ((0, 1), (1, 2), (2, 0)):
+            expected = float(theils_u(mat[:, i], mat[:, j]))
+            assert float(out[i, j]) == pytest.approx(expected, abs=1e-5)
+
+    def test_nan_strategies(self):
+        # tiny-sample bias correction legitimately degenerates (reference parity),
+        # so check the NaN handling with bias_correction=False
+        preds = jnp.array([0.0, 1.0, float("nan"), 2.0])
+        target = jnp.array([0.0, 1.0, 1.0, 2.0])
+        drop = cramers_v(preds, target, bias_correction=False, nan_strategy="drop")
+        replace = cramers_v(preds, target, bias_correction=False, nan_strategy="replace", nan_replace_value=0.0)
+        assert float(drop) == pytest.approx(1.0, abs=1e-5)  # 3 clean rows match exactly
+        assert np.isfinite(float(replace))
+        with pytest.raises(ValueError, match="nan_strategy"):
+            cramers_v(preds, target, nan_strategy="bad")
+
+
+def test_exported_from_root():
+    assert tm.CramersV is CramersV
+    assert tm.functional.cramers_v is cramers_v

@@ -1,0 +1,115 @@
+"""Pairwise + multimodal tests. Goldens: scipy.spatial.distance.cdist."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+from torchmetrics_tpu.multimodal import CLIPScore
+from torchmetrics_tpu.functional.multimodal import clip_score
+
+_RNG = np.random.RandomState(0)
+_X = _RNG.randn(7, 5).astype(np.float64)
+_Y = _RNG.randn(4, 5).astype(np.float64)
+
+
+class TestVsScipyCdist:
+    def test_euclidean(self):
+        ours = np.asarray(pairwise_euclidean_distance(jnp.asarray(_X), jnp.asarray(_Y)))
+        np.testing.assert_allclose(ours, cdist(_X, _Y, metric="euclidean"), atol=1e-5)
+
+    def test_manhattan(self):
+        ours = np.asarray(pairwise_manhattan_distance(jnp.asarray(_X), jnp.asarray(_Y)))
+        np.testing.assert_allclose(ours, cdist(_X, _Y, metric="cityblock"), atol=1e-6)
+
+    def test_cosine(self):
+        ours = np.asarray(pairwise_cosine_similarity(jnp.asarray(_X), jnp.asarray(_Y)))
+        np.testing.assert_allclose(ours, 1 - cdist(_X, _Y, metric="cosine"), atol=1e-6)
+
+    def test_minkowski(self):
+        ours = np.asarray(pairwise_minkowski_distance(jnp.asarray(_X), jnp.asarray(_Y), exponent=3))
+        np.testing.assert_allclose(ours, cdist(_X, _Y, metric="minkowski", p=3), atol=1e-5)
+
+    def test_linear(self):
+        ours = np.asarray(pairwise_linear_similarity(jnp.asarray(_X), jnp.asarray(_Y)))
+        np.testing.assert_allclose(ours, _X @ _Y.T, atol=1e-6)
+
+
+class TestOptions:
+    def test_self_similarity_zero_diagonal_default(self):
+        out = np.asarray(pairwise_euclidean_distance(jnp.asarray(_X)))
+        np.testing.assert_allclose(np.diag(out), 0.0, atol=1e-6)
+        out_keep = np.asarray(pairwise_cosine_similarity(jnp.asarray(_X), zero_diagonal=False))
+        np.testing.assert_allclose(np.diag(out_keep), 1.0, atol=1e-6)
+
+    def test_reduction(self):
+        full = np.asarray(pairwise_manhattan_distance(jnp.asarray(_X), jnp.asarray(_Y)))
+        mean = np.asarray(pairwise_manhattan_distance(jnp.asarray(_X), jnp.asarray(_Y), reduction="mean"))
+        ssum = np.asarray(pairwise_manhattan_distance(jnp.asarray(_X), jnp.asarray(_Y), reduction="sum"))
+        np.testing.assert_allclose(mean, full.mean(-1), atol=1e-6)
+        np.testing.assert_allclose(ssum, full.sum(-1), atol=1e-6)
+        with pytest.raises(ValueError, match="reduction"):
+            pairwise_euclidean_distance(jnp.asarray(_X), reduction="bad")
+
+    def test_input_validation(self):
+        with pytest.raises(ValueError, match="2D tensor"):
+            pairwise_euclidean_distance(jnp.zeros((3,)))
+        with pytest.raises(ValueError, match="same as the last dimension"):
+            pairwise_euclidean_distance(jnp.zeros((3, 4)), jnp.zeros((3, 5)))
+
+    def test_jit(self):
+        jitted = jax.jit(lambda a, b: pairwise_euclidean_distance(a, b))
+        out = np.asarray(jitted(jnp.asarray(_X, dtype=jnp.float32), jnp.asarray(_Y, dtype=jnp.float32)))
+        np.testing.assert_allclose(out, cdist(_X, _Y), atol=1e-4)
+
+
+def _fake_embed(images, text):
+    # deterministic embedder: image mean-pools to a vector, text hashes to the same
+    # vector when the caption matches the image index encoded in its pixel values
+    img_feats = jnp.stack([jnp.full((8,), float(jnp.mean(i))) for i in images])
+    txt_feats = jnp.stack([jnp.full((8,), float(len(t))) for t in text])
+    return img_feats, txt_feats
+
+
+class TestCLIPScore:
+    def test_injected_embedder_perfect_match(self):
+        images = [jnp.ones((3, 4, 4)) * 2.0]
+        # same direction -> cosine 1 -> score 100
+        score = clip_score(images, ["ab"], embed_fn=_fake_embed)
+        assert float(score) == pytest.approx(100.0, abs=1e-4)
+
+    def test_modular_accumulates(self):
+        metric = CLIPScore(embed_fn=_fake_embed)
+        metric.update([jnp.ones((3, 4, 4))], ["xy"])
+        metric.update([jnp.ones((3, 4, 4))], ["pq"])
+        assert float(metric.compute()) == pytest.approx(100.0, abs=1e-4)
+        assert int(metric.n_samples) == 2
+
+    def test_clamped_at_zero(self):
+        def _anti_embed(images, text):
+            img = jnp.ones((len(images), 4))
+            return img, -img  # opposite direction -> cosine -1 -> clamped to 0
+
+        score = clip_score([jnp.ones((3, 2, 2))], ["a"], embed_fn=_anti_embed)
+        assert float(score) == 0.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="same"):
+            clip_score([jnp.ones((3, 2, 2))], ["a", "b"], embed_fn=_fake_embed)
+        with pytest.raises(ValueError, match="3d"):
+            clip_score([jnp.ones((2, 2))], ["a"], embed_fn=_fake_embed)
+
+
+def test_exported_from_root():
+    assert tm.CLIPScore is CLIPScore
+    assert tm.functional.pairwise_cosine_similarity is pairwise_cosine_similarity
+    assert tm.functional.clip_score is clip_score

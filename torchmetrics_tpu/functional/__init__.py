@@ -12,6 +12,12 @@ from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.detection import __all__ as _detection_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
+from torchmetrics_tpu.functional.multimodal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.multimodal import __all__ as _multimodal_all
+from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
+from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.pairwise import __all__ as _pairwise_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
@@ -23,6 +29,9 @@ __all__ = (
     list(_audio_all)
     + list(_classification_all)
     + list(_detection_all)
+    + list(_multimodal_all)
+    + list(_nominal_all)
+    + list(_pairwise_all)
     + list(_regression_all)
     + list(_retrieval_all)
     + list(_image_all)

@@ -1,0 +1,5 @@
+"""Functional multimodal metrics (reference ``src/torchmetrics/functional/multimodal/__init__.py``)."""
+
+from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
+
+__all__ = ["clip_score"]

@@ -1,0 +1,95 @@
+"""CLIPScore functional (reference ``functional/multimodal/clip_score.py``).
+
+The embedding backend is an injection point: pass ``model``/``processor`` callables (any
+image/text towers returning embeddings) and the metric core — L2-normalize, cosine, x100
+— runs in jnp. The default backend loads the HF ``CLIPModel`` like the reference
+(``clip_score.py:24-96``), gated on ``transformers`` availability; the zero-download
+injected path keeps the metric testable without weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+_DEFAULT_MODEL = "openai/clip-vit-large-patch14"
+
+
+def _get_model_and_processor(model_name_or_path: str = _DEFAULT_MODEL) -> Tuple[Any, Any]:
+    """HF CLIP towers (reference ``clip_score.py:79-96``)."""
+    if _TRANSFORMERS_AVAILABLE:
+        from transformers import CLIPModel, CLIPProcessor
+
+        return CLIPModel.from_pretrained(model_name_or_path), CLIPProcessor.from_pretrained(model_name_or_path)
+    raise ModuleNotFoundError(
+        "`clip_score` metric requires `transformers` package be installed."
+        " Either install with `pip install transformers>=4.0` or `pip install torchmetrics[multimodal]`."
+    )
+
+
+def _hf_embed(images: List[Array], text: List[str], model: Any, processor: Any) -> Tuple[Array, Array]:
+    """Run the HF towers on host and return (img_features, txt_features) as jnp arrays."""
+    import torch
+
+    processed = processor(
+        text=text, images=[np.asarray(i) for i in images], return_tensors="pt", padding=True
+    )
+    with torch.no_grad():
+        img_features = model.get_image_features(processed["pixel_values"]).numpy()
+        txt_features = model.get_text_features(processed["input_ids"], processed["attention_mask"]).numpy()
+    return jnp.asarray(img_features), jnp.asarray(txt_features)
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model: Any,
+    processor: Any,
+    embed_fn: Optional[Callable[[List[Array], List[str]], Tuple[Array, Array]]] = None,
+) -> Tuple[Array, int]:
+    """Per-pair 100 x cosine similarity (reference ``clip_score.py:41-76``)."""
+    if not isinstance(images, list):
+        images = [images] if images.ndim == 3 else list(images)
+    else:
+        images = list(images)
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+
+    if embed_fn is not None:
+        img_features, txt_features = embed_fn(images, text)
+    else:
+        img_features, txt_features = _hf_embed(images, text, model, processor)
+
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+    score = 100 * (img_features * txt_features).sum(axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: str = _DEFAULT_MODEL,
+    embed_fn: Optional[Callable[[List[Array], List[str]], Tuple[Array, Array]]] = None,
+) -> Array:
+    r"""CLIPScore(I, C) = max(100 * cos(E_I, E_C), 0) averaged over pairs (reference ``clip_score.py:99-151``)."""
+    if embed_fn is None:
+        model, processor = _get_model_and_processor(model_name_or_path)
+    else:
+        model = processor = None
+    score, _ = _clip_score_update(images, text, model, processor, embed_fn)
+    score = score.mean(0)
+    return jnp.maximum(score, jnp.zeros_like(score))

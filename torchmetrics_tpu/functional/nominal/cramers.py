@@ -1,0 +1,92 @@
+"""Cramer's V (reference ``functional/nominal/cramers.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _nominal_bins_update,
+    _nominal_dense_update,
+    _nominal_input_validation,
+    _pairwise_matrix,
+    _unable_to_use_bias_correction_warning,
+)
+
+Array = jax.Array
+
+
+def _cramers_v_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Fold a batch into the confusion matrix (reference ``cramers.py:33-55``)."""
+    return _nominal_bins_update(
+        preds, target, num_classes, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    """V = sqrt(phi^2 / min(r-1, c-1)), optionally bias-corrected (reference ``cramers.py:58-88``)."""
+    cm = _drop_empty_rows_and_cols(np.asarray(confmat, dtype=np.float64))
+    cm_sum = cm.sum()
+    chi_squared = _compute_chi_squared(cm, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    n_rows, n_cols = cm.shape
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, n_rows, n_cols, cm_sum
+        )
+        if min(rows_corrected, cols_corrected) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
+            return jnp.asarray(float("nan"))
+        value = np.sqrt(phi_squared_corrected / min(rows_corrected - 1, cols_corrected - 1))
+    else:
+        value = np.sqrt(phi_squared / min(n_rows - 1, n_cols - 1))
+    return jnp.asarray(np.clip(value, 0.0, 1.0), dtype=jnp.float32)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Cramer's V association between two categorical series (reference ``cramers.py:91-141``).
+
+    Category values may be arbitrary (floats, non-contiguous ints): they are densified
+    before binning, unlike the reference which requires 0..k-1 codes.
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_dense_update(
+        preds, target, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Pairwise Cramer's V over dataset columns (reference ``cramers.py:144-183``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+
+    def _stat(x: Array, y: Array) -> Array:
+        confmat = _nominal_dense_update(x, y, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update)
+        return _cramers_v_compute(confmat, bias_correction)
+
+    return _pairwise_matrix(matrix, _stat)

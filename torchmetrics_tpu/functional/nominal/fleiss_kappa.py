@@ -1,0 +1,51 @@
+"""Fleiss' kappa (reference ``functional/nominal/fleiss_kappa.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
+    """Normalize ratings to a per-sample category-count matrix (reference ``fleiss_kappa.py:20-46``)."""
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        n_categories = ratings.shape[1]
+        picked = ratings.argmax(axis=1)  # (n_samples, n_raters)
+        one_hot = jax.nn.one_hot(picked, n_categories, axis=-1)  # (n_samples, n_raters, n_categories)
+        return one_hot.sum(axis=1).astype(jnp.int32)
+    if mode == "counts" and (ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating)):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    """kappa = (p_bar - pe_bar) / (1 - pe_bar) over the counts matrix (reference ``fleiss_kappa.py:49-66``)."""
+    counts = jnp.asarray(counts, dtype=jnp.float32)
+    total = counts.shape[0]
+    n_rater = counts.sum(axis=1)
+    num_raters = n_rater.max()
+
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = ((counts**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = (p_i**2).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    r"""Fleiss' kappa inter-rater agreement (reference ``fleiss_kappa.py:69-110``)."""
+    if mode not in ("counts", "probs"):
+        raise ValueError("Argument ``mode`` must be one of ['counts', 'probs']")
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

@@ -1,0 +1,76 @@
+"""Pearson's contingency coefficient (reference ``functional/nominal/pearson.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _nominal_bins_update,
+    _nominal_dense_update,
+    _nominal_input_validation,
+    _pairwise_matrix,
+)
+
+Array = jax.Array
+
+
+def _pearsons_contingency_coefficient_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Fold a batch into the confusion matrix (reference ``pearson.py:30-52``)."""
+    return _nominal_bins_update(
+        preds, target, num_classes, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """sqrt(phi^2 / (1 + phi^2)) (reference ``pearson.py:55-70``)."""
+    cm = _drop_empty_rows_and_cols(np.asarray(confmat, dtype=np.float64))
+    cm_sum = cm.sum()
+    chi_squared = _compute_chi_squared(cm, bias_correction=False)
+    phi_squared = chi_squared / cm_sum
+    value = np.sqrt(phi_squared / (1 + phi_squared))
+    return jnp.asarray(np.clip(value, 0.0, 1.0), dtype=jnp.float32)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Pearson's contingency coefficient between two categorical series (reference ``pearson.py:73-127``).
+
+    Category values may be arbitrary; they are densified before binning.
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_dense_update(
+        preds, target, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Pairwise contingency coefficients over dataset columns (reference ``pearson.py:130-169``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+
+    def _stat(x: Array, y: Array) -> Array:
+        confmat = _nominal_dense_update(x, y, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update)
+        return _pearsons_contingency_coefficient_compute(confmat)
+
+    return _pairwise_matrix(matrix, _stat)

@@ -1,0 +1,97 @@
+"""Theil's U (uncertainty coefficient; reference ``functional/nominal/theils_u.py``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from torchmetrics_tpu.functional.nominal.utils import (
+    _drop_empty_rows_and_cols,
+    _nominal_bins_update,
+    _nominal_dense_update,
+    _nominal_input_validation,
+)
+
+Array = jax.Array
+
+
+def _conditional_entropy_compute(confmat: np.ndarray) -> float:
+    """H(X|Y) from the contingency table (reference ``theils_u.py:29-52``)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    total = confmat.sum()
+    p_xy = confmat / total
+    p_y = confmat.sum(1) / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = p_xy * np.log(p_y[:, None] / p_xy)
+    return float(np.nansum(terms))
+
+
+def _theils_u_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Fold a batch into the confusion matrix (reference ``theils_u.py:55-77``)."""
+    return _nominal_bins_update(
+        preds, target, num_classes, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """U = (H(X) - H(X|Y)) / H(X) (reference ``theils_u.py:80-103``)."""
+    cm = _drop_empty_rows_and_cols(np.asarray(confmat, dtype=np.float64))
+    s_xy = _conditional_entropy_compute(cm)
+    total = cm.sum()
+    p_x = cm.sum(0) / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s_x = -float(np.nansum(p_x * np.log(p_x)))
+    if s_x == 0:
+        return jnp.asarray(0.0, dtype=jnp.float32)
+    return jnp.asarray((s_x - s_xy) / s_x, dtype=jnp.float32)
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Theil's U: how much knowing ``target`` reduces uncertainty in ``preds``.
+
+    Asymmetric: ``U(preds|target) != U(target|preds)`` (reference ``theils_u.py:106-147``).
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_dense_update(
+        preds, target, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+    return _theils_u_compute(confmat)
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Full (asymmetric) pairwise Theil's U matrix over dataset columns (reference ``theils_u.py:150-190``).
+
+    One confusion matrix per unordered column pair: ``U(j|i)`` is computed from the
+    transposed ``(i, j)`` table, halving the device scatters vs. iterating permutations.
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        confmat = _nominal_dense_update(
+            matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+        )
+        out[i, j] = float(_theils_u_compute(confmat))
+        out[j, i] = float(_theils_u_compute(confmat.T))
+    return jnp.asarray(out)

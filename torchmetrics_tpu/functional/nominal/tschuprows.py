@@ -1,0 +1,91 @@
+"""Tschuprow's T (reference ``functional/nominal/tschuprows.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _nominal_bins_update,
+    _nominal_dense_update,
+    _nominal_input_validation,
+    _pairwise_matrix,
+    _unable_to_use_bias_correction_warning,
+)
+
+Array = jax.Array
+
+
+def _tschuprows_t_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Fold a batch into the confusion matrix (reference ``tschuprows.py:32-54``)."""
+    return _nominal_bins_update(
+        preds, target, num_classes, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    """T = sqrt(phi^2 / sqrt((r-1)(c-1))), optionally bias-corrected (reference ``tschuprows.py:57-85``)."""
+    cm = _drop_empty_rows_and_cols(np.asarray(confmat, dtype=np.float64))
+    cm_sum = cm.sum()
+    chi_squared = _compute_chi_squared(cm, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    n_rows, n_cols = cm.shape
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, n_rows, n_cols, cm_sum
+        )
+        if min(rows_corrected, cols_corrected) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
+            return jnp.asarray(float("nan"))
+        value = np.sqrt(phi_squared_corrected / np.sqrt((rows_corrected - 1) * (cols_corrected - 1)))
+    else:
+        value = np.sqrt(phi_squared / np.sqrt((n_rows - 1) * (n_cols - 1)))
+    return jnp.asarray(np.clip(value, 0.0, 1.0), dtype=jnp.float32)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Tschuprow's T association between two categorical series (reference ``tschuprows.py:88-143``).
+
+    Category values may be arbitrary; they are densified before binning.
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _nominal_dense_update(
+        preds, target, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update
+    )
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    r"""Pairwise Tschuprow's T over dataset columns (reference ``tschuprows.py:146-186``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+
+    def _stat(x: Array, y: Array) -> Array:
+        confmat = _nominal_dense_update(x, y, nan_strategy, nan_replace_value, _multiclass_confusion_matrix_update)
+        return _tschuprows_t_compute(confmat, bias_correction)
+
+    return _pairwise_matrix(matrix, _stat)

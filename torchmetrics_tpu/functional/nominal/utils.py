@@ -1,0 +1,159 @@
+"""Shared machinery for the nominal-association statistics (reference ``functional/nominal/utils.py``).
+
+Confusion-matrix accumulation runs on device; the association statistics themselves are
+epoch-end scalars over a (classes x classes) table whose empty rows/columns must be
+dropped (data-dependent shape), so the compute stage runs on host numpy — one tiny
+matrix, fetched once.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[Union[int, float]]) -> None:
+    if nan_strategy not in ["replace", "drop"]:
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (int, float)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace or drop NaN rows (reference ``utils.py:114-144``)."""
+    if nan_strategy == "replace":
+        return jnp.nan_to_num(preds, nan=nan_replace_value), jnp.nan_to_num(target, nan=nan_replace_value)
+    keep = ~(jnp.isnan(preds) | jnp.isnan(target))
+    return preds[keep], target[keep]
+
+
+def _drop_empty_rows_and_cols(confmat: np.ndarray) -> np.ndarray:
+    """Drop all-zero rows and columns (reference ``utils.py:60-79``)."""
+    confmat = confmat[confmat.sum(1) != 0]
+    return confmat[:, confmat.sum(0) != 0]
+
+
+def _compute_expected_freqs(confmat: np.ndarray) -> np.ndarray:
+    """Outer product of the margins over the total (reference ``utils.py:34-38``)."""
+    margin_rows, margin_cols = confmat.sum(1), confmat.sum(0)
+    return np.outer(margin_rows, margin_cols) / confmat.sum()
+
+
+def _compute_chi_squared(confmat: np.ndarray, bias_correction: bool) -> float:
+    """Chi-square test of independence, scipy-style Yates correction at df=1 (reference ``utils.py:41-57``)."""
+    expected_freqs = _compute_expected_freqs(confmat)
+    df = expected_freqs.size - sum(expected_freqs.shape) + expected_freqs.ndim - 1
+    if df == 0:
+        return 0.0
+    if df == 1 and bias_correction:
+        diff = expected_freqs - confmat
+        direction = np.sign(diff)
+        confmat = confmat + direction * np.minimum(0.5, np.abs(diff))
+    return float(np.sum((confmat - expected_freqs) ** 2 / expected_freqs))
+
+
+def _compute_phi_squared_corrected(phi_squared: float, n_rows: int, n_cols: int, cm_sum: float) -> float:
+    """Bias-corrected phi squared (reference ``utils.py:82-92``)."""
+    return max(0.0, phi_squared - ((n_rows - 1) * (n_cols - 1)) / (cm_sum - 1))
+
+
+def _compute_rows_and_cols_corrected(n_rows: int, n_cols: int, cm_sum: float) -> Tuple[float, float]:
+    """Bias-corrected row/column counts (reference ``utils.py:95-99``)."""
+    rows_corrected = n_rows - (n_rows - 1) ** 2 / (cm_sum - 1)
+    cols_corrected = n_cols - (n_cols - 1) ** 2 / (cm_sum - 1)
+    return rows_corrected, cols_corrected
+
+
+def _compute_bias_corrected_values(
+    phi_squared: float, n_rows: int, n_cols: int, cm_sum: float
+) -> Tuple[float, float, float]:
+    """Bias-corrected phi squared and effective table shape (reference ``utils.py:102-108``)."""
+    phi_squared_corrected = _compute_phi_squared_corrected(phi_squared, n_rows, n_cols, cm_sum)
+    rows_corrected, cols_corrected = _compute_rows_and_cols_corrected(n_rows, n_cols, cm_sum)
+    return phi_squared_corrected, rows_corrected, cols_corrected
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )
+
+
+def _nominal_bins_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str,
+    nan_replace_value: Optional[Union[int, float]],
+    confmat_update: Callable[[Array, Array, int], Array],
+) -> Array:
+    """Shared modular update: squeeze logits to labels, scrub NaNs, fold the table.
+
+    Labels must already be dense 0..num_classes-1 codes (reference parity) — values
+    outside that range are silently dropped by the scatter.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    return confmat_update(preds.astype(jnp.int32), target.astype(jnp.int32), num_classes)
+
+
+def _nominal_dense_update(
+    preds: Array,
+    target: Array,
+    nan_strategy: str,
+    nan_replace_value: Optional[Union[int, float]],
+    confmat_update: Callable[[Array, Array, int], Array],
+) -> Array:
+    """Single-shot functional update: relabel arbitrary category values to dense codes.
+
+    The convenience functionals accept any category coding (floats, non-contiguous
+    ints); binning raw values against ``len(unique)`` bins would silently drop
+    out-of-range pairs, so NaNs are scrubbed first and the joint value set is
+    densified via searchsorted before the device scatter.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+
+    p = np.asarray(preds).reshape(-1)
+    t = np.asarray(target).reshape(-1)
+    uniq = np.unique(np.concatenate([p, t]))
+    p_codes = np.searchsorted(uniq, p)
+    t_codes = np.searchsorted(uniq, t)
+    return confmat_update(jnp.asarray(p_codes, dtype=jnp.int32), jnp.asarray(t_codes, dtype=jnp.int32), len(uniq))
+
+
+def _pairwise_matrix(
+    matrix: Array,
+    statistic: Callable[[Array, Array], Array],
+) -> Array:
+    """Symmetric pairwise association matrix over dataset columns (reference ``cramers.py:144-183``)."""
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        out[i, j] = out[j, i] = float(statistic(matrix[:, i], matrix[:, j]))
+    return jnp.asarray(out)

@@ -1,0 +1,36 @@
+"""Pairwise cosine similarity (reference ``functional/pairwise/cosine.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Row-normalize then one matmul (reference ``cosine.py:24-45``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    norm_x = jnp.linalg.norm(x, axis=1, keepdims=True)
+    norm_y = jnp.linalg.norm(y, axis=1, keepdims=True)
+    x_normed = x / jnp.where(norm_x == 0, 1.0, norm_x)
+    y_normed = y / jnp.where(norm_y == 0, 1.0, norm_y)
+    distance = x_normed @ y_normed.T
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    r"""Pairwise cosine similarity between rows of ``x`` (and ``y``) (reference ``cosine.py:48-93``)."""
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,40 @@
+"""Pairwise euclidean distance (reference ``functional/pairwise/euclidean.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """``sqrt(|x|^2 + |y|^2 - 2 x y^T)`` — one MXU matmul plus row norms (reference ``euclidean.py:22-42``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    # accumulate the norm algebra in f64 on host platforms that allow it; the matmul
+    # itself is the MXU-friendly part (reference upcasts for the same cancellation issue)
+    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    xd = x.astype(dtype)
+    yd = y.astype(dtype)
+    x_norm = (xd * xd).sum(axis=1, keepdims=True)
+    y_norm = (yd * yd).sum(axis=1)
+    distance = (x_norm + y_norm - 2 * xd @ yd.T).astype(x.dtype)
+    distance = _zero_diagonal(distance, zero_diagonal)
+    return jnp.sqrt(jnp.clip(distance, 0.0))
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    r"""Pairwise euclidean distances between rows of ``x`` (and ``y``) (reference ``euclidean.py:45-89``)."""
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,52 @@
+"""Shared input checks and reductions for the pairwise matrices (reference ``functional/pairwise/helpers.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_input(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Tuple[Array, Array, bool]:
+    """Validate shapes and resolve the ``zero_diagonal`` default (reference ``helpers.py:18-44``)."""
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """Row-wise mean/sum or the full matrix (reference ``helpers.py:47-61``)."""
+    if reduction == "mean":
+        return distmat.mean(axis=-1)
+    if reduction == "sum":
+        return distmat.sum(axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def _zero_diagonal(distmat: Array, zero_diagonal: bool) -> Array:
+    """Branch-free diagonal clear so the kernels stay jit-friendly."""
+    if not zero_diagonal:
+        return distmat
+    n, m = distmat.shape
+    eye = jnp.eye(n, m, dtype=bool)
+    return jnp.where(eye, 0.0, distmat)

@@ -1,0 +1,31 @@
+"""Pairwise linear similarity (reference ``functional/pairwise/linear.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from torchmetrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Plain inner-product matrix ``x y^T`` (reference ``linear.py:22-38``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = x @ y.T
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    r"""Pairwise linear similarity between rows of ``x`` (and ``y``) (reference ``linear.py:41-84``)."""
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

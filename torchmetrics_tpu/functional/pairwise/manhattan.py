@@ -1,0 +1,32 @@
+"""Pairwise manhattan distance (reference ``functional/pairwise/manhattan.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Broadcasted |x_i - y_j| sum (reference ``manhattan.py:22-38``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.abs(x[:, None, :] - y[None, :, :]).sum(axis=-1)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    r"""Pairwise manhattan distances between rows of ``x`` (and ``y``) (reference ``manhattan.py:41-85``)."""
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

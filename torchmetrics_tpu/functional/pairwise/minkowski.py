@@ -1,0 +1,41 @@
+"""Pairwise minkowski distance (reference ``functional/pairwise/minkowski.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+def _pairwise_minkowski_distance_update(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: Union[int, float] = 2,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Broadcasted p-norm distance (reference ``minkowski.py:24-46``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    if not (isinstance(exponent, (float, int)) and exponent >= 1):
+        raise TorchMetricsUserError(
+            f"Argument ``p`` must be a float or int greater than or equal to 1, but got {exponent}"
+        )
+    distance = (jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent).sum(axis=-1) ** (1.0 / exponent)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: Union[int, float] = 2,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    r"""Pairwise minkowski distances between rows of ``x`` (and ``y``) (reference ``minkowski.py:48-94``)."""
+    distance = _pairwise_minkowski_distance_update(x, y, exponent, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,5 @@
+"""Multimodal metrics (reference ``src/torchmetrics/multimodal/__init__.py``)."""
+
+from torchmetrics_tpu.multimodal.clip_score import CLIPScore
+
+__all__ = ["CLIPScore"]

@@ -1,0 +1,58 @@
+"""Modular CLIPScore (reference ``multimodal/clip_score.py:28-158``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal.clip_score import (
+    _DEFAULT_MODEL,
+    _clip_score_update,
+    _get_model_and_processor,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CLIPScore(Metric):
+    """Streaming text-image similarity with score/n_samples sum states."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 100.0
+
+    score: Array
+    n_samples: Array
+
+    def __init__(
+        self,
+        model_name_or_path: str = _DEFAULT_MODEL,
+        embed_fn: Optional[Callable[[List[Array], List[str]], Tuple[Array, Array]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.embed_fn = embed_fn
+        if embed_fn is None:
+            self.model, self.processor = _get_model_and_processor(model_name_or_path)
+        else:
+            self.model = self.processor = None
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
+        """Fold one batch of image/caption pairs into the running score."""
+        score, n_samples = _clip_score_update(images, text, self.model, self.processor, self.embed_fn)
+        self.score = self.score + score.sum(0)
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        """Average CLIPScore clamped at zero."""
+        return jnp.maximum(self.score / self.n_samples, jnp.asarray(0.0))
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,15 @@
+"""Nominal-association metrics (reference ``src/torchmetrics/nominal/__init__.py``)."""
+
+from torchmetrics_tpu.nominal.cramers import CramersV
+from torchmetrics_tpu.nominal.fleiss_kappa import FleissKappa
+from torchmetrics_tpu.nominal.pearson import PearsonsContingencyCoefficient
+from torchmetrics_tpu.nominal.theils_u import TheilsU
+from torchmetrics_tpu.nominal.tschuprows import TschuprowsT
+
+__all__ = [
+    "CramersV",
+    "FleissKappa",
+    "PearsonsContingencyCoefficient",
+    "TheilsU",
+    "TschuprowsT",
+]

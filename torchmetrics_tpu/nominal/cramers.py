@@ -1,0 +1,54 @@
+"""Modular CramersV (reference ``nominal/cramers.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal.cramers import _cramers_v_compute, _cramers_v_update
+from torchmetrics_tpu.functional.nominal.utils import _nominal_input_validation
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CramersV(Metric):
+    """Cramer's V with a device confusion-matrix sum state (reference ``cramers.py:26-133``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[Union[int, float]] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.bias_correction = bias_correction
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch of label pairs into the table."""
+        confmat = _cramers_v_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Cramer's V over the accumulated table."""
+        return _cramers_v_compute(self.confmat, self.bias_correction)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,44 @@
+"""Modular FleissKappa (reference ``nominal/fleiss_kappa.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from torchmetrics_tpu.functional.nominal.fleiss_kappa import _fleiss_kappa_compute, _fleiss_kappa_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class FleissKappa(Metric):
+    """Fleiss' kappa with a concatenated counts-matrix state (reference ``fleiss_kappa.py:27-120``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    counts: List[Array]
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument ``mode`` must be one of ['counts', 'probs']")
+        self.mode = mode
+        self.add_state("counts", default=[], dist_reduce_fx="cat")
+
+    def update(self, ratings: Array) -> None:
+        """Buffer the per-sample category-count rows for one batch."""
+        counts = _fleiss_kappa_update(ratings, self.mode)
+        self.counts.append(counts)
+
+    def compute(self) -> Array:
+        """Kappa over all rated samples."""
+        return _fleiss_kappa_compute(dim_zero_cat(self.counts))
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

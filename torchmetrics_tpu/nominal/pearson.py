@@ -1,0 +1,57 @@
+"""Modular PearsonsContingencyCoefficient (reference ``nominal/pearson.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal.pearson import (
+    _pearsons_contingency_coefficient_compute,
+    _pearsons_contingency_coefficient_update,
+)
+from torchmetrics_tpu.functional.nominal.utils import _nominal_input_validation
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonsContingencyCoefficient(Metric):
+    """Pearson's contingency coefficient over a device table (reference ``pearson.py:28-136``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[Union[int, float]] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch of label pairs into the table."""
+        confmat = _pearsons_contingency_coefficient_update(
+            preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value
+        )
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Contingency coefficient over the accumulated table."""
+        return _pearsons_contingency_coefficient_compute(self.confmat)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
