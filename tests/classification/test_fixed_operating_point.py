@@ -1,0 +1,232 @@
+"""Classification-tail tests: recall@fixed-precision, precision@fixed-recall,
+specificity@sensitivity. Goldens: brute-force selection over sklearn curves."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_curve as sk_roc
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.classification import (
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySpecificityAtSensitivity,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    MulticlassSpecificityAtSensitivity,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    binary_specificity_at_sensitivity,
+    multiclass_recall_at_fixed_precision,
+    multilabel_precision_at_fixed_recall,
+    multiclass_specificity_at_sensitivity,
+    recall_at_fixed_precision,
+    specificity_at_sensitivity,
+)
+
+
+def _binary_case(seed=0, n=200):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n)
+    target = (rng.rand(n) < preds).astype(np.int64)  # informative scores
+    return preds, target
+
+
+def _sk_recall_at_precision(preds, target, min_precision):
+    p, r, t = sk_prc(target, preds)
+    best = max(
+        ((rr, pp, tt) for pp, rr, tt in zip(p[:-1], r[:-1], t) if pp >= min_precision),
+        default=None,
+    )
+    if best is None or best[0] == 0.0:
+        return (best[0] if best else 0.0), 1e6
+    return best[0], best[2]
+
+
+class TestBinaryRecallAtFixedPrecision:
+    @pytest.mark.parametrize("min_precision", [0.3, 0.5, 0.8])
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_vs_sklearn(self, min_precision, seed):
+        preds, target = _binary_case(seed)
+        recall, threshold = binary_recall_at_fixed_precision(
+            jnp.asarray(preds), jnp.asarray(target), min_precision=min_precision
+        )
+        sk_recall, _ = _sk_recall_at_precision(preds, target, min_precision)
+        assert float(recall) == pytest.approx(sk_recall, abs=1e-5)
+
+    def test_impossible_precision(self):
+        # every positive outscored by a negative: precision 1.0 is unattainable, so
+        # the fallback must report recall 0 with the 1e6 sentinel threshold
+        preds = jnp.array([0.1, 0.2, 0.8, 0.9])
+        target = jnp.array([1, 1, 0, 0])
+        recall, threshold = binary_recall_at_fixed_precision(preds, target, min_precision=1.0)
+        assert float(recall) == 0.0
+        assert float(threshold) == pytest.approx(1e6)
+
+    def test_wrong_arg_name_not_leaked(self):
+        with pytest.raises(ValueError, match="min_recall"):
+            binary_precision_at_fixed_recall(
+                jnp.zeros(4), jnp.zeros(4, dtype=jnp.int32), min_recall=2.0
+            )
+
+    def test_binned_close_to_exact(self):
+        preds, target = _binary_case(5, n=2000)
+        exact, _ = binary_recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), min_precision=0.5)
+        binned, _ = binary_recall_at_fixed_precision(
+            jnp.asarray(preds), jnp.asarray(target), min_precision=0.5, thresholds=200
+        )
+        assert float(binned) == pytest.approx(float(exact), abs=0.02)
+
+    def test_modular_matches_functional(self):
+        preds, target = _binary_case(7)
+        metric = BinaryRecallAtFixedPrecision(min_precision=0.6)
+        metric.update(jnp.asarray(preds[:100]), jnp.asarray(target[:100]))
+        metric.update(jnp.asarray(preds[100:]), jnp.asarray(target[100:]))
+        recall_m, thr_m = metric.compute()
+        recall_f, thr_f = binary_recall_at_fixed_precision(
+            jnp.asarray(preds), jnp.asarray(target), min_precision=0.6
+        )
+        assert float(recall_m) == pytest.approx(float(recall_f), abs=1e-6)
+        assert float(thr_m) == pytest.approx(float(thr_f), abs=1e-6)
+
+
+class TestBinaryPrecisionAtFixedRecall:
+    @pytest.mark.parametrize("min_recall", [0.3, 0.7])
+    def test_vs_sklearn(self, min_recall):
+        preds, target = _binary_case(2)
+        precision, _ = binary_precision_at_fixed_recall(
+            jnp.asarray(preds), jnp.asarray(target), min_recall=min_recall
+        )
+        p, r, t = sk_prc(target, preds)
+        sk_best = max(pp for pp, rr in zip(p[:-1], r[:-1]) if rr >= min_recall)
+        assert float(precision) == pytest.approx(sk_best, abs=1e-5)
+
+    def test_modular(self):
+        preds, target = _binary_case(9)
+        metric = BinaryPrecisionAtFixedRecall(min_recall=0.5)
+        metric.update(jnp.asarray(preds), jnp.asarray(target))
+        precision_m, _ = metric.compute()
+        precision_f, _ = binary_precision_at_fixed_recall(jnp.asarray(preds), jnp.asarray(target), min_recall=0.5)
+        assert float(precision_m) == pytest.approx(float(precision_f), abs=1e-6)
+
+
+class TestBinarySpecificityAtSensitivity:
+    @pytest.mark.parametrize("min_sensitivity", [0.3, 0.6, 0.9])
+    def test_vs_sklearn(self, min_sensitivity):
+        preds, target = _binary_case(4)
+        specificity, _ = binary_specificity_at_sensitivity(
+            jnp.asarray(preds), jnp.asarray(target), min_sensitivity=min_sensitivity
+        )
+        fpr, tpr, thr = sk_roc(target, preds)
+        spec = 1 - fpr
+        qual = spec[tpr >= min_sensitivity]
+        assert float(specificity) == pytest.approx(qual.max(), abs=1e-5)
+
+    def test_modular(self):
+        preds, target = _binary_case(11)
+        metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+        metric.update(jnp.asarray(preds), jnp.asarray(target))
+        spec_m, _ = metric.compute()
+        spec_f, _ = binary_specificity_at_sensitivity(jnp.asarray(preds), jnp.asarray(target), min_sensitivity=0.5)
+        assert float(spec_m) == pytest.approx(float(spec_f), abs=1e-6)
+
+
+def _multiclass_case(seed=0, n=150, k=4):
+    rng = np.random.RandomState(seed)
+    logits = rng.randn(n, k)
+    target = rng.randint(0, k, n)
+    logits[np.arange(n), target] += 1.5  # informative
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    return probs, target
+
+
+class TestMulticlassAndMultilabel:
+    def test_multiclass_vs_per_class_binary(self):
+        probs, target = _multiclass_case()
+        recall, thr = multiclass_recall_at_fixed_precision(
+            jnp.asarray(probs), jnp.asarray(target), num_classes=4, min_precision=0.5
+        )
+        assert recall.shape == (4,)
+        for c in range(4):
+            rec_c, _ = binary_recall_at_fixed_precision(
+                jnp.asarray(probs[:, c]), jnp.asarray((target == c).astype(np.int64)), min_precision=0.5
+            )
+            assert float(recall[c]) == pytest.approx(float(rec_c), abs=1e-5)
+
+    def test_multiclass_specificity(self):
+        probs, target = _multiclass_case(3)
+        spec, thr = multiclass_specificity_at_sensitivity(
+            jnp.asarray(probs), jnp.asarray(target), num_classes=4, min_sensitivity=0.5
+        )
+        assert spec.shape == (4,)
+        assert bool(jnp.all((spec >= 0) & (spec <= 1)))
+
+    def test_multilabel_precision_at_recall(self):
+        rng = np.random.RandomState(6)
+        preds = rng.rand(100, 3)
+        target = (rng.rand(100, 3) < preds).astype(np.int64)
+        precision, thr = multilabel_precision_at_fixed_recall(
+            jnp.asarray(preds), jnp.asarray(target), num_labels=3, min_recall=0.5
+        )
+        assert precision.shape == (3,)
+        for lb in range(3):
+            prec_l, _ = binary_precision_at_fixed_recall(
+                jnp.asarray(preds[:, lb]), jnp.asarray(target[:, lb]), min_recall=0.5
+            )
+            assert float(precision[lb]) == pytest.approx(float(prec_l), abs=1e-5)
+
+    def test_modular_multiclass(self):
+        probs, target = _multiclass_case(8)
+        metric = MulticlassRecallAtFixedPrecision(num_classes=4, min_precision=0.4)
+        metric.update(jnp.asarray(probs), jnp.asarray(target))
+        recall_m, _ = metric.compute()
+        recall_f, _ = multiclass_recall_at_fixed_precision(
+            jnp.asarray(probs), jnp.asarray(target), num_classes=4, min_precision=0.4
+        )
+        np.testing.assert_allclose(np.asarray(recall_m), np.asarray(recall_f), atol=1e-6)
+
+    def test_modular_multilabel_binned(self):
+        rng = np.random.RandomState(10)
+        preds = rng.rand(80, 2)
+        target = (rng.rand(80, 2) < preds).astype(np.int64)
+        metric = MultilabelRecallAtFixedPrecision(num_labels=2, min_precision=0.5, thresholds=100)
+        metric.update(jnp.asarray(preds), jnp.asarray(target))
+        recall_m, _ = metric.compute()
+        assert recall_m.shape == (2,)
+
+    def test_modular_multiclass_specificity(self):
+        probs, target = _multiclass_case(12)
+        metric = MulticlassSpecificityAtSensitivity(num_classes=4, min_sensitivity=0.6)
+        metric.update(jnp.asarray(probs), jnp.asarray(target))
+        spec_m, _ = metric.compute()
+        spec_f, _ = multiclass_specificity_at_sensitivity(
+            jnp.asarray(probs), jnp.asarray(target), num_classes=4, min_sensitivity=0.6
+        )
+        np.testing.assert_allclose(np.asarray(spec_m), np.asarray(spec_f), atol=1e-6)
+
+
+class TestTaskRouters:
+    def test_functional_router(self):
+        preds, target = _binary_case(13)
+        a = recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), task="binary", min_precision=0.5)
+        b = binary_recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), min_precision=0.5)
+        assert float(a[0]) == pytest.approx(float(b[0]), abs=1e-6)
+        s = specificity_at_sensitivity(jnp.asarray(preds), jnp.asarray(target), task="binary", min_sensitivity=0.5)
+        assert 0.0 <= float(s[0]) <= 1.0
+
+    def test_class_router(self):
+        m = tm.RecallAtFixedPrecision(task="binary", min_precision=0.5)
+        assert isinstance(m, BinaryRecallAtFixedPrecision)
+        m2 = tm.SpecificityAtSensitivity(task="multiclass", num_classes=3, min_sensitivity=0.5)
+        assert isinstance(m2, MulticlassSpecificityAtSensitivity)
+        m3 = tm.PrecisionAtFixedRecall(task="binary", min_recall=0.5)
+        assert isinstance(m3, BinaryPrecisionAtFixedRecall)
+
+    def test_arg_validation(self):
+        with pytest.raises(ValueError, match="min_precision"):
+            binary_recall_at_fixed_precision(jnp.zeros(4), jnp.zeros(4, dtype=jnp.int32), min_precision=2.0)
+        with pytest.raises(ValueError, match="min_sensitivity"):
+            BinarySpecificityAtSensitivity(min_sensitivity=-0.5)

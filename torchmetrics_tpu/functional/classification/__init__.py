@@ -119,6 +119,26 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     stat_scores,
 )
 
+from torchmetrics_tpu.functional.classification.precision_fixed_recall import (
+    binary_precision_at_fixed_recall,
+    multiclass_precision_at_fixed_recall,
+    multilabel_precision_at_fixed_recall,
+    precision_at_fixed_recall,
+)
+from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+    recall_at_fixed_precision,
+)
+from torchmetrics_tpu.functional.classification.specificity_sensitivity import (
+    binary_specificity_at_sensitivity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_specificity_at_sensitivity,
+    specicity_at_sensitivity,
+    specificity_at_sensitivity,
+)
+
 __all__ = [
     "binary_calibration_error",
     "calibration_error",
@@ -200,4 +220,17 @@ __all__ = [
     "multiclass_stat_scores",
     "multilabel_stat_scores",
     "stat_scores",
+    "binary_precision_at_fixed_recall",
+    "multiclass_precision_at_fixed_recall",
+    "multilabel_precision_at_fixed_recall",
+    "precision_at_fixed_recall",
+    "binary_recall_at_fixed_precision",
+    "multiclass_recall_at_fixed_precision",
+    "multilabel_recall_at_fixed_precision",
+    "recall_at_fixed_precision",
+    "binary_specificity_at_sensitivity",
+    "multiclass_specificity_at_sensitivity",
+    "multilabel_specificity_at_sensitivity",
+    "specicity_at_sensitivity",
+    "specificity_at_sensitivity",
 ]

@@ -1,0 +1,133 @@
+"""Precision at fixed recall (reference ``functional/classification/precision_fixed_recall.py``).
+
+The mirror image of ``recall_fixed_precision.py``: same curve states, the selection
+swaps the objective and the constrained coordinate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_compute,
+    _binary_recall_at_fixed_precision_arg_validation,
+    _lexi_max_at_constraint,
+    _multiclass_recall_at_fixed_precision_arg_compute,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_arg_compute,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+)
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _precision_at_recall(
+    precision: Array, recall: Array, thresholds: Array, min_recall: float
+) -> Tuple[Array, Array]:
+    """Highest precision whose recall clears the floor (reference ``precision_fixed_recall.py:42-61``)."""
+    return _lexi_max_at_constraint(precision, recall, thresholds, min_recall)
+
+
+def binary_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    r"""Highest precision given a minimum recall floor, binary task (reference ``:63-134``)."""
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index, arg_name="min_recall")
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_recall, reduce_fn=_precision_at_recall)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    r"""Highest per-class precision given a minimum recall floor (reference ``:137-219``)."""
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_recall, thresholds, ignore_index, arg_name="min_recall")
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_recall_at_fixed_precision_arg_compute(
+        state, num_classes, thresholds, min_recall, reduce_fn=_precision_at_recall
+    )
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    r"""Highest per-label precision given a minimum recall floor (reference ``:222-303``)."""
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_recall, thresholds, ignore_index, arg_name="min_recall")
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_recall_at_fixed_precision_arg_compute(
+        state, num_labels, thresholds, ignore_index, min_recall, reduce_fn=_precision_at_recall
+    )
+
+
+def precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-routing wrapper (reference ``:306-348``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_at_fixed_recall(preds, target, min_recall, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_at_fixed_recall(
+            preds, target, num_classes, min_recall, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_at_fixed_recall(
+            preds, target, num_labels, min_recall, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

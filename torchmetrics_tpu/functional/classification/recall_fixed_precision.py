@@ -1,0 +1,241 @@
+"""Recall at fixed precision (reference ``functional/classification/recall_fixed_precision.py``).
+
+Operating-point selection over the PR curve: the curve state machinery is shared with
+``precision_recall_curve.py``; the selection itself is a tiny host reduction over the
+already-computed curve (lexicographic max, matching the reference's tuple-max).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _lexi_max_at_constraint(
+    objective: Array, constrained: Array, thresholds: Array, min_constraint: float
+) -> Tuple[Array, Array]:
+    """Max objective among points whose constrained value clears the floor.
+
+    Ties broken by the constrained value, then threshold — the reference's
+    ``max((obj, con, t) ...)`` tuple ordering (``recall_fixed_precision.py:40-63``).
+    Returns (0.0, 1e6) when no point qualifies.
+    """
+    obj = np.asarray(objective, dtype=np.float64)
+    con = np.asarray(constrained, dtype=np.float64)
+    thr = np.asarray(thresholds, dtype=np.float64)
+    n = min(len(obj), len(con), len(thr))
+    obj, con, thr = obj[:n], con[:n], thr[:n]
+    mask = con >= min_constraint
+    if not mask.any():
+        return jnp.asarray(0.0, dtype=jnp.float32), jnp.asarray(1e6, dtype=jnp.float32)
+    obj, con, thr = obj[mask], con[mask], thr[mask]
+    best = np.lexsort((thr, con, obj))[-1]
+    max_obj = obj[best]
+    best_thr = thr[best] if max_obj != 0.0 else 1e6
+    return jnp.asarray(max_obj, dtype=jnp.float32), jnp.asarray(best_thr, dtype=jnp.float32)
+
+
+def _recall_at_precision(
+    precision: Array, recall: Array, thresholds: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Highest recall whose precision clears the floor (reference ``:40-63``)."""
+    # the curve appends a (precision=1, recall=0) endpoint with no threshold; the
+    # reference's zip() implicitly drops it, as does the truncation here
+    return _lexi_max_at_constraint(recall, precision, thresholds, min_precision)
+
+
+def _validate_fixed_point_arg(value: float, name: str) -> None:
+    """Shared [0,1]-float check for the min_precision/min_recall/min_sensitivity floors."""
+    if not isinstance(value, float) or not (0 <= value <= 1):
+        raise ValueError(f"Expected argument `{name}` to be an float in the [0,1] range, but got {value}")
+
+
+def _binary_recall_at_fixed_precision_arg_validation(
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    arg_name: str = "min_precision",
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    _validate_fixed_point_arg(min_precision, arg_name)
+
+
+def _binary_recall_at_fixed_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_precision: float,
+    pos_label: int = 1,
+    reduce_fn: Callable = _recall_at_precision,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return reduce_fn(precision, recall, thresholds, min_precision)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    r"""Highest recall given a minimum precision floor, binary task (reference ``:84-154``)."""
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision)
+
+
+def _multiclass_recall_at_fixed_precision_arg_validation(
+    num_classes: int,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    arg_name: str = "min_precision",
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    _validate_fixed_point_arg(min_precision, arg_name)
+
+
+def _multiclass_recall_at_fixed_precision_arg_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_precision: float,
+    reduce_fn: Callable = _recall_at_precision,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if not isinstance(precision, list):
+        # one D2H fetch of the full (C, T) arrays, not three per class
+        precision, recall, thr = np.asarray(precision), np.asarray(recall), np.asarray(thresholds)
+        res = [reduce_fn(p, r, thr, min_precision) for p, r in zip(precision, recall)]
+    else:
+        res = [reduce_fn(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    r"""Highest per-class recall given a minimum precision floor (reference ``:186-263``)."""
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_recall_at_fixed_precision_arg_compute(state, num_classes, thresholds, min_precision)
+
+
+def _multilabel_recall_at_fixed_precision_arg_validation(
+    num_labels: int,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    arg_name: str = "min_precision",
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    _validate_fixed_point_arg(min_precision, arg_name)
+
+
+def _multilabel_recall_at_fixed_precision_arg_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_precision: float,
+    reduce_fn: Callable = _recall_at_precision,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    if not isinstance(precision, list):
+        # one D2H fetch of the full (L, T) arrays, not three per label
+        precision, recall, thr = np.asarray(precision), np.asarray(recall), np.asarray(thresholds)
+        res = [reduce_fn(p, r, thr, min_precision) for p, r in zip(precision, recall)]
+    else:
+        res = [reduce_fn(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    r"""Highest per-label recall given a minimum precision floor (reference ``:298-377``)."""
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_recall_at_fixed_precision_arg_compute(state, num_labels, thresholds, ignore_index, min_precision)
+
+
+def recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-routing wrapper (reference ``:380-422``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_recall_at_fixed_precision(preds, target, min_precision, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_recall_at_fixed_precision(
+            preds, target, num_classes, min_precision, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_recall_at_fixed_precision(
+            preds, target, num_labels, min_precision, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")
