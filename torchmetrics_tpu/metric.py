@@ -397,7 +397,8 @@ class Metric:
             dist_sync_fn = gather_all_tensors
 
         self._cache = self._copy_state_refs()
-        self._sync_dist(dist_sync_fn, process_group=process_group)
+        with jax.profiler.TraceAnnotation(f"{type(self).__name__}.sync"):
+            self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
@@ -428,8 +429,12 @@ class Metric:
             should_sync=should_sync,
             distributed_available=distributed_available,
         )
-        yield
-        self.unsync(should_unsync=self._is_synced and should_unsync)
+        try:
+            yield
+        finally:
+            # restore local state even when the compute body raises — otherwise the
+            # metric is wedged in the synced state and every later call errors
+            self.unsync(should_unsync=self._is_synced and should_unsync)
 
     # ------------------------------------------------------------------ wrapping
 
@@ -438,7 +443,10 @@ class Metric:
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            update(*args, **kwargs)
+            # host-side trace span: shows up in jax.profiler / Perfetto timelines so
+            # metric updates are attributable inside a profiled training step (SURVEY §5.1)
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -468,7 +476,7 @@ class Metric:
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ):
+            ), jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
                 value = _squeeze_if_scalar(compute(*args, **kwargs))
 
             if self.compute_with_cache:
@@ -622,9 +630,16 @@ class Metric:
         for key in self._persistent:
             self._persistent[key] = mode
 
+    _UPDATE_COUNT_KEY = "_update_count"
+
     def state_dict(self, destination: Optional[Dict] = None, prefix: str = "", keep_vars: bool = False) -> Dict[str, Any]:
-        """Serialize persistent states to numpy (reference ``metric.py:768-797``)."""
+        """Serialize persistent states to numpy (reference ``metric.py:768-797``).
+
+        ``_update_count`` rides along so a resumed metric keeps the weighting that
+        ``merge_state`` and running means depend on.
+        """
         destination = {} if destination is None else destination
+        wrote_any = False
         for key in self._defaults:
             if not self._persistent[key]:
                 continue
@@ -635,10 +650,14 @@ class Metric:
                 destination[prefix + key] = [np.asarray(v) for v in current_val]
             else:
                 destination[prefix + key] = current_val
+            wrote_any = True
+        if wrote_any:
+            destination[prefix + self._UPDATE_COUNT_KEY] = self._update_count
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
         """Restore states saved by ``state_dict`` (reference ``metric.py:799-816``)."""
+        restored_any = False
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:
@@ -647,7 +666,13 @@ class Metric:
                     setattr(self, key, [jnp.asarray(v) for v in val])
                 else:
                     setattr(self, key, jnp.asarray(val))
-                self._update_count = max(self._update_count, 1)
+                restored_any = True
+        count_key = prefix + self._UPDATE_COUNT_KEY
+        if count_key in state_dict:
+            self._update_count = int(state_dict[count_key])
+        elif restored_any:
+            # legacy checkpoints without the count: mark as updated at least once
+            self._update_count = max(self._update_count, 1)
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Keep only kwargs that ``update`` accepts (reference ``metric.py:818-837``)."""
