@@ -41,28 +41,44 @@ def _input_validator(
         if any(k not in p for p in targets):
             raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
 
-    if any(not isinstance(pred[item_val_name], _ARRAY_TYPES) for pred in preds):
+    def _mask_ok(value) -> bool:
+        if isinstance(value, _ARRAY_TYPES):
+            return True
+        # segm also accepts COCO-style *uncompressed* RLE dict sequences (native kernel
+        # path): counts must be an integer run-length sequence, not pycocotools'
+        # compressed bytes/str form — reject that here rather than deep in compute()
+        return item_val_name == "masks" and isinstance(value, (list, tuple)) and all(
+            isinstance(v, dict)
+            and "size" in v
+            and isinstance(v.get("counts"), (list, tuple, np.ndarray))
+            for v in value
+        )
+
+    def _n(value) -> int:
+        return len(value) if isinstance(value, (list, tuple)) else value.shape[0]
+
+    if any(not _mask_ok(pred[item_val_name]) for pred in preds):
         raise ValueError(f"Expected all {item_val_name} in `preds` to be of type Array")
     if any(not isinstance(pred["scores"], _ARRAY_TYPES) for pred in preds):
         raise ValueError("Expected all scores in `preds` to be of type Array")
     if any(not isinstance(pred["labels"], _ARRAY_TYPES) for pred in preds):
         raise ValueError("Expected all labels in `preds` to be of type Array")
-    if any(not isinstance(target[item_val_name], _ARRAY_TYPES) for target in targets):
+    if any(not _mask_ok(target[item_val_name]) for target in targets):
         raise ValueError(f"Expected all {item_val_name} in `target` to be of type Array")
     if any(not isinstance(target["labels"], _ARRAY_TYPES) for target in targets):
         raise ValueError("Expected all labels in `target` to be of type Array")
 
     for i, item in enumerate(targets):
-        if item[item_val_name].shape[0] != item["labels"].shape[0]:
+        if _n(item[item_val_name]) != item["labels"].shape[0]:
             raise ValueError(
                 f"Input {item_val_name} and labels of sample {i} in targets have a"
-                f" different length (expected {item[item_val_name].shape[0]} labels, got {item['labels'].shape[0]})"
+                f" different length (expected {_n(item[item_val_name])} labels, got {item['labels'].shape[0]})"
             )
     for i, item in enumerate(preds):
-        if not (item[item_val_name].shape[0] == item["labels"].shape[0] == item["scores"].shape[0]):
+        if not (_n(item[item_val_name]) == item["labels"].shape[0] == item["scores"].shape[0]):
             raise ValueError(
                 f"Input {item_val_name}, labels and scores of sample {i} in predictions have a"
-                f" different length (expected {item[item_val_name].shape[0]} labels and scores,"
+                f" different length (expected {_n(item[item_val_name])} labels and scores,"
                 f" got {item['labels'].shape[0]} labels and {item['scores'].shape[0]})"
             )
 

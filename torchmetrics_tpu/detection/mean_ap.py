@@ -44,8 +44,15 @@ def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     return inter / np.where(union == 0, 1.0, union)
 
 
-def _np_mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
-    """Dense-mask pairwise IoU: one flattened matmul (the jnp twin runs on MXU)."""
+def _np_mask_iou(det, gt) -> np.ndarray:
+    """Pairwise mask IoU: dense masks via one flattened matmul, RLEs via the native kernel."""
+    if _is_rle_list(det) or _is_rle_list(gt):
+        from torchmetrics_tpu.native import rle_encode, rle_iou
+
+        # mixed inputs: encode the dense side so one O(runs) kernel handles the pair
+        det_rle = list(det) if _is_rle_list(det) else [rle_encode(m) for m in np.asarray(det)]
+        gt_rle = list(gt) if _is_rle_list(gt) else [rle_encode(m) for m in np.asarray(gt)]
+        return rle_iou(det_rle, gt_rle)
     if det.size == 0 or gt.size == 0:
         return np.zeros((det.shape[0], gt.shape[0]))
     d = det.reshape(det.shape[0], -1).astype(np.float64)
@@ -55,8 +62,29 @@ def _np_mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     return inter / np.where(union == 0, 1.0, union)
 
 
-def _area(values: np.ndarray, iou_type: str) -> np.ndarray:
+def _is_rle_list(values) -> bool:
+    """True for a sequence of COCO-style ``{"size", "counts"}`` RLE dicts."""
+    return isinstance(values, (list, tuple)) and (len(values) == 0 or isinstance(values[0], dict))
+
+
+def _take(values, selector):
+    """Row-select that works for both ndarray stacks and RLE lists."""
+    if _is_rle_list(values):
+        idx = np.flatnonzero(selector) if np.asarray(selector).dtype == bool else np.asarray(selector)
+        return [values[i] for i in idx]
+    return values[selector]
+
+
+def _n_items(values) -> int:
+    return len(values) if _is_rle_list(values) else values.shape[0]
+
+
+def _area(values, iou_type: str) -> np.ndarray:
     """Box or mask areas for the ignore-range logic."""
+    if _is_rle_list(values):
+        from torchmetrics_tpu.native import rle_area
+
+        return np.asarray([rle_area(r) for r in values], dtype=np.float64)
     if values.size == 0:
         return np.zeros((values.shape[0],))
     if iou_type == "bbox":
@@ -132,14 +160,19 @@ class MeanAveragePrecision(Metric):
             self.groundtruths.append(self._get_safe_item_values(item))
             self.groundtruth_labels.append(jnp.asarray(item["labels"]))
 
-    def _get_safe_item_values(self, item: Dict[str, Any]) -> Array:
+    def _get_safe_item_values(self, item: Dict[str, Any]) -> Any:
         if self.iou_type == "bbox":
             boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
             if boxes.size > 0:
                 boxes = _box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
             return boxes
-        # segm: dense boolean masks (num_boxes, H, W)
-        return jnp.asarray(item["masks"], dtype=bool)
+        masks = item["masks"]
+        if _is_rle_list(masks):
+            # COCO-style uncompressed RLE dicts: kept on host, evaluated by the
+            # native C++ kernel (torchmetrics_tpu/native/rle.cpp)
+            return list(masks)
+        # dense boolean masks (num_boxes, H, W)
+        return jnp.asarray(masks, dtype=bool)
 
     @staticmethod
     def _get_classes(det_labels: List[np.ndarray], gt_labels: List[np.ndarray]) -> List[int]:
@@ -152,11 +185,11 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> Dict[str, Array]:
         """COCOeval over the buffered epoch (reference ``mean_ap.py:846-875``)."""
-        # single D2H fetch of all raw states
-        dets = [np.asarray(d) for d in self.detections]
+        # single D2H fetch of all raw states (RLE lists are already host data)
+        dets = [d if _is_rle_list(d) else np.asarray(d) for d in self.detections]
         det_scores = [np.asarray(s) for s in self.detection_scores]
         det_labels = [np.asarray(l).reshape(-1) for l in self.detection_labels]
-        gts = [np.asarray(g) for g in self.groundtruths]
+        gts = [g if _is_rle_list(g) else np.asarray(g) for g in self.groundtruths]
         gt_labels = [np.asarray(l).reshape(-1) for l in self.groundtruth_labels]
 
         classes = self._get_classes(det_labels, gt_labels)
@@ -202,11 +235,11 @@ class MeanAveragePrecision(Metric):
         det_mask = det_labels[idx] == class_id
         if not gt_mask.any() or not det_mask.any():
             return np.zeros((0, 0))
-        gt = gts[idx][gt_mask]
-        det = dets[idx][det_mask]
+        gt = _take(gts[idx], gt_mask)
+        det = _take(dets[idx], det_mask)
         scores = det_scores[idx][det_mask]
         order = np.argsort(-scores, kind="stable")
-        det = det[order][:max_det]
+        det = _take(det, order[:max_det])
         if self.iou_type == "bbox":
             return _np_box_iou(det, gt)
         return _np_mask_iou(det, gt)
@@ -235,7 +268,7 @@ class MeanAveragePrecision(Metric):
             return None
 
         if n_gt_cls > 0 and n_det_cls == 0:
-            areas = _area(gts[idx][gt_mask], self.iou_type)
+            areas = _area(_take(gts[idx], gt_mask), self.iou_type)
             ignore = (areas < area_range[0]) | (areas > area_range[1])
             return {
                 "dtMatches": np.zeros((nb_iou_thrs, 0), dtype=bool),
@@ -247,8 +280,8 @@ class MeanAveragePrecision(Metric):
         scores = det_scores[idx][det_mask]
         order = np.argsort(-scores, kind="stable")
         scores_sorted = scores[order][:max_det]
-        det = dets[idx][det_mask][order][:max_det]
-        nb_det = det.shape[0]
+        det = _take(_take(dets[idx], det_mask), order[:max_det])
+        nb_det = _n_items(det)
 
         if n_gt_cls == 0:
             det_areas = _area(det, self.iou_type)
@@ -260,12 +293,12 @@ class MeanAveragePrecision(Metric):
                 "dtIgnore": np.tile(ignore[None, :], (nb_iou_thrs, 1)),
             }
 
-        gt = gts[idx][gt_mask]
+        gt = _take(gts[idx], gt_mask)
         areas = _area(gt, self.iou_type)
         ignore_area = (areas < area_range[0]) | (areas > area_range[1])
         gtind = np.argsort(ignore_area.astype(np.uint8), kind="stable")  # ignored gts last
         gt_ignore = ignore_area[gtind]
-        nb_gt = gt.shape[0]
+        nb_gt = _n_items(gt)
 
         iou_mat = ious[idx, class_id]
         iou_mat = iou_mat[:, gtind] if iou_mat.size > 0 else iou_mat

@@ -367,7 +367,12 @@ class Metric:
                 continue
             if _is_array(output_dict[attr][0]):
                 output_dict[attr] = jnp.stack(output_dict[attr])
-            elif isinstance(output_dict[attr][0], list):
+            elif isinstance(output_dict[attr][0], list) and (
+                len(output_dict[attr][0]) == 0 or _is_array(output_dict[attr][0][0])
+            ):
+                # gathered per-element world lists of arrays -> interleave (ref ``metric.py:400-405``).
+                # Host-object entries (RLE dicts, strings) are NOT flattened: the array-only
+                # gather can't move them between processes, so per-image alignment must survive.
                 output_dict[attr] = _flatten(output_dict[attr])
 
             if not (callable(reduction_fn) or reduction_fn is None):

@@ -1,0 +1,18 @@
+"""Native host-side kernels (C++, ctypes-bound).
+
+The reference's only native surface is third-party (pycocotools' C RLE mask ops,
+ATen); this package holds the first-party equivalents the TPU build needs on host
+(SURVEY §2.12). Kernels compile lazily with the baked-in ``g++`` into the package's
+``_build`` directory; every entry point has a pure-numpy fallback so the framework
+works even without a toolchain.
+"""
+
+from torchmetrics_tpu.native.rle_mask import (
+    native_available,
+    rle_area,
+    rle_decode,
+    rle_encode,
+    rle_iou,
+)
+
+__all__ = ["native_available", "rle_area", "rle_decode", "rle_encode", "rle_iou"]
