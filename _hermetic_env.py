@@ -1,0 +1,27 @@
+"""Shared subprocess-environment builder for hermetic CPU-mesh runs.
+
+Single owner of the axon-trigger prefix list: the axon sitecustomize registers its TPU
+plugin whenever ``PALLAS_AXON_POOL_IPS`` is set and then forces
+``jax_platforms="axon,cpu"`` over the env var; with the tunnel down that registration
+can hang any jax call. Both ``bench.py`` (sync probe) and ``__graft_entry__.py``
+(multichip dryrun) build their subprocess env here so the scrub list cannot drift.
+"""
+
+import os
+from typing import Dict, Optional
+
+_AXON_TRIGGER_PREFIXES = ("PALLAS_AXON", "AXON_")
+
+
+def hermetic_cpu_env(n_devices: Optional[int] = None) -> Dict[str, str]:
+    """A copy of ``os.environ`` pinned to a pure-CPU jax interpreter."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    for var in ("PJRT_DEVICE", "TPU_SKIP_MDS_QUERY", "PYTHONSTARTUP"):
+        env.pop(var, None)
+    for var in list(env):
+        if var.startswith(_AXON_TRIGGER_PREFIXES):
+            env.pop(var)
+    return env

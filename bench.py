@@ -228,8 +228,9 @@ print((time.perf_counter() - t0) / 50 * 1e6)
 
 def bench_sync_latency():
     """8-virtual-device psum of a metric state pytree, hermetic CPU subprocess."""
-    env = dict(os.environ)
-    env.pop("PYTHONSTARTUP", None)
+    from _hermetic_env import hermetic_cpu_env
+
+    env = hermetic_cpu_env(8)
     proc = subprocess.run(
         [sys.executable, "-c", _SYNC_PROBE], capture_output=True, text=True, timeout=300, env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
