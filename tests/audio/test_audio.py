@@ -13,6 +13,7 @@ import pytest
 import torch
 
 import torchmetrics_tpu as tm
+from tests.testers import MetricTester
 from torchmetrics_tpu.audio import (
     PermutationInvariantTraining,
     ScaleInvariantSignalDistortionRatio,
@@ -262,6 +263,34 @@ class TestModular:
 
             with pytest.raises(ModuleNotFoundError, match="pystoi"):
                 ShortTimeObjectiveIntelligibility(8000)
+
+
+class TestThroughHarness:
+    """Three-level MetricTester protocol (forward / synced-step merge / final compute)."""
+
+    def _batches(self, seed=0, n_batches=4, batch=6, time=64):
+        rng = np.random.RandomState(seed)
+        preds = [jnp.asarray(rng.randn(batch, time).astype(np.float32)) for _ in range(n_batches)]
+        target = [jnp.asarray(rng.randn(batch, time).astype(np.float32)) for _ in range(n_batches)]
+        return preds, target
+
+    def test_snr_protocol(self):
+        preds, target = self._batches()
+
+        def golden(p, t):
+            return np.asarray(signal_noise_ratio(jnp.asarray(p), jnp.asarray(t))).mean()
+
+        MetricTester().run_class_metric_test(preds, target, SignalNoiseRatio, golden, atol=1e-4)
+
+    def test_si_sdr_protocol(self):
+        preds, target = self._batches(seed=2)
+
+        def golden(p, t):
+            return np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(p), jnp.asarray(t))).mean()
+
+        MetricTester().run_class_metric_test(
+            preds, target, ScaleInvariantSignalDistortionRatio, golden, atol=1e-4
+        )
 
 
 def test_exported_from_root():

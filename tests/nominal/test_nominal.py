@@ -256,6 +256,24 @@ class TestModular:
             cramers_v(preds, target, nan_strategy="bad")
 
 
+class TestThroughHarness:
+    """Three-level MetricTester protocol over the confusion-matrix sum states."""
+
+    def test_cramers_protocol(self):
+        from tests.testers import MetricTester
+
+        rng = np.random.RandomState(0)
+        preds = [jnp.asarray(rng.randint(0, 4, 50)) for _ in range(4)]
+        target = [jnp.asarray(rng.randint(0, 4, 50)) for _ in range(4)]
+
+        def golden(p, t):
+            return float(cramers_v(jnp.asarray(p), jnp.asarray(t)))
+
+        MetricTester().run_class_metric_test(
+            preds, target, CramersV, golden, metric_args={"num_classes": 4}, atol=1e-5
+        )
+
+
 def test_exported_from_root():
     assert tm.CramersV is CramersV
     assert tm.functional.cramers_v is cramers_v
