@@ -62,6 +62,60 @@ def _binary_clf_curve(
     return fps, tps, preds[threshold_idxs]
 
 
+def _binned_multi_threshold_confmat(
+    preds: Array,
+    positive: Array,
+    valid: Array,
+    thresholds: Array,
+) -> Array:
+    """``(len_t, C, 2, 2)`` confusion tensor for every threshold, via histograms.
+
+    TPU-native reformulation of the reference's per-threshold scatter
+    (``precision_recall_curve.py:205-243``): instead of materialising the
+    ``(N, C, len_t)`` comparison tensor and scattering all of it, bucketise each
+    score into its threshold bin (``searchsorted``), build two ``(C, len_t+1)``
+    histograms with one ``N*C``-element scatter-add each, and recover the
+    per-threshold counts ``#{p >= t}`` as suffix sums — ``len_t``-times less
+    scatter traffic, identical integer counts.
+
+    Args:
+        preds: ``(N, C)`` scores.
+        positive: ``(N, C)`` 0/1 ground-truth membership.
+        valid: ``(N, C)`` mask of samples to count.
+        thresholds: ``(len_t,)`` threshold values (any order).
+    """
+    n_thresh = thresholds.shape[0]
+    num_classes = preds.shape[1]
+    order = jnp.argsort(thresholds)
+    sorted_thr = thresholds[order]
+    # bin[n, c] = #{t : sorted_thr[t] <= preds[n, c]} in [0, len_t]; NaN scores land in
+    # bin 0 (below every threshold) to match ``preds >= t`` being False for NaN.
+    bins = jnp.searchsorted(sorted_thr, preds, side="right")
+    bins = jnp.where(jnp.isnan(preds), 0, bins)
+    flat_idx = bins + (n_thresh + 1) * jnp.arange(num_classes, dtype=bins.dtype)[None, :]
+    flat_idx = jnp.where(valid, flat_idx, -1)
+    valid_i = valid.astype(jnp.int32)
+    pos_w = positive.astype(jnp.int32) * valid_i
+    zeros = jnp.zeros(num_classes * (n_thresh + 1), dtype=jnp.int32)
+    pos_hist = zeros.at[flat_idx.ravel()].add(pos_w.ravel(), mode="drop").reshape(num_classes, n_thresh + 1)
+    tot_hist = zeros.at[flat_idx.ravel()].add(valid_i.ravel(), mode="drop").reshape(num_classes, n_thresh + 1)
+    pos_cum = jnp.cumsum(pos_hist, axis=1)
+    tot_cum = jnp.cumsum(tot_hist, axis=1)
+    pos_total = pos_cum[:, -1:]
+    tot_total = tot_cum[:, -1:]
+    # preds >= sorted_thr[t]  <=>  bin > t, so the count at t is the suffix sum past t.
+    tp = (pos_total - pos_cum[:, :n_thresh]).T  # (len_t, C)
+    pred_pos = (tot_total - tot_cum[:, :n_thresh]).T
+    fp = pred_pos - tp
+    fn = jnp.broadcast_to(pos_total.T, tp.shape) - tp
+    tn = jnp.broadcast_to((tot_total - pos_total).T, tp.shape) - fp
+    confmat = jnp.stack(
+        [jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2
+    )  # (len_t, C, 2, 2)
+    inv_order = jnp.argsort(order)
+    return confmat[inv_order]
+
+
 def _adjust_threshold_arg(
     thresholds: Optional[Union[int, List[float], Array]] = None,
 ) -> Optional[Array]:
@@ -144,19 +198,14 @@ def _binary_precision_recall_curve_update(
     target: Array,
     thresholds: Optional[Array],
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Binned: (len_t, 2, 2) multi-threshold confmat via one scatter-add (reference ``:189-243``)."""
+    """Binned: (len_t, 2, 2) multi-threshold confmat via bucketised histograms (reference ``:189-243``)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
     valid = target >= 0
-    preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.int32)  # (N, len_t)
-    safe_target = jnp.where(valid, target, 0)
-    unique_mapping = preds_t + 2 * safe_target[:, None] + 4 * jnp.arange(len_t)[None, :]
-    unique_mapping = jnp.where(valid[:, None], unique_mapping, -1)
-    bins = jnp.zeros(4 * len_t, dtype=jnp.int32).at[unique_mapping.flatten()].add(
-        valid[:, None].astype(jnp.int32).repeat(len_t, axis=1).flatten(), mode="drop"
+    confmat = _binned_multi_threshold_confmat(
+        preds[:, None], (target > 0)[:, None], valid[:, None], thresholds
     )
-    return bins.reshape(len_t, 2, 2)
+    return confmat[:, 0]
 
 
 def _binary_precision_recall_curve_compute(
@@ -278,23 +327,15 @@ def _multiclass_precision_recall_curve_update(
     num_classes: int,
     thresholds: Optional[Array],
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Binned: (len_t, C, 2, 2) via one scatter-add (reference ``:445-501``)."""
+    """Binned: (len_t, C, 2, 2) via bucketised histograms (reference ``:445-501``)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
     valid = target >= 0
     safe_target = jnp.where(valid, target, 0)
-    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)  # (N, C, T)
     target_t = jax.nn.one_hot(safe_target, num_classes, dtype=jnp.int32)  # (N, C)
-    unique_mapping = preds_t + 2 * target_t[:, :, None]
-    unique_mapping = unique_mapping + 4 * jnp.arange(num_classes)[None, :, None]
-    unique_mapping = unique_mapping + 4 * num_classes * jnp.arange(len_t)[None, None, :]
-    unique_mapping = jnp.where(valid[:, None, None], unique_mapping, -1)
-    weights = jnp.broadcast_to(valid[:, None, None], unique_mapping.shape).astype(jnp.int32)
-    bins = jnp.zeros(4 * num_classes * len_t, dtype=jnp.int32).at[unique_mapping.flatten()].add(
-        weights.flatten(), mode="drop"
+    return _binned_multi_threshold_confmat(
+        preds, target_t, jnp.broadcast_to(valid[:, None], preds.shape), thresholds
     )
-    return bins.reshape(len_t, num_classes, 2, 2)
 
 
 def _multiclass_precision_recall_curve_compute(
@@ -392,22 +433,12 @@ def _multilabel_precision_recall_curve_update(
     num_labels: int,
     thresholds: Optional[Array],
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Binned: (len_t, L, 2, 2) via one scatter-add (reference ``:700-722``)."""
+    """Binned: (len_t, L, 2, 2) via bucketised histograms (reference ``:700-722``)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
     valid = target >= 0
     safe_target = jnp.where(valid, target, 0)
-    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)
-    unique_mapping = preds_t + 2 * safe_target[:, :, None]
-    unique_mapping = unique_mapping + 4 * jnp.arange(num_labels)[None, :, None]
-    unique_mapping = unique_mapping + 4 * num_labels * jnp.arange(len_t)[None, None, :]
-    unique_mapping = jnp.where(valid[:, None, None] if valid.ndim == 1 else valid[:, :, None], unique_mapping, -1)
-    weights = (unique_mapping >= 0).astype(jnp.int32)
-    bins = jnp.zeros(4 * num_labels * len_t, dtype=jnp.int32).at[unique_mapping.flatten()].add(
-        weights.flatten(), mode="drop"
-    )
-    return bins.reshape(len_t, num_labels, 2, 2)
+    return _binned_multi_threshold_confmat(preds, safe_target > 0, valid, thresholds)
 
 
 def _multilabel_precision_recall_curve_compute(
