@@ -8,6 +8,10 @@ Covers BASELINE.json scenarios #1-#3 at realistic, compute-bound shapes plus an
                   CIFAR-10-shaped logits 8192x10 (config #2, single-chip portion)
 - ``ssim``:       SSIM over 4x3x256x256 image batches (config #3; einsum band-matrix
                   filters — ``lax.conv`` costs ~107ms flat through the axon tunnel)
+- ``perplexity``: Perplexity update on 8x512x32000 LM logits (config #4's
+                  tensor-native tier; BERTScore/ROUGE are host-tokenised by design)
+- ``det_iou``:    batched pairwise box IoU, 64 images x 100x100 boxes (config #5's
+                  device-side matching hot op; mAP list states are host-ragged)
 - ``sync_us``:    metric-state psum over an 8-virtual-device CPU mesh in a hermetic
                   subprocess (config #2's sync half; real ICI numbers need a pod)
 
@@ -31,6 +35,8 @@ import numpy as np
 ACC_BATCH, ACC_CLASSES = 8192, 1000
 CIFAR_BATCH, CIFAR_CLASSES, N_THRESH = 8192, 10, 200
 IMG_BATCH, IMG_SIZE = 4, 256
+PPL_BATCH, PPL_SEQ, PPL_VOCAB = 8, 512, 32000
+DET_IMGS, DET_BOXES = 64, 100
 STEPS = 2000        # device-side scan steps (ours)
 TORCH_STEPS = 20    # eager baseline iterations (each is ~ms-scale on CPU)
 WARMUP = 5
@@ -156,6 +162,36 @@ def bench_ours():
     ssim_state = (jnp.asarray(0.0), jnp.asarray(0))
     results["ssim_us"] = _time_jitted(ssim_step, ssim_state, img_a, img_b)
 
+    # -- scenario 4: perplexity on LM-eval-shaped logits ------------------
+    from torchmetrics_tpu.functional.text.perplexity import _perplexity_update
+
+    lm_logits = jax.random.normal(jax.random.fold_in(key, 7), (PPL_BATCH, PPL_SEQ, PPL_VOCAB), jnp.float32)
+    lm_target = jax.random.randint(jax.random.fold_in(key, 8), (PPL_BATCH, PPL_SEQ), 0, PPL_VOCAB, jnp.int32)
+
+    @jax.jit
+    def ppl_step(state, logits, target):
+        total, count = _perplexity_update(logits, target, ignore_index=-100)
+        return (state[0] + total, state[1] + count)
+
+    ppl_state = (jnp.asarray(0.0), jnp.asarray(0))
+    results["perplexity_us"] = _time_jitted(ppl_step, ppl_state, lm_logits, lm_target)
+
+    # -- scenario 5: batched pairwise box IoU (mAP matching hot op) --------
+    from torchmetrics_tpu.functional.detection.helpers import _box_iou
+
+    kb1, kb2 = jax.random.split(jax.random.fold_in(key, 9))
+    xy1 = jax.random.uniform(kb1, (DET_IMGS, DET_BOXES, 2)) * 500
+    wh1 = jax.random.uniform(kb2, (DET_IMGS, DET_BOXES, 2)) * 100 + 1
+    dets = jnp.concatenate([xy1, xy1 + wh1], axis=-1)
+    gts = jnp.concatenate([xy1 + 5.0, xy1 + wh1 + 5.0], axis=-1)
+
+    @jax.jit
+    def iou_step(state, dets, gts):
+        ious = jax.vmap(_box_iou)(dets, gts)  # (IMGS, BOXES, BOXES)
+        return state + ious.max(-1).sum()
+
+    results["det_iou_us"] = _time_jitted(iou_step, jnp.asarray(0.0), dets, gts)
+
     return results
 
 
@@ -231,6 +267,41 @@ def bench_torch():
         return ssim_map.mean((1, 2, 3)).sum()
 
     results["ssim_us"] = timeit(ssim_step, img_a, img_b)
+
+    # scenario 4: perplexity update (reference text/perplexity.py:67-96)
+    lm_logits = torch.from_numpy(rng.randn(PPL_BATCH, PPL_SEQ, PPL_VOCAB).astype(np.float32))
+    lm_target = torch.from_numpy(rng.randint(0, PPL_VOCAB, (PPL_BATCH, PPL_SEQ)).astype(np.int64))
+
+    def ppl_step(logits, target):
+        log_probs = logits.reshape(-1, PPL_VOCAB).log_softmax(dim=1)
+        flat = target.reshape(-1)
+        mask = flat != -100
+        picked = log_probs.gather(1, flat.clamp(min=0).unsqueeze(1)).squeeze(1)
+        return -(picked * mask).sum(), mask.sum()
+
+    results["perplexity_us"] = timeit(ppl_step, lm_logits, lm_target)
+
+    # scenario 5: batched pairwise IoU (reference detection/mean_ap.py:413 via torchvision box_iou)
+    xy1 = torch.from_numpy((rng.rand(DET_IMGS, DET_BOXES, 2) * 500).astype(np.float32))
+    wh1 = torch.from_numpy((rng.rand(DET_IMGS, DET_BOXES, 2) * 100 + 1).astype(np.float32))
+    t_dets = torch.cat([xy1, xy1 + wh1], dim=-1)
+    t_gts = torch.cat([xy1 + 5.0, xy1 + wh1 + 5.0], dim=-1)
+
+    def iou_step(dets, gts):
+        out = 0.0
+        for i in range(DET_IMGS):  # reference evaluates per image (mean_ap.py:407-413)
+            a, b = dets[i], gts[i]
+            area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+            area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+            lt = torch.max(a[:, None, :2], b[None, :, :2])
+            rb = torch.min(a[:, None, 2:], b[None, :, 2:])
+            wh = (rb - lt).clamp(min=0)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / (area_a[:, None] + area_b[None, :] - inter)
+            out = out + iou.max(-1).values.sum()
+        return out
+
+    results["det_iou_us"] = timeit(iou_step, t_dets, t_gts)
 
     return results
 

@@ -1,0 +1,185 @@
+"""Flax feature-extractor architectures: shapes, jit, and torch-parity of converters.
+
+torchvision is not installed in this image, so parity is checked against hand-built
+torch replicas of the torchvision layouts (the state-dict key schema is the same):
+VGG16 as the exact ``features`` Sequential, InceptionA as the reference block. This
+validates conv padding/strides, BN statistics handling, branch concat order, and the
+OIHW->HWIO conversion — not just shapes — without any pretrained download.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from torch import nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu.models import InceptionV3, inception_v3_extractor, vgg16_lpips_extractor  # noqa: E402
+from torchmetrics_tpu.models import inception as inception_mod  # noqa: E402
+from torchmetrics_tpu.models.vgg import from_torch_state_dict as vgg_convert  # noqa: E402
+
+
+def test_inception_extractor_shape_and_jit():
+    extractor = inception_v3_extractor()
+    feats = extractor(jnp.zeros((2, 3, 299, 299), jnp.uint8))
+    assert feats.shape == (2, 2048)
+
+
+def _tree_shapes(tree):
+    return jax.tree_util.tree_map(lambda x: tuple(x.shape), tree)
+
+
+def test_inception_converter_structure_matches_init():
+    """The converted state dict must be drop-in for ``model.init``'s variables."""
+    model = InceptionV3()
+    init_vars = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 299, 299), jnp.float32))
+
+    # synthetic torchvision-style state dict with the right shapes, inferred from init
+    state = {}
+    for coll, leaf_map in (("params", {"kernel": "conv.weight", "scale": "bn.weight", "bias": "bn.bias"}),
+                           ("batch_stats", {"mean": "bn.running_mean", "var": "bn.running_var"})):
+        flat = jax.tree_util.tree_flatten_with_path(init_vars[coll])[0]
+        for path, leaf in flat:
+            keys = [p.key for p in path]
+            torch_name = ".".join(keys[:-2])  # drop conv/bn + param leaf
+            leaf_name = leaf_map[keys[-1]]
+            shape = leaf.shape
+            if keys[-1] == "kernel":  # HWIO -> OIHW
+                shape = (shape[3], shape[2], shape[0], shape[1])
+            state[f"{torch_name}.{leaf_name}"] = torch.randn(*shape)
+
+    converted = inception_mod.from_torch_state_dict(state)
+    assert _tree_shapes(converted["params"]) == _tree_shapes(init_vars["params"])
+    assert _tree_shapes(converted["batch_stats"]) == _tree_shapes(init_vars["batch_stats"])
+    # converted weights must drive the forward
+    feats = InceptionV3().apply(converted, jnp.zeros((1, 3, 299, 299), jnp.float32))
+    assert feats.shape == (1, 2048)
+
+
+class _TorchBasicConv2d(tnn.Module):
+    """torchvision BasicConv2d: conv(bias=False) + BN(eps=1e-3) + relu."""
+
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = tnn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = tnn.BatchNorm2d(cout, eps=0.001)
+
+    def forward(self, x):
+        return torch.relu(self.bn(self.conv(x)))
+
+
+class _TorchInceptionA(tnn.Module):
+    """torchvision InceptionA with the same child names/state-dict keys."""
+
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = _TorchBasicConv2d(cin, 64, kernel_size=1)
+        self.branch5x5_1 = _TorchBasicConv2d(cin, 48, kernel_size=1)
+        self.branch5x5_2 = _TorchBasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = _TorchBasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _TorchBasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _TorchBasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = _TorchBasicConv2d(cin, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(torch.nn.functional.avg_pool2d(x, 3, stride=1, padding=1))
+        return torch.cat([b1, b5, b3, bp], 1)
+
+
+def test_inception_a_block_matches_torch_replica():
+    """One real block end-to-end: conversion + padding + BN stats + concat order."""
+    torch.manual_seed(0)
+    tblock = _TorchInceptionA(192, 32)
+    tblock.eval()
+    # randomise BN stats so the parity check exercises them
+    for m in tblock.modules():
+        if isinstance(m, tnn.BatchNorm2d):
+            m.running_mean.uniform_(-0.5, 0.5)
+            m.running_var.uniform_(0.5, 1.5)
+
+    state = {f"Mixed_5b.{k}": v for k, v in tblock.state_dict().items()}
+    params = {c: inception_mod._convert_basic_conv(state, f"Mixed_5b.{c}")
+              for c in inception_mod._BLOCK_CONVS["Mixed_5b"]}
+    stats = {c: inception_mod._convert_basic_conv_stats(state, f"Mixed_5b.{c}")
+             for c in inception_mod._BLOCK_CONVS["Mixed_5b"]}
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 192, 17, 17).astype(np.float32)
+    with torch.no_grad():
+        want = tblock(torch.from_numpy(x)).numpy()
+
+    block = inception_mod.InceptionA(32)
+    got = block.apply({"params": params, "batch_stats": stats}, jnp.transpose(jnp.asarray(x), (0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2), want, atol=1e-4, rtol=1e-4)
+
+
+def _torch_vgg16_features():
+    """Exact torchvision vgg16().features layout (conv indices 0..28)."""
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"]
+    layers, cin = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(tnn.MaxPool2d(2, 2))
+        else:
+            layers += [tnn.Conv2d(cin, v, 3, padding=1), tnn.ReLU(inplace=False)]
+            cin = v
+    return tnn.Sequential(*layers)
+
+
+def test_vgg_converter_matches_torch_replica():
+    torch.manual_seed(1)
+    features = _torch_vgg16_features()
+    features.eval()
+    state = {f"features.{k}": v for k, v in features.state_dict().items()}
+    extractor = vgg16_lpips_extractor(state_dict=state)
+
+    rng = np.random.RandomState(1)
+    imgs = rng.uniform(-1, 1, (2, 3, 64, 64)).astype(np.float32)
+
+    # the lpips extractor contract: input is already ScalingLayer-normalised (the
+    # pipeline does it), outputs come back NCHW
+    with torch.no_grad():
+        x = torch.from_numpy(imgs)
+        taps = {3, 8, 15, 22, 29}  # post-relu layers feeding LPIPS heads
+        want = []
+        for i, layer in enumerate(features):
+            x = layer(x)
+            if i in taps:
+                want.append(x.numpy())
+            if i == 29:
+                break
+
+    got = extractor(jnp.asarray(imgs))
+    assert len(got) == 5
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=1e-4, rtol=1e-4)
+
+
+def test_inception_extractor_uint8_matches_unit_floats():
+    """uint8 images and their /255 float equivalents must produce identical features."""
+    extractor = inception_v3_extractor()
+    rng = np.random.RandomState(3)
+    u8 = rng.randint(0, 256, (2, 3, 299, 299)).astype(np.uint8)
+    f32 = u8.astype(np.float32) / 255.0
+    got_u8 = np.asarray(extractor(jnp.asarray(u8)))
+    got_f32 = np.asarray(extractor(jnp.asarray(f32)))
+    np.testing.assert_allclose(got_u8, got_f32, atol=1e-5)
+
+
+def test_vgg_extractor_composes_with_lpips_pipeline():
+    """The extractor must slot into make_lpips_net: NCHW maps, no double scaling."""
+    from torchmetrics_tpu.functional.image.lpips import make_lpips_net
+
+    net = make_lpips_net(vgg16_lpips_extractor())
+    rng = np.random.RandomState(4)
+    a = rng.uniform(0, 1, (2, 3, 64, 64)).astype(np.float32)
+    d_same = np.asarray(net(jnp.asarray(a), jnp.asarray(a), normalize=True))
+    d_diff = np.asarray(net(jnp.asarray(a), jnp.asarray(1 - a), normalize=True))
+    assert d_same.shape[0] == 2
+    np.testing.assert_allclose(d_same, 0.0, atol=1e-10)  # identical inputs -> zero distance
+    assert (d_diff > 0).all()

@@ -35,8 +35,10 @@ def resolve_feature_extractor(
     if isinstance(feature, (int, str)):
         raise ModuleNotFoundError(
             f"Default feature extractor `feature={feature!r}` requires pretrained InceptionV3 weights, which are"
-            " not bundled. Pass a callable `imgs -> (N, d)` feature extractor instead (e.g. a Flax module apply"
-            " with converted weights)."
+            " not bundled. Build one with `torchmetrics_tpu.models.inception_v3_extractor(state_dict=...)`"
+            " from a torchvision inception_v3 checkpoint (the architecture is a native Flax module), or pass"
+            " any callable `imgs -> (N, d)` feature extractor. Note: that trunk ends at the 2048-d pool —"
+            " InceptionScore needs class LOGITS, so wrap the trunk with the checkpoint's fc layer."
         )
     if not callable(feature):
         raise TypeError("Got unknown input to argument `feature`")

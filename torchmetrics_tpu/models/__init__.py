@@ -1,0 +1,15 @@
+"""Frozen feature-extractor architectures backing the model-based metrics.
+
+The reference embeds pretrained torch networks inside its metrics — torch-fidelity's
+InceptionV3 for FID/KID/IS (``src/torchmetrics/image/fid.py:52-157``), vendored
+SqueezeNet/AlexNet/VGG16 for LPIPS (``functional/image/lpips.py:59-187``), HF
+CLIP/BERT for CLIPScore/BERTScore. Here the architectures are native Flax modules that
+run on the TPU inside jitted metric updates; pretrained weights are loaded by
+converting a torch/torchvision state dict (no weights are bundled — this environment
+has zero egress).
+"""
+
+from torchmetrics_tpu.models.inception import InceptionV3, inception_v3_extractor
+from torchmetrics_tpu.models.vgg import VGG16Features, vgg16_lpips_extractor
+
+__all__ = ["InceptionV3", "VGG16Features", "inception_v3_extractor", "vgg16_lpips_extractor"]

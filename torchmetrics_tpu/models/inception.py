@@ -1,0 +1,252 @@
+"""Flax InceptionV3 feature trunk for FID / KID / InceptionScore.
+
+Mirrors the torchvision InceptionV3 topology the reference wraps via torch-fidelity
+(``src/torchmetrics/image/fid.py:52-157``): BasicConv2d (conv + BN eps=1e-3 + relu),
+Inception A/B/C/D/E blocks, global average pool to a 2048-d feature vector. Inference
+only — BatchNorm applies stored statistics; no dropout, no aux head.
+
+Built TPU-first: NHWC layout internally (XLA's preferred conv layout on TPU), bf16
+compute with f32 statistics optional, the whole trunk jit-compiles to one XLA program.
+``from_torch_state_dict`` converts a torchvision ``inception_v3`` checkpoint (OIHW ->
+HWIO transposes, BN buffers); ``inception_v3_extractor`` packages params + apply into
+the ``imgs -> (N, 2048)`` callable the image metrics accept.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
+
+Array = jax.Array
+
+_BN_EPS = 1e-3
+
+
+if nn is not None:
+
+    class BasicConv2d(nn.Module):
+        """conv -> BN(eps=1e-3, inference) -> relu."""
+
+        features: int
+        kernel: Tuple[int, int]
+        strides: Tuple[int, int] = (1, 1)
+        padding: Any = (0, 0)
+
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            pad = self.padding
+            if isinstance(pad, tuple) and isinstance(pad[0], int):
+                pad = ((pad[0], pad[0]), (pad[1], pad[1]))
+            x = nn.Conv(self.features, self.kernel, self.strides, padding=pad, use_bias=False, name="conv")(x)
+            x = nn.BatchNorm(use_running_average=True, epsilon=_BN_EPS, name="bn")(x)
+            return nn.relu(x)
+
+    class InceptionA(nn.Module):
+        pool_features: int
+
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            b1 = BasicConv2d(64, (1, 1), name="branch1x1")(x)
+            b5 = BasicConv2d(48, (1, 1), name="branch5x5_1")(x)
+            b5 = BasicConv2d(64, (5, 5), padding=(2, 2), name="branch5x5_2")(b5)
+            b3 = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+            b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(b3)
+            b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_3")(b3)
+            bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+            bp = BasicConv2d(self.pool_features, (1, 1), name="branch_pool")(bp)
+            return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+    class InceptionB(nn.Module):
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            b3 = BasicConv2d(384, (3, 3), strides=(2, 2), name="branch3x3")(x)
+            bd = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+            bd = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+            bd = BasicConv2d(96, (3, 3), strides=(2, 2), name="branch3x3dbl_3")(bd)
+            bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+            return jnp.concatenate([b3, bd, bp], axis=-1)
+
+    class InceptionC(nn.Module):
+        channels_7x7: int
+
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            c7 = self.channels_7x7
+            b1 = BasicConv2d(192, (1, 1), name="branch1x1")(x)
+            b7 = BasicConv2d(c7, (1, 1), name="branch7x7_1")(x)
+            b7 = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7_2")(b7)
+            b7 = BasicConv2d(192, (7, 1), padding=(3, 0), name="branch7x7_3")(b7)
+            bd = BasicConv2d(c7, (1, 1), name="branch7x7dbl_1")(x)
+            bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_2")(bd)
+            bd = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7dbl_3")(bd)
+            bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_4")(bd)
+            bd = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7dbl_5")(bd)
+            bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+            bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+            return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+    class InceptionD(nn.Module):
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            b3 = BasicConv2d(192, (1, 1), name="branch3x3_1")(x)
+            b3 = BasicConv2d(320, (3, 3), strides=(2, 2), name="branch3x3_2")(b3)
+            b7 = BasicConv2d(192, (1, 1), name="branch7x7x3_1")(x)
+            b7 = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7x3_2")(b7)
+            b7 = BasicConv2d(192, (7, 1), padding=(3, 0), name="branch7x7x3_3")(b7)
+            b7 = BasicConv2d(192, (3, 3), strides=(2, 2), name="branch7x7x3_4")(b7)
+            bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+            return jnp.concatenate([b3, b7, bp], axis=-1)
+
+    class InceptionE(nn.Module):
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            b1 = BasicConv2d(320, (1, 1), name="branch1x1")(x)
+            b3 = BasicConv2d(384, (1, 1), name="branch3x3_1")(x)
+            b3a = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3_2a")(b3)
+            b3b = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3_2b")(b3)
+            b3 = jnp.concatenate([b3a, b3b], axis=-1)
+            bd = BasicConv2d(448, (1, 1), name="branch3x3dbl_1")(x)
+            bd = BasicConv2d(384, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+            bda = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3dbl_3a")(bd)
+            bdb = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3dbl_3b")(bd)
+            bd = jnp.concatenate([bda, bdb], axis=-1)
+            bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+            bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+            return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+    class InceptionV3(nn.Module):
+        """Feature trunk; ``__call__`` maps NCHW or NHWC uint8/float images -> (N, 2048)."""
+
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            if x.ndim != 4:
+                raise ValueError(f"Expected 4d image batch, got shape {x.shape}")
+            if x.shape[1] == 3 and x.shape[-1] != 3:  # NCHW -> NHWC
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                x = x.astype(jnp.float32) / 255.0
+            # torchvision's transform_input=False path: plain [0,1] -> [-1, 1]
+            x = x * 2.0 - 1.0
+            x = BasicConv2d(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
+            x = BasicConv2d(32, (3, 3), name="Conv2d_2a_3x3")(x)
+            x = BasicConv2d(64, (3, 3), padding=(1, 1), name="Conv2d_2b_3x3")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+            x = BasicConv2d(80, (1, 1), name="Conv2d_3b_1x1")(x)
+            x = BasicConv2d(192, (3, 3), name="Conv2d_4a_3x3")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+            x = InceptionA(32, name="Mixed_5b")(x)
+            x = InceptionA(64, name="Mixed_5c")(x)
+            x = InceptionA(64, name="Mixed_5d")(x)
+            x = InceptionB(name="Mixed_6a")(x)
+            x = InceptionC(128, name="Mixed_6b")(x)
+            x = InceptionC(160, name="Mixed_6c")(x)
+            x = InceptionC(160, name="Mixed_6d")(x)
+            x = InceptionC(192, name="Mixed_6e")(x)
+            x = InceptionD(name="Mixed_7a")(x)
+            x = InceptionE(name="Mixed_7b")(x)
+            x = InceptionE(name="Mixed_7c")(x)
+            return x.mean(axis=(1, 2))  # global average pool -> (N, 2048)
+
+else:  # pragma: no cover
+    InceptionV3 = None  # type: ignore[assignment,misc]
+
+
+def _convert_basic_conv(src: Mapping[str, Any], prefix: str) -> Dict[str, Dict[str, Array]]:
+    """torchvision ``BasicConv2d`` tensors -> flax {conv: {kernel}, bn: {...}}."""
+    import numpy as np
+
+    w = np.asarray(src[f"{prefix}.conv.weight"])  # (O, I, kH, kW)
+    return {
+        "conv": {"kernel": jnp.asarray(w.transpose(2, 3, 1, 0))},
+        "bn": {
+            "scale": jnp.asarray(np.asarray(src[f"{prefix}.bn.weight"])),
+            "bias": jnp.asarray(np.asarray(src[f"{prefix}.bn.bias"])),
+        },
+    }
+
+
+def _convert_basic_conv_stats(src: Mapping[str, Any], prefix: str) -> Dict[str, Dict[str, Array]]:
+    import numpy as np
+
+    return {
+        "bn": {
+            "mean": jnp.asarray(np.asarray(src[f"{prefix}.bn.running_mean"])),
+            "var": jnp.asarray(np.asarray(src[f"{prefix}.bn.running_var"])),
+        }
+    }
+
+
+_STEM = ["Conv2d_1a_3x3", "Conv2d_2a_3x3", "Conv2d_2b_3x3", "Conv2d_3b_1x1", "Conv2d_4a_3x3"]
+_BLOCK_CONVS: Dict[str, Sequence[str]] = {
+    "Mixed_5b": ["branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"],
+    "Mixed_6a": ["branch3x3", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3"],
+    "Mixed_6b": ["branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3", "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3", "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"],
+    "Mixed_7a": ["branch3x3_1", "branch3x3_2", "branch7x7x3_1", "branch7x7x3_2", "branch7x7x3_3", "branch7x7x3_4"],
+    "Mixed_7b": ["branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b", "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a", "branch3x3dbl_3b", "branch_pool"],
+}
+_BLOCK_ALIASES = {
+    "Mixed_5c": "Mixed_5b",
+    "Mixed_5d": "Mixed_5b",
+    "Mixed_6c": "Mixed_6b",
+    "Mixed_6d": "Mixed_6b",
+    "Mixed_6e": "Mixed_6b",
+    "Mixed_7c": "Mixed_7b",
+}
+_ALL_BLOCKS = ["Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a", "Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e", "Mixed_7a", "Mixed_7b", "Mixed_7c"]
+
+
+def from_torch_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Convert a torchvision ``inception_v3`` state dict to flax variables.
+
+    Returns ``{"params": ..., "batch_stats": ...}`` ready for ``InceptionV3().apply``.
+    Aux-head and fc keys are ignored.
+    """
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    for name in _STEM:
+        params[name] = _convert_basic_conv(state_dict, name)
+        stats[name] = _convert_basic_conv_stats(state_dict, name)
+    for block in _ALL_BLOCKS:
+        layout = _BLOCK_CONVS[_BLOCK_ALIASES.get(block, block)]
+        params[block] = {c: _convert_basic_conv(state_dict, f"{block}.{c}") for c in layout}
+        stats[block] = {c: _convert_basic_conv_stats(state_dict, f"{block}.{c}") for c in layout}
+    return {"params": params, "batch_stats": stats}
+
+
+def inception_v3_extractor(
+    state_dict: Optional[Mapping[str, Any]] = None,
+    variables: Optional[Dict[str, Any]] = None,
+    dtype: jnp.dtype = jnp.float32,
+):
+    """Build the ``imgs -> (N, 2048)`` callable the image metrics accept.
+
+    Pass either a torch(vision) ``state_dict`` (converted here) or ready flax
+    ``variables``. With neither, parameters are randomly initialised — shapes and the
+    compiled graph are real, but FID values are meaningless until weights are loaded
+    (no pretrained weights are bundled; the reference has the same failure mode when
+    ``torch-fidelity`` is absent).
+    """
+    if nn is None:  # pragma: no cover
+        raise ModuleNotFoundError("flax is required for the built-in InceptionV3 extractor")
+    model = InceptionV3()
+    if variables is None:
+        if state_dict is not None:
+            variables = from_torch_state_dict(state_dict)
+        else:
+            variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 299, 299), jnp.float32))
+
+    def apply(imgs: Array) -> Array:
+        # keep integer dtypes intact: the trunk's own uint8 -> /255 normalisation must
+        # see them (casting first would skip it and feed [-1, 509] to the network)
+        if not jnp.issubdtype(imgs.dtype, jnp.integer):
+            imgs = imgs.astype(dtype)
+        return model.apply(variables, imgs)
+
+    return jax.jit(apply)

@@ -1,0 +1,103 @@
+"""Flax VGG16 feature slices for LPIPS.
+
+Mirrors the vendored ``Vgg16`` in the reference (``functional/image/lpips.py:134-187``):
+five conv stages whose post-relu activations (relu1_2, relu2_2, relu3_3, relu4_3,
+relu5_3) feed the LPIPS linear heads. ``from_torch_state_dict`` converts a torchvision
+``vgg16().features`` checkpoint (layer-indexed keys ``features.N.weight``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
+
+Array = jax.Array
+
+# torchvision vgg16.features conv layer indices, grouped by stage
+_STAGES: Tuple[Tuple[int, ...], ...] = ((0, 2), (5, 7), (10, 12, 14), (17, 19, 21), (24, 26, 28))
+_WIDTHS: Tuple[int, ...] = (64, 128, 256, 512, 512)
+
+# ImageNet normalisation baked into the LPIPS scaling layer (lpips.py:46-55)
+_SHIFT = jnp.asarray([-0.030, -0.088, -0.188])
+_SCALE = jnp.asarray([0.458, 0.448, 0.450])
+
+
+if nn is not None:
+
+    class VGG16Features(nn.Module):
+        """``__call__`` maps NCHW/NHWC images -> 5 post-relu stage features (NHWC).
+
+        ``apply_scaling=True`` applies the LPIPS ScalingLayer to raw [-1, 1] inputs;
+        use ``False`` when composing with a pipeline that already scaled (the LPIPS
+        functional pipeline applies ``scaling_layer`` itself).
+        """
+
+        apply_scaling: bool = True
+
+        @nn.compact
+        def __call__(self, x: Array) -> List[Array]:
+            if x.shape[1] == 3 and x.shape[-1] != 3:  # NCHW -> NHWC
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            if self.apply_scaling:
+                x = (x - _SHIFT) / _SCALE  # LPIPS ScalingLayer on [-1, 1] inputs
+            outs = []
+            for si, stage in enumerate(_STAGES):
+                for li in stage:
+                    x = nn.Conv(_WIDTHS[si], (3, 3), padding=((1, 1), (1, 1)), name=f"conv{li}")(x)
+                    x = nn.relu(x)
+                outs.append(x)
+                if si < len(_STAGES) - 1:
+                    x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            return outs
+
+else:  # pragma: no cover
+    VGG16Features = None  # type: ignore[assignment,misc]
+
+
+def from_torch_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Convert torchvision ``vgg16`` (or bare ``features``) weights to flax variables."""
+    import numpy as np
+
+    prefix = "features." if any(k.startswith("features.") for k in state_dict) else ""
+    params: Dict[str, Any] = {}
+    for stage in _STAGES:
+        for li in stage:
+            w = np.asarray(state_dict[f"{prefix}{li}.weight"])  # (O, I, 3, 3)
+            b = np.asarray(state_dict[f"{prefix}{li}.bias"])
+            params[f"conv{li}"] = {"kernel": jnp.asarray(w.transpose(2, 3, 1, 0)), "bias": jnp.asarray(b)}
+    return {"params": params}
+
+
+def vgg16_lpips_extractor(
+    state_dict: Optional[Mapping[str, Any]] = None,
+    variables: Optional[Dict[str, Any]] = None,
+):
+    """Build the ``feats_fn`` the LPIPS pipeline injects: NCHW in -> 5 NCHW stage maps.
+
+    Drop-in for ``functional.image.lpips.make_lpips_net``: the pipeline applies the
+    ScalingLayer itself, so scaling is disabled here, and outputs are returned NCHW
+    (channel axis 1) as ``normalize_tensor``/the linear heads expect. Random init
+    without weights — real topology/compile, meaningless LPIPS values until a
+    torchvision checkpoint is converted in (nothing is bundled; zero egress).
+    """
+    if nn is None:  # pragma: no cover
+        raise ModuleNotFoundError("flax is required for the built-in VGG16 extractor")
+    model = VGG16Features(apply_scaling=False)
+    if variables is None:
+        if state_dict is not None:
+            variables = from_torch_state_dict(state_dict)
+        else:
+            variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 64, 64), jnp.float32))
+
+    def feats_fn(imgs: Array) -> List[Array]:
+        outs = model.apply(variables, imgs)
+        return [jnp.transpose(o, (0, 3, 1, 2)) for o in outs]
+
+    return jax.jit(feats_fn)
