@@ -1,0 +1,54 @@
+"""BERTScore with your own embedding model (counterpart of reference
+``examples/bert_score-own_model.py``).
+
+The metric accepts any tokenizer + forward function pair — here a tiny
+hash-embedding "model" that runs entirely in jax, so the example needs no
+pretrained download. Swap ``tokenizer``/``forward_fn`` for a Flax transformer
+(e.g. ``transformers.FlaxAutoModel``) to get real BERTScore values.
+"""
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.text import BERTScore
+
+_VOCAB_BUCKETS = 512
+_DIM = 64
+_MAX_LEN = 16
+
+
+def tokenizer(sentences: List[str], max_length: int = _MAX_LEN) -> Dict[str, jnp.ndarray]:
+    """Whitespace tokens hashed into id buckets, padded to ``max_length``."""
+    ids = jnp.zeros((len(sentences), max_length), dtype=jnp.int32)
+    mask = jnp.zeros((len(sentences), max_length), dtype=jnp.int32)
+    for i, sentence in enumerate(sentences):
+        toks = [hash(w) % _VOCAB_BUCKETS for w in sentence.lower().split()][:max_length]
+        ids = ids.at[i, : len(toks)].set(jnp.asarray(toks, dtype=jnp.int32))
+        mask = mask.at[i, : len(toks)].set(1)
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+# a fixed random embedding table stands in for the transformer encoder
+_EMBED = jax.random.normal(jax.random.PRNGKey(0), (_VOCAB_BUCKETS, _DIM))
+
+
+def forward_fn(input_ids: jnp.ndarray, attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """(B, L) ids -> (B, L, D) contextual-ish embeddings (here: table lookup)."""
+    return _EMBED[input_ids]
+
+
+def main():
+    preds = ["hello there", "the cat sat on the mat"]
+    target = ["hello there", "a cat sat on the mat"]
+
+    metric = BERTScore(model=forward_fn, user_tokenizer=tokenizer, max_length=_MAX_LEN)
+    metric.update(preds, target)
+    score = metric.compute()
+    for key in ("precision", "recall", "f1"):
+        print(f"{key:>9s}: {[round(float(v), 4) for v in jnp.atleast_1d(jnp.asarray(score[key]))]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Mean Average Precision on COCO-style predictions (counterpart of reference
+``examples/detection_map.py``).
+
+Demonstrates the list-state detection metric: per-image prediction/target dicts,
+box-format handling, and the per-class breakdown.
+"""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+
+def main():
+    # two images: one clean hit, one with a duplicate + a miss
+    preds = [
+        {
+            "boxes": jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+            "scores": jnp.asarray([0.536]),
+            "labels": jnp.asarray([0]),
+        },
+        {
+            "boxes": jnp.asarray([[12.0, 8.0, 110.0, 96.0], [14.0, 10.0, 112.0, 94.0], [300.0, 300.0, 340.0, 350.0]]),
+            "scores": jnp.asarray([0.81, 0.63, 0.41]),
+            "labels": jnp.asarray([1, 1, 2]),
+        },
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+            "labels": jnp.asarray([0]),
+        },
+        {
+            "boxes": jnp.asarray([[10.0, 9.0, 108.0, 95.0]]),
+            "labels": jnp.asarray([1]),
+        },
+    ]
+
+    metric = MeanAveragePrecision(box_format="xyxy", iou_type="bbox", class_metrics=True)
+    metric.update(preds, target)
+    result = metric.compute()
+    for key, value in sorted(result.items()):
+        arr = jnp.asarray(value)
+        if arr.ndim == 0:
+            print(f"{key:>20s}: {float(arr):.4f}")
+        else:
+            print(f"{key:>20s}: {[round(float(v), 4) for v in arr]}")
+
+
+if __name__ == "__main__":
+    main()

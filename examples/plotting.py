@@ -1,0 +1,73 @@
+"""Plotting metric values and curves (counterpart of reference ``examples/plotting.py``).
+
+Every metric carries ``plot_lower_bound``/``plot_upper_bound``/``legend_name`` class
+metadata and a ``.plot()`` method backed by the shared plot engine
+(``torchmetrics_tpu/utilities/plot.py``). Run with matplotlib installed:
+
+    python examples/plotting.py accuracy|confusion_matrix|pr_curve|tracker
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy_example():
+    """Plot a scalar metric's value for a single step."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    key = jax.random.PRNGKey(0)
+    metric = MulticlassAccuracy(num_classes=5)
+    metric.update(jax.random.normal(key, (64, 5)), jax.random.randint(key, (64,), 0, 5))
+    fig, ax = metric.plot()
+    return fig, ax
+
+
+def confusion_matrix_example():
+    """Plot a confusion matrix heatmap."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    key = jax.random.PRNGKey(1)
+    metric = MulticlassConfusionMatrix(num_classes=5)
+    metric.update(jax.random.randint(key, (100,), 0, 5), jax.random.randint(jax.random.fold_in(key, 1), (100,), 0, 5))
+    fig, ax = metric.plot()
+    return fig, ax
+
+
+def pr_curve_example():
+    """Plot a binned precision-recall curve."""
+    from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+
+    key = jax.random.PRNGKey(2)
+    metric = BinaryPrecisionRecallCurve(thresholds=50)
+    metric.update(jax.random.uniform(key, (256,)), jax.random.randint(jax.random.fold_in(key, 1), (256,), 0, 2))
+    fig, ax = metric.plot()
+    return fig, ax
+
+
+def tracker_example():
+    """Plot a metric's trajectory over epochs via MetricTracker."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.wrappers import MetricTracker
+
+    key = jax.random.PRNGKey(3)
+    tracker = MetricTracker(BinaryAccuracy())
+    for epoch in range(5):
+        tracker.increment()
+        k = jax.random.fold_in(key, epoch)
+        tracker.update(jax.random.uniform(k, (128,)), jax.random.randint(jax.random.fold_in(k, 1), (128,), 0, 2))
+    fig, ax = tracker.plot()
+    return fig, ax
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "accuracy"
+    fig, _ = {
+        "accuracy": accuracy_example,
+        "confusion_matrix": confusion_matrix_example,
+        "pr_curve": pr_curve_example,
+        "tracker": tracker_example,
+    }[which]()
+    fig.savefig(f"plot_{which}.png")
+    print(f"wrote plot_{which}.png")

@@ -1,0 +1,41 @@
+"""ROUGE with a custom normalizer and tokenizer (counterpart of reference
+``examples/rouge_score-own_normalizer_and_tokenizer.py``).
+
+By default the ROUGE implementation lower-cases, strips non-alphanumerics, and splits
+on whitespace. Both stages are injectable — useful for languages or domains where the
+default regex is wrong (accented characters, code, CJK...).
+"""
+
+import re
+from typing import Sequence
+
+from torchmetrics_tpu.functional.text import rouge_score
+
+
+def accent_preserving_normalizer(text: str) -> str:
+    """Keep unicode word characters (the default regex would strip accents)."""
+    return re.sub(r"[^\w]+", " ", text.lower())
+
+
+def simple_tokenizer(text: str) -> Sequence[str]:
+    return text.split()
+
+
+def main():
+    preds = "Général Kenobi vous êtes audacieux"
+    target = "Général Kenobi vous êtes un négociateur audacieux"
+
+    default = rouge_score(preds, target, rouge_keys="rouge1")
+    custom = rouge_score(
+        preds,
+        target,
+        rouge_keys="rouge1",
+        normalizer=accent_preserving_normalizer,
+        tokenizer=simple_tokenizer,
+    )
+    print("default normalizer  rouge1_fmeasure:", round(float(default["rouge1_fmeasure"]), 4))
+    print("accent-preserving   rouge1_fmeasure:", round(float(custom["rouge1_fmeasure"]), 4))
+
+
+if __name__ == "__main__":
+    main()

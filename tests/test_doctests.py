@@ -1,0 +1,42 @@
+"""Every docstring example is itself a test (reference ``pyproject.toml:28-31`` runs
+``--doctest-modules`` over the whole package; here doctests are collected explicitly so
+the CPU-mesh conftest env applies)."""
+
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import torchmetrics_tpu
+
+
+def _modules_with_doctests():
+    found = []
+    for info in pkgutil.walk_packages(torchmetrics_tpu.__path__, prefix="torchmetrics_tpu."):
+        if "native" in info.name:  # requires the compiled C++ library
+            continue
+        try:
+            mod = importlib.import_module(info.name)
+        except Exception:
+            continue
+        if doctest.DocTestFinder().find(mod) and any(
+            t.examples for t in doctest.DocTestFinder().find(mod)
+        ):
+            found.append(info.name)
+    return sorted(found)
+
+
+_MODULES = _modules_with_doctests()
+
+
+def test_doctest_modules_discovered():
+    # guard against the discovery silently collapsing
+    assert len(_MODULES) >= 15, _MODULES
+
+
+@pytest.mark.parametrize("module_name", _MODULES)
+def test_doctest(module_name):
+    mod = importlib.import_module(module_name)
+    results = doctest.testmod(mod, verbose=False, optionflags=doctest.NORMALIZE_WHITESPACE)
+    assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"

@@ -109,7 +109,16 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum of a value stream (reference ``aggregation.py:300``)."""
+    """Running sum of a value stream (reference ``aggregation.py:300``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> print(float(metric.compute()))
+        6.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
@@ -141,7 +150,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (reference ``aggregation.py:459-560``)."""
+    """Weighted running mean (reference ``aggregation.py:459-560``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> print(float(metric.compute()))
+        2.0
+    """
 
     weight: Array
 
@@ -172,7 +191,8 @@ class RunningMean(Running):
 
 
 class RunningSum(Running):
-    """Sum over a running window (reference ``aggregation.py:629``)."""
+    """Sum over a running window (reference ``aggregation.py:629``).
+    """
 
     def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

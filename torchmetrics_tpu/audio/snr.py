@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class SignalNoiseRatio(_MeanOfBatchValues):
-    """Average SNR over all seen samples (reference ``snr.py:35-139``)."""
+    """Average SNR over all seen samples (reference ``snr.py:35-139``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SignalNoiseRatio()
+        >>> print(round(float(snr(preds, target)), 4))
+        16.1805
+    """
 
     plot_lower_bound = None
     plot_upper_bound = None
@@ -38,7 +48,8 @@ class ScaleInvariantSignalNoiseRatio(_MeanOfBatchValues):
 
 
 class ComplexScaleInvariantSignalNoiseRatio(_MeanOfBatchValues):
-    """Average C-SI-SNR (reference ``snr.py:239-330``)."""
+    """Average C-SI-SNR (reference ``snr.py:239-330``).
+    """
 
     def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -23,7 +23,17 @@ Array = jax.Array
 
 
 class BinaryAccuracy(BinaryStatScores):
-    """Accuracy for binary tasks (reference ``accuracy.py:30-135``)."""
+    """Accuracy for binary tasks (reference ``accuracy.py:30-135``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> metric = BinaryAccuracy()
+        >>> print(float(metric(preds, target)))
+        0.6666666865348816
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -54,7 +64,8 @@ class MulticlassAccuracy(MulticlassStatScores):
 
 
 class MultilabelAccuracy(MultilabelStatScores):
-    """Accuracy for multilabel tasks (reference ``accuracy.py:283-430``)."""
+    """Accuracy for multilabel tasks (reference ``accuracy.py:283-430``).
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -72,7 +83,17 @@ class MultilabelAccuracy(MultilabelStatScores):
 
 
 class Accuracy:
-    """Task router: returns the Binary/Multiclass/Multilabel variant (reference ``accuracy.py:433-553``)."""
+    """Task router: returns the Binary/Multiclass/Multilabel variant (reference ``accuracy.py:433-553``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> accuracy = Accuracy(task='multiclass', num_classes=4)
+        >>> print(float(accuracy(preds, target)))
+        0.5
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -129,7 +129,17 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
 
 
 class AUROC:
-    """Task router (reference ``auroc.py`` legacy class)."""
+    """Task router (reference ``auroc.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> auroc = AUROC(task='binary')
+        >>> print(float(auroc(preds, target)))
+        0.5
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -165,7 +165,18 @@ class MultilabelConfusionMatrix(Metric):
 
 
 class ConfusionMatrix:
-    """Task router (reference ``confusion_matrix.py`` legacy class)."""
+    """Task router (reference ``confusion_matrix.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ConfusionMatrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confmat = ConfusionMatrix(task='binary')
+        >>> print(confmat(preds, target))
+        [[2 0]
+         [1 1]]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -244,7 +244,17 @@ class FBetaScore:
 
 
 class F1Score:
-    """Task router (reference ``f_beta.py`` legacy class)."""
+    """Task router (reference ``f_beta.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import F1Score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> f1 = F1Score(task='multiclass', num_classes=3)
+        >>> print(round(float(f1(preds, target)), 4))
+        0.3333
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -239,7 +239,22 @@ class MultilabelPrecisionRecallCurve(Metric):
 
 
 class PrecisionRecallCurve:
-    """Task router (reference ``precision_recall_curve.py`` legacy class)."""
+    """Task router (reference ``precision_recall_curve.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PrecisionRecallCurve
+        >>> pred = jnp.asarray([0.0, 0.5, 0.7, 0.8])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> pr_curve = PrecisionRecallCurve(task='binary', thresholds=5)
+        >>> precision, recall, thresholds = pr_curve(pred, target)
+        >>> print(precision)
+        [0.5       0.6666667 0.6666667 0.        0.        1.       ]
+        >>> print(recall)
+        [1. 1. 1. 0. 0. 0.]
+        >>> print(thresholds)
+        [0.   0.25 0.5  0.75 1.  ]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -28,6 +28,17 @@ class MetricCollection:
     Metrics with identical states (e.g. accuracy/precision/recall over the same
     stat-scores) form a compute group: only the group leader runs ``update``; members
     receive the leader's state (array references) lazily.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+        >>> target = jnp.asarray([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.asarray([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([MulticlassAccuracy(num_classes=3, average='micro'), MulticlassPrecision(num_classes=3, average='macro')])
+        >>> result = metrics(preds, target)
+        >>> print({k: round(float(v), 4) for k, v in sorted(result.items())})
+        {'MulticlassAccuracy': 0.125, 'MulticlassPrecision': 0.0667}
     """
 
     _groups: Dict[int, List[str]]
@@ -55,7 +66,8 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Per-metric ``forward`` (batch values); kwargs filtered per signature (reference ``:153-160``)."""
+        """Per-metric ``forward`` (batch values); kwargs filtered per signature (reference ``:153-160``).
+    """
         return self._compute_and_reduce("forward", *args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:

@@ -22,7 +22,17 @@ Array = jax.Array
 
 
 class IntersectionOverUnion(Metric):
-    """Mean IoU over matched detection/ground-truth boxes (reference ``iou.py:38``)."""
+    """Mean IoU over matched detection/ground-truth boxes (reference ``iou.py:38``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+        >>> preds = [{'boxes': jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), 'scores': jnp.asarray([0.236]), 'labels': jnp.asarray([4])}]
+        >>> target = [{'boxes': jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), 'labels': jnp.asarray([4])}]
+        >>> metric = IntersectionOverUnion()
+        >>> print({k: round(float(v), 4) for k, v in metric(preds, target).items()})
+        {'iou': 0.6898}
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True

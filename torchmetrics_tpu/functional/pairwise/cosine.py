@@ -31,6 +31,17 @@ def pairwise_cosine_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    r"""Pairwise cosine similarity between rows of ``x`` (and ``y``) (reference ``cosine.py:48-93``)."""
+    r"""Pairwise cosine similarity between rows of ``x`` (and ``y``) (reference ``cosine.py:48-93``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> print(jnp.round(pairwise_cosine_similarity(x, y), 4))
+        [[0.5547 0.8682]
+         [0.5145 0.8437]
+         [0.53   0.8533]]
+    """
     distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

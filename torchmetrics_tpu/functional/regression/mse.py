@@ -29,6 +29,15 @@ def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Union[int, Arra
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
-    """MSE (or RMSE with ``squared=False``); reference ``mse.py:63-88``."""
+    """MSE (or RMSE with ``squared=False``); reference ``mse.py:63-88``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mean_squared_error
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> print(float(mean_squared_error(x, y)))
+        0.25
+    """
     sum_squared_error, n_obs = _mean_squared_error_update(preds, target, num_outputs)
     return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -20,6 +20,15 @@ class PeakSignalNoiseRatio(Metric):
     Scalar sum states when ``dim`` is None; cat list states of per-slice SSE/count
     otherwise. When ``data_range`` is None the observed min/max are tracked as
     min/max-reduced states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PeakSignalNoiseRatio
+        >>> psnr = PeakSignalNoiseRatio()
+        >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> print(round(float(psnr(preds, target)), 4))
+        2.5527
     """
 
     is_differentiable: bool = True
@@ -53,8 +62,8 @@ class PeakSignalNoiseRatio(Metric):
         if data_range is None:
             if dim is not None:
                 raise ValueError("The `data_range` must be given when `dim` is not None.")
-            self.add_state("min_target", jnp.asarray(0.0), dist_reduce_fx=jnp.minimum)
-            self.add_state("max_target", jnp.asarray(0.0), dist_reduce_fx=jnp.maximum)
+            self.add_state("min_target", jnp.asarray(0.0), dist_reduce_fx="min")
+            self.add_state("max_target", jnp.asarray(0.0), dist_reduce_fx="max")
         elif isinstance(data_range, tuple):
             self.add_state("data_range", jnp.asarray(float(data_range[1] - data_range[0])), dist_reduce_fx="mean")
             self.clamping_fn = lambda x: jnp.clip(x, data_range[0], data_range[1])

@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class CramersV(Metric):
-    """Cramer's V with a device confusion-matrix sum state (reference ``cramers.py:26-133``)."""
+    """Cramer's V with a device confusion-matrix sum state (reference ``cramers.py:26-133``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> preds = jnp.asarray([0, 1, 2, 1, 0, 2, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 2, 0, 2, 1, 2, 0, 0])
+        >>> cramers_v = CramersV(num_classes=3)
+        >>> print(round(float(cramers_v(preds, target)), 4))
+        0.6614
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class MeanSquaredError(Metric):
-    """MSE / RMSE (reference ``mse.py:26-120``)."""
+    """MSE / RMSE (reference ``mse.py:26-120``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredError
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> mean_squared_error = MeanSquaredError()
+        >>> print(float(mean_squared_error(preds, target)))
+        0.875
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -25,7 +25,17 @@ Array = jax.Array
 
 
 class PearsonCorrCoef(Metric):
-    """Pearson r from streaming moments (reference ``pearson.py:72-163``)."""
+    """Pearson r from streaming moments (reference ``pearson.py:72-163``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PearsonCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> pearson = PearsonCorrCoef()
+        >>> print(round(float(pearson(preds, target)), 4))
+        0.9849
+    """
 
     is_differentiable: bool = True
     higher_is_better: Optional[bool] = None  # both +1 and -1 are "good"

@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class R2Score(Metric):
-    """R² with optional adjustment (reference ``r2.py:27-135``)."""
+    """R² with optional adjustment (reference ``r2.py:27-135``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import R2Score
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> r2score = R2Score()
+        >>> print(round(float(r2score(preds, target)), 4))
+        0.9486
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True

@@ -13,7 +13,18 @@ Array = jax.Array
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision over queries, batched over the dense rank matrix."""
+    """Mean average precision over queries, batched over the dense rank matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> print(round(float(rmap(preds, target, indexes=indexes)), 4))
+        0.7917
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, **kwargs: Any) -> None:

@@ -14,7 +14,16 @@ Array = jax.Array
 
 
 class BLEUScore(Metric):
-    """BLEU with per-order numerator/denominator tensor states (reference ``bleu.py:28-124``)."""
+    """BLEU with per-order numerator/denominator tensor states (reference ``bleu.py:28-124``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu = BLEUScore()
+        >>> print(round(float(bleu(preds, target)), 4))
+        0.7598
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -14,7 +14,19 @@ Array = jax.Array
 
 
 class Perplexity(Metric):
-    """Perplexity with Σ−logp / count states (reference ``perplexity.py:28-111``)."""
+    """Perplexity with Σ−logp / count states (reference ``perplexity.py:28-111``).
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import Perplexity
+        >>> gen = jax.random.PRNGKey(22)
+        >>> preds = jax.random.normal(gen, (2, 8, 5))
+        >>> target = jnp.asarray([[0, 1, 2, 3, 4, 0, 1, 2], [2, 3, 4, 0, 1, 2, 3, 4]])
+        >>> perp = Perplexity()
+        >>> print(round(float(perp(preds, target)), 4))
+        10.1364
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

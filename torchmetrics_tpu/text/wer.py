@@ -21,7 +21,16 @@ Array = jax.Array
 
 
 class WordErrorRate(Metric):
-    """WER (reference ``wer.py:25-91``)."""
+    """WER (reference ``wer.py:25-91``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordErrorRate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> wer = WordErrorRate()
+        >>> print(float(wer(preds, target)))
+        0.5
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = False
@@ -133,7 +142,8 @@ class WordInfoLost(Metric):
 
 
 class WordInfoPreserved(Metric):
-    """WIP (reference ``wip.py:25-92``)."""
+    """WIP (reference ``wip.py:25-92``).
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True
