@@ -1,0 +1,62 @@
+"""Generate docs/api/*.md symbol listings from the live package exports."""
+
+import importlib
+import inspect
+import os
+import pathlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402  (the axon sitecustomize overrides the env var; pin the config)
+
+jax.config.update("jax_platforms", "cpu")
+
+DOMAINS = [
+    ("torchmetrics_tpu", "Root exports"),
+    ("torchmetrics_tpu.functional", "Functional API"),
+    ("torchmetrics_tpu.classification", "Classification"),
+    ("torchmetrics_tpu.regression", "Regression"),
+    ("torchmetrics_tpu.image", "Image"),
+    ("torchmetrics_tpu.text", "Text"),
+    ("torchmetrics_tpu.audio", "Audio"),
+    ("torchmetrics_tpu.detection", "Detection"),
+    ("torchmetrics_tpu.retrieval", "Retrieval"),
+    ("torchmetrics_tpu.nominal", "Nominal"),
+    ("torchmetrics_tpu.multimodal", "Multimodal"),
+    ("torchmetrics_tpu.wrappers", "Wrappers"),
+    ("torchmetrics_tpu.ops", "TPU compute kernels"),
+    ("torchmetrics_tpu.models", "Feature-extractor models"),
+    ("torchmetrics_tpu.parallel", "Parallel / sync"),
+]
+
+OUT = pathlib.Path(__file__).parent / "api"
+
+
+def first_line(obj) -> str:
+    doc = inspect.getdoc(obj) or ""
+    return doc.split("\n")[0].strip()
+
+
+def main() -> None:
+    OUT.mkdir(exist_ok=True)
+    index = ["# API reference", ""]
+    for mod_name, title in DOMAINS:
+        mod = importlib.import_module(mod_name)
+        names = sorted(set(getattr(mod, "__all__", dir(mod))))
+        lines = [f"# {title} (`{mod_name}`)", ""]
+        for name in names:
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            kind = "class" if inspect.isclass(obj) else "function" if callable(obj) else "object"
+            lines.append(f"- **`{name}`** ({kind}) — {first_line(obj)}")
+        slug = mod_name.replace("torchmetrics_tpu", "root").replace(".", "_")
+        (OUT / f"{slug}.md").write_text("\n".join(lines) + "\n")
+        index.append(f"- [{title}]({slug}.md) — {len([n for n in names if not n.startswith('_')])} symbols")
+    (OUT / "index.md").write_text("\n".join(index) + "\n")
+    print(f"wrote {len(DOMAINS) + 1} files to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
