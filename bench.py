@@ -12,8 +12,9 @@ Covers BASELINE.json scenarios #1-#3 at realistic, compute-bound shapes plus an
                   tensor-native tier; BERTScore/ROUGE are host-tokenised by design)
 - ``det_iou``:    batched pairwise box IoU, 64 images x 100x100 boxes (config #5's
                   device-side matching hot op; mAP list states are host-ragged)
-- ``sync_us``:    metric-state psum over an 8-virtual-device CPU mesh in a hermetic
-                  subprocess (config #2's sync half; real ICI numbers need a pod)
+- ``sync_us``:    metric-state psum swept over 8/16/32-virtual-device CPU meshes in
+                  hermetic subprocesses (config #2's sync half and the north star's
+                  8->256 scaling axis; real ICI numbers need a pod)
 
 Each "ours" number is a jitted state-in/state-out update step on the TPU; each baseline
 is a faithful torch-eager re-expression of the reference's update stage (the reference
@@ -307,16 +308,17 @@ def bench_torch():
 
 
 _SYNC_PROBE = r"""
-import os
+import os, sys
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
 import time
 import jax, jax.numpy as jnp
 jax.config.update("jax_platforms", "cpu")
 from jax.sharding import PartitionSpec as P
 from torchmetrics_tpu.parallel import EvalMesh
 
-mesh = EvalMesh(8)
+mesh = EvalMesh(n)
 
 def sync(flat_state):
     return jax.lax.psum(flat_state, mesh.axis)
@@ -324,7 +326,7 @@ def sync(flat_state):
 # metric state coalesced into one flat per-chip vector -> a single collective per sync
 synced = jax.jit(jax.shard_map(sync, mesh=mesh.mesh, in_specs=P(mesh.axis), out_specs=P()))
 # config #2's per-chip state: binned curve 200*10*2*2 + confusion matrix 10*10 = 8100
-flat = mesh.shard_batch(jnp.ones((8, 8100)))
+flat = mesh.shard_batch(jnp.ones((n, 8100)))
 synced(flat).block_until_ready()
 t0 = time.perf_counter()
 for _ in range(50):
@@ -335,13 +337,18 @@ print((time.perf_counter() - t0) / 50 * 1e6)
 """
 
 
-def bench_sync_latency():
-    """8-virtual-device psum of a metric state pytree, hermetic CPU subprocess."""
+def bench_sync_latency(n_devices=8):
+    """Metric-state psum over an n-virtual-device mesh, hermetic CPU subprocess.
+
+    The north-star metric is sync latency scaling 8 -> 256 chips; without a pod the
+    virtual CPU mesh gives the collective-count/geometry scaling (real ICI latency
+    needs hardware). ``main`` sweeps 8/16/32.
+    """
     from _hermetic_env import hermetic_cpu_env
 
-    env = hermetic_cpu_env(8)
+    env = hermetic_cpu_env(n_devices)
     proc = subprocess.run(
-        [sys.executable, "-c", _SYNC_PROBE], capture_output=True, text=True, timeout=300, env=env,
+        [sys.executable, "-c", _SYNC_PROBE, str(n_devices)], capture_output=True, text=True, timeout=300, env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     for line in reversed(proc.stdout.strip().splitlines()):
@@ -349,8 +356,7 @@ def bench_sync_latency():
             return float(line)
         except ValueError:
             continue
-    print(f"sync probe failed rc={proc.returncode}: {proc.stderr.strip()[-500:]}", file=sys.stderr)
-    return None
+    raise RuntimeError(f"sync probe produced no number: {proc.stdout[-500:]!r} {proc.stderr[-500:]!r}")
 
 
 def main():
@@ -359,10 +365,12 @@ def main():
         baseline = bench_torch()
     except Exception:
         baseline = {}
-    try:
-        sync_us = bench_sync_latency()
-    except Exception:
-        sync_us = None
+    sync_sweep = {}
+    for n in (8, 16, 32):
+        try:
+            sync_sweep[n] = bench_sync_latency(n)
+        except Exception as err:
+            print(f"sync probe failed for {n} devices: {err}", file=sys.stderr)
 
     extras = {}
     for key, ours_us in ours.items():
@@ -370,8 +378,8 @@ def main():
         if key in baseline:
             extras[key.replace("_us", "_us_torch")] = round(baseline[key], 2)
             extras[key.replace("_us", "_speedup")] = round(baseline[key] / ours_us, 3)
-    if sync_us is not None:
-        extras["mesh8_sync_us"] = round(sync_us, 2)
+    for n, sync_us in sync_sweep.items():
+        extras[f"mesh{n}_sync_us"] = round(sync_us, 2)
 
     vs = baseline.get("accuracy_us", ours["accuracy_us"]) / ours["accuracy_us"]
     print(

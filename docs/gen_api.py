@@ -50,10 +50,11 @@ def main() -> None:
             if obj is None:
                 continue
             kind = "class" if inspect.isclass(obj) else "function" if callable(obj) else "object"
-            lines.append(f"- **`{name}`** ({kind}) — {first_line(obj)}")
+            desc = first_line(obj)
+            lines.append(f"- **`{name}`** ({kind}) — {desc}" if desc else f"- **`{name}`** ({kind})")
         slug = mod_name.replace("torchmetrics_tpu", "root").replace(".", "_")
         (OUT / f"{slug}.md").write_text("\n".join(lines) + "\n")
-        index.append(f"- [{title}]({slug}.md) — {len([n for n in names if not n.startswith('_')])} symbols")
+        index.append(f"- [{title}]({slug}.md) — {len(lines) - 2} symbols")
     (OUT / "index.md").write_text("\n".join(index) + "\n")
     print(f"wrote {len(DOMAINS) + 1} files to {OUT}")
 

@@ -1,0 +1,135 @@
+"""Framework-integration tests (counterpart of reference
+``tests/integrations/test_lightning.py``).
+
+The reference proves metrics compose with a Lightning training loop: per-epoch reset
+semantics, logging values inside steps, collections in loops, scriptability, dtype
+transfer. Here the host framework is a plain flax/optax training loop — the
+BASELINE.json north star requires existing ``metric.update()/.compute()`` scripts to
+run unmodified inside jax training code.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MeanMetric, MetricCollection
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassPrecision
+
+NUM_EPOCHS = 3
+NUM_BATCHES = 4
+BATCH = 32
+CLASSES = 5
+
+
+def _batches(seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(NUM_BATCHES):
+        logits = rng.randn(BATCH, CLASSES).astype(np.float32)
+        labels = rng.randint(0, CLASSES, BATCH)
+        yield jnp.asarray(logits), jnp.asarray(labels)
+
+
+def test_metric_across_epochs_with_reset():
+    """Per-epoch compute + reset mirrors the reference's epoch-end semantics."""
+    metric = MulticlassAccuracy(num_classes=CLASSES, average="micro")
+    epoch_values = []
+    for epoch in range(NUM_EPOCHS):
+        golds, preds_all = [], []
+        for logits, labels in _batches(epoch):
+            metric.update(logits, labels)
+            golds.append(np.asarray(labels))
+            preds_all.append(np.asarray(logits).argmax(-1))
+        val = float(metric.compute())
+        want = float((np.concatenate(preds_all) == np.concatenate(golds)).mean())
+        assert val == pytest.approx(want, abs=1e-6)
+        epoch_values.append(val)
+        metric.reset()
+        assert metric._update_count == 0
+    assert len(set(epoch_values)) > 1  # different epochs saw different data
+
+
+def test_metric_inside_jitted_train_step():
+    """The functional stages drive a jitted train step; the stateful wrapper follows."""
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _multiclass_stat_scores_format,
+        _multiclass_stat_scores_update,
+    )
+
+    params = jnp.zeros((CLASSES,))
+
+    @jax.jit
+    def train_step(params, metric_state, logits, labels):
+        loss = jnp.mean((logits - params[None, :]) ** 2)
+        p, t = _multiclass_stat_scores_format(logits, labels, top_k=1)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(p, t, CLASSES, 1, "micro", "global", None)
+        new_state = jax.tree_util.tree_map(lambda s, d: s + d, metric_state, (tp, fp, tn, fn))
+        return params - 0.1 * jax.grad(lambda q: jnp.mean((logits - q[None, :]) ** 2))(params), new_state, loss
+
+    state = tuple(jnp.asarray(0) for _ in range(4))
+    for logits, labels in _batches(0):
+        params, state, loss = train_step(params, state, logits, labels)
+    tp, fp, tn, fn = state
+    acc = float(tp / (tp + fn))
+    ref = MulticlassAccuracy(num_classes=CLASSES, average="micro")
+    for logits, labels in _batches(0):
+        ref.update(logits, labels)
+    assert acc == pytest.approx(float(ref.compute()), abs=1e-6)
+
+
+def test_collection_logging_in_loop():
+    """Collections update once per step and produce the full dict each epoch."""
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=CLASSES, average="micro"),
+            "prec": MulticlassPrecision(num_classes=CLASSES, average="macro"),
+        }
+    )
+    logged = []
+    for logits, labels in _batches(1):
+        logged.append({k: float(v) for k, v in coll(logits, labels).items()})
+    epoch = {k: float(v) for k, v in coll.compute().items()}
+    assert set(epoch) == {"acc", "prec"}
+    assert all(set(step) == {"acc", "prec"} for step in logged)
+    coll.reset()
+    for m in coll.values():
+        assert m._update_count == 0
+
+
+def test_loss_tracking_with_aggregation():
+    """MeanMetric tracks a scalar loss stream like self.log(on_epoch=True)."""
+    mean_loss = MeanMetric()
+    losses = []
+    for logits, labels in _batches(2):
+        loss = float(jnp.mean(logits**2))
+        mean_loss.update(loss)
+        losses.append(loss)
+    assert float(mean_loss.compute()) == pytest.approx(np.mean(losses), rel=1e-6)
+
+
+def test_set_dtype_transfer():
+    """set_dtype moves states like Lightning's precision plugins move modules."""
+    metric = BinaryAccuracy()
+    metric.update(jnp.asarray([0.1, 0.9, 0.8]), jnp.asarray([0, 1, 1]))
+    metric.set_dtype(jnp.bfloat16)
+    val = metric.compute()
+    assert float(val) == pytest.approx(1.0)
+    metric.set_dtype(jnp.float32)
+    assert float(metric.compute()) == pytest.approx(1.0)
+
+
+def test_state_dict_checkpoint_roundtrip_mid_training():
+    """Persist mid-epoch, restore into a fresh metric, resume — value unchanged."""
+    metric = SumMetric()
+    metric.persistent(True)
+    metric.update(jnp.asarray([1.0, 2.0]))
+    ckpt = metric.state_dict()
+
+    restored = SumMetric()
+    restored.persistent(True)
+    restored.load_state_dict(ckpt)
+    restored.update(jnp.asarray([3.0]))
+
+    metric.update(jnp.asarray([3.0]))
+    assert float(restored.compute()) == float(metric.compute()) == 6.0

@@ -62,18 +62,22 @@ def axis_gather(x: Array, axis_name: str) -> Array:
 
 
 def axis_sum(x: Array, axis_name: str) -> Array:
+    """``psum`` over a mesh axis — the sum-reducible state sync primitive."""
     return lax.psum(x, axis_name)
 
 
 def axis_mean(x: Array, axis_name: str) -> Array:
+    """``pmean`` over a mesh axis."""
     return lax.pmean(x, axis_name)
 
 
 def axis_max(x: Array, axis_name: str) -> Array:
+    """``pmax`` over a mesh axis."""
     return lax.pmax(x, axis_name)
 
 
 def axis_min(x: Array, axis_name: str) -> Array:
+    """``pmin`` over a mesh axis."""
     return lax.pmin(x, axis_name)
 
 
