@@ -1,0 +1,61 @@
+"""Orbax checkpoint/resume round-trips (SURVEY §5.4; reference metric.py:768-816)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MeanMetric, MetricCollection
+from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve, MulticlassAccuracy
+from torchmetrics_tpu.utilities.checkpoint import restore_metric_state, save_metric_state
+
+
+def test_metric_roundtrip(tmp_path):
+    metric = MulticlassAccuracy(num_classes=3, average="micro")
+    metric.update(jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1]]), jnp.asarray([0, 2]))
+    save_metric_state(metric, str(tmp_path / "ckpt"))
+
+    restored = restore_metric_state(MulticlassAccuracy(num_classes=3, average="micro"), str(tmp_path / "ckpt"))
+    assert float(restored.compute()) == float(metric.compute())
+    assert restored._update_count == metric._update_count
+
+    # resuming continues accumulation identically
+    batch = (jnp.asarray([[0.2, 0.7, 0.1]]), jnp.asarray([1]))
+    metric.update(*batch)
+    restored.update(*batch)
+    assert float(restored.compute()) == float(metric.compute())
+
+
+def test_list_state_roundtrip(tmp_path):
+    metric = BinaryPrecisionRecallCurve(thresholds=None)  # unbounded cat list states
+    metric.update(jnp.asarray([0.2, 0.7, 0.4]), jnp.asarray([0, 1, 1]))
+    metric.update(jnp.asarray([0.6, 0.3]), jnp.asarray([1, 0]))
+    save_metric_state(metric, str(tmp_path / "ckpt"))
+
+    restored = restore_metric_state(BinaryPrecisionRecallCurve(thresholds=None), str(tmp_path / "ckpt"))
+    for got, want in zip(restored.compute(), metric.compute()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_collection_roundtrip(tmp_path):
+    coll = MetricCollection({"acc": MulticlassAccuracy(num_classes=3, average="micro"), "mean": MeanMetric()})
+    coll["acc"].update(jnp.asarray([[0.9, 0.05, 0.05]]), jnp.asarray([0]))
+    coll["mean"].update(jnp.asarray(4.0))
+    save_metric_state(coll, str(tmp_path / "ckpt"))
+
+    restored = restore_metric_state(
+        MetricCollection({"acc": MulticlassAccuracy(num_classes=3, average="micro"), "mean": MeanMetric()}),
+        str(tmp_path / "ckpt"),
+    )
+    got = {k: float(v) for k, v in restored.compute().items()}
+    want = {k: float(v) for k, v in coll.compute().items()}
+    assert got == want
+
+
+def test_save_does_not_mutate_persistence_flags(tmp_path):
+    metric = BinaryPrecisionRecallCurve(thresholds=None)  # list states, non-persistent by default
+    metric.update(jnp.asarray([0.2, 0.7]), jnp.asarray([0, 1]))
+    before = dict(metric._persistent)
+    assert not any(before.values())
+    save_metric_state(metric, str(tmp_path / "ckpt"))
+    assert dict(metric._persistent) == before  # flags untouched after snapshot
+    assert metric.state_dict() == {}  # non-persistent states still excluded
