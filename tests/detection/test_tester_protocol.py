@@ -1,0 +1,165 @@
+"""Detection metrics through the universal MetricTester protocol.
+
+IoU-variant metrics run the full three-level check against numpy brute-force box
+goldens; MeanAveragePrecision runs the merge/structural levels with its functional
+single-shot as the consistency golden (independent pycocotools-pinned values live in
+``test_detection.py``). Inputs are lists of per-image dicts — the tester's ``_cat``
+concatenates image lists, mirroring the world-concat of ``dist_reduce_fx=None``
+list states (reference ``detection/mean_ap.py:358-362``).
+"""
+
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+from testers import MetricTester  # noqa: E402
+
+from torchmetrics_tpu.detection import (  # noqa: E402
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+)
+
+NUM_BATCHES, IMGS, BOXES = 4, 3, 5
+
+
+def _rand_boxes(rng, n):
+    xy = rng.rand(n, 2).astype(np.float32) * 100
+    wh = rng.rand(n, 2).astype(np.float32) * 40 + 2
+    return np.concatenate([xy, xy + wh], axis=-1)
+
+
+def _make_inputs(seed, num_labels=2):
+    rng = np.random.RandomState(seed)
+    preds, target = [], []
+    for _ in range(NUM_BATCHES):
+        p_imgs, t_imgs = [], []
+        for _ in range(IMGS):
+            boxes = _rand_boxes(rng, BOXES)
+            t_imgs.append(
+                {
+                    "boxes": jnp.asarray(boxes + rng.randn(BOXES, 4).astype(np.float32)),
+                    "labels": jnp.asarray(rng.randint(0, num_labels, BOXES)),
+                }
+            )
+            p_imgs.append(
+                {
+                    "boxes": jnp.asarray(boxes),
+                    "scores": jnp.asarray(rng.rand(BOXES).astype(np.float32)),
+                    "labels": jnp.asarray(rng.randint(0, num_labels, BOXES)),
+                }
+            )
+        preds.append(p_imgs)
+        target.append(t_imgs)
+    return preds, target
+
+
+def _np_iou_matrix(a, b, kind):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    iou = inter / union
+    if kind == "iou":
+        return iou
+    lt_e = np.minimum(a[:, None, :2], b[None, :, :2])
+    rb_e = np.maximum(a[:, None, 2:], b[None, :, 2:])
+    wh_e = np.clip(rb_e - lt_e, 0, None)
+    if kind == "giou":
+        hull = wh_e[..., 0] * wh_e[..., 1]
+        return iou - (hull - union) / hull
+    # diou / ciou need center distance and diagonal
+    ca = (a[:, None, :2] + a[:, None, 2:]) / 2
+    cb = (b[None, :, :2] + b[None, :, 2:]) / 2
+    rho2 = ((ca - cb) ** 2).sum(-1)
+    diag2 = (wh_e**2).sum(-1)
+    diou = iou - rho2 / diag2
+    if kind == "diou":
+        return diou
+    wa = a[:, 2] - a[:, 0]
+    ha = a[:, 3] - a[:, 1]
+    wb = b[:, 2] - b[:, 0]
+    hb = b[:, 3] - b[:, 1]
+    v = (4 / np.pi**2) * (np.arctan(wb / hb)[None, :] - np.arctan(wa / ha)[:, None]) ** 2
+    alpha = v / np.clip(1 - iou + v, 1e-12, None)
+    return diou - alpha * v
+
+
+_INVALID = {"iou": 0.0, "giou": -1.0, "diou": -1.0, "ciou": -2.0}
+
+
+def _np_iou_metric(kind):
+    """Golden mirroring the reference aggregate (iou.py:38-41,226-248): label-mismatch
+    pairs are masked to the variant's invalid value; per image, matched-label sets take
+    the matrix diagonal, otherwise the whole-matrix mean."""
+
+    def ref(preds, target):
+        per_image = []
+        for p, t in zip(preds, target):
+            mat = _np_iou_matrix(np.asarray(p["boxes"]), np.asarray(t["boxes"]), kind)
+            d_lab, g_lab = np.asarray(p["labels"]), np.asarray(t["labels"])
+            mat = np.where(d_lab[:, None] == g_lab[None, :], mat, _INVALID[kind])
+            labels_eq = d_lab.shape == g_lab.shape and bool((d_lab == g_lab).all())
+            per_image.append(np.diagonal(mat).mean() if labels_eq else mat.mean())
+        return {kind: np.mean(per_image) if per_image else 0.0}
+
+    return ref
+
+
+_CASES = [
+    (IntersectionOverUnion, "iou"),
+    (GeneralizedIntersectionOverUnion, "giou"),
+    (DistanceIntersectionOverUnion, "diou"),
+    (CompleteIntersectionOverUnion, "ciou"),
+]
+
+
+class TestIoUVariantsThroughProtocol(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("metric_class,kind", _CASES, ids=[k for _, k in _CASES])
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_three_level_protocol(self, metric_class, kind, seed):
+        preds, target = _make_inputs(seed)
+        self.run_class_metric_test(preds, target, metric_class, _np_iou_metric(kind))
+
+
+class TestMeanAPThroughProtocol(MetricTester):
+    atol = 1e-6
+
+    def test_merge_and_structural_levels(self):
+        preds, target = _make_inputs(11, num_labels=3)
+
+        def golden(all_preds, all_target):
+            m = MeanAveragePrecision()
+            m.update(all_preds, all_target)
+            out = m.compute()
+            return {k: np.asarray(v) for k, v in out.items() if k != "classes"}
+
+        single = MeanAveragePrecision()
+        for p, t in zip(preds, target):
+            single.update(p, t)
+        want = golden([img for b in preds for img in b], [img for b in target for img in b])
+        got = {k: np.asarray(v) for k, v in single.compute().items() if k != "classes"}
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], atol=1e-6, err_msg=k)
+
+        # world-2 emulation: replicas merge raw list states, compute matches
+        replicas = [MeanAveragePrecision(), MeanAveragePrecision()]
+        for i, (p, t) in enumerate(zip(preds, target)):
+            replicas[i % 2].update(p, t)
+        replicas[0].merge_state(replicas[1])
+        merged = {k: np.asarray(v) for k, v in replicas[0].compute().items() if k != "classes"}
+        for k in want:
+            np.testing.assert_allclose(merged[k], want[k], atol=1e-6, err_msg=k)
+
+        self._run_structural_checks(MeanAveragePrecision, {}, preds, target, [{}] * NUM_BATCHES)

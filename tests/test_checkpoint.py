@@ -59,3 +59,15 @@ def test_save_does_not_mutate_persistence_flags(tmp_path):
     save_metric_state(metric, str(tmp_path / "ckpt"))
     assert dict(metric._persistent) == before  # flags untouched after snapshot
     assert metric.state_dict() == {}  # non-persistent states still excluded
+
+
+def test_restore_clears_compute_cache(tmp_path):
+    src = MeanMetric()
+    src.update(jnp.asarray(10.0))
+    save_metric_state(src, str(tmp_path / "ckpt"))
+
+    live = MeanMetric()
+    live.update(jnp.asarray(99.0))
+    assert float(live.compute()) == 99.0  # caches
+    restore_metric_state(live, str(tmp_path / "ckpt"))
+    assert float(live.compute()) == 10.0  # cache invalidated, restored state wins

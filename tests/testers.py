@@ -88,16 +88,27 @@ class MetricTester:
                 return [x for v in vals for x in v]
             return np.concatenate([np.asarray(v) for v in vals])
 
+        def _cat_kw(batch_ids):
+            """Concatenate per-batch update kwargs the golden also understands."""
+            merged = {}
+            for k in (kw[batch_ids[0]] or {}):
+                if _accepts_kwarg(reference_metric, k):
+                    merged[k] = _cat([kw[i][k] for i in batch_ids])
+            return merged
+
+        def _ref(p, t, batch_ids):
+            return reference_metric(p, t, **_cat_kw(batch_ids))
+
         # (a) per-batch forward
         metric = metric_class(**metric_args)
         for i in range(n_batches):
             batch_val = metric(preds[i], target[i], **kw[i])
             if check_batch:
-                ref_val = reference_metric(preds[i], target[i])
+                ref_val = _ref(preds[i], target[i], [i])
                 _assert_allclose(batch_val, ref_val, atol, msg=f"forward batch {i}")
 
         # (c1) final compute over all data, single replica
-        ref_total = reference_metric(_cat(preds), _cat(target))
+        ref_total = _ref(_cat(preds), _cat(target), list(range(n_batches)))
         _assert_allclose(metric.compute(), ref_total, atol, msg="single-replica compute")
 
         if check_merge:
@@ -114,7 +125,7 @@ class MetricTester:
                     replicas[0].merge_state(rep)
                 _assert_allclose(
                     replicas[0].compute(),
-                    reference_metric(_cat(step_p), _cat(step_t)),
+                    _ref(_cat(step_p), _cat(step_t), list(range(step * WORLD_SIZE, (step + 1) * WORLD_SIZE))),
                     atol,
                     msg=f"synced step {step}",
                 )

@@ -86,6 +86,8 @@ def restore_metric_state(metric: Any, path: str) -> Any:
         npz = np.load(path if path.endswith(".npz") else path + ".npz")
         flat = dict(npz)
     metric.load_state_dict(_from_saveable(flat))
+    for m in _metrics_of(metric):  # drop any cached compute() value — state just changed
+        m._computed = None
     return metric
 
 
