@@ -41,7 +41,17 @@ class SignalDistortionRatio(_MeanOfBatchValues):
 
 
 class ScaleInvariantSignalDistortionRatio(_MeanOfBatchValues):
-    """Average SI-SDR (reference ``sdr.py:163-246``)."""
+    """Average SI-SDR (reference ``sdr.py:163-246``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = ScaleInvariantSignalDistortionRatio()
+        >>> print(round(float(si_sdr(preds, target)), 4))
+        18.403
+    """
 
     def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -116,7 +116,17 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
 
 
 class AveragePrecision:
-    """Task router (reference ``average_precision.py`` legacy class)."""
+    """Task router (reference ``average_precision.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import AveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> metric = AveragePrecision(task='binary')
+        >>> print(round(float(metric(preds, target)), 4))
+        0.8333
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -140,7 +140,17 @@ class MulticlassCalibrationError(Metric):
 
 
 class CalibrationError:
-    """Task router (reference ``calibration_error.py`` legacy class)."""
+    """Task router (reference ``calibration_error.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CalibrationError
+        >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> metric = CalibrationError(task='binary', n_bins=2, norm='l1')
+        >>> print(round(float(metric(preds, target)), 4))
+        0.29
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -77,7 +77,17 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
 
 class CohenKappa:
-    """Task router (reference ``cohen_kappa.py`` legacy class)."""
+    """Task router (reference ``cohen_kappa.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CohenKappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> metric = CohenKappa(task='binary')
+        >>> print(float(metric(preds, target)))
+        0.5
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

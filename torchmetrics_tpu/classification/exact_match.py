@@ -130,7 +130,17 @@ class MultilabelExactMatch(_AbstractExactMatch):
 
 
 class ExactMatch:
-    """Task router (reference ``exact_match.py`` legacy class)."""
+    """Task router (reference ``exact_match.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ExactMatch
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> metric = ExactMatch(task='multilabel', num_labels=2)
+        >>> print(float(metric(preds, target)))
+        0.5
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

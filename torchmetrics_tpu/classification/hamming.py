@@ -65,7 +65,17 @@ class MultilabelHammingDistance(MultilabelStatScores):
 
 
 class HammingDistance:
-    """Task router (reference ``hamming.py`` legacy class)."""
+    """Task router (reference ``hamming.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import HammingDistance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> metric = HammingDistance(task='multilabel', num_labels=2)
+        >>> print(float(metric(preds, target)))
+        0.25
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -104,7 +104,17 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
 
 
 class JaccardIndex:
-    """Task router (reference ``jaccard.py`` legacy class)."""
+    """Task router (reference ``jaccard.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import JaccardIndex
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> metric = JaccardIndex(task='binary')
+        >>> print(round(float(metric(preds, target)), 4))
+        0.5
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

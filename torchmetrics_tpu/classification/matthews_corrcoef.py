@@ -98,7 +98,17 @@ class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
 
 
 class MatthewsCorrCoef:
-    """Task router (reference ``matthews_corrcoef.py`` legacy class)."""
+    """Task router (reference ``matthews_corrcoef.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MatthewsCorrCoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> metric = MatthewsCorrCoef(task='binary')
+        >>> print(round(float(metric(preds, target)), 4))
+        0.5774
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -65,7 +65,17 @@ class MultilabelSpecificity(MultilabelStatScores):
 
 
 class Specificity:
-    """Task router (reference ``specificity.py`` legacy class)."""
+    """Task router (reference ``specificity.py`` legacy class).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Specificity
+        >>> target = jnp.asarray([0, 1, 0, 1])
+        >>> preds = jnp.asarray([0, 1, 1, 1])
+        >>> metric = Specificity(task='binary')
+        >>> print(float(metric(preds, target)))
+        0.5
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -242,7 +242,16 @@ def auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task-routing wrapper (reference legacy API)."""
+    """Task-routing wrapper (reference legacy API).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import auroc
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> print(float(auroc(preds, target, task='binary')))
+        0.5
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)

@@ -113,7 +113,16 @@ def _final_aggregation(
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson r (reference ``pearson.py:104-130``)."""
+    """Pearson r (reference ``pearson.py:104-130``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pearson_corrcoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(round(float(pearson_corrcoef(preds, target)), 4))
+        0.9849
+    """
     d = preds.shape[1] if preds.ndim == 2 else 1
     _temp = jnp.zeros(d).squeeze()
     mean_x, mean_y, var_x = _temp, _temp, _temp

@@ -34,6 +34,14 @@ def _wer_compute(errors: Array, total: Array) -> Array:
 
 
 def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """WER (reference ``wer.py:64-88``)."""
+    """WER (reference ``wer.py:64-88``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional import word_error_rate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> print(float(word_error_rate(preds, target)))
+        0.5
+    """
     errors, total = _wer_update(preds, target)
     return _wer_compute(errors, total)

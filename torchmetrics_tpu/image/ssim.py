@@ -114,7 +114,8 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
-    """MS-SSIM (reference ``ssim.py:222-419``)."""
+    """MS-SSIM (reference ``ssim.py:222-419``).
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True

@@ -15,7 +15,16 @@ Array = jax.Array
 
 
 class TotalVariation(Metric):
-    """TV (reference ``tv.py:26-113``)."""
+    """TV (reference ``tv.py:26-113``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import TotalVariation
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> metric = TotalVariation()
+        >>> print(float(metric(img)))
+        60.0
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

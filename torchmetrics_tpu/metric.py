@@ -678,6 +678,9 @@ class Metric:
         elif restored_any:
             # legacy checkpoints without the count: mark as updated at least once
             self._update_count = max(self._update_count, 1)
+        if restored_any:
+            # state changed under the cache — a prior compute() value is stale now
+            self._computed = None
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Keep only kwargs that ``update`` accepts (reference ``metric.py:818-837``)."""

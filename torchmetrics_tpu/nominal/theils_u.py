@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class TheilsU(Metric):
-    """Theil's U (asymmetric uncertainty coefficient) over a device table (reference ``theils_u.py:26-120``)."""
+    """Theil's U (asymmetric uncertainty coefficient) over a device table (reference ``theils_u.py:26-120``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import TheilsU
+        >>> preds = jnp.asarray([0, 1, 2, 1, 0, 2, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 2, 0, 2, 1, 2, 0, 0])
+        >>> metric = TheilsU(num_classes=3)
+        >>> print(round(float(metric(preds, target)), 4))
+        0.5869
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

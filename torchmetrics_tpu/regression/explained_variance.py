@@ -18,7 +18,17 @@ Array = jax.Array
 
 
 class ExplainedVariance(Metric):
-    """Explained variance from streaming sums (reference ``explained_variance.py:26-125``)."""
+    """Explained variance from streaming sums (reference ``explained_variance.py:26-125``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ExplainedVariance
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = ExplainedVariance()
+        >>> print(round(float(metric(preds, target)), 4))
+        0.9572
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True

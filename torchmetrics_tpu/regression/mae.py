@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class MeanAbsoluteError(Metric):
-    """MAE (reference ``mae.py:26-98``)."""
+    """MAE (reference ``mae.py:26-98``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanAbsoluteError
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = MeanAbsoluteError()
+        >>> print(float(metric(preds, target)))
+        0.5
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -20,7 +20,17 @@ Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
-    """Spearman ρ (reference ``spearman.py:25-112``)."""
+    """Spearman ρ (reference ``spearman.py:25-112``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpearmanCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SpearmanCorrCoef()
+        >>> print(round(float(metric(preds, target)), 4))
+        1.0
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -11,7 +11,18 @@ Array = jax.Array
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank: ``argmax`` over the rank-sorted relevance picks the first hit."""
+    """Mean reciprocal rank: ``argmax`` over the rank-sorted relevance picks the first hit.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> print(round(float(mrr(preds, target, indexes=indexes)), 4))
+        0.75
+    """
 
     def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
         rel = target_mat * valid

@@ -19,7 +19,16 @@ Array = jax.Array
 
 
 class CHRFScore(Metric):
-    """chrF / chrF++ (reference ``chrf.py:30-178``)."""
+    """chrF / chrF++ (reference ``chrf.py:30-178``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> chrf = CHRFScore()
+        >>> print(round(float(chrf(preds, target)), 4))
+        0.4942
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -19,7 +19,17 @@ Array = jax.Array
 
 
 class ROUGEScore(Metric):
-    """ROUGE-N/L/Lsum with per-key score lists (reference ``rouge.py:27-168``)."""
+    """ROUGE-N/L/Lsum with per-key score lists (reference ``rouge.py:27-168``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import ROUGEScore
+        >>> preds = 'My name is John'
+        >>> target = 'Is your name John'
+        >>> rouge = ROUGEScore(rouge_keys='rouge1')
+        >>> result = rouge(preds, target)
+        >>> print(round(float(result['rouge1_fmeasure']), 4))
+        0.75
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

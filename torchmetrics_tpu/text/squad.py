@@ -20,7 +20,17 @@ Array = jax.Array
 
 
 class SQuAD(Metric):
-    """SQuAD EM/F1 with sum states (reference ``squad.py:26-117``)."""
+    """SQuAD EM/F1 with sum states (reference ``squad.py:26-117``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import SQuAD
+        >>> preds = [{'prediction_text': '1976', 'id': '56e10a3be3433e1400422b22'}]
+        >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e10a3be3433e1400422b22'}]
+        >>> squad = SQuAD()
+        >>> result = squad(preds, target)
+        >>> print(float(result['exact_match']), float(result['f1']))
+        100.0 100.0
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -13,7 +13,18 @@ Array = jax.Array
 
 
 class MinMaxMetric(Metric):
-    """Track min/max of a scalar metric across compute calls (reference ``minmax.py:28``)."""
+    """Track min/max of a scalar metric across compute calls (reference ``minmax.py:28``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MinMaxMetric
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> _ = metric(jnp.asarray([1.0, 0.0, 1.0]), jnp.asarray([1, 0, 0]))
+        >>> _ = metric(jnp.asarray([1.0, 0.0, 1.0]), jnp.asarray([1, 0, 1]))
+        >>> print({k: round(float(v), 4) for k, v in sorted(metric.compute().items())})
+        {'max': 1.0, 'min': 1.0, 'raw': 1.0}
+    """
 
     full_state_update: Optional[bool] = True
     min_val: Array

@@ -20,6 +20,20 @@ class MetricTracker:
     """Track a metric (or collection) across steps/epochs (reference ``tracker.py:31``).
 
     ``increment()`` snapshots a fresh clone; ``best_metric()`` scans history.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricTracker, MeanMetric
+        >>> tracker = MetricTracker(MeanMetric())
+        >>> for epoch_vals in ([1.0, 2.0], [3.0, 4.0]):
+        ...     tracker.increment()
+        ...     for v in epoch_vals:
+        ...         tracker.update(jnp.asarray(v))
+        >>> print([float(v) for v in tracker.compute_all()])
+        [1.5, 3.5]
+        >>> best, which = tracker.best_metric(return_step=True)
+        >>> print(float(best), which)
+        3.5 1
     """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
