@@ -1,0 +1,53 @@
+"""Convert the LPIPS linear-head checkpoints to the bundled ``lpips_heads.npz``.
+
+The LPIPS paper's learned per-layer 1x1 heads ship with the upstream project as tiny
+torch checkpoints (reference ``src/torchmetrics/functional/image/lpips_models/
+{alex,squeeze,vgg}.pth``, loaded at ``lpips.py:286``). This script torch-loads them and
+re-serializes the raw float arrays (~6 KB total) as a single npz the JAX package can
+read without torch at runtime.
+
+Usage::
+
+    python scripts/convert_lpips_heads.py [SRC_DIR] [DST_NPZ]
+
+Defaults: SRC_DIR = /root/reference/src/torchmetrics/functional/image/lpips_models,
+DST_NPZ = torchmetrics_tpu/functional/image/_weights/lpips_heads.npz.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+_DEFAULT_SRC = Path("/root/reference/src/torchmetrics/functional/image/lpips_models")
+_DEFAULT_DST = (
+    Path(__file__).resolve().parent.parent
+    / "torchmetrics_tpu"
+    / "functional"
+    / "image"
+    / "_weights"
+    / "lpips_heads.npz"
+)
+
+
+def convert(src_dir: Path, dst: Path) -> None:
+    import torch
+
+    out = {}
+    for net in ("alex", "squeeze", "vgg"):
+        sd = torch.load(src_dir / f"{net}.pth", map_location="cpu")
+        for key, tensor in sd.items():
+            # 'lin{i}.model.1.weight' with shape (1, C, 1, 1) -> flat (C,)
+            idx = int(key.split(".")[0][len("lin") :])
+            out[f"{net}_lin{idx}"] = np.asarray(tensor, dtype=np.float32).reshape(-1)
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(dst, **out)
+    print(f"wrote {dst} ({dst.stat().st_size} bytes, {len(out)} heads)")
+
+
+if __name__ == "__main__":
+    src = Path(sys.argv[1]) if len(sys.argv) > 1 else _DEFAULT_SRC
+    dst = Path(sys.argv[2]) if len(sys.argv) > 2 else _DEFAULT_DST
+    convert(src, dst)

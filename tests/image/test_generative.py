@@ -196,9 +196,39 @@ class TestLPIPS:
         v_far = float(m.compute())
         assert v_far > v_near > 0
 
-    def test_string_backbone_raises(self):
-        with pytest.raises(ModuleNotFoundError, match="pretrained"):
-            LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    def test_string_backbone_default_path(self):
+        """String backbones work out of the box: bundled heads + random-init warning."""
+        with pytest.warns(UserWarning, match="self-consistent"):
+            m = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        img = jnp.asarray(rng.uniform(0, 1, size=(2, 3, 64, 64)))
+        other = jnp.clip(img + 0.2, 0, 1)
+        m.update(img, other)
+        assert float(m.compute()) > 0
+        same = LearnedPerceptualImagePatchSimilarity(net_type=m.net)  # reuse built net
+        same.update(img, img)
+        assert float(same.compute()) == pytest.approx(0.0, abs=1e-6)
+
+    def test_string_backbone_invalid_name_raises(self):
+        with pytest.raises(ValueError, match="net_type"):
+            LearnedPerceptualImagePatchSimilarity(net_type="resnet")
+
+    def test_bundled_heads_match_reference_checkpoints(self):
+        """Converted npz heads equal the reference's torch checkpoints exactly."""
+        torch = pytest.importorskip("torch")
+        from pathlib import Path
+
+        from torchmetrics_tpu.functional.image.lpips import load_lpips_heads
+
+        src = Path("/root/reference/src/torchmetrics/functional/image/lpips_models")
+        if not src.exists():
+            pytest.skip("reference checkpoints not available")
+        for net in ("alex", "vgg", "squeeze"):
+            heads = load_lpips_heads(net)
+            sd = torch.load(src / f"{net}.pth", map_location="cpu")
+            assert len(heads) == len(sd)
+            for i, head in enumerate(heads):
+                ref = np.asarray(sd[f"lin{i}.model.1.weight"]).reshape(-1)
+                np.testing.assert_array_equal(np.asarray(head), ref)
 
     def test_invalid_range_raises(self):
         net = self._toy_net()

@@ -1,0 +1,348 @@
+"""Tests for the five previously-untested wrappers: BootStrapper, ClasswiseWrapper,
+MultioutputWrapper, MultitaskWrapper, Running.
+
+Semantics model: reference ``tests/unittests/wrappers/test_{bootstrapping,classwise,
+multioutput,multitask,running}.py`` — bootstrap parity on captured resamples vs
+sklearn, classwise key naming (incl. inside a MetricCollection), multioutput column
+routing + NaN removal, multitask dict routing + error surface, running-window values
+vs golden over the trailing window.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sklearn.metrics import accuracy_score, mean_squared_error, precision_score
+
+from torchmetrics_tpu import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanMetric,
+    MeanSquaredError,
+    MetricCollection,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+    SumMetric,
+)
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+_RNG = np.random.default_rng(42)
+_N_BATCHES, _BATCH = 6, 32
+_NUM_CLASSES = 5
+_preds_mc = _RNG.integers(0, _NUM_CLASSES, size=(_N_BATCHES, _BATCH))
+_target_mc = _RNG.integers(0, _NUM_CLASSES, size=(_N_BATCHES, _BATCH))
+_preds_reg = _RNG.normal(size=(_N_BATCHES, _BATCH)).astype(np.float32)
+_target_reg = _RNG.normal(size=(_N_BATCHES, _BATCH)).astype(np.float32)
+
+
+# --------------------------------------------------------------------- BootStrapper
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler(sampling_strategy):
+    """Resampled indices stay in range, repeat some rows, and drop some rows."""
+    idx = np.asarray(_bootstrap_sampler(50, sampling_strategy, np.random.RandomState(1)))
+    assert idx.min() >= 0 and idx.max() < 50
+    counts = np.bincount(idx, minlength=50)
+    assert (counts >= 2).any(), "no sample drawn twice — not sampling with replacement"
+    assert (counts == 0).any(), "every sample drawn — not a bootstrap draw"
+
+
+class _CapturingBootStrapper(BootStrapper):
+    """Record the resampled inputs each copy saw, so sklearn can replay them."""
+
+    def update(self, preds, target):  # noqa: D102
+        if not hasattr(self, "captured"):
+            self.captured = [([], []) for _ in range(self.num_bootstraps)]
+        size = preds.shape[0]
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            p, t = jnp.take(preds, sample_idx, axis=0), jnp.take(target, sample_idx, axis=0)
+            self.metrics[idx].update(p, t)
+            self.captured[idx][0].append(np.asarray(p))
+            self.captured[idx][1].append(np.asarray(t))
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+@pytest.mark.parametrize(
+    ("base", "golden"),
+    [
+        (
+            lambda: MulticlassPrecision(num_classes=_NUM_CLASSES, average="micro"),
+            lambda t, p: precision_score(t, p, average="micro"),
+        ),
+        (lambda: MeanSquaredError(), mean_squared_error),
+    ],
+)
+def test_bootstrap_parity(sampling_strategy, base, golden):
+    """mean/std/quantile/raw over bootstrap copies equal sklearn on the captured resamples."""
+    wrapper = _CapturingBootStrapper(
+        base(), num_bootstraps=8, mean=True, std=True, raw=True, quantile=jnp.asarray([0.05, 0.95]),
+        sampling_strategy=sampling_strategy,
+    )
+    wrapper._rng = np.random.RandomState(7)
+    is_classif = isinstance(wrapper.metrics[0], MulticlassPrecision)
+    preds, target = (_preds_mc, _target_mc) if is_classif else (_preds_reg, _target_reg)
+    for p, t in zip(preds, target):
+        wrapper.update(jnp.asarray(p), jnp.asarray(t))
+    out = wrapper.compute()
+    sk = np.asarray([
+        golden(np.concatenate(ct), np.concatenate(cp)) for cp, ct in wrapper.captured
+    ])
+    np.testing.assert_allclose(np.asarray(out["mean"]), sk.mean(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["std"]), sk.std(ddof=1), atol=1e-5)
+    np.testing.assert_allclose(np.sort(np.asarray(out["raw"])), np.sort(sk), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["quantile"]), np.quantile(sk, [0.05, 0.95]), atol=1e-5
+    )
+
+
+def test_bootstrap_raises():
+    with pytest.raises(ValueError, match="to be an instance"):
+        BootStrapper(1)
+    with pytest.raises(ValueError, match="sampling_strategy"):
+        BootStrapper(MeanMetric(), sampling_strategy="bogus")
+
+
+# ----------------------------------------------------------------- ClasswiseWrapper
+
+
+def test_classwise_raises():
+    with pytest.raises(ValueError, match="instance of"):
+        ClasswiseWrapper([])
+    with pytest.raises(ValueError, match="list of strings"):
+        ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels="not-a-list")
+
+
+def test_classwise_keys_and_values():
+    """Without labels keys are `<name>_{i}`; with labels `<name>_{label}`; values match average=None."""
+    p, t = jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0])
+    plain = MulticlassAccuracy(num_classes=_NUM_CLASSES, average=None)
+    ref = np.asarray(plain(p, t))
+
+    wrapped = ClasswiseWrapper(MulticlassAccuracy(num_classes=_NUM_CLASSES, average=None))
+    out = wrapped(p, t)
+    assert set(out.keys()) == {f"multiclassaccuracy_{i}" for i in range(_NUM_CLASSES)}
+    np.testing.assert_allclose([float(out[f"multiclassaccuracy_{i}"]) for i in range(_NUM_CLASSES)], ref, atol=1e-6)
+
+    labels = ["a", "b", "c", "d", "e"]
+    wrapped = ClasswiseWrapper(MulticlassAccuracy(num_classes=_NUM_CLASSES, average=None), labels=labels)
+    wrapped.update(p, t)
+    out = wrapped.compute()
+    assert set(out.keys()) == {f"multiclassaccuracy_{lab}" for lab in labels}
+    np.testing.assert_allclose([float(out[f"multiclassaccuracy_{lab}"]) for lab in labels], ref, atol=1e-6)
+    wrapped.reset()
+    assert wrapped.metric.update_count == 0
+
+
+@pytest.mark.parametrize(("prefix", "postfix"), [(None, None), ("pre_", None), (None, "_post")])
+def test_classwise_in_collection(prefix, postfix):
+    """ClasswiseWrapper nests in a MetricCollection and its keys pick up prefix/postfix."""
+    coll = MetricCollection(
+        {"acc": ClasswiseWrapper(MulticlassAccuracy(num_classes=_NUM_CLASSES, average=None))},
+        prefix=prefix,
+        postfix=postfix,
+    )
+    coll.update(jnp.asarray(_preds_mc[0]), jnp.asarray(_target_mc[0]))
+    out = coll.compute()
+    for k in out:
+        assert k.startswith(prefix or "") and k.endswith(postfix or "")
+        assert "multiclassaccuracy_" in k
+
+
+# --------------------------------------------------------------- MultioutputWrapper
+
+
+def test_multioutput_mse_columns():
+    """Per-column MSE equals sklearn column-wise (multioutput='raw_values')."""
+    p = _RNG.normal(size=(4, 16, 2)).astype(np.float32)
+    t = _RNG.normal(size=(4, 16, 2)).astype(np.float32)
+    metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    for i in range(4):
+        metric.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    ref = mean_squared_error(t.reshape(-1, 2), p.reshape(-1, 2), multioutput="raw_values")
+    np.testing.assert_allclose(np.asarray(metric.compute()), ref, atol=1e-5)
+
+
+def test_multioutput_classification_forward():
+    """Forward routes each output column to its own clone and stacks batch values."""
+    p = _RNG.integers(0, 2, size=(24, 2))
+    t = _RNG.integers(0, 2, size=(24, 2))
+    metric = MultioutputWrapper(BinaryAccuracy(), num_outputs=2)
+    out = metric(jnp.asarray(p, dtype=jnp.float32), jnp.asarray(t))
+    ref = [accuracy_score(t[:, i], p[:, i]) for i in range(2)]
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+
+def test_multioutput_remove_nans():
+    """Rows with a NaN in any input are dropped per-output before the update."""
+    p = np.array([[1.0, 2.0], [np.nan, 3.0], [4.0, np.nan], [5.0, 6.0]], dtype=np.float32)
+    t = np.array([[1.0, 2.0], [2.0, 3.0], [4.0, 5.0], [5.0, 7.0]], dtype=np.float32)
+    metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=True)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    # column 0 keeps rows {0,2,3}; column 1 keeps rows {0,1,3}
+    ref0 = mean_squared_error(t[[0, 2, 3], 0], p[[0, 2, 3], 0])
+    ref1 = mean_squared_error(t[[0, 1, 3], 1], p[[0, 1, 3], 1])
+    np.testing.assert_allclose(np.asarray(metric.compute()), [ref0, ref1], atol=1e-6)
+
+
+def test_multioutput_reset():
+    metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    metric.update(jnp.asarray(_preds_reg[0]).reshape(-1, 2), jnp.asarray(_target_reg[0]).reshape(-1, 2))
+    assert all(m.update_count == 1 for m in metric.metrics)
+    metric.reset()
+    assert all(m.update_count == 0 for m in metric.metrics)
+
+
+# ---------------------------------------------------------------- MultitaskWrapper
+
+
+def _make_multitask():
+    return MultitaskWrapper(
+        {
+            "classification": BinaryAccuracy(),
+            "regression": MeanSquaredError(),
+        }
+    )
+
+
+def test_multitask_raises():
+    with pytest.raises(TypeError, match="to be a dict"):
+        MultitaskWrapper([BinaryAccuracy()])
+    with pytest.raises(TypeError, match="Metric or a MetricCollection"):
+        MultitaskWrapper({"a": 1})
+    metric = _make_multitask()
+    with pytest.raises(ValueError, match="same keys"):
+        metric.update({"classification": jnp.zeros(4)}, {"wrong": jnp.zeros(4)})
+
+
+def test_multitask_basic_and_forward():
+    """Per-task results equal the individually-run metrics; forward returns batch dict."""
+    pc = _RNG.integers(0, 2, size=(2, _BATCH)).astype(np.float32)
+    tc = _RNG.integers(0, 2, size=(2, _BATCH))
+    metric = _make_multitask()
+    for i in range(2):
+        out = metric(
+            {"classification": jnp.asarray(pc[i]), "regression": jnp.asarray(_preds_reg[i])},
+            {"classification": jnp.asarray(tc[i]), "regression": jnp.asarray(_target_reg[i])},
+        )
+        assert set(out.keys()) == {"classification", "regression"}
+    res = metric.compute()
+    np.testing.assert_allclose(
+        float(res["classification"]), accuracy_score(tc.reshape(-1), pc.reshape(-1)), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(res["regression"]),
+        mean_squared_error(_target_reg[:2].reshape(-1), _preds_reg[:2].reshape(-1)),
+        atol=1e-5,
+    )
+    metric.reset()
+    assert all(m.update_count == 0 for m in metric.task_metrics.values())
+
+
+def test_multitask_with_collection():
+    """A task can be a whole MetricCollection."""
+    metric = MultitaskWrapper(
+        {"cls": MetricCollection([BinaryAccuracy()]), "reg": MeanSquaredError()}
+    )
+    metric.update(
+        {"cls": jnp.asarray([1.0, 0.0, 1.0, 1.0]), "reg": jnp.asarray([1.0, 2.0])},
+        {"cls": jnp.asarray([1, 0, 0, 1]), "reg": jnp.asarray([1.0, 4.0])},
+    )
+    res = metric.compute()
+    np.testing.assert_allclose(float(res["cls"]["BinaryAccuracy"]), 0.75, atol=1e-6)
+    np.testing.assert_allclose(float(res["reg"]), 2.0, atol=1e-6)
+
+
+# ----------------------------------------------------------------------- Running
+
+
+def test_running_raises():
+    with pytest.raises(ValueError, match="instance of"):
+        Running(1)
+    with pytest.raises(ValueError, match="positive integer"):
+        Running(SumMetric(), window=0)
+
+
+@pytest.mark.parametrize(
+    ("base_cls", "expected"),
+    [
+        (SumMetric, [0.0, 1.0, 3.0, 6.0, 9.0, 12.0]),
+        (MeanMetric, [0.0, 0.5, 1.0, 2.0, 3.0, 4.0]),
+    ],
+)
+def test_running_aggregation_window(base_cls, expected):
+    """compute() aggregates over exactly the trailing window of 3 updates."""
+    metric = Running(base_cls(), window=3)
+    outs = []
+    for i in range(6):
+        metric(jnp.asarray(float(i)))
+        outs.append(float(metric.compute()))
+    np.testing.assert_allclose(outs, expected)
+
+
+def test_running_forward_is_batch_value():
+    """forward returns the current-batch value, not the windowed one."""
+    metric = Running(SumMetric(), window=3)
+    for i in range(5):
+        assert float(metric(jnp.asarray(float(i)))) == float(i)
+
+
+@pytest.mark.parametrize("window", [2, 3])
+def test_running_metric_window_vs_golden(window):
+    """Running(MeanSquaredError) equals sklearn over the trailing `window` batches."""
+    metric = Running(MeanSquaredError(), window=window)
+    for i in range(_N_BATCHES):
+        metric(jnp.asarray(_preds_reg[i]), jnp.asarray(_target_reg[i]))
+        lo = max(0, i + 1 - window)
+        ref = mean_squared_error(
+            _target_reg[lo : i + 1].reshape(-1), _preds_reg[lo : i + 1].reshape(-1)
+        )
+        np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-5)
+
+
+def test_running_mean_reduced_state():
+    """A dist_reduce_fx='mean' state folds with correct per-slot weights (window=1
+    returns the slot value, window=3 the plain mean of the three slots)."""
+    from torchmetrics_tpu.metric import Metric
+
+    class MeanStateMetric(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("val", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+        def update(self, x):
+            self.val = jnp.asarray(x, dtype=jnp.float32)
+
+        def compute(self):
+            return self.val
+
+    m = Running(MeanStateMetric(), window=1)
+    m.update(5.0)
+    assert float(m.compute()) == pytest.approx(5.0)
+
+    m = Running(MeanStateMetric(), window=3)
+    for v in (3.0, 6.0, 9.0):
+        m.update(v)
+    assert float(m.compute()) == pytest.approx(6.0)
+    m.update(12.0)  # window slides: mean(6, 9, 12)
+    assert float(m.compute()) == pytest.approx(9.0)
+
+
+def test_running_reset():
+    metric = Running(SumMetric(), window=3)
+    for i in range(4):
+        metric(jnp.asarray(float(i)))
+    metric.reset()
+    assert metric._num_vals_seen == 0
+    assert float(metric.base_metric.compute() if metric.base_metric.update_count else 0.0) == 0.0

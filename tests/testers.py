@@ -27,7 +27,8 @@ import pickle
 import jax
 import jax.numpy as jnp
 
-WORLD_SIZE = 2  # emulated world size, matches reference NUM_PROCESSES=2
+WORLD_SIZE = 2  # default emulated world size, matches reference NUM_PROCESSES=2
+MERGE_WORLD_SIZES = (2, 3, 4)  # N-way merge_state folding must hold beyond pairwise
 
 
 def _to_np(x: Any) -> Any:
@@ -112,7 +113,7 @@ class MetricTester:
         _assert_allclose(metric.compute(), ref_total, atol, msg="single-replica compute")
 
         if check_merge:
-            # (b) synced-step: world-2 emulation, per-step merged value vs concat batch
+            # (b) synced-step: world-N emulation, per-step merged value vs concat batch
             for step in range(n_batches // WORLD_SIZE):
                 replicas = [metric_class(**metric_args) for _ in range(WORLD_SIZE)]
                 step_p, step_t = [], []
@@ -130,13 +131,19 @@ class MetricTester:
                     msg=f"synced step {step}",
                 )
 
-            # (c2) final compute, world-2 round-robin accumulation then merge
-            replicas = [metric_class(**metric_args) for _ in range(WORLD_SIZE)]
-            for i in range(n_batches):
-                replicas[i % WORLD_SIZE].update(preds[i], target[i], **kw[i])
-            for rep in replicas[1:]:
-                replicas[0].merge_state(rep)
-            _assert_allclose(replicas[0].compute(), ref_total, atol, msg="merged compute")
+            # (c2) final compute: round-robin accumulation then sequential N-way merge,
+            # for every world size in MERGE_WORLD_SIZES (folding must stay associative
+            # past pairwise — a 3-shard fold once broke `None`-reduction states).
+            for world_size in MERGE_WORLD_SIZES:
+                n_active = min(world_size, n_batches)
+                replicas = [metric_class(**metric_args) for _ in range(n_active)]
+                for i in range(n_batches):
+                    replicas[i % n_active].update(preds[i], target[i], **kw[i])
+                for rep in replicas[1:]:
+                    replicas[0].merge_state(rep)
+                _assert_allclose(
+                    replicas[0].compute(), ref_total, atol, msg=f"merged compute (world={world_size})"
+                )
 
         if check_structural:
             self._run_structural_checks(metric_class, metric_args, preds, target, kw)

@@ -1,23 +1,30 @@
 """LPIPS distance pipeline (reference ``functional/image/lpips.py``).
 
 The reference vendors torchvision AlexNet/VGG/SqueezeNet backbones plus bundled linear
-heads (``lpips_models/*.pth``). This environment bundles no pretrained weights, so the
-TPU build ships the full distance *pipeline* (input scaling, per-layer unit
-normalization, squared diff, 1×1 linear heads, spatial averaging, layer sum) with the
-backbone injected as a callable: ``feats_fn(img) -> [feature_map, ...]`` plus optional
-per-layer head weights. ``make_lpips_net`` composes them into the ``net(img1, img2,
-normalize)`` callable the modular metric consumes — a user with converted weights gets
-exact LPIPS; tests drive the pipeline with toy backbones.
+heads (``lpips_models/*.pth``). The TPU build ships the full distance *pipeline* (input
+scaling, per-layer unit normalization, squared diff, 1×1 linear heads, spatial
+averaging, layer sum), native Flax backbones (``models/{alexnet,vgg,squeezenet}.py``),
+and the **learned LPIPS heads converted and bundled** (``_weights/lpips_heads.npz``,
+from the reference's checkpoints loaded at ``lpips.py:286`` — see
+``scripts/convert_lpips_heads.py``). Backbone ImageNet weights are NOT bundled
+(zero-egress environment): string ``net_type`` builds a deterministic randomly
+initialised backbone and warns — scores are then self-consistent but not canonical
+LPIPS until a torchvision checkpoint is converted in via ``backbone_state_dict``.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, Tuple, Union
+from functools import lru_cache
+from pathlib import Path
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 Array = jax.Array
+
+_HEADS_FILE = Path(__file__).resolve().parent / "_weights" / "lpips_heads.npz"
+_N_HEADS = {"alex": 5, "vgg": 5, "squeeze": 7}
 
 # ImageNet-derived scaling constants (reference ``lpips.py:196-203``)
 _SHIFT = jnp.asarray([-0.030, -0.088, -0.188])[None, :, None, None]
@@ -94,6 +101,70 @@ def make_lpips_net(
     return net
 
 
+def load_lpips_heads(net_type: str = "alex") -> List[Array]:
+    """Load the bundled learned 1x1 head weights for a backbone, as flat ``(C,)`` arrays.
+
+    Converted from the reference's ``lpips_models/{alex,squeeze,vgg}.pth`` (the LPIPS
+    paper's learned heads, loaded by the reference at ``lpips.py:286``) by
+    ``scripts/convert_lpips_heads.py``.
+    """
+    if net_type not in _N_HEADS:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(_N_HEADS)}, but got {net_type}.")
+    import numpy as np
+
+    with np.load(_HEADS_FILE) as data:
+        return [jnp.asarray(data[f"{net_type}_lin{i}"]) for i in range(_N_HEADS[net_type])]
+
+
+def lpips_network(
+    net_type: str = "alex",
+    backbone_state_dict: Optional[Mapping[str, Any]] = None,
+    backbone_variables: Optional[Mapping[str, Any]] = None,
+    spatial: bool = False,
+) -> Callable[..., Array]:
+    """Build the default ``net(img1, img2, normalize=...)`` for a string backbone.
+
+    Uses the bundled learned heads plus the native Flax backbone. Without
+    ``backbone_state_dict``/``backbone_variables`` the backbone is deterministically
+    randomly initialised and a warning is emitted: distances are valid for relative
+    comparison within one configuration, but not canonical LPIPS values.
+    """
+    if net_type not in _N_HEADS:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(_N_HEADS)}, but got {net_type}.")
+    if backbone_state_dict is None and backbone_variables is None:
+        from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(
+            f"No pretrained `{net_type}` backbone weights are bundled (the learned LPIPS heads are). Using a"
+            " deterministic randomly-initialised backbone: scores are self-consistent but not canonical LPIPS."
+            " Pass `backbone_state_dict=` (a torchvision checkpoint) for exact values."
+        )
+    if backbone_state_dict is None and backbone_variables is None:
+        return _default_lpips_network(net_type, spatial)
+    feats_fn = _lpips_backbone_builder(net_type)(
+        state_dict=backbone_state_dict, variables=backbone_variables
+    )
+    return make_lpips_net(feats_fn, lin_weights=load_lpips_heads(net_type), spatial=spatial)
+
+
+def _lpips_backbone_builder(net_type: str) -> Callable[..., Callable[[Array], Sequence[Array]]]:
+    if net_type == "alex":
+        from torchmetrics_tpu.models.alexnet import alexnet_lpips_extractor as build
+    elif net_type == "vgg":
+        from torchmetrics_tpu.models.vgg import vgg16_lpips_extractor as build
+    else:
+        from torchmetrics_tpu.models.squeezenet import squeezenet_lpips_extractor as build
+    return build
+
+
+@lru_cache(maxsize=None)
+def _default_lpips_network(net_type: str, spatial: bool) -> Callable[..., Array]:
+    """Cache the default-weights net per backbone: one jitted extractor whose XLA cache
+    is shared across functional calls, instead of re-initialising per call."""
+    feats_fn = _lpips_backbone_builder(net_type)()
+    return make_lpips_net(feats_fn, lin_weights=load_lpips_heads(net_type), spatial=spatial)
+
+
 def _valid_img(img: Array, normalize: bool) -> bool:
     """Input domain check (reference ``lpips.py:331-334``)."""
     value_check = bool(img.max() <= 1.0 and img.min() >= 0.0) if normalize else bool(img.min() >= -1)
@@ -121,15 +192,17 @@ def _lpips_compute(sum_scores: Array, total: Union[Array, int], reduction: str =
 def learned_perceptual_image_patch_similarity(
     img1: Array,
     img2: Array,
-    net: Callable[..., Array],
+    net: Union[str, Callable[..., Array]] = "alex",
     reduction: str = "mean",
     normalize: bool = False,
 ) -> Array:
-    """LPIPS with an injected backbone net (reference ``lpips.py:353-401``)."""
-    if not callable(net):
-        raise ModuleNotFoundError(
-            f"Argument `net={net!r}`: string backbones require pretrained weights, which are not bundled."
-            " Build one with `make_lpips_net(feats_fn, lin_weights)` from converted weights."
+    """LPIPS with a string backbone (bundled heads) or an injected net (reference ``lpips.py:353-401``)."""
+    if isinstance(net, str):
+        net = lpips_network(net)
+    elif not callable(net):
+        raise ValueError(
+            f"Argument `net={net!r}` must be a backbone name in {tuple(_N_HEADS)} or a callable built with"
+            " `make_lpips_net(feats_fn, lin_weights)`."
         )
     loss, total = _lpips_update(img1, img2, net, normalize)
     return _lpips_compute(loss.sum(), total, reduction)

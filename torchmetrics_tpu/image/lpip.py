@@ -1,7 +1,10 @@
 """Modular LPIPS (reference ``src/torchmetrics/image/lpip.py``).
 
-Sum-of-distances + count states; backbone injected as a callable (see
-``functional/image/lpips.py`` for why — no bundled pretrained weights).
+Sum-of-distances + count states. String ``net_type`` works out of the box: the learned
+LPIPS heads are bundled (converted from the reference's ``lpips_models/*.pth``); the
+backbone is a native Flax module — deterministically random-initialised (with a
+warning) unless ``backbone_state_dict``/``backbone_variables`` supplies torchvision
+ImageNet weights, in which case values are canonical LPIPS.
 """
 
 from __future__ import annotations
@@ -11,7 +14,7 @@ from typing import Any, Callable, Optional, Union
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.image.lpips import _lpips_compute, _lpips_update
+from torchmetrics_tpu.functional.image.lpips import _lpips_compute, _lpips_update, lpips_network
 from torchmetrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -21,11 +24,15 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     """LPIPS (reference ``lpip.py:30-142``).
 
     Args:
-        net_type: a ``net(img1, img2, normalize=...) -> (N,)`` callable (build with
-            :func:`torchmetrics_tpu.functional.image.lpips.make_lpips_net`); the
-            reference's string backbones raise — their weights are not bundled.
+        net_type: ``'alex'``/``'vgg'``/``'squeeze'`` (bundled learned heads + native
+            Flax backbone; backbone weights random-init with a warning unless supplied
+            below), or a ``net(img1, img2, normalize=...) -> (N,)`` callable built with
+            :func:`torchmetrics_tpu.functional.image.lpips.make_lpips_net`.
         reduction: 'mean' or 'sum' over accumulated per-sample distances.
         normalize: True if inputs are in [0,1] (scaled to [-1,1] internally).
+        backbone_state_dict: torchvision checkpoint for the string backbone — supplies
+            ImageNet weights, making values canonical LPIPS.
+        backbone_variables: ready flax variables for the string backbone.
     """
 
     is_differentiable: bool = True
@@ -39,6 +46,8 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         net_type: Union[str, Callable[..., Array]] = "alex",
         reduction: str = "mean",
         normalize: bool = False,
+        backbone_state_dict: Optional[Any] = None,
+        backbone_variables: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -46,13 +55,15 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
             valid_net_type = ("vgg", "alex", "squeeze")
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            raise ModuleNotFoundError(
-                f"Backbone `net_type={net_type!r}` requires pretrained weights, which are not bundled."
-                " Pass a callable net built with `make_lpips_net(feats_fn, lin_weights)` instead."
+            self.net = lpips_network(
+                net_type,
+                backbone_state_dict=backbone_state_dict,
+                backbone_variables=backbone_variables,
             )
-        if not callable(net_type):
+        elif callable(net_type):
+            self.net = net_type
+        else:
             raise ValueError("Argument `net_type` must be a string or a callable net.")
-        self.net = net_type
 
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:

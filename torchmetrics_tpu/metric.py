@@ -304,7 +304,7 @@ class Metric:
                     list(other_state) if isinstance(other_state, list) else [other_state]
                 )
             elif reduce_fn is None and _is_array(self_state):
-                reduced = jnp.stack([self_state, other_state])
+                reduced = self._fold_none_arrays(attr, self_state, other_state)
             elif reduce_fn is None and isinstance(self_state, list):
                 reduced = _flatten([self_state, other_state])
             elif reduce_fn and callable(reduce_fn):
@@ -314,6 +314,22 @@ class Metric:
             setattr(self, attr, reduced)
         self._update_count = self_count + incoming_count
         self._computed = None
+
+    def _fold_none_arrays(self, attr: str, self_state: Any, other_state: Any) -> Any:
+        """N-way fold of a ``dist_reduce_fx=None`` array state.
+
+        Raw-gathered states keep a stacked ``(shards, *default.shape)`` layout (the
+        reference stacks gathered tensors, ``metric.py:401-416``); appending rows —
+        rather than pairwise ``jnp.stack`` — keeps folding associative so three or
+        more shards can be merged sequentially.
+        """
+        base_ndim = getattr(self._defaults[attr], "ndim", 0)
+
+        def _rows(x: Any) -> Any:
+            x = jnp.asarray(x)
+            return x if x.ndim == base_ndim + 1 else x[None]
+
+        return jnp.concatenate([_rows(self_state), _rows(other_state)], axis=0)
 
     def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
         """Merge ``incoming_state`` (treated as global) with current (batch) state (reference ``metric.py:356-384``)."""
@@ -334,7 +350,7 @@ class Metric:
                     list(local_state) if isinstance(local_state, list) else [local_state]
                 )
             elif reduce_fn is None and _is_array(global_state):
-                reduced = jnp.stack([global_state, local_state])
+                reduced = self._fold_none_arrays(attr, global_state, local_state)
             elif reduce_fn is None and isinstance(global_state, list):
                 reduced = _flatten([global_state, local_state])
             elif reduce_fn and callable(reduce_fn):

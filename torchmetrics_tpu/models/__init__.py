@@ -9,7 +9,18 @@ converting a torch/torchvision state dict (no weights are bundled — this envir
 has zero egress).
 """
 
+from torchmetrics_tpu.models.alexnet import AlexNetFeatures, alexnet_lpips_extractor
 from torchmetrics_tpu.models.inception import InceptionV3, inception_v3_extractor
+from torchmetrics_tpu.models.squeezenet import SqueezeNetFeatures, squeezenet_lpips_extractor
 from torchmetrics_tpu.models.vgg import VGG16Features, vgg16_lpips_extractor
 
-__all__ = ["InceptionV3", "VGG16Features", "inception_v3_extractor", "vgg16_lpips_extractor"]
+__all__ = [
+    "AlexNetFeatures",
+    "InceptionV3",
+    "SqueezeNetFeatures",
+    "VGG16Features",
+    "alexnet_lpips_extractor",
+    "inception_v3_extractor",
+    "squeezenet_lpips_extractor",
+    "vgg16_lpips_extractor",
+]

@@ -1,0 +1,91 @@
+"""Flax AlexNet feature slices for LPIPS.
+
+Mirrors the vendored ``Alexnet`` in the reference (``functional/image/lpips.py:91-133``):
+five taps at the post-relu activations of torchvision ``alexnet().features`` layers
+1/4/7/9/11 (channel dims 64/192/384/256/256), which feed the bundled ``alex`` LPIPS
+linear heads. ``from_torch_state_dict`` converts a torchvision checkpoint
+(layer-indexed keys ``features.N.weight``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
+
+Array = jax.Array
+
+# torchvision alexnet.features conv layers: index -> (width, kernel, stride, pad)
+_CONVS = {0: (64, 11, 4, 2), 3: (192, 5, 1, 2), 6: (384, 3, 1, 1), 8: (256, 3, 1, 1), 10: (256, 3, 1, 1)}
+# reference slice boundaries (lpips.py:104-114): maxpool(3,2) before convs 3 and 6
+_TAPS = (0, 3, 6, 8, 10)
+_POOL_BEFORE = (3, 6)
+
+
+if nn is not None:
+
+    class AlexNetFeatures(nn.Module):
+        """``__call__`` maps NCHW/NHWC images -> 5 post-relu slice features (NHWC)."""
+
+        @nn.compact
+        def __call__(self, x: Array) -> List[Array]:
+            if x.shape[1] == 3 and x.shape[-1] != 3:  # NCHW -> NHWC
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            outs = []
+            for li in _TAPS:
+                if li in _POOL_BEFORE:
+                    x = nn.max_pool(x, (3, 3), strides=(2, 2))
+                width, k, s, p = _CONVS[li]
+                x = nn.Conv(
+                    width, (k, k), strides=(s, s), padding=((p, p), (p, p)), name=f"conv{li}"
+                )(x)
+                x = nn.relu(x)
+                outs.append(x)
+            return outs
+
+else:  # pragma: no cover
+    AlexNetFeatures = None  # type: ignore[assignment,misc]
+
+
+def from_torch_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Convert torchvision ``alexnet`` (or bare ``features``) weights to flax variables."""
+    import numpy as np
+
+    prefix = "features." if any(k.startswith("features.") for k in state_dict) else ""
+    params: Dict[str, Any] = {}
+    for li in _TAPS:
+        w = np.asarray(state_dict[f"{prefix}{li}.weight"])  # (O, I, kH, kW)
+        b = np.asarray(state_dict[f"{prefix}{li}.bias"])
+        params[f"conv{li}"] = {"kernel": jnp.asarray(w.transpose(2, 3, 1, 0)), "bias": jnp.asarray(b)}
+    return {"params": params}
+
+
+def alexnet_lpips_extractor(
+    state_dict: Optional[Mapping[str, Any]] = None,
+    variables: Optional[Dict[str, Any]] = None,
+):
+    """Build the ``feats_fn`` the LPIPS pipeline injects: NCHW in -> 5 NCHW slice maps.
+
+    Deterministic random init without weights (see ``vgg.py`` — nothing is bundled for
+    backbones; the learned LPIPS heads ARE bundled, so the pipeline runs end-to-end).
+    """
+    if nn is None:  # pragma: no cover
+        raise ModuleNotFoundError("flax is required for the built-in AlexNet extractor")
+    model = AlexNetFeatures()
+    if variables is None:
+        if state_dict is not None:
+            variables = from_torch_state_dict(state_dict)
+        else:
+            variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 64, 64), jnp.float32))
+
+    def feats_fn(imgs: Array) -> List[Array]:
+        outs = model.apply(variables, imgs)
+        return [jnp.transpose(o, (0, 3, 1, 2)) for o in outs]
+
+    return jax.jit(feats_fn)

@@ -1,0 +1,122 @@
+"""Flax SqueezeNet-1.1 feature slices for LPIPS.
+
+Mirrors the vendored ``SqueezeNet`` in the reference (``functional/image/lpips.py:59-88``):
+seven taps over torchvision ``squeezenet1_1().features`` at slice boundaries
+[0:2), [2:5), [5:8), [8:10), [10:11), [11:12), [12:13) — channel dims
+64/128/256/384/384/512/512, feeding the bundled ``squeeze`` LPIPS heads.
+
+torchvision's max pools use ``ceil_mode=True``; emulated here by right/bottom padding
+with ``-inf`` when the spatial extent doesn't divide evenly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
+
+Array = jax.Array
+
+# torchvision squeezenet1_1.features: Fire(squeeze, expand1x1, expand3x3) per index
+_FIRES = {3: (16, 64, 64), 4: (16, 64, 64), 6: (32, 128, 128), 7: (32, 128, 128),
+          9: (48, 192, 192), 10: (48, 192, 192), 11: (64, 256, 256), 12: (64, 256, 256)}
+_POOL_BEFORE = (3, 6, 9)  # MaxPool2d(3, 2, ceil_mode=True) at features indices 2/5/8
+_SLICE_ENDS = (1, 4, 7, 9, 10, 11, 12)  # last features-index of each of the 7 taps
+
+
+def _ceil_max_pool(x: Array) -> Array:
+    """3x3/stride-2 max pool with torch ``ceil_mode=True`` semantics (NHWC)."""
+    h, w = x.shape[1], x.shape[2]
+    pad_h = (-(h - 3)) % 2
+    pad_w = (-(w - 3)) % 2
+    if pad_h or pad_w:
+        x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)), constant_values=-jnp.inf)
+    return nn.max_pool(x, (3, 3), strides=(2, 2))
+
+
+if nn is not None:
+
+    class Fire(nn.Module):
+        """squeeze 1x1 -> relu -> [expand 1x1 | expand 3x3] -> relu -> concat."""
+
+        squeeze: int
+        expand1: int
+        expand3: int
+
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            x = nn.relu(nn.Conv(self.squeeze, (1, 1), name="squeeze")(x))
+            e1 = nn.relu(nn.Conv(self.expand1, (1, 1), name="expand1x1")(x))
+            e3 = nn.relu(nn.Conv(self.expand3, (3, 3), padding=((1, 1), (1, 1)), name="expand3x3")(x))
+            return jnp.concatenate([e1, e3], axis=-1)
+
+    class SqueezeNetFeatures(nn.Module):
+        """``__call__`` maps NCHW/NHWC images -> 7 slice features (NHWC)."""
+
+        @nn.compact
+        def __call__(self, x: Array) -> List[Array]:
+            if x.shape[1] == 3 and x.shape[-1] != 3:  # NCHW -> NHWC
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            x = nn.Conv(64, (3, 3), strides=(2, 2), padding="VALID", name="conv0")(x)
+            x = nn.relu(x)
+            outs = [x]  # tap 1: features[0:2)
+            for li in range(3, 13):
+                if li in _POOL_BEFORE:
+                    x = _ceil_max_pool(x)
+                if li in _FIRES:
+                    s, e1, e3 = _FIRES[li]
+                    x = Fire(s, e1, e3, name=f"fire{li}")(x)
+                if li in _SLICE_ENDS:
+                    outs.append(x)
+            return outs
+
+else:  # pragma: no cover
+    SqueezeNetFeatures = None  # type: ignore[assignment,misc]
+
+
+def from_torch_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Convert torchvision ``squeezenet1_1`` (or bare ``features``) weights to flax variables."""
+    import numpy as np
+
+    prefix = "features." if any(k.startswith("features.") for k in state_dict) else ""
+
+    def conv(key: str) -> Dict[str, Array]:
+        w = np.asarray(state_dict[f"{prefix}{key}.weight"])  # (O, I, kH, kW)
+        b = np.asarray(state_dict[f"{prefix}{key}.bias"])
+        return {"kernel": jnp.asarray(w.transpose(2, 3, 1, 0)), "bias": jnp.asarray(b)}
+
+    params: Dict[str, Any] = {"conv0": conv("0")}
+    for li in _FIRES:
+        params[f"fire{li}"] = {
+            "squeeze": conv(f"{li}.squeeze"),
+            "expand1x1": conv(f"{li}.expand1x1"),
+            "expand3x3": conv(f"{li}.expand3x3"),
+        }
+    return {"params": params}
+
+
+def squeezenet_lpips_extractor(
+    state_dict: Optional[Mapping[str, Any]] = None,
+    variables: Optional[Dict[str, Any]] = None,
+):
+    """Build the ``feats_fn`` the LPIPS pipeline injects: NCHW in -> 7 NCHW slice maps."""
+    if nn is None:  # pragma: no cover
+        raise ModuleNotFoundError("flax is required for the built-in SqueezeNet extractor")
+    model = SqueezeNetFeatures()
+    if variables is None:
+        if state_dict is not None:
+            variables = from_torch_state_dict(state_dict)
+        else:
+            variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 64, 64), jnp.float32))
+
+    def feats_fn(imgs: Array) -> List[Array]:
+        outs = model.apply(variables, imgs)
+        return [jnp.transpose(o, (0, 3, 1, 2)) for o in outs]
+
+    return jax.jit(feats_fn)

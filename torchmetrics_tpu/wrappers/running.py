@@ -66,10 +66,18 @@ class Running(Metric):
         return res
 
     def compute(self) -> Any:
-        """Fold every window slot into the base metric and compute (reference ``running.py:118-126``)."""
-        for i in range(self.window):
-            self.base_metric._reduce_states(
-                {key: getattr(self, key + f"_{i}") for key in self.base_metric._defaults}
+        """Fold the occupied window slots into the base metric and compute.
+
+        Reference ``running.py:118-126`` folds with ``_reduce_states``, which breaks
+        mean-reduced states (the reset base metric has ``_update_count == 0``). Folding
+        with ``merge_state(..., incoming_count=1)`` instead — each slot snapshots
+        exactly one update — weights every reduction correctly, and skipping the
+        never-written slots keeps defaults out of mean/max/min states.
+        """
+        for i in range(min(self._num_vals_seen, self.window)):
+            self.base_metric.merge_state(
+                {key: getattr(self, key + f"_{i}") for key in self.base_metric._defaults},
+                incoming_count=1,
             )
         val = self.base_metric.compute()
         self.base_metric.reset()
