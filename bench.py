@@ -42,6 +42,26 @@ STEPS = 2000        # device-side scan steps (ours)
 TORCH_STEPS = 20    # eager baseline iterations (each is ~ms-scale on CPU)
 WARMUP = 5
 
+# Per-chip HBM peak (GB/s) by device kind — the metric-update kernels are
+# memory-bound (elementwise/reduction over logits), so achieved-GB/s vs HBM peak is
+# the honest efficiency readout (MFU would flatter: these kernels do few FLOPs/byte).
+_HBM_PEAK_GBPS = {"TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v5p": 2765.0}
+_DEFAULT_HBM_PEAK = 819.0
+
+# Bytes each scenario's update step must move through HBM at minimum: inputs read +
+# state read/written (outputs that stay in registers/VMEM are not counted).
+_SCENARIO_BYTES = {
+    "accuracy_us": ACC_BATCH * ACC_CLASSES * 4 + ACC_BATCH * 4 + 8 * ACC_CLASSES * 4,
+    "auroc_cm_us": (
+        CIFAR_BATCH * CIFAR_CLASSES * 4  # logits
+        + CIFAR_BATCH * 4
+        + 2 * (N_THRESH * CIFAR_CLASSES * 4 * 4 + CIFAR_CLASSES * CIFAR_CLASSES * 4)  # states r+w
+    ),
+    "ssim_us": 2 * IMG_BATCH * 3 * IMG_SIZE * IMG_SIZE * 4,
+    "perplexity_us": PPL_BATCH * PPL_SEQ * PPL_VOCAB * 4 + PPL_BATCH * PPL_SEQ * 4,
+    "det_iou_us": 2 * DET_IMGS * DET_BOXES * 4 * 4 + DET_IMGS * DET_BOXES * DET_BOXES * 4,
+}
+
 
 def _time_jitted(step, state, *args):
     """Mean µs/step of a jitted state-in/state-out update, measured on-device.
@@ -359,8 +379,21 @@ def bench_sync_latency(n_devices=8):
     raise RuntimeError(f"sync probe produced no number: {proc.stdout[-500:]!r} {proc.stderr[-500:]!r}")
 
 
+def _hbm_peak_gbps():
+    """(peak or None, device_kind): None for unrecognized backends (e.g. CPU) so the
+    output never fabricates a peak_frac against hardware that was not present."""
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    for name, peak in _HBM_PEAK_GBPS.items():
+        if name in kind:
+            return peak, kind
+    return None, kind
+
+
 def main():
     ours = bench_ours()  # all device timings complete before any host work
+    peak_gbps, device_kind = _hbm_peak_gbps()
     try:
         baseline = bench_torch()
     except Exception:
@@ -375,11 +408,22 @@ def main():
     extras = {}
     for key, ours_us in ours.items():
         extras[key.replace("_us", "_us_ours")] = round(ours_us, 2)
+        if key in _SCENARIO_BYTES:
+            gbps = _SCENARIO_BYTES[key] / (ours_us * 1e-6) / 1e9
+            extras[key.replace("_us", "_gbps")] = round(gbps, 1)
+            if peak_gbps is not None:
+                extras[key.replace("_us", "_peak_frac")] = round(gbps / peak_gbps, 3)
         if key in baseline:
             extras[key.replace("_us", "_us_torch")] = round(baseline[key], 2)
             extras[key.replace("_us", "_speedup")] = round(baseline[key] / ours_us, 3)
     for n, sync_us in sync_sweep.items():
         extras[f"mesh{n}_sync_us"] = round(sync_us, 2)
+        # Per-shard normalization: the virtual CPU mesh reduces all N shards on one
+        # host, so total time grows ~O(N) (bytes grow with N) — flat us/shard shows
+        # that's the emulation's bandwidth, not collective geometry. On real ICI a
+        # ring all-reduce moves ~2*(N-1)/N * bytes per chip: ~constant in N, plus
+        # O(log N) latency hops — the 8->256 north-star axis needs a pod to measure.
+        extras[f"mesh{n}_sync_us_per_shard"] = round(sync_us / n, 2)
 
     vs = baseline.get("accuracy_us", ours["accuracy_us"]) / ours["accuracy_us"]
     print(
@@ -388,7 +432,13 @@ def main():
                 "metric": "multiclass_accuracy_8192x1000_update_us_per_step",
                 "value": round(ours["accuracy_us"], 2),
                 "unit": "us/step",
+                # ratio vs the reference's update stage re-expressed in eager torch on
+                # CPU (the reference CI's own configuration; no CUDA device here) —
+                # NOT a same-silicon comparison
                 "vs_baseline": round(vs, 3),
+                "baseline": "torch-eager-cpu",
+                "device": device_kind,
+                "hbm_peak_gbps": peak_gbps,
                 "extras": extras,
             }
         )

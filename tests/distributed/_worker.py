@@ -1,0 +1,85 @@
+"""Worker for the real 2-process host-sync test (launched by test_multiprocess_sync).
+
+Each process initializes ``jax.distributed`` (gloo CPU collectives), then drives the
+host/multi-process sync path — ``gather_all_tensors`` equal-shape, ragged pad/trim,
+and ``process_group`` sub-worlds — plus full metric ``compute()`` syncs, mirroring
+the reference's 2-process gloo-pool recipe (``tests/unittests/conftest.py:25-56``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+RANK = int(sys.argv[1])
+PORT = sys.argv[2]
+WORLD = 2
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{PORT}", num_processes=WORLD, process_id=RANK, local_device_ids=[0]
+)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu import PearsonCorrCoef  # noqa: E402
+from torchmetrics_tpu.classification import MulticlassAccuracy  # noqa: E402
+from torchmetrics_tpu.parallel.sync import gather_all_tensors, jit_distributed_available  # noqa: E402
+
+assert jax.process_count() == WORLD, f"world did not form: {jax.process_count()}"
+assert jit_distributed_available()
+
+# --- 1. equal-shape gather -----------------------------------------------------------
+x = jnp.full((3, 2), float(RANK + 1))
+out = gather_all_tensors(x)
+assert len(out) == WORLD and all(o.shape == (3, 2) for o in out)
+np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+np.testing.assert_allclose(np.asarray(out[1]), 2.0)
+
+# --- 2. ragged gather: pad/trim branch (rank r contributes r+2 rows) -----------------
+ragged = jnp.arange((RANK + 2) * 4, dtype=jnp.float32).reshape(RANK + 2, 4)
+out = gather_all_tensors(ragged)
+assert [o.shape for o in out] == [(2, 4), (3, 4)]
+np.testing.assert_allclose(np.asarray(out[RANK]), np.asarray(ragged))
+
+# --- 3. process_group sub-worlds -----------------------------------------------------
+mine = gather_all_tensors(x, group=[RANK])
+assert len(mine) == 1
+np.testing.assert_allclose(np.asarray(mine[0]), float(RANK + 1))
+both = gather_all_tensors(ragged, group=[0, 1])
+assert [o.shape for o in both] == [(2, 4), (3, 4)]
+
+# --- 4. metric compute() across the real world ---------------------------------------
+rng = np.random.default_rng(0)  # identical stream on both ranks
+all_preds = rng.integers(0, 5, size=(WORLD, 32))
+all_target = rng.integers(0, 5, size=(WORLD, 32))
+
+acc = MulticlassAccuracy(num_classes=5, average="micro")
+acc.update(jnp.asarray(all_preds[RANK]), jnp.asarray(all_target[RANK]))
+synced_val = float(acc.compute())
+golden = float(np.mean(all_preds.reshape(-1) == all_target.reshape(-1)))
+np.testing.assert_allclose(synced_val, golden, atol=1e-6)
+
+# unsync restored local state: recompute without sync gives the rank-local value
+acc._to_sync = False
+acc._computed = None
+local_val = float(acc.compute())
+local_golden = float(np.mean(all_preds[RANK] == all_target[RANK]))
+np.testing.assert_allclose(local_val, local_golden, atol=1e-6)
+
+# --- 5. None-reduction raw gather (Pearson moments folded at compute) ----------------
+p = rng.normal(size=(WORLD, 40)).astype(np.float32)
+t = (0.5 * p + 0.5 * rng.normal(size=(WORLD, 40))).astype(np.float32)
+pearson = PearsonCorrCoef()
+pearson.update(jnp.asarray(p[RANK]), jnp.asarray(t[RANK]))
+synced_r = float(pearson.compute())
+full = np.corrcoef(p.reshape(-1), t.reshape(-1))[0, 1]
+np.testing.assert_allclose(synced_r, full, atol=1e-5)
+
+print(f"RANK {RANK} PASS", flush=True)

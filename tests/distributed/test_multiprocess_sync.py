@@ -1,0 +1,61 @@
+"""Real 2-process ``jax.distributed`` execution of the host sync path.
+
+The reference runs its distributed tests in actual 2-process gloo worlds
+(``tests/unittests/conftest.py:25-56``, ``helpers/testers.py:404-421``); this is the
+TPU-build equivalent: two CPU processes joined via ``jax.distributed.initialize``
+(gloo collectives), driving ``parallel/sync.py``'s ``gather_all_tensors`` —
+equal-shape, ragged pad/trim, ``process_group`` sub-worlds — and full metric
+``compute()`` syncs with ``process_count() == 2`` (see ``_worker.py``).
+
+The workers strip the axon site customization from PYTHONPATH: its forced backend
+registration breaks multi-process world formation.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_WORKER = Path(__file__).resolve().parent / "_worker.py"
+_REPO = Path(__file__).resolve().parents[2]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(300)
+def test_two_process_host_sync():
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = str(_REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), str(rank), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=str(_REPO),
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        outs.append(out)
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"RANK {rank} PASS" in out, f"rank {rank} did not pass:\n{out[-3000:]}"

@@ -1,0 +1,80 @@
+"""Tests for the FID-compat InceptionV3 trunk (torch-fidelity semantics).
+
+Reference behavior spec: ``/root/reference/src/torchmetrics/image/fid.py:69-153`` —
+TF1-style resize, (x-128)/128 normalisation, tap layout, FID-variant pooling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import warnings
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.image._extractor import resolve_feature_extractor
+from torchmetrics_tpu.models.inception import (
+    fid_inception_v3_extractor,
+    tf1_bilinear_resize,
+    _tf1_resize_matrix,
+)
+
+rng = np.random.default_rng(7)
+
+
+def test_tf1_resize_matrix_rows_sum_to_one():
+    for in_s, out_s in [(4, 8), (32, 299), (299, 299), (300, 299)]:
+        m = _tf1_resize_matrix(in_s, out_s)
+        np.testing.assert_allclose(np.asarray(m.sum(axis=1)), np.ones(out_s), atol=1e-5)
+
+
+def test_tf1_resize_semantics():
+    """src = dst * (in/out) — NOT half-pixel: out[1] of a 4->8 upsample interpolates
+    source rows 0/1 at fraction 0.5, and out[0] equals source row 0 exactly."""
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    out = tf1_bilinear_resize(x, (8, 8))
+    np.testing.assert_allclose(float(out[0, 0, 0, 0]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(out[0, 1, 0, 0]), 2.0, atol=1e-6)  # (row0+row1)/2
+    np.testing.assert_allclose(float(out[0, 0, 1, 0]), 0.5, atol=1e-6)  # (col0+col1)/2
+
+
+def test_identity_resize_is_exact():
+    x = jnp.asarray(rng.normal(size=(1, 299, 299, 2)).astype(np.float32))
+    out = tf1_bilinear_resize(x, (299, 299))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+
+
+@pytest.mark.parametrize(("tap", "dim"), [("64", 64), ("192", 192), ("logits_unbiased", 1008)])
+def test_trunk_tap_dims(tap, dim):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        extractor, n = resolve_feature_extractor(tap)
+    assert n == dim
+    imgs = jnp.asarray(rng.integers(0, 255, size=(2, 3, 32, 32), dtype=np.uint8))
+    feats = extractor(imgs)
+    assert feats.shape == (2, dim)
+    assert bool(jnp.isfinite(feats).all())
+
+
+def test_trunk_2048_and_multi_tap():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fn = fid_inception_v3_extractor(("2048", "logits"), warn_on_random=False)
+    imgs = jnp.asarray(rng.integers(0, 255, size=(2, 3, 48, 48), dtype=np.uint8))
+    feats, logits = fn(imgs)
+    assert feats.shape == (2, 2048) and logits.shape == (2, 1008)
+
+
+def test_default_trunk_is_cached_and_deterministic():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        a, _ = resolve_feature_extractor(64)
+        b, _ = resolve_feature_extractor("64")
+    assert a is b  # lru-cached default: FID/KID/IS share one trunk + XLA cache
+    imgs = jnp.asarray(rng.integers(0, 255, size=(1, 3, 32, 32), dtype=np.uint8))
+    np.testing.assert_array_equal(np.asarray(a(imgs)), np.asarray(b(imgs)))
+
+
+def test_invalid_tap_raises():
+    with pytest.raises(ValueError, match="feature"):
+        resolve_feature_extractor(128)

@@ -89,9 +89,12 @@ class TestFID:
         fid.update(imgs, real=False)
         assert int(fid.real_features_num_samples) == 8
 
-    def test_default_feature_raises_without_weights(self):
-        with pytest.raises(ModuleNotFoundError, match="pretrained"):
-            FrechetInceptionDistance()
+    def test_default_feature_builds_compat_trunk(self):
+        """Default feature=2048 now builds the FID-compat trunk, warning that the
+        deterministic random init is self-consistent only (no bundled weights)."""
+        with pytest.warns(UserWarning, match="self-consistent"):
+            fid = FrechetInceptionDistance()
+        assert fid.num_features == 2048
 
     def test_merge_state_parity(self):
         """World-2 emulation: two replicas merged == single stream (psum sync path)."""
@@ -126,7 +129,10 @@ class TestInceptionScore:
         scores = []
         for chunk in np.array_split(prob, 2):
             marg = chunk.mean(0, keepdims=True)
-            kl = (chunk * (np.log(chunk) - np.log(marg))).sum(1).mean()
+            # xlogy-safe: classes with p underflowed to exactly 0 contribute 0
+            with np.errstate(divide="ignore", invalid="ignore"):
+                term = chunk * (np.log(chunk) - np.log(marg))
+            kl = np.where(chunk > 0, term, 0.0).sum(1).mean()
             scores.append(np.exp(kl))
         np.testing.assert_allclose(float(mean), np.mean(scores), rtol=1e-4)
         np.testing.assert_allclose(float(std), np.std(scores, ddof=1), rtol=1e-3)

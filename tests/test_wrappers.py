@@ -345,4 +345,17 @@ def test_running_reset():
         metric(jnp.asarray(float(i)))
     metric.reset()
     assert metric._num_vals_seen == 0
-    assert float(metric.base_metric.compute() if metric.base_metric.update_count else 0.0) == 0.0
+    # stale slots must not leak into a fresh window: sum of {5} alone, not {1,2,3,5}
+    metric(jnp.asarray(5.0))
+    assert float(metric.compute()) == pytest.approx(5.0)
+
+
+def test_running_forward_only_use_does_not_warn():
+    import warnings
+
+    metric = Running(SumMetric(), window=2)
+    metric(jnp.asarray(1.0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert float(metric.compute()) == pytest.approx(1.0)
+    assert metric.update_count == 1

@@ -1,0 +1,99 @@
+"""End-to-end tests for the HF-backed default paths of BERTScore / InfoLM / CLIPScore.
+
+No egress in CI: a tiny Flax BERT checkpoint + WordPiece vocab are written with
+``save_pretrained`` to a tmp dir and loaded back through the exact code path a user's
+``model_name_or_path`` takes (reference ``text/bert.py:192-195``). Hub ids that are
+not cached must fail with the actionable offline error, not an HTTP traceback.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+transformers = pytest.importorskip("transformers")
+
+from torchmetrics_tpu.functional.text.bert import bert_score  # noqa: E402
+from torchmetrics_tpu.functional.text.infolm import infolm  # noqa: E402
+from torchmetrics_tpu.text.bert import BERTScore  # noqa: E402
+
+_VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "hello", "world", "the", "cat", "sat", "on", "mat", "a", "dog", "ran", "in", "park",
+]
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_dir(tmp_path_factory):
+    """A local save_pretrained checkpoint: tiny FlaxBertForMaskedLM + matching tokenizer."""
+    d = tmp_path_factory.mktemp("tiny_bert")
+    vocab = d / "vocab.txt"
+    vocab.write_text("\n".join(_VOCAB))
+    tok = transformers.BertTokenizer(str(vocab))
+    tok.save_pretrained(str(d))
+    config = transformers.BertConfig(
+        vocab_size=len(_VOCAB),
+        hidden_size=16,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=32,
+        max_position_embeddings=64,
+    )
+    model = transformers.FlaxBertForMaskedLM(config, seed=0)
+    model.save_pretrained(str(d))
+    return str(d)
+
+
+def test_bert_score_from_local_checkpoint(tiny_bert_dir):
+    """model_name_or_path drives tokenizer + Flax model end-to-end; identical
+    sentences score 1.0 and different sentences score strictly lower."""
+    preds = ["hello world", "the cat sat on the mat"]
+    target = ["hello world", "the cat sat on the mat"]
+    out = bert_score(preds, target, model_name_or_path=tiny_bert_dir, max_length=16)
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-4)
+
+    out2 = bert_score(["a dog ran in the park"], ["the cat sat on the mat"],
+                      model_name_or_path=tiny_bert_dir, max_length=16)
+    assert float(np.asarray(out2["f1"])[0]) < 1.0 - 1e-4
+
+
+def test_bert_score_modular_with_idf(tiny_bert_dir):
+    metric = BERTScore(model_name_or_path=tiny_bert_dir, idf=True, max_length=16)
+    metric.update(["hello world"], ["hello world"])
+    metric.update(["the cat sat"], ["the cat sat"])
+    out = metric.compute()
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-4)
+
+
+def test_bert_score_num_layers(tiny_bert_dir):
+    out = bert_score(["hello world"], ["hello world"],
+                     model_name_or_path=tiny_bert_dir, num_layers=1, max_length=16)
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-4)
+
+
+def test_infolm_from_local_checkpoint(tiny_bert_dir):
+    """Masked-LM distribution pipeline: identical corpora give ~0 divergence."""
+    score = infolm(["hello world"], ["hello world"], model_name_or_path=tiny_bert_dir, idf=False)
+    np.testing.assert_allclose(float(score), 0.0, atol=1e-4)
+    score2 = infolm(["a dog ran in the park"], ["the cat sat on the mat"],
+                    model_name_or_path=tiny_bert_dir, idf=False)
+    assert float(score2) > float(score)
+
+
+def test_uncached_hub_id_fails_cleanly(monkeypatch):
+    """A hub id that is not cached raises the actionable offline error."""
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    with pytest.raises(ModuleNotFoundError, match="cached"):
+        bert_score(["x"], ["x"], model_name_or_path="no-such-org/no-such-model")
+
+
+def test_clip_score_uncached_fails_cleanly(monkeypatch):
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
+
+    with pytest.raises(ModuleNotFoundError, match="cached"):
+        clip_score(jnp.zeros((3, 32, 32), dtype=jnp.uint8), "a photo")

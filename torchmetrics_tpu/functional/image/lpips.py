@@ -139,7 +139,6 @@ def lpips_network(
             " deterministic randomly-initialised backbone: scores are self-consistent but not canonical LPIPS."
             " Pass `backbone_state_dict=` (a torchvision checkpoint) for exact values."
         )
-    if backbone_state_dict is None and backbone_variables is None:
         return _default_lpips_network(net_type, spatial)
     feats_fn = _lpips_backbone_builder(net_type)(
         state_dict=backbone_state_dict, variables=backbone_variables

@@ -27,7 +27,12 @@ def _get_model_and_processor(model_name_or_path: str = _DEFAULT_MODEL) -> Tuple[
     if _TRANSFORMERS_AVAILABLE:
         from transformers import CLIPModel, CLIPProcessor
 
-        return CLIPModel.from_pretrained(model_name_or_path), CLIPProcessor.from_pretrained(model_name_or_path)
+        try:
+            return CLIPModel.from_pretrained(model_name_or_path), CLIPProcessor.from_pretrained(model_name_or_path)
+        except Exception as exc:  # noqa: BLE001 — offline-clean error instead of hub traceback
+            from torchmetrics_tpu.utilities.hf import _load_error
+
+            raise _load_error(model_name_or_path, exc) from exc
     raise ModuleNotFoundError(
         "`clip_score` metric requires `transformers` package be installed."
         " Either install with `pip install transformers>=4.0` or `pip install torchmetrics[multimodal]`."

@@ -1,11 +1,13 @@
 """BERTScore (reference ``functional/text/bert.py``).
 
 The greedy cosine-matching core is pure jnp — one (L_p, L_t) matmul per pair, vmapped
-over the batch (MXU path). The transformer is an injection point: pass
-``user_tokenizer`` (sentences → {input_ids, attention_mask}) and ``model``
-(input_ids, attention_mask → (N, L, D) embeddings) exactly like the reference's
-own-model path (``examples/bert_score-own_model.py``); HF model-name strings raise —
-no pretrained weights are bundled in this environment.
+over the batch (MXU path). The transformer comes from either path the reference
+supports: ``model_name_or_path`` loads a HF transformer (Flax-first via
+``utilities.hf``, torch-weight conversion, offline-clean error when the weights are
+not cached — reference ``text/bert.py:192-195``), or inject ``user_tokenizer``
+(sentences → {input_ids, attention_mask}) plus ``model`` (input_ids, attention_mask →
+(N, L, D) embeddings) like the reference's own-model path
+(``examples/bert_score-own_model.py``).
 """
 
 from __future__ import annotations
@@ -119,6 +121,23 @@ def bert_score(
         raise ValueError("Number of predicted and reference sentences must be the same!")
     if rescale_with_baseline:
         raise ValueError("Baseline rescaling requires downloadable baseline files, which are unavailable.")
+    if model is None and model_name_or_path is not None:
+        # HF path (reference ``text/bert.py:192-195``): Flax-first transformer +
+        # AutoTokenizer, offline-clean errors from utilities.hf
+        from torchmetrics_tpu.utilities.hf import (
+            hf_embedding_forward,
+            hf_tokenize,
+            load_hf_model_and_tokenizer,
+            model_max_length,
+        )
+
+        hf_model, hf_tok = load_hf_model_and_tokenizer(model_name_or_path)
+        model = hf_embedding_forward(hf_model, num_layers=num_layers)
+        hf_max_length = model_max_length(hf_model, max_length)
+        if user_tokenizer is None:
+            user_tokenizer = lambda sents: dict(  # noqa: E731
+                zip(("input_ids", "attention_mask"), hf_tokenize(hf_tok, sents, max_length=hf_max_length))
+            )
     _validate_model_inputs(model if model is not None else model_name_or_path, user_tokenizer)
 
     pred_tok = user_tokenizer(preds)

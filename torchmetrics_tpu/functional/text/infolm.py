@@ -1,11 +1,10 @@
 """InfoLM (reference ``functional/text/infolm.py``).
 
 All nine information measures are implemented as pure jnp functions over masked-LM
-token distributions; the masked language model itself is an injection point (callable
-``model(sentences) -> (probs, mask)`` giving per-sentence aggregated token
-distributions), since no pretrained weights are downloadable here. HF model-name
-strings raise with guidance, mirroring the pluggable-extractor policy of the image
-domain.
+token distributions. The masked LM comes from either path: ``model_name_or_path``
+builds the full HF pipeline (tokenize → masked-LM logits → temperature softmax →
+idf-weighted aggregation; Flax-first via ``utilities.hf`` with offline-clean errors),
+or inject a callable ``model(sentences) -> (N, V) distributions`` directly.
 """
 
 from __future__ import annotations
@@ -112,6 +111,73 @@ class _InformationMeasure:
         return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * q), axis=-1), 0.0, 1.0))
 
 
+def make_hf_masked_lm_distribution_fn(
+    model_name_or_path: str,
+    temperature: float = 0.25,
+    idf: bool = True,
+    max_length: int = 512,
+) -> Callable[[List[str]], Array]:
+    """Build the reference's masked-LM sentence-distribution pipeline from a HF id.
+
+    Per the reference (``functional/text/infolm.py:354-403``): for every sequence
+    position, replace that token with ``[MASK]``, run the masked LM, and take the
+    temperature-softmaxed *predictive* distribution at the masked position; aggregate
+    the per-position distributions into one (V,) sentence distribution, weighting by
+    idf of the replaced token (or uniformly), with special tokens (PAD/SEP/CLS)
+    excluded from the aggregation.
+    """
+    import numpy as np
+
+    from torchmetrics_tpu.utilities.hf import (
+        hf_logits_forward,
+        hf_tokenize,
+        load_hf_model_and_tokenizer,
+        model_max_length,
+    )
+
+    hf_model, tokenizer = load_hf_model_and_tokenizer(model_name_or_path, "FlaxAutoModelForMaskedLM")
+    forward = hf_logits_forward(hf_model)
+    max_length = model_max_length(hf_model, max_length)
+    mask_token_id = tokenizer.mask_token_id
+    if mask_token_id is None:
+        raise ValueError(
+            f"Tokenizer for `{model_name_or_path!r}` has no mask token — InfoLM requires a masked LM."
+        )
+    special_ids = [i for i in (tokenizer.pad_token_id, tokenizer.sep_token_id, tokenizer.cls_token_id) if i is not None]
+
+    def fn(sentences: List[str]) -> Array:
+        ids, attn = hf_tokenize(tokenizer, sentences, max_length=max_length, padding="longest")
+        ids_np = np.asarray(ids)
+        seq_len = ids_np.shape[1]
+        # 1s on real content tokens (reference ``_get_token_mask:330-352``)
+        token_mask = ~np.isin(ids_np, special_ids)
+        if idf:
+            from torchmetrics_tpu.functional.text.bert import _compute_idf, _idf_weights
+
+            # token_mask (not the attention mask) as the weight mask: special tokens
+            # are excluded from the aggregation (reference ``infolm.py:398-401``)
+            pos_w = np.asarray(_idf_weights(ids_np, token_mask, _compute_idf([ids], [attn])), dtype=np.float64)
+        else:
+            pos_w = token_mask.astype(np.float64)
+
+        acc = None
+        for pos in range(seq_len):
+            if not token_mask[:, pos].any():
+                continue
+            masked = ids_np.copy()
+            masked[:, pos] = mask_token_id
+            logits = forward(jnp.asarray(masked), attn)  # (N, L, V)
+            probs = np.asarray(jax.nn.softmax(logits[:, pos, :] / temperature, axis=-1), dtype=np.float64)
+            contrib = probs * pos_w[:, pos : pos + 1]
+            acc = contrib if acc is None else acc + contrib
+        if acc is None:
+            raise ValueError("No content tokens found in the input sentences.")
+        acc /= np.clip(pos_w.sum(axis=1, keepdims=True), _EPS, None)
+        return jnp.asarray(acc)
+
+    return fn
+
+
 def infolm(
     preds: Union[str, List[str]],
     target: Union[str, List[str]],
@@ -135,11 +201,12 @@ def infolm(
         target = [target]
     if len(preds) != len(target):
         raise ValueError("Number of predicted and reference sentences must be the same!")
+    if model is None and model_name_or_path is not None:
+        model = make_hf_masked_lm_distribution_fn(model_name_or_path, temperature=temperature, idf=idf)
     if model is None or isinstance(model, str) or not callable(model):
-        raise ModuleNotFoundError(
-            f"Default masked-LM backbones (`model_name_or_path={model_name_or_path!r}`) require downloadable"
-            " pretrained weights, which are not available. Pass a callable"
-            " `model(sentences) -> (N, V) distributions` instead."
+        raise ValueError(
+            "Either pass `model_name_or_path` (a cached/local HF masked-LM) or a callable"
+            " `model(sentences) -> (N, V) distributions`."
         )
     measure = _InformationMeasure(information_measure, alpha, beta)
     preds_distribution = model(preds)

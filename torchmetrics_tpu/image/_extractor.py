@@ -1,11 +1,14 @@
 """Pluggable feature-extractor resolution for model-backed image metrics.
 
 The reference builds its extractors from ``torch-fidelity``'s pretrained InceptionV3
-(``image/fid.py:52-157``). This environment has no bundled weights and no egress, so
-the extractor is an injection point instead: any callable ``imgs -> (N, d) features``
-(a Flax module's apply, a jitted function, …). Passing the reference's integer feature
-sizes raises the same kind of actionable error the reference raises when
-``torch-fidelity`` is missing.
+(``image/fid.py:52-157``). The TPU build ships that trunk as a native Flax module —
+``models.inception.FIDInceptionV3`` reproduces the FID-variant pooling blocks, the
+TF1-style bilinear resize to 299x299, and the 1008-way logits head — so the
+reference's integer/str defaults (``feature=64/192/768/2048``, ``'logits_unbiased'``)
+work out of the box. Pretrained weights are NOT bundled (zero-egress environment):
+the default trunk is deterministically randomly initialised and warns — scores are
+self-consistent but not canonical until a ``pt_inception-2015-12-05`` checkpoint is
+converted in. Any callable ``imgs -> (N, d)`` remains accepted as a custom extractor.
 """
 
 from __future__ import annotations
@@ -17,6 +20,8 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
+_FID_TAP_DIMS = {"64": 64, "192": 192, "768": 768, "2048": 2048, "logits_unbiased": 1008, "logits": 1008}
+
 
 def resolve_feature_extractor(
     feature,
@@ -26,20 +31,22 @@ def resolve_feature_extractor(
     """Return ``(extractor, num_features)`` for a pluggable ``feature`` argument.
 
     Args:
-        feature: a callable ``imgs -> (N, d)`` feature extractor, or one of the
-            reference's integer/str defaults (which require pretrained weights and
-            therefore raise here with guidance).
-        num_features: feature dimensionality; probed with a dummy forward if ``None``.
+        feature: one of the reference's integer/str taps (64/192/768/2048 /
+            'logits_unbiased'/'logits' — builds the FID-compat InceptionV3 trunk,
+            reference ``image/fid.py:186-201``), or a callable ``imgs -> (N, d)``.
+        num_features: feature dimensionality; for callables probed with a dummy
+            forward when ``None``.
         probe_shape: shape of the dummy input used to probe ``num_features``.
     """
     if isinstance(feature, (int, str)):
-        raise ModuleNotFoundError(
-            f"Default feature extractor `feature={feature!r}` requires pretrained InceptionV3 weights, which are"
-            " not bundled. Build one with `torchmetrics_tpu.models.inception_v3_extractor(state_dict=...)`"
-            " from a torchvision inception_v3 checkpoint (the architecture is a native Flax module), or pass"
-            " any callable `imgs -> (N, d)` feature extractor. Note: that trunk ends at the 2048-d pool —"
-            " InceptionScore needs class LOGITS, so wrap the trunk with the checkpoint's fc layer."
-        )
+        tap = str(feature)
+        if tap not in _FID_TAP_DIMS:
+            raise ValueError(
+                f"Integer/str input to argument `feature` must be one of {sorted(_FID_TAP_DIMS)}, got {feature!r}"
+            )
+        from torchmetrics_tpu.models.inception import fid_inception_v3_extractor
+
+        return fid_inception_v3_extractor(tap), _FID_TAP_DIMS[tap]
     if not callable(feature):
         raise TypeError("Got unknown input to argument `feature`")
     if num_features is None:

@@ -14,6 +14,7 @@ import numpy as np
 
 from torchmetrics_tpu.image._extractor import resolve_feature_extractor
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.compute import _safe_xlogy
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -75,7 +76,10 @@ class InceptionScore(Metric):
         kl_ = []
         for p, log_p in zip(prob_chunks, log_prob_chunks):
             mean_prob = p.mean(axis=0, keepdims=True)
-            kl = p * (log_p - jnp.log(mean_prob))
+            # p*log_p uses the finite log_softmax; the marginal term goes through
+            # xlogy so classes whose probability underflows to exactly 0 contribute
+            # 0 instead of 0 * log(0) = nan (hit with saturated/extreme logits)
+            kl = p * log_p - _safe_xlogy(p, jnp.broadcast_to(mean_prob, p.shape))
             kl_.append(jnp.exp(kl.sum(axis=1).mean()))
         kl_stack = jnp.stack(kl_)
         return kl_stack.mean(), kl_stack.std(ddof=1)

@@ -14,7 +14,8 @@ the ``imgs -> (N, 2048)`` callable the image metrics accept.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+from functools import lru_cache
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -48,8 +49,17 @@ if nn is not None:
             x = nn.BatchNorm(use_running_average=True, epsilon=_BN_EPS, name="bn")(x)
             return nn.relu(x)
 
+    def _branch_avg_pool(x: Array, count_include_pad: bool) -> Array:
+        """3x3/stride-1/pad-1 average pool; ``count_include_pad=False`` is the
+        torch-fidelity FID-variant semantics (border windows divide by the number of
+        real pixels, not 9)."""
+        return nn.avg_pool(
+            x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)), count_include_pad=count_include_pad
+        )
+
     class InceptionA(nn.Module):
         pool_features: int
+        fid_pool: bool = False  # torch-fidelity FIDInceptionA: count_include_pad=False
 
         @nn.compact
         def __call__(self, x: Array) -> Array:
@@ -59,7 +69,7 @@ if nn is not None:
             b3 = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
             b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(b3)
             b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_3")(b3)
-            bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+            bp = _branch_avg_pool(x, count_include_pad=not self.fid_pool)
             bp = BasicConv2d(self.pool_features, (1, 1), name="branch_pool")(bp)
             return jnp.concatenate([b1, b5, b3, bp], axis=-1)
 
@@ -75,6 +85,7 @@ if nn is not None:
 
     class InceptionC(nn.Module):
         channels_7x7: int
+        fid_pool: bool = False  # torch-fidelity FIDInceptionC: count_include_pad=False
 
         @nn.compact
         def __call__(self, x: Array) -> Array:
@@ -88,7 +99,7 @@ if nn is not None:
             bd = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7dbl_3")(bd)
             bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_4")(bd)
             bd = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7dbl_5")(bd)
-            bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+            bp = _branch_avg_pool(x, count_include_pad=not self.fid_pool)
             bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
             return jnp.concatenate([b1, b7, bd, bp], axis=-1)
 
@@ -105,6 +116,11 @@ if nn is not None:
             return jnp.concatenate([b3, b7, bp], axis=-1)
 
     class InceptionE(nn.Module):
+        # torch-fidelity variants: FIDInceptionE_1 (Mixed_7b) = avg pool with
+        # count_include_pad=False; FIDInceptionE_2 (Mixed_7c) = MAX pool — the TF
+        # implementation's quirk, preserved so converted weights reproduce scores.
+        pool: str = "avg"  # "avg" | "fid_avg" | "max"
+
         @nn.compact
         def __call__(self, x: Array) -> Array:
             b1 = BasicConv2d(320, (1, 1), name="branch1x1")(x)
@@ -117,7 +133,14 @@ if nn is not None:
             bda = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3dbl_3a")(bd)
             bdb = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3dbl_3b")(bd)
             bd = jnp.concatenate([bda, bdb], axis=-1)
-            bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+            if self.pool == "max":
+                bp = nn.max_pool(
+                    jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf),
+                    (3, 3),
+                    strides=(1, 1),
+                )
+            else:
+                bp = _branch_avg_pool(x, count_include_pad=self.pool == "avg")
             bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
             return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
@@ -154,8 +177,100 @@ if nn is not None:
             x = InceptionE(name="Mixed_7c")(x)
             return x.mean(axis=(1, 2))  # global average pool -> (N, 2048)
 
+    class FIDInceptionV3(nn.Module):
+        """torch-fidelity's 'inception-v3-compat' trunk (reference ``image/fid.py:69-153``).
+
+        Differences from torchvision captured here: TF1-style bilinear resize to
+        299x299 (``align_corners=False``, source = dest * scale — implemented as two
+        matmuls, MXU-friendly), ``(x - 128) / 128`` input normalisation, FID-variant
+        pooling in the A/C/E blocks (``count_include_pad=False``; max pool in
+        Mixed_7c), and a 1008-way fc head. ``request`` picks the returned taps from
+        {'64', '192', '768', '2048', 'logits_unbiased', 'logits'}.
+        """
+
+        request: Tuple[str, ...] = ("2048",)
+
+        @nn.compact
+        def __call__(self, x: Array) -> Dict[str, Array]:
+            if x.ndim != 4:
+                raise ValueError(f"Expected 4d image batch, got shape {x.shape}")
+            if x.shape[1] == 3 and x.shape[-1] != 3:  # NCHW -> NHWC
+                x = jnp.transpose(x, (0, 2, 3, 1))
+            x = x.astype(jnp.float32)
+            x = tf1_bilinear_resize(x, (299, 299))
+            x = (x - 128.0) / 128.0
+
+            out: Dict[str, Array] = {}
+            need = set(self.request)
+
+            x = BasicConv2d(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
+            x = BasicConv2d(32, (3, 3), name="Conv2d_2a_3x3")(x)
+            x = BasicConv2d(64, (3, 3), padding=(1, 1), name="Conv2d_2b_3x3")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+            if "64" in need:
+                out["64"] = x.mean(axis=(1, 2))
+            x = BasicConv2d(80, (1, 1), name="Conv2d_3b_1x1")(x)
+            x = BasicConv2d(192, (3, 3), name="Conv2d_4a_3x3")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2))
+            if "192" in need:
+                out["192"] = x.mean(axis=(1, 2))
+            x = InceptionA(32, fid_pool=True, name="Mixed_5b")(x)
+            x = InceptionA(64, fid_pool=True, name="Mixed_5c")(x)
+            x = InceptionA(64, fid_pool=True, name="Mixed_5d")(x)
+            x = InceptionB(name="Mixed_6a")(x)
+            x = InceptionC(128, fid_pool=True, name="Mixed_6b")(x)
+            x = InceptionC(160, fid_pool=True, name="Mixed_6c")(x)
+            x = InceptionC(160, fid_pool=True, name="Mixed_6d")(x)
+            x = InceptionC(192, fid_pool=True, name="Mixed_6e")(x)
+            if "768" in need:
+                out["768"] = x.mean(axis=(1, 2))
+            x = InceptionD(name="Mixed_7a")(x)
+            x = InceptionE(pool="fid_avg", name="Mixed_7b")(x)
+            x = InceptionE(pool="max", name="Mixed_7c")(x)
+            x = x.mean(axis=(1, 2))  # (N, 2048)
+            if "2048" in need:
+                out["2048"] = x
+            if need & {"logits_unbiased", "logits"}:
+                kernel = self.param("fc_kernel", nn.initializers.lecun_normal(), (2048, 1008))
+                bias = self.param("fc_bias", nn.initializers.zeros_init(), (1008,))
+                unbiased = x @ kernel
+                if "logits_unbiased" in need:
+                    out["logits_unbiased"] = unbiased
+                if "logits" in need:
+                    out["logits"] = unbiased + bias
+            return out
+
 else:  # pragma: no cover
     InceptionV3 = None  # type: ignore[assignment,misc]
+    FIDInceptionV3 = None  # type: ignore[assignment,misc]
+
+
+def tf1_bilinear_resize(x: Array, out_hw: Tuple[int, int]) -> Array:
+    """Bilinear resize with TF1 ``align_corners=False`` semantics, as two matmuls.
+
+    torch-fidelity's ``interpolate_bilinear_2d_like_tensorflow1x`` maps source
+    coordinates as ``src = dst * (in/out)`` (no half-pixel offset — unlike
+    ``jax.image.resize``). Expressed as per-axis interpolation matrices so the whole
+    resize rides the MXU instead of gather lanes. Input/output NHWC.
+    """
+    in_h, in_w = x.shape[1], x.shape[2]
+    mh = _tf1_resize_matrix(in_h, out_hw[0])
+    mw = _tf1_resize_matrix(in_w, out_hw[1])
+    x = jnp.einsum("oh,nhwc->nowc", mh, x)
+    return jnp.einsum("pw,nowc->nopc", mw, x)
+
+
+def _tf1_resize_matrix(in_size: int, out_size: int) -> Array:
+    scale = in_size / out_size
+    src = jnp.arange(out_size, dtype=jnp.float32) * scale
+    x0 = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+    x1 = jnp.minimum(x0 + 1, in_size - 1)
+    frac = src - x0.astype(jnp.float32)
+    rows = jnp.arange(out_size)
+    m = jnp.zeros((out_size, in_size), jnp.float32)
+    m = m.at[rows, x0].add(1.0 - frac)
+    m = m.at[rows, x1].add(frac)
+    return m
 
 
 def _convert_basic_conv(src: Mapping[str, Any], prefix: str) -> Dict[str, Dict[str, Array]]:
@@ -218,6 +333,85 @@ def from_torch_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
         params[block] = {c: _convert_basic_conv(state_dict, f"{block}.{c}") for c in layout}
         stats[block] = {c: _convert_basic_conv_stats(state_dict, f"{block}.{c}") for c in layout}
     return {"params": params, "batch_stats": stats}
+
+
+def from_fidelity_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Convert a torch-fidelity ``pt_inception-2015-12-05`` state dict to flax variables.
+
+    The checkpoint uses torchvision-style module names plus a 1008-way ``fc``; block
+    conv layout is identical, so the torchvision converters apply, with the fc mapped
+    to the ``FIDInceptionV3`` flat params.
+    """
+    import numpy as np
+
+    variables = from_torch_state_dict(state_dict)
+    if "fc.weight" in state_dict:
+        w = np.asarray(state_dict["fc.weight"])  # (1008, 2048)
+        variables["params"]["fc_kernel"] = jnp.asarray(w.T)
+        variables["params"]["fc_bias"] = jnp.asarray(np.asarray(state_dict["fc.bias"]))
+    return variables
+
+
+def fid_inception_v3_extractor(
+    request: Union[str, Sequence[str]] = "2048",
+    state_dict: Optional[Mapping[str, Any]] = None,
+    variables: Optional[Dict[str, Any]] = None,
+    warn_on_random: bool = True,
+):
+    """Build the torch-fidelity-compat ``imgs -> (N, d)`` callable for FID/KID/IS.
+
+    ``request`` is one tap name or a sequence of them (a single name returns that
+    array; a sequence returns a tuple in order). Without ``state_dict``/``variables``
+    the trunk is deterministically randomly initialised and warns: scores are
+    self-consistent (valid for tracking relative progress with one configuration) but
+    NOT comparable to canonical torch-fidelity/reference FID values. Convert the
+    ``pt_inception-2015-12-05`` checkpoint via ``from_fidelity_state_dict`` for
+    canonical scores.
+    """
+    if nn is None:  # pragma: no cover
+        raise ModuleNotFoundError("flax is required for the built-in InceptionV3 extractor")
+    single = isinstance(request, str)
+    taps = (request,) if single else tuple(request)
+    valid = {"64", "192", "768", "2048", "logits_unbiased", "logits"}
+    if not set(taps) <= valid:
+        raise ValueError(f"Requested taps {taps} must be a subset of {sorted(valid)}")
+    if variables is None:
+        if state_dict is not None:
+            variables = from_fidelity_state_dict(state_dict)
+        else:
+            if warn_on_random:
+                from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    "No pretrained InceptionV3 weights are bundled (zero-egress environment). Using a"
+                    " deterministic randomly-initialised FID-compat trunk: scores are self-consistent but NOT"
+                    " comparable to canonical FID/KID/IS values. Pass `state_dict=` (a torch-fidelity"
+                    " pt_inception-2015-12-05 checkpoint) or `variables=` for canonical scores."
+                )
+            # cached: FID + KID + IS with default args share one trunk + XLA cache
+            return _default_fid_extractor(taps)
+
+    model = FIDInceptionV3(request=taps)
+
+    def apply(imgs: Array):
+        out = model.apply(variables, imgs)
+        return out[taps[0]] if single else tuple(out[t] for t in taps)
+
+    return jax.jit(apply)
+
+
+@lru_cache(maxsize=None)
+def _default_fid_extractor(taps: Tuple[str, ...]):
+    """One deterministic random-init trunk + jit cache per requested tap set."""
+    model = FIDInceptionV3(request=taps)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32), jnp.float32))
+    single = len(taps) == 1
+
+    def apply(imgs: Array):
+        out = model.apply(variables, imgs)
+        return out[taps[0]] if single else tuple(out[t] for t in taps)
+
+    return jax.jit(apply)
 
 
 def inception_v3_extractor(

@@ -89,8 +89,10 @@ def _simple_gather_all_tensors(result: Array, group: Any, world_size: int) -> Li
     """Equal-shape gather (reference ``distributed.py:90-94``)."""
     from jax.experimental import multihost_utils
 
+    # process_allgather returns host numpy — convert so downstream reductions see
+    # device arrays like every other sync mode
     gathered = multihost_utils.process_allgather(result, tiled=False)
-    return [gathered[i] for i in range(world_size)]
+    return [jnp.asarray(gathered[i]) for i in range(world_size)]
 
 
 def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
@@ -120,7 +122,7 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
 
     if all(all_shapes[i] == all_shapes[members[0]] for i in members):
         gathered = multihost_utils.process_allgather(result, tiled=False)
-        return [gathered[i] for i in members]
+        return [jnp.asarray(gathered[i]) for i in members]
 
     max_shape = tuple(max(all_shapes[i][d] for i in members) for d in range(result.ndim))
     pad = [(0, m - s) for m, s in zip(max_shape, result.shape)]
@@ -129,7 +131,7 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     out = []
     for i in members:
         slices = tuple(slice(0, d) for d in all_shapes[i])
-        out.append(gathered[i][slices])
+        out.append(jnp.asarray(gathered[i][slices]))
     return out
 
 

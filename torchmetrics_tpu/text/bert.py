@@ -2,7 +2,8 @@
 
 Raw sentence list states (cat) — tokenization/model forward deferred to compute, like
 the reference which stores tokenized tensors and runs the model at compute
-(``bert.py:192-195``). The embedding model is an injection point.
+(``bert.py:192-195``). ``model_name_or_path`` loads a HF transformer (Flax-first,
+offline-clean errors); alternatively inject ``model``/``user_tokenizer`` callables.
 """
 
 from __future__ import annotations
@@ -47,6 +48,7 @@ class BERTScore(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
         self.model = model
         self.user_tokenizer = user_tokenizer
         self.user_forward_fn = user_forward_fn
@@ -79,6 +81,7 @@ class BERTScore(Metric):
             preds=self.preds,
             target=self.target,
             model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
             model=self.model,
             user_tokenizer=self.user_tokenizer,
             user_forward_fn=self.user_forward_fn,

@@ -1,0 +1,165 @@
+"""Hugging Face model loading for the model-backed text/multimodal metrics.
+
+The reference loads ``transformers`` AutoModels directly inside BERTScore/InfoLM/
+CLIPScore (``text/bert.py:192-195``, ``functional/text/infolm.py``,
+``multimodal/clip_score.py``). The TPU build routes every such load through here:
+
+- Flax-first: ``FlaxAuto*`` classes run the transformer natively under JAX/XLA on the
+  TPU; if a checkpoint only ships torch weights, ``from_pt=True`` converts them.
+- Torch fallback: when no Flax head exists for an architecture, the torch model runs
+  host-side and features are shipped to device (the reference runs torch everywhere).
+- Offline-clean errors: in a no-egress environment ``from_pretrained`` of an uncached
+  hub id fails — that surface is turned into one actionable message (cache the model
+  or pass a local directory / injected callables) instead of an HTTP traceback.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@lru_cache(maxsize=8)
+def load_hf_model_and_tokenizer(model_name_or_path: str, auto_cls_name: str = "FlaxAutoModel") -> Tuple[Any, Any]:
+    """Cached ``(model, tokenizer)`` per checkpoint id/path.
+
+    Metric ``forward``/``compute`` call into the functional API per step; without this
+    cache every step would re-deserialize the checkpoint and retrace the forward
+    (mirrors ``_default_lpips_network``/``_default_fid_extractor`` in the image stack).
+    """
+    return load_hf_flax_model(model_name_or_path, auto_cls_name), load_hf_tokenizer(model_name_or_path)
+
+
+def _load_error(model_name_or_path: str, exc: Exception) -> ModuleNotFoundError:
+    return ModuleNotFoundError(
+        f"Could not load pretrained weights for `{model_name_or_path!r}`: {exc.__class__.__name__}. In an"
+        " offline environment the weights must already be cached (HF_HOME) or `model_name_or_path` must be a"
+        " local directory created with `save_pretrained`. Alternatively inject the network directly (pass a"
+        " callable model + tokenizer), as in the reference's own-model example."
+    )
+
+
+def load_hf_tokenizer(model_name_or_path: str) -> Any:
+    """AutoTokenizer with offline-clean failure."""
+    from transformers import AutoTokenizer
+
+    try:
+        return AutoTokenizer.from_pretrained(model_name_or_path)
+    except Exception as exc:  # noqa: BLE001 — hub raises OSError/EnvironmentError/HTTPError variants
+        raise _load_error(model_name_or_path, exc) from exc
+
+
+def load_hf_flax_model(model_name_or_path: str, auto_cls_name: str = "FlaxAutoModel") -> Any:
+    """Load a Flax transformer (converting torch weights when needed), else torch fallback.
+
+    Returns a model object with ``__call__(input_ids, attention_mask, ...)``; the
+    ``framework`` attribute is set to ``"flax"`` or ``"pt"``.
+    """
+    import transformers
+
+    flax_cls = getattr(transformers, auto_cls_name, None)
+    errors = []
+    if flax_cls is not None:
+        for kwargs in ({}, {"from_pt": True}):
+            try:
+                # transformers models carry a read-only `.framework` ("flax"/"pt")
+                return flax_cls.from_pretrained(model_name_or_path, **kwargs)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+    torch_cls_name = auto_cls_name.replace("Flax", "")
+    torch_cls = getattr(transformers, torch_cls_name)
+    try:
+        model = torch_cls.from_pretrained(model_name_or_path)
+    except Exception as exc:  # noqa: BLE001
+        raise _load_error(model_name_or_path, errors[0] if errors else exc) from exc
+    model.eval()
+    return model
+
+
+def hf_embedding_forward(model: Any, num_layers: Optional[int] = None) -> Callable:
+    """Wrap a loaded HF model as ``(input_ids, attention_mask) -> (N, L, D) jnp array``.
+
+    ``num_layers`` selects ``hidden_states[num_layers]`` (the reference's layer pick,
+    ``functional/text/bert.py``); ``None`` uses the last hidden state.
+    """
+    framework = getattr(model, "framework", "flax")
+
+    if framework == "pt":
+
+        def forward(input_ids, attention_mask):
+            import numpy as np
+            import torch
+
+            with torch.no_grad():
+                out = model(
+                    input_ids=torch.as_tensor(np.asarray(input_ids)),
+                    attention_mask=torch.as_tensor(np.asarray(attention_mask)),
+                    output_hidden_states=num_layers is not None,
+                )
+            hidden = out.hidden_states[num_layers] if num_layers is not None else out.last_hidden_state
+            return jnp.asarray(hidden.numpy())
+
+        return forward
+
+    def forward(input_ids, attention_mask):
+        out = model(
+            input_ids=jnp.asarray(input_ids),
+            attention_mask=jnp.asarray(attention_mask),
+            output_hidden_states=num_layers is not None,
+        )
+        hidden = out.hidden_states[num_layers] if num_layers is not None else out.last_hidden_state
+        return jnp.asarray(hidden)
+
+    return forward
+
+
+def hf_logits_forward(model: Any) -> Callable:
+    """Wrap a loaded HF masked-LM as ``(input_ids, attention_mask) -> (N, L, V) logits``."""
+    framework = getattr(model, "framework", "flax")
+
+    if framework == "pt":
+
+        def forward(input_ids, attention_mask):
+            import numpy as np
+            import torch
+
+            with torch.no_grad():
+                out = model(
+                    input_ids=torch.as_tensor(np.asarray(input_ids)),
+                    attention_mask=torch.as_tensor(np.asarray(attention_mask)),
+                )
+            return jnp.asarray(out.logits.numpy())
+
+        return forward
+
+    def forward(input_ids, attention_mask):
+        out = model(input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask))
+        return jnp.asarray(out.logits)
+
+    return forward
+
+
+def model_max_length(model: Any, max_length: int) -> int:
+    """Cap a requested sequence length by the model's position-embedding capacity.
+
+    Padding past ``max_position_embeddings`` feeds out-of-range position ids into the
+    embedding lookup, which silently corrupts every token's attention output.
+    """
+    cap = getattr(getattr(model, "config", None), "max_position_embeddings", None)
+    return min(max_length, cap) if isinstance(cap, int) and cap > 0 else max_length
+
+
+def hf_tokenize(
+    tokenizer: Any, sentences, max_length: int = 512, padding: str = "max_length"
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tokenize a list of sentences to padded ``(input_ids, attention_mask)`` arrays."""
+    enc = tokenizer(
+        list(sentences),
+        padding=padding,
+        truncation=True,
+        max_length=max_length,
+        return_tensors="np",
+    )
+    return jnp.asarray(enc["input_ids"]), jnp.asarray(enc["attention_mask"])

@@ -62,6 +62,9 @@ class Running(Metric):
             setattr(self, key + f"_{val}", getattr(self.base_metric, key))
         self.base_metric.reset()
         self._num_vals_seen += 1
+        # this override bypasses the wrapped update(), so bump the wrapper's own
+        # count — otherwise compute() after forward-only use warns "before update"
+        self._update_count += 1
         self._computed = None
         return res
 
