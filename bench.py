@@ -46,7 +46,6 @@ WARMUP = 5
 # memory-bound (elementwise/reduction over logits), so achieved-GB/s vs HBM peak is
 # the honest efficiency readout (MFU would flatter: these kernels do few FLOPs/byte).
 _HBM_PEAK_GBPS = {"TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v5p": 2765.0}
-_DEFAULT_HBM_PEAK = 819.0
 
 # Bytes each scenario's update step must move through HBM at minimum: inputs read +
 # state read/written (outputs that stay in registers/VMEM are not counted).

@@ -1,0 +1,337 @@
+"""Reference-scale parametrization sweeps for the stat-scores family.
+
+Models the reference's case matrices (``tests/unittests/classification/inputs.py:19-70``
+and e.g. ``test_accuracy.py:38-65``): input kind (probs / logits / labels) x
+ignore_index (None / -1) x average (micro/macro/weighted/none) x multidim_average
+(global/samplewise), each checked against sklearn on the masked, host-formatted data.
+Each family runs well over 20 parametrizations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from sklearn.metrics import precision_recall_fscore_support as sk_prfs
+from sklearn.metrics import multilabel_confusion_matrix as sk_mcm
+
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassSpecificity,
+    MultilabelAccuracy,
+    MultilabelF1Score,
+    MultilabelPrecision,
+    MultilabelRecall,
+    MultilabelSpecificity,
+)
+
+NUM_CLASSES = 5
+NUM_LABELS = 4
+NUM_BATCHES = 4
+BATCH_SIZE = 33  # deliberately not a multiple of anything
+_RNG = np.random.RandomState(7)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ------------------------------------------------------------------ input cases
+
+_binary_cases = {
+    "probs": _RNG.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    "logits": _RNG.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    "labels": _RNG.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+}
+_binary_target = _RNG.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+_mc_cases = {
+    "probs": _softmax(_RNG.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), -1).astype(np.float32),
+    "logits": _RNG.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32),
+    "labels": _RNG.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+}
+_mc_target = _RNG.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+_ml_cases = {
+    "probs": _RNG.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32),
+    "logits": _RNG.randn(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32),
+    "labels": _RNG.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)),
+}
+_ml_target = _RNG.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+
+# multidim (..., EXTRA) variants for samplewise sweeps
+EXTRA = 6
+_mc_md_preds = _RNG.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA).astype(np.float32)
+_mc_md_target = _RNG.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA))
+
+
+def _mask_ignore(labels, target, ignore_index):
+    labels = np.asarray(labels).reshape(-1)
+    target = np.asarray(target).reshape(-1)
+    if ignore_index is None:
+        return labels, target
+    keep = target != ignore_index
+    return labels[keep], target[keep]
+
+
+def _inject_ignore(target, ignore_index, frac=0.1):
+    if ignore_index is None:
+        return target
+    t = np.array(target)
+    flat = t.reshape(-1)
+    idx = _RNG.choice(flat.size, int(flat.size * frac), replace=False)
+    flat[idx] = ignore_index
+    return t
+
+
+# ------------------------------------------------------------------ goldens
+
+
+def _golden_prfs(labels, target, n_classes, average, beta=1.0):
+    """precision/recall/f1 via sklearn; 'none' keeps per-class vectors."""
+    avg = None if average in (None, "none") else average
+    p, r, f, _ = sk_prfs(
+        target, labels, labels=list(range(n_classes)), average=avg, beta=beta, zero_division=0
+    )
+    return p, r, f
+
+
+def _golden_specificity(labels, target, n_classes, average):
+    mcm = sk_mcm(target, labels, labels=list(range(n_classes)))
+    tn, fp = mcm[:, 0, 0], mcm[:, 0, 1]
+    fn, tp = mcm[:, 1, 0], mcm[:, 1, 1]
+    if average == "micro":
+        return tn.sum() / max(tn.sum() + fp.sum(), 1)
+    per_class = np.where(tn + fp > 0, tn / np.maximum(tn + fp, 1), 0.0)
+    if average == "macro":
+        return per_class.mean()
+    if average == "weighted":
+        support = tp + fn
+        return (per_class * support).sum() / max(support.sum(), 1)
+    return per_class
+
+
+def _golden_accuracy_multilabel(labels, target, average):
+    """Reference multilabel accuracy: per-label (tp+tn)/(tp+tn+fp+fn)."""
+    labels = labels.reshape(-1, NUM_LABELS)
+    target = target.reshape(-1, NUM_LABELS)
+    correct = (labels == target).astype(np.float64)
+    if average == "micro":
+        return correct.mean()
+    per_label = correct.mean(axis=0)
+    if average == "macro":
+        return per_label.mean()
+    if average == "weighted":
+        support = target.sum(axis=0)
+        return (per_label * support).sum() / max(support.sum(), 1)
+    return per_label
+
+
+# ------------------------------------------------------------------ binary sweep
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize(
+    ("metric_cls", "golden_key"),
+    [
+        (BinaryAccuracy, "accuracy"),
+        (BinaryPrecision, "precision"),
+        (BinaryRecall, "recall"),
+        (BinaryF1Score, "f1"),
+        (BinarySpecificity, "specificity"),
+    ],
+)
+def test_binary_sweep(kind, ignore_index, metric_cls, golden_key):
+    preds = _binary_cases[kind]
+    target = _inject_ignore(_binary_target, ignore_index)
+
+    metric = metric_cls(ignore_index=ignore_index)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    got = float(metric.compute())
+
+    if kind == "labels":
+        hard = preds
+    else:
+        p = _sigmoid(preds) if kind == "logits" else preds
+        hard = (p > 0.5).astype(int)
+    hard, masked_t = _mask_ignore(hard, target, ignore_index)
+    if golden_key == "accuracy":
+        want = float((hard == masked_t).mean())
+    elif golden_key == "specificity":
+        want = float(_golden_specificity(hard, masked_t, 2, None)[1])  # positive class
+    else:
+        p, r, f = _golden_prfs(hard, masked_t, 2, None)
+        want = float({"precision": p, "recall": r, "f1": f}[golden_key][1])  # positive class
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ------------------------------------------------------------------ multiclass sweep
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize(
+    ("metric_cls", "golden_key"),
+    [
+        (MulticlassPrecision, "precision"),
+        (MulticlassRecall, "recall"),
+        (MulticlassF1Score, "f1"),
+        (MulticlassSpecificity, "specificity"),
+    ],
+)
+def test_multiclass_sweep(kind, ignore_index, average, metric_cls, golden_key):
+    preds = _mc_cases[kind]
+    target = _inject_ignore(_mc_target, ignore_index)
+
+    metric = metric_cls(num_classes=NUM_CLASSES, average=average, ignore_index=ignore_index)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    got = np.asarray(metric.compute())
+
+    hard = preds.argmax(-1) if kind != "labels" else preds
+    hard, masked_t = _mask_ignore(hard, target, ignore_index)
+    if golden_key == "specificity":
+        want = _golden_specificity(hard, masked_t, NUM_CLASSES, average)
+    else:
+        p, r, f = _golden_prfs(hard, masked_t, NUM_CLASSES, average)
+        want = {"precision": p, "recall": r, "f1": f}[golden_key]
+    np.testing.assert_allclose(got, np.asarray(want, dtype=np.float64), atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multiclass_accuracy_sweep(ignore_index, average):
+    target = _inject_ignore(_mc_target, ignore_index)
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average=average, ignore_index=ignore_index)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(_mc_cases["logits"][i]), jnp.asarray(target[i]))
+    got = float(metric.compute())
+    hard, masked_t = _mask_ignore(_mc_cases["logits"].argmax(-1), target, ignore_index)
+    if average == "micro":
+        want = float((hard == masked_t).mean())
+    else:  # macro accuracy == macro recall
+        _, r, _ = _golden_prfs(hard, masked_t, NUM_CLASSES, "macro")
+        want = float(r)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multiclass_samplewise_sweep(average):
+    """multidim_average='samplewise': per-sample values over the EXTRA dim."""
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average=average, multidim_average="samplewise")
+    got = []
+    for i in range(NUM_BATCHES):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, average=average, multidim_average="samplewise")
+        m.update(jnp.asarray(_mc_md_preds[i]), jnp.asarray(_mc_md_target[i]))
+        got.append(np.asarray(m.compute()))
+    got = np.concatenate(got)
+
+    hard = _mc_md_preds.argmax(2)  # (NB, B, EXTRA)
+    want = []
+    for i in range(NUM_BATCHES):
+        for s in range(BATCH_SIZE):
+            h, t = hard[i, s], _mc_md_target[i, s]
+            if average == "micro":
+                want.append((h == t).mean())
+            else:
+                # reference macro drops classes absent from preds AND target
+                # (weights[tp+fp+fn == 0] = 0, utilities/compute.py:66-68)
+                recalls = []
+                for c in range(NUM_CLASSES):
+                    support = (t == c).sum()
+                    predicted = (h == c).sum()
+                    if support + predicted == 0:
+                        continue
+                    recalls.append(((h == c) & (t == c)).sum() / max(support, 1))
+                want.append(np.mean(recalls))
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+# ------------------------------------------------------------------ multilabel sweep
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("average", ["micro", "macro", "none"])
+@pytest.mark.parametrize(
+    ("metric_cls", "golden_key"),
+    [
+        (MultilabelPrecision, "precision"),
+        (MultilabelRecall, "recall"),
+        (MultilabelF1Score, "f1"),
+    ],
+)
+def test_multilabel_sweep(kind, ignore_index, average, metric_cls, golden_key):
+    preds = _ml_cases[kind]
+    target = _inject_ignore(_ml_target, ignore_index)
+
+    metric = metric_cls(num_labels=NUM_LABELS, average=average, ignore_index=ignore_index)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    got = np.asarray(metric.compute())
+
+    if kind == "labels":
+        hard = preds
+    else:
+        p = _sigmoid(preds) if kind == "logits" else preds
+        hard = (p > 0.5).astype(int)
+    hard = hard.reshape(-1, NUM_LABELS)
+    t = target.reshape(-1, NUM_LABELS)
+    # per-label tp/fp/fn with ignore_index masking
+    tps, fps, fns = [], [], []
+    for lab in range(NUM_LABELS):
+        h, tt = hard[:, lab], t[:, lab]
+        if ignore_index is not None:
+            keep = tt != ignore_index
+            h, tt = h[keep], tt[keep]
+        tps.append(((h == 1) & (tt == 1)).sum())
+        fps.append(((h == 1) & (tt == 0)).sum())
+        fns.append(((h == 0) & (tt == 1)).sum())
+    tp, fp, fn = np.asarray(tps, float), np.asarray(fps, float), np.asarray(fns, float)
+    if golden_key == "precision":
+        per = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), 0.0)
+    elif golden_key == "recall":
+        per = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+    else:
+        per = np.where(2 * tp + fp + fn > 0, 2 * tp / np.maximum(2 * tp + fp + fn, 1), 0.0)
+    if average == "micro":
+        s_tp, s_fp, s_fn = tp.sum(), fp.sum(), fn.sum()
+        if golden_key == "precision":
+            want = s_tp / max(s_tp + s_fp, 1)
+        elif golden_key == "recall":
+            want = s_tp / max(s_tp + s_fn, 1)
+        else:
+            want = 2 * s_tp / max(2 * s_tp + s_fp + s_fn, 1)
+    elif average == "macro":
+        want = per.mean()
+    else:
+        want = per
+    np.testing.assert_allclose(got, np.asarray(want, dtype=np.float64), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_multilabel_accuracy_sweep(average):
+    metric = MultilabelAccuracy(num_labels=NUM_LABELS, average=average)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(_ml_cases["probs"][i]), jnp.asarray(_ml_target[i]))
+    got = np.asarray(metric.compute())
+    hard = (_ml_cases["probs"] > 0.5).astype(int)
+    want = _golden_accuracy_multilabel(hard, _ml_target, average)
+    np.testing.assert_allclose(got, np.asarray(want, dtype=np.float64), atol=1e-6)

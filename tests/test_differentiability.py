@@ -1,0 +1,107 @@
+"""Differentiability (jax.grad) and half-precision (bf16) test tiers.
+
+Reference: ``tests/unittests/helpers/testers.py:443-543`` asserts ``is_differentiable``
+against autograd and runs fp16 passes; here ``jax.grad`` finiteness/non-zeroness and a
+bf16-vs-f32 relaxed-tolerance pass cover the tensor-native families.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.testers import MetricTester
+
+from torchmetrics_tpu import regression
+from torchmetrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+from torchmetrics_tpu.functional.classification.calibration_error import binary_calibration_error
+from torchmetrics_tpu.functional.classification.hinge import multiclass_hinge_loss
+from torchmetrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from torchmetrics_tpu.functional.image.ssim import structural_similarity_index_measure
+from torchmetrics_tpu.functional.regression.concordance import concordance_corrcoef
+from torchmetrics_tpu.functional.regression.cosine_similarity import cosine_similarity
+from torchmetrics_tpu.functional.regression.explained_variance import explained_variance
+from torchmetrics_tpu.functional.regression.mae import mean_absolute_error
+from torchmetrics_tpu.functional.regression.mse import mean_squared_error
+from torchmetrics_tpu.functional.regression.pearson import pearson_corrcoef
+from torchmetrics_tpu.functional.regression.r2 import r2_score
+from torchmetrics_tpu.functional.text.perplexity import perplexity
+
+_RNG = np.random.default_rng(11)
+_N = 64
+
+_p_reg = _RNG.normal(size=_N).astype(np.float32)
+_t_reg = (0.7 * _p_reg + 0.4 * _RNG.normal(size=_N)).astype(np.float32)
+_p_prob = _RNG.uniform(0.05, 0.95, size=_N).astype(np.float32)
+_t_bin = _RNG.integers(0, 2, size=_N)
+_logits = _RNG.normal(size=(_N, 5)).astype(np.float32)
+_t_mc = _RNG.integers(0, 5, size=_N)
+_audio_p = _RNG.normal(size=(4, 256)).astype(np.float32)
+_audio_t = (_audio_p + 0.2 * _RNG.normal(size=(4, 256))).astype(np.float32)
+_img_a = _RNG.uniform(0, 1, size=(2, 3, 32, 32)).astype(np.float32)
+_img_b = np.clip(_img_a + 0.05 * _RNG.normal(size=_img_a.shape), 0, 1).astype(np.float32)
+_lm_logits = _RNG.normal(size=(2, 16, 30)).astype(np.float32)
+_lm_target = _RNG.integers(0, 30, size=(2, 16))
+
+# (id, functional, preds, target, kwargs, modular class or None)
+_DIFFERENTIABLE_CASES = [
+    ("mse", mean_squared_error, _p_reg, _t_reg, {}, regression.MeanSquaredError),
+    ("mae", mean_absolute_error, _p_reg, _t_reg, {}, regression.MeanAbsoluteError),
+    ("pearson", pearson_corrcoef, _p_reg, _t_reg, {}, regression.PearsonCorrCoef),
+    ("concordance", concordance_corrcoef, _p_reg, _t_reg, {}, regression.ConcordanceCorrCoef),
+    ("r2", r2_score, _p_reg, _t_reg, {}, regression.R2Score),
+    ("explained_variance", explained_variance, _p_reg, _t_reg, {}, regression.ExplainedVariance),
+    ("cosine", cosine_similarity, _p_reg.reshape(8, 8), _t_reg.reshape(8, 8), {}, regression.CosineSimilarity),
+    ("hinge", multiclass_hinge_loss, _logits, _t_mc, {"num_classes": 5}, None),
+    ("calibration", binary_calibration_error, _p_prob, _t_bin, {"n_bins": 10}, None),
+    ("snr", signal_noise_ratio, _audio_p, _audio_t, {}, None),
+    ("si_snr", scale_invariant_signal_noise_ratio, _audio_p, _audio_t, {}, None),
+    ("psnr", peak_signal_noise_ratio, _img_a, _img_b, {"data_range": 1.0}, None),
+    ("ssim", structural_similarity_index_measure, _img_a, _img_b, {"data_range": 1.0}, None),
+    ("perplexity", perplexity, _lm_logits, _lm_target, {}, None),
+]
+
+
+class TestDifferentiability(MetricTester):
+    @pytest.mark.parametrize(
+        ("fn", "preds", "target", "kwargs", "cls"),
+        [c[1:] for c in _DIFFERENTIABLE_CASES],
+        ids=[c[0] for c in _DIFFERENTIABLE_CASES],
+    )
+    def test_grad_finite_and_nonzero(self, fn, preds, target, kwargs, cls):
+        self.run_differentiability_test(preds, target, fn, metric_class=cls, metric_args=kwargs)
+
+
+_BF16_CASES = [
+    ("mse", mean_squared_error, _p_reg, _t_reg, {}, 1e-2),
+    ("mae", mean_absolute_error, _p_reg, _t_reg, {}, 1e-2),
+    ("pearson", pearson_corrcoef, _p_reg, _t_reg, {}, 2e-2),
+    ("r2", r2_score, _p_reg, _t_reg, {}, 5e-2),
+    ("cosine", cosine_similarity, _p_reg.reshape(8, 8), _t_reg.reshape(8, 8), {}, 1e-2),
+    ("hinge", multiclass_hinge_loss, _logits, _t_mc, {"num_classes": 5}, 2e-2),
+    ("snr", signal_noise_ratio, _audio_p, _audio_t, {}, 2e-1),
+    ("psnr", peak_signal_noise_ratio, _img_a, _img_b, {"data_range": 1.0}, 5e-1),
+    ("ssim", structural_similarity_index_measure, _img_a, _img_b, {"data_range": 1.0}, 5e-2),
+    ("perplexity", perplexity, _lm_logits, _lm_target, {}, 5e-1),
+]
+
+
+class TestBF16Precision(MetricTester):
+    @pytest.mark.parametrize(
+        ("fn", "preds", "target", "kwargs", "atol"),
+        [c[1:] for c in _BF16_CASES],
+        ids=[c[0] for c in _BF16_CASES],
+    )
+    def test_bf16_matches_f32(self, fn, preds, target, kwargs, atol):
+        self.run_precision_test(preds, target, fn, metric_args=kwargs, atol=atol, rtol=5e-2)
+
+    def test_bf16_stat_scores_exact(self):
+        """Label-based classification counters are integer work — bf16 probs in,
+        exact counts out."""
+        from torchmetrics_tpu.functional.classification.accuracy import multiclass_accuracy
+
+        ref = multiclass_accuracy(jnp.asarray(_logits), jnp.asarray(_t_mc), num_classes=5)
+        low = multiclass_accuracy(jnp.asarray(_logits, jnp.bfloat16), jnp.asarray(_t_mc), num_classes=5)
+        np.testing.assert_allclose(float(low), float(ref), atol=1e-6)

@@ -202,6 +202,69 @@ class MetricTester:
             _assert_allclose(res, ref, atol, msg="jitted functional")
 
 
+    def run_differentiability_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        metric_class: Optional[Callable] = None,
+        metric_args: Optional[Dict[str, Any]] = None,
+        expect_nonzero_grad: bool = True,
+    ) -> None:
+        """``jax.grad`` tier (reference ``testers.py:509-543``).
+
+        For a metric declaring ``is_differentiable=True``: the functional must be
+        differentiable w.r.t. ``preds`` under ``jax.grad`` with finite gradients, and
+        (by default) a gradient that is not identically zero — the JAX analogue of
+        the reference's ``requires_grad``/gradcheck assertions.
+        """
+        metric_args = metric_args or {}
+        if metric_class is not None:
+            assert getattr(metric_class, "is_differentiable", None) is True, (
+                f"{metric_class}: run_differentiability_test requires is_differentiable=True metadata"
+            )
+        p = jnp.asarray(preds, dtype=jnp.float32)
+        t = jnp.asarray(target)
+
+        def scalar_loss(p_):
+            out = metric_functional(p_, t, **metric_args)
+            leaves = jax.tree_util.tree_leaves(out)
+            return jnp.sum(jnp.stack([jnp.sum(jnp.asarray(leaf, dtype=jnp.float32)) for leaf in leaves]))
+
+        grads = jax.grad(scalar_loss)(p)
+        assert bool(jnp.isfinite(grads).all()), "non-finite gradients"
+        if expect_nonzero_grad:
+            assert float(jnp.abs(grads).max()) > 0.0, "gradient identically zero"
+
+    def run_precision_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_functional: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        dtype: Any = jnp.bfloat16,
+        atol: float = 1e-2,
+        rtol: float = 1e-2,
+    ) -> None:
+        """Half-precision tier (reference ``testers.py:443-507``): the functional run
+        with bf16 float inputs must match its own f32 output at relaxed tolerance."""
+        metric_args = metric_args or {}
+
+        def cast(x, dt):
+            x = jnp.asarray(x)
+            return x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        ref = metric_functional(cast(preds, jnp.float32), cast(target, jnp.float32), **metric_args)
+        low = metric_functional(cast(preds, dtype), cast(target, dtype), **metric_args)
+        _assert_allclose(
+            _to_np(jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32), low)),
+            _to_np(ref),
+            atol=atol,
+            rtol=rtol,
+            msg=f"{dtype} vs f32",
+        )
+
+
 def _is_array_input(x: Any) -> bool:
     return isinstance(x, (jax.Array, jnp.ndarray, np.ndarray))
 

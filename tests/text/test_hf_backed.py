@@ -84,16 +84,31 @@ def test_infolm_from_local_checkpoint(tiny_bert_dir):
     assert float(score2) > float(score)
 
 
+def _raise_not_cached(*args, **kwargs):
+    raise OSError("no cached snapshot found (simulated offline hub)")
+
+
 def test_uncached_hub_id_fails_cleanly(monkeypatch):
-    """A hub id that is not cached raises the actionable offline error."""
-    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    """An uncached hub id surfaces the actionable offline error, not a hub traceback.
+
+    The hub failure is simulated by patching ``from_pretrained`` — env-var switches
+    like HF_HUB_OFFLINE are read at transformers import time, so setting them here
+    would be a no-op on a machine with network access.
+    """
+    from torchmetrics_tpu.utilities import hf as hf_utils
+
+    hf_utils.load_hf_model_and_tokenizer.cache_clear()
+    monkeypatch.setattr(transformers.AutoTokenizer, "from_pretrained", _raise_not_cached)
+    monkeypatch.setattr(transformers.FlaxAutoModel, "from_pretrained", _raise_not_cached)
+    monkeypatch.setattr(transformers.AutoModel, "from_pretrained", _raise_not_cached)
     with pytest.raises(ModuleNotFoundError, match="cached"):
         bert_score(["x"], ["x"], model_name_or_path="no-such-org/no-such-model")
 
 
 def test_clip_score_uncached_fails_cleanly(monkeypatch):
-    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
     from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
 
+    monkeypatch.setattr(transformers.CLIPModel, "from_pretrained", _raise_not_cached)
+    monkeypatch.setattr(transformers.CLIPProcessor, "from_pretrained", _raise_not_cached)
     with pytest.raises(ModuleNotFoundError, match="cached"):
         clip_score(jnp.zeros((3, 32, 32), dtype=jnp.uint8), "a photo")
