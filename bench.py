@@ -326,6 +326,42 @@ def bench_torch():
     return results
 
 
+def bench_map_epoch_end(n_images=300, n_classes=10):
+    """BASELINE #5 end-to-end: MeanAveragePrecision epoch-end ``compute()`` wall-clock.
+
+    Update appends device arrays (the hot-loop side is the jitted IoU scenario
+    above); this times the host COCOeval-semantics matching + the batched
+    device->host state fetch at epoch end. Runs AFTER all jitted timings — it
+    fetches, which drops the tunneled stream into polling mode.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.RandomState(0)
+    target, preds = [], []
+    for _ in range(n_images):
+        n = rng.randint(1, 8)
+        xy = rng.rand(n, 2) * 400
+        wh = rng.rand(n, 2) * 60 + 30
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        labels = rng.randint(0, n_classes, n)
+        target.append(dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(labels)))
+        preds.append(
+            dict(
+                boxes=jnp.asarray(boxes + rng.randn(n, 4).astype(np.float32)),
+                scores=jnp.asarray(rng.rand(n).astype(np.float32)),
+                labels=jnp.asarray(labels),
+            )
+        )
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    t0 = time.perf_counter()
+    out = metric.compute()
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    return elapsed_ms, float(out["map"])
+
+
 _SYNC_PROBE = r"""
 import os, sys
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
@@ -415,6 +451,13 @@ def main():
         if key in baseline:
             extras[key.replace("_us", "_us_torch")] = round(baseline[key], 2)
             extras[key.replace("_us", "_speedup")] = round(baseline[key] / ours_us, 3)
+    try:
+        map_ms, map_val = bench_map_epoch_end()
+        extras["map300_compute_ms"] = round(map_ms, 1)
+        extras["map300_value"] = round(map_val, 4)
+    except Exception as err:
+        print(f"map epoch-end probe failed: {err}", file=sys.stderr)
+
     for n, sync_us in sync_sweep.items():
         extras[f"mesh{n}_sync_us"] = round(sync_us, 2)
         # Per-shard normalization: the virtual CPU mesh reduces all N shards on one

@@ -267,20 +267,123 @@ class TestMeanAveragePrecision:
         assert float(out["mar_1"]) == pytest.approx(0.6, abs=1e-4)
 
     def test_coco_fixture_vs_pycocotools(self):
+        """Official pycocotools values (3-decimal table) at half-ulp tolerance."""
         preds, target = _coco_fixture()
         m = MeanAveragePrecision(class_metrics=True)
         m.update(preds[:2], target[:2])
         m.update(preds[2:], target[2:])
         out = m.compute()
         for key, expected in _PYCOCO_EXPECTED.items():
-            assert float(out[key]) == pytest.approx(expected, abs=0.015), key
+            assert float(out[key]) == pytest.approx(expected, abs=5e-4), key
+        # per-class at the reference's own atol (``test_map.py:364``): the table's
+        # class-49 value 0.556 is not reproducible from this literal fixture — a
+        # step-by-step hand simulation of COCOeval matching + 101-point
+        # interpolation on these boxes yields 0.55469, which is what we produce
         np.testing.assert_allclose(
-            np.asarray(out["map_per_class"]), [0.725, 0.800, 0.454, -1.000, 0.650, 0.556], atol=0.015
+            np.asarray(out["map_per_class"]), [0.725, 0.800, 0.454, -1.000, 0.650, 0.556], atol=1e-2
         )
         np.testing.assert_allclose(
-            np.asarray(out["mar_100_per_class"]), [0.780, 0.800, 0.450, -1.000, 0.650, 0.580], atol=0.015
+            np.asarray(out["mar_100_per_class"]), [0.780, 0.800, 0.450, -1.000, 0.650, 0.580], atol=1e-2
         )
         np.testing.assert_array_equal(np.asarray(out["classes"]), [0, 1, 2, 3, 4, 49])
+
+    def test_custom_iou_thresholds(self):
+        """With iou_thresholds=[0.1, 0.2] the 0.5/0.75 summaries are absent (-1)
+        (reference ``test_map.py:519-528``)."""
+        preds, target = _coco_fixture()
+        m = MeanAveragePrecision(iou_thresholds=[0.1, 0.2])
+        m.update(preds, target)
+        out = m.compute()
+        assert float(out["map_50"]) == -1.0
+        assert float(out["map_75"]) == -1.0
+        assert float(out["map"]) > 0.6  # looser thresholds -> higher AP than map@[.5:.95]
+
+    def test_missing_pred_lowers_map(self):
+        """One good detection, one false negative (reference ``test_map.py:538-556``)."""
+        target = [
+            dict(boxes=jnp.array([[10.0, 20, 15, 25]]), labels=jnp.array([0])),
+            dict(boxes=jnp.array([[10.0, 20, 15, 25]]), labels=jnp.array([0])),
+        ]
+        preds = [
+            dict(boxes=jnp.array([[10.0, 20, 15, 25]]), scores=jnp.array([0.9]), labels=jnp.array([0])),
+            dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), jnp.int32)),
+        ]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        assert float(m.compute()["map"]) < 1
+
+    def test_missing_gt_lowers_map(self):
+        """One good detection, one false positive (reference ``test_map.py:560-579``)."""
+        target = [
+            dict(boxes=jnp.array([[10.0, 20, 15, 25]]), labels=jnp.array([0])),
+            dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,), jnp.int32)),
+        ]
+        preds = [
+            dict(boxes=jnp.array([[10.0, 20, 15, 25]]), scores=jnp.array([0.9]), labels=jnp.array([0])),
+            dict(boxes=jnp.array([[10.0, 20, 15, 25]]), scores=jnp.array([0.95]), labels=jnp.array([0])),
+        ]
+        m = MeanAveragePrecision()
+        m.update(preds, target)
+        assert float(m.compute()["map"]) < 1
+
+    def test_coco_scale_500_images(self):
+        """~500-image synthetic COCO-scale run with analytically known values.
+
+        Case A: predictions == ground truth -> every summary is exactly 1.
+        Case B: per class, the top-scored half of detections are exact matches and
+        the rest are non-overlapping false positives scored strictly lower, so the
+        101-point interpolated AP equals the detected recall fraction.
+        """
+        import time as _time
+
+        rng = np.random.RandomState(0)
+        n_images, n_classes = 500, 10
+        target, perfect, half = [], [], []
+        for _ in range(n_images):
+            n = rng.randint(1, 8)
+            xy = rng.rand(n, 2) * 400
+            wh = rng.rand(n, 2) * 60 + 30
+            boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+            labels = rng.randint(0, n_classes, n)
+            target.append(dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(labels)))
+            perfect.append(
+                dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(rng.rand(n).astype(np.float32) * 0.5 + 0.5),
+                     labels=jnp.asarray(labels))
+            )
+            detected = rng.rand(n) < 0.5
+            det_boxes = boxes[detected]
+            # false positives: far away from every gt (shifted by 1000)
+            fp_boxes = boxes[~detected] + 1000.0
+            half.append(
+                dict(
+                    boxes=jnp.asarray(np.concatenate([det_boxes, fp_boxes]).astype(np.float32)),
+                    scores=jnp.asarray(
+                        np.concatenate([rng.rand(detected.sum()) * 0.4 + 0.6, rng.rand((~detected).sum()) * 0.3]
+                                       ).astype(np.float32)
+                    ),
+                    labels=jnp.asarray(np.concatenate([target[-1]["labels"][detected], target[-1]["labels"][~detected]])),
+                )
+            )
+
+        m = MeanAveragePrecision()
+        for lo in range(0, n_images, 100):
+            m.update(perfect[lo : lo + 100], target[lo : lo + 100])
+        t0 = _time.perf_counter()
+        out = m.compute()
+        compute_s = _time.perf_counter() - t0
+        assert float(out["map"]) == pytest.approx(1.0, abs=1e-6)
+        assert float(out["mar_100"]) == pytest.approx(1.0, abs=1e-6)
+        # epoch-end budget: the reference's pycocotools accumulate+summarize on 5k
+        # images is seconds-scale; 500 images must stay well under a minute here
+        assert compute_s < 60, f"mAP compute() took {compute_s:.1f}s at 500 images"
+
+        m2 = MeanAveragePrecision()
+        m2.update(half, target)
+        out2 = m2.compute()
+        # every class's detected fraction ~0.5; AP == recall fraction per class
+        total = sum(len(np.asarray(t["labels"])) for t in target)
+        det = sum(len(np.asarray(p["scores"])[np.asarray(p["scores"]) > 0.5]) for p in half)
+        assert float(out2["map"]) == pytest.approx(det / total, abs=0.02)
 
     def test_empty_target_image(self):
         preds = [

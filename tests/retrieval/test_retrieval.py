@@ -283,5 +283,7 @@ class TestRawStateSync:
 
 
 def test_exported_from_root():
-    assert tm.RetrievalMAP is RetrievalMAP
+    # root name is the deprecated-alias subclass of the domain class (reference
+    # root-import semantics); the functional export is the same object
+    assert issubclass(tm.RetrievalMAP, RetrievalMAP) and tm.RetrievalMAP is not RetrievalMAP
     assert tm.functional.retrieval_average_precision is retrieval_average_precision

@@ -1,0 +1,62 @@
+"""Deprecated root-alias shims: root imports warn-and-work (reference
+``src/torchmetrics/__init__.py`` + per-domain ``_deprecated.py``)."""
+
+import warnings
+
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+
+
+@pytest.mark.parametrize(
+    ("name", "domain"),
+    [
+        ("SignalNoiseRatio", "audio"),
+        ("PanopticQuality", "detection"),
+        ("StructuralSimilarityIndexMeasure", "image"),
+        ("RetrievalMAP", "retrieval"),
+        ("Perplexity", "text"),
+    ],
+)
+def test_root_alias_warns_and_works(name, domain):
+    cls = getattr(tm, name)
+    with pytest.deprecated_call(match=f"torchmetrics_tpu.{domain}.{name}"):
+        if name == "PanopticQuality":
+            cls({0, 1}, {7})
+        else:
+            cls()
+
+
+@pytest.mark.parametrize(
+    ("name", "domain"),
+    [
+        ("SignalNoiseRatio", "audio"),
+        ("StructuralSimilarityIndexMeasure", "image"),
+        ("Perplexity", "text"),
+    ],
+)
+def test_domain_import_does_not_warn(name, domain):
+    import importlib
+
+    cls = getattr(importlib.import_module(f"torchmetrics_tpu.{domain}"), name)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        cls()
+
+
+def test_root_alias_is_functional_subclass():
+    """The shim still IS the real metric: values match the domain class."""
+    from torchmetrics_tpu.text import Perplexity as DomainPerplexity
+
+    logits = jnp.log(jnp.asarray([[[0.7, 0.1, 0.2], [0.25, 0.5, 0.25]]]))
+    target = jnp.asarray([[0, 1]])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        root_metric = tm.Perplexity()
+    assert isinstance(root_metric, DomainPerplexity)
+    root_metric.update(logits, target)
+    ref = DomainPerplexity()
+    ref.update(logits, target)
+    assert float(root_metric.compute()) == float(ref.compute())

@@ -75,8 +75,11 @@ def test_pallas_kernel_pads_ragged_batches():
 
 
 _TPU_PARITY_SCRIPT = r"""
+import sys
 import jax, jax.numpy as jnp, numpy as np
-assert jax.default_backend() == "tpu", jax.default_backend()
+if jax.default_backend() != "tpu":
+    print("TPU_PARITY_SKIP")  # probed, not assumed: no TPU on this machine
+    sys.exit(0)
 from torchmetrics_tpu.ops.multi_threshold import _counts_pallas, _counts_histogram
 rng = np.random.RandomState(0)
 for n, c, t in [(1000, 10, 200), (513, 1, 33), (257, 37, 17)]:
@@ -94,21 +97,21 @@ print("TPU_PARITY_OK")
 """
 
 
-@pytest.mark.skipif(
-    not os.environ.get("PALLAS_AXON_POOL_IPS") and not os.path.isdir("/root/.axon_site"),
-    reason="no TPU attached to this machine",
-)
 def test_pallas_compiled_path_matches_on_tpu():
     """Run the COMPILED Mosaic kernel on the real TPU in a subprocess.
 
     The test suite itself is pinned to the CPU platform (conftest), so the compiled
-    path — the one production uses — is exercised out-of-process with the axon
-    platform env restored.
+    path — the one production uses — is exercised out-of-process with the platform
+    pins removed. The script itself probes for a TPU and emits a skip sentinel when
+    none is attached — one subprocess, probed-not-assumed.
     """
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = "/root/repo:/root/.axon_site"
+    # repo root for the import; keep whatever PYTHONPATH entries (e.g. a TPU plugin
+    # site dir) the outer environment already carries
+    env["PYTHONPATH"] = os.pathsep.join(p for p in [repo_root, os.environ.get("PYTHONPATH", "")] if p)
     proc = subprocess.run(
         [sys.executable, "-c", _TPU_PARITY_SCRIPT],
         env=env,
@@ -117,6 +120,8 @@ def test_pallas_compiled_path_matches_on_tpu():
         timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
+    if "TPU_PARITY_SKIP" in proc.stdout:
+        pytest.skip("no TPU attached to this machine")
     assert "TPU_PARITY_OK" in proc.stdout
 
 

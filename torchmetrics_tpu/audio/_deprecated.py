@@ -1,0 +1,16 @@
+"""Deprecated-root-import shims (reference ``audio/_deprecated.py``)."""
+
+from torchmetrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from torchmetrics_tpu.utilities.deprecation import root_alias
+
+_PermutationInvariantTraining = root_alias(PermutationInvariantTraining, "audio")
+_ScaleInvariantSignalDistortionRatio = root_alias(ScaleInvariantSignalDistortionRatio, "audio")
+_ScaleInvariantSignalNoiseRatio = root_alias(ScaleInvariantSignalNoiseRatio, "audio")
+_SignalDistortionRatio = root_alias(SignalDistortionRatio, "audio")
+_SignalNoiseRatio = root_alias(SignalNoiseRatio, "audio")

@@ -1,0 +1,10 @@
+"""Deprecated-root-import shims (reference ``detection/_deprecated.py``)."""
+
+from torchmetrics_tpu.detection import (
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from torchmetrics_tpu.utilities.deprecation import root_alias
+
+_ModifiedPanopticQuality = root_alias(ModifiedPanopticQuality, "detection")
+_PanopticQuality = root_alias(PanopticQuality, "detection")

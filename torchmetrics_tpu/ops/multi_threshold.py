@@ -197,9 +197,22 @@ def multi_threshold_counts(
     Returns:
         ``(tp, predpos)``, both ``(T, C)`` int32.
     """
+
+    def _inputs_on_tpu(x: Array) -> bool:
+        """Dispatch on the concrete committed device when available (explicit
+        placement on a non-default backend picks the matching path), falling back
+        to the default backend for tracers, whose device is unknown at trace time."""
+        try:
+            devs = getattr(x, "devices", None)
+            if callable(devs):
+                return next(iter(devs())).platform == "tpu"
+        except Exception:
+            pass
+        return jax.default_backend() == "tpu"
+
     n, c = preds.shape
     t = thresholds.shape[0]
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = _inputs_on_tpu(preds)
     if (
         _PALLAS_AVAILABLE
         and on_tpu

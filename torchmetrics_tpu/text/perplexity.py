@@ -17,15 +17,14 @@ class Perplexity(Metric):
     """Perplexity with Σ−logp / count states (reference ``perplexity.py:28-111``).
 
     Example:
-        >>> import jax
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.text import Perplexity
-        >>> gen = jax.random.PRNGKey(22)
-        >>> preds = jax.random.normal(gen, (2, 8, 5))
-        >>> target = jnp.asarray([[0, 1, 2, 3, 4, 0, 1, 2], [2, 3, 4, 0, 1, 2, 3, 4]])
+        >>> logits = jnp.log(jnp.asarray([[[0.7, 0.1, 0.2], [0.25, 0.5, 0.25]],
+        ...                               [[0.1, 0.1, 0.8], [0.3, 0.4, 0.3]]]))
+        >>> target = jnp.asarray([[0, 1], [2, 1]])
         >>> perp = Perplexity()
-        >>> print(round(float(perp(preds, target)), 4))
-        10.1364
+        >>> print(round(float(perp(logits, target)), 2))
+        1.73
     """
 
     is_differentiable: bool = True

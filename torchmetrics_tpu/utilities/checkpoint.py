@@ -93,7 +93,13 @@ def restore_metric_state(metric: Any, path: str) -> Any:
 
 def _metrics_of(metric: Any):
     """Leaf Metric objects of a metric or collection."""
-    return metric.values() if hasattr(metric, "values") and not hasattr(metric, "_persistent") else [metric]
+    from torchmetrics_tpu.collections import MetricCollection  # local import avoids a cycle
+
+    if isinstance(metric, MetricCollection):
+        # copy_state=False: a persistence snapshot must see the live objects, not
+        # compute-group state copies
+        return metric.values(copy_state=False)
+    return [metric]
 
 
 def _snapshot_persistence(metric: Any) -> list:

@@ -1,0 +1,29 @@
+"""Root-alias deprecation shims.
+
+The reference keeps domain metrics importable from the package root but deprecated:
+per-domain ``_deprecated.py`` modules define ``_X(X)`` subclasses that warn on
+construction via ``_deprecated_root_import_class``, and the root ``__init__`` exports
+those under the plain names (reference ``src/torchmetrics/__init__.py`` +
+``image/_deprecated.py`` etc.). ``root_alias`` builds such a subclass; importing from
+``torchmetrics_tpu.<domain>`` stays warning-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type
+
+from torchmetrics_tpu.utilities.prints import _deprecated_root_import_class
+
+
+def root_alias(cls: Type, domain: str) -> Type:
+    """Subclass ``cls`` so that construction warns about the deprecated root import."""
+
+    class _RootAlias(cls):  # type: ignore[misc,valid-type]
+        def __init__(self, *args: Any, **kwargs: Any) -> None:
+            _deprecated_root_import_class(cls.__name__, domain)
+            super().__init__(*args, **kwargs)
+
+    _RootAlias.__name__ = f"_{cls.__name__}"
+    _RootAlias.__qualname__ = f"_{cls.__name__}"
+    _RootAlias.__doc__ = f"Deprecated-root-import wrapper for :class:`torchmetrics_tpu.{domain}.{cls.__name__}`."
+    return _RootAlias
