@@ -1,0 +1,58 @@
+"""FID / KID / IS / LPIPS with the built-in default extractors.
+
+All four work out of the box: the FID-compat InceptionV3 trunk and the LPIPS
+backbones are native Flax modules (deterministically initialised, with a warning that
+scores are self-consistent rather than canonical until pretrained weights are
+converted in), and the learned LPIPS heads ARE bundled. To get canonical values,
+convert checkpoints::
+
+    import torch
+    from torchmetrics_tpu.models.inception import from_fidelity_state_dict
+    variables = from_fidelity_state_dict(torch.load("pt_inception-2015-12-05.pth"))
+    fid = FrechetInceptionDistance(feature=fid_inception_v3_extractor("2048", variables=variables))
+
+    sd = torch.load("vgg16-imagenet.pth")  # torchvision checkpoint
+    lpips = LearnedPerceptualImagePatchSimilarity(net_type="vgg", backbone_state_dict=sd)
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+)
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    real = jnp.asarray(rng.integers(0, 255, size=(16, 3, 64, 64), dtype=np.uint8))
+    fake = jnp.asarray(rng.integers(60, 255, size=(16, 3, 64, 64), dtype=np.uint8))
+
+    fid = FrechetInceptionDistance(feature=64)
+    fid.update(real, real=True)
+    fid.update(fake, real=False)
+    print("FID:", float(fid.compute()))
+
+    kid = KernelInceptionDistance(feature=64, subset_size=8)
+    kid.update(real, real=True)
+    kid.update(fake, real=False)
+    kid_mean, kid_std = kid.compute()
+    print("KID:", float(kid_mean), "+/-", float(kid_std))
+
+    inception = InceptionScore(splits=4)
+    inception.update(fake)
+    is_mean, is_std = inception.compute()
+    print("IS:", float(is_mean), "+/-", float(is_std))
+
+    lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", normalize=True)
+    img = jnp.asarray(rng.uniform(0, 1, size=(4, 3, 64, 64)).astype(np.float32))
+    lpips.update(img, jnp.clip(img + 0.1, 0, 1))
+    print("LPIPS:", float(lpips.compute()))
+
+
+if __name__ == "__main__":
+    main()

@@ -172,7 +172,15 @@ def structural_similarity_index_measure(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """SSIM (reference ``ssim.py:213-287``)."""
+    """SSIM (reference ``ssim.py:213-287``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+        >>> img = jnp.ones((1, 3, 16, 16)) * 0.5
+        >>> print(round(float(structural_similarity_index_measure(img, img, data_range=1.0)), 4))
+        1.0
+    """
     preds, target = _ssim_check_inputs(preds, target)
     similarity_pack = _ssim_update(
         preds,

@@ -35,6 +35,14 @@ def pairwise_euclidean_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    r"""Pairwise euclidean distances between rows of ``x`` (and ``y``) (reference ``euclidean.py:45-89``)."""
+    r"""Pairwise euclidean distances between rows of ``x`` (and ``y``) (reference ``euclidean.py:45-89``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_euclidean_distance
+        >>> x = jnp.asarray([[0.0, 0.0], [3.0, 4.0]])
+        >>> pairwise_euclidean_distance(x).round(1).tolist()
+        [[0.0, 5.0], [5.0, 0.0]]
+    """
     distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

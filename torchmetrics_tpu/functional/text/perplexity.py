@@ -72,6 +72,14 @@ def _perplexity_compute(total: Array, count: Array) -> Array:
 
 
 def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
-    """Perplexity (reference ``perplexity.py:111-140``)."""
+    """Perplexity (reference ``perplexity.py:111-140``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.text import perplexity
+        >>> logits = jnp.log(jnp.asarray([[[0.5, 0.25, 0.25], [0.25, 0.5, 0.25]]]))
+        >>> print(round(float(perplexity(logits, jnp.asarray([[0, 1]]))), 2))
+        2.0
+    """
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)

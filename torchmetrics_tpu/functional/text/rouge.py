@@ -257,7 +257,14 @@ def rouge_score(
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
 ) -> Dict[str, Array]:
-    """ROUGE (reference ``rouge.py:411-520``)."""
+    """ROUGE (reference ``rouge.py:411-520``).
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import rouge_score
+        >>> out = rouge_score("the cat sat on the mat", "a cat sat on the mat")
+        >>> print(round(float(out["rouge1_fmeasure"]), 4))
+        0.8333
+    """
     stemmer = None
     if use_stemmer:
         try:

@@ -36,7 +36,21 @@ def _bootstrap_sampler(
 
 
 class BootStrapper(Metric):
-    """Keep ``num_bootstraps`` copies of a metric, each updated on a resampled batch (reference ``bootstrapping.py:52``)."""
+    """Keep ``num_bootstraps`` copies of a metric, each updated on a resampled batch (reference ``bootstrapping.py:52``).
+
+    Example:
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import BootStrapper, MeanMetric
+        >>> boot = BootStrapper(MeanMetric(), num_bootstraps=4)
+        >>> boot._rng = np.random.RandomState(0)  # seeded for a reproducible example
+        >>> boot.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+        >>> out = boot.compute()
+        >>> sorted(out.keys())
+        ['mean', 'std']
+        >>> bool(out['std'] >= 0)
+        True
+    """
 
     full_state_update: Optional[bool] = True
 

@@ -12,7 +12,17 @@ Array = jax.Array
 
 
 class ClasswiseWrapper(Metric):
-    """Split a per-class metric output into a labeled dict (reference ``classwise.py:26``)."""
+    """Split a per-class metric output into a labeled dict (reference ``classwise.py:26``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ClasswiseWrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["a", "b", "c"])
+        >>> out = metric(jnp.asarray([0, 1, 2, 0]), jnp.asarray([0, 1, 1, 0]))
+        >>> {k: round(float(v), 2) for k, v in sorted(out.items())}
+        {'multiclassaccuracy_a': 1.0, 'multiclassaccuracy_b': 0.5, 'multiclassaccuracy_c': 0.0}
+    """
 
     def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
         super().__init__()

@@ -28,7 +28,18 @@ def _get_nan_indices(*tensors: Array) -> Array:
 
 
 class MultioutputWrapper(Metric):
-    """One metric clone per output column (reference ``multioutput.py:29``)."""
+    """One metric clone per output column (reference ``multioutput.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> preds = jnp.asarray([[1.0, 10.0], [2.0, 20.0]])
+        >>> target = jnp.asarray([[1.0, 12.0], [2.0, 22.0]])
+        >>> metric.update(preds, target)
+        >>> [round(float(v), 2) for v in metric.compute()]
+        [0.0, 4.0]
+    """
 
     is_differentiable = False
 

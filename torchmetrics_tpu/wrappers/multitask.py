@@ -13,7 +13,20 @@ Array = jax.Array
 
 
 class MultitaskWrapper(Metric):
-    """Different metrics on different tasks via dict inputs (reference ``multitask.py:28``)."""
+    """Different metrics on different tasks via dict inputs (reference ``multitask.py:28``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredError, MultitaskWrapper
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        >>> metric.update(
+        ...     {"cls": jnp.asarray([1.0, 0.0, 1.0, 1.0]), "reg": jnp.asarray([1.0, 2.0])},
+        ...     {"cls": jnp.asarray([1, 0, 0, 1]), "reg": jnp.asarray([1.0, 4.0])},
+        ... )
+        >>> {k: round(float(v), 2) for k, v in sorted(metric.compute().items())}
+        {'cls': 0.75, 'reg': 2.0}
+    """
 
     is_differentiable = False
 

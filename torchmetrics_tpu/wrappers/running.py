@@ -21,6 +21,15 @@ class Running(Metric):
 
     ``forward`` still returns the current-batch value; ``compute`` returns the windowed
     value. Memory grows linearly with ``window`` (one state copy per slot).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Running, SumMetric
+        >>> metric = Running(SumMetric(), window=3)
+        >>> for v in (1.0, 2.0, 3.0, 4.0):
+        ...     _ = metric(jnp.asarray(v))
+        >>> float(metric.compute())  # sum over the trailing window {2, 3, 4}
+        9.0
     """
 
     def __init__(self, base_metric: Metric, window: int = 5) -> None:
