@@ -125,8 +125,7 @@ def bench_ours():
         _multiclass_precision_recall_curve_update,
     )
     from torchmetrics_tpu.functional.classification.stat_scores import (
-        _multiclass_stat_scores_format,
-        _multiclass_stat_scores_update,
+        _multiclass_stat_scores_format_update,
     )
     from torchmetrics_tpu.functional.image.ssim import _ssim_update
 
@@ -143,8 +142,10 @@ def bench_ours():
 
     @jax.jit
     def acc_step(state, preds, target):
-        p, t = _multiclass_stat_scores_format(preds, target, top_k=1)
-        tp, fp, tn, fn = _multiclass_stat_scores_update(p, t, ACC_CLASSES, 1, "macro", "global", None)
+        # fused single-pass path on TPU (ops/stat_counts.py); staged elsewhere
+        tp, fp, tn, fn = _multiclass_stat_scores_format_update(
+            preds, target, ACC_CLASSES, 1, "macro", "global", None
+        )
         return (state[0] + tp, state[1] + fp, state[2] + tn, state[3] + fn)
 
     acc_state = tuple(jnp.zeros(ACC_CLASSES, jnp.int32) for _ in range(4))

@@ -93,6 +93,20 @@ for n, c, t in [(1000, 10, 200), (513, 1, 33), (257, 37, 17)]:
     want = _counts_histogram(*args)
     assert (np.asarray(got[0]) == np.asarray(want[0])).all(), (n, c, t, "tp")
     assert (np.asarray(got[1]) == np.asarray(want[1])).all(), (n, c, t, "pp")
+
+# fused logits -> stat-scores kernel (ops/stat_counts.py), compiled path
+from torchmetrics_tpu.ops.stat_counts import fused_multiclass_stat_scores
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_format, _multiclass_stat_scores_update)
+for n, c in [(1000, 10), (513, 100), (257, 1000)]:
+    preds = rng.randn(n, c).astype(np.float32)
+    target = rng.randint(0, c, n).astype(np.int32)
+    target[rng.rand(n) < 0.1] = -1
+    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), c, ignore_index=-1)
+    p, t = _multiclass_stat_scores_format(jnp.asarray(preds), jnp.asarray(target), 1)
+    want = _multiclass_stat_scores_update(p, t, c, 1, "macro", "global", -1)
+    for g, w, name in zip(got, want, ("tp", "fp", "tn", "fn")):
+        assert (np.asarray(g) == np.asarray(w)).all(), (n, c, name)
 print("TPU_PARITY_OK")
 """
 

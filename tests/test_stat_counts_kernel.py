@@ -1,0 +1,100 @@
+"""Fused logits->stat-scores kernel parity (interpret mode; the compiled Mosaic path
+is exercised on real TPU via the same out-of-process pattern as test_ops_kernels)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_update,
+)
+from torchmetrics_tpu.ops.stat_counts import (
+    _PALLAS_AVAILABLE,
+    _block_rows,
+    _fused_counts_pallas,
+    fused_multiclass_stat_scores,
+)
+
+pytestmark = pytest.mark.skipif(not _PALLAS_AVAILABLE, reason="pallas unavailable")
+
+rng = np.random.RandomState(3)
+
+
+def _staged(preds, target, num_classes, ignore_index=None):
+    p, t = _multiclass_stat_scores_format(jnp.asarray(preds), jnp.asarray(target), 1)
+    return _multiclass_stat_scores_update(p, t, num_classes, 1, "macro", "global", ignore_index)
+
+
+@pytest.mark.parametrize(("n", "c"), [(64, 5), (131, 10), (257, 33), (1000, 100)])
+def test_fused_matches_staged(n, c):
+    preds = rng.randn(n, c).astype(np.float32)
+    target = rng.randint(0, c, n)
+    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), c, interpret=True)
+    want = _staged(preds, target, c)
+    for g, w, name in zip(got, want, "tp fp tn fn".split()):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_fused_ignore_index():
+    n, c = 200, 7
+    preds = rng.randn(n, c).astype(np.float32)
+    target = rng.randint(0, c, n)
+    target[rng.rand(n) < 0.2] = -1
+    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), c, ignore_index=-1, interpret=True)
+    want = _staged(preds, target, c, ignore_index=-1)
+    for g, w, name in zip(got, want, "tp fp tn fn".split()):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_fused_argmax_tie_break_matches():
+    """Duplicate row maxima must resolve to the same (first) index as jnp.argmax."""
+    preds = np.zeros((16, 6), dtype=np.float32)
+    preds[:, 2] = 1.0
+    preds[:, 4] = 1.0  # tie between class 2 and 4 -> argmax picks 2
+    target = np.full(16, 4)
+    tp, pred_count, tgt_count = _fused_counts_pallas(jnp.asarray(preds), jnp.asarray(target), 6, interpret=True)
+    assert int(pred_count[2]) == 16 and int(pred_count[4]) == 0
+    assert int(tp.sum()) == 0
+
+
+def test_block_rows_positive_for_supported_classes():
+    for c in (2, 10, 100, 1000, 4096):
+        assert _block_rows(c) > 0
+
+
+def test_empty_batch_returns_zeros():
+    got = fused_multiclass_stat_scores(
+        jnp.zeros((0, 5)), jnp.zeros((0,), jnp.int32), 5, interpret=True
+    )
+    for g in got:
+        np.testing.assert_array_equal(np.asarray(g), np.zeros(5, np.int32))
+
+
+def test_oversized_num_classes_raises():
+    with pytest.raises(ValueError, match="VMEM"):
+        fused_multiclass_stat_scores(jnp.zeros((8, 8192)), jnp.zeros((8,), jnp.int32), 8192, interpret=True)
+
+
+def test_nan_logits_match_argmax_semantics():
+    """jnp.argmax treats NaN as maximal (first NaN wins); the kernel must agree."""
+    preds = np.array([[np.nan, 1.0, 2.0], [0.5, np.nan, np.nan], [0.1, 0.2, 0.3]], np.float32)
+    target = np.array([0, 1, 2])
+    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), 3, interpret=True)
+    want = _staged(preds, target, 3)
+    for g, w, name in zip(got, want, "tp fp tn fn".split()):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_out_of_range_target_dropped_like_staged():
+    """target >= num_classes drops the sample (staged scatter mode='drop' parity)."""
+    preds = np.array([[3.0, 1.0, 0.0], [0.0, 2.0, 0.0]], np.float32)
+    target = np.array([7, 1])
+    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), 3, interpret=True)
+    want = _staged(preds, target, 3)
+    for g, w, name in zip(got, want, "tp fp tn fn".split()):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+    assert (np.asarray(got[2]) >= 0).all()  # tn never negative

