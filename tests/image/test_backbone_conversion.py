@@ -1,0 +1,88 @@
+"""LPIPS backbone weight-converter roundtrips.
+
+Each converter's key map is verified by inverting it from a random-init flax trunk
+(a padding/transpose slip in any converter silently corrupts user-supplied
+torchvision checkpoints — one such slip in the SqueezeNet stem was caught by review).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.models import alexnet, squeezenet, vgg
+
+rng = np.random.default_rng(2)
+
+
+def _assert_tree_equal(a, b):
+    flat_a = jax.tree_util.tree_leaves_with_path(a)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(b))
+    assert len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(flat_b[path]), err_msg=str(path))
+
+
+def _invert_conv(leaf):
+    return np.asarray(leaf["kernel"]).transpose(3, 2, 0, 1), np.asarray(leaf["bias"])
+
+
+def test_vgg16_conversion_roundtrip():
+    model = vgg.VGG16Features(apply_scaling=False)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32), jnp.float32))
+    sd = {}
+    for name, leaf in variables["params"].items():
+        li = int(name.replace("conv", ""))
+        w, b = _invert_conv(leaf)
+        sd[f"features.{li}.weight"] = w
+        sd[f"features.{li}.bias"] = b
+    _assert_tree_equal(variables, vgg.from_torch_state_dict(sd))
+
+
+def test_alexnet_conversion_roundtrip():
+    model = alexnet.AlexNetFeatures()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 64, 64), jnp.float32))
+    sd = {}
+    for name, leaf in variables["params"].items():
+        li = int(name.replace("conv", ""))
+        w, b = _invert_conv(leaf)
+        sd[f"features.{li}.weight"] = w
+        sd[f"features.{li}.bias"] = b
+    _assert_tree_equal(variables, alexnet.from_torch_state_dict(sd))
+
+
+def test_squeezenet_conversion_roundtrip():
+    model = squeezenet.SqueezeNetFeatures()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 64, 64), jnp.float32))
+    sd = {}
+    for name, leaf in variables["params"].items():
+        if name == "conv0":
+            w, b = _invert_conv(leaf)
+            sd["features.0.weight"] = w
+            sd["features.0.bias"] = b
+            continue
+        li = int(name.replace("fire", ""))
+        for sub in ("squeeze", "expand1x1", "expand3x3"):
+            w, b = _invert_conv(leaf[sub])
+            sd[f"features.{li}.{sub}.weight"] = w
+            sd[f"features.{li}.{sub}.bias"] = b
+    _assert_tree_equal(variables, squeezenet.from_torch_state_dict(sd))
+
+
+@pytest.mark.parametrize(
+    ("mod", "builder", "n_taps", "dims"),
+    [
+        (vgg, "vgg16_lpips_extractor", 5, (64, 128, 256, 512, 512)),
+        (alexnet, "alexnet_lpips_extractor", 5, (64, 192, 384, 256, 256)),
+        (squeezenet, "squeezenet_lpips_extractor", 7, (64, 128, 256, 384, 384, 512, 512)),
+    ],
+)
+def test_extractor_tap_channel_dims(mod, builder, n_taps, dims):
+    """Slice taps must line up with the bundled head widths (reference slice spec)."""
+    fn = getattr(mod, builder)()
+    feats = fn(jnp.zeros((1, 3, 64, 64), jnp.float32))
+    assert len(feats) == n_taps
+    assert tuple(f.shape[1] for f in feats) == dims

@@ -1,0 +1,70 @@
+"""Regression multioutput sweeps through the universal MetricTester protocol.
+
+Single-output golden coverage lives in ``test_regression.py``; this file sweeps the
+``num_outputs`` axis (per-column states, merge worlds, structural checks) against
+column-wise sklearn/scipy goldens.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from sklearn.metrics import mean_squared_error, mean_squared_log_error, r2_score
+
+from tests.testers import MetricTester
+
+from torchmetrics_tpu import regression
+
+NUM_BATCHES, BATCH = 4, 48
+_RNG = np.random.RandomState(13)
+
+
+def _data(num_outputs):
+    shape = (NUM_BATCHES, BATCH, num_outputs)
+    preds = _RNG.randn(*shape).astype(np.float64)
+    target = (0.8 * preds + 0.3 * _RNG.randn(*shape)).astype(np.float64)
+    return preds, target
+
+
+class TestMultioutputSweep(MetricTester):
+    # f64 inputs (x64 is on in the suite): the sweep checks the math, not the f32
+    # cancellation behavior of the sufficient-statistics formulations
+    atol = 1e-6
+
+    @pytest.mark.parametrize("num_outputs", [2, 5])
+    @pytest.mark.parametrize(
+        ("metric_cls", "golden"),
+        [
+            (regression.MeanSquaredError, lambda p, t: mean_squared_error(t, p, multioutput="raw_values")),
+            (regression.PearsonCorrCoef, lambda p, t: np.asarray(
+                [scipy.stats.pearsonr(p[:, i], t[:, i])[0] for i in range(p.shape[1])])),
+            # reference default multioutput='uniform_average': a scalar over outputs
+            (regression.R2Score, lambda p, t: r2_score(t, p)),
+        ],
+        ids=["mse", "pearson", "r2"],
+    )
+    def test_vs_columnwise_golden(self, num_outputs, metric_cls, golden):
+        preds, target = _data(num_outputs)
+        self.run_class_metric_test(
+            preds=list(preds),
+            target=list(target),
+            metric_class=lambda **kw: metric_cls(num_outputs=num_outputs, **kw),
+            reference_metric=lambda p, t, *_: golden(
+                np.asarray(p).reshape(-1, num_outputs), np.asarray(t).reshape(-1, num_outputs)
+            ),
+        )
+
+
+def test_msle_nonnegative_inputs():
+    preds = np.abs(_RNG.randn(NUM_BATCHES, BATCH)).astype(np.float32)
+    target = np.abs(_RNG.randn(NUM_BATCHES, BATCH)).astype(np.float32)
+    import jax.numpy as jnp
+
+    metric = regression.MeanSquaredLogError()
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    np.testing.assert_allclose(
+        float(metric.compute()), mean_squared_log_error(target.reshape(-1), preds.reshape(-1)), atol=1e-5
+    )

@@ -112,3 +112,52 @@ def test_clip_score_uncached_fails_cleanly(monkeypatch):
     monkeypatch.setattr(transformers.CLIPProcessor, "from_pretrained", _raise_not_cached)
     with pytest.raises(ModuleNotFoundError, match="cached"):
         clip_score(jnp.zeros((3, 32, 32), dtype=jnp.uint8), "a photo")
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_dir(tmp_path_factory):
+    """A local save_pretrained CLIP checkpoint: tiny towers + tokenizer + processor."""
+    import json
+
+    d = tmp_path_factory.mktemp("tiny_clip")
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1, "a</w>": 2, "photo</w>": 3,
+             "of</w>": 4, "cat</w>": 5, "dog</w>": 6}
+    json.dump(vocab, open(d / "vocab.json", "w"))
+    (d / "merges.txt").write_text("#version: 0.2\n")
+    tok = transformers.CLIPTokenizer(str(d / "vocab.json"), str(d / "merges.txt"))
+    tok.save_pretrained(str(d))
+    config = transformers.CLIPConfig(
+        text_config={"vocab_size": len(vocab), "hidden_size": 16, "num_hidden_layers": 2,
+                     "num_attention_heads": 2, "intermediate_size": 32,
+                     "max_position_embeddings": 16, "projection_dim": 8},
+        vision_config={"hidden_size": 16, "num_hidden_layers": 2, "num_attention_heads": 2,
+                       "intermediate_size": 32, "image_size": 32, "patch_size": 8,
+                       "projection_dim": 8},
+        projection_dim=8,
+    )
+    torch_model = transformers.CLIPModel(config)
+    torch_model.eval()
+    torch_model.save_pretrained(str(d))
+    transformers.CLIPImageProcessor(
+        size={"shortest_edge": 32}, crop_size={"height": 32, "width": 32}
+    ).save_pretrained(str(d))
+    return str(d)
+
+
+def test_clip_score_from_local_checkpoint(tiny_clip_dir):
+    """model_name_or_path drives the full HF CLIP path end-to-end, offline."""
+    from torchmetrics_tpu.multimodal import CLIPScore
+
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(0, 255, size=(2, 3, 32, 32), dtype=np.uint8))
+    metric = CLIPScore(model_name_or_path=tiny_clip_dir)
+    metric.update(imgs, ["a photo of a cat", "a photo of a dog"])
+    val = float(metric.compute())
+    assert np.isfinite(val) and 0.0 <= val <= 100.0
+
+    # per-pair scores are deterministic for a fixed checkpoint
+    from torchmetrics_tpu.functional.multimodal import clip_score
+
+    v1 = float(clip_score(imgs, ["a photo of a cat", "a photo of a dog"], model_name_or_path=tiny_clip_dir))
+    v2 = float(clip_score(imgs, ["a photo of a cat", "a photo of a dog"], model_name_or_path=tiny_clip_dir))
+    assert v1 == v2
