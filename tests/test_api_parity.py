@@ -1,0 +1,70 @@
+"""API-surface parity audit vs the reference package.
+
+Walks every ``__all__`` the reference declares (root, ``functional``, and each domain
+subpackage) and asserts the same name is importable from the corresponding
+``torchmetrics_tpu`` module. Skips wherever the read-only reference checkout is not
+mounted. Conditional reference exports (names gated on optional deps at reference
+import time) are resolved from the reference's source text, not its runtime import,
+so the audit covers the full declared surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+from pathlib import Path
+
+import pytest
+
+_REF = Path("/root/reference/src/torchmetrics")
+
+pytestmark = pytest.mark.skipif(not _REF.exists(), reason="reference checkout not mounted")
+
+_MODULES = [
+    "",
+    "functional",
+    "classification",
+    "regression",
+    "image",
+    "text",
+    "audio",
+    "detection",
+    "retrieval",
+    "nominal",
+    "multimodal",
+    "wrappers",
+    "functional.classification",
+    "functional.regression",
+    "functional.image",
+    "functional.text",
+    "functional.audio",
+    "functional.detection",
+    "functional.retrieval",
+    "functional.nominal",
+    "functional.pairwise",
+    "functional.multimodal",
+]
+
+
+def _reference_all(module: str) -> list:
+    path = _REF / module.replace(".", "/") / "__init__.py" if module else _REF / "__init__.py"
+    tree = ast.parse(path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(getattr(t, "id", None) == "__all__" for t in node.targets):
+            return [ast.literal_eval(e) for e in node.value.elts]
+    return []
+
+
+@pytest.mark.parametrize("module", _MODULES, ids=[m or "root" for m in _MODULES])
+def test_every_reference_export_exists(module):
+    names = _reference_all(module)
+    ours = importlib.import_module(f"torchmetrics_tpu.{module}" if module else "torchmetrics_tpu")
+    missing = [n for n in names if not hasattr(ours, n)]
+    assert not missing, f"{module or 'root'}: missing {len(missing)}/{len(names)}: {missing}"
+
+
+def test_parity_audit_covers_real_surface():
+    # the audit is vacuous if the reference layout moved — require the big tables
+    assert len(_reference_all("")) >= 90
+    assert len(_reference_all("functional")) >= 90
+    assert len(_reference_all("classification")) >= 90
