@@ -334,6 +334,26 @@ def bench_torch():
     return results
 
 
+def bench_rouge(n_pairs=200):
+    """BASELINE #4's host half: ROUGE-1/2/L over WMT-shaped sentence pairs.
+
+    Tokenization and n-gram counting are host work by design (reference does the
+    same); this times the full functional on synthetic en-de-like pairs.
+    """
+    from torchmetrics_tpu.functional.text import rouge_score
+
+    rng = np.random.RandomState(0)
+    words = ["the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog", "ein",
+             "schnell", "braun", "fuchs", "springt", "uber", "den", "faulen", "hund"]
+    preds = [" ".join(rng.choice(words, rng.randint(8, 24))) for _ in range(n_pairs)]
+    target = [" ".join(rng.choice(words, rng.randint(8, 24))) for _ in range(n_pairs)]
+    rouge_score(preds[:4], target[:4])  # warm
+    t0 = time.perf_counter()
+    out = rouge_score(preds, target)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    return elapsed_ms, float(out["rouge1_fmeasure"])
+
+
 def bench_map_epoch_end(n_images=300, n_classes=10):
     """BASELINE #5 end-to-end: MeanAveragePrecision epoch-end ``compute()`` wall-clock.
 
@@ -465,6 +485,11 @@ def main():
         extras["map300_value"] = round(map_val, 4)
     except Exception as err:
         print(f"map epoch-end probe failed: {err}", file=sys.stderr)
+    try:
+        rouge_ms, _ = bench_rouge()
+        extras["rouge200_ms"] = round(rouge_ms, 1)
+    except Exception as err:
+        print(f"rouge probe failed: {err}", file=sys.stderr)
 
     for n, sync_us in sync_sweep.items():
         extras[f"mesh{n}_sync_us"] = round(sync_us, 2)

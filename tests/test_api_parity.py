@@ -58,6 +58,11 @@ def _reference_all(module: str) -> list:
 @pytest.mark.parametrize("module", _MODULES, ids=[m or "root" for m in _MODULES])
 def test_every_reference_export_exists(module):
     names = _reference_all(module)
+    # an empty table means the parser no longer finds the reference's __all__
+    # (layout/AST-shape change) — fail loudly instead of passing vacuously.
+    # functional.multimodal/multimodal legitimately declare no names.
+    if module not in ("multimodal", "functional.multimodal"):
+        assert names, f"{module or 'root'}: reference __all__ not found — update _reference_all"
     ours = importlib.import_module(f"torchmetrics_tpu.{module}" if module else "torchmetrics_tpu")
     missing = [n for n in names if not hasattr(ours, n)]
     assert not missing, f"{module or 'root'}: missing {len(missing)}/{len(names)}: {missing}"

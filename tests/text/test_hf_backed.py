@@ -161,3 +161,16 @@ def test_clip_score_from_local_checkpoint(tiny_clip_dir):
     v1 = float(clip_score(imgs, ["a photo of a cat", "a photo of a dog"], model_name_or_path=tiny_clip_dir))
     v2 = float(clip_score(imgs, ["a photo of a cat", "a photo of a dog"], model_name_or_path=tiny_clip_dir))
     assert v1 == v2
+
+
+def test_rouge_compute_handles_synced_array_state():
+    """After a distributed sync, cat-reduced states arrive as one array of per-sample
+    scores; compute must return the scalar mean (reference averages unconditionally)."""
+    from torchmetrics_tpu.functional.text.rouge import _rouge_score_compute
+
+    out = _rouge_score_compute({"rouge1_fmeasure": jnp.asarray([0.2, 0.4, 0.6])})
+    assert np.asarray(out["rouge1_fmeasure"]).shape == ()
+    np.testing.assert_allclose(float(out["rouge1_fmeasure"]), 0.4, atol=1e-6)
+
+    out = _rouge_score_compute({"rouge1_fmeasure": [0.25, jnp.asarray([0.5, 0.75])]})
+    np.testing.assert_allclose(float(out["rouge1_fmeasure"]), 0.5, atol=1e-6)

@@ -41,18 +41,19 @@ def _split_sentence(x: str) -> Sequence[str]:
     return [s for s in parts if s]
 
 
-def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, Array]:
-    """P/R/F from hit counts (reference ``rouge.py:75-92``)."""
+def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    """P/R/F from hit counts (reference ``rouge.py:75-92``).
+
+    Host floats throughout: creating three device scalars per (pair, rouge-key) made
+    200 WMT pairs cost ~33 s on the tunneled TPU (thousands of ~100 ms dispatches);
+    n-gram scoring is host work — only aggregated results become arrays.
+    """
     precision = hits_or_lcs / pred_len
     recall = hits_or_lcs / target_len
     if precision == recall == 0.0:
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     fmeasure = 2 * precision * recall / (precision + recall)
-    return {
-        "precision": jnp.asarray(precision, dtype=jnp.float32),
-        "recall": jnp.asarray(recall, dtype=jnp.float32),
-        "fmeasure": jnp.asarray(fmeasure, dtype=jnp.float32),
-    }
+    return {"precision": float(precision), "recall": float(recall), "fmeasure": float(fmeasure)}
 
 
 def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> np.ndarray:
@@ -124,7 +125,7 @@ def _normalize_and_tokenize_text(
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
 
 
-def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, Array]:
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
     """ROUGE-N P/R/F (reference ``rouge.py:198-220``)."""
 
     def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
@@ -133,27 +134,27 @@ def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> D
     pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
     pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
 
     hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
     return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
 
 
-def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, Array]:
+def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, float]:
     """ROUGE-L P/R/F (reference ``rouge.py:223-235``)."""
     pred_len, target_len = len(pred), len(target)
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     lcs = _lcs(pred, target)
     return _compute_metrics(lcs, pred_len, target_len)
 
 
-def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, Array]:
+def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, float]:
     """ROUGE-Lsum P/R/F via union-LCS (reference ``rouge.py:238-277``)."""
     pred_len = sum(map(len, pred))
     target_len = sum(map(len, target))
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
 
     def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
         ngrams: Counter = Counter()
@@ -184,13 +185,13 @@ def _rouge_score_update(
     stemmer: Optional[Any] = None,
     normalizer: Optional[Callable[[str], str]] = None,
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
-) -> Dict[Union[int, str], List[Dict[str, Array]]]:
+) -> Dict[Union[int, str], List[Dict[str, float]]]:
     """Per-sample (best or averaged over references) scores (reference ``rouge.py:280-391``)."""
-    results: Dict[Union[int, str], List[Dict[str, Array]]] = {key: [] for key in rouge_keys_values}
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
 
     for pred_raw, target_raw in zip(preds, target):
-        result_inner: Dict[Union[int, str], Dict[str, Array]] = {key: {} for key in rouge_keys_values}
-        result_avg: Dict[Union[int, str], List[Dict[str, Array]]] = {key: [] for key in rouge_keys_values}
+        result_inner: Dict[Union[int, str], Dict[str, float]] = {key: {} for key in rouge_keys_values}
+        result_avg: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
         list_results = []
         pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
         pred_lsum = []
@@ -229,7 +230,7 @@ def _rouge_score_update(
             for rouge_key in rouge_keys_values:
                 scores = result_avg[rouge_key]
                 avg = {
-                    tp: jnp.asarray(np.mean([float(s[tp]) for s in scores]), dtype=jnp.float32)
+                    tp: float(np.mean([float(s[tp]) for s in scores]))
                     for tp in ("precision", "recall", "fmeasure")
                 }
                 results[rouge_key].append(avg)
@@ -237,14 +238,24 @@ def _rouge_score_update(
     return results
 
 
-def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
-    """Average per-sample scores (reference ``rouge.py:394-408``)."""
+def _rouge_score_compute(sentence_results: Dict[str, List[Any]]) -> Dict[str, Array]:
+    """Average per-sample scores (reference ``rouge.py:394-408``).
+
+    List entries may be host floats (fresh per-pair scores) or 1-d arrays (per-update
+    batches); a bare array is a synced state (``dim_zero_cat`` of all samples). Every
+    branch returns the scalar mean.
+    """
     output: Dict[str, Array] = {}
     for rouge_key, scores in sentence_results.items():
         if isinstance(scores, list):
-            output[rouge_key] = jnp.mean(jnp.stack(scores)) if scores else jnp.asarray(0.0)
+            if not scores:
+                output[rouge_key] = jnp.asarray(0.0)
+                continue
+            flat = np.concatenate([np.atleast_1d(np.asarray(s, dtype=np.float64)) for s in scores])
+            output[rouge_key] = jnp.asarray(np.mean(flat), dtype=jnp.float32)
         else:
-            output[rouge_key] = scores
+            # synced state: dim_zero_cat produced one array of per-sample scores
+            output[rouge_key] = jnp.mean(jnp.atleast_1d(jnp.asarray(scores)))
     return output
 
 

@@ -100,10 +100,15 @@ class ROUGEScore(Metric):
             tokenizer=self.tokenizer,
             accumulate=self.accumulate,
         )
+        # one device array per (key, score) per update call — per-pair device
+        # scalars cost a dispatch each and made large corpora pathologically slow
+        batched: Dict[str, list] = {}
         for rouge_key, metrics in output.items():
             for metric in metrics:
                 for tp, value in metric.items():
-                    getattr(self, f"rouge{rouge_key}_{tp}").append(value)
+                    batched.setdefault(f"rouge{rouge_key}_{tp}", []).append(float(value))
+        for name, values in batched.items():
+            getattr(self, name).append(jnp.asarray(values, dtype=jnp.float32))
 
     def compute(self) -> Dict[str, Array]:
         """Mean over accumulated per-sample scores."""
