@@ -62,22 +62,32 @@ _SCENARIO_BYTES = {
 }
 
 
-def _time_jitted(step, state, *args):
+def _time_jitted(step, state, *args, int_probe=None):
     """Mean µs/step of a jitted state-in/state-out update, measured on-device.
 
     The steps run inside ONE ``lax.scan`` dispatch per measurement, and the reported
     number is the SLOPE between a short and a long scan: the axon tunnel adds a fixed
     ~1ms dispatch+poll cost per call that would otherwise swamp the kernels being timed
     (a real training loop pipelines dispatch behind device work, so device throughput is
-    the honest number). Float arguments are perturbed by a per-step epsilon so XLA
-    cannot hoist the loop-invariant update out of the scan.
+    the honest number). A carry-dependent probe perturbs an input each step so the
+    chain is strictly sequential and XLA cannot simplify the update away.
 
-    The carry-dependent probe costs one input read+write copy per step, so every
-    reported number is a conservative UPPER bound (the tax is ~40% on the 524 MB
-    perplexity scenario). The copy-free alternative — scanning over pre-materialised
-    stacked input copies — was tried and rejected: without the strict carry->input
-    dependency the tunneled runtime's completion signal stops tracking the real work
-    and reports physically impossible numbers (1 µs for a 33 MB reduction).
+    Probe placement matters: adding the probe to a large float input forces a
+    materialised read+write copy of it per step BEFORE any opaque (pallas) consumer —
+    a tax XLA fuses away for plain-XLA consumers but not for custom calls, which made
+    the r03 bench report the fused accuracy kernel as slower than the staged path it
+    beats by 2.6x. ``int_probe=i`` instead adds a runtime-zero (compile-opaque) int32
+    derived from the carry to the SMALL integer input ``args[i]``, so the big float
+    tensor is read in place, exactly like fresh model logits in a real eval loop.
+    Measured r04: hoisting of the now-loop-invariant heavy ops does NOT occur (staged
+    accuracy 121 µs and perplexity 756 µs both sit above their one-pass HBM floors of
+    41/640 µs; a hoist would collapse them to ~µs) — ``main`` still cross-checks every
+    number against its floor and flags ``*_below_floor`` if a future compiler starts
+    hoisting. ``lax.optimization_barrier`` probing was tried and rejected: it let the
+    staged path collapse to 36 µs, below the physical floor.
+
+    Numbers for scenarios without a small int input (ssim, det_iou) keep the float
+    add-probe and remain conservative upper bounds (copy tax <=5% there).
     """
     import jax
     import jax.numpy as jnp
@@ -90,10 +100,19 @@ def _time_jitted(step, state, *args):
         def many(state, *args):
             def body(s, e):
                 # carry-dependent probe: forces true sequential execution — XLA can
-                # neither hoist the update out of the scan nor simplify it away
-                # (argmax/softmax are invariant to +constant, so a plain epsilon is not enough)
+                # neither hoist the perturbed input's consumers out of the scan nor
+                # simplify them away (argmax/softmax are invariant to +constant, so a
+                # plain epsilon without the carry term would not be enough)
                 probe = jax.tree_util.tree_leaves(s)[0].ravel()[0].astype(jnp.float32) * jnp.float32(1e-30) + e
-                perturbed = tuple(a + probe if jnp.issubdtype(a.dtype, jnp.floating) else a for a in args)
+                if int_probe is None:
+                    perturbed = tuple(
+                        a + probe if jnp.issubdtype(a.dtype, jnp.floating) else a for a in args
+                    )
+                else:
+                    zero = probe.astype(jnp.int32)  # runtime 0, opaque at compile time
+                    perturbed = tuple(
+                        a + zero if i == int_probe else a for i, a in enumerate(args)
+                    )
                 return step(s, *perturbed), None
 
             return lax.scan(body, state, eps)[0]
@@ -107,7 +126,7 @@ def _time_jitted(step, state, *args):
         s = many(state, *args)  # compile + warm
         jax.block_until_ready(s)
         best = float("inf")
-        for _ in range(3):
+        for _ in range(5):
             t0 = time.perf_counter()
             s = many(state, *args)
             jax.block_until_ready(s)
@@ -156,7 +175,14 @@ def bench_ours():
         return (state[0] + tp, state[1] + fp, state[2] + tn, state[3] + fn)
 
     acc_state = tuple(jnp.zeros(ACC_CLASSES, jnp.int32) for _ in range(4))
-    results["accuracy_us"] = _time_jitted(acc_step, acc_state, preds, target)
+    results["accuracy_us"] = _time_jitted(acc_step, acc_state, preds, target, int_probe=1)
+
+    # report whether the fused one-hot-matmul path engages (r03's open question)
+    from torchmetrics_tpu.ops.stat_counts import fused_multiclass_stat_scores_supported
+
+    results["accuracy_fused_gate"] = bool(
+        fused_multiclass_stat_scores_supported(preds, target, ACC_CLASSES, 1, "global")
+    )
 
     # -- scenario 2: binned AUROC + confusion matrix ----------------------
     logits = jax.random.normal(k3, (CIFAR_BATCH, CIFAR_CLASSES), dtype=jnp.float32)
@@ -175,7 +201,7 @@ def bench_ours():
         jnp.zeros((N_THRESH, CIFAR_CLASSES, 2, 2), jnp.int32),
         jnp.zeros((CIFAR_CLASSES, CIFAR_CLASSES), jnp.int32),
     )
-    results["auroc_cm_us"] = _time_jitted(auroc_cm_step, auroc_state, logits, labels)
+    results["auroc_cm_us"] = _time_jitted(auroc_cm_step, auroc_state, logits, labels, int_probe=1)
 
     # -- scenario 3: SSIM on 256x256 batches ------------------------------
     img_a = jax.random.uniform(k5, (IMG_BATCH, 3, IMG_SIZE, IMG_SIZE), dtype=jnp.float32)
@@ -202,7 +228,7 @@ def bench_ours():
         return (state[0] + total, state[1] + count)
 
     ppl_state = (jnp.asarray(0.0), jnp.asarray(0))
-    results["perplexity_us"] = _time_jitted(ppl_step, ppl_state, lm_logits, lm_target)
+    results["perplexity_us"] = _time_jitted(ppl_step, ppl_state, lm_logits, lm_target, int_probe=1)
 
     # -- scenario 5: batched pairwise box IoU (mAP matching hot op) --------
     from torchmetrics_tpu.functional.detection.helpers import _box_iou
@@ -468,7 +494,7 @@ def main():
         except Exception as err:
             print(f"sync probe failed for {n} devices: {err}", file=sys.stderr)
 
-    extras = {}
+    extras = {"accuracy_fused_gate": ours.pop("accuracy_fused_gate", None)}
     for key, ours_us in ours.items():
         extras[key.replace("_us", "_us_ours")] = round(ours_us, 2)
         if key in _SCENARIO_BYTES:
@@ -476,6 +502,11 @@ def main():
             extras[key.replace("_us", "_gbps")] = round(gbps, 1)
             if peak_gbps is not None:
                 extras[key.replace("_us", "_peak_frac")] = round(gbps / peak_gbps, 3)
+                # physical sanity: one HBM pass over the scenario's bytes; a reading
+                # below it means the compiler hoisted work out of the timing loop
+                floor_us = _SCENARIO_BYTES[key] / peak_gbps / 1e3
+                if ours_us < 0.9 * floor_us:
+                    extras[key.replace("_us", "_below_floor")] = True
         if key in baseline:
             extras[key.replace("_us", "_us_torch")] = round(baseline[key], 2)
             extras[key.replace("_us", "_speedup")] = round(baseline[key] / ours_us, 3)

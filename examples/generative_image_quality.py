@@ -1,10 +1,11 @@
 """FID / KID / IS / LPIPS with the built-in default extractors.
 
-All four work out of the box: the FID-compat InceptionV3 trunk and the LPIPS
-backbones are native Flax modules (deterministically initialised, with a warning that
-scores are self-consistent rather than canonical until pretrained weights are
-converted in), and the learned LPIPS heads ARE bundled. To get canonical values,
-convert checkpoints::
+The FID-compat InceptionV3 trunk and the LPIPS backbones are native Flax modules;
+the learned LPIPS heads ARE bundled, pretrained backbone weights are not. Without
+weights the constructors RAISE unless you explicitly opt in to the deterministic
+random-init trunks (``allow_random_features=True`` / ``allow_random_backbone=True``
+— scores are then self-consistent but not canonical, as this demo does). To get
+canonical values, convert checkpoints::
 
     import torch
     from torchmetrics_tpu.models.inception import from_fidelity_state_dict
@@ -32,23 +33,23 @@ def main() -> None:
     real = jnp.asarray(rng.integers(0, 255, size=(16, 3, 64, 64), dtype=np.uint8))
     fake = jnp.asarray(rng.integers(60, 255, size=(16, 3, 64, 64), dtype=np.uint8))
 
-    fid = FrechetInceptionDistance(feature=64)
+    fid = FrechetInceptionDistance(feature=64, allow_random_features=True)
     fid.update(real, real=True)
     fid.update(fake, real=False)
     print("FID:", float(fid.compute()))
 
-    kid = KernelInceptionDistance(feature=64, subset_size=8)
+    kid = KernelInceptionDistance(feature=64, subset_size=8, allow_random_features=True)
     kid.update(real, real=True)
     kid.update(fake, real=False)
     kid_mean, kid_std = kid.compute()
     print("KID:", float(kid_mean), "+/-", float(kid_std))
 
-    inception = InceptionScore(splits=4)
+    inception = InceptionScore(splits=4, allow_random_features=True)
     inception.update(fake)
     is_mean, is_std = inception.compute()
     print("IS:", float(is_mean), "+/-", float(is_std))
 
-    lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", normalize=True)
+    lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", normalize=True, allow_random_backbone=True)
     img = jnp.asarray(rng.uniform(0, 1, size=(4, 3, 64, 64)).astype(np.float32))
     lpips.update(img, jnp.clip(img + 0.1, 0, 1))
     print("LPIPS:", float(lpips.compute()))

@@ -48,7 +48,7 @@ def test_identity_resize_is_exact():
 def test_trunk_tap_dims(tap, dim):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        extractor, n = resolve_feature_extractor(tap)
+        extractor, n = resolve_feature_extractor(tap, allow_random_features=True)
     assert n == dim
     imgs = jnp.asarray(rng.integers(0, 255, size=(2, 3, 32, 32), dtype=np.uint8))
     feats = extractor(imgs)
@@ -59,7 +59,7 @@ def test_trunk_tap_dims(tap, dim):
 def test_trunk_2048_and_multi_tap():
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        fn = fid_inception_v3_extractor(("2048", "logits"), warn_on_random=False)
+        fn = fid_inception_v3_extractor(("2048", "logits"), allow_random=True)
     imgs = jnp.asarray(rng.integers(0, 255, size=(2, 3, 48, 48), dtype=np.uint8))
     feats, logits = fn(imgs)
     assert feats.shape == (2, 2048) and logits.shape == (2, 1008)
@@ -68,8 +68,8 @@ def test_trunk_2048_and_multi_tap():
 def test_default_trunk_is_cached_and_deterministic():
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        a, _ = resolve_feature_extractor(64)
-        b, _ = resolve_feature_extractor("64")
+        a, _ = resolve_feature_extractor(64, allow_random_features=True)
+        b, _ = resolve_feature_extractor("64", allow_random_features=True)
     assert a is b  # lru-cached default: FID/KID/IS share one trunk + XLA cache
     imgs = jnp.asarray(rng.integers(0, 255, size=(1, 3, 32, 32), dtype=np.uint8))
     np.testing.assert_array_equal(np.asarray(a(imgs)), np.asarray(b(imgs)))

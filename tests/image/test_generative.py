@@ -89,11 +89,17 @@ class TestFID:
         fid.update(imgs, real=False)
         assert int(fid.real_features_num_samples) == 8
 
-    def test_default_feature_builds_compat_trunk(self):
-        """Default feature=2048 now builds the FID-compat trunk, warning that the
+    def test_default_feature_raises_without_weights(self):
+        """Default feature=2048 without weights RAISES (random-init scores look
+        plausible but are meaningless — reference hard-errors too, fid.py:264-270)."""
+        with pytest.raises(RuntimeError, match="allow_random_features"):
+            FrechetInceptionDistance()
+
+    def test_default_feature_builds_compat_trunk_with_opt_in(self):
+        """Explicit opt-in builds the FID-compat trunk, warning that the
         deterministic random init is self-consistent only (no bundled weights)."""
         with pytest.warns(UserWarning, match="self-consistent"):
-            fid = FrechetInceptionDistance()
+            fid = FrechetInceptionDistance(allow_random_features=True)
         assert fid.num_features == 2048
 
     def test_merge_state_parity(self):
@@ -202,10 +208,15 @@ class TestLPIPS:
         v_far = float(m.compute())
         assert v_far > v_near > 0
 
+    def test_string_backbone_raises_without_weights(self):
+        """A string backbone without weights raises unless explicitly opted in."""
+        with pytest.raises(RuntimeError, match="allow_random_backbone"):
+            LearnedPerceptualImagePatchSimilarity(net_type="alex")
+
     def test_string_backbone_default_path(self):
-        """String backbones work out of the box: bundled heads + random-init warning."""
+        """With the opt-in, string backbones work: bundled heads + random-init warning."""
         with pytest.warns(UserWarning, match="self-consistent"):
-            m = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+            m = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_backbone=True)
         img = jnp.asarray(rng.uniform(0, 1, size=(2, 3, 64, 64)))
         other = jnp.clip(img + 0.2, 0, 1)
         m.update(img, other)

@@ -122,3 +122,41 @@ def test_reset():
     mc.reset()
     for m in mc.values(copy_state=False):
         assert m.update_count == 0
+
+
+def test_group_members_inherit_fold_markers():
+    """A leader whose dist_reduce_fx=None state was folded by merge_state propagates
+    the stacked layout AND its fold marker to members — a member-side fold must not
+    re-wrap the already-stacked state (regression: concatenate rank mismatch)."""
+    from torchmetrics_tpu.metric import Metric
+
+    class NoneState(Metric):
+        full_state_update = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.zeros(3), dist_reduce_fx=None)
+
+        def update(self, x):
+            self.s = jnp.asarray(x)
+
+        def compute(self):
+            return jnp.sum(self.s)
+
+    mc = MetricCollection({"a": NoneState(), "b": NoneState()})
+    mc.update(jnp.arange(3.0))
+    leader = mc._modules["a"]
+    shard = NoneState()
+    shard.update(jnp.arange(3.0) + 1)
+    leader.merge_state(shard)  # leader state now stacked (2, 3), marked folded
+    assert "s" in leader._none_folded
+
+    for _, m in mc.items(copy_state=False):  # re-propagates leader state to members
+        pass
+    member = mc._modules["b"]
+    assert member.s.shape == (2, 3) and "s" in member._none_folded
+
+    shard2 = NoneState()
+    shard2.update(jnp.arange(3.0) + 2)
+    member.merge_state(shard2)  # crashed before fold markers travelled with states
+    assert member.s.shape == (3, 3)

@@ -1,4 +1,5 @@
-"""Fused logits->stat-scores kernel parity (interpret mode; the compiled Mosaic path
+"""Fused logits->stat-scores parity for BOTH impls — the onehot-matmul default (pure
+XLA, runs everywhere) and the pallas kernel (interpret mode; the compiled Mosaic path
 is exercised on real TPU via the same out-of-process pattern as test_ops_kernels)."""
 
 from __future__ import annotations
@@ -17,11 +18,26 @@ from torchmetrics_tpu.ops.stat_counts import (
     _block_rows,
     _fused_counts_pallas,
     fused_multiclass_stat_scores,
+    fused_multiclass_stat_scores_supported,
 )
 
-pytestmark = pytest.mark.skipif(not _PALLAS_AVAILABLE, reason="pallas unavailable")
+# only the pallas impl needs pallas; onehot_matmul is pure XLA and must keep coverage
+# even where the pallas import fails (it is the production default on TPU)
+_pallas_only = pytest.mark.skipif(not _PALLAS_AVAILABLE, reason="pallas unavailable")
+
+IMPLS = (
+    "onehot_matmul",
+    pytest.param("pallas", marks=_pallas_only),
+)
 
 rng = np.random.RandomState(3)
+
+
+def _fused(preds, target, num_classes, impl, ignore_index=None):
+    return fused_multiclass_stat_scores(
+        jnp.asarray(preds), jnp.asarray(target), num_classes,
+        ignore_index=ignore_index, interpret=impl == "pallas", impl=impl,
+    )
 
 
 def _staged(preds, target, num_classes, ignore_index=None):
@@ -29,27 +45,30 @@ def _staged(preds, target, num_classes, ignore_index=None):
     return _multiclass_stat_scores_update(p, t, num_classes, 1, "macro", "global", ignore_index)
 
 
+@pytest.mark.parametrize("impl", IMPLS)
 @pytest.mark.parametrize(("n", "c"), [(64, 5), (131, 10), (257, 33), (1000, 100)])
-def test_fused_matches_staged(n, c):
+def test_fused_matches_staged(n, c, impl):
     preds = rng.randn(n, c).astype(np.float32)
     target = rng.randint(0, c, n)
-    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), c, interpret=True)
+    got = _fused(preds, target, c, impl)
     want = _staged(preds, target, c)
     for g, w, name in zip(got, want, "tp fp tn fn".split()):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
 
 
-def test_fused_ignore_index():
+@pytest.mark.parametrize("impl", IMPLS)
+def test_fused_ignore_index(impl):
     n, c = 200, 7
     preds = rng.randn(n, c).astype(np.float32)
     target = rng.randint(0, c, n)
     target[rng.rand(n) < 0.2] = -1
-    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), c, ignore_index=-1, interpret=True)
+    got = _fused(preds, target, c, impl, ignore_index=-1)
     want = _staged(preds, target, c, ignore_index=-1)
     for g, w, name in zip(got, want, "tp fp tn fn".split()):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
 
 
+@_pallas_only
 def test_fused_argmax_tie_break_matches():
     """Duplicate row maxima must resolve to the same (first) index as jnp.argmax."""
     preds = np.zeros((16, 6), dtype=np.float32)
@@ -61,39 +80,65 @@ def test_fused_argmax_tie_break_matches():
     assert int(tp.sum()) == 0
 
 
+@_pallas_only
 def test_block_rows_positive_for_supported_classes():
     for c in (2, 10, 100, 1000, 4096):
         assert _block_rows(c) > 0
 
 
-def test_empty_batch_returns_zeros():
-    got = fused_multiclass_stat_scores(
-        jnp.zeros((0, 5)), jnp.zeros((0,), jnp.int32), 5, interpret=True
-    )
+@pytest.mark.parametrize("impl", IMPLS)
+def test_empty_batch_returns_zeros(impl):
+    got = _fused(jnp.zeros((0, 5)), jnp.zeros((0,), jnp.int32), 5, impl)
     for g in got:
         np.testing.assert_array_equal(np.asarray(g), np.zeros(5, np.int32))
 
 
+@_pallas_only
 def test_oversized_num_classes_raises():
     with pytest.raises(ValueError, match="VMEM"):
         fused_multiclass_stat_scores(jnp.zeros((8, 8192)), jnp.zeros((8,), jnp.int32), 8192, interpret=True)
 
 
-def test_nan_logits_match_argmax_semantics():
-    """jnp.argmax treats NaN as maximal (first NaN wins); the kernel must agree."""
+def test_onehot_matmul_has_no_class_cap():
+    """The matmul impl handles widths past the pallas VMEM cap."""
+    n, c = 16, 8192
+    preds = rng.randn(n, c).astype(np.float32)
+    target = rng.randint(0, c, n)
+    got = _fused(preds, target, c, "onehot_matmul")
+    want = _staged(preds, target, c)
+    for g, w, name in zip(got, want, "tp fp tn fn".split()):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_gate_rejects_mismatched_logit_width():
+    """validate_args=False + wrong width must fall back to staged argmax semantics."""
+    preds = jnp.zeros((8, 7))
+    target = jnp.zeros((8,), jnp.int32)
+    assert not fused_multiclass_stat_scores_supported(preds, target, 5, 1, "global")
+
+
+def test_unknown_impl_raises():
+    with pytest.raises(ValueError, match="impl"):
+        fused_multiclass_stat_scores(jnp.zeros((4, 3)), jnp.zeros((4,), jnp.int32), 3, impl="bogus")
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_nan_logits_match_argmax_semantics(impl):
+    """jnp.argmax treats NaN as maximal (first NaN wins); both impls must agree."""
     preds = np.array([[np.nan, 1.0, 2.0], [0.5, np.nan, np.nan], [0.1, 0.2, 0.3]], np.float32)
     target = np.array([0, 1, 2])
-    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), 3, interpret=True)
+    got = _fused(preds, target, 3, impl)
     want = _staged(preds, target, 3)
     for g, w, name in zip(got, want, "tp fp tn fn".split()):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
 
 
-def test_out_of_range_target_dropped_like_staged():
+@pytest.mark.parametrize("impl", IMPLS)
+def test_out_of_range_target_dropped_like_staged(impl):
     """target >= num_classes drops the sample (staged scatter mode='drop' parity)."""
     preds = np.array([[3.0, 1.0, 0.0], [0.0, 2.0, 0.0]], np.float32)
     target = np.array([7, 1])
-    got = fused_multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), 3, interpret=True)
+    got = _fused(preds, target, 3, impl)
     want = _staged(preds, target, 3)
     for g, w, name in zip(got, want, "tp fp tn fn".split()):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)

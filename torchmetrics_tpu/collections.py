@@ -88,42 +88,64 @@ class MetricCollection:
                 self._groups_checked = True
 
     def _merge_compute_groups(self) -> None:
-        """O(n²) state-equality scan merging groups (reference ``collections.py:209-242``)."""
-        n_groups = len(self._groups)
-        while True:
-            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
-                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
-                    if cg_idx1 == cg_idx2:
-                        continue
-                    metric1 = self._modules[cg_members1[0]]
-                    metric2 = self._modules[cg_members2[0]]
-                    if self._equal_metric_states(metric1, metric2):
-                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
-                        break
-                if len(self._groups) != n_groups:
+        """One-pass signature-bucketed group merge (behavior parity with reference
+        ``collections.py:209-242``, algorithm owned here).
+
+        Each group is fingerprinted by its leader's state STRUCTURE
+        (``_state_signature``: sorted state names, container kinds, shapes, dtypes) —
+        pure metadata, no device work. Only groups with identical fingerprints can
+        possibly share state, so value comparison (``_states_allclose``, the only part
+        that touches arrays) runs within a bucket: each group folds into the first
+        bucket representative whose state values match, else becomes a new
+        representative. Single pass, no deepcopy, no fixed-point rescan — the
+        signature bucketing makes transitive merging fall out of representative
+        chaining instead of repeated O(n²) sweeps.
+        """
+        merged: List[List[str]] = []
+        buckets: Dict[tuple, List[List[str]]] = {}
+        for members in self._groups.values():
+            leader = self._modules[members[0]]
+            sig = self._state_signature(leader)
+            if sig is None:  # stateless metrics never share a group
+                merged.append(members)
+                continue
+            for rep_members in buckets.setdefault(sig, []):
+                if self._states_allclose(self._modules[rep_members[0]], leader):
+                    rep_members.extend(members)
                     break
-            if len(self._groups) == n_groups:
-                break
-            n_groups = len(self._groups)
-        self._groups = dict(enumerate(list(self._groups.values())))
+            else:
+                buckets[sig].append(members)
+                merged.append(members)
+        self._groups = dict(enumerate(merged))
 
     @staticmethod
-    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
-        """Shape+allclose equality of two metrics' states (reference ``collections.py:244-267``)."""
-        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
-            return False
-        if metric1._defaults.keys() != metric2._defaults.keys():
-            return False
+    def _state_signature(metric: Metric) -> Optional[tuple]:
+        """Structural fingerprint of a metric's registered states, or None if stateless.
+
+        Two metrics can only share a compute group when their fingerprints are equal;
+        comparing fingerprints costs no device traffic.
+        """
+        if not metric._defaults:
+            return None
+        sig = []
+        for key in sorted(metric._defaults):
+            val = getattr(metric, key)
+            if isinstance(val, list):
+                sig.append((key, "list", tuple((tuple(v.shape), str(v.dtype)) for v in val)))
+            else:
+                sig.append((key, "array", tuple(val.shape), str(val.dtype)))
+        return tuple(sig)
+
+    @staticmethod
+    def _states_allclose(metric1: Metric, metric2: Metric) -> bool:
+        """Value equality of two structurally identical metrics' states."""
         for key in metric1._defaults:
             state1 = getattr(metric1, key)
             state2 = getattr(metric2, key)
-            if type(state1) is not type(state2):
-                return False
-            if isinstance(state1, list) and isinstance(state2, list):
-                return len(state1) == len(state2) and all(
-                    s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)
-                )
-            if state1.shape != state2.shape or not allclose(state1, state2):
+            if isinstance(state1, list):
+                if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            elif not allclose(state1, state2):
                 return False
         return True
 
@@ -142,6 +164,9 @@ class MetricCollection:
                         setattr(mi, state, list(m0_state) if copy and isinstance(m0_state, list) else m0_state)
                     mi._update_count = m0._update_count
                     mi._computed = None
+                    # fold markers travel with the states they describe, else a member
+                    # holding the leader's stacked None-reduced state would re-wrap it
+                    mi._none_folded = set(m0._none_folded)
         self._state_is_copy = copy
 
     # ------------------------------------------------------------------ compute

@@ -383,11 +383,12 @@ def _multiclass_stat_scores_format_update(
     """Fused format + update.
 
     On TPU, 2-D float logits with top-1/global accumulation take the single-pass
-    Pallas kernel (``ops/stat_counts.py``: row-max one-hot + MXU reduction in one HBM
-    pass — ~1.44x over the staged argmax -> confusion-matrix pipeline at 8192x1000);
-    every other configuration runs the staged stages with identical results. Micro
-    averaging reduces the per-class counts (elementwise sums equal the direct micro
-    counters exactly).
+    one-hot-matmul reduction (``ops/stat_counts.py``: argmax + two MXU matmuls with
+    lazily generated one-hot operands — measured 122.7 -> 46.6 µs vs the staged
+    argmax -> confusion-matrix pipeline at 8192x1000 on TPU v5e, ~88% of the
+    one-pass HBM floor); every other configuration runs the staged stages with
+    identical results. Micro averaging reduces the per-class counts (elementwise
+    sums equal the direct micro counters exactly).
     """
     from torchmetrics_tpu.ops.stat_counts import (
         fused_multiclass_stat_scores,

@@ -121,23 +121,34 @@ def lpips_network(
     backbone_state_dict: Optional[Mapping[str, Any]] = None,
     backbone_variables: Optional[Mapping[str, Any]] = None,
     spatial: bool = False,
+    allow_random_backbone: bool = False,
 ) -> Callable[..., Array]:
     """Build the default ``net(img1, img2, normalize=...)`` for a string backbone.
 
     Uses the bundled learned heads plus the native Flax backbone. Without
-    ``backbone_state_dict``/``backbone_variables`` the backbone is deterministically
-    randomly initialised and a warning is emitted: distances are valid for relative
-    comparison within one configuration, but not canonical LPIPS values.
+    ``backbone_state_dict``/``backbone_variables`` this RAISES unless
+    ``allow_random_backbone=True`` (a randomly-initialised backbone yields
+    plausible-looking but non-canonical LPIPS; the reference hard-errors when the
+    lpips package is absent). With the opt-in, the backbone is deterministically
+    randomly initialised and a warning is emitted: distances are then valid for
+    relative comparison within one configuration only.
     """
     if net_type not in _N_HEADS:
         raise ValueError(f"Argument `net_type` must be one of {tuple(_N_HEADS)}, but got {net_type}.")
     if backbone_state_dict is None and backbone_variables is None:
+        if not allow_random_backbone:
+            raise RuntimeError(
+                f"No pretrained `{net_type}` backbone weights were supplied and none are bundled (the learned"
+                " LPIPS heads are), so scores would come from a randomly-initialised backbone —"
+                " plausible-looking but not canonical LPIPS. Pass `backbone_state_dict=` (a torchvision"
+                " checkpoint) or `backbone_variables=` for exact values, or opt in explicitly with"
+                " `allow_random_backbone=True`."
+            )
         from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
         rank_zero_warn(
-            f"No pretrained `{net_type}` backbone weights are bundled (the learned LPIPS heads are). Using a"
-            " deterministic randomly-initialised backbone: scores are self-consistent but not canonical LPIPS."
-            " Pass `backbone_state_dict=` (a torchvision checkpoint) for exact values."
+            f"Using a deterministic randomly-initialised `{net_type}` backbone (`allow_random_backbone=True`):"
+            " scores are self-consistent but not canonical LPIPS."
         )
         return _default_lpips_network(net_type, spatial)
     feats_fn = _lpips_backbone_builder(net_type)(
@@ -194,10 +205,15 @@ def learned_perceptual_image_patch_similarity(
     net: Union[str, Callable[..., Array]] = "alex",
     reduction: str = "mean",
     normalize: bool = False,
+    allow_random_backbone: bool = False,
 ) -> Array:
-    """LPIPS with a string backbone (bundled heads) or an injected net (reference ``lpips.py:353-401``)."""
+    """LPIPS with a string backbone (bundled heads) or an injected net (reference ``lpips.py:353-401``).
+
+    A string ``net`` without pretrained backbone weights raises unless
+    ``allow_random_backbone=True`` — see :func:`lpips_network`.
+    """
     if isinstance(net, str):
-        net = lpips_network(net)
+        net = lpips_network(net, allow_random_backbone=allow_random_backbone)
     elif not callable(net):
         raise ValueError(
             f"Argument `net={net!r}` must be a backbone name in {tuple(_N_HEADS)} or a callable built with"

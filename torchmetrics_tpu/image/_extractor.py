@@ -5,10 +5,12 @@ The reference builds its extractors from ``torch-fidelity``'s pretrained Incepti
 ``models.inception.FIDInceptionV3`` reproduces the FID-variant pooling blocks, the
 TF1-style bilinear resize to 299x299, and the 1008-way logits head — so the
 reference's integer/str defaults (``feature=64/192/768/2048``, ``'logits_unbiased'``)
-work out of the box. Pretrained weights are NOT bundled (zero-egress environment):
-the default trunk is deterministically randomly initialised and warns — scores are
-self-consistent but not canonical until a ``pt_inception-2015-12-05`` checkpoint is
-converted in. Any callable ``imgs -> (N, d)`` remains accepted as a custom extractor.
+work out of the box once weights are supplied. Pretrained weights are NOT bundled
+(zero-egress environment): without them the builder RAISES unless the caller opts in
+with ``allow_random_features=True``, in which case the trunk is deterministically
+randomly initialised and warns — scores are then self-consistent but not canonical
+until a ``pt_inception-2015-12-05`` checkpoint is converted in. Any callable
+``imgs -> (N, d)`` remains accepted as a custom extractor.
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ def resolve_feature_extractor(
     feature,
     num_features: Optional[int] = None,
     probe_shape: Tuple[int, ...] = (1, 3, 299, 299),
+    allow_random_features: bool = False,
 ) -> Tuple[Callable[[Array], Array], int]:
     """Return ``(extractor, num_features)`` for a pluggable ``feature`` argument.
 
@@ -37,6 +40,10 @@ def resolve_feature_extractor(
         num_features: feature dimensionality; for callables probed with a dummy
             forward when ``None``.
         probe_shape: shape of the dummy input used to probe ``num_features``.
+        allow_random_features: opt-in for the randomly-initialised built-in trunk
+            when no weights are available; without it the builder raises (matching
+            the reference's hard error when torch-fidelity is absent,
+            ``image/fid.py:264-270``).
     """
     if isinstance(feature, (int, str)):
         tap = str(feature)
@@ -46,7 +53,7 @@ def resolve_feature_extractor(
             )
         from torchmetrics_tpu.models.inception import fid_inception_v3_extractor
 
-        return fid_inception_v3_extractor(tap), _FID_TAP_DIMS[tap]
+        return fid_inception_v3_extractor(tap, allow_random=allow_random_features), _FID_TAP_DIMS[tap]
     if not callable(feature):
         raise TypeError("Got unknown input to argument `feature`")
     if num_features is None:

@@ -77,10 +77,13 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         num_features: Optional[int] = None,
+        allow_random_features: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.inception, num_features = resolve_feature_extractor(feature, num_features)
+        self.inception, num_features = resolve_feature_extractor(
+            feature, num_features, allow_random_features=allow_random_features
+        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

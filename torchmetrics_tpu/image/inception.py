@@ -37,6 +37,7 @@ class InceptionScore(Metric):
         splits: int = 10,
         normalize: bool = False,
         num_features: Optional[int] = None,
+        allow_random_features: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -45,7 +46,9 @@ class InceptionScore(Metric):
             " For large datasets this may lead to large memory footprint.",
             UserWarning,
         )
-        self.inception, _ = resolve_feature_extractor(feature, num_features)
+        self.inception, _ = resolve_feature_extractor(
+            feature, num_features, allow_random_features=allow_random_features
+        )
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Integer input to argument `splits` must be positive")
         self.splits = splits

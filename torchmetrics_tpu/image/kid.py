@@ -72,6 +72,7 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         num_features: Optional[int] = None,
+        allow_random_features: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -80,7 +81,9 @@ class KernelInceptionDistance(Metric):
             " For large datasets this may lead to large memory footprint.",
             UserWarning,
         )
-        self.inception, _ = resolve_feature_extractor(feature, num_features)
+        self.inception, _ = resolve_feature_extractor(
+            feature, num_features, allow_random_features=allow_random_features
+        )
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")
         self.subsets = subsets

@@ -48,6 +48,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         normalize: bool = False,
         backbone_state_dict: Optional[Any] = None,
         backbone_variables: Optional[Any] = None,
+        allow_random_backbone: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -59,6 +60,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
                 net_type,
                 backbone_state_dict=backbone_state_dict,
                 backbone_variables=backbone_variables,
+                allow_random_backbone=allow_random_backbone,
             )
         elif callable(net_type):
             self.net = net_type

@@ -139,6 +139,11 @@ class Metric:
         # initialize state
         self._cache: Optional[Dict[str, Union[List[Array], Array]]] = None
         self._is_synced = False
+        # dist_reduce_fx=None array states that currently hold a stacked
+        # (shards, *default.shape) layout — tracked explicitly so folding never has
+        # to guess from ndim (a state whose legitimate per-update shape is one rank
+        # above its default would otherwise be mis-concatenated)
+        self._none_folded: set = set()
 
     @property
     def _update_called(self) -> bool:
@@ -268,11 +273,18 @@ class Metric:
         return batch_val
 
     def _copy_state_refs(self) -> Dict[str, Any]:
-        return {attr: (list(v) if isinstance(v := getattr(self, attr), list) else v) for attr in self._defaults}
+        refs: Dict[str, Any] = {
+            attr: (list(v) if isinstance(v := getattr(self, attr), list) else v) for attr in self._defaults
+        }
+        refs["__none_folded__"] = frozenset(self._none_folded)
+        return refs
 
     def _restore_state_refs(self, cache: Dict[str, Any]) -> None:
         for attr, val in cache.items():
-            setattr(self, attr, val)
+            if attr == "__none_folded__":
+                self._none_folded = set(val)
+            else:
+                setattr(self, attr, val)
 
     def merge_state(self, incoming_state: Union["Metric", Dict[str, Any]], incoming_count: int = 1) -> None:
         """Fold another metric's state (or a raw state dict) into this one.
@@ -282,8 +294,10 @@ class Metric:
         pipelines. Mean states are weighted by update counts (taken from the incoming
         metric, or ``incoming_count`` for raw dicts).
         """
+        incoming_folded: Optional[frozenset] = None  # raw dicts: unknown -> ndim fallback
         if isinstance(incoming_state, Metric):
             incoming_count = incoming_state._update_count
+            incoming_folded = frozenset(incoming_state._none_folded)
             incoming_state = {attr: getattr(incoming_state, attr) for attr in incoming_state._defaults}
         self_count = self._update_count
         for attr in self._defaults:
@@ -304,7 +318,13 @@ class Metric:
                     list(other_state) if isinstance(other_state, list) else [other_state]
                 )
             elif reduce_fn is None and _is_array(self_state):
-                reduced = self._fold_none_arrays(attr, self_state, other_state)
+                reduced = self._fold_none_arrays(
+                    attr,
+                    self_state,
+                    other_state,
+                    self_folded=attr in self._none_folded,
+                    other_folded=None if incoming_folded is None else attr in incoming_folded,
+                )
             elif reduce_fn is None and isinstance(self_state, list):
                 reduced = _flatten([self_state, other_state])
             elif reduce_fn and callable(reduce_fn):
@@ -315,24 +335,43 @@ class Metric:
         self._update_count = self_count + incoming_count
         self._computed = None
 
-    def _fold_none_arrays(self, attr: str, self_state: Any, other_state: Any) -> Any:
+    def _fold_none_arrays(
+        self,
+        attr: str,
+        self_state: Any,
+        other_state: Any,
+        self_folded: Optional[bool] = None,
+        other_folded: Optional[bool] = None,
+    ) -> Any:
         """N-way fold of a ``dist_reduce_fx=None`` array state.
 
         Raw-gathered states keep a stacked ``(shards, *default.shape)`` layout (the
         reference stacks gathered tensors, ``metric.py:401-416``); appending rows —
         rather than pairwise ``jnp.stack`` — keeps folding associative so three or
-        more shards can be merged sequentially.
+        more shards can be merged sequentially. Whether a side already carries the
+        stacked shard axis is tracked EXPLICITLY (``_none_folded`` on each metric,
+        threaded through the callers) — only raw state dicts, whose provenance is
+        unknown, fall back to the ndim heuristic — so a state whose legitimate
+        per-update shape is one rank above its default still merges with ``stack``
+        semantics.
         """
         base_ndim = getattr(self._defaults[attr], "ndim", 0)
 
-        def _rows(x: Any) -> Any:
+        def _rows(x: Any, folded: Optional[bool]) -> Any:
             x = jnp.asarray(x)
-            return x if x.ndim == base_ndim + 1 else x[None]
+            if folded is None:  # unknown provenance: infer — documented fallback only
+                folded = x.ndim == base_ndim + 1
+            return x if folded else x[None]
 
-        return jnp.concatenate([_rows(self_state), _rows(other_state)], axis=0)
+        out = jnp.concatenate(
+            [_rows(self_state, self_folded), _rows(other_state, other_folded)], axis=0
+        )
+        self._none_folded.add(attr)
+        return out
 
     def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
         """Merge ``incoming_state`` (treated as global) with current (batch) state (reference ``metric.py:356-384``)."""
+        global_folded = incoming_state.get("__none_folded__")  # _copy_state_refs snapshots carry this
         for attr in self._defaults:
             local_state = getattr(self, attr)
             global_state = incoming_state[attr]
@@ -350,7 +389,13 @@ class Metric:
                     list(local_state) if isinstance(local_state, list) else [local_state]
                 )
             elif reduce_fn is None and _is_array(global_state):
-                reduced = self._fold_none_arrays(attr, global_state, local_state)
+                reduced = self._fold_none_arrays(
+                    attr,
+                    global_state,
+                    local_state,
+                    self_folded=None if global_folded is None else attr in global_folded,
+                    other_folded=attr in self._none_folded,
+                )
             elif reduce_fn is None and isinstance(global_state, list):
                 reduced = _flatten([global_state, local_state])
             elif reduce_fn and callable(reduce_fn):
@@ -383,6 +428,9 @@ class Metric:
                 continue
             if _is_array(output_dict[attr][0]):
                 output_dict[attr] = jnp.stack(output_dict[attr])
+                if reduction_fn is None:
+                    # gathered None-reduced arrays now carry a leading shard axis
+                    self._none_folded.add(attr)
             elif isinstance(output_dict[attr][0], list) and (
                 len(output_dict[attr][0]) == 0 or _is_array(output_dict[attr][0][0])
             ):
@@ -552,6 +600,7 @@ class Metric:
                 setattr(self, attr, [])
         self._cache = None
         self._is_synced = False
+        self._none_folded = set()
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference ``metric.py:640-642``)."""
@@ -564,6 +613,7 @@ class Metric:
     def __setstate__(self, state: Dict[str, Any]) -> None:
         """Re-wrap update/compute on unpickle (reference ``metric.py:650-655``)."""
         self.__dict__.update(state)
+        self.__dict__.setdefault("_none_folded", set())
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
@@ -686,7 +736,15 @@ class Metric:
                 if isinstance(val, list):
                     setattr(self, key, [jnp.asarray(v) for v in val])
                 else:
-                    setattr(self, key, jnp.asarray(val))
+                    arr = jnp.asarray(val)
+                    setattr(self, key, arr)
+                    # checkpoints don't carry fold flags: recover a None-reduced
+                    # state's stacked-shard marker from rank (documented fallback)
+                    if self._reductions.get(key) is None and _is_array(self._defaults[key]):
+                        if arr.ndim == self._defaults[key].ndim + 1:
+                            self._none_folded.add(key)
+                        else:
+                            self._none_folded.discard(key)
                 restored_any = True
         count_key = prefix + self._UPDATE_COUNT_KEY
         if count_key in state_dict:

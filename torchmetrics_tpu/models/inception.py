@@ -356,17 +356,20 @@ def fid_inception_v3_extractor(
     request: Union[str, Sequence[str]] = "2048",
     state_dict: Optional[Mapping[str, Any]] = None,
     variables: Optional[Dict[str, Any]] = None,
-    warn_on_random: bool = True,
+    allow_random: bool = False,
 ):
     """Build the torch-fidelity-compat ``imgs -> (N, d)`` callable for FID/KID/IS.
 
     ``request`` is one tap name or a sequence of them (a single name returns that
     array; a sequence returns a tuple in order). Without ``state_dict``/``variables``
-    the trunk is deterministically randomly initialised and warns: scores are
-    self-consistent (valid for tracking relative progress with one configuration) but
-    NOT comparable to canonical torch-fidelity/reference FID values. Convert the
-    ``pt_inception-2015-12-05`` checkpoint via ``from_fidelity_state_dict`` for
-    canonical scores.
+    this RAISES unless ``allow_random=True`` — mirroring the reference's hard error
+    when torch-fidelity is absent (``image/fid.py:264-270``), because a
+    randomly-initialised trunk produces plausible-looking but non-canonical scores.
+    With ``allow_random=True`` the trunk is deterministically randomly initialised
+    and warns: scores are then self-consistent (valid for tracking relative progress
+    within one configuration) but NOT comparable to canonical torch-fidelity/reference
+    FID values. Convert the ``pt_inception-2015-12-05`` checkpoint via
+    ``from_fidelity_state_dict`` for canonical scores.
     """
     if nn is None:  # pragma: no cover
         raise ModuleNotFoundError("flax is required for the built-in InceptionV3 extractor")
@@ -379,15 +382,21 @@ def fid_inception_v3_extractor(
         if state_dict is not None:
             variables = from_fidelity_state_dict(state_dict)
         else:
-            if warn_on_random:
-                from torchmetrics_tpu.utilities.prints import rank_zero_warn
-
-                rank_zero_warn(
-                    "No pretrained InceptionV3 weights are bundled (zero-egress environment). Using a"
-                    " deterministic randomly-initialised FID-compat trunk: scores are self-consistent but NOT"
-                    " comparable to canonical FID/KID/IS values. Pass `state_dict=` (a torch-fidelity"
-                    " pt_inception-2015-12-05 checkpoint) or `variables=` for canonical scores."
+            if not allow_random:
+                raise RuntimeError(
+                    "No pretrained InceptionV3 weights were supplied and none are bundled (zero-egress"
+                    " environment), so FID/KID/IS scores would come from a randomly-initialised trunk —"
+                    " plausible-looking but meaningless. Pass `state_dict=` (a torch-fidelity"
+                    " pt_inception-2015-12-05 checkpoint, converted via `from_fidelity_state_dict`) or"
+                    " `variables=` for canonical scores, or opt in to the random trunk explicitly with"
+                    " `allow_random_features=True` (metric constructors) / `allow_random=True` (this builder)."
                 )
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "Using a deterministic randomly-initialised FID-compat trunk (`allow_random=True`): scores"
+                " are self-consistent but NOT comparable to canonical FID/KID/IS values."
+            )
             # cached: FID + KID + IS with default args share one trunk + XLA cache
             return _default_fid_extractor(taps)
 

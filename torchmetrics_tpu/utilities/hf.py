@@ -60,20 +60,32 @@ def load_hf_flax_model(model_name_or_path: str, auto_cls_name: str = "FlaxAutoMo
     import transformers
 
     flax_cls = getattr(transformers, auto_cls_name, None)
-    errors = []
+    first_exc: Optional[Exception] = None
     if flax_cls is not None:
-        for kwargs in ({}, {"from_pt": True}):
-            try:
-                # transformers models carry a read-only `.framework` ("flax"/"pt")
-                return flax_cls.from_pretrained(model_name_or_path, **kwargs)
-            except Exception as exc:  # noqa: BLE001
-                errors.append(exc)
+        try:
+            # transformers models carry a read-only `.framework` ("flax"/"pt")
+            return flax_cls.from_pretrained(model_name_or_path)
+        except Exception as exc:  # noqa: BLE001 — hub raises OSError/ValueError variants
+            first_exc = exc
+            if "flax_model" in str(exc) or "from_pt" in str(exc):
+                # checkpoint exists but ships only torch weights -> converting is the
+                # fix; any other failure skips straight to the torch fallback so an
+                # uncached checkpoint pays two slow hub attempts, not three
+                try:
+                    return flax_cls.from_pretrained(model_name_or_path, from_pt=True)
+                except Exception as exc2:  # noqa: BLE001
+                    first_exc = exc2
     torch_cls_name = auto_cls_name.replace("Flax", "")
-    torch_cls = getattr(transformers, torch_cls_name)
+    torch_cls = getattr(transformers, torch_cls_name, None)
+    if torch_cls is None:
+        raise _load_error(
+            model_name_or_path,
+            first_exc or AttributeError(f"transformers has no auto class {torch_cls_name!r}"),
+        )
     try:
         model = torch_cls.from_pretrained(model_name_or_path)
     except Exception as exc:  # noqa: BLE001
-        raise _load_error(model_name_or_path, errors[0] if errors else exc) from exc
+        raise _load_error(model_name_or_path, first_exc or exc) from exc
     model.eval()
     return model
 
