@@ -1,0 +1,130 @@
+"""Numeric cross-validation: converted flax trunks == independent torch mirrors.
+
+The credibility of FID/KID/IS/LPIPS rests on the converted-weight forward matching
+the torch forward (reference pipeline: ``/root/reference/src/torchmetrics/image/
+fid.py:69-153``, ``functional/image/lpips.py:59-232``). One random state dict is
+loaded through BOTH stacks and features must agree:
+
+- LPIPS backbones run in float64 end-to-end, so the tolerance is 1e-8 — any
+  disagreement is a semantic bug (transposed kernel, wrong pool mode), not noise.
+- The FID trunk pins float32 internally (TPU-first); its tolerance is calibrated to
+  f32 accumulation across the 94-conv stack, still far below bug scale (a wrong BN
+  epsilon alone shifts pooled features by >1e-2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from tests.image.torch_mirrors import (  # noqa: E402
+    TorchAlexNetFeatures,
+    TorchFIDInceptionV3,
+    TorchSqueezeNetFeatures,
+    TorchVGG16Features,
+    seeded_state_dict,
+    tf1_resize_torch,
+)
+from torchmetrics_tpu.models import alexnet, inception, squeezenet, vgg  # noqa: E402
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+@pytest.mark.parametrize(
+    ("torch_cls", "flax_mod", "builder", "hw"),
+    [
+        (TorchVGG16Features, vgg, "vgg16_lpips_extractor", 64),
+        (TorchVGG16Features, vgg, "vgg16_lpips_extractor", 37),  # odd extent: pool edges
+        (TorchAlexNetFeatures, alexnet, "alexnet_lpips_extractor", 64),
+        (TorchAlexNetFeatures, alexnet, "alexnet_lpips_extractor", 83),
+        (TorchSqueezeNetFeatures, squeezenet, "squeezenet_lpips_extractor", 64),
+        (TorchSqueezeNetFeatures, squeezenet, "squeezenet_lpips_extractor", 49),  # ceil-mode pools
+    ],
+)
+def test_lpips_backbone_matches_torch_f64(torch_cls, flax_mod, builder, hw):
+    tm = torch_cls().double()
+    sd = seeded_state_dict(tm, seed=hw)
+    tm.load_state_dict(sd, strict=False)
+    tm.eval()
+
+    rng = np.random.default_rng(hw)  # per-test: reproducible in isolation
+    x = rng.uniform(-1, 1, size=(2, 3, hw, hw))
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x))
+
+    feats_fn = getattr(flax_mod, builder)(state_dict=sd)
+    got = feats_fn(jnp.asarray(x))
+
+    assert len(got) == len(want)
+    for i, (g, w) in enumerate(zip(got, want)):
+        g, w = np.asarray(g), _np(w)
+        assert g.shape == w.shape, f"tap {i}: {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, rtol=1e-7, atol=1e-8, err_msg=f"tap {i}")
+
+
+def test_tf1_resize_matches_independent_torch_impl():
+    """The matmul-formulated flax resize == a gather-based torch implementation, f64."""
+    rng = np.random.default_rng(21)
+    for in_hw, out_hw in [((32, 48), (299, 299)), ((299, 299), (299, 299)), ((310, 17), (299, 299))]:
+        x = rng.uniform(0, 255, size=(1, 3, *in_hw))
+        want = _np(tf1_resize_torch(torch.as_tensor(x), out_hw))
+        # flax path is NHWC
+        got = np.asarray(inception.tf1_bilinear_resize(jnp.asarray(x.transpose(0, 2, 3, 1)), out_hw))
+        # flax builds its interpolation matrices in f32 (trunk is f32 throughout), so
+        # ~1e-5 relative noise on the 0..255 scale is expected; a wrong coordinate
+        # mapping (half-pixel vs TF1) errs at O(1)
+        np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want, rtol=1e-4, atol=5e-3)
+
+
+@pytest.mark.parametrize("hw", [64, 299])
+def test_fid_inception_trunk_matches_torch(hw):
+    """All six taps of the FID-compat trunk agree with the torch mirror through the
+    converter, including the TF1 resize, FID pool variants, and the 1008-way fc."""
+    tm = TorchFIDInceptionV3().double()
+    sd = seeded_state_dict(tm, seed=3)
+    tm.load_state_dict(sd, strict=False)
+    tm.eval()
+
+    rng = np.random.default_rng(hw)
+    x = rng.uniform(0, 255, size=(2, 3, hw, hw))
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x))
+
+    variables = inception.from_fidelity_state_dict(sd)
+    model = inception.FIDInceptionV3(request=("64", "192", "768", "2048", "logits_unbiased", "logits"))
+    got = model.apply(variables, jnp.asarray(x.astype(np.float32)))
+
+    for tap in ("64", "192", "768", "2048", "logits_unbiased", "logits"):
+        g, w = np.asarray(got[tap]), _np(want[tap])
+        assert g.shape == w.shape, f"tap {tap}"
+        scale = max(np.abs(w).max(), 1e-3)
+        err = np.abs(g - w).max() / scale
+        assert err < 2e-4, f"tap {tap}: max rel-to-peak error {err:.2e} (f32 noise is ~1e-5)"
+
+
+def test_fid_trunk_detects_wrong_bn_epsilon():
+    """Calibration guard: the tolerance above MUST catch a BN-epsilon mismatch, the
+    exact silent-corruption class this suite exists for."""
+    tm = TorchFIDInceptionV3().double()
+    sd = seeded_state_dict(tm, seed=3)
+    tm.load_state_dict(sd, strict=False)
+    for m in tm.modules():
+        if isinstance(m, torch.nn.BatchNorm2d):
+            m.eps = 1e-5  # torch default, NOT inception's 1e-3
+    tm.eval()
+
+    rng = np.random.default_rng(5)
+    x = rng.uniform(0, 255, size=(1, 3, 64, 64))
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x))
+    variables = inception.from_fidelity_state_dict(sd)
+    model = inception.FIDInceptionV3(request=("2048",))
+    got = model.apply(variables, jnp.asarray(x.astype(np.float32)))
+    scale = max(np.abs(_np(want["2048"])).max(), 1e-3)
+    err = np.abs(np.asarray(got["2048"]) - _np(want["2048"])).max() / scale
+    assert err > 2e-4, f"epsilon mismatch went undetected (err {err:.2e}) — tolerance too loose"

@@ -1,0 +1,331 @@
+"""Hand-built torch mirrors of the Flax backbones, for numeric cross-validation.
+
+torchvision is not installed in this image, so these modules re-create the exact
+torchvision layer layouts (``vgg16().features``, ``alexnet().features``,
+``squeezenet1_1().features``, ``inception_v3`` + torch-fidelity's FID variants) from
+their published architecture, with state-dict key names matching what the repo's
+``from_torch_state_dict`` converters consume. Loading ONE random state dict through
+both stacks and comparing forwards proves the converters' tensor layouts AND the
+flax modules' op semantics (conv padding/stride, pool ceil/count_include_pad, BN
+epsilon, TF1 resize) against an independent torch implementation.
+
+Everything runs in float64 where the flax side permits, so disagreement means a real
+semantic bug, not accumulation noise.
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def seeded_state_dict(module: nn.Module, seed: int) -> dict:
+    """Randomize every parameter AND buffer (variances positive) deterministically.
+
+    Randomized BN running stats (not the 0/1 defaults) make mean/var mapping swaps
+    and epsilon mismatches visible in the forward comparison.
+    """
+    g = torch.Generator().manual_seed(seed)
+    sd = module.state_dict()
+    out = {}
+    for k, v in sd.items():
+        if k.endswith("num_batches_tracked"):
+            out[k] = v
+            continue
+        r = torch.randn(v.shape, generator=g, dtype=torch.float64)
+        if k.endswith("running_var"):
+            r = r.abs() + 0.5  # positive, away from zero
+        elif k.endswith("running_mean") or k.endswith(".bias"):
+            r = r * 0.2
+        else:
+            fan_in = max(int(v.numel() // v.shape[0]) if v.ndim else 1, 1)
+            r = r / math.sqrt(fan_in)  # keep activations O(1) through the stack
+        out[k] = r
+    return out
+
+
+# --------------------------------------------------------------------------- LPIPS backbones
+
+
+class TorchVGG16Features(nn.Module):
+    """torchvision ``vgg16().features`` with the 5 LPIPS taps (post-relu 1_2..5_3)."""
+
+    _STAGES = ((0, 2), (5, 7), (10, 12, 14), (17, 19, 21), (24, 26, 28))
+    _WIDTHS = (64, 128, 256, 512, 512)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.features = nn.Module()
+        in_ch = 3
+        for si, stage in enumerate(self._STAGES):
+            for li in stage:
+                self.features.add_module(str(li), nn.Conv2d(in_ch, self._WIDTHS[si], 3, padding=1))
+                in_ch = self._WIDTHS[si]
+
+    def forward(self, x):
+        outs = []
+        for si, stage in enumerate(self._STAGES):
+            for li in stage:
+                x = F.relu(getattr(self.features, str(li))(x))
+            outs.append(x)
+            if si < len(self._STAGES) - 1:
+                x = F.max_pool2d(x, 2, 2)
+        return outs
+
+
+class TorchAlexNetFeatures(nn.Module):
+    """torchvision ``alexnet().features`` with the 5 LPIPS taps."""
+
+    _CONVS = {0: (64, 11, 4, 2), 3: (192, 5, 1, 2), 6: (384, 3, 1, 1), 8: (256, 3, 1, 1), 10: (256, 3, 1, 1)}
+    _POOL_BEFORE = (3, 6)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.features = nn.Module()
+        in_ch = 3
+        for li, (w, k, s, p) in self._CONVS.items():
+            self.features.add_module(str(li), nn.Conv2d(in_ch, w, k, stride=s, padding=p))
+            in_ch = w
+
+    def forward(self, x):
+        outs = []
+        for li in self._CONVS:
+            if li in self._POOL_BEFORE:
+                x = F.max_pool2d(x, 3, 2)
+            x = F.relu(getattr(self.features, str(li))(x))
+            outs.append(x)
+        return outs
+
+
+class _TorchFire(nn.Module):
+    def __init__(self, in_ch, squeeze, e1, e3) -> None:
+        super().__init__()
+        self.squeeze = nn.Conv2d(in_ch, squeeze, 1)
+        self.expand1x1 = nn.Conv2d(squeeze, e1, 1)
+        self.expand3x3 = nn.Conv2d(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = F.relu(self.squeeze(x))
+        return torch.cat([F.relu(self.expand1x1(x)), F.relu(self.expand3x3(x))], dim=1)
+
+
+class TorchSqueezeNetFeatures(nn.Module):
+    """torchvision ``squeezenet1_1().features`` with the 7 LPIPS slice taps."""
+
+    _FIRES = {3: (16, 64, 64), 4: (16, 64, 64), 6: (32, 128, 128), 7: (32, 128, 128),
+              9: (48, 192, 192), 10: (48, 192, 192), 11: (64, 256, 256), 12: (64, 256, 256)}
+    _POOL_BEFORE = (3, 6, 9)
+    _SLICE_ENDS = (1, 4, 7, 9, 10, 11, 12)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.features = nn.Module()
+        self.features.add_module("0", nn.Conv2d(3, 64, 3, stride=2))  # VALID padding
+        in_ch = 64
+        for li, (s, e1, e3) in self._FIRES.items():
+            self.features.add_module(str(li), _TorchFire(in_ch, s, e1, e3))
+            in_ch = e1 + e3
+
+    def forward(self, x):
+        x = F.relu(getattr(self.features, "0")(x))
+        outs = [x]
+        for li in range(3, 13):
+            if li in self._POOL_BEFORE:
+                x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+            if li in self._FIRES:
+                x = getattr(self.features, str(li))(x)
+            if li in self._SLICE_ENDS:
+                outs.append(x)
+        return outs
+
+
+# --------------------------------------------------------------------------- InceptionV3
+
+
+class _TorchBasicConv2d(nn.Module):
+    def __init__(self, in_ch, out_ch, **conv_kwargs) -> None:
+        super().__init__()
+        self.conv = nn.Conv2d(in_ch, out_ch, bias=False, **conv_kwargs)
+        self.bn = nn.BatchNorm2d(out_ch, eps=0.001)  # torchvision inception BN epsilon
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg3(x, count_include_pad):
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=count_include_pad)
+
+
+class _TorchInceptionA(nn.Module):
+    def __init__(self, in_ch, pool_features, fid_pool=False) -> None:
+        super().__init__()
+        self.fid_pool = fid_pool
+        self.branch1x1 = _TorchBasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch5x5_1 = _TorchBasicConv2d(in_ch, 48, kernel_size=1)
+        self.branch5x5_2 = _TorchBasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = _TorchBasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _TorchBasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _TorchBasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = _TorchBasicConv2d(in_ch, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(_avg3(x, count_include_pad=not self.fid_pool))
+        return torch.cat([b1, b5, bd, bp], 1)
+
+
+class _TorchInceptionB(nn.Module):
+    def __init__(self, in_ch) -> None:
+        super().__init__()
+        self.branch3x3 = _TorchBasicConv2d(in_ch, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = _TorchBasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _TorchBasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _TorchBasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = F.max_pool2d(x, 3, 2)
+        return torch.cat([b3, bd, bp], 1)
+
+
+class _TorchInceptionC(nn.Module):
+    def __init__(self, in_ch, c7, fid_pool=False) -> None:
+        super().__init__()
+        self.fid_pool = fid_pool
+        self.branch1x1 = _TorchBasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7_1 = _TorchBasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7_2 = _TorchBasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = _TorchBasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = _TorchBasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7dbl_2 = _TorchBasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = _TorchBasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = _TorchBasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = _TorchBasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = _TorchBasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+        )
+        bp = self.branch_pool(_avg3(x, count_include_pad=not self.fid_pool))
+        return torch.cat([b1, b7, bd, bp], 1)
+
+
+class _TorchInceptionD(nn.Module):
+    def __init__(self, in_ch) -> None:
+        super().__init__()
+        self.branch3x3_1 = _TorchBasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch3x3_2 = _TorchBasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = _TorchBasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7x3_2 = _TorchBasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = _TorchBasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = _TorchBasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        bp = F.max_pool2d(x, 3, 2)
+        return torch.cat([b3, b7, bp], 1)
+
+
+class _TorchInceptionE(nn.Module):
+    def __init__(self, in_ch, pool="avg") -> None:
+        super().__init__()
+        self.pool = pool
+        self.branch1x1 = _TorchBasicConv2d(in_ch, 320, kernel_size=1)
+        self.branch3x3_1 = _TorchBasicConv2d(in_ch, 384, kernel_size=1)
+        self.branch3x3_2a = _TorchBasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = _TorchBasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = _TorchBasicConv2d(in_ch, 448, kernel_size=1)
+        self.branch3x3dbl_2 = _TorchBasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = _TorchBasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _TorchBasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = _TorchBasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool == "max":
+            bp = F.max_pool2d(x, 3, stride=1, padding=1)
+        else:
+            bp = _avg3(x, count_include_pad=self.pool == "avg")
+        bp = self.branch_pool(bp)
+        return torch.cat([b1, b3, bd, bp], 1)
+
+
+def tf1_resize_torch(x: torch.Tensor, out_hw) -> torch.Tensor:
+    """TF1 align_corners=False bilinear resize, gather-based (independent of the flax
+    matmul formulation): src = dst * (in/out), floor + linear weights, edge-clamped."""
+    n, c, in_h, in_w = x.shape
+    out = x
+
+    def axis_resize(t, in_size, out_size, dim):
+        scale = in_size / out_size
+        src = torch.arange(out_size, dtype=t.dtype) * scale
+        x0 = src.floor().long().clamp(0, in_size - 1)
+        x1 = (x0 + 1).clamp(max=in_size - 1)
+        frac = (src - x0.to(t.dtype)).reshape([-1 if i == dim else 1 for i in range(4)])
+        a = t.index_select(dim, x0)
+        b = t.index_select(dim, x1)
+        return a * (1 - frac) + b * frac
+
+    out = axis_resize(out, in_h, out_hw[0], 2)
+    out = axis_resize(out, in_w, out_hw[1], 3)
+    return out
+
+
+class TorchFIDInceptionV3(nn.Module):
+    """torch-fidelity 'inception-v3-compat' mirror: TF1 resize, (x-128)/128, FID pool
+    variants (count_include_pad=False in A/C/E1; max pool in E2/Mixed_7c), 1008-way fc.
+    State-dict keys match ``models.inception.from_fidelity_state_dict``'s input."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.Conv2d_1a_3x3 = _TorchBasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = _TorchBasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = _TorchBasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = _TorchBasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = _TorchBasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = _TorchInceptionA(192, 32, fid_pool=True)
+        self.Mixed_5c = _TorchInceptionA(256, 64, fid_pool=True)
+        self.Mixed_5d = _TorchInceptionA(288, 64, fid_pool=True)
+        self.Mixed_6a = _TorchInceptionB(288)
+        self.Mixed_6b = _TorchInceptionC(768, 128, fid_pool=True)
+        self.Mixed_6c = _TorchInceptionC(768, 160, fid_pool=True)
+        self.Mixed_6d = _TorchInceptionC(768, 160, fid_pool=True)
+        self.Mixed_6e = _TorchInceptionC(768, 192, fid_pool=True)
+        self.Mixed_7a = _TorchInceptionD(768)
+        self.Mixed_7b = _TorchInceptionE(1280, pool="fid_avg")
+        self.Mixed_7c = _TorchInceptionE(2048, pool="max")
+        self.fc = nn.Linear(2048, 1008)
+
+    def forward(self, x):
+        out = {}
+        x = tf1_resize_torch(x.to(self.fc.weight.dtype), (299, 299))
+        x = (x - 128.0) / 128.0
+        x = self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x)))
+        x = F.max_pool2d(x, 3, 2)
+        out["64"] = x.mean(dim=(2, 3))
+        x = self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(x))
+        x = F.max_pool2d(x, 3, 2)
+        out["192"] = x.mean(dim=(2, 3))
+        x = self.Mixed_5d(self.Mixed_5c(self.Mixed_5b(x)))
+        x = self.Mixed_6e(self.Mixed_6d(self.Mixed_6c(self.Mixed_6b(self.Mixed_6a(x)))))
+        out["768"] = x.mean(dim=(2, 3))
+        x = self.Mixed_7c(self.Mixed_7b(self.Mixed_7a(x)))
+        x = x.mean(dim=(2, 3))
+        out["2048"] = x
+        out["logits_unbiased"] = x @ self.fc.weight.T
+        out["logits"] = out["logits_unbiased"] + self.fc.bias
+        return out

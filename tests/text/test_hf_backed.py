@@ -16,6 +16,7 @@ import pytest
 import jax.numpy as jnp
 
 transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
 
 from torchmetrics_tpu.functional.text.bert import bert_score  # noqa: E402
 from torchmetrics_tpu.functional.text.infolm import infolm  # noqa: E402
@@ -27,6 +28,15 @@ _VOCAB = [
 ]
 
 
+def _tiny_bert_config():
+    """One config shared by the flax- and torch-weight fixtures: the torch-vs-flax
+    comparison only means something if both checkpoints have the same shape."""
+    return transformers.BertConfig(
+        vocab_size=len(_VOCAB), hidden_size=16, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=32, max_position_embeddings=64,
+    )
+
+
 @pytest.fixture(scope="module")
 def tiny_bert_dir(tmp_path_factory):
     """A local save_pretrained checkpoint: tiny FlaxBertForMaskedLM + matching tokenizer."""
@@ -35,15 +45,7 @@ def tiny_bert_dir(tmp_path_factory):
     vocab.write_text("\n".join(_VOCAB))
     tok = transformers.BertTokenizer(str(vocab))
     tok.save_pretrained(str(d))
-    config = transformers.BertConfig(
-        vocab_size=len(_VOCAB),
-        hidden_size=16,
-        num_hidden_layers=2,
-        num_attention_heads=2,
-        intermediate_size=32,
-        max_position_embeddings=64,
-    )
-    model = transformers.FlaxBertForMaskedLM(config, seed=0)
+    model = transformers.FlaxBertForMaskedLM(_tiny_bert_config(), seed=0)
     model.save_pretrained(str(d))
     return str(d)
 
@@ -174,3 +176,49 @@ def test_rouge_compute_handles_synced_array_state():
 
     out = _rouge_score_compute({"rouge1_fmeasure": [0.25, jnp.asarray([0.5, 0.75])]})
     np.testing.assert_allclose(float(out["rouge1_fmeasure"]), 0.5, atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_pt_dir(tmp_path_factory):
+    """The same tiny config saved as TORCH weights only — exercises the from_pt
+    conversion branch of load_hf_flax_model."""
+    d = tmp_path_factory.mktemp("tiny_bert_pt")
+    vocab = d / "vocab.txt"
+    vocab.write_text("\n".join(_VOCAB))
+    transformers.BertTokenizer(str(vocab)).save_pretrained(str(d))
+    config = transformers.BertConfig(
+        vocab_size=len(_VOCAB), hidden_size=16, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=32, max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    # .bin (not safetensors): flax load must FAIL first, driving the from_pt retry
+    transformers.BertForMaskedLM(config).save_pretrained(str(d), safe_serialization=False)
+    return str(d)
+
+
+def test_flax_load_matches_torch_forward(tiny_bert_pt_dir):
+    """Numeric proof for the Flax-first text path: loading torch weights through
+    load_hf_flax_model (from_pt conversion) produces hidden states equal to the
+    torch model's own forward — the feature tensors BERTScore consumes."""
+    from torchmetrics_tpu.utilities.hf import hf_embedding_forward, load_hf_flax_model, load_hf_tokenizer
+
+    model = load_hf_flax_model(tiny_bert_pt_dir)
+    assert getattr(model, "framework", None) == "flax"  # conversion path, not torch fallback
+    tok = load_hf_tokenizer(tiny_bert_pt_dir)
+    enc = tok(["hello world", "the cat sat on the mat"], padding="max_length",
+              max_length=16, truncation=True, return_tensors="np")
+
+    forward = hf_embedding_forward(model, num_layers=2)
+    got = np.asarray(forward(enc["input_ids"], enc["attention_mask"]))
+
+    tmodel = transformers.BertForMaskedLM.from_pretrained(tiny_bert_pt_dir)
+    tmodel.eval()
+    with torch.no_grad():
+        out = tmodel(
+            input_ids=torch.as_tensor(np.asarray(enc["input_ids"])),
+            attention_mask=torch.as_tensor(np.asarray(enc["attention_mask"])),
+            output_hidden_states=True,
+        )
+    want = out.hidden_states[2].numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
