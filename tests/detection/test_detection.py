@@ -543,3 +543,98 @@ class TestPanopticQuality:
 def test_exported_from_root():
     assert tm.MeanAveragePrecision is MeanAveragePrecision
     assert tm.functional.intersection_over_union is intersection_over_union
+
+
+class TestPackedUpdates:
+    """TPU-first packed batch path == per-image dict path, exactly."""
+
+    @staticmethod
+    def _random_epoch(rng, n_images, n_classes=7, max_boxes=9):
+        list_preds, list_target = [], []
+        bm = max_boxes + 3  # padded width > any count
+        pb = np.zeros((n_images, bm, 4), np.float32)
+        ps = np.zeros((n_images, bm), np.float32)
+        pl = np.zeros((n_images, bm), np.int32)
+        pn = np.zeros((n_images,), np.int32)
+        tb = np.zeros((n_images, bm, 4), np.float32)
+        tl = np.zeros((n_images, bm), np.int32)
+        tn = np.zeros((n_images,), np.int32)
+        for i in range(n_images):
+            n = rng.randint(0, max_boxes + 1)
+            xy = rng.rand(n, 2) * 300
+            wh = rng.rand(n, 2) * 80 + 4
+            boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+            labels = rng.randint(0, n_classes, n)
+            det = boxes + rng.randn(n, 4).astype(np.float32) * 3
+            scores = rng.rand(n).astype(np.float32)
+            list_preds.append(dict(boxes=jnp.asarray(det), scores=jnp.asarray(scores), labels=jnp.asarray(labels)))
+            list_target.append(dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(labels)))
+            pb[i, :n] = det; ps[i, :n] = scores; pl[i, :n] = labels; pn[i] = n
+            # pad rows hold garbage on purpose: they must never be read
+            pb[i, n:] = -1e9
+            tb[i, :n] = boxes; tl[i, :n] = labels; tn[i] = n
+            tb[i, n:] = 7e8
+        packed_preds = dict(boxes=jnp.asarray(pb), scores=jnp.asarray(ps),
+                            labels=jnp.asarray(pl), num_boxes=jnp.asarray(pn))
+        packed_target = dict(boxes=jnp.asarray(tb), labels=jnp.asarray(tl), num_boxes=jnp.asarray(tn))
+        return list_preds, list_target, packed_preds, packed_target
+
+    def test_packed_equals_list_path(self):
+        rng = np.random.RandomState(3)
+        lp, lt, pp, pt = self._random_epoch(rng, 40)
+        m_list = MeanAveragePrecision()
+        m_list.update(lp, lt)
+        m_packed = MeanAveragePrecision()
+        m_packed.update(pp, pt)
+        out_l, out_p = m_list.compute(), m_packed.compute()
+        for k in out_l:
+            np.testing.assert_allclose(
+                np.asarray(out_l[k]), np.asarray(out_p[k]), atol=1e-7, err_msg=k
+            )
+
+    def test_packed_and_list_mix_in_one_epoch(self):
+        rng = np.random.RandomState(4)
+        lp, lt, pp, pt = self._random_epoch(rng, 24)
+        m_all_list = MeanAveragePrecision()
+        m_all_list.update(lp, lt)
+        mixed = MeanAveragePrecision()
+        mixed.update(lp[:10], lt[:10])
+        pp10 = {k: v[10:] for k, v in pp.items()}
+        pt10 = {k: v[10:] for k, v in pt.items()}
+        mixed.update(pp10, pt10)
+        out_a, out_b = m_all_list.compute(), mixed.compute()
+        for k in out_a:
+            np.testing.assert_allclose(np.asarray(out_a[k]), np.asarray(out_b[k]), atol=1e-7, err_msg=k)
+
+    def test_packed_rejects_segm_and_bad_shapes(self):
+        m = MeanAveragePrecision(iou_type="segm")
+        with pytest.raises(ValueError, match="bbox"):
+            m.update(dict(boxes=jnp.zeros((1, 2, 4)), scores=jnp.zeros((1, 2)),
+                          labels=jnp.zeros((1, 2)), num_boxes=jnp.zeros((1,))),
+                     dict(boxes=jnp.zeros((1, 2, 4)), labels=jnp.zeros((1, 2)), num_boxes=jnp.zeros((1,))))
+        m2 = MeanAveragePrecision()
+        with pytest.raises(ValueError, match="missing"):
+            m2.update(dict(boxes=jnp.zeros((1, 2, 4))), dict(boxes=jnp.zeros((1, 2, 4))))
+        with pytest.raises(ValueError, match="batch dimension"):
+            m2.update(dict(boxes=jnp.zeros((2, 3, 4)), scores=jnp.zeros((2, 3)),
+                           labels=jnp.zeros((2, 3)), num_boxes=jnp.zeros((2,))),
+                      dict(boxes=jnp.zeros((1, 3, 4)), labels=jnp.zeros((1, 3)), num_boxes=jnp.zeros((1,))))
+
+    def test_packed_cxcywh_format(self):
+        rng = np.random.RandomState(5)
+        lp, lt, pp, pt = self._random_epoch(rng, 12)
+
+        def to_cxcywh(b):
+            out = np.asarray(b).copy()
+            wh = out[..., 2:] - out[..., :2]
+            out[..., :2] = out[..., :2] + wh / 2
+            out[..., 2:] = wh
+            return jnp.asarray(out)
+
+        m_xyxy = MeanAveragePrecision()
+        m_xyxy.update(pp, pt)
+        m_c = MeanAveragePrecision(box_format="cxcywh")
+        m_c.update({**pp, "boxes": to_cxcywh(pp["boxes"])}, {**pt, "boxes": to_cxcywh(pt["boxes"])})
+        np.testing.assert_allclose(
+            np.asarray(m_xyxy.compute()["map"]), np.asarray(m_c.compute()["map"]), atol=1e-6
+        )

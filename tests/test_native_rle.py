@@ -124,3 +124,60 @@ class TestSegmMapWithRLE:
 
         for key in ("map", "map_50", "map_75", "mar_100", "map_small", "map_medium"):
             assert float(out_rle[key]) == pytest.approx(float(out_dense[key]), abs=1e-6), key
+
+
+class TestCocoMatch:
+    """C++ matcher == numpy fallback, bit-for-bit, across ragged shapes."""
+
+    @staticmethod
+    def _random_case(rng, d, g):
+        iou = rng.rand(d, g)
+        iou[rng.rand(d, g) < 0.5] = 0.0  # plenty of below-threshold entries
+        det_areas = rng.rand(d) * 10000
+        gt_areas = rng.rand(g) * 10000
+        thrs = np.linspace(0.5, 0.95, 10)
+        ranges = np.array([[0.0, 1e10], [0.0, 1024.0], [1024.0, 9216.0], [9216.0, 1e10]])
+        return iou, det_areas, gt_areas, thrs, ranges
+
+    @pytest.mark.parametrize(("d", "g"), [(0, 0), (0, 5), (5, 0), (1, 1), (7, 3), (100, 40)])
+    def test_native_equals_fallback(self, d, g):
+        from torchmetrics_tpu.native import rle_mask
+
+        rng = np.random.RandomState(d * 31 + g)
+        args = self._random_case(rng, d, g)
+        native = rle_mask.coco_match(*args)
+        lib = rle_mask._LIB
+        try:
+            rle_mask._LIB = None
+            fallback = rle_mask.coco_match(*args)
+        finally:
+            rle_mask._LIB = lib
+        for a, b, name in zip(native, fallback, ("det_matches", "det_ignore", "gt_ignore")):
+            np.testing.assert_array_equal(a, b, err_msg=name)
+
+    def test_tie_breaks_first_sorted_gt(self):
+        """Two gts with identical IoU: the first in partitioned order wins (numpy
+        argmax parity)."""
+        from torchmetrics_tpu.native import coco_match
+
+        iou = np.array([[0.9, 0.9]])
+        dm, di, gi = coco_match(iou, np.array([100.0]), np.array([100.0, 100.0]),
+                                np.array([0.5]), np.array([[0.0, 1e10]]))
+        assert dm[0, 0, 0]
+        # second det can only take the remaining gt
+        iou2 = np.vstack([iou, iou])
+        dm2, _, _ = coco_match(iou2, np.array([100.0, 100.0]), np.array([100.0, 100.0]),
+                               np.array([0.5]), np.array([[0.0, 1e10]]))
+        assert dm2[0, 0].all()
+
+    def test_ignored_gts_never_match(self):
+        from torchmetrics_tpu.native import coco_match
+
+        # single gt outside the area range: detection stays unmatched and, being
+        # itself out of range, becomes ignored
+        iou = np.array([[0.99]])
+        dm, di, gi = coco_match(iou, np.array([50000.0]), np.array([50000.0]),
+                                np.array([0.5]), np.array([[0.0, 1024.0]]))
+        assert not dm.any()
+        assert di.all()
+        assert gi.all()

@@ -8,6 +8,7 @@ works even without a toolchain.
 """
 
 from torchmetrics_tpu.native.rle_mask import (
+    coco_match,
     native_available,
     rle_area,
     rle_decode,
@@ -15,4 +16,4 @@ from torchmetrics_tpu.native.rle_mask import (
     rle_iou,
 )
 
-__all__ = ["native_available", "rle_area", "rle_decode", "rle_encode", "rle_iou"]
+__all__ = ["coco_match", "native_available", "rle_area", "rle_decode", "rle_encode", "rle_iou"]

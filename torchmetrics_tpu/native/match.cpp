@@ -1,0 +1,71 @@
+// COCO greedy detection<->ground-truth matcher, one call per (image, class).
+//
+// Replaces the per-(image, class, area, threshold) Python loops in
+// detection/mean_ap.py (reference semantics: mean_ap.py:510-635): one call
+// evaluates ALL area ranges and IoU thresholds, so the Python side makes
+// n_nonempty_pairs calls instead of n_pairs * areas * thresholds * dets numpy ops.
+//
+// Semantics pinned by tests/detection goldens (pycocotools parity):
+// - detections arrive score-sorted (stable desc) and truncated to max_det;
+// - per area range, ground truths are stably partitioned: in-range first,
+//   out-of-range (ignored) last; matching considers only unmatched, non-ignored
+//   gts; ties resolve to the lowest partitioned index (numpy argmax semantics);
+// - a detection matches the best such gt if IoU > threshold (strict);
+// - unmatched detections whose own area is out of range are marked ignored.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// iou:          (D, G) row-major; rows score-sorted, columns in original gt order
+// det_areas:    (D,)
+// gt_areas:     (G,)
+// thrs:         (T,) IoU thresholds
+// ranges:       (A, 2) [lo, hi] area ranges
+// det_matches:  (A, T, D) out, zero-initialised by caller
+// det_ignore:   (A, T, D) out, zero-initialised
+// gt_ignore:    (A, G)    out — ignore flags in the per-area partitioned order
+void coco_match(const double* iou, const double* det_areas, const double* gt_areas,
+                int64_t D, int64_t G, const double* thrs, int64_t T,
+                const double* ranges, int64_t A,
+                uint8_t* det_matches, uint8_t* det_ignore, uint8_t* gt_ignore) {
+    std::vector<int64_t> gtind(G);
+    std::vector<uint8_t> gt_matched(G);
+    for (int64_t a = 0; a < A; ++a) {
+        const double lo = ranges[2 * a], hi = ranges[2 * a + 1];
+        uint8_t* gti = gt_ignore + a * G;
+        int64_t k = 0;
+        for (int64_t g = 0; g < G; ++g)
+            if (!(gt_areas[g] < lo || gt_areas[g] > hi)) gtind[k++] = g;
+        const int64_t n_valid = k;
+        for (int64_t g = 0; g < G; ++g)
+            if (gt_areas[g] < lo || gt_areas[g] > hi) gtind[k++] = g;
+        for (int64_t g = 0; g < G; ++g) gti[g] = g >= n_valid;
+
+        for (int64_t t = 0; t < T; ++t) {
+            const double thr = thrs[t];
+            std::fill(gt_matched.begin(), gt_matched.end(), 0);
+            uint8_t* dm = det_matches + (a * T + t) * D;
+            uint8_t* di = det_ignore + (a * T + t) * D;
+            for (int64_t d = 0; d < D; ++d) {
+                const double* row = iou + d * G;
+                double best = 0.0;
+                int64_t bi = -1;
+                for (int64_t g = 0; g < n_valid; ++g) {  // ignored gts never match
+                    if (gt_matched[g]) continue;
+                    const double v = row[gtind[g]];
+                    if (bi < 0 || v > best) { best = v; bi = g; }
+                }
+                if (bi < 0 || best <= thr) continue;
+                dm[d] = 1;
+                gt_matched[bi] = 1;
+            }
+            for (int64_t d = 0; d < D; ++d)
+                if (!dm[d] && (det_areas[d] < lo || det_areas[d] > hi)) di[d] = 1;
+        }
+    }
+}
+
+}  // extern "C"

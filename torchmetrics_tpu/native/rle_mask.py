@@ -20,13 +20,26 @@ _LIB: Optional[ctypes.CDLL] = None
 _COMPILE_ATTEMPTED = False
 NATIVE_RLE_AVAILABLE = False
 
-_SRC = os.path.join(os.path.dirname(__file__), "rle.cpp")
+_SRCS = [
+    os.path.join(os.path.dirname(__file__), "rle.cpp"),
+    os.path.join(os.path.dirname(__file__), "match.cpp"),
+]
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
 
 
-def _compile_and_load() -> Optional[ctypes.CDLL]:
-    so_path = os.path.join(_BUILD_DIR, "librle.so")
+def _stale(so_path: str) -> bool:
     if not os.path.exists(so_path):
+        return True
+    try:
+        so_mtime = os.path.getmtime(so_path)
+        return any(os.path.getmtime(src) > so_mtime for src in _SRCS)
+    except OSError:  # source-stripped install: a present .so is good as-is
+        return False
+
+
+def _compile_and_load() -> Optional[ctypes.CDLL]:
+    so_path = os.path.join(_BUILD_DIR, "libnative.so")
+    if _stale(so_path):
         tmp = None
         try:
             os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -34,7 +47,7 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
             os.close(fd)
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, *_SRCS],
                 check=True, capture_output=True, timeout=120,
             )
             os.replace(tmp, so_path)
@@ -60,6 +73,12 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
     lib.rle_area.argtypes = [u32p, ctypes.c_int64]
     lib.rle_iou.restype = None
     lib.rle_iou.argtypes = [u32p, i64p, i64p, ctypes.c_int64, i64p, i64p, ctypes.c_int64, u8p, f64p]
+    lib.coco_match.restype = None
+    lib.coco_match.argtypes = [
+        f64p, f64p, f64p, ctypes.c_int64, ctypes.c_int64,
+        f64p, ctypes.c_int64, f64p, ctypes.c_int64,
+        u8p, u8p, u8p,
+    ]
     return lib
 
 
@@ -185,3 +204,79 @@ def rle_iou(
             union = da if crowd[j] else da + gm.sum() - inter
             out[i, j] = inter / union if union > 0 else 0.0
     return out
+
+
+def coco_match(
+    iou: np.ndarray,
+    det_areas: np.ndarray,
+    gt_areas: np.ndarray,
+    thresholds: np.ndarray,
+    area_ranges: np.ndarray,
+):
+    """Greedy COCO matching for one (image, class) over ALL areas x thresholds.
+
+    Args:
+        iou: ``(D, G)`` with rows score-sorted (stable desc) and truncated to the
+            largest max-det threshold; columns in original gt order.
+        det_areas / gt_areas: per-box (or per-mask) areas.
+        thresholds: ``(T,)`` IoU thresholds.
+        area_ranges: ``(A, 2)`` [lo, hi] pairs.
+
+    Returns:
+        ``(det_matches, det_ignore, gt_ignore)`` with shapes ``(A, T, D)`` /
+        ``(A, T, D)`` / ``(A, G)`` bool; gt flags are in the per-area partitioned
+        order (in-range gts first). Semantics identical to the numpy fallback —
+        see ``match.cpp`` for the pinned rules.
+    """
+    iou = np.ascontiguousarray(iou, dtype=np.float64)
+    det_areas = np.ascontiguousarray(det_areas, dtype=np.float64)
+    gt_areas = np.ascontiguousarray(gt_areas, dtype=np.float64)
+    thresholds = np.ascontiguousarray(thresholds, dtype=np.float64)
+    area_ranges = np.ascontiguousarray(area_ranges, dtype=np.float64)
+    d, g = det_areas.shape[0], gt_areas.shape[0]
+    t, a = thresholds.shape[0], area_ranges.shape[0]
+
+    lib = _lib()
+    if lib is not None:
+        det_matches = np.zeros((a, t, d), dtype=np.uint8)
+        det_ignore = np.zeros((a, t, d), dtype=np.uint8)
+        gt_ignore = np.zeros((a, g), dtype=np.uint8)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.coco_match(
+            iou.ctypes.data_as(f64p),
+            det_areas.ctypes.data_as(f64p),
+            gt_areas.ctypes.data_as(f64p),
+            ctypes.c_int64(d), ctypes.c_int64(g),
+            thresholds.ctypes.data_as(f64p), ctypes.c_int64(t),
+            area_ranges.ctypes.data_as(f64p), ctypes.c_int64(a),
+            det_matches.ctypes.data_as(u8p),
+            det_ignore.ctypes.data_as(u8p),
+            gt_ignore.ctypes.data_as(u8p),
+        )
+        return det_matches.astype(bool), det_ignore.astype(bool), gt_ignore.astype(bool)
+
+    # numpy fallback — the reference's loop semantics (mean_ap.py:510-635)
+    det_matches = np.zeros((a, t, d), dtype=bool)
+    det_ignore = np.zeros((a, t, d), dtype=bool)
+    gt_ignore_out = np.zeros((a, g), dtype=bool)
+    for ai, (lo, hi) in enumerate(area_ranges):
+        ignore = (gt_areas < lo) | (gt_areas > hi)
+        gtind = np.argsort(ignore.astype(np.uint8), kind="stable")
+        gt_ign = ignore[gtind]
+        gt_ignore_out[ai] = gt_ign
+        iou_s = iou[:, gtind] if iou.size else iou
+        for ti, thr in enumerate(thresholds):
+            gt_matched = np.zeros(g, dtype=bool)
+            for di in range(d):
+                masked = iou_s[di] * ~(gt_matched | gt_ign)
+                if masked.size == 0:
+                    continue
+                m = int(masked.argmax())
+                if masked[m] <= thr:
+                    continue
+                det_matches[ai, ti, di] = True
+                gt_matched[m] = True
+        out_of_range = (det_areas < lo) | (det_areas > hi)
+        det_ignore[ai] |= ~det_matches[ai] & out_of_range[None, :]
+    return det_matches, det_ignore, gt_ignore_out
