@@ -1,0 +1,296 @@
+"""Top-k matrices, ranking sweeps with ignore_index, and degenerate-input policies.
+
+Models the reference's edge grids (``tests/unittests/classification/test_accuracy.py``
+top-k cases, ``test_auroc.py``/``test_average_precision.py`` ignore_index cases, and
+the zero-division behavior pinned by ``utilities/compute.py`` ``_safe_divide`` +
+``_adjust_weights_safe_divide``: classes with tp+fp+fn == 0 are DROPPED from macro
+averages, not averaged in as zeros).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import roc_auc_score as sk_auroc
+
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryF1Score,
+    BinaryPrecision,
+    MulticlassAUROC,
+    MulticlassAccuracy,
+    MulticlassAveragePrecision,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+
+NC = 5
+NL = 4
+NB, BS = 4, 41
+_RNG = np.random.RandomState(23)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_mc_logits = _RNG.randn(NB, BS, NC).astype(np.float32)
+_mc_probs = _softmax(_mc_logits)
+_mc_target = _RNG.randint(0, NC, (NB, BS))
+_bin_probs = _RNG.rand(NB, BS).astype(np.float32)
+_bin_target = _RNG.randint(0, 2, (NB, BS))
+_ml_probs = _RNG.rand(NB, BS, NL).astype(np.float32)
+_ml_target = _RNG.randint(0, 2, (NB, BS, NL))
+
+
+def _inject_ignore(target, ignore_index, frac=0.15, seed=1):
+    if ignore_index is None:
+        return target
+    t = np.array(target)
+    flat = t.reshape(-1)
+    idx = np.random.RandomState(seed).choice(flat.size, int(flat.size * frac), replace=False)
+    flat[idx] = ignore_index
+    return t
+
+
+def _update_all(metric, preds, target):
+    for i in range(NB):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    return np.asarray(metric.compute())
+
+
+# ------------------------------------------------------------------ top-k matrices
+
+
+def _topk_onehot(probs, k):
+    """(N, C) one-hot of the k highest-scoring classes per row (reference
+    ``utilities/data.py select_topk``)."""
+    idx = np.argsort(-probs, axis=-1, kind="stable")[:, :k]
+    oh = np.zeros(probs.shape, dtype=int)
+    np.put_along_axis(oh, idx, 1, axis=-1)
+    return oh
+
+
+def _topk_counts(probs, target, k, ignore_index=None):
+    probs = probs.reshape(-1, NC)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        keep = target != ignore_index
+        probs, target = probs[keep], target[keep]
+    pred_oh = _topk_onehot(probs, k)
+    tgt_oh = np.zeros_like(pred_oh)
+    tgt_oh[np.arange(target.size), target] = 1
+    tp = (pred_oh & tgt_oh).sum(0)
+    fp = (pred_oh & ~tgt_oh.astype(bool)).sum(0)
+    fn = ((1 - pred_oh) & tgt_oh.astype(bool)).sum(0)
+    tn = probs.shape[0] - tp - fp - fn
+    return tp, fp, tn, fn
+
+
+def _reduce(tp, fp, tn, fn, average, kind):
+    tp, fp, tn, fn = (x.astype(np.float64) for x in (tp, fp, tn, fn))
+    if kind == "accuracy":
+        per = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+        micro = (tp.sum() + 0.0) / max((tp + fn).sum(), 1)
+    elif kind == "precision":
+        per = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), 0.0)
+        micro = tp.sum() / max((tp + fp).sum(), 1)
+    elif kind == "recall":
+        per = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+        micro = tp.sum() / max((tp + fn).sum(), 1)
+    else:  # f1
+        per = np.where(2 * tp + fp + fn > 0, 2 * tp / np.maximum(2 * tp + fp + fn, 1), 0.0)
+        micro = 2 * tp.sum() / max((2 * tp + fp + fn).sum(), 1)
+    if average == "micro":
+        return micro
+    support_mask = (tp + fp + fn) > 0  # reference drops dead classes from macro
+    if average == "macro":
+        return per[support_mask].mean()
+    if average == "weighted":
+        w = tp + fn
+        return (per * w).sum() / max(w.sum(), 1)
+    return per
+
+
+@pytest.mark.parametrize("k", [1, 2, 3])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize(
+    ("metric_cls", "kind"),
+    [
+        (MulticlassAccuracy, "accuracy"),
+        (MulticlassPrecision, "precision"),
+        (MulticlassRecall, "recall"),
+        (MulticlassF1Score, "f1"),
+    ],
+)
+def test_multiclass_topk_matrix(k, average, ignore_index, metric_cls, kind):
+    target = _inject_ignore(_mc_target, ignore_index)
+    m = metric_cls(num_classes=NC, top_k=k, average=average, ignore_index=ignore_index)
+    got = float(_update_all(m, _mc_probs, target))
+    tp, fp, tn, fn = _topk_counts(_mc_probs, target, k, ignore_index)
+    want = _reduce(tp, fp, tn, fn, average, kind)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_topk_equals_k_classes_is_perfect_recall():
+    m = MulticlassAccuracy(num_classes=NC, top_k=NC, average="micro")
+    got = float(_update_all(m, _mc_probs, _mc_target))
+    np.testing.assert_allclose(got, 1.0, atol=1e-7)
+
+
+# ------------------------------------------------------------------ ranking sweeps
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_binary_auroc_ignore_index(ignore_index):
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryAUROC(thresholds=None, ignore_index=ignore_index)
+    got = float(_update_all(m, _bin_probs, target))
+    p, t = _bin_probs.reshape(-1), target.reshape(-1)
+    if ignore_index is not None:
+        keep = t != ignore_index
+        p, t = p[keep], t[keep]
+    np.testing.assert_allclose(got, sk_auroc(t, p), atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_binary_average_precision_ignore_index(ignore_index):
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryAveragePrecision(thresholds=None, ignore_index=ignore_index)
+    got = float(_update_all(m, _bin_probs, target))
+    p, t = _bin_probs.reshape(-1), target.reshape(-1)
+    if ignore_index is not None:
+        keep = t != ignore_index
+        p, t = p[keep], t[keep]
+    np.testing.assert_allclose(got, sk_ap(t, p), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_multiclass_auroc_matrix(average, ignore_index):
+    target = _inject_ignore(_mc_target, ignore_index, seed=2)
+    m = MulticlassAUROC(num_classes=NC, average=average, thresholds=None, ignore_index=ignore_index)
+    got = float(_update_all(m, _mc_probs, target))
+    p, t = _mc_probs.reshape(-1, NC), target.reshape(-1)
+    if ignore_index is not None:
+        keep = t != ignore_index
+        p, t = p[keep], t[keep]
+    want = sk_auroc(t, p, multi_class="ovr", average=average, labels=list(range(NC)))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multiclass_average_precision_matrix(average):
+    m = MulticlassAveragePrecision(num_classes=NC, average=average, thresholds=None)
+    got = _update_all(m, _mc_probs, _mc_target)
+    p, t = _mc_probs.reshape(-1, NC), _mc_target.reshape(-1)
+    per = np.asarray([sk_ap((t == c).astype(int), p[:, c]) for c in range(NC)])
+    want = per.mean() if average == "macro" else per
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "micro", "none"])
+def test_multilabel_average_precision_matrix(average):
+    m = MultilabelAveragePrecision(num_labels=NL, average=average, thresholds=None)
+    got = _update_all(m, _ml_probs, _ml_target)
+    p, t = _ml_probs.reshape(-1, NL), _ml_target.reshape(-1, NL)
+    if average == "micro":
+        want = sk_ap(t.ravel(), p.ravel())
+    else:
+        per = np.asarray([sk_ap(t[:, c], p[:, c]) for c in range(NL)])
+        want = per.mean() if average == "macro" else per
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "micro"])
+def test_multilabel_auroc_matrix(average):
+    m = MultilabelAUROC(num_labels=NL, average=average, thresholds=None)
+    got = float(_update_all(m, _ml_probs, _ml_target))
+    p, t = _ml_probs.reshape(-1, NL), _ml_target.reshape(-1, NL)
+    if average == "micro":
+        want = sk_auroc(t.ravel(), p.ravel())
+    else:
+        want = np.mean([sk_auroc(t[:, c], p[:, c]) for c in range(NL)])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ------------------------------------------------------------------ degenerate inputs
+
+
+def test_absent_class_dropped_from_macro():
+    """A class never predicted and never true is dropped from the macro mean, not
+    averaged in as zero (reference ``_adjust_weights_safe_divide``)."""
+    preds = np.array([0, 1, 0, 1])
+    target = np.array([0, 1, 0, 1])
+    m = MulticlassPrecision(num_classes=3, average="macro")
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-7)
+
+
+def test_zero_division_is_zero_not_nan():
+    """All-negative target with all-negative preds: precision 0/0 -> 0.0."""
+    m = BinaryPrecision()
+    m.update(jnp.zeros(8), jnp.zeros(8, dtype=jnp.int32))
+    got = float(m.compute())
+    assert got == 0.0 and np.isfinite(got)
+
+    f = BinaryF1Score()
+    f.update(jnp.zeros(8), jnp.zeros(8, dtype=jnp.int32))
+    assert float(f.compute()) == 0.0
+
+
+def test_compute_without_update_warns():
+    m = MulticlassAccuracy(num_classes=3)
+    with pytest.warns(UserWarning, match="before the ``update``"):
+        m.compute()
+
+
+def test_all_ignored_batch_is_neutral():
+    """A batch whose targets are ALL ignore_index must not change the result."""
+    a = MulticlassF1Score(num_classes=NC, average="macro", ignore_index=-1)
+    b = MulticlassF1Score(num_classes=NC, average="macro", ignore_index=-1)
+    a.update(jnp.asarray(_mc_probs[0]), jnp.asarray(_mc_target[0]))
+    b.update(jnp.asarray(_mc_probs[0]), jnp.asarray(_mc_target[0]))
+    b.update(jnp.asarray(_mc_probs[1]), jnp.asarray(np.full((BS,), -1)))
+    np.testing.assert_allclose(float(a.compute()), float(b.compute()), atol=1e-7)
+
+
+def test_single_sample_updates_accumulate():
+    """Streaming one sample at a time equals one big batch."""
+    whole = MulticlassRecall(num_classes=NC, average="macro")
+    whole.update(jnp.asarray(_mc_probs[0]), jnp.asarray(_mc_target[0]))
+    stream = MulticlassRecall(num_classes=NC, average="macro")
+    for i in range(BS):
+        stream.update(jnp.asarray(_mc_probs[0, i : i + 1]), jnp.asarray(_mc_target[0, i : i + 1]))
+    np.testing.assert_allclose(float(whole.compute()), float(stream.compute()), atol=1e-7)
+
+
+def test_perfect_and_inverted_predictions():
+    perfect = MulticlassF1Score(num_classes=3, average="macro")
+    perfect.update(jnp.asarray([0, 1, 2, 0]), jnp.asarray([0, 1, 2, 0]))
+    np.testing.assert_allclose(float(perfect.compute()), 1.0, atol=1e-7)
+
+    inverted = BinaryF1Score()
+    inverted.update(jnp.asarray([1, 1, 0, 0]), jnp.asarray([0, 0, 1, 1]))
+    np.testing.assert_allclose(float(inverted.compute()), 0.0, atol=1e-7)
+
+
+def test_auroc_single_class_target_is_degenerate():
+    """AUROC with only one class present: reference warns and returns 0."""
+    m = BinaryAUROC(thresholds=None)
+    m.update(jnp.asarray([0.1, 0.8, 0.4]), jnp.asarray([1, 1, 1]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = float(m.compute())
+    assert np.isfinite(got)

@@ -1,0 +1,294 @@
+"""Reference-scale parametrization matrices for the confmat-derivative families.
+
+Models the reference's per-family case grids (``tests/unittests/classification/
+test_confusion_matrix.py``, ``test_jaccard.py``, ``test_cohen_kappa.py``,
+``test_matthews_corrcoef.py``, ``test_hamming_distance.py``): input kind x
+ignore_index x average/normalize, all checked against sklearn on the masked,
+host-formatted data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from sklearn.metrics import cohen_kappa_score as sk_kappa
+from sklearn.metrics import confusion_matrix as sk_confmat
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+
+from torchmetrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryHammingDistance,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassHammingDistance,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelConfusionMatrix,
+    MultilabelHammingDistance,
+    MultilabelJaccardIndex,
+    MultilabelMatthewsCorrCoef,
+)
+
+NC = 5
+NL = 4
+NB, BS = 4, 33
+_RNG = np.random.RandomState(11)
+
+_bin_probs = _RNG.rand(NB, BS).astype(np.float32)
+_bin_logits = _RNG.randn(NB, BS).astype(np.float32)
+_bin_labels = _RNG.randint(0, 2, (NB, BS))
+_bin_target = _RNG.randint(0, 2, (NB, BS))
+
+_mc_logits = _RNG.randn(NB, BS, NC).astype(np.float32)
+_mc_labels = _RNG.randint(0, NC, (NB, BS))
+_mc_target = _RNG.randint(0, NC, (NB, BS))
+
+_ml_probs = _RNG.rand(NB, BS, NL).astype(np.float32)
+_ml_labels = _RNG.randint(0, 2, (NB, BS, NL))
+_ml_target = _RNG.randint(0, 2, (NB, BS, NL))
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _inject_ignore(target, ignore_index, frac=0.12, seed=0):
+    if ignore_index is None:
+        return target
+    t = np.array(target)
+    flat = t.reshape(-1)
+    idx = np.random.RandomState(seed).choice(flat.size, int(flat.size * frac), replace=False)
+    flat[idx] = ignore_index
+    return t
+
+
+def _mask(hard, target, ignore_index):
+    hard = np.asarray(hard).reshape(-1)
+    target = np.asarray(target).reshape(-1)
+    if ignore_index is None:
+        return hard, target
+    keep = target != ignore_index
+    return hard[keep], target[keep]
+
+
+def _update_all(metric, preds, target):
+    for i in range(NB):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    return np.asarray(metric.compute())
+
+
+def _bin_hard(kind):
+    if kind == "labels":
+        return _bin_labels
+    p = _sigmoid(_bin_logits) if kind == "logits" else _bin_probs
+    return (p > 0.5).astype(int)
+
+
+# ------------------------------------------------------------------ confusion matrix
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+def test_binary_confusion_matrix_matrix(kind, ignore_index, normalize):
+    preds = {"probs": _bin_probs, "logits": _bin_logits, "labels": _bin_labels}[kind]
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryConfusionMatrix(ignore_index=ignore_index, normalize=normalize)
+    got = _update_all(m, preds, target)
+
+    hard, t = _mask(_bin_hard(kind), target, ignore_index)
+    want = sk_confmat(t, hard, labels=[0, 1]).astype(np.float64)
+    if normalize == "true":
+        want = want / np.maximum(want.sum(1, keepdims=True), 1e-12)
+    elif normalize == "pred":
+        want = want / np.maximum(want.sum(0, keepdims=True), 1e-12)
+    elif normalize == "all":
+        want = want / max(want.sum(), 1e-12)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1, 2])
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+def test_multiclass_confusion_matrix_matrix(ignore_index, normalize):
+    # ignore_index=2 (a VALID class id) must drop those samples entirely
+    target = _inject_ignore(_mc_target, ignore_index, seed=3)
+    m = MulticlassConfusionMatrix(num_classes=NC, ignore_index=ignore_index, normalize=normalize)
+    got = _update_all(m, _mc_logits, target)
+
+    hard, t = _mask(_mc_logits.argmax(-1), target, ignore_index)
+    want = sk_confmat(t, hard, labels=list(range(NC))).astype(np.float64)
+    if normalize == "true":
+        sums = want.sum(1, keepdims=True)
+    elif normalize == "pred":
+        sums = want.sum(0, keepdims=True)
+    elif normalize == "all":
+        sums = np.asarray(want.sum())
+    else:
+        sums = None
+    if sums is not None:
+        want = want / np.where(np.asarray(sums) == 0, 1.0, sums)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_multilabel_confusion_matrix_matrix(ignore_index):
+    target = _inject_ignore(_ml_target, ignore_index, seed=5)
+    m = MultilabelConfusionMatrix(num_labels=NL, ignore_index=ignore_index)
+    got = _update_all(m, _ml_probs, target)
+    hard = (_ml_probs > 0.5).astype(int).reshape(-1, NL)
+    t = target.reshape(-1, NL)
+    for lab in range(NL):
+        h, tt = _mask(hard[:, lab], t[:, lab], ignore_index)
+        want = sk_confmat(tt, h, labels=[0, 1])
+        np.testing.assert_allclose(got[lab], want, atol=1e-6, err_msg=f"label {lab}")
+
+
+# ------------------------------------------------------------------ jaccard
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_binary_jaccard_matrix(kind, ignore_index):
+    preds = {"probs": _bin_probs, "logits": _bin_logits, "labels": _bin_labels}[kind]
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryJaccardIndex(ignore_index=ignore_index)
+    got = float(_update_all(m, preds, target))
+    hard, t = _mask(_bin_hard(kind), target, ignore_index)
+    want = sk_jaccard(t, hard, zero_division=0)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_multiclass_jaccard_matrix(ignore_index, average):
+    target = _inject_ignore(_mc_target, ignore_index, seed=7)
+    m = MulticlassJaccardIndex(num_classes=NC, average=average, ignore_index=ignore_index)
+    got = _update_all(m, _mc_logits, target)
+    hard, t = _mask(_mc_logits.argmax(-1), target, ignore_index)
+    avg = None if average == "none" else average
+    want = sk_jaccard(t, hard, labels=list(range(NC)), average=avg, zero_division=0)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "none"])
+def test_multilabel_jaccard_matrix(average):
+    m = MultilabelJaccardIndex(num_labels=NL, average=average)
+    got = _update_all(m, _ml_probs, _ml_target)
+    hard = (_ml_probs > 0.5).astype(int).reshape(-1, NL)
+    t = _ml_target.reshape(-1, NL)
+    avg = None if average == "none" else average
+    want = sk_jaccard(t, hard, average=avg, zero_division=0)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+# ------------------------------------------------------------------ cohen kappa
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_binary_cohen_kappa_matrix(kind, ignore_index, weights):
+    preds = {"probs": _bin_probs, "logits": _bin_logits, "labels": _bin_labels}[kind]
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryCohenKappa(ignore_index=ignore_index, weights=weights)
+    got = float(_update_all(m, preds, target))
+    hard, t = _mask(_bin_hard(kind), target, ignore_index)
+    want = sk_kappa(t, hard, weights=weights)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_multiclass_cohen_kappa_matrix(ignore_index, weights):
+    target = _inject_ignore(_mc_target, ignore_index, seed=9)
+    m = MulticlassCohenKappa(num_classes=NC, ignore_index=ignore_index, weights=weights)
+    got = float(_update_all(m, _mc_logits, target))
+    hard, t = _mask(_mc_logits.argmax(-1), target, ignore_index)
+    want = sk_kappa(t, hard, labels=list(range(NC)), weights=weights)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ------------------------------------------------------------------ matthews
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_binary_matthews_matrix(kind, ignore_index):
+    preds = {"probs": _bin_probs, "logits": _bin_logits, "labels": _bin_labels}[kind]
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryMatthewsCorrCoef(ignore_index=ignore_index)
+    got = float(_update_all(m, preds, target))
+    hard, t = _mask(_bin_hard(kind), target, ignore_index)
+    np.testing.assert_allclose(got, sk_matthews(t, hard), atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_multiclass_matthews_matrix(ignore_index):
+    target = _inject_ignore(_mc_target, ignore_index, seed=13)
+    m = MulticlassMatthewsCorrCoef(num_classes=NC, ignore_index=ignore_index)
+    got = float(_update_all(m, _mc_logits, target))
+    hard, t = _mask(_mc_logits.argmax(-1), target, ignore_index)
+    np.testing.assert_allclose(got, sk_matthews(t, hard), atol=1e-6)
+
+
+def test_multilabel_matthews_matrix():
+    """Reference multilabel MCC folds every label into one global 2x2 confmat —
+    equals binary MCC over the flattened label matrix."""
+    m = MultilabelMatthewsCorrCoef(num_labels=NL)
+    got = float(_update_all(m, _ml_probs, _ml_target))
+    hard = (_ml_probs > 0.5).astype(int).reshape(-1)
+    want = sk_matthews(_ml_target.reshape(-1), hard)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ------------------------------------------------------------------ hamming
+
+
+@pytest.mark.parametrize("kind", ["probs", "logits", "labels"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_binary_hamming_matrix(kind, ignore_index):
+    preds = {"probs": _bin_probs, "logits": _bin_logits, "labels": _bin_labels}[kind]
+    target = _inject_ignore(_bin_target, ignore_index)
+    m = BinaryHammingDistance(ignore_index=ignore_index)
+    got = float(_update_all(m, preds, target))
+    hard, t = _mask(_bin_hard(kind), target, ignore_index)
+    np.testing.assert_allclose(got, (hard != t).mean(), atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multiclass_hamming_matrix(ignore_index, average):
+    """Hamming distance == 1 - accuracy under the same averaging (reference
+    ``functional/classification/hamming.py`` reduce)."""
+    target = _inject_ignore(_mc_target, ignore_index, seed=17)
+    m = MulticlassHammingDistance(num_classes=NC, average=average, ignore_index=ignore_index)
+    got = float(_update_all(m, _mc_logits, target))
+    hard, t = _mask(_mc_logits.argmax(-1), target, ignore_index)
+    if average == "micro":
+        want = (hard != t).mean()
+    else:  # macro: 1 - mean per-class recall
+        recalls = [((hard == c) & (t == c)).sum() / max((t == c).sum(), 1) for c in range(NC)]
+        want = 1.0 - np.mean(recalls)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "none"])
+def test_multilabel_hamming_matrix(average):
+    m = MultilabelHammingDistance(num_labels=NL, average=average)
+    got = _update_all(m, _ml_probs, _ml_target)
+    hard = (_ml_probs > 0.5).astype(int).reshape(-1, NL)
+    t = _ml_target.reshape(-1, NL)
+    per_label = (hard != t).mean(axis=0)
+    if average == "micro":
+        want = (hard != t).mean()
+    elif average == "macro":
+        want = per_label.mean()
+    else:
+        want = per_label
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)

@@ -1,0 +1,274 @@
+"""Sweeps for the remaining classification families: calibration error, hinge,
+exact match, dice, curves (multiclass/multilabel ROC & PR), and ranking.
+
+Goldens are hand-rolled numpy implementations of the reference definitions
+(``functional/classification/{calibration_error,hinge,exact_match,ranking}.py``)
+plus sklearn for the curve point sets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_curve as sk_roc
+
+from torchmetrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryHingeLoss,
+    Dice,
+    MulticlassCalibrationError,
+    MulticlassExactMatch,
+    MulticlassHingeLoss,
+    MulticlassPrecisionRecallCurve,
+    MulticlassROC,
+    MultilabelCoverageError,
+    MultilabelExactMatch,
+    MultilabelPrecisionRecallCurve,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+    MultilabelROC,
+)
+
+NC = 5
+NL = 4
+N = 170
+_RNG = np.random.RandomState(43)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_bin_probs = _RNG.rand(N).astype(np.float64)
+_bin_target = _RNG.randint(0, 2, N)
+_mc_probs = _softmax(_RNG.randn(N, NC)).astype(np.float64)
+_mc_target = _RNG.randint(0, NC, N)
+_ml_probs = _RNG.rand(N, NL).astype(np.float64)
+_ml_target = _RNG.randint(0, 2, (N, NL))
+
+
+# ------------------------------------------------------------------ calibration error
+
+
+def _golden_ece(confidences, accuracies, n_bins, norm):
+    """Reference binning: uniform bins over [0, 1], boundary by torch.bucketize
+    semantics (``calibration_error.py _binning_bucketize``)."""
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.digitize(confidences, bins[1:-1], right=False)
+    ece_terms = []
+    for b in range(n_bins):
+        sel = idx == b
+        if not sel.any():
+            continue
+        prop = sel.mean()
+        conf = confidences[sel].mean()
+        acc = accuracies[sel].mean()
+        ece_terms.append((abs(acc - conf), prop))
+    if norm == "l1":
+        return sum(d * p for d, p in ece_terms)
+    if norm == "max":
+        return max(d for d, _ in ece_terms)
+    return np.sqrt(sum(d * d * p for d, p in ece_terms))
+
+
+@pytest.mark.parametrize("n_bins", [10, 15, 30])
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_binary_calibration_error_matrix(n_bins, norm):
+    m = BinaryCalibrationError(n_bins=n_bins, norm=norm)
+    m.update(jnp.asarray(_bin_probs), jnp.asarray(_bin_target))
+    got = float(m.compute())
+    # reference binary semantics: confidence = p(positive), accuracy = target
+    # (calibration_error.py:134-136) — NOT top-label confidence/correctness; the
+    # two agree under l1/l2 by mirror symmetry but differ for max
+    want = _golden_ece(_bin_probs, _bin_target.astype(float), n_bins, norm)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("n_bins", [10, 20])
+@pytest.mark.parametrize("norm", ["l1", "max"])
+def test_multiclass_calibration_error_matrix(n_bins, norm):
+    m = MulticlassCalibrationError(num_classes=NC, n_bins=n_bins, norm=norm)
+    m.update(jnp.asarray(_mc_probs), jnp.asarray(_mc_target))
+    got = float(m.compute())
+    conf = _mc_probs.max(-1)
+    acc = (_mc_probs.argmax(-1) == _mc_target).astype(float)
+    want = _golden_ece(conf, acc, n_bins, norm)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_perfectly_calibrated_one_hot_is_zero():
+    onehot = np.eye(NC)[_mc_target]
+    m = MulticlassCalibrationError(num_classes=NC, n_bins=10, norm="l1")
+    m.update(jnp.asarray(onehot), jnp.asarray(_mc_target))
+    np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-6)
+
+
+# ------------------------------------------------------------------ hinge
+
+
+@pytest.mark.parametrize("squared", [False, True])
+def test_binary_hinge_matrix(squared):
+    """Reference formats logits through sigmoid first (confusion_matrix format with
+    convert_to_labels=False); the margin is computed on the PROBABILITY."""
+    logits = _RNG.randn(N)
+    m = BinaryHingeLoss(squared=squared)
+    m.update(jnp.asarray(logits), jnp.asarray(_bin_target))
+    got = float(m.compute())
+    p = 1.0 / (1.0 + np.exp(-logits))
+    margin = np.where(_bin_target == 1, p, -p)
+    measures = np.maximum(1 - margin, 0.0)
+    want = (measures**2 if squared else measures).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("multiclass_mode", ["crammer-singer", "one-vs-all"])
+def test_multiclass_hinge_matrix(multiclass_mode):
+    """Logits are softmaxed by the format stage; margins computed on probabilities."""
+    logits = _RNG.randn(N, NC)
+    m = MulticlassHingeLoss(num_classes=NC, multiclass_mode=multiclass_mode)
+    m.update(jnp.asarray(logits), jnp.asarray(_mc_target))
+    got = np.asarray(m.compute())
+    probs = _softmax(logits)
+    if multiclass_mode == "crammer-singer":
+        true_score = probs[np.arange(N), _mc_target]
+        masked = probs.copy()
+        masked[np.arange(N), _mc_target] = -np.inf
+        best_other = masked.max(-1)
+        want = np.maximum(1 - (true_score - best_other), 0).mean()
+    else:  # reference one-vs-all returns a per-class vector
+        t = np.full((N, NC), -1.0)
+        t[np.arange(N), _mc_target] = 1.0
+        want = np.maximum(1 - t * probs, 0).mean(0)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6)
+
+
+# ------------------------------------------------------------------ exact match
+
+
+@pytest.mark.parametrize("multidim_average", ["global", "samplewise"])
+def test_multiclass_exact_match_matrix(multidim_average):
+    extra = 7
+    preds = _RNG.randint(0, NC, (N, extra))
+    target = preds.copy()
+    flip = _RNG.rand(N, extra) < 0.3
+    target[flip] = _RNG.randint(0, NC, flip.sum())
+    m = MulticlassExactMatch(num_classes=NC, multidim_average=multidim_average)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    got = np.asarray(m.compute())
+    rows_equal = (preds == target).all(axis=1)
+    want = rows_equal.astype(float) if multidim_average == "samplewise" else rows_equal.mean()
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+def test_multilabel_exact_match_matrix():
+    m = MultilabelExactMatch(num_labels=NL)
+    m.update(jnp.asarray(_ml_probs), jnp.asarray(_ml_target))
+    got = float(m.compute())
+    hard = (_ml_probs > 0.5).astype(int)
+    want = (hard == _ml_target).all(axis=1).mean()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ------------------------------------------------------------------ dice
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+def test_dice_matrix(average, ignore_index):
+    preds = _RNG.randint(0, NC, N)
+    target = _RNG.randint(0, NC, N)
+    m = Dice(num_classes=NC, average=average, ignore_index=ignore_index)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    got = float(m.compute())
+
+    classes = [c for c in range(NC) if c != ignore_index]
+    tp = np.asarray([((preds == c) & (target == c)).sum() for c in classes], float)
+    fp = np.asarray([((preds == c) & (target != c)).sum() for c in classes], float)
+    fn = np.asarray([((preds != c) & (target == c)).sum() for c in classes], float)
+    if average == "micro":
+        want = 2 * tp.sum() / max(2 * tp.sum() + fp.sum() + fn.sum(), 1)
+    else:
+        per = np.where(2 * tp + fp + fn > 0, 2 * tp / np.maximum(2 * tp + fp + fn, 1), np.nan)
+        want = np.nanmean(per)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ------------------------------------------------------------------ curves (exact)
+
+
+def test_multiclass_roc_points_vs_sklearn():
+    m = MulticlassROC(num_classes=NC, thresholds=None)
+    m.update(jnp.asarray(_mc_probs), jnp.asarray(_mc_target))
+    fprs, tprs, _ = m.compute()
+    for c in range(NC):
+        fpr_sk, tpr_sk, _ = sk_roc((_mc_target == c).astype(int), _mc_probs[:, c])
+        # same curve as point sets (threshold conventions differ at the ends)
+        got = set(zip(np.round(np.asarray(fprs[c]), 6), np.round(np.asarray(tprs[c]), 6)))
+        want = set(zip(np.round(fpr_sk, 6), np.round(tpr_sk, 6)))
+        assert want <= got | want, f"class {c}"
+        assert got >= want - {(0.0, 0.0)}, f"class {c}"
+
+
+def test_multilabel_pr_curve_points_vs_sklearn():
+    m = MultilabelPrecisionRecallCurve(num_labels=NL, thresholds=None)
+    m.update(jnp.asarray(_ml_probs), jnp.asarray(_ml_target))
+    precs, recs, _ = m.compute()
+    for c in range(NL):
+        p_sk, r_sk, _ = sk_prc(_ml_target[:, c], _ml_probs[:, c])
+        got = set(zip(np.round(np.asarray(precs[c]), 6), np.round(np.asarray(recs[c]), 6)))
+        want = set(zip(np.round(p_sk, 6), np.round(r_sk, 6)))
+        assert len(want - got) <= 1, f"label {c}: {sorted(want - got)[:4]}"
+
+
+@pytest.mark.parametrize("n_thresholds", [20, 100])
+def test_binned_curves_converge_to_exact(n_thresholds):
+    """Binned AUROC approaches the exact value as thresholds densify."""
+    from torchmetrics_tpu.classification import MulticlassAUROC
+
+    exact = MulticlassAUROC(num_classes=NC, thresholds=None)
+    exact.update(jnp.asarray(_mc_probs), jnp.asarray(_mc_target))
+    binned = MulticlassAUROC(num_classes=NC, thresholds=n_thresholds)
+    binned.update(jnp.asarray(_mc_probs), jnp.asarray(_mc_target))
+    tol = 0.05 if n_thresholds == 20 else 0.01
+    np.testing.assert_allclose(float(binned.compute()), float(exact.compute()), atol=tol)
+
+
+def test_multilabel_auroc_binned_equals_exact_on_own_scores():
+    """Thresholds taken from the observed score values: binned AUROC == exact
+    (same floats on both sides, so no grid-quantisation slack)."""
+    from torchmetrics_tpu.classification import MultilabelAUROC
+
+    scores = np.round(_ml_probs * 20) / 20 * 0.9 + 0.05  # keep strictly inside (0, 1)
+    # the grid needs one threshold above every score so the binned curve reaches
+    # (0, 0) like the exact one (whose implicit top threshold is +inf)
+    thresholds = jnp.asarray(np.concatenate([np.unique(scores), [1.0]]))
+    exact = MultilabelAUROC(num_labels=NL, average="macro", thresholds=None)
+    exact.update(jnp.asarray(scores), jnp.asarray(_ml_target))
+    binned = MultilabelAUROC(num_labels=NL, average="macro", thresholds=thresholds)
+    binned.update(jnp.asarray(scores), jnp.asarray(_ml_target))
+    np.testing.assert_allclose(float(binned.compute()), float(exact.compute()), atol=1e-6)
+
+
+# ------------------------------------------------------------------ ranking
+
+
+def test_ranking_metrics_vs_sklearn():
+    from sklearn.metrics import coverage_error as sk_cov
+    from sklearn.metrics import label_ranking_average_precision_score as sk_lrap
+    from sklearn.metrics import label_ranking_loss as sk_rloss
+
+    for cls, sk_fn in [
+        (MultilabelCoverageError, sk_cov),
+        (MultilabelRankingAveragePrecision, sk_lrap),
+        (MultilabelRankingLoss, sk_rloss),
+    ]:
+        m = cls(num_labels=NL)
+        for chunk_p, chunk_t in zip(np.array_split(_ml_probs, 3), np.array_split(_ml_target, 3)):
+            m.update(jnp.asarray(chunk_p), jnp.asarray(chunk_t))
+        got = float(m.compute())
+        want = sk_fn(_ml_target, _ml_probs)
+        np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=cls.__name__)

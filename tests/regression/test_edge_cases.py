@@ -1,0 +1,203 @@
+"""Degenerate-input and streaming-equivalence sweeps for regression + aggregation.
+
+Models the reference's edge coverage (``tests/unittests/regression/*``,
+``tests/unittests/bases/test_aggregation.py``): constant inputs, single samples,
+perfect fits, NaN policies across every aggregator, and stream-vs-batch equality
+for every streaming-state metric.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+)
+
+_RNG = np.random.RandomState(31)
+
+
+# ------------------------------------------------------------------ stream == batch
+
+
+_STREAMING_METRICS = [
+    (MeanAbsoluteError, {}),
+    (MeanSquaredError, {}),
+    (MeanAbsolutePercentageError, {}),
+    (PearsonCorrCoef, {}),
+    (ConcordanceCorrCoef, {}),
+    (ExplainedVariance, {}),
+    (R2Score, {}),
+    (CosineSimilarity, {}),
+    (SpearmanCorrCoef, {}),
+    (KendallRankCorrCoef, {}),
+]
+
+
+@pytest.mark.parametrize(("metric_cls", "kwargs"), _STREAMING_METRICS)
+@pytest.mark.parametrize("n_chunks", [1, 3, 7])
+def test_stream_equals_batch(metric_cls, kwargs, n_chunks):
+    n = 63
+    if metric_cls is CosineSimilarity:
+        preds = _RNG.randn(n, 5).astype(np.float64)
+        target = _RNG.randn(n, 5).astype(np.float64)
+    else:
+        preds = _RNG.randn(n).astype(np.float64)
+        target = (0.7 * preds + 0.3 * _RNG.randn(n)).astype(np.float64)
+    if metric_cls is MeanAbsolutePercentageError:
+        target = np.abs(target) + 0.5
+
+    whole = metric_cls(**kwargs)
+    whole.update(jnp.asarray(preds), jnp.asarray(target))
+    want = np.asarray(whole.compute())
+
+    stream = metric_cls(**kwargs)
+    for chunk_p, chunk_t in zip(np.array_split(preds, n_chunks), np.array_split(target, n_chunks)):
+        stream.update(jnp.asarray(chunk_p), jnp.asarray(chunk_t))
+    got = np.asarray(stream.compute())
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-9)
+
+
+# ------------------------------------------------------------------ degenerate inputs
+
+
+def test_perfect_fit_values():
+    x = jnp.asarray(_RNG.randn(32))
+    for cls, expected in [
+        (MeanAbsoluteError, 0.0),
+        (MeanSquaredError, 0.0),
+        (R2Score, 1.0),
+        (ExplainedVariance, 1.0),
+        (PearsonCorrCoef, 1.0),
+        (ConcordanceCorrCoef, 1.0),
+        (SpearmanCorrCoef, 1.0),
+        (KendallRankCorrCoef, 1.0),
+    ]:
+        m = cls()
+        m.update(x, x)
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6, err_msg=cls.__name__)
+
+
+def test_anticorrelated_is_minus_one():
+    x = jnp.asarray(_RNG.randn(32))
+    for cls in (PearsonCorrCoef, SpearmanCorrCoef, KendallRankCorrCoef):
+        m = cls()
+        m.update(x, -x)
+        np.testing.assert_allclose(float(m.compute()), -1.0, atol=1e-6, err_msg=cls.__name__)
+
+
+def test_constant_target_correlations_are_not_inf():
+    """Zero-variance target: correlation is undefined; result must be finite/NaN,
+    never +-inf (safe-divide posture)."""
+    preds = jnp.asarray(_RNG.randn(16))
+    const = jnp.ones(16)
+    for cls in (PearsonCorrCoef, SpearmanCorrCoef):
+        m = cls()
+        m.update(preds, const)
+        got = float(m.compute())
+        assert not np.isinf(got), cls.__name__
+
+
+def test_single_sample_mae_mse():
+    for cls, expected in [(MeanAbsoluteError, 2.0), (MeanSquaredError, 4.0)]:
+        m = cls()
+        m.update(jnp.asarray([3.0]), jnp.asarray([1.0]))
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-7)
+
+
+def test_mse_squared_false_is_rmse():
+    preds = _RNG.randn(40)
+    target = _RNG.randn(40)
+    m = MeanSquaredError(squared=False)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(
+        float(m.compute()), np.sqrt(np.mean((preds - target) ** 2)), rtol=1e-6
+    )
+
+
+def test_r2_insufficient_samples_raises():
+    """Reference ``r2.py`` demands >= 2 samples."""
+    m = R2Score()
+    m.update(jnp.asarray([1.0]), jnp.asarray([1.0]))
+    with pytest.raises(ValueError, match="at least two samples"):
+        m.compute()
+
+
+# ------------------------------------------------------------------ aggregation NaN policies
+
+
+_AGGS = [(MeanMetric, 2.0), (SumMetric, 4.0), (MaxMetric, 3.0), (MinMetric, 1.0)]
+
+
+@pytest.mark.parametrize(("cls", "want_ignore"), _AGGS)
+def test_nan_ignore_policy(cls, want_ignore):
+    m = cls(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    np.testing.assert_allclose(float(m.compute()), want_ignore, atol=1e-7)
+
+
+@pytest.mark.parametrize(("cls", "_"), _AGGS)
+def test_nan_error_policy(cls, _):
+    m = cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="[Nn]an"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+@pytest.mark.parametrize(("cls", "want"), _AGGS)
+def test_nan_warn_policy_warns_then_ignores(cls, want):
+    """Reference 'warn' == 'ignore' + a warning (aggregation.py nan check)."""
+    m = cls(nan_strategy="warn")
+    with pytest.warns(UserWarning):
+        m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-7)
+
+
+def test_nan_replace_policy():
+    m = SumMetric(nan_strategy=7.0)
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    np.testing.assert_allclose(float(m.compute()), 10.0, atol=1e-7)
+
+
+def test_cat_metric_nan_ignore_drops_elements():
+    m = CatMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    m.update(jnp.asarray([4.0]))
+    got = np.asarray(m.compute())
+    np.testing.assert_allclose(got, [1.0, 3.0, 4.0], atol=1e-7)
+
+
+def test_empty_update_then_compute():
+    """Aggregators with no updates return their neutral default without crashing."""
+    import warnings
+
+    for cls in (MeanMetric, SumMetric):
+        m = cls()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got = float(m.compute())
+        assert np.isfinite(got) or np.isnan(got)
+
+
+def test_mean_metric_weighted_stream_equals_batch():
+    vals = _RNG.rand(30)
+    w = _RNG.rand(30) + 0.1
+    whole = MeanMetric()
+    whole.update(jnp.asarray(vals), jnp.asarray(w))
+    stream = MeanMetric()
+    for v_c, w_c in zip(np.array_split(vals, 4), np.array_split(w, 4)):
+        stream.update(jnp.asarray(v_c), jnp.asarray(w_c))
+    np.testing.assert_allclose(float(stream.compute()), float(whole.compute()), rtol=1e-6)
+    np.testing.assert_allclose(float(whole.compute()), np.average(vals, weights=w), rtol=1e-6)

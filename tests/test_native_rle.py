@@ -143,6 +143,8 @@ class TestCocoMatch:
     def test_native_equals_fallback(self, d, g):
         from torchmetrics_tpu.native import rle_mask
 
+        if not rle_mask.native_available():
+            pytest.skip("native library unavailable — both sides would be the fallback")
         rng = np.random.RandomState(d * 31 + g)
         args = self._random_case(rng, d, g)
         native = rle_mask.coco_match(*args)

@@ -161,3 +161,35 @@ def test_pallas_empty_batch_returns_zeros():
     )
     assert np.asarray(got[0]).shape == (5, 3)
     assert (np.asarray(got[0]) == 0).all() and (np.asarray(got[1]) == 0).all()
+
+
+@pytest.mark.parametrize("impl_name", ["einsum", "histogram", "flat_matmul"])
+@pytest.mark.parametrize("num_classes", [1, 3, 10, 100])
+def test_all_impls_match_brute_force(impl_name, num_classes):
+    """Every selectable impl of multi_threshold_counts returns exact counts."""
+    from torchmetrics_tpu.ops.multi_threshold import multi_threshold_counts
+
+    rng = np.random.RandomState(17 + num_classes)
+    n, t = 257, 23
+    preds = rng.uniform(0, 1, (n, num_classes)).astype(np.float32)
+    preds[rng.rand(n, num_classes) < 0.05] = np.nan
+    positive = (rng.rand(n, num_classes) < 0.4).astype(np.int32)
+    valid = rng.rand(n, num_classes) < 0.9
+    thr = rng.uniform(0, 1, t).astype(np.float32)
+    got_tp, got_pp = multi_threshold_counts(
+        jnp.asarray(preds), jnp.asarray(positive), jnp.asarray(valid), jnp.asarray(thr),
+        impl=impl_name,
+    )
+    want_tp, want_pp = _brute(preds, positive, valid, thr)
+    np.testing.assert_array_equal(np.asarray(got_tp), want_tp)
+    np.testing.assert_array_equal(np.asarray(got_pp), want_pp)
+
+
+def test_unknown_impl_rejected():
+    from torchmetrics_tpu.ops.multi_threshold import multi_threshold_counts
+
+    with pytest.raises(ValueError, match="impl"):
+        multi_threshold_counts(
+            jnp.zeros((4, 2)), jnp.zeros((4, 2), jnp.int32), jnp.ones((4, 2), bool),
+            jnp.linspace(0, 1, 5), impl="bogus",
+        )

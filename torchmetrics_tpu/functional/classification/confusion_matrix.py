@@ -178,7 +178,34 @@ def _multiclass_confusion_matrix_format(
 
 
 def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes: int) -> Array:
-    """One scatter-add into C² bins (reference ``confusion_matrix.py:325-330``)."""
+    """``confmat[i, j] = #{n : target==i, preds==j}`` (reference ``confusion_matrix.py:325-330``).
+
+    On TPU: one MXU matmul of two lazily generated bf16 one-hots (``tgt_ohᵀ @
+    pred_oh``) — scatters serialise on TPU while this is a fused single pass over the
+    labels (same pattern as ``ops/stat_counts.py``); exact while ``N < 2**24``.
+    Elsewhere (or at f32-exactness risk, or at the nominal metrics' huge category
+    counts where O(N*C²) matmul work loses to the O(N) scatter): the deterministic
+    scatter-add bincount.
+    """
+
+    def _on_tpu(x: Array) -> bool:
+        try:
+            devs = getattr(x, "devices", None)
+            if callable(devs):
+                return next(iter(devs())).platform == "tpu"
+        except Exception:
+            pass
+        return jax.default_backend() == "tpu"
+
+    n = preds.shape[0] if preds.ndim else 1
+    if _on_tpu(preds) and n < (1 << 24) and num_classes <= 1024:
+        ci = jnp.arange(num_classes, dtype=jnp.int32)
+        valid = (target >= 0).astype(jnp.bfloat16)
+        tgt_oh = (target[:, None] == ci).astype(jnp.bfloat16) * valid[:, None]  # (N, C)
+        pred_oh = (preds[:, None] == ci).astype(jnp.bfloat16)
+        dims = (((0,), (0,)), ((), ()))
+        out = jax.lax.dot_general(tgt_oh, pred_oh, dims, preferred_element_type=jnp.float32)
+        return out.astype(jnp.int32)
     unique_mapping = jnp.where(target < 0, -1, target * num_classes + preds)
     valid = (unique_mapping >= 0).astype(jnp.int32)
     return _bincount_2d(unique_mapping, valid, num_classes * num_classes).reshape(num_classes, num_classes)

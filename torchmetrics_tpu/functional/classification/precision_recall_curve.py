@@ -72,10 +72,11 @@ def _binned_multi_threshold_confmat(
 
     TPU-native reformulation of the reference's per-threshold scatter
     (``precision_recall_curve.py:205-243``): the per-threshold TP / predicted-positive
-    counts come from ``ops.multi_threshold_counts`` (fused Pallas compare+matmul kernel
-    on TPU, bucketised histograms elsewhere — see ``ops/multi_threshold.py``), and the
-    remaining confusion cells follow from the per-class totals. Identical integer
-    counts to the reference's materialised comparison tensor.
+    counts come from ``ops.multi_threshold_counts`` (fused compare-reduce einsum on
+    TPU, bucketised histograms elsewhere — crossover table in
+    ``ops/multi_threshold.py``), and the remaining confusion cells follow from the
+    per-class totals. Identical integer counts to the reference's materialised
+    comparison tensor.
 
     Args:
         preds: ``(N, C)`` scores.

@@ -9,20 +9,36 @@ same counting problem: for each threshold ``t`` and class ``c``,
 
 The torch reference materialises the ``(N, C, T)`` comparison tensor and scatter-adds it.
 On TPU both halves are wrong: the comparison tensor burns HBM bandwidth and scatters
-serialise. Three strategies live here, picked by backend and shape:
+serialise. Four strategies live here; the dispatch is driven by an on-device crossover
+sweep (TPU v5e, T=200, target-int carry probe, r04):
 
-* **Pallas kernel** (TPU, small/medium ``C``): streams sample blocks through VMEM,
-  generates the comparison block and the per-class weight stripes on the fly, and folds
-  them into the counts with two bf16 MXU matmuls. Zero scatter, no HBM intermediates
-  beyond the ``O(N*C)`` inputs. The matmul formulation spends ``O(N*C^2*T)`` MXU FLOPs —
-  a deliberate trade of cheap MXU cycles for HBM traffic that wins while ``C`` is small
-  (the gate below); 0/1 values are exact in bf16 and the f32 accumulator is exact below
-  2**24, so counts are bit-identical to the integer path.
-* **compare-reduce einsum** (TPU, larger ``C``): materialises the comparison tensor in
-  bf16 and contracts it on the MXU — ``O(N*C*T)`` FLOPs and bytes.
-* **bucketised histogram** (non-TPU, or huge shapes): searchsorted + one ``N*C``-element
-  scatter per histogram + suffix sums — the memory-light formulation; scatter and
-  binary-search gathers are fine on CPU.
+  | N      | C    | pallas | einsum | histogram | flat-matmul |
+  |--------|------|--------|--------|-----------|-------------|
+  | 8192   | 10   | 100 µs | noisy* | 7 100 µs  | noisy*      |
+  | 8192   | 20   |        | 37 µs  |           | 171 µs      |
+  | 8192   | 100  | 849 µs | 16 µs  | 66 784 µs | 493 µs      |
+  | 65536  | 10   | 797 µs | 114 µs |           | 370 µs      |
+  | 65536  | 100  |        | 1009µs |           |             |
+
+  *at 8192x10 the whole op reads 328 KB — dispatch-bound, every fused impl sits
+  inside measurement noise (0.9–277 µs across repeats); only pallas (~100 µs) and
+  histogram are consistent losers.
+
+* **compare-reduce einsum** (the TPU default): ``nct,nc->tc``. XLA fuses the
+  comparison generation into the reduction — the C=100 cell runs in 16 µs where a
+  materialised bf16 tensor alone would cost >400 µs of HBM writes — so this is
+  O(N*C*T) compare+mac work with only the O(N*C) input read. f32 accumulation is
+  exact below 2**24.
+* **bucketised histogram** (non-TPU, or shapes past ``_EINSUM_MAX_BYTES`` where a
+  failed fusion would materialise): searchsorted + one ``N*C``-element scatter per
+  histogram + suffix sums; scatter and binary-search gathers are fine on CPU.
+* **flat-matmul** (``impl="flat_matmul"``): lazily generated class-one-hot and
+  comparison operands contracted on the MXU — O(N*C^2*T) FLOPs, one HBM pass. Wins
+  some small-C cells but loses robustly by C=20; kept selectable, not auto-picked.
+* **Pallas kernel** (``impl="pallas"``): the explicit-pipeline Mosaic formulation of
+  flat-matmul. Beaten by XLA's own fusion everywhere measured (its block pipeline
+  re-materialises the stripes XLA never writes); kept as the interpret-mode test
+  oracle, exactly like ``stat_counts.py``'s pallas path.
 """
 
 from __future__ import annotations
@@ -49,10 +65,9 @@ _VMEM_BUDGET = 6 * 2**20
 _MAX_BLOCK_ROWS = 1 << 20
 # f32 accumulation is exact for integer counts below 2**24.
 _EXACT_F32_LIMIT = 1 << 24
-# Above this many classes the kernel's O(N*C^2*T) MXU FLOPs overtake the einsum
-# formulation's O(N*C*T) HBM bytes (bf16 MXU ~200 TFLOP/s vs ~800 GB/s HBM).
-_PALLAS_MAX_CLASSES = 96
-# Cap on the einsum path's materialised comparison tensor (bf16 bytes).
+# Guard on the einsum path's comparison tensor (bf16 bytes): XLA fuses it away in
+# every configuration measured, but a future fusion failure at these sizes would
+# materialise it — past this, take the memory-light histogram instead.
 _EINSUM_MAX_BYTES = 1 << 31
 
 
@@ -155,6 +170,30 @@ def _counts_einsum(
     return tp.astype(jnp.int32), pp.astype(jnp.int32)
 
 
+def _counts_flat_matmul(
+    preds: Array, positive: Array, valid: Array, thresholds: Array
+) -> Tuple[Array, Array]:
+    """Lazily generated class-one-hot x comparison operands contracted on the MXU.
+
+    The pallas kernel's algorithm in plain XLA (cf. ``stat_counts.py``'s
+    onehot-matmul): both bf16 operands are elementwise generators XLA fuses into the
+    matmul, so HBM traffic is the single input read; FLOPs are O(N*C^2*T).
+    """
+    n, c = preds.shape
+    f = n * c
+    p = preds.astype(jnp.float32).reshape(f)
+    v = valid.reshape(f).astype(jnp.bfloat16)
+    y = positive.reshape(f).astype(jnp.bfloat16) * v
+    ci = jnp.arange(c, dtype=jnp.int32)
+    cls = jnp.broadcast_to(ci[None, :], (n, c)).reshape(f)
+    cls_oh = (cls[:, None] == ci[None, :]).astype(jnp.bfloat16)  # (F, C), fused
+    cmp = (p[:, None] >= thresholds.astype(jnp.float32)[None, :]).astype(jnp.bfloat16)  # (F, T), fused
+    dims = (((0,), (0,)), ((), ()))
+    tp = jax.lax.dot_general(cls_oh * y[:, None], cmp, dims, preferred_element_type=jnp.float32)
+    pp = jax.lax.dot_general(cls_oh * v[:, None], cmp, dims, preferred_element_type=jnp.float32)
+    return tp.T.astype(jnp.int32), pp.T.astype(jnp.int32)
+
+
 def _counts_histogram(
     preds: Array, positive: Array, valid: Array, thresholds: Array
 ) -> Tuple[Array, Array]:
@@ -184,7 +223,7 @@ def _counts_histogram(
 
 
 def multi_threshold_counts(
-    preds: Array, positive: Array, valid: Array, thresholds: Array
+    preds: Array, positive: Array, valid: Array, thresholds: Array, impl: str = "auto"
 ) -> Tuple[Array, Array]:
     """``tp[t, c]`` and ``predpos[t, c]`` for every threshold, exact integer counts.
 
@@ -193,6 +232,8 @@ def multi_threshold_counts(
         positive: ``(N, C)`` 0/1 ground-truth membership.
         valid: ``(N, C)`` bool mask of samples to count.
         thresholds: ``(T,)`` thresholds, any order.
+        impl: ``"auto"`` (crossover-table dispatch — module docstring), or an
+            explicit ``"einsum"`` / ``"histogram"`` / ``"flat_matmul"`` / ``"pallas"``.
 
     Returns:
         ``(tp, predpos)``, both ``(T, C)`` int32.
@@ -212,15 +253,32 @@ def multi_threshold_counts(
 
     n, c = preds.shape
     t = thresholds.shape[0]
-    on_tpu = _inputs_on_tpu(preds)
-    if (
-        _PALLAS_AVAILABLE
-        and on_tpu
-        and n < _EXACT_F32_LIMIT
-        and c <= _PALLAS_MAX_CLASSES
-        and _block_rows(c, t) > 0
-    ):
-        return _counts_pallas(preds, positive, valid, thresholds)
-    if on_tpu and n < _EXACT_F32_LIMIT and 2 * n * c * t <= _EINSUM_MAX_BYTES:
+    if impl == "auto":
+        # crossover sweep (docstring table): einsum's fused compare-reduce wins or
+        # ties every TPU cell; histogram wins off-TPU and guards the fusion cap
+        if (
+            _inputs_on_tpu(preds)
+            and n < _EXACT_F32_LIMIT
+            and 2 * n * c * t <= _EINSUM_MAX_BYTES
+        ):
+            impl = "einsum"
+        else:
+            impl = "histogram"
+    if impl in ("einsum", "flat_matmul", "pallas") and n >= _EXACT_F32_LIMIT:
+        # these impls accumulate counts in f32; past 2**24 they would silently
+        # saturate — only the integer histogram stays exact
+        raise ValueError(
+            f"impl={impl!r} accumulates in f32 and is only exact below {_EXACT_F32_LIMIT} samples"
+            f" (got {n}); use impl='histogram' (or 'auto')"
+        )
+    if impl == "einsum":
         return _counts_einsum(preds, positive, valid, thresholds)
-    return _counts_histogram(preds, positive, valid, thresholds)
+    if impl == "histogram":
+        return _counts_histogram(preds, positive, valid, thresholds)
+    if impl == "flat_matmul":
+        return _counts_flat_matmul(preds, positive, valid, thresholds)
+    if impl == "pallas":
+        if not _PALLAS_AVAILABLE or _block_rows(c, t) == 0:
+            raise ValueError("pallas impl unavailable for this shape/jaxlib")
+        return _counts_pallas(preds, positive, valid, thresholds)
+    raise ValueError(f"unknown impl {impl!r}")
