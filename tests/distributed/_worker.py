@@ -1,9 +1,11 @@
-"""Worker for the real 2-process host-sync test (launched by test_multiprocess_sync).
+"""Worker for the real N-process host-sync tests (launched by test_multiprocess_sync).
 
 Each process initializes ``jax.distributed`` (gloo CPU collectives), then drives the
-host/multi-process sync path — ``gather_all_tensors`` equal-shape, ragged pad/trim,
-and ``process_group`` sub-worlds — plus full metric ``compute()`` syncs, mirroring
-the reference's 2-process gloo-pool recipe (``tests/unittests/conftest.py:25-56``).
+host/multi-process sync path — ``gather_all_tensors`` equal-shape, ragged pad/trim
+with EVERY rank's shape distinct, and ``process_group`` sub-worlds — plus full
+metric ``compute()`` syncs (stat-scores, Pearson's None-reduction moments, and a
+retrieval metric's cat-reduced list states), mirroring the reference's gloo-pool
+recipe (``tests/unittests/conftest.py:25-56``) at world sizes beyond its fixed 2.
 """
 
 from __future__ import annotations
@@ -13,7 +15,7 @@ import sys
 
 RANK = int(sys.argv[1])
 PORT = sys.argv[2]
-WORLD = 2
+WORLD = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
@@ -31,6 +33,7 @@ import jax.numpy as jnp  # noqa: E402
 from torchmetrics_tpu import PearsonCorrCoef  # noqa: E402
 from torchmetrics_tpu.classification import MulticlassAccuracy  # noqa: E402
 from torchmetrics_tpu.parallel.sync import gather_all_tensors, jit_distributed_available  # noqa: E402
+from torchmetrics_tpu.retrieval import RetrievalMRR  # noqa: E402
 
 assert jax.process_count() == WORLD, f"world did not form: {jax.process_count()}"
 assert jit_distributed_available()
@@ -39,24 +42,29 @@ assert jit_distributed_available()
 x = jnp.full((3, 2), float(RANK + 1))
 out = gather_all_tensors(x)
 assert len(out) == WORLD and all(o.shape == (3, 2) for o in out)
-np.testing.assert_allclose(np.asarray(out[0]), 1.0)
-np.testing.assert_allclose(np.asarray(out[1]), 2.0)
+for r in range(WORLD):
+    np.testing.assert_allclose(np.asarray(out[r]), float(r + 1))
 
-# --- 2. ragged gather: pad/trim branch (rank r contributes r+2 rows) -----------------
+# --- 2. ragged gather: pad/trim branch, every rank a different shape -----------------
 ragged = jnp.arange((RANK + 2) * 4, dtype=jnp.float32).reshape(RANK + 2, 4)
 out = gather_all_tensors(ragged)
-assert [o.shape for o in out] == [(2, 4), (3, 4)]
+assert [o.shape for o in out] == [(r + 2, 4) for r in range(WORLD)]
 np.testing.assert_allclose(np.asarray(out[RANK]), np.asarray(ragged))
+for r in range(WORLD):  # trimmed content, not just shape
+    np.testing.assert_allclose(np.asarray(out[r]), np.arange((r + 2) * 4).reshape(r + 2, 4))
 
 # --- 3. process_group sub-worlds -----------------------------------------------------
 mine = gather_all_tensors(x, group=[RANK])
 assert len(mine) == 1
 np.testing.assert_allclose(np.asarray(mine[0]), float(RANK + 1))
-both = gather_all_tensors(ragged, group=[0, 1])
-assert [o.shape for o in both] == [(2, 4), (3, 4)]
+# a sub-world of all-but-the-last rank (size 3 at world 4); every rank still
+# participates in the full-world collective underneath
+sub = list(range(max(WORLD - 1, 2)))[:WORLD]
+subbed = gather_all_tensors(ragged, group=sub)
+assert [o.shape for o in subbed] == [(r + 2, 4) for r in sub]
 
 # --- 4. metric compute() across the real world ---------------------------------------
-rng = np.random.default_rng(0)  # identical stream on both ranks
+rng = np.random.default_rng(0)  # identical stream on every rank
 all_preds = rng.integers(0, 5, size=(WORLD, 32))
 all_target = rng.integers(0, 5, size=(WORLD, 32))
 
@@ -81,5 +89,28 @@ pearson.update(jnp.asarray(p[RANK]), jnp.asarray(t[RANK]))
 synced_r = float(pearson.compute())
 full = np.corrcoef(p.reshape(-1), t.reshape(-1))[0, 1]
 np.testing.assert_allclose(synced_r, full, atol=1e-5)
+
+# --- 6. cat-reduced list states: retrieval metric over rank-split queries ------------
+n_q = 2  # queries per rank; global query ids stay disjoint across ranks
+docs_per_q = 6
+scores = rng.random(size=(WORLD, n_q * docs_per_q)).astype(np.float32)
+rel = rng.integers(0, 2, size=(WORLD, n_q * docs_per_q))
+rel[:, 0] = 1  # every first doc relevant: no empty-query edge here
+indexes = np.repeat(np.arange(WORLD * n_q).reshape(WORLD, n_q), docs_per_q, axis=1)
+
+mrr = RetrievalMRR()
+mrr.update(jnp.asarray(scores[RANK]), jnp.asarray(rel[RANK]), indexes=jnp.asarray(indexes[RANK]))
+synced_mrr = float(mrr.compute())
+
+# host golden over the full world
+rrs = []
+for w in range(WORLD):
+    for q in range(n_q):
+        sl = slice(q * docs_per_q, (q + 1) * docs_per_q)
+        order = np.argsort(-scores[w, sl], kind="stable")
+        ranked_rel = rel[w, sl][order]
+        first = np.flatnonzero(ranked_rel)
+        rrs.append(1.0 / (first[0] + 1) if first.size else 0.0)
+np.testing.assert_allclose(synced_mrr, np.mean(rrs), atol=1e-6)
 
 print(f"RANK {RANK} PASS", flush=True)

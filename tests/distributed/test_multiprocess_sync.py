@@ -32,20 +32,21 @@ def _free_port() -> int:
 
 
 @pytest.mark.timeout(300)
-def test_two_process_host_sync():
+@pytest.mark.parametrize("world", [2, 4])
+def test_n_process_host_sync(world):
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
     env["PYTHONPATH"] = str(_REPO)
     procs = [
         subprocess.Popen(
-            [sys.executable, str(_WORKER), str(rank), str(port)],
+            [sys.executable, str(_WORKER), str(rank), str(port), str(world)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
             cwd=str(_REPO),
         )
-        for rank in range(2)
+        for rank in range(world)
     ]
     outs = []
     for rank, proc in enumerate(procs):

@@ -188,17 +188,10 @@ def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes
     scatter-add bincount.
     """
 
-    def _on_tpu(x: Array) -> bool:
-        try:
-            devs = getattr(x, "devices", None)
-            if callable(devs):
-                return next(iter(devs())).platform == "tpu"
-        except Exception:
-            pass
-        return jax.default_backend() == "tpu"
+    from torchmetrics_tpu.ops._dispatch import inputs_on_tpu
 
     n = preds.shape[0] if preds.ndim else 1
-    if _on_tpu(preds) and n < (1 << 24) and num_classes <= 1024:
+    if inputs_on_tpu(preds) and n < (1 << 24) and num_classes <= 1024:
         ci = jnp.arange(num_classes, dtype=jnp.int32)
         valid = (target >= 0).astype(jnp.bfloat16)
         tgt_oh = (target[:, None] == ci).astype(jnp.bfloat16) * valid[:, None]  # (N, C)
@@ -206,7 +199,11 @@ def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes
         dims = (((0,), (0,)), ((), ()))
         out = jax.lax.dot_general(tgt_oh, pred_oh, dims, preferred_element_type=jnp.float32)
         return out.astype(jnp.int32)
-    unique_mapping = jnp.where(target < 0, -1, target * num_classes + preds)
+    # out-of-range preds/target are DROPPED (matching the one-hot path and the
+    # documented nominal-metrics contract) — without the preds bound an invalid
+    # code would alias into a wrong cell of the flattened bincount
+    invalid = (target < 0) | (target >= num_classes) | (preds < 0) | (preds >= num_classes)
+    unique_mapping = jnp.where(invalid, -1, target * num_classes + preds)
     valid = (unique_mapping >= 0).astype(jnp.int32)
     return _bincount_2d(unique_mapping, valid, num_classes * num_classes).reshape(num_classes, num_classes)
 

@@ -1,0 +1,21 @@
+"""Shared dispatch helpers for the fused-op gates."""
+
+from __future__ import annotations
+
+import jax
+
+
+def inputs_on_tpu(x) -> bool:
+    """Whether ``x`` lives on (or will be placed on) a TPU.
+
+    Dispatch on the concrete committed device when available — explicit placement
+    on a non-default backend must pick the matching path — falling back to the
+    default backend for tracers, whose device is unknown at trace time.
+    """
+    try:
+        devs = getattr(x, "devices", None)
+        if callable(devs):
+            return next(iter(devs())).platform == "tpu"
+    except Exception:
+        pass
+    return jax.default_backend() == "tpu"

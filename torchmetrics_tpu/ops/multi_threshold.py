@@ -239,17 +239,7 @@ def multi_threshold_counts(
         ``(tp, predpos)``, both ``(T, C)`` int32.
     """
 
-    def _inputs_on_tpu(x: Array) -> bool:
-        """Dispatch on the concrete committed device when available (explicit
-        placement on a non-default backend picks the matching path), falling back
-        to the default backend for tracers, whose device is unknown at trace time."""
-        try:
-            devs = getattr(x, "devices", None)
-            if callable(devs):
-                return next(iter(devs())).platform == "tpu"
-        except Exception:
-            pass
-        return jax.default_backend() == "tpu"
+    from torchmetrics_tpu.ops._dispatch import inputs_on_tpu
 
     n, c = preds.shape
     t = thresholds.shape[0]
@@ -257,7 +247,7 @@ def multi_threshold_counts(
         # crossover sweep (docstring table): einsum's fused compare-reduce wins or
         # ties every TPU cell; histogram wins off-TPU and guards the fusion cap
         if (
-            _inputs_on_tpu(preds)
+            inputs_on_tpu(preds)
             and n < _EXACT_F32_LIMIT
             and 2 * n * c * t <= _EINSUM_MAX_BYTES
         ):
@@ -280,5 +270,7 @@ def multi_threshold_counts(
     if impl == "pallas":
         if not _PALLAS_AVAILABLE or _block_rows(c, t) == 0:
             raise ValueError("pallas impl unavailable for this shape/jaxlib")
-        return _counts_pallas(preds, positive, valid, thresholds)
+        # off-TPU the Mosaic kernel cannot compile — run the documented
+        # interpret-mode oracle instead of dying in lowering
+        return _counts_pallas(preds, positive, valid, thresholds, interpret=not inputs_on_tpu(preds))
     raise ValueError(f"unknown impl {impl!r}")

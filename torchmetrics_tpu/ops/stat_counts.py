@@ -184,13 +184,9 @@ def fused_multiclass_stat_scores_supported(
     # per-class f32 accumulator counts are bounded by the number of rows
     if preds.shape[0] >= _EXACT_F32_LIMIT:
         return False
-    try:
-        devs = getattr(preds, "devices", None)
-        if callable(devs):
-            return next(iter(devs())).platform == "tpu"
-    except Exception:
-        pass
-    return jax.default_backend() == "tpu"
+    from torchmetrics_tpu.ops._dispatch import inputs_on_tpu
+
+    return inputs_on_tpu(preds)
 
 
 def fused_multiclass_stat_scores(

@@ -120,11 +120,16 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     all_shapes = multihost_utils.process_allgather(local_shape, tiled=False)
     all_shapes = [tuple(int(d) for d in all_shapes[i]) for i in range(world_size)]
 
-    if all(all_shapes[i] == all_shapes[members[0]] for i in members):
+    # EVERY process participates in the underlying collective (sub-worlds only
+    # filter the results), so both the equal-shape fast path and the pad target
+    # must consider ALL ranks — padding to the members' max alone gives a
+    # non-member with a larger shape a negative pad, killing it while the members
+    # deadlock in the collective (caught by the world-3 sub-group test).
+    if all(s == all_shapes[0] for s in all_shapes):
         gathered = multihost_utils.process_allgather(result, tiled=False)
         return [jnp.asarray(gathered[i]) for i in members]
 
-    max_shape = tuple(max(all_shapes[i][d] for i in members) for d in range(result.ndim))
+    max_shape = tuple(max(s[d] for s in all_shapes) for d in range(result.ndim))
     pad = [(0, m - s) for m, s in zip(max_shape, result.shape)]
     padded = jnp.pad(result, pad)
     gathered = multihost_utils.process_allgather(padded, tiled=False)
