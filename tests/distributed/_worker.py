@@ -113,4 +113,47 @@ for w in range(WORLD):
         rrs.append(1.0 / (first[0] + 1) if first.size else 0.0)
 np.testing.assert_allclose(synced_mrr, np.mean(rrs), atol=1e-6)
 
+# --- 7. BERTScore tokenized-tensor states ride the array gather ----------------------
+from torchmetrics_tpu.text import BERTScore  # noqa: E402
+
+_L, _D = 8, 6
+
+
+def _toy_tokenizer(sents):
+    ids = np.zeros((len(sents), _L), np.int32)
+    mask = np.zeros((len(sents), _L), np.int32)
+    for i, s in enumerate(sents):
+        toks = [1] + [sum(map(ord, w)) % 997 + 3 for w in s.split()][: _L - 2] + [2]
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1
+    return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+
+def _toy_forward(input_ids, attention_mask):
+    freqs = jnp.arange(1, _D + 1, dtype=jnp.float32) * 0.1
+    return jnp.sin(jnp.asarray(input_ids, jnp.float32)[:, :, None] * freqs)
+
+
+sentences = [
+    "the cat sat on the mat", "a dog ran in the park", "hello world again",
+    "metrics ride the gather", "every rank holds a slice", "scores must agree",
+    "one more pair here", "and a final one",
+][: 2 * WORLD]
+noisy = [s.replace("the", "a") for s in sentences]
+lo, hi = RANK * 2, RANK * 2 + 2
+
+dist_bs = BERTScore(model=_toy_forward, user_tokenizer=_toy_tokenizer, idf=True)
+dist_bs.update(noisy[lo:hi], sentences[lo:hi])
+synced = dist_bs.compute()
+
+whole = BERTScore(model=_toy_forward, user_tokenizer=_toy_tokenizer, idf=True)
+whole.update(noisy[: 2 * WORLD], sentences[: 2 * WORLD])
+whole._to_sync = False  # rank-local single-process golden over the full corpus
+golden_scores = whole.compute()
+for key in ("precision", "recall", "f1"):
+    got = np.asarray(synced[key])
+    want = np.asarray(golden_scores[key])
+    assert got.shape == want.shape == (2 * WORLD,), (key, got.shape)
+    np.testing.assert_allclose(got, want, atol=1e-5, err_msg=key)
+
 print(f"RANK {RANK} PASS", flush=True)

@@ -222,3 +222,31 @@ def test_flax_load_matches_torch_forward(tiny_bert_pt_dir):
     want = out.hidden_states[2].numpy()
     assert got.shape == want.shape
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bert_score_tokenized_states_ride_array_sync(tiny_bert_dir):
+    """With a tokenizer available, update() stores padded token ARRAYS (not strings);
+    a pluggable world-2 sync duplicates them and compute scores the doubled corpus —
+    the cross-process semantics raw string states cannot have."""
+    metric = BERTScore(
+        model_name_or_path=tiny_bert_dir, max_length=16, idf=True,
+        dist_sync_fn=lambda x, group=None: [x, x],
+        distributed_available_fn=lambda: True,
+    )
+    metric.update(["hello world", "the cat sat"], ["hello world", "a cat sat"])
+    assert len(metric.preds) == 0  # no string fallback used
+    assert len(metric.pred_input_ids) == 1 and metric.pred_input_ids[0].shape[0] == 2
+    out = metric.compute()
+    f1 = np.asarray(out["f1"])
+    assert f1.shape == (4,)  # doubled world
+    np.testing.assert_allclose(f1[:2], f1[2:], atol=1e-6)  # same pairs, same scores
+
+    # pickle round-trip drops the resolved HF closures and re-resolves lazily
+    # (pickled WITHOUT the unpicklable lambda sync hooks of the metric above)
+    import pickle
+
+    plain = BERTScore(model_name_or_path=tiny_bert_dir, max_length=16)
+    plain.update(["hello world"], ["hello world"])
+    clone = pickle.loads(pickle.dumps(plain))
+    assert clone._resolved is False
+    np.testing.assert_allclose(np.asarray(clone.compute()["f1"]), 1.0, atol=1e-4)

@@ -78,7 +78,10 @@ def _greedy_cosine_scores(
     tgt_n = _norm(tgt_emb)
 
     def _one(pe, pm, te, tm, pw, tw):
-        sim = pe @ te.T  # (Lp, Lt)
+        # full-f32 matmul: at TPU-default (bf16) precision an identical pair's
+        # self-similarity lands at ~0.9995 instead of 1.0 — metric fidelity is
+        # worth the negligible cost next to the model forward
+        sim = jnp.matmul(pe, te.T, precision=jax.lax.Precision.HIGHEST)  # (Lp, Lt)
         neg = -jnp.inf
         sim_masked = jnp.where(pm[:, None] * tm[None, :] > 0, sim, neg)
         best_for_pred = jnp.where(pm > 0, jnp.max(sim_masked, axis=1), 0.0)
@@ -89,6 +92,66 @@ def _greedy_cosine_scores(
         return precision, recall, f1
 
     return jax.vmap(_one)(pred_n, pred_mask, tgt_n, tgt_mask, pred_w, tgt_w)
+
+
+def _resolve_model_and_tokenizer(
+    model_name_or_path: Optional[str],
+    num_layers: Optional[int],
+    model: Optional[Callable],
+    user_tokenizer: Optional[Callable],
+    max_length: int,
+) -> Tuple[Optional[Callable], Optional[Callable]]:
+    """Resolve ``(forward, tokenizer)`` callables for the HF path.
+
+    Reference ``text/bert.py:192-195``: Flax-first transformer + AutoTokenizer with
+    offline-clean errors (utilities.hf). The tokenizer pads to the model-capped
+    ``max_length`` so every batch has the same width — which is what lets the
+    modular metric store tokenized ARRAYS that ride the cross-process gather.
+    """
+    if model is None and model_name_or_path is not None:
+        from torchmetrics_tpu.utilities.hf import (
+            hf_embedding_forward,
+            hf_tokenize,
+            load_hf_model_and_tokenizer,
+            model_max_length,
+        )
+
+        hf_model, hf_tok = load_hf_model_and_tokenizer(model_name_or_path)
+        model = hf_embedding_forward(hf_model, num_layers=num_layers)
+        hf_max_length = model_max_length(hf_model, max_length)
+        if user_tokenizer is None:
+            user_tokenizer = lambda sents: dict(  # noqa: E731
+                zip(("input_ids", "attention_mask"), hf_tokenize(hf_tok, sents, max_length=hf_max_length))
+            )
+    return model, user_tokenizer
+
+
+def _score_from_tokens(
+    pred_tok: Dict[str, Array],
+    tgt_tok: Dict[str, Array],
+    forward: Callable,
+    idf: bool,
+) -> Tuple[Array, Array, Array]:
+    """(precision, recall, f1) per pair from tokenized batches — the post-tokenize
+    half of the pipeline, shared by the functional API and the modular metric's
+    tokenized-tensor states."""
+    pred_emb = forward(pred_tok["input_ids"], pred_tok["attention_mask"])
+    tgt_emb = forward(tgt_tok["input_ids"], tgt_tok["attention_mask"])
+
+    idf_map = (
+        _compute_idf([tgt_tok["input_ids"]], [tgt_tok["attention_mask"]]) if idf else None
+    )
+    pred_w = _idf_weights(pred_tok["input_ids"], pred_tok["attention_mask"], idf_map)
+    tgt_w = _idf_weights(tgt_tok["input_ids"], tgt_tok["attention_mask"], idf_map)
+
+    return _greedy_cosine_scores(
+        pred_emb,
+        jnp.asarray(pred_tok["attention_mask"], dtype=jnp.float32),
+        tgt_emb,
+        jnp.asarray(tgt_tok["attention_mask"], dtype=jnp.float32),
+        pred_w,
+        tgt_w,
+    )
 
 
 def bert_score(
@@ -121,44 +184,13 @@ def bert_score(
         raise ValueError("Number of predicted and reference sentences must be the same!")
     if rescale_with_baseline:
         raise ValueError("Baseline rescaling requires downloadable baseline files, which are unavailable.")
-    if model is None and model_name_or_path is not None:
-        # HF path (reference ``text/bert.py:192-195``): Flax-first transformer +
-        # AutoTokenizer, offline-clean errors from utilities.hf
-        from torchmetrics_tpu.utilities.hf import (
-            hf_embedding_forward,
-            hf_tokenize,
-            load_hf_model_and_tokenizer,
-            model_max_length,
-        )
-
-        hf_model, hf_tok = load_hf_model_and_tokenizer(model_name_or_path)
-        model = hf_embedding_forward(hf_model, num_layers=num_layers)
-        hf_max_length = model_max_length(hf_model, max_length)
-        if user_tokenizer is None:
-            user_tokenizer = lambda sents: dict(  # noqa: E731
-                zip(("input_ids", "attention_mask"), hf_tokenize(hf_tok, sents, max_length=hf_max_length))
-            )
+    model, user_tokenizer = _resolve_model_and_tokenizer(
+        model_name_or_path, num_layers, model, user_tokenizer, max_length
+    )
     _validate_model_inputs(model if model is not None else model_name_or_path, user_tokenizer)
 
     pred_tok = user_tokenizer(preds)
     tgt_tok = user_tokenizer(target)
     forward = user_forward_fn if user_forward_fn is not None else model
-
-    pred_emb = forward(pred_tok["input_ids"], pred_tok["attention_mask"])
-    tgt_emb = forward(tgt_tok["input_ids"], tgt_tok["attention_mask"])
-
-    idf_map = (
-        _compute_idf([tgt_tok["input_ids"]], [tgt_tok["attention_mask"]]) if idf else None
-    )
-    pred_w = _idf_weights(pred_tok["input_ids"], pred_tok["attention_mask"], idf_map)
-    tgt_w = _idf_weights(tgt_tok["input_ids"], tgt_tok["attention_mask"], idf_map)
-
-    precision, recall, f1 = _greedy_cosine_scores(
-        pred_emb,
-        jnp.asarray(pred_tok["attention_mask"], dtype=jnp.float32),
-        tgt_emb,
-        jnp.asarray(tgt_tok["attention_mask"], dtype=jnp.float32),
-        pred_w,
-        tgt_w,
-    )
+    precision, recall, f1 = _score_from_tokens(pred_tok, tgt_tok, forward, idf)
     return {"precision": precision, "recall": recall, "f1": f1}

@@ -414,6 +414,20 @@ class Metric:
             # pre-concatenate list states to minimize collectives (ref ``metric.py:391-392``)
             if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
+            if (
+                reduction_fn == dim_zero_cat
+                and isinstance(input_dict[attr], list)
+                and not input_dict[attr]
+                and jax.process_count() > 1
+            ):
+                # an empty list state has no leaves, so this process would SKIP the
+                # collective other processes enter — a silent deadlock; fail loud
+                raise TorchMetricsUserError(
+                    f"Cannot sync empty list state `{attr}` in a {jax.process_count()}-process"
+                    " world: this process would skip the all-gather the other processes are"
+                    " blocked in. Ensure every process receives at least one update before"
+                    " compute(), or skip syncing (sync_on_compute=False) for ragged epochs."
+                )
 
         output_dict = apply_to_collection(
             input_dict,

@@ -1,9 +1,12 @@
 """Modular BERTScore (reference ``src/torchmetrics/text/bert.py``).
 
-Raw sentence list states (cat) — tokenization/model forward deferred to compute, like
-the reference which stores tokenized tensors and runs the model at compute
-(``bert.py:192-195``). ``model_name_or_path`` loads a HF transformer (Flax-first,
-offline-clean errors); alternatively inject ``model``/``user_tokenizer`` callables.
+State design follows the reference (``bert.py:192-195``): when a tokenizer is
+available (``model_name_or_path`` or ``user_tokenizer``), ``update`` tokenizes
+immediately and stores padded ``input_ids``/``attention_mask`` ARRAYS — fixed-width,
+so they ride the cross-process array gather and a multi-host eval computes over the
+full corpus (including corpus-wide idf). Only with no tokenizer at all does the
+metric fall back to raw sentence-list states, which are host data and aggregate
+per-host only.
 """
 
 from __future__ import annotations
@@ -11,9 +14,15 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
+import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.text.bert import bert_score
+from torchmetrics_tpu.functional.text.bert import (
+    _resolve_model_and_tokenizer,
+    _score_from_tokens,
+    bert_score,
+)
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -29,6 +38,10 @@ class BERTScore(Metric):
 
     preds: List[str]
     target: List[str]
+    pred_input_ids: List[Array]
+    pred_attention_mask: List[Array]
+    target_input_ids: List[Array]
+    target_attention_mask: List[Array]
 
     def __init__(
         self,
@@ -57,26 +70,82 @@ class BERTScore(Metric):
         self.batch_size = batch_size
         self.lang = lang
         self.rescale_with_baseline = rescale_with_baseline
-        # Strings are host data, not arrays — raw (None) states pass through sync
-        # untouched; the array-only gather path cannot concatenate them. Cross-host
-        # aggregation therefore happens per-host (the reference avoids this by storing
-        # tokenized tensors instead; with an injected tokenizer users can do the same).
+        # resolved lazily on first use (loading the HF model at construction would
+        # make the ctor heavy and pickling awkward)
+        self._forward_fn: Optional[Callable] = None
+        self._tokenize_fn: Optional[Callable] = None
+        self._resolved = False
+
+        # tokenized-tensor states (reference parity): fixed-width int arrays that
+        # the cross-process gather concatenates like any other cat state
+        self.add_state("pred_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("pred_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+        # raw-sentence fallback for the no-tokenizer configuration: host data, raw
+        # (None) states pass through sync untouched — per-host aggregation only
         self.add_state("preds", [], dist_reduce_fx=None)
         self.add_state("target", [], dist_reduce_fx=None)
 
+    def _resolve(self) -> None:
+        # loads the model too, not just the tokenizer: the tokenizer's pad width must
+        # be capped by the model's position-embedding capacity (model_max_length), so
+        # a tokenizer-only resolution could store arrays the forward cannot consume
+        if self._resolved:
+            return
+        forward, tokenizer = _resolve_model_and_tokenizer(
+            self.model_name_or_path, self.num_layers, self.model, self.user_tokenizer, self.max_length
+        )
+        self._forward_fn = self.user_forward_fn if self.user_forward_fn is not None else forward
+        self._tokenize_fn = tokenizer
+        self._resolved = True
+
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
-        """Buffer raw sentences."""
+        """Tokenize and buffer (arrays when a tokenizer exists, else raw sentences)."""
         if isinstance(preds, str):
             preds = [preds]
         if isinstance(target, str):
             target = [target]
         if len(preds) != len(target):
             raise ValueError("Number of predicted and reference sentences must be the same!")
-        self.preds.extend(preds)
-        self.target.extend(target)
+        self._resolve()
+        if self._tokenize_fn is not None:
+            p_tok = self._tokenize_fn(list(preds))
+            t_tok = self._tokenize_fn(list(target))
+            self.pred_input_ids.append(jnp.asarray(p_tok["input_ids"]))
+            self.pred_attention_mask.append(jnp.asarray(p_tok["attention_mask"]))
+            self.target_input_ids.append(jnp.asarray(t_tok["input_ids"]))
+            self.target_attention_mask.append(jnp.asarray(t_tok["attention_mask"]))
+        else:
+            self.preds.extend(preds)
+            self.target.extend(target)
+
+    def _has_tokenized_state(self) -> bool:
+        state = self.pred_input_ids
+        return len(state) > 0 if isinstance(state, list) else state.size > 0
 
     def compute(self) -> Dict[str, Array]:
-        """Run the injected model over all buffered sentences and match greedily."""
+        """Score the gathered corpus (tokenized-array path) or buffered sentences."""
+        if self._has_tokenized_state():
+            if self.rescale_with_baseline:
+                raise ValueError(
+                    "Baseline rescaling requires downloadable baseline files, which are unavailable."
+                )
+            self._resolve()
+            if self._forward_fn is None:
+                from torchmetrics_tpu.functional.text.bert import _validate_model_inputs
+
+                _validate_model_inputs(None, self._tokenize_fn)  # curated error
+            pred_tok = {
+                "input_ids": dim_zero_cat(self.pred_input_ids),
+                "attention_mask": dim_zero_cat(self.pred_attention_mask),
+            }
+            tgt_tok = {
+                "input_ids": dim_zero_cat(self.target_input_ids),
+                "attention_mask": dim_zero_cat(self.target_attention_mask),
+            }
+            precision, recall, f1 = _score_from_tokens(pred_tok, tgt_tok, self._forward_fn, self.idf)
+            return {"precision": precision, "recall": recall, "f1": f1}
         return bert_score(
             preds=self.preds,
             target=self.target,
@@ -91,6 +160,13 @@ class BERTScore(Metric):
             lang=self.lang,
             rescale_with_baseline=self.rescale_with_baseline,
         )
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Resolved HF callables close over live model objects — drop them and
+        re-resolve lazily after unpickling."""
+        state = dict(super().__getstate__())
+        state.update(_resolved=False, _forward_fn=None, _tokenize_fn=None)
+        return state
 
     def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
         return self._plot(val, ax)
