@@ -250,3 +250,57 @@ def test_bert_score_tokenized_states_ride_array_sync(tiny_bert_dir):
     clone = pickle.loads(pickle.dumps(plain))
     assert clone._resolved is False
     np.testing.assert_allclose(np.asarray(clone.compute()["f1"]), 1.0, atol=1e-4)
+
+
+def test_bert_score_dynamic_width_tokenizer_normalized():
+    """A user tokenizer that pads per-batch ('longest') still yields cat-able
+    fixed-width states; zero padding is score-neutral (mask-weighted)."""
+    import jax.numpy as jnp
+
+    D = 5
+
+    def tok(sents):
+        width = max(len(s.split()) for s in sents) + 2  # dynamic per batch
+        ids = np.zeros((len(sents), width), np.int32)
+        mask = np.zeros((len(sents), width), np.int32)
+        for i, s in enumerate(sents):
+            t = [1] + [sum(map(ord, w)) % 97 + 3 for w in s.split()] + [2]
+            ids[i, : len(t)] = t
+            mask[i, : len(t)] = 1
+        return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+    def fwd(ids, mask):
+        return jnp.sin(jnp.asarray(ids, jnp.float32)[:, :, None] * (np.arange(1, D + 1) * 0.3))
+
+    m = BERTScore(model=fwd, user_tokenizer=tok, max_length=12)
+    m.update(["short one"], ["short one"])
+    m.update(["a much longer sentence with many words"], ["a much longer sentence with many words"])
+    out = m.compute()  # widths 4 and 9, normalized to 12
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-5)
+
+    over = BERTScore(model=fwd, user_tokenizer=tok, max_length=4)
+    with pytest.raises(ValueError, match="max_length"):
+        over.update(["this sentence is far too long for four"], ["x"] )
+
+
+def test_mixed_empty_cat_state_sync_raises(monkeypatch):
+    """One populated rank + one empty rank: the count pre-gather fails loud on the
+    would-deadlock configuration; all-empty stays a benign consistent skip."""
+    import jax
+    from jax.experimental import multihost_utils
+    from torchmetrics_tpu.aggregation import CatMetric
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda x, tiled=False: np.asarray([0, 1]))
+
+    m = CatMetric(dist_sync_fn=lambda x, group=None: [x, x],
+                  distributed_available_fn=lambda: True)
+    with pytest.raises(TorchMetricsUserError, match="deadlock"):
+        m._sync_dist(dist_sync_fn=m.dist_sync_fn)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda x, tiled=False: np.asarray([0, 0]))
+    m._sync_dist(dist_sync_fn=m.dist_sync_fn)  # all-empty: consistent no-op
+    assert m.value == []

@@ -100,14 +100,15 @@ def _resolve_model_and_tokenizer(
     model: Optional[Callable],
     user_tokenizer: Optional[Callable],
     max_length: int,
-) -> Tuple[Optional[Callable], Optional[Callable]]:
-    """Resolve ``(forward, tokenizer)`` callables for the HF path.
+) -> Tuple[Optional[Callable], Optional[Callable], int]:
+    """Resolve ``(forward, tokenizer, pad_width)`` for the HF path.
 
     Reference ``text/bert.py:192-195``: Flax-first transformer + AutoTokenizer with
     offline-clean errors (utilities.hf). The tokenizer pads to the model-capped
-    ``max_length`` so every batch has the same width — which is what lets the
+    ``pad_width`` so every batch has the same width — which is what lets the
     modular metric store tokenized ARRAYS that ride the cross-process gather.
     """
+    pad_width = max_length
     if model is None and model_name_or_path is not None:
         from torchmetrics_tpu.utilities.hf import (
             hf_embedding_forward,
@@ -118,12 +119,13 @@ def _resolve_model_and_tokenizer(
 
         hf_model, hf_tok = load_hf_model_and_tokenizer(model_name_or_path)
         model = hf_embedding_forward(hf_model, num_layers=num_layers)
-        hf_max_length = model_max_length(hf_model, max_length)
+        pad_width = model_max_length(hf_model, max_length)
         if user_tokenizer is None:
+            hf_max_length = pad_width
             user_tokenizer = lambda sents: dict(  # noqa: E731
                 zip(("input_ids", "attention_mask"), hf_tokenize(hf_tok, sents, max_length=hf_max_length))
             )
-    return model, user_tokenizer
+    return model, user_tokenizer, pad_width
 
 
 def _score_from_tokens(
@@ -184,7 +186,7 @@ def bert_score(
         raise ValueError("Number of predicted and reference sentences must be the same!")
     if rescale_with_baseline:
         raise ValueError("Baseline rescaling requires downloadable baseline files, which are unavailable.")
-    model, user_tokenizer = _resolve_model_and_tokenizer(
+    model, user_tokenizer, _ = _resolve_model_and_tokenizer(
         model_name_or_path, num_layers, model, user_tokenizer, max_length
     )
     _validate_model_inputs(model if model is not None else model_name_or_path, user_tokenizer)

@@ -414,20 +414,31 @@ class Metric:
             # pre-concatenate list states to minimize collectives (ref ``metric.py:391-392``)
             if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
-            if (
-                reduction_fn == dim_zero_cat
-                and isinstance(input_dict[attr], list)
-                and not input_dict[attr]
-                and jax.process_count() > 1
-            ):
-                # an empty list state has no leaves, so this process would SKIP the
-                # collective other processes enter — a silent deadlock; fail loud
-                raise TorchMetricsUserError(
-                    f"Cannot sync empty list state `{attr}` in a {jax.process_count()}-process"
-                    " world: this process would skip the all-gather the other processes are"
-                    " blocked in. Ensure every process receives at least one update before"
-                    " compute(), or skip syncing (sync_on_compute=False) for ragged epochs."
-                )
+
+        if jax.process_count() > 1:
+            # an empty list state has no leaves, so a process holding one SKIPS the
+            # collective the populated processes enter — a silent deadlock. One tiny
+            # fixed-shape count gather per cat state (every rank participates)
+            # distinguishes "empty everywhere" (benign: all ranks skip consistently)
+            # from mixed emptiness, which fails loud ON EVERY RANK.
+            from jax.experimental import multihost_utils
+
+            for attr, reduction_fn in self._reductions.items():
+                if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list):
+                    counts = np.asarray(
+                        multihost_utils.process_allgather(
+                            jnp.asarray(len(input_dict[attr])), tiled=False
+                        )
+                    )
+                    if counts.max() > 0 and counts.min() == 0:
+                        raise TorchMetricsUserError(
+                            f"Cannot sync list state `{attr}`: processes"
+                            f" {np.flatnonzero(counts == 0).tolist()} hold no elements while"
+                            " others do — the empty ones would skip the all-gather and"
+                            " deadlock the rest. Ensure every process receives at least one"
+                            " update before compute(), or skip syncing"
+                            " (sync_on_compute=False) for ragged epochs."
+                        )
 
         output_dict = apply_to_collection(
             input_dict,

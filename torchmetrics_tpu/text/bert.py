@@ -74,6 +74,7 @@ class BERTScore(Metric):
         # make the ctor heavy and pickling awkward)
         self._forward_fn: Optional[Callable] = None
         self._tokenize_fn: Optional[Callable] = None
+        self._pad_width = max_length
         self._resolved = False
 
         # tokenized-tensor states (reference parity): fixed-width int arrays that
@@ -93,11 +94,12 @@ class BERTScore(Metric):
         # a tokenizer-only resolution could store arrays the forward cannot consume
         if self._resolved:
             return
-        forward, tokenizer = _resolve_model_and_tokenizer(
+        forward, tokenizer, pad_width = _resolve_model_and_tokenizer(
             self.model_name_or_path, self.num_layers, self.model, self.user_tokenizer, self.max_length
         )
         self._forward_fn = self.user_forward_fn if self.user_forward_fn is not None else forward
         self._tokenize_fn = tokenizer
+        self._pad_width = pad_width
         self._resolved = True
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
@@ -112,13 +114,32 @@ class BERTScore(Metric):
         if self._tokenize_fn is not None:
             p_tok = self._tokenize_fn(list(preds))
             t_tok = self._tokenize_fn(list(target))
-            self.pred_input_ids.append(jnp.asarray(p_tok["input_ids"]))
-            self.pred_attention_mask.append(jnp.asarray(p_tok["attention_mask"]))
-            self.target_input_ids.append(jnp.asarray(t_tok["input_ids"]))
-            self.target_attention_mask.append(jnp.asarray(t_tok["attention_mask"]))
+            self.pred_input_ids.append(self._to_width(p_tok["input_ids"]))
+            self.pred_attention_mask.append(self._to_width(p_tok["attention_mask"]))
+            self.target_input_ids.append(self._to_width(t_tok["input_ids"]))
+            self.target_attention_mask.append(self._to_width(t_tok["attention_mask"]))
         else:
             self.preds.extend(preds)
             self.target.extend(target)
+
+    def _to_width(self, arr: Any) -> Array:
+        """Right-pad a tokenized batch to the fixed state width.
+
+        User tokenizers commonly pad dynamically (``padding='longest'``), giving a
+        different width per batch — but cat states (and the cross-process gather's
+        pre-concatenate) need one width. Zero padding is score-neutral: every
+        similarity/idf term is attention-mask-weighted.
+        """
+        arr = jnp.asarray(arr)
+        width = self._pad_width
+        if arr.shape[1] > width:
+            raise ValueError(
+                f"Tokenizer produced width {arr.shape[1]} > max_length={width}; truncate in the"
+                " tokenizer or raise `max_length` (silent truncation here would corrupt scores)."
+            )
+        if arr.shape[1] < width:
+            arr = jnp.pad(arr, ((0, 0), (0, width - arr.shape[1])))
+        return arr
 
     def _has_tokenized_state(self) -> bool:
         state = self.pred_input_ids
