@@ -1,0 +1,151 @@
+"""Audio-family sweeps: closed-form SNR/SI-SNR/SDR goldens, PIT permutation
+recovery, and invariances — the reference's case grid
+(``tests/unittests/audio/*``) with analytic oracles (no external audio libs).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from torchmetrics_tpu.functional.audio import (
+    permutation_invariant_training,
+    scale_invariant_signal_distortion_ratio,
+    signal_noise_ratio,
+)
+
+_RNG = np.random.RandomState(71)
+
+
+def _snr_golden(preds, target, zero_mean=False):
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    noise = preds - target
+    return 10 * np.log10((target**2).sum(-1) / (noise**2).sum(-1))
+
+
+def _si_sdr_golden(preds, target, zero_mean=False):
+    """Reference default is zero_mean=False (the flag is opt-in)."""
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    proj = alpha * target
+    noise = preds - proj
+    return 10 * np.log10((proj**2).sum(-1) / (noise**2).sum(-1))
+
+
+def test_snr_closed_form():
+    t = _RNG.randn(4, 256)
+    p = t + 0.1 * _RNG.randn(4, 256)
+    got = np.asarray(signal_noise_ratio(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, _snr_golden(p, t), rtol=1e-5)
+
+
+def test_snr_known_amplitude_ratio():
+    """Pure sine + noise at exactly -20 dB: SNR == 20 dB."""
+    n = 4096
+    t = np.sin(np.linspace(0, 40 * np.pi, n))
+    noise = np.sin(np.linspace(0, 27 * np.pi, n) + 0.5)
+    noise = noise / np.linalg.norm(noise) * np.linalg.norm(t) * 0.1
+    got = float(signal_noise_ratio(jnp.asarray(t + noise), jnp.asarray(t)))
+    np.testing.assert_allclose(got, 20.0, atol=1e-4)
+
+
+def test_si_sdr_closed_form_and_scale_invariance():
+    t = _RNG.randn(3, 512)
+    p = t + 0.2 * _RNG.randn(3, 512)
+    got = np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, _si_sdr_golden(p, t), rtol=1e-5)
+    scaled = np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(7.3 * p), jnp.asarray(t)))
+    np.testing.assert_allclose(scaled, got, rtol=1e-4)
+
+
+@pytest.mark.parametrize(
+    ("cls", "golden"),
+    [
+        (SignalNoiseRatio, lambda p, t: _snr_golden(p, t).mean()),
+        (ScaleInvariantSignalDistortionRatio, lambda p, t: _si_sdr_golden(p, t).mean()),
+        (ScaleInvariantSignalNoiseRatio, None),  # == si-sdr on zero-mean inputs
+    ],
+)
+def test_modular_stream_equals_batch(cls, golden):
+    t = _RNG.randn(6, 300)
+    p = t + 0.15 * _RNG.randn(6, 300)
+    whole = cls()
+    whole.update(jnp.asarray(p), jnp.asarray(t))
+    want = float(whole.compute())
+    stream = cls()
+    for lo in range(0, 6, 2):
+        stream.update(jnp.asarray(p[lo : lo + 2]), jnp.asarray(t[lo : lo + 2]))
+    np.testing.assert_allclose(float(stream.compute()), want, rtol=1e-5)
+    if golden is not None:
+        np.testing.assert_allclose(want, golden(p, t), rtol=1e-4)
+
+
+def test_sdr_close_to_si_sdr_for_zero_mean():
+    t = _RNG.randn(2, 400)
+    t -= t.mean(-1, keepdims=True)
+    p = t + 0.1 * _RNG.randn(2, 400)
+    m = SignalDistortionRatio()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    sdr = float(m.compute())
+    si = float(np.mean(_si_sdr_golden(p, t)))
+    assert abs(sdr - si) < 5.0  # same regime; SDR's 512-tap filtered projection scores higher
+    assert sdr >= si - 1e-3
+
+
+# ------------------------------------------------------------------ PIT
+
+
+def test_pit_recovers_permutation():
+    """Sources shuffled by a known permutation: PIT must find it exactly."""
+    n_src, length = 3, 200
+    target = _RNG.randn(2, n_src, length)
+    perm = np.array([2, 0, 1])
+    preds = target[:, perm, :] + 0.01 * _RNG.randn(2, n_src, length)
+
+    best_metric, best_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target),
+        scale_invariant_signal_distortion_ratio, eval_func="max",
+    )
+    inv = np.argsort(perm)  # mapping preds index -> target index
+    for b in range(2):
+        np.testing.assert_array_equal(np.asarray(best_perm[b]), inv)
+    assert float(jnp.mean(best_metric)) > 20  # near-clean alignment
+
+
+def test_pit_beats_every_fixed_permutation():
+    n_src = 3
+    target = _RNG.randn(1, n_src, 150)
+    preds = target[:, [1, 2, 0], :] + 0.3 * _RNG.randn(1, n_src, 150)
+    best_metric, _ = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target),
+        scale_invariant_signal_distortion_ratio, eval_func="max",
+    )
+    best = float(jnp.mean(best_metric))
+    for perm in itertools.permutations(range(n_src)):
+        fixed = np.mean(_si_sdr_golden(np.asarray(preds)[:, list(perm), :], target))
+        assert best >= fixed - 1e-4
+
+
+def test_pit_modular_accumulates():
+    t1 = _RNG.randn(2, 2, 100)
+    p1 = t1[:, ::-1, :] + 0.05 * _RNG.randn(2, 2, 100)
+    m = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, eval_func="max")
+    m.update(jnp.asarray(p1), jnp.asarray(t1))
+    v1 = float(m.compute())
+    m.update(jnp.asarray(p1), jnp.asarray(t1))
+    np.testing.assert_allclose(float(m.compute()), v1, rtol=1e-6)  # same data -> same mean

@@ -1,0 +1,142 @@
+"""Structural-image-metric sweeps: analytic goldens, parameter grids, and
+degenerate inputs — the reference's case matrix for PSNR/SSIM/UQI/SAM/TV
+(``tests/unittests/image/*``) without skimage (not installed): goldens are closed
+forms or hand-rolled numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from torchmetrics_tpu.functional.image.ssim import structural_similarity_index_measure
+from torchmetrics_tpu.image import (
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+)
+
+_RNG = np.random.RandomState(61)
+
+
+# ------------------------------------------------------------------ PSNR
+
+
+@pytest.mark.parametrize("data_range", [1.0, 255.0])
+def test_psnr_closed_form(data_range):
+    a = _RNG.rand(2, 3, 16, 16).astype(np.float64) * data_range
+    b = np.clip(a + _RNG.randn(2, 3, 16, 16) * 0.05 * data_range, 0, data_range)
+    got = float(peak_signal_noise_ratio(jnp.asarray(b), jnp.asarray(a), data_range=data_range))
+    mse = np.mean((a - b) ** 2)
+    want = 10 * np.log10(data_range**2 / mse)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_psnr_identical_is_infinite_or_huge():
+    a = jnp.asarray(_RNG.rand(1, 3, 8, 8))
+    got = float(peak_signal_noise_ratio(a, a, data_range=1.0))
+    assert got > 80 or np.isinf(got)
+
+
+def test_psnr_base_argument():
+    """base=e gives PSNR in nats: ratio ln(10)/10 vs the dB value."""
+    a = _RNG.rand(1, 3, 8, 8)
+    b = np.clip(a + 0.1 * _RNG.randn(1, 3, 8, 8), 0, 1)
+    db = float(peak_signal_noise_ratio(jnp.asarray(b), jnp.asarray(a), data_range=1.0, base=10))
+    nat = float(peak_signal_noise_ratio(jnp.asarray(b), jnp.asarray(a), data_range=1.0, base=2.718281828))
+    np.testing.assert_allclose(nat / db, np.log(10), rtol=1e-3)
+
+
+def test_psnr_accumulation_weighted_by_elements():
+    """Streaming PSNR folds sum-squared-error and counts, not per-batch dB."""
+    a1, a2 = _RNG.rand(2, 1, 8, 8), _RNG.rand(3, 1, 8, 8)
+    b1 = np.clip(a1 + 0.05 * _RNG.randn(*a1.shape), 0, 1)
+    b2 = np.clip(a2 + 0.20 * _RNG.randn(*a2.shape), 0, 1)
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    m.update(jnp.asarray(b1), jnp.asarray(a1))
+    m.update(jnp.asarray(b2), jnp.asarray(a2))
+    got = float(m.compute())
+    mse = (np.sum((a1 - b1) ** 2) + np.sum((a2 - b2) ** 2)) / (a1.size + a2.size)
+    np.testing.assert_allclose(got, 10 * np.log10(1.0 / mse), rtol=1e-5)
+
+
+# ------------------------------------------------------------------ SSIM
+
+
+def test_ssim_identical_is_one():
+    a = jnp.asarray(_RNG.rand(2, 3, 32, 32))
+    np.testing.assert_allclose(
+        float(structural_similarity_index_measure(a, a, data_range=1.0)), 1.0, atol=1e-6
+    )
+
+
+def test_ssim_constant_shift_penalized_by_luminance_only():
+    """A constant offset keeps structure/contrast at 1; SSIM equals the closed-form
+    luminance term (2*mu1*mu2 + c1) / (mu1^2 + mu2^2 + c1) for flat images."""
+    mu1, mu2 = 0.4, 0.6
+    a = jnp.full((1, 1, 32, 32), mu1)
+    b = jnp.full((1, 1, 32, 32), mu2)
+    got = float(structural_similarity_index_measure(a, b, data_range=1.0))
+    c1 = (0.01 * 1.0) ** 2
+    c2 = (0.03 * 1.0) ** 2
+    want = ((2 * mu1 * mu2 + c1) * c2) / ((mu1**2 + mu2**2 + c1) * c2)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("kernel_size", [7, 11, 13])
+@pytest.mark.parametrize("sigma", [1.0, 1.5, 2.5])
+def test_ssim_parameter_grid_monotone(kernel_size, sigma):
+    a = _RNG.rand(1, 1, 48, 48)
+    near = np.clip(a + 0.02 * _RNG.randn(*a.shape), 0, 1)
+    far = np.clip(a + 0.3 * _RNG.randn(*a.shape), 0, 1)
+    s_near = float(structural_similarity_index_measure(
+        jnp.asarray(near), jnp.asarray(a), data_range=1.0, kernel_size=kernel_size, sigma=sigma))
+    s_far = float(structural_similarity_index_measure(
+        jnp.asarray(far), jnp.asarray(a), data_range=1.0, kernel_size=kernel_size, sigma=sigma))
+    assert 1.0 > s_near > s_far > -1.0
+
+
+def test_ssim_modular_stream_equals_batch():
+    a = _RNG.rand(6, 3, 24, 24).astype(np.float32)
+    b = np.clip(a + 0.1 * _RNG.randn(*a.shape).astype(np.float32), 0, 1)
+    whole = StructuralSimilarityIndexMeasure(data_range=1.0)
+    whole.update(jnp.asarray(b), jnp.asarray(a))
+    stream = StructuralSimilarityIndexMeasure(data_range=1.0)
+    for lo in range(0, 6, 2):
+        stream.update(jnp.asarray(b[lo : lo + 2]), jnp.asarray(a[lo : lo + 2]))
+    np.testing.assert_allclose(float(stream.compute()), float(whole.compute()), rtol=1e-5)
+
+
+# ------------------------------------------------------------------ UQI / TV
+
+
+def test_uqi_identical_is_one():
+    a = jnp.asarray(_RNG.rand(2, 3, 32, 32))
+    m = UniversalImageQualityIndex()
+    m.update(a, a)
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-5)
+
+
+def test_total_variation_closed_form():
+    """TV of a horizontal ramp: only horizontal diffs contribute."""
+    ramp = np.tile(np.arange(8, dtype=np.float64), (8, 1))[None, None]
+    m = TotalVariation()
+    m.update(jnp.asarray(ramp))
+    got = float(m.compute())
+    want = 8 * 7 * 1.0  # 8 rows x 7 unit steps, no vertical variation
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_total_variation_accumulates_over_batches():
+    x1 = _RNG.rand(2, 3, 12, 12)
+    x2 = _RNG.rand(3, 3, 12, 12)
+    whole = TotalVariation()
+    whole.update(jnp.asarray(np.concatenate([x1, x2])))
+    stream = TotalVariation()
+    stream.update(jnp.asarray(x1))
+    stream.update(jnp.asarray(x2))
+    np.testing.assert_allclose(float(stream.compute()), float(whole.compute()), rtol=1e-6)

@@ -1,0 +1,161 @@
+"""Retrieval-family sweeps: hand goldens per query, empty-target policies, top_k
+grids, and multi-query accumulation across batches — the reference's case matrix
+(``tests/unittests/retrieval/helpers.py`` + per-metric files).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+
+_RNG = np.random.RandomState(53)
+N_QUERIES = 7
+DOCS = (4, 9, 6, 5, 8, 3, 7)  # ragged per-query document counts
+
+
+def _make_epoch(all_relevant=True, seed=0):
+    rng = np.random.RandomState(seed)
+    scores, rel, idx = [], [], []
+    for q, n in enumerate(DOCS):
+        scores.append(rng.rand(n).astype(np.float32))
+        r = rng.randint(0, 2, n)
+        if all_relevant and r.sum() == 0:
+            r[rng.randint(n)] = 1
+        rel.append(r)
+        idx.append(np.full(n, q))
+    return np.concatenate(scores), np.concatenate(rel), np.concatenate(idx)
+
+
+def _per_query(scores, rel, idx):
+    for q in np.unique(idx):
+        sel = idx == q
+        order = np.argsort(-scores[sel], kind="stable")
+        yield rel[sel][order]
+
+
+def _golden(metric_name, ranked, k=None):
+    n = len(ranked)
+    k = n if k is None else min(k, n)
+    n_rel = ranked.sum()
+    if metric_name == "precision":
+        return ranked[:k].sum() / k
+    if metric_name == "recall":
+        return ranked[:k].sum() / max(n_rel, 1)
+    if metric_name == "hit_rate":
+        return float(ranked[:k].sum() > 0)
+    if metric_name == "mrr":
+        first = np.flatnonzero(ranked)
+        return 1.0 / (first[0] + 1) if first.size else 0.0
+    if metric_name == "map":
+        if n_rel == 0:
+            return 0.0
+        prec_at_hit = [(ranked[: i + 1].sum() / (i + 1)) for i in np.flatnonzero(ranked)]
+        return float(np.mean(prec_at_hit))
+    if metric_name == "r_precision":
+        return ranked[: max(n_rel, 1)].sum() / max(n_rel, 1)
+    if metric_name == "fall_out":
+        n_irrel = n - n_rel
+        return float((1 - ranked[:k]).sum() / max(n_irrel, 1))
+    if metric_name == "ndcg":
+        discounts = 1.0 / np.log2(np.arange(2, k + 2))
+        dcg = (ranked[:k] * discounts).sum()
+        ideal = np.sort(ranked)[::-1]
+        idcg = (ideal[:k] * discounts).sum()
+        return dcg / idcg if idcg > 0 else 0.0
+    raise KeyError(metric_name)
+
+
+_CASES = [
+    (RetrievalPrecision, "precision", {}),
+    (RetrievalRecall, "recall", {}),
+    (RetrievalHitRate, "hit_rate", {}),
+    (RetrievalMRR, "mrr", {}),
+    (RetrievalMAP, "map", {}),
+    (RetrievalRPrecision, "r_precision", {}),
+    (RetrievalFallOut, "fall_out", {}),
+    (RetrievalNormalizedDCG, "ndcg", {}),
+]
+
+
+@pytest.mark.parametrize(("cls", "name", "kwargs"), _CASES)
+@pytest.mark.parametrize("n_batches", [1, 3])
+def test_vs_hand_golden(cls, name, kwargs, n_batches):
+    scores, rel, idx = _make_epoch(seed=3)
+    m = cls(**kwargs)
+    for s, r, i in zip(
+        np.array_split(scores, n_batches), np.array_split(rel, n_batches), np.array_split(idx, n_batches)
+    ):
+        m.update(jnp.asarray(s), jnp.asarray(r), indexes=jnp.asarray(i))
+    got = float(m.compute())
+    want = np.mean([_golden(name, ranked) for ranked in _per_query(scores, rel, idx)])
+    np.testing.assert_allclose(got, want, atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize("k", [1, 2, 3])
+@pytest.mark.parametrize(
+    ("cls", "name"),
+    [(RetrievalPrecision, "precision"), (RetrievalRecall, "recall"), (RetrievalHitRate, "hit_rate"),
+     (RetrievalFallOut, "fall_out"), (RetrievalNormalizedDCG, "ndcg")],
+)
+def test_top_k_grid(cls, name, k):
+    scores, rel, idx = _make_epoch(seed=11)
+    m = cls(top_k=k)
+    m.update(jnp.asarray(scores), jnp.asarray(rel), indexes=jnp.asarray(idx))
+    got = float(m.compute())
+    want = np.mean([_golden(name, ranked, k=k) for ranked in _per_query(scores, rel, idx)])
+    np.testing.assert_allclose(got, want, atol=1e-6, err_msg=f"{name}@{k}")
+
+
+@pytest.mark.parametrize("action", ["skip", "neg", "pos"])
+def test_empty_target_actions(action):
+    """A query with zero relevant documents follows the configured policy
+    (reference ``retrieval/base.py`` empty_target_action)."""
+    scores = jnp.asarray([0.9, 0.1, 0.8, 0.3])
+    rel = jnp.asarray([1, 0, 0, 0])  # query 0 has a hit, query 1 has none
+    idx = jnp.asarray([0, 0, 1, 1])
+    m = RetrievalMRR(empty_target_action=action)
+    m.update(scores, rel, indexes=idx)
+    got = float(m.compute())
+    q0 = 1.0
+    if action == "skip":
+        want = q0
+    elif action == "neg":
+        want = (q0 + 0.0) / 2
+    else:
+        want = (q0 + 1.0) / 2
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_empty_target_error_action():
+    m = RetrievalMRR(empty_target_action="error")
+    m.update(jnp.asarray([0.5]), jnp.asarray([0]), indexes=jnp.asarray([0]))
+    with pytest.raises(ValueError, match="`compute` method was provided with a query with no positive target"):
+        m.compute()
+
+
+def test_indexes_define_queries_not_update_boundaries():
+    """The same index appearing in two updates folds into ONE query."""
+    m = RetrievalPrecision()
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]), indexes=jnp.asarray([0, 0]))
+    m.update(jnp.asarray([0.7, 0.1]), jnp.asarray([0, 1]), indexes=jnp.asarray([0, 0]))
+    got = float(m.compute())
+    np.testing.assert_allclose(got, 0.5, atol=1e-6)  # one query: 2 relevant of 4 docs
+
+
+def test_missing_indexes_raises():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="`indexes` cannot be None"):
+        m.update(jnp.asarray([0.5]), jnp.asarray([1]), indexes=None)

@@ -293,7 +293,7 @@ def test_mixed_empty_cat_state_sync_raises(monkeypatch):
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(multihost_utils, "process_allgather",
-                        lambda x, tiled=False: np.asarray([0, 1]))
+                        lambda x, tiled=False: np.asarray([[0], [1]]))  # (world, n_cat_states)
 
     m = CatMetric(dist_sync_fn=lambda x, group=None: [x, x],
                   distributed_available_fn=lambda: True)
@@ -301,6 +301,6 @@ def test_mixed_empty_cat_state_sync_raises(monkeypatch):
         m._sync_dist(dist_sync_fn=m.dist_sync_fn)
 
     monkeypatch.setattr(multihost_utils, "process_allgather",
-                        lambda x, tiled=False: np.asarray([0, 0]))
+                        lambda x, tiled=False: np.asarray([[0], [0]]))
     m._sync_dist(dist_sync_fn=m.dist_sync_fn)  # all-empty: consistent no-op
     assert m.value == []

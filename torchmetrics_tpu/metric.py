@@ -417,28 +417,32 @@ class Metric:
 
         if jax.process_count() > 1:
             # an empty list state has no leaves, so a process holding one SKIPS the
-            # collective the populated processes enter — a silent deadlock. One tiny
-            # fixed-shape count gather per cat state (every rank participates)
+            # collective the populated processes enter — a silent deadlock. ONE tiny
+            # fixed-shape count gather covering every cat state at once (every rank
+            # participates; attr order is the shared _reductions insertion order)
             # distinguishes "empty everywhere" (benign: all ranks skip consistently)
             # from mixed emptiness, which fails loud ON EVERY RANK.
-            from jax.experimental import multihost_utils
+            cat_attrs = [
+                attr
+                for attr, fn in self._reductions.items()
+                if fn == dim_zero_cat and isinstance(input_dict[attr], list)
+            ]
+            if cat_attrs:
+                from jax.experimental import multihost_utils
 
-            for attr, reduction_fn in self._reductions.items():
-                if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list):
-                    counts = np.asarray(
-                        multihost_utils.process_allgather(
-                            jnp.asarray(len(input_dict[attr])), tiled=False
-                        )
+                local_counts = jnp.asarray([len(input_dict[a]) for a in cat_attrs])
+                counts = np.asarray(multihost_utils.process_allgather(local_counts, tiled=False))
+                mixed = (counts.max(axis=0) > 0) & (counts.min(axis=0) == 0)
+                if mixed.any():
+                    attr = cat_attrs[int(np.flatnonzero(mixed)[0])]
+                    empties = np.flatnonzero(counts[:, int(np.flatnonzero(mixed)[0])] == 0)
+                    raise TorchMetricsUserError(
+                        f"Cannot sync list state `{attr}`: processes {empties.tolist()} hold"
+                        " no elements while others do — the empty ones would skip the"
+                        " all-gather and deadlock the rest. Ensure every process receives at"
+                        " least one update before compute(), or skip syncing"
+                        " (sync_on_compute=False) for ragged epochs."
                     )
-                    if counts.max() > 0 and counts.min() == 0:
-                        raise TorchMetricsUserError(
-                            f"Cannot sync list state `{attr}`: processes"
-                            f" {np.flatnonzero(counts == 0).tolist()} hold no elements while"
-                            " others do — the empty ones would skip the all-gather and"
-                            " deadlock the rest. Ensure every process receives at least one"
-                            " update before compute(), or skip syncing"
-                            " (sync_on_compute=False) for ragged epochs."
-                        )
 
         output_dict = apply_to_collection(
             input_dict,

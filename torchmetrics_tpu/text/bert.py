@@ -133,9 +133,18 @@ class BERTScore(Metric):
         arr = jnp.asarray(arr)
         width = self._pad_width
         if arr.shape[1] > width:
+            capped = width < self.max_length
+            constraint = (
+                f"the model's position-embedding capacity ({width}, which capped your"
+                f" max_length={self.max_length})" if capped else f"max_length={width}"
+            )
+            remedy = (
+                "truncate in the tokenizer or use a model with more positions"
+                if capped else "truncate in the tokenizer or raise `max_length`"
+            )
             raise ValueError(
-                f"Tokenizer produced width {arr.shape[1]} > max_length={width}; truncate in the"
-                " tokenizer or raise `max_length` (silent truncation here would corrupt scores)."
+                f"Tokenizer produced width {arr.shape[1]} > {constraint}; {remedy}"
+                " (silent truncation here would corrupt scores)."
             )
         if arr.shape[1] < width:
             arr = jnp.pad(arr, ((0, 0), (0, width - arr.shape[1])))
