@@ -359,3 +359,131 @@ def test_running_forward_only_use_does_not_warn():
         warnings.simplefilter("error")
         assert float(metric.compute()) == pytest.approx(1.0)
     assert metric.update_count == 1
+
+
+# ---------------------------------------------------------------- MetricTracker
+
+
+def test_tracker_best_metric_and_history():
+    from torchmetrics_tpu.wrappers import MetricTracker
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    tracker = MetricTracker(BinaryAccuracy(), maximize=True)
+    streams = [
+        (jnp.asarray([1, 1, 0, 0]), jnp.asarray([1, 0, 0, 0])),   # acc 0.75
+        (jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1])),   # acc 1.00
+        (jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1])),   # acc 0.00
+    ]
+    for preds, target in streams:
+        tracker.increment()
+        tracker.update(preds, target)
+    assert tracker.n_steps == 3
+    history = np.asarray([float(v) for v in tracker.compute_all()])
+    np.testing.assert_allclose(history, [0.75, 1.0, 0.0], atol=1e-6)
+    best, which = tracker.best_metric(return_step=True)
+    np.testing.assert_allclose(float(best), 1.0, atol=1e-6)
+    assert which == 1
+
+
+def test_tracker_minimize_direction():
+    from torchmetrics_tpu.wrappers import MetricTracker
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    tracker = MetricTracker(MeanSquaredError(), maximize=False)
+    for offset in (1.0, 0.1, 0.5):
+        tracker.increment()
+        x = jnp.asarray([0.0, 1.0, 2.0])
+        tracker.update(x + offset, x)
+    best, step = tracker.best_metric(return_step=True)
+    np.testing.assert_allclose(float(best), 0.01, atol=1e-6)
+    assert step == 1
+
+
+def test_tracker_over_collection():
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.wrappers import MetricTracker
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryPrecision
+
+    tracker = MetricTracker(
+        MetricCollection([BinaryAccuracy(), BinaryPrecision()]), maximize=[True, True]
+    )
+    tracker.increment()
+    tracker.update(jnp.asarray([1, 0, 1, 0]), jnp.asarray([1, 0, 0, 0]))
+    tracker.increment()
+    tracker.update(jnp.asarray([1, 0, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+    best = tracker.best_metric()
+    np.testing.assert_allclose(float(best["BinaryAccuracy"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(best["BinaryPrecision"]), 1.0, atol=1e-6)
+    assert len(tracker.compute_all()["BinaryAccuracy"]) == 2
+
+
+def test_tracker_requires_increment():
+    from torchmetrics_tpu.wrappers import MetricTracker
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    tracker = MetricTracker(BinaryAccuracy())
+    with pytest.raises(ValueError, match="increment"):
+        tracker.update(jnp.asarray([1]), jnp.asarray([1]))
+
+
+# ---------------------------------------------------------------- MinMaxMetric
+
+
+def test_minmax_tracks_extrema_of_compute():
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    mm = MinMaxMetric(BinaryAccuracy())
+    out1 = mm(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 0, 0]))  # batch acc 0.5
+    np.testing.assert_allclose(float(out1["raw"]), 0.5, atol=1e-6)
+    # reference-parity quirk: every forward() resets the extrema before re-applying
+    # the batch (full-state path), so after a second forward min == max == batch value
+    out2 = mm(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))  # batch acc 1.0
+    np.testing.assert_allclose(float(out2["raw"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(out2["max"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(out2["min"]), 1.0, atol=1e-6)
+    # reference-parity: forward's full-state path caches only the wrapper's OWN
+    # states (none), so the base metric keeps only the LAST batch across forwards
+    # (metric.py _forward_full_state_update cache = self._defaults) — epoch compute
+    # therefore reflects batch 2 alone
+    epoch = mm.compute()
+    np.testing.assert_allclose(float(epoch["raw"]), 1.0, atol=1e-6)
+
+
+def test_minmax_update_path_accumulates():
+    """Plain update() (the reference docstring flow) accumulates normally and the
+    extrema fold each compute value."""
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    mm = MinMaxMetric(BinaryAccuracy())
+    mm.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+    out1 = mm.compute()
+    np.testing.assert_allclose(float(out1["raw"]), 1.0, atol=1e-6)
+    mm.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 0, 0]))
+    out2 = mm.compute()
+    np.testing.assert_allclose(float(out2["raw"]), 0.75, atol=1e-6)
+    np.testing.assert_allclose(float(out2["min"]), 0.75, atol=1e-6)
+    np.testing.assert_allclose(float(out2["max"]), 1.0, atol=1e-6)
+
+
+def test_minmax_reset_clears_extrema():
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    mm = MinMaxMetric(BinaryAccuracy())
+    mm(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+    mm.reset()
+    out = mm(jnp.asarray([1, 1]), jnp.asarray([1, 1]))
+    np.testing.assert_allclose(float(out["min"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(out["max"]), 1.0, atol=1e-6)
+
+
+def test_minmax_requires_scalar_base():
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+    from torchmetrics_tpu.classification import BinaryConfusionMatrix
+
+    mm = MinMaxMetric(BinaryConfusionMatrix())
+    mm.update(jnp.asarray([1.0, 0.0]), jnp.asarray([1, 0]))
+    with pytest.raises(RuntimeError, match="scalar"):
+        mm.compute()
