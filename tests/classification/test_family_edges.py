@@ -20,6 +20,7 @@ from sklearn.metrics import roc_auc_score as sk_auroc
 
 from torchmetrics_tpu.classification import (
     BinaryAUROC,
+    MulticlassSpecificity,
     BinaryAveragePrecision,
     BinaryF1Score,
     BinaryPrecision,
@@ -294,3 +295,108 @@ def test_auroc_single_class_target_is_degenerate():
         warnings.simplefilter("ignore")
         got = float(m.compute())
     assert np.isfinite(got)
+
+
+# ------------------------------------------------------------------ samplewise grids
+
+
+_EXTRA = 5
+_mc_md_probs = _softmax(_RNG.randn(29, NC, _EXTRA), axis=1)
+_mc_md_target = _RNG.randint(0, NC, (29, _EXTRA))
+_ml_md_probs = _RNG.rand(29, NL, _EXTRA)
+_ml_md_target = _RNG.randint(0, 2, (29, NL, _EXTRA))
+
+
+def _samplewise_counts_mc(probs, target, ignore_index=None):
+    """Per-sample (tp, fp, tn, fn) over the EXTRA dim, (N, C) each."""
+    hard = probs.argmax(1)  # (N, EXTRA)
+    n = hard.shape[0]
+    tps, fps, fns, tns = [], [], [], []
+    for s in range(n):
+        h, t = hard[s], target[s]
+        if ignore_index is not None:
+            keep = t != ignore_index
+            h, t = h[keep], t[keep]
+        tp = np.asarray([((h == c) & (t == c)).sum() for c in range(NC)], float)
+        fp = np.asarray([((h == c) & (t != c)).sum() for c in range(NC)], float)
+        fn = np.asarray([((h != c) & (t == c)).sum() for c in range(NC)], float)
+        tn = len(t) - tp - fp - fn
+        tps.append(tp); fps.append(fp); fns.append(fn); tns.append(tn)
+    return map(np.asarray, (tps, fps, tns, fns))
+
+
+def _reduce_samplewise(tp, fp, tn, fn, average, kind):
+    if kind == "precision":
+        per = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), 0.0)
+        micro_num, micro_den = tp.sum(1), (tp + fp).sum(1)
+    elif kind == "recall":
+        per = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+        micro_num, micro_den = tp.sum(1), (tp + fn).sum(1)
+    elif kind == "f1":
+        per = np.where(2 * tp + fp + fn > 0, 2 * tp / np.maximum(2 * tp + fp + fn, 1), 0.0)
+        micro_num, micro_den = 2 * tp.sum(1), (2 * tp + fp + fn).sum(1)
+    else:  # specificity
+        per = np.where(tn + fp > 0, tn / np.maximum(tn + fp, 1), 0.0)
+        micro_num, micro_den = tn.sum(1), (tn + fp).sum(1)
+    if average == "micro":
+        return micro_num / np.maximum(micro_den, 1)
+    mask = (tp + fp + fn) > 0  # dead classes drop from the per-sample macro
+    return np.where(mask, per, 0).sum(1) / np.maximum(mask.sum(1), 1)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize(
+    ("metric_cls", "kind"),
+    [
+        (MulticlassPrecision, "precision"),
+        (MulticlassRecall, "recall"),
+        (MulticlassF1Score, "f1"),
+        (MulticlassSpecificity, "specificity"),
+    ],
+)
+def test_multiclass_samplewise_grid(average, ignore_index, metric_cls, kind):
+    target = _mc_md_target.copy()
+    if ignore_index is not None:
+        flat = target.reshape(-1)
+        drop = np.random.RandomState(5).choice(flat.size, flat.size // 8, replace=False)
+        flat[drop] = ignore_index
+    m = metric_cls(
+        num_classes=NC, average=average, multidim_average="samplewise", ignore_index=ignore_index
+    )
+    m.update(jnp.asarray(_mc_md_probs), jnp.asarray(target))
+    got = np.asarray(m.compute())
+    tp, fp, tn, fn = _samplewise_counts_mc(_mc_md_probs, target, ignore_index)
+    want = _reduce_samplewise(tp, fp, tn, fn, average, kind)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multilabel_samplewise_grid(average):
+    from torchmetrics_tpu.classification import MultilabelF1Score
+
+    m = MultilabelF1Score(num_labels=NL, average=average, multidim_average="samplewise")
+    m.update(jnp.asarray(_ml_md_probs), jnp.asarray(_ml_md_target))
+    got = np.asarray(m.compute())
+    hard = (_ml_md_probs > 0.5).astype(int)  # (N, NL, EXTRA)
+    tp = ((hard == 1) & (_ml_md_target == 1)).sum(-1).astype(float)  # (N, NL)
+    fp = ((hard == 1) & (_ml_md_target == 0)).sum(-1).astype(float)
+    fn = ((hard == 0) & (_ml_md_target == 1)).sum(-1).astype(float)
+    per = np.where(2 * tp + fp + fn > 0, 2 * tp / np.maximum(2 * tp + fp + fn, 1), 0.0)
+    if average == "micro":
+        want = 2 * tp.sum(1) / np.maximum((2 * tp + fp + fn).sum(1), 1)
+    else:
+        want = per.mean(1)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_samplewise_stream_appends_rows():
+    """Samplewise states append one row per sample across updates."""
+    m = MulticlassF1Score(num_classes=NC, average="macro", multidim_average="samplewise")
+    m.update(jnp.asarray(_mc_md_probs[:10]), jnp.asarray(_mc_md_target[:10]))
+    m.update(jnp.asarray(_mc_md_probs[10:]), jnp.asarray(_mc_md_target[10:]))
+    got = np.asarray(m.compute())
+    whole = MulticlassF1Score(num_classes=NC, average="macro", multidim_average="samplewise")
+    whole.update(jnp.asarray(_mc_md_probs), jnp.asarray(_mc_md_target))
+    np.testing.assert_allclose(got, np.asarray(whole.compute()), atol=1e-7)

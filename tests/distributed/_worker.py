@@ -156,4 +156,32 @@ for key in ("precision", "recall", "f1"):
     assert got.shape == want.shape == (2 * WORLD,), (key, got.shape)
     np.testing.assert_allclose(got, want, atol=1e-5, err_msg=key)
 
+# --- 8. ROUGE per-pair score arrays + SQuAD sum scalars sync across ranks ------------
+from torchmetrics_tpu.text import ROUGEScore, SQuAD  # noqa: E402
+
+r_preds = [s.replace("a", "the") for s in sentences]
+rouge_dist = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+rouge_dist.update(r_preds[lo:hi], sentences[lo:hi])
+rouge_synced = rouge_dist.compute()
+rouge_whole = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+rouge_whole.update(r_preds[: 2 * WORLD], sentences[: 2 * WORLD])
+rouge_whole._to_sync = False
+rouge_golden = rouge_whole.compute()
+for key in ("rouge1_fmeasure", "rougeL_fmeasure"):
+    np.testing.assert_allclose(
+        float(rouge_synced[key]), float(rouge_golden[key]), atol=1e-6, err_msg=key
+    )
+
+sq_preds = [{"prediction_text": s, "id": str(i)} for i, s in enumerate(r_preds)]
+sq_target = [{"answers": {"answer_start": [0], "text": [s]}, "id": str(i)}
+             for i, s in enumerate(sentences)]
+squad_dist = SQuAD()
+squad_dist.update(sq_preds[lo:hi], sq_target[lo:hi])
+squad_synced = squad_dist.compute()
+squad_whole = SQuAD()
+squad_whole.update(sq_preds[: 2 * WORLD], sq_target[: 2 * WORLD])
+squad_whole._to_sync = False
+squad_golden = squad_whole.compute()
+np.testing.assert_allclose(float(squad_synced["f1"]), float(squad_golden["f1"]), atol=1e-5)
+
 print(f"RANK {RANK} PASS", flush=True)
