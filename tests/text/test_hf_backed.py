@@ -304,3 +304,30 @@ def test_mixed_empty_cat_state_sync_raises(monkeypatch):
                         lambda x, tiled=False: np.asarray([[0], [0]]))
     m._sync_dist(dist_sync_fn=m.dist_sync_fn)  # all-empty: consistent no-op
     assert m.value == []
+
+
+def test_infolm_tokenized_states_and_sync(tiny_bert_dir):
+    """InfoLM stores fixed-width token arrays (not strings) on the HF path; a
+    pluggable world-2 sync doubles the corpus and the mean score is unchanged
+    (same pairs twice); matches the functional API on the same inputs."""
+    from torchmetrics_tpu.text import InfoLM
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    preds = ["hello world", "the cat sat"]
+    target = ["hello world", "a cat sat"]
+    m = InfoLM(model_name_or_path=tiny_bert_dir, idf=False,
+               dist_sync_fn=lambda x, group=None: [x, x],
+               distributed_available_fn=lambda: True)
+    m.update(preds, target)
+    assert len(m.preds) == 0 and len(m.pred_input_ids) == 1
+    synced = float(m.compute())
+    want = float(infolm(preds, target, model_name_or_path=tiny_bert_dir, idf=False))
+    np.testing.assert_allclose(synced, want, atol=1e-6)  # near-zero KL: padding-width float noise ~1e-8
+
+    import pickle
+
+    plain = InfoLM(model_name_or_path=tiny_bert_dir, idf=True)
+    plain.update(preds, target)
+    clone = pickle.loads(pickle.dumps(plain))
+    assert clone._resolved is False
+    assert np.isfinite(float(clone.compute()))

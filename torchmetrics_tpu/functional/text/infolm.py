@@ -136,8 +136,58 @@ def make_hf_masked_lm_distribution_fn(
     )
 
     hf_model, tokenizer = load_hf_model_and_tokenizer(model_name_or_path, "FlaxAutoModelForMaskedLM")
-    forward = hf_logits_forward(hf_model)
     max_length = model_max_length(hf_model, max_length)
+
+    token_fn = make_hf_masked_lm_distribution_from_tokens_fn(model_name_or_path, temperature, idf)
+
+    def fn(sentences: List[str]) -> Array:
+        ids, attn = hf_tokenize(tokenizer, sentences, max_length=max_length, padding="longest")
+        return token_fn(ids, attn)
+
+    return fn
+
+
+def make_hf_masked_lm_distribution_fns(
+    model_name_or_path: str,
+    temperature: float = 0.25,
+    idf: bool = True,
+    max_length: int = 512,
+) -> Tuple[Callable[[List[str]], Tuple[Array, Array]], Callable[[Array, Array], Array], int]:
+    """(tokenize_fn, distribution_from_tokens_fn, pad_width) — the split pipeline.
+
+    The modular metric tokenizes at ``update`` (fixed ``pad_width`` so token arrays
+    ride the cross-process gather as cat states) and computes distributions at
+    ``compute`` over the gathered corpus, which keeps idf corpus-wide. Padding width
+    is score-neutral: pad tokens are special tokens, excluded from aggregation.
+    """
+    from torchmetrics_tpu.utilities.hf import (
+        hf_tokenize,
+        load_hf_model_and_tokenizer,
+        model_max_length,
+    )
+
+    hf_model, tokenizer = load_hf_model_and_tokenizer(model_name_or_path, "FlaxAutoModelForMaskedLM")
+    pad_width = model_max_length(hf_model, max_length)
+
+    def tokenize_fn(sentences: List[str]) -> Tuple[Array, Array]:
+        return hf_tokenize(tokenizer, sentences, max_length=pad_width, padding="max_length")
+
+    token_fn = make_hf_masked_lm_distribution_from_tokens_fn(model_name_or_path, temperature, idf)
+    return tokenize_fn, token_fn, pad_width
+
+
+def make_hf_masked_lm_distribution_from_tokens_fn(
+    model_name_or_path: str,
+    temperature: float = 0.25,
+    idf: bool = True,
+) -> Callable[[Array, Array], Array]:
+    """``(input_ids, attention_mask) -> (N, V)`` sentence distributions."""
+    import numpy as np
+
+    from torchmetrics_tpu.utilities.hf import hf_logits_forward, load_hf_model_and_tokenizer
+
+    hf_model, tokenizer = load_hf_model_and_tokenizer(model_name_or_path, "FlaxAutoModelForMaskedLM")
+    forward = hf_logits_forward(hf_model)
     mask_token_id = tokenizer.mask_token_id
     if mask_token_id is None:
         raise ValueError(
@@ -145,9 +195,19 @@ def make_hf_masked_lm_distribution_fn(
         )
     special_ids = [i for i in (tokenizer.pad_token_id, tokenizer.sep_token_id, tokenizer.cls_token_id) if i is not None]
 
-    def fn(sentences: List[str]) -> Array:
-        ids, attn = hf_tokenize(tokenizer, sentences, max_length=max_length, padding="longest")
+    def fn(ids: Array, attn: Array) -> Array:
         ids_np = np.asarray(ids)
+        attn_np = np.asarray(attn)
+        # trim trailing all-pad columns: the metric path pads to model_max_length for
+        # fixed-width gatherable states, but every forward is O(L^2) attention — and
+        # padding is score-neutral (pad positions are excluded from aggregation), so
+        # run the model at the corpus's true longest length
+        content_cols = np.flatnonzero(attn_np.any(axis=0))
+        if content_cols.size and content_cols[-1] + 1 < ids_np.shape[1]:
+            keep = int(content_cols[-1]) + 1
+            ids_np = ids_np[:, :keep]
+            attn_np = attn_np[:, :keep]
+        attn = jnp.asarray(attn_np)
         seq_len = ids_np.shape[1]
         # 1s on real content tokens (reference ``_get_token_mask:330-352``)
         token_mask = ~np.isin(ids_np, special_ids)
@@ -156,7 +216,10 @@ def make_hf_masked_lm_distribution_fn(
 
             # token_mask (not the attention mask) as the weight mask: special tokens
             # are excluded from the aggregation (reference ``infolm.py:398-401``)
-            pos_w = np.asarray(_idf_weights(ids_np, token_mask, _compute_idf([ids], [attn])), dtype=np.float64)
+            pos_w = np.asarray(
+                _idf_weights(ids_np, token_mask, _compute_idf([jnp.asarray(ids_np)], [attn])),
+                dtype=np.float64,
+            )
         else:
             pos_w = token_mask.astype(np.float64)
 
@@ -166,7 +229,7 @@ def make_hf_masked_lm_distribution_fn(
                 continue
             masked = ids_np.copy()
             masked[:, pos] = mask_token_id
-            logits = forward(jnp.asarray(masked), attn)  # (N, L, V)
+            logits = forward(jnp.asarray(masked), jnp.asarray(attn))  # (N, L, V)
             probs = np.asarray(jax.nn.softmax(logits[:, pos, :] / temperature, axis=-1), dtype=np.float64)
             contrib = probs * pos_w[:, pos : pos + 1]
             acc = contrib if acc is None else acc + contrib

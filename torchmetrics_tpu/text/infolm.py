@@ -1,4 +1,12 @@
-"""Modular InfoLM (reference ``src/torchmetrics/text/infolm.py``)."""
+"""Modular InfoLM (reference ``src/torchmetrics/text/infolm.py``).
+
+State design mirrors BERTScore: with ``model_name_or_path`` the metric tokenizes at
+``update`` and stores fixed-width ``input_ids``/``attention_mask`` ARRAYS as cat
+states that ride the cross-process gather — a multi-host eval computes sentence
+distributions (and corpus-wide idf) over the full gathered corpus. With an injected
+``model`` callable (sentences -> distributions) the raw-sentence buffers are kept,
+which aggregate per-host only.
+"""
 
 from __future__ import annotations
 
@@ -7,8 +15,13 @@ from typing import Any, Callable, List, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.text.infolm import infolm
+from torchmetrics_tpu.functional.text.infolm import (
+    _InformationMeasure,
+    infolm,
+    make_hf_masked_lm_distribution_fns,
+)
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -22,6 +35,10 @@ class InfoLM(Metric):
 
     preds: List[str]
     target: List[str]
+    pred_input_ids: List[Array]
+    pred_attention_mask: List[Array]
+    target_input_ids: List[Array]
+    target_attention_mask: List[Array]
 
     def __init__(
         self,
@@ -44,21 +61,68 @@ class InfoLM(Metric):
         self.beta = beta
         self.model = model
         self.return_sentence_level_score = return_sentence_level_score
-        # String buffers: raw (None) states — arrays-only sync cannot cat host strings.
+        # resolved lazily; dropped on pickle (closures over live HF models)
+        self._tokenize_fn: Optional[Callable] = None
+        self._dist_fn: Optional[Callable] = None
+        self._resolved = False
+
+        # tokenized-tensor states: fixed-width int arrays ride the array gather
+        self.add_state("pred_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("pred_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+        # raw-sentence fallback for injected-model configurations (host data)
         self.add_state("preds", [], dist_reduce_fx=None)
         self.add_state("target", [], dist_reduce_fx=None)
 
+    def _resolve(self) -> None:
+        if self._resolved:
+            return
+        if self.model is None and self.model_name_or_path is not None:
+            self._tokenize_fn, self._dist_fn, _ = make_hf_masked_lm_distribution_fns(
+                self.model_name_or_path, temperature=self.temperature, idf=self.idf
+            )
+        self._resolved = True
+
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
-        """Buffer raw sentences."""
+        """Tokenize and buffer (arrays on the HF path, raw sentences otherwise)."""
         if isinstance(preds, str):
             preds = [preds]
         if isinstance(target, str):
             target = [target]
-        self.preds.extend(preds)
-        self.target.extend(target)
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        self._resolve()
+        if self._tokenize_fn is not None:
+            p_ids, p_attn = self._tokenize_fn(list(preds))
+            t_ids, t_attn = self._tokenize_fn(list(target))
+            self.pred_input_ids.append(jnp.asarray(p_ids))
+            self.pred_attention_mask.append(jnp.asarray(p_attn))
+            self.target_input_ids.append(jnp.asarray(t_ids))
+            self.target_attention_mask.append(jnp.asarray(t_attn))
+        else:
+            self.preds.extend(preds)
+            self.target.extend(target)
+
+    def _has_tokenized_state(self) -> bool:
+        state = self.pred_input_ids
+        return len(state) > 0 if isinstance(state, list) else state.size > 0
 
     def compute(self) -> Union[Array, Tuple[Array, Array]]:
-        """Score all buffered sentences with the injected model."""
+        """Score the gathered corpus (tokenized path) or buffered sentences."""
+        if self._has_tokenized_state():
+            self._resolve()
+            measure = _InformationMeasure(self.information_measure, self.alpha, self.beta)
+            preds_distribution = self._dist_fn(
+                dim_zero_cat(self.pred_input_ids), dim_zero_cat(self.pred_attention_mask)
+            )
+            target_distribution = self._dist_fn(
+                dim_zero_cat(self.target_input_ids), dim_zero_cat(self.target_attention_mask)
+            )
+            scores = measure(preds_distribution, target_distribution)
+            if self.return_sentence_level_score:
+                return scores.mean(), scores
+            return scores.mean()
         return infolm(
             self.preds,
             self.target,
@@ -71,6 +135,12 @@ class InfoLM(Metric):
             model=self.model,
             return_sentence_level_score=self.return_sentence_level_score,
         )
+
+    def __getstate__(self) -> dict:
+        """Resolved HF closures are unpicklable — drop and re-resolve lazily."""
+        state = dict(super().__getstate__())
+        state.update(_resolved=False, _tokenize_fn=None, _dist_fn=None)
+        return state
 
     def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
         return self._plot(val, ax)
