@@ -1,0 +1,67 @@
+"""COCO-scale detection evaluation via the packed TPU path.
+
+The per-image-dict API (see ``detection_map.py``) is reference parity, but each image
+costs five separate device buffers — through a tunneled TPU every buffer fetch is
+~0.6 ms at epoch end, dwarfing the math at COCO scale. The packed update accepts the
+padded batch layout a batched NMS produces on device — ``boxes (B, M, 4)``,
+``scores (B, M)``, ``labels (B, M)``, ``num_boxes (B,)`` — storing ONE buffer per
+update call, so a 5k-image epoch fetches tens of buffers instead of ~50k and
+``compute()`` finishes in ~13 s (native C++ greedy matcher underneath). Both paths
+produce identical results and can mix within one epoch.
+"""
+
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+
+def main(n_images: int = 1000, n_classes: int = 80, batch: int = 250, max_boxes: int = 16) -> None:
+    rng = np.random.RandomState(0)
+    metric = MeanAveragePrecision()
+
+    for lo in range(0, n_images, batch):
+        b = min(batch, n_images - lo)
+        counts = rng.randint(1, max_boxes + 1, size=b).astype(np.int32)
+        pred_boxes = np.zeros((b, max_boxes, 4), np.float32)
+        pred_scores = np.zeros((b, max_boxes), np.float32)
+        pred_labels = np.zeros((b, max_boxes), np.int32)
+        tgt_boxes = np.zeros((b, max_boxes, 4), np.float32)
+        tgt_labels = np.zeros((b, max_boxes), np.int32)
+        for i, n in enumerate(counts):
+            xy = rng.rand(n, 2) * 500
+            wh = rng.rand(n, 2) * 120 + 8
+            boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+            labels = rng.randint(0, n_classes, n)
+            tgt_boxes[i, :n], tgt_labels[i, :n] = boxes, labels
+            pred_boxes[i, :n] = boxes + rng.randn(n, 4).astype(np.float32) * 2
+            pred_scores[i, :n] = rng.rand(n)
+            pred_labels[i, :n] = labels
+
+        metric.update(
+            dict(
+                boxes=jnp.asarray(pred_boxes),
+                scores=jnp.asarray(pred_scores),
+                labels=jnp.asarray(pred_labels),
+                num_boxes=jnp.asarray(counts),
+            ),
+            dict(
+                boxes=jnp.asarray(tgt_boxes),
+                labels=jnp.asarray(tgt_labels),
+                num_boxes=jnp.asarray(counts),
+            ),
+        )
+
+    t0 = time.perf_counter()
+    result = metric.compute()
+    elapsed = time.perf_counter() - t0
+    print(f"{n_images} images x {n_classes} classes: compute() in {elapsed:.1f}s")
+    for key in ("map", "map_50", "map_75", "map_small", "map_medium", "map_large", "mar_100"):
+        print(f"{key:>12s}: {float(result[key]):.4f}")
+
+
+if __name__ == "__main__":
+    main()
