@@ -426,6 +426,11 @@ def bench_map_coco_scale(n_images=5000, n_classes=80, batch=500, max_boxes=16):
     instead of ~50k through the tunnel; matching runs in the native C++
     ``coco_match`` kernel. Reference comparison: pycocotools on COCO val2017 is
     seconds-to-a-minute scale for the same accumulate+summarize work.
+
+    In-bench numbers are upper bounds with high variance (7-44 s observed): this
+    probe runs after the map300 probe has already dropped the tunneled stream into
+    ~100 ms polling mode, and that state taxes every remaining fetch. Run in
+    isolation the same compute measures ~11 s.
     """
     import jax.numpy as jnp
 
