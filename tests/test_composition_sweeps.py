@@ -1,0 +1,176 @@
+"""CompositionalMetric operator sweep: the arithmetic/comparison/bitwise/unary
+overload surface (``metric.py:863-999``), evaluated lazily against the eager
+numpy result — plus matmul, invert, indexing, and reflected bitwise forms.
+"""
+
+from __future__ import annotations
+
+import operator
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MeanMetric, SumMetric
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.metric import CompositionalMetric
+
+
+def _mean_with(values):
+    m = MeanMetric()
+    m.update(jnp.asarray(values))
+    return m
+
+
+_BINARY_OPS = [
+    (operator.add, "add"),
+    (operator.sub, "sub"),
+    (operator.mul, "mul"),
+    (operator.truediv, "truediv"),
+    (operator.floordiv, "floordiv"),
+    (operator.mod, "mod"),
+    (operator.pow, "pow"),
+    (operator.eq, "eq"),
+    (operator.ne, "ne"),
+    (operator.lt, "lt"),
+    (operator.le, "le"),
+    (operator.gt, "gt"),
+    (operator.ge, "ge"),
+]
+
+
+@pytest.mark.parametrize(("op", "name"), _BINARY_OPS, ids=[n for _, n in _BINARY_OPS])
+def test_metric_op_metric(op, name):
+    a = _mean_with([2.0, 4.0])   # 3.0
+    b = _mean_with([1.0, 3.0])   # 2.0
+    composed = op(a, b)
+    assert isinstance(composed, CompositionalMetric)
+    got = np.asarray(composed.compute())
+    want = op(3.0, 2.0)
+    np.testing.assert_allclose(got, np.asarray(want, dtype=np.float64), atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize(("op", "name"), _BINARY_OPS, ids=[n for _, n in _BINARY_OPS])
+def test_metric_op_scalar_and_reflected(op, name):
+    a = _mean_with([2.0, 4.0])  # 3.0
+    np.testing.assert_allclose(
+        np.asarray(op(a, 2.0).compute()), op(3.0, 2.0), atol=1e-6, err_msg=f"{name} scalar"
+    )
+    np.testing.assert_allclose(
+        np.asarray(op(5.0, a).compute()), op(5.0, 3.0), atol=1e-6, err_msg=f"r{name} scalar"
+    )
+
+
+def test_unary_ops_reference_quirks():
+    """The reference's unary quirks, reproduced exactly: ``+m`` is abs
+    (metric.py:994) and ``-m`` is ``-abs(m)`` (its ``_neg`` helper)."""
+    a = _mean_with([-2.0, -4.0])  # -3.0
+    np.testing.assert_allclose(float(abs(a).compute()), 3.0, atol=1e-6)
+    np.testing.assert_allclose(float((+a).compute()), 3.0, atol=1e-6)
+    np.testing.assert_allclose(float((-a).compute()), -3.0, atol=1e-6)  # -abs(-3)
+    b = _mean_with([2.0, 4.0])  # +3.0
+    np.testing.assert_allclose(float((-b).compute()), -3.0, atol=1e-6)
+
+
+def test_bitwise_ops_on_integer_metrics():
+    from torchmetrics_tpu.metric import Metric
+
+    class IntConst(Metric):
+        def __init__(self, v):
+            super().__init__()
+            self.add_state("v", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+            self._init_v = v
+
+        def update(self):
+            self.v = jnp.asarray(self._init_v, dtype=jnp.int32)
+
+        def compute(self):
+            return self.v
+
+    a = IntConst(6); a.update()
+    b = IntConst(3); b.update()
+    np.testing.assert_allclose(int((a & b).compute()), 6 & 3)
+    np.testing.assert_allclose(int((a | b).compute()), 6 | 3)
+    np.testing.assert_allclose(int((a ^ b).compute()), 6 ^ 3)
+
+
+def test_matmul_invert_getitem_and_reflected_bitwise():
+    from torchmetrics_tpu.metric import Metric
+
+    class Vec(Metric):
+        def __init__(self, vals):
+            super().__init__()
+            self.add_state("v", jnp.zeros(len(vals)), dist_reduce_fx="sum")
+            self._vals = jnp.asarray(vals, dtype=jnp.float64)
+
+        def update(self):
+            self.v = self._vals
+
+        def compute(self):
+            return self.v
+
+    a = Vec([1.0, 2.0]); a.update()
+    b = Vec([3.0, 4.0]); b.update()
+    np.testing.assert_allclose(float((a @ b).compute()), 11.0, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray((a[1]).compute()), 2.0, atol=1e-6
+    )
+
+    class IntVal(Metric):
+        def __init__(self, v):
+            super().__init__()
+            self.add_state("v", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+            self._v = v
+
+        def update(self):
+            self.v = jnp.asarray(self._v, dtype=jnp.int32)
+
+        def compute(self):
+            return self.v
+
+    m = IntVal(6); m.update()
+    np.testing.assert_allclose(int((~m).compute()), ~6)
+    # reflected bitwise: plain int on the left
+    np.testing.assert_allclose(int((5 & m).compute()), 5 & 6)
+    np.testing.assert_allclose(int((5 | m).compute()), 5 | 6)
+    np.testing.assert_allclose(int((5 ^ m).compute()), 5 ^ 6)
+
+
+def test_composition_is_lazy_and_tracks_updates():
+    """The DAG recomputes from CURRENT state: updating a leaf changes the result."""
+    a = MeanMetric()
+    b = MeanMetric()
+    c = a + b
+    a.update(jnp.asarray([1.0]))
+    b.update(jnp.asarray([2.0]))
+    np.testing.assert_allclose(float(c.compute()), 3.0, atol=1e-6)
+    a.update(jnp.asarray([3.0]))  # a's mean becomes 2.0
+    # no cache poke needed: composed compute() is never cached (metric.py:1002)
+    np.testing.assert_allclose(float(c.compute()), 4.0, atol=1e-6)
+
+
+def test_nested_composition_dag():
+    a = _mean_with([4.0])
+    b = _mean_with([2.0])
+    expr = (a + b) * (a - b) / b  # (6 * 2) / 2 = 6
+    np.testing.assert_allclose(float(expr.compute()), 6.0, atol=1e-6)
+
+
+def test_composition_update_fans_out():
+    """update on a composition updates every constituent metric."""
+    acc_a = BinaryAccuracy()
+    acc_b = BinaryAccuracy(threshold=0.3)
+    both = acc_a + acc_b
+    both.update(jnp.asarray([0.4, 0.9]), jnp.asarray([1, 1]))
+    np.testing.assert_allclose(float(acc_a.compute()), 0.5, atol=1e-6)   # 0.4 < 0.5 miss
+    np.testing.assert_allclose(float(acc_b.compute()), 1.0, atol=1e-6)   # 0.4 > 0.3 hit
+    np.testing.assert_allclose(float(both.compute()), 1.5, atol=1e-6)
+
+
+def test_composition_reset_fans_out():
+    a = _mean_with([5.0])
+    b = _mean_with([7.0])
+    c = a + b
+    c.reset()
+    assert a.update_count == 0 and b.update_count == 0
