@@ -73,31 +73,33 @@ def test_unary_ops_reference_quirks():
     np.testing.assert_allclose(float((-b).compute()), -3.0, atol=1e-6)
 
 
+from torchmetrics_tpu.metric import Metric
+
+
+class _IntConst(Metric):
+    """Constant int32-valued metric for the bitwise/invert overload tests."""
+
+    def __init__(self, v):
+        super().__init__()
+        self.add_state("v", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self._init_v = v
+
+    def update(self):
+        self.v = jnp.asarray(self._init_v, dtype=jnp.int32)
+
+    def compute(self):
+        return self.v
+
+
 def test_bitwise_ops_on_integer_metrics():
-    from torchmetrics_tpu.metric import Metric
-
-    class IntConst(Metric):
-        def __init__(self, v):
-            super().__init__()
-            self.add_state("v", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
-            self._init_v = v
-
-        def update(self):
-            self.v = jnp.asarray(self._init_v, dtype=jnp.int32)
-
-        def compute(self):
-            return self.v
-
-    a = IntConst(6); a.update()
-    b = IntConst(3); b.update()
+    a = _IntConst(6); a.update()
+    b = _IntConst(3); b.update()
     np.testing.assert_allclose(int((a & b).compute()), 6 & 3)
     np.testing.assert_allclose(int((a | b).compute()), 6 | 3)
     np.testing.assert_allclose(int((a ^ b).compute()), 6 ^ 3)
 
 
 def test_matmul_invert_getitem_and_reflected_bitwise():
-    from torchmetrics_tpu.metric import Metric
-
     class Vec(Metric):
         def __init__(self, vals):
             super().__init__()
@@ -117,19 +119,7 @@ def test_matmul_invert_getitem_and_reflected_bitwise():
         np.asarray((a[1]).compute()), 2.0, atol=1e-6
     )
 
-    class IntVal(Metric):
-        def __init__(self, v):
-            super().__init__()
-            self.add_state("v", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
-            self._v = v
-
-        def update(self):
-            self.v = jnp.asarray(self._v, dtype=jnp.int32)
-
-        def compute(self):
-            return self.v
-
-    m = IntVal(6); m.update()
+    m = _IntConst(6); m.update()
     np.testing.assert_allclose(int((~m).compute()), ~6)
     # reflected bitwise: plain int on the left
     np.testing.assert_allclose(int((5 & m).compute()), 5 & 6)
