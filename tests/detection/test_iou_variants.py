@@ -1,0 +1,186 @@
+"""IoU-variant closed-form sweeps: GIoU/DIoU/CIoU on constructed geometry where
+every term of the penalty is computable by hand, plus the modular metrics'
+iou_threshold / respect_labels / class_metrics grids (reference
+``tests/unittests/detection/test_intersection.py`` case families).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+)
+from torchmetrics_tpu.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+)
+
+
+def _iou_hand(a, b):
+    lt = np.maximum(a[:2], b[:2])
+    rb = np.minimum(a[2:], b[2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[0] * wh[1]
+    area = lambda x: (x[2] - x[0]) * (x[3] - x[1])  # noqa: E731
+    return inter / (area(a) + area(b) - inter)
+
+
+def _giou_hand(a, b):
+    iou = _iou_hand(a, b)
+    lt = np.minimum(a[:2], b[:2])
+    rb = np.maximum(a[2:], b[2:])
+    hull = (rb[0] - lt[0]) * (rb[1] - lt[1])
+    area = lambda x: (x[2] - x[0]) * (x[3] - x[1])  # noqa: E731
+    lt_i = np.maximum(a[:2], b[:2])
+    rb_i = np.minimum(a[2:], b[2:])
+    wh = np.clip(rb_i - lt_i, 0, None)
+    union = area(a) + area(b) - wh[0] * wh[1]
+    return iou - (hull - union) / hull
+
+
+def _diou_hand(a, b):
+    iou = _iou_hand(a, b)
+    ca = np.asarray([(a[0] + a[2]) / 2, (a[1] + a[3]) / 2])
+    cb = np.asarray([(b[0] + b[2]) / 2, (b[1] + b[3]) / 2])
+    rho2 = ((ca - cb) ** 2).sum()
+    lt = np.minimum(a[:2], b[:2])
+    rb = np.maximum(a[2:], b[2:])
+    diag2 = ((rb - lt) ** 2).sum()
+    return iou - rho2 / diag2
+
+
+def _ciou_hand(a, b):
+    diou = _diou_hand(a, b)
+    iou = _iou_hand(a, b)
+    wa, ha = a[2] - a[0], a[3] - a[1]
+    wb, hb = b[2] - b[0], b[3] - b[1]
+    v = (4 / np.pi**2) * (np.arctan(wb / hb) - np.arctan(wa / ha)) ** 2
+    alpha = 0.0 if v == 0 else v / (1 - iou + v)  # 0/0 at identical aspect -> no penalty
+    return diou - alpha * v
+
+
+_CASES = [
+    # identical boxes
+    (np.asarray([0.0, 0.0, 10.0, 10.0]), np.asarray([0.0, 0.0, 10.0, 10.0])),
+    # half overlap
+    (np.asarray([0.0, 0.0, 10.0, 10.0]), np.asarray([5.0, 0.0, 15.0, 10.0])),
+    # disjoint, horizontally separated
+    (np.asarray([0.0, 0.0, 10.0, 10.0]), np.asarray([20.0, 0.0, 30.0, 10.0])),
+    # contained, different aspect
+    (np.asarray([0.0, 0.0, 20.0, 10.0]), np.asarray([5.0, 2.0, 10.0, 8.0])),
+    # diagonal offset
+    (np.asarray([0.0, 0.0, 8.0, 6.0]), np.asarray([4.0, 3.0, 12.0, 9.0])),
+]
+
+
+@pytest.mark.parametrize(
+    ("fn", "hand"),
+    [
+        (intersection_over_union, _iou_hand),
+        (generalized_intersection_over_union, _giou_hand),
+        (distance_intersection_over_union, _diou_hand),
+        (complete_intersection_over_union, _ciou_hand),
+    ],
+    ids=["iou", "giou", "diou", "ciou"],
+)
+@pytest.mark.parametrize("case", range(len(_CASES)), ids=[f"case{i}" for i in range(len(_CASES))])
+def test_variant_closed_form(fn, hand, case):
+    a, b = _CASES[case]
+    got = float(fn(jnp.asarray(a[None]), jnp.asarray(b[None]), aggregate=True))
+    np.testing.assert_allclose(got, hand(a, b), atol=1e-5)
+
+
+def test_giou_disjoint_is_negative_and_bounded():
+    a = np.asarray([0.0, 0.0, 10.0, 10.0])
+    b = np.asarray([100.0, 100.0, 110.0, 110.0])
+    g = float(generalized_intersection_over_union(jnp.asarray(a[None]), jnp.asarray(b[None])))
+    assert -1.0 <= g < 0.0
+
+
+@pytest.mark.parametrize(
+    ("cls", "fn"),
+    [(IntersectionOverUnion, intersection_over_union),
+     (GeneralizedIntersectionOverUnion, generalized_intersection_over_union),
+     (DistanceIntersectionOverUnion, distance_intersection_over_union),
+     (CompleteIntersectionOverUnion, complete_intersection_over_union)],
+    ids=["iou", "giou", "diou", "ciou"],
+)
+def test_modular_matches_functional_on_matched_pairs(cls, fn):
+    """All-distinct labels make same-label pairs exactly the diagonal, so the
+    modular mean must equal the mean diagonal of the functional's pair matrix."""
+    rng = np.random.RandomState(3)
+    xy = rng.rand(6, 2) * 100
+    wh = rng.rand(6, 2) * 40 + 5
+    gt = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    det = gt + rng.randn(6, 4).astype(np.float32) * 2
+    labels = np.arange(6)
+
+    m = cls()
+    m.update(
+        [dict(boxes=jnp.asarray(det), scores=jnp.asarray(rng.rand(6).astype(np.float32)),
+              labels=jnp.asarray(labels))],
+        [dict(boxes=jnp.asarray(gt), labels=jnp.asarray(labels))],
+    )
+    got = float(m.compute()[cls._iou_type])
+    pair_matrix = np.asarray(fn(jnp.asarray(det), jnp.asarray(gt), aggregate=False))
+    want = float(np.diag(pair_matrix).mean())
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_respect_labels_gates_matches():
+    """respect_labels=True scores cross-label pairs as the invalid value;
+    False lets geometry alone decide."""
+    box = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    near = jnp.asarray([[1.0, 1.0, 11.0, 11.0]])
+    preds = [dict(boxes=near, scores=jnp.asarray([0.9]), labels=jnp.asarray([1]))]
+    target = [dict(boxes=box, labels=jnp.asarray([2]))]
+
+    strict = IntersectionOverUnion(respect_labels=True)
+    strict.update(preds, target)
+    loose = IntersectionOverUnion(respect_labels=False)
+    loose.update(preds, target)
+    assert float(strict.compute()["iou"]) == pytest.approx(0.0, abs=1e-6)
+    assert float(loose.compute()["iou"]) > 0.5
+
+
+def test_iou_threshold_filters_low_overlap():
+    box = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    weak = jnp.asarray([[8.0, 8.0, 18.0, 18.0]])  # iou ~ 0.02
+    preds = [dict(boxes=weak, scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))]
+    target = [dict(boxes=box, labels=jnp.asarray([0]))]
+    gated = IntersectionOverUnion(iou_threshold=0.5)
+    gated.update(preds, target)
+    open_m = IntersectionOverUnion()
+    open_m.update(preds, target)
+    assert float(gated.compute()["iou"]) == pytest.approx(0.0, abs=1e-6)
+    assert 0.0 < float(open_m.compute()["iou"]) < 0.1
+
+
+def test_class_metrics_per_class_pair_means():
+    """class_metrics averages over ALL same-label det x gt pairs (reference
+    semantics, not one-to-one matching): two disjoint identical boxes per class
+    give (1 + 0 + 0 + 1) / 4 = 0.5 per class."""
+    gt = np.asarray([
+        [0.0, 0.0, 10.0, 10.0], [100.0, 100.0, 110.0, 110.0],   # class 0, far apart
+        [200.0, 0.0, 210.0, 10.0], [300.0, 100.0, 310.0, 110.0],  # class 1, far apart
+    ], dtype=np.float32)
+    labels = np.asarray([0, 0, 1, 1])
+    m = IntersectionOverUnion(class_metrics=True)
+    m.update(
+        [dict(boxes=jnp.asarray(gt), scores=jnp.asarray([0.9, 0.8, 0.7, 0.6]),
+              labels=jnp.asarray(labels))],
+        [dict(boxes=jnp.asarray(gt), labels=jnp.asarray(labels))],
+    )
+    out = m.compute()
+    assert "iou/cl_0" in out and "iou/cl_1" in out
+    np.testing.assert_allclose(float(out["iou/cl_0"]), 0.5, atol=1e-6)
+    np.testing.assert_allclose(float(out["iou/cl_1"]), 0.5, atol=1e-6)
