@@ -113,3 +113,30 @@ def test_exported_from_root():
     assert tm.CLIPScore is CLIPScore
     assert tm.functional.pairwise_cosine_similarity is pairwise_cosine_similarity
     assert tm.functional.clip_score is clip_score
+
+
+class TestSklearnOracle:
+    """Second-oracle spot checks (sklearn.metrics.pairwise) and the minkowski
+    exponent grid — the rest of the option surface is covered above vs scipy."""
+
+    X = np.random.RandomState(83).randn(17, 6).astype(np.float64)
+    Y = np.random.RandomState(84).randn(11, 6).astype(np.float64)
+
+    def test_two_matrix_forms_vs_sklearn(self):
+        from sklearn.metrics import pairwise as sk
+
+        for fn, oracle in [
+            (pairwise_cosine_similarity, sk.cosine_similarity),
+            (pairwise_euclidean_distance, sk.euclidean_distances),
+            (pairwise_manhattan_distance, sk.manhattan_distances),
+            (pairwise_linear_similarity, sk.linear_kernel),
+        ]:
+            got = np.asarray(fn(jnp.asarray(self.X), jnp.asarray(self.Y)))
+            np.testing.assert_allclose(got, oracle(self.X, self.Y), rtol=1e-6, atol=1e-9,
+                                       err_msg=fn.__name__)
+
+    @pytest.mark.parametrize("p", [1.0, 2.0, 3.0])
+    def test_minkowski_exponent_grid(self, p):
+        got = np.asarray(pairwise_minkowski_distance(jnp.asarray(self.X), jnp.asarray(self.Y), exponent=p))
+        want = np.asarray([[np.sum(np.abs(x - y) ** p) ** (1 / p) for y in self.Y] for x in self.X])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
