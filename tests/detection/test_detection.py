@@ -638,3 +638,51 @@ class TestPackedUpdates:
         np.testing.assert_allclose(
             np.asarray(m_xyxy.compute()["map"]), np.asarray(m_c.compute()["map"]), atol=1e-6
         )
+
+
+def test_packed_update_rejects_labels_above_f32_exact_range():
+    """Class ids with |v| >= 2**24 are not exact in the f32 packed channel. Host
+    inputs are refused at pack time (no device fetch needed); device-array labels
+    are caught at compute on the already-fetched buffers."""
+    m = MeanAveragePrecision()
+    preds = {
+        "boxes": np.zeros((1, 2, 4)),
+        "scores": np.zeros((1, 2)),
+        "labels": np.asarray([[2**24, 0]]),
+        "num_boxes": np.asarray([2]),
+    }
+    target = {
+        "boxes": np.zeros((1, 2, 4)),
+        "labels": np.asarray([[0, 1]]),
+        "num_boxes": np.asarray([2]),
+    }
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        m.update(preds, target)
+    # large-magnitude NEGATIVE ids are just as inexact
+    preds["labels"] = np.asarray([[-(2**24 + 8), 0]])
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        m.update(preds, target)
+    # just-below-the-bound ids pack fine
+    preds["labels"] = np.asarray([[2**24 - 1, 0]])
+    m.update(preds, target)
+    # sentinel labels in PADDING slots are never read back and must not trip the check
+    preds["labels"] = np.asarray([[1, np.iinfo(np.int32).max]])
+    preds["num_boxes"] = np.asarray([1])
+    m.update(preds, target)
+
+    # device-array labels skip the update-time host check but fail at compute
+    m2 = MeanAveragePrecision()
+    preds_dev = {
+        "boxes": jnp.zeros((1, 2, 4)),
+        "scores": jnp.zeros((1, 2)),
+        "labels": jnp.asarray([[2**24 + 8, 0]], jnp.int32),
+        "num_boxes": jnp.asarray([2]),
+    }
+    target_dev = {
+        "boxes": jnp.zeros((1, 2, 4)),
+        "labels": jnp.asarray([[0, 1]]),
+        "num_boxes": jnp.asarray([2]),
+    }
+    m2.update(preds_dev, target_dev)
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        m2.compute()

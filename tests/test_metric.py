@@ -482,3 +482,45 @@ def test_merge_state_mean_weighted():
     a.merge_state(b)
     assert np.allclose(a.m, (3 * 4.0 + 1 * 10.0) / 4)
     assert a.update_count == 4
+
+
+def test_ragged_none_list_state_sync_raises(monkeypatch):
+    """None-reduced list states (detection's packed per-batch states) sync one
+    collective per element, so ANY cross-rank length mismatch — not just
+    empty-vs-nonempty — must fail loud before the ragged collectives deadlock."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    class PackedDummy(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("packs", default=[], dist_reduce_fx=None)
+
+        def update(self, x):
+            self.packs.append(jnp.asarray(x))
+
+        def compute(self):
+            return self.packs
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.asarray([[2], [3]])
+    )
+    m = PackedDummy(dist_sync_fn=lambda x, group=None: [x, x], distributed_available_fn=lambda: True)
+    m.update(jnp.ones((2, 3)))
+    m.update(jnp.ones((2, 3)))
+    with pytest.raises(TorchMetricsUserError, match="deadlock"):
+        m._sync_dist(dist_sync_fn=m.dist_sync_fn)
+
+    # equal nonzero lengths: sync proceeds, each element gathered positionally
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.asarray([[2], [2]])
+    )
+    m2 = PackedDummy(dist_sync_fn=lambda x, group=None: [x, x], distributed_available_fn=lambda: True)
+    m2.update(jnp.ones((2, 3)))
+    m2.update(jnp.ones((2, 3)))
+    m2._sync_dist(dist_sync_fn=m2.dist_sync_fn)
+    # per-element world lists interleave: 2 local elements x world 2 -> 4 elements,
+    # each keeping its original per-batch shape
+    assert len(m2.packs) == 4
+    assert all(p.shape == (2, 3) for p in m2.packs)

@@ -29,6 +29,12 @@ from torchmetrics_tpu.metric import Metric
 
 Array = jax.Array
 
+_LABEL_F32_BOUND_MSG = (
+    "Packed `{}` labels reach |{}| >= 2**24: class ids of that magnitude are not"
+    " exactly representable in the f32 packed channel and would be silently rounded"
+    " to a wrong class. Use the per-image list update path for such ids."
+)
+
 
 def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     """Host-side pairwise IoU used inside the ragged evaluation loops."""
@@ -226,6 +232,21 @@ class MeanAveragePrecision(Metric):
         if p_boxes.shape[0] != t_boxes.shape[0]:
             raise ValueError("Packed preds and target must share the batch dimension")
         b, m = p_boxes.shape[:2]
+        for name, lbl, cnt in (
+            ("preds", preds["labels"], preds["num_boxes"]),
+            ("target", target["labels"], target["num_boxes"]),
+        ):
+            # Validate the f32-exactness bound WITHOUT a device fetch: host inputs
+            # (numpy/lists) are checked here for an early, per-call error; device
+            # arrays are checked once at compute on the already-fetched buffers
+            # (see _unpack_into), preserving the single-fetch-at-compute invariant.
+            # Only rows within num_boxes count — padding slots may hold sentinels.
+            if isinstance(lbl, (np.ndarray, list, tuple, int)) and isinstance(cnt, (np.ndarray, list, tuple, int)):
+                lbl_np = np.asarray(lbl)
+                valid = np.arange(lbl_np.shape[-1]) < np.asarray(cnt).reshape(-1, 1)
+                masked = np.abs(np.where(valid, lbl_np, 0))
+                if masked.size and int(masked.max()) >= 2**24:
+                    raise ValueError(_LABEL_F32_BOUND_MSG.format(name, int(masked.max())))
         if self.box_format != "xyxy":
             p_boxes = _box_convert(p_boxes.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy").reshape(b, m, 4)
             t_boxes = _box_convert(t_boxes.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy").reshape(*t_boxes.shape)
@@ -279,6 +300,16 @@ class MeanAveragePrecision(Metric):
         packed_t = _bulk_to_host(self.packed_targets)
         t_counts = _bulk_to_host(self.packed_target_counts)
         for pp, pc, tt, tc in zip(packed_p, p_counts, packed_t, t_counts):
+            # f32-exactness bound, checked on the already-fetched host buffers (any
+            # original id with |v| >= 2**24 lands here with |packed| >= 2**24, so
+            # detection after the cast is sound; ids that were device arrays at
+            # update time could not be checked without an extra fetch). Only rows
+            # within each image's count — padding slots may hold sentinels.
+            for name, col, cnt in (("preds", pp[..., 5], pc), ("target", tt[..., 4], tc)):
+                valid = np.arange(col.shape[-1]) < np.asarray(cnt).reshape(-1, 1)
+                masked = np.abs(np.where(valid, col, 0.0))
+                if masked.size and float(masked.max()) >= 2**24:
+                    raise ValueError(_LABEL_F32_BOUND_MSG.format(name, int(masked.max())))
             if (pc < 0).any() or (pc > pp.shape[1]).any() or (tc < 0).any() or (tc > tt.shape[1]).any():
                 raise ValueError(
                     f"Packed num_boxes out of range: counts must lie in [0, padded width]"

@@ -416,33 +416,47 @@ class Metric:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         if jax.process_count() > 1:
-            # an empty list state has no leaves, so a process holding one SKIPS the
-            # collective the populated processes enter — a silent deadlock. ONE tiny
-            # fixed-shape count gather covering every cat state at once (every rank
+            # A list state syncs one collective PER ELEMENT, so ranks holding different
+            # list lengths enter different numbers of collectives — a silent deadlock.
+            # Cat states are pre-concatenated above (length is 0 or 1, so only
+            # empty-vs-nonempty can diverge); None-reduced list states (detection's
+            # packed per-batch states) keep their elements separate and positional, so
+            # ANY length mismatch is fatal, not just mixed emptiness. ONE tiny
+            # fixed-shape count gather covering every list state at once (every rank
             # participates; attr order is the shared _reductions insertion order)
-            # distinguishes "empty everywhere" (benign: all ranks skip consistently)
-            # from mixed emptiness, which fails loud ON EVERY RANK.
-            cat_attrs = [
+            # fails loud ON EVERY RANK before the ragged collectives can wedge.
+            # filter on the DEFAULT's type, not the live local type: a None-reduced
+            # state folded to an array on one rank but not another would otherwise
+            # make the guard collective itself ragged across ranks
+            list_attrs = [
                 attr
                 for attr, fn in self._reductions.items()
-                if fn == dim_zero_cat and isinstance(input_dict[attr], list)
+                if (fn == dim_zero_cat or fn is None) and isinstance(self._defaults[attr], list)
             ]
-            if cat_attrs:
+            if list_attrs:
                 from jax.experimental import multihost_utils
 
-                local_counts = jnp.asarray([len(input_dict[a]) for a in cat_attrs])
+                # count = number of collectives this rank will enter for the attr: a
+                # state folded to a single array (merge_state snapshot) enters one
+                local_counts = jnp.asarray(
+                    [len(x) if isinstance(x, list) else 1 for x in (input_dict[a] for a in list_attrs)]
+                )
                 counts = np.asarray(multihost_utils.process_allgather(local_counts, tiled=False))
-                mixed = (counts.max(axis=0) > 0) & (counts.min(axis=0) == 0)
-                if mixed.any():
-                    attr = cat_attrs[int(np.flatnonzero(mixed)[0])]
-                    empties = np.flatnonzero(counts[:, int(np.flatnonzero(mixed)[0])] == 0)
-                    raise TorchMetricsUserError(
-                        f"Cannot sync list state `{attr}`: processes {empties.tolist()} hold"
-                        " no elements while others do — the empty ones would skip the"
-                        " all-gather and deadlock the rest. Ensure every process receives at"
-                        " least one update before compute(), or skip syncing"
-                        " (sync_on_compute=False) for ragged epochs."
-                    )
+                for idx, attr in enumerate(list_attrs):
+                    col = counts[:, idx]
+                    is_cat = self._reductions[attr] == dim_zero_cat
+                    # cat: pre-concat above leaves 0 or 1 elements, so only mixed
+                    # emptiness can occur; None: exact positional alignment required.
+                    bad = (col.max() > 0 and col.min() == 0) if is_cat else (col.max() != col.min())
+                    if bad:
+                        raise TorchMetricsUserError(
+                            f"Cannot sync list state `{attr}`: processes hold differing"
+                            f" element counts {col.tolist()} — ranks with fewer elements"
+                            " would skip collectives the rest enter and deadlock the"
+                            " world. Ensure every process sees the same number of"
+                            " updates before compute(), or skip syncing"
+                            " (sync_on_compute=False) for ragged epochs."
+                        )
 
         output_dict = apply_to_collection(
             input_dict,

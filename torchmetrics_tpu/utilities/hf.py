@@ -41,6 +41,43 @@ def _load_error(model_name_or_path: str, exc: Exception) -> ModuleNotFoundError:
     )
 
 
+def _is_repo_not_found(exc: Exception) -> bool:
+    """True when the failure means the checkpoint ID is unresolvable (vs a weights-format issue).
+
+    Matched by exception class name (``huggingface_hub`` raises dedicated types) plus
+    the two stable identifier-level messages, so a wording tweak in format-level
+    errors can never suppress the ``from_pt`` conversion retry.
+    """
+    names = set()
+    stack, seen = [exc], set()
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        names.add(type(e).__name__)
+        stack += [e.__cause__, e.__context__]
+    if names & {
+        "RepositoryNotFoundError",
+        "RevisionNotFoundError",
+        "GatedRepoError",
+        "HFValidationError",
+        # offline/no-egress: the id may exist but cannot be fetched — a from_pt
+        # retry would just pay another full network timeout
+        "LocalEntryNotFoundError",
+        "OfflineModeIsEnabled",
+        "ConnectionError",
+        "ConnectTimeout",
+    }:
+        return True
+    msg = str(exc)
+    return (
+        "is not a valid model identifier" in msg
+        or "is not a local folder" in msg
+        or "offline mode" in msg.lower()
+    )
+
+
 def load_hf_tokenizer(model_name_or_path: str) -> Any:
     """AutoTokenizer with offline-clean failure."""
     from transformers import AutoTokenizer
@@ -67,14 +104,21 @@ def load_hf_flax_model(model_name_or_path: str, auto_cls_name: str = "FlaxAutoMo
             return flax_cls.from_pretrained(model_name_or_path)
         except Exception as exc:  # noqa: BLE001 — hub raises OSError/ValueError variants
             first_exc = exc
-            if "flax_model" in str(exc) or "from_pt" in str(exc):
-                # checkpoint exists but ships only torch weights -> converting is the
-                # fix; any other failure skips straight to the torch fallback so an
-                # uncached checkpoint pays two slow hub attempts, not three
+            # A torch-only checkpoint makes the plain Flax load fail, but the error
+            # wording varies across transformers versions — sniffing the message would
+            # silently lose the Flax-first path on a phrasing change. Retry with
+            # from_pt=True by default, skipping only errors that clearly say the
+            # CHECKPOINT ID itself cannot be resolved (so a missing/uncached id pays
+            # two slow hub attempts, not three, while every weights-format failure
+            # still gets the conversion attempt regardless of phrasing).
+            if not _is_repo_not_found(exc):
                 try:
                     return flax_cls.from_pretrained(model_name_or_path, from_pt=True)
                 except Exception as exc2:  # noqa: BLE001
-                    first_exc = exc2
+                    if "flax_model" in str(exc) or "from_pt" in str(exc):
+                        # the first error explicitly named the missing Flax weights, so
+                        # the conversion failure is the more informative one to surface
+                        first_exc = exc2
     torch_cls_name = auto_cls_name.replace("Flax", "")
     torch_cls = getattr(transformers, torch_cls_name, None)
     if torch_cls is None:
