@@ -1,0 +1,174 @@
+"""Mocked-backend contract tests for PESQ/STOI (VERDICT r4 weak #3).
+
+``pesq``/``pystoi`` are not installed here, so without these tests the wrapper
+code paths (argument order, batch reshape, multiprocess branch, class-level
+averaging) would ship with zero executable coverage. A fake backend module is
+injected via ``sys.modules`` and the availability flags are flipped on the
+already-imported wrapper modules, pinning the exact call contract the real C
+packages expect (reference ``functional/audio/pesq.py:24-91``, ``stoi.py:22-86``).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.functional.audio.pesq as pesq_mod
+import torchmetrics_tpu.functional.audio.stoi as stoi_mod
+import torchmetrics_tpu.audio.pesq as pesq_cls_mod
+import torchmetrics_tpu.audio.stoi as stoi_cls_mod
+
+
+@pytest.fixture()
+def fake_pesq(monkeypatch):
+    """A fake `pesq` backend recording every call; score = mean(ref) - mean(deg)."""
+    calls = {"pesq": [], "pesq_batch": []}
+    mod = types.ModuleType("pesq")
+
+    def _pesq(fs, ref, deg, mode):
+        # the ITU wrapper's contract: positional (fs, REFERENCE, DEGRADED, mode)
+        assert isinstance(fs, int) and mode in ("wb", "nb")
+        ref = np.asarray(ref)
+        deg = np.asarray(deg)
+        assert ref.ndim == 1 and deg.ndim == 1, "backend receives 1-D host vectors"
+        calls["pesq"].append((fs, ref.copy(), deg.copy(), mode))
+        return float(ref.mean() - deg.mean())
+
+    def _pesq_batch(fs, ref, deg, mode, n_processor=1):
+        ref = np.asarray(ref)
+        deg = np.asarray(deg)
+        assert ref.ndim == 2 and deg.ndim == 2, "batch backend receives (N, T) host arrays"
+        calls["pesq_batch"].append((fs, ref.copy(), deg.copy(), mode, n_processor))
+        return [float(r.mean() - d.mean()) for r, d in zip(ref, deg)]
+
+    mod.pesq = _pesq
+    mod.pesq_batch = _pesq_batch
+    monkeypatch.setitem(sys.modules, "pesq", mod)
+    monkeypatch.setattr(pesq_mod, "_PESQ_AVAILABLE", True)
+    monkeypatch.setattr(pesq_cls_mod, "_PESQ_AVAILABLE", True)
+    return calls
+
+
+@pytest.fixture()
+def fake_stoi(monkeypatch):
+    calls = []
+    mod = types.ModuleType("pystoi")
+
+    def _stoi(ref, deg, fs_sig, extended=False):
+        ref = np.asarray(ref)
+        deg = np.asarray(deg)
+        assert ref.ndim == 1 and deg.ndim == 1
+        calls.append((ref.copy(), deg.copy(), fs_sig, extended))
+        return float(ref.mean() - deg.mean())
+
+    mod.stoi = _stoi
+    monkeypatch.setitem(sys.modules, "pystoi", mod)
+    monkeypatch.setattr(stoi_mod, "_PYSTOI_AVAILABLE", True)
+    monkeypatch.setattr(stoi_cls_mod, "_PYSTOI_AVAILABLE", True)
+    return calls
+
+
+def test_pesq_1d_argument_order(fake_pesq):
+    preds = jnp.asarray(np.full(100, 2.0, np.float32))
+    target = jnp.asarray(np.full(100, 5.0, np.float32))
+    out = pesq_mod.perceptual_evaluation_speech_quality(preds, target, 16000, "wb")
+    # target rides in the REFERENCE slot, preds in DEGRADED: 5 - 2 = +3
+    assert float(out) == pytest.approx(3.0)
+    (fs, ref, deg, mode), = fake_pesq["pesq"]
+    assert fs == 16000 and mode == "wb"
+    np.testing.assert_allclose(ref, 5.0)
+    np.testing.assert_allclose(deg, 2.0)
+
+
+def test_pesq_batch_reshape_roundtrip(fake_pesq):
+    rng = np.random.default_rng(0)
+    preds = rng.standard_normal((2, 3, 64)).astype(np.float32)
+    target = rng.standard_normal((2, 3, 64)).astype(np.float32)
+    out = pesq_mod.perceptual_evaluation_speech_quality(jnp.asarray(preds), jnp.asarray(target), 8000, "nb")
+    # (2, 3, T) flattens to 6 backend calls and reshapes back to (2, 3)
+    assert out.shape == (2, 3)
+    assert len(fake_pesq["pesq"]) == 6
+    expected = target.reshape(-1, 64).mean(-1) - preds.reshape(-1, 64).mean(-1)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), expected, atol=1e-6)
+
+
+def test_pesq_multiprocess_branch(fake_pesq):
+    rng = np.random.default_rng(1)
+    preds = rng.standard_normal((4, 64)).astype(np.float32)
+    target = rng.standard_normal((4, 64)).astype(np.float32)
+    out = pesq_mod.perceptual_evaluation_speech_quality(
+        jnp.asarray(preds), jnp.asarray(target), 16000, "wb", n_processes=2
+    )
+    # n_processes != 1 routes to pesq_batch with n_processor, no per-row calls
+    assert len(fake_pesq["pesq"]) == 0
+    (fs, ref, deg, mode, n_proc), = fake_pesq["pesq_batch"]
+    assert (fs, mode, n_proc) == (16000, "wb", 2)
+    assert out.shape == (4,)
+
+
+def test_pesq_validation_errors(fake_pesq):
+    x = jnp.zeros(10)
+    with pytest.raises(ValueError, match="fs"):
+        pesq_mod.perceptual_evaluation_speech_quality(x, x, 44100, "wb")
+    with pytest.raises(ValueError, match="mode"):
+        pesq_mod.perceptual_evaluation_speech_quality(x, x, 16000, "xx")
+    with pytest.raises(RuntimeError, match="shape"):
+        pesq_mod.perceptual_evaluation_speech_quality(jnp.zeros(10), jnp.zeros(12), 16000, "wb")
+
+
+def test_pesq_class_averages(fake_pesq):
+    m = pesq_cls_mod.PerceptualEvaluationSpeechQuality(16000, "wb")
+    t1 = jnp.asarray(np.full((2, 50), 3.0, np.float32))
+    p1 = jnp.asarray(np.full((2, 50), 1.0, np.float32))
+    t2 = jnp.asarray(np.full((1, 50), 7.0, np.float32))
+    p2 = jnp.asarray(np.full((1, 50), 1.0, np.float32))
+    m.update(p1, t1)
+    m.update(p2, t2)
+    # mean over all 3 samples: (2 + 2 + 6) / 3
+    assert float(m.compute()) == pytest.approx(10.0 / 3.0)
+
+
+def test_stoi_1d_argument_order_and_extended_flag(fake_stoi):
+    preds = jnp.asarray(np.full(80, 1.0, np.float32))
+    target = jnp.asarray(np.full(80, 4.0, np.float32))
+    out = stoi_mod.short_time_objective_intelligibility(preds, target, 10000, extended=True)
+    assert float(out) == pytest.approx(3.0)
+    (ref, deg, fs, extended), = fake_stoi
+    np.testing.assert_allclose(ref, 4.0)
+    np.testing.assert_allclose(deg, 1.0)
+    assert fs == 10000 and extended is True
+
+
+def test_stoi_batch_reshape(fake_stoi):
+    rng = np.random.default_rng(2)
+    preds = rng.standard_normal((3, 2, 48)).astype(np.float32)
+    target = rng.standard_normal((3, 2, 48)).astype(np.float32)
+    out = stoi_mod.short_time_objective_intelligibility(jnp.asarray(preds), jnp.asarray(target), 8000)
+    assert out.shape == (3, 2)
+    assert len(fake_stoi) == 6
+    expected = target.reshape(-1, 48).mean(-1) - preds.reshape(-1, 48).mean(-1)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), expected, atol=1e-6)
+
+
+def test_stoi_class_averages(fake_stoi):
+    m = stoi_cls_mod.ShortTimeObjectiveIntelligibility(8000)
+    m.update(jnp.asarray(np.full((2, 40), 1.0, np.float32)), jnp.asarray(np.full((2, 40), 2.0, np.float32)))
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_missing_backend_raises_module_not_found():
+    # without the fixtures the real flags are False in this environment
+    if pesq_mod._PESQ_AVAILABLE or stoi_mod._PYSTOI_AVAILABLE:
+        pytest.skip("real backends installed")
+    with pytest.raises(ModuleNotFoundError, match="pesq"):
+        pesq_mod.perceptual_evaluation_speech_quality(jnp.zeros(10), jnp.zeros(10), 16000, "wb")
+    with pytest.raises(ModuleNotFoundError, match="pystoi"):
+        stoi_mod.short_time_objective_intelligibility(jnp.zeros(10), jnp.zeros(10), 8000)
+    with pytest.raises(ModuleNotFoundError):
+        pesq_cls_mod.PerceptualEvaluationSpeechQuality(16000, "wb")
+    with pytest.raises(ModuleNotFoundError):
+        stoi_cls_mod.ShortTimeObjectiveIntelligibility(8000)

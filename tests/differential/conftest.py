@@ -1,0 +1,42 @@
+"""Differential-test wiring: make the mounted reference importable side-by-side.
+
+The reference (`/root/reference/src/torchmetrics`, torch CPU) is the *executing
+oracle* for these tests: identical seeded inputs are driven through the reference
+metric and the TPU build, per the reference's own three-level protocol
+(``/root/reference/tests/unittests/helpers/testers.py:77-227``). The only import
+blocker is the absent ``lightning_utilities`` dependency, shimmed (~100 lines) in
+``tests/reference_shims/``.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+_SHIMS = str(Path(__file__).resolve().parents[1] / "reference_shims")
+_REF_SRC = "/root/reference/src"
+
+
+def _ensure_reference_importable() -> None:
+    for p in (_SHIMS, _REF_SRC):
+        if p not in sys.path:
+            # append, not prepend: nothing in the repo may shadow these, and the
+            # shim must never win over a real installed lightning_utilities
+            sys.path.append(p)
+
+
+_ensure_reference_importable()
+
+
+@pytest.fixture(scope="session")
+def reference_tm():
+    """The imported reference torchmetrics package (skips if unavailable)."""
+    pytest.importorskip("torch")
+    if not Path(_REF_SRC).is_dir():
+        pytest.skip("reference tree not mounted")
+    import torchmetrics
+
+    assert Path(torchmetrics.__file__).is_relative_to(_REF_SRC), (
+        f"differential oracle must be the mounted reference, got {torchmetrics.__file__}"
+    )
+    return torchmetrics

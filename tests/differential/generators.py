@@ -1,0 +1,344 @@
+"""Seeded host-side input generators for the differential sweep.
+
+Every generator returns ``n_batches`` tuples of positional ``update`` arguments as
+plain host data (numpy / strings / dicts); the harness converts per-side. Scales
+mirror the reference fixtures (``tests/unittests/conftest.py:25-30``: 4 batches of
+32, 5 classes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+N_BATCHES = 4
+B = 32
+C = 5
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def make_batches(name: str, seed: int, **kwargs: Any) -> List[Tuple[Any, ...]]:
+    rng = np.random.default_rng(seed)
+    return _REGISTRY[name](rng, **kwargs)
+
+
+@register("mc_logits")
+def _mc_logits(rng, num_classes=C, batch=B):
+    return [
+        (rng.standard_normal((batch, num_classes)).astype(np.float32), rng.integers(0, num_classes, batch))
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("mc_probs")
+def _mc_probs(rng, num_classes=C, batch=B):
+    out = []
+    for _ in range(N_BATCHES):
+        p = rng.random((batch, num_classes)).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        out.append((p, rng.integers(0, num_classes, batch)))
+    return out
+
+
+@register("mc_labels")
+def _mc_labels(rng, num_classes=C, batch=B):
+    return [
+        (rng.integers(0, num_classes, batch), rng.integers(0, num_classes, batch)) for _ in range(N_BATCHES)
+    ]
+
+
+@register("bin_probs")
+def _bin_probs(rng, batch=B):
+    return [
+        (rng.random(batch).astype(np.float32), rng.integers(0, 2, batch)) for _ in range(N_BATCHES)
+    ]
+
+
+@register("bin_logits")
+def _bin_logits(rng, batch=B):
+    return [
+        (rng.standard_normal(batch).astype(np.float32), rng.integers(0, 2, batch)) for _ in range(N_BATCHES)
+    ]
+
+
+@register("ml_probs")
+def _ml_probs(rng, num_labels=C, batch=B):
+    return [
+        (rng.random((batch, num_labels)).astype(np.float32), rng.integers(0, 2, (batch, num_labels)))
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("bin_probs_grouped")
+def _bin_probs_grouped(rng, batch=B):
+    # preds, target, groups — for group-fairness metrics
+    return [
+        (rng.random(batch).astype(np.float32), rng.integers(0, 2, batch), rng.integers(0, 2, batch))
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("reg")
+def _reg(rng, batch=B):
+    return [
+        (rng.standard_normal(batch).astype(np.float32), rng.standard_normal(batch).astype(np.float32))
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("reg_corr")
+def _reg_corr(rng, batch=B):
+    # correlated pair, away from degenerate zero-variance
+    out = []
+    for _ in range(N_BATCHES):
+        t = rng.standard_normal(batch).astype(np.float32)
+        p = (0.7 * t + 0.3 * rng.standard_normal(batch)).astype(np.float32)
+        out.append((p, t))
+    return out
+
+
+@register("reg_pos")
+def _reg_pos(rng, batch=B):
+    # strictly positive, bounded away from zero (MAPE/MSLE/Tweedie safety)
+    return [
+        (
+            (rng.random(batch) * 4 + 0.5).astype(np.float32),
+            (rng.random(batch) * 4 + 0.5).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("reg_2d")
+def _reg_2d(rng, batch=B, dims=3):
+    return [
+        (
+            rng.standard_normal((batch, dims)).astype(np.float32),
+            rng.standard_normal((batch, dims)).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("kl_probs")
+def _kl_probs(rng, batch=B, dims=C):
+    out = []
+    for _ in range(N_BATCHES):
+        p = rng.random((batch, dims)).astype(np.float32) + 0.05
+        q = rng.random((batch, dims)).astype(np.float32) + 0.05
+        out.append((p / p.sum(-1, keepdims=True), q / q.sum(-1, keepdims=True)))
+    return out
+
+
+@register("retrieval")
+def _retrieval(rng, batch=B, n_queries=4):
+    # preds, target, indexes — every query group guaranteed >=1 positive and >=1
+    # negative so metrics with empty_target_action defaults agree
+    out = []
+    for _ in range(N_BATCHES):
+        idx = np.sort(rng.integers(0, n_queries, batch))
+        tgt = rng.integers(0, 2, batch)
+        for q in range(n_queries):
+            members = np.flatnonzero(idx == q)
+            if members.size:
+                tgt[members[0]] = 1
+                if members.size > 1:
+                    tgt[members[-1]] = 0
+        out.append((rng.random(batch).astype(np.float32), tgt, idx))
+    return out
+
+
+@register("img")
+def _img(rng, batch=4, ch=3, size=32):
+    return [
+        (
+            rng.random((batch, ch, size, size)).astype(np.float32),
+            rng.random((batch, ch, size, size)).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("img_large")
+def _img_large(rng, batch=1, ch=3, size=192):
+    # big enough for MS-SSIM's 4x downsampling chain with the 11-tap window
+    return [
+        (
+            rng.random((batch, ch, size, size)).astype(np.float32),
+            rng.random((batch, ch, size, size)).astype(np.float32),
+        )
+        for _ in range(2)
+    ]
+
+
+@register("img_correlated")
+def _img_correlated(rng, batch=2, ch=3, size=64):
+    # target + noise, the SSIM-family's intended regime (pure noise pairs sit at
+    # the metric's degenerate floor where implementations diverge in ulps)
+    out = []
+    for _ in range(N_BATCHES):
+        t = rng.random((batch, ch, size, size)).astype(np.float32)
+        p = np.clip(t + 0.1 * rng.standard_normal(t.shape), 0, 1).astype(np.float32)
+        out.append((p, t))
+    return out
+
+
+@register("audio")
+def _audio(rng, batch=2, t=1000):
+    return [
+        (
+            rng.standard_normal((batch, t)).astype(np.float32),
+            rng.standard_normal((batch, t)).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("audio_multisrc")
+def _audio_multisrc(rng, batch=2, s=2, t=400):
+    return [
+        (
+            rng.standard_normal((batch, s, t)).astype(np.float32),
+            rng.standard_normal((batch, s, t)).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("audio_complex")
+def _audio_complex(rng, batch=2, f=20, t=30):
+    # (..., freq, time, 2) real/imag pairs for complex SI-SNR
+    return [
+        (
+            rng.standard_normal((batch, f, t, 2)).astype(np.float32),
+            rng.standard_normal((batch, f, t, 2)).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+_SENTS = [
+    "the cat sat on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello world this is a test sentence",
+    "the weather is nice today",
+    "machine translation evaluation is hard",
+    "metrics must agree across frameworks",
+    "the dog barked at the mailman",
+    "she sells sea shells by the sea shore",
+]
+_REFS = [
+    "the cat sat on a mat",
+    "the quick brown fox jumped over the lazy dog",
+    "hello world this is the test sentence",
+    "today the weather is nice",
+    "evaluating machine translation is difficult",
+    "metrics should agree between frameworks",
+    "a dog barked at the mail carrier",
+    "she sells seashells by the seashore",
+]
+
+
+@register("text_pairs")
+def _text_pairs(rng, per_batch=4):
+    out = []
+    for b in range(N_BATCHES):
+        ids = rng.integers(0, len(_SENTS), per_batch)
+        out.append(([_SENTS[i] for i in ids], [_REFS[i] for i in ids]))
+    return out
+
+
+@register("text_corpus")
+def _text_corpus(rng, per_batch=4):
+    # preds: list[str]; target: list[list[str]] (multi-reference)
+    out = []
+    for b in range(N_BATCHES):
+        ids = rng.integers(0, len(_SENTS), per_batch)
+        out.append(([_SENTS[i] for i in ids], [[_REFS[i], _SENTS[(i + 1) % len(_SENTS)]] for i in ids]))
+    return out
+
+
+@register("perplexity")
+def _perplexity(rng, batch=2, t=8, v=10):
+    return [
+        (
+            rng.standard_normal((batch, t, v)).astype(np.float32),
+            rng.integers(0, v, (batch, t)),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("squad")
+def _squad(rng):
+    pairs = [
+        ("the answer is paris", "the answer is paris"),
+        ("london", "paris"),
+        ("forty two", "forty-two"),
+        ("a cat", "the cat"),
+    ]
+    out = []
+    for b in range(N_BATCHES):
+        preds, tgts = [], []
+        for i, (p, t) in enumerate(pairs):
+            qid = f"q{b}_{i}"
+            preds.append({"prediction_text": p, "id": qid})
+            tgts.append({"answers": {"answer_start": [0], "text": [t]}, "id": qid})
+        out.append((preds, tgts))
+    return out
+
+
+@register("nominal")
+def _nominal(rng, batch=B, k=4):
+    return [
+        (rng.integers(0, k, batch), rng.integers(0, k, batch)) for _ in range(N_BATCHES)
+    ]
+
+
+@register("fleiss")
+def _fleiss(rng, n_subj=10, k=4, n_raters=6):
+    out = []
+    for _ in range(N_BATCHES):
+        counts = rng.multinomial(n_raters, np.ones(k) / k, size=n_subj).astype(np.int64)
+        out.append((counts,))
+    return out
+
+
+@register("scalar")
+def _scalar(rng):
+    return [(rng.standard_normal(8).astype(np.float32),) for _ in range(N_BATCHES)]
+
+
+@register("mc_labels_md")
+def _mc_labels_md(rng, num_classes=C, batch=B, d=3):
+    # multidim int labels for ExactMatch
+    return [
+        (rng.integers(0, num_classes, (batch, d)), rng.integers(0, num_classes, (batch, d)))
+        for _ in range(N_BATCHES)
+    ]
+
+
+@register("img_single")
+def _img_single(rng, batch=2, ch=3, size=32):
+    return [(rng.random((batch, ch, size, size)).astype(np.float32),) for _ in range(N_BATCHES)]
+
+
+@register("img_gray")
+def _img_gray(rng, batch=2, size=32):
+    return [
+        (
+            rng.random((batch, 1, size, size)).astype(np.float32),
+            rng.random((batch, 1, size, size)).astype(np.float32),
+        )
+        for _ in range(N_BATCHES)
+    ]

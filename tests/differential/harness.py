@@ -1,0 +1,161 @@
+"""Execute-the-reference differential harness.
+
+Drives the SAME seeded host inputs through a reference (torch) metric and the TPU
+build's metric, comparing at the reference's own three protocol levels
+(``/root/reference/tests/unittests/helpers/testers.py:77-227``):
+
+(a) per-batch ``forward`` return values;
+(b) 2-replica world emulation: our two replicas folded with ``merge_state`` must
+    equal the reference's single instance fed all batches (the reference realizes
+    this level with a 2-process gloo pool; state-merge equivalence is the same
+    contract without processes);
+(c) epoch ``compute`` over all batches.
+
+Inputs are host data (numpy arrays / strings / dicts); each side converts with its
+own ingestion path (torch.from_numpy vs jnp.asarray), exactly as a user would.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def to_torch(x: Any) -> Any:
+    import torch
+
+    if isinstance(x, np.ndarray):
+        t = torch.from_numpy(np.ascontiguousarray(x))
+        # torch metrics default to f32/i64; mirror a torch user's dtypes
+        if t.dtype == torch.float64:
+            t = t.float()
+        elif t.dtype in (torch.int32, torch.int16, torch.uint8):
+            t = t.long()
+        return t
+    if isinstance(x, dict):
+        return {k: to_torch(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)) and x and isinstance(x[0], (np.ndarray, dict)):
+        return type(x)(to_torch(v) for v in x)
+    return x
+
+
+def to_jax(x: Any) -> Any:
+    import jax.numpy as jnp
+
+    if isinstance(x, np.ndarray):
+        a = jnp.asarray(x)
+        if a.dtype == jnp.float64:
+            a = a.astype(jnp.float32)
+        return a
+    if isinstance(x, dict):
+        return {k: to_jax(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)) and x and isinstance(x[0], (np.ndarray, dict)):
+        return type(x)(to_jax(v) for v in x)
+    return x
+
+
+def normalize(out: Any) -> Any:
+    """Reduce either framework's output pytree to plain numpy/python for comparison."""
+    import torch
+
+    if isinstance(out, torch.Tensor):
+        return out.detach().cpu().numpy()
+    if isinstance(out, dict):
+        return {str(k): normalize(v) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return [normalize(v) for v in out]
+    if hasattr(out, "__array__"):
+        return np.asarray(out)
+    return out
+
+
+def assert_tree_allclose(ours: Any, ref: Any, atol: float, rtol: float, where: str) -> None:
+    if isinstance(ref, dict):
+        assert isinstance(ours, dict), f"{where}: ours is {type(ours)}, ref is dict"
+        missing = set(ref) - set(ours)
+        assert not missing, f"{where}: missing keys {sorted(missing)}"
+        for k in ref:
+            assert_tree_allclose(ours[k], ref[k], atol, rtol, f"{where}.{k}")
+    elif isinstance(ref, list):
+        assert len(ours) == len(ref), f"{where}: length {len(ours)} vs ref {len(ref)}"
+        for i, (o, r) in enumerate(zip(ours, ref)):
+            assert_tree_allclose(o, r, atol, rtol, f"{where}[{i}]")
+    elif ref is None:
+        assert ours is None, f"{where}: expected None, got {ours!r}"
+    elif isinstance(ref, str):
+        assert str(ours) == ref, f"{where}: {ours!r} vs {ref!r}"
+    else:
+        o = np.asarray(ours, dtype=np.float64)
+        r = np.asarray(ref, dtype=np.float64)
+        assert o.shape == r.shape, f"{where}: shape {o.shape} vs ref {r.shape}"
+        np.testing.assert_allclose(o, r, atol=atol, rtol=rtol, err_msg=where, equal_nan=True)
+
+
+@dataclass
+class DiffCase:
+    """One differential scenario: a metric class driven by both frameworks."""
+
+    id: str
+    path: str  # "domain.ClassName", resolved in BOTH packages
+    gen: str  # key into the generator registry (generators.py)
+    args: Dict[str, Any] = field(default_factory=dict)  # shared ctor kwargs
+    our_args: Dict[str, Any] = field(default_factory=dict)  # ours-only overrides
+    ref_args: Dict[str, Any] = field(default_factory=dict)  # reference-only overrides
+    atol: float = 1e-5
+    rtol: float = 1e-4
+    check_forward: bool = True  # compare per-batch forward values
+    check_merge: bool = True  # 2-replica merge_state vs reference epoch
+    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+    requires: Tuple[str, ...] = ()  # packages the REFERENCE side needs
+    # kwargs whose value is a functional, named by "domain.fn_name" and resolved in
+    # EACH side's own `functional` namespace (e.g. PIT's metric_func)
+    args_resolve: Dict[str, str] = field(default_factory=dict)
+
+
+def _resolve(root: Any, path: str) -> Callable:
+    obj = root
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def run_differential_case(case: DiffCase, batches: Sequence[Tuple[Any, ...]], reference_tm: Any) -> None:
+    import torchmetrics_tpu as ours_pkg
+
+    ref_cls = _resolve(reference_tm, case.path)
+    our_cls = _resolve(ours_pkg, case.path)
+
+    ref_kwargs = {**case.args, **case.ref_args}
+    our_kwargs = {**case.args, **case.our_args}
+    for kwarg, fn_path in case.args_resolve.items():
+        ref_kwargs[kwarg] = _resolve(reference_tm.functional, fn_path)
+        our_kwargs[kwarg] = _resolve(ours_pkg.functional, fn_path)
+
+    ref_m = ref_cls(**ref_kwargs)
+    our_m = our_cls(**our_kwargs)
+
+    # (a) per-batch forward
+    for i, batch in enumerate(batches):
+        ref_out = ref_m(*to_torch(batch))
+        our_out = our_m(*to_jax(batch))
+        if case.check_forward:
+            assert_tree_allclose(
+                normalize(our_out), normalize(ref_out), case.atol, case.rtol, f"{case.id}:forward[{i}]"
+            )
+
+    # (c) epoch compute
+    ref_epoch = normalize(ref_m.compute())
+    our_epoch = normalize(our_m.compute())
+    assert_tree_allclose(our_epoch, ref_epoch, case.atol, case.rtol, f"{case.id}:epoch")
+
+    # (b) 2-replica merge: ours split across two instances and folded must equal
+    # the reference's all-batches epoch value
+    if case.check_merge and len(batches) >= 2:
+        reps = [our_cls(**our_kwargs) for _ in range(2)]
+        for i, batch in enumerate(batches):
+            reps[i % 2].update(*to_jax(batch))
+        reps[0].merge_state(reps[1])
+        merged = normalize(reps[0].compute())
+        assert_tree_allclose(merged, ref_epoch, case.atol, case.rtol, f"{case.id}:2replica-merge")

@@ -1,0 +1,231 @@
+"""Differential tests for the orchestration layer: collections, wrappers,
+composition, windowed aggregation — the reference executing side-by-side.
+
+These are the layers where state-sharing (compute groups), state duplication
+(Running windows) and lazy DAGs (CompositionalMetric) could diverge from the
+reference even when every leaf metric agrees; the zoo sweep (test_zoo.py) covers
+the leaves, this module covers the plumbing above them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.differential.generators import make_batches
+from tests.differential.harness import assert_tree_allclose, normalize, to_jax, to_torch
+
+
+def _ours():
+    import torchmetrics_tpu
+
+    return torchmetrics_tpu
+
+
+def test_metric_collection_compute_groups(reference_tm):
+    """A collection whose members share states (one compute group) must produce the
+    reference collection's dict, key for key."""
+    ours = _ours()
+    batches = make_batches("mc_logits", 1234)
+
+    def build(tm):
+        return tm.MetricCollection(
+            [
+                tm.classification.MulticlassAccuracy(num_classes=5, average="macro"),
+                tm.classification.MulticlassPrecision(num_classes=5, average="macro"),
+                tm.classification.MulticlassRecall(num_classes=5, average="macro"),
+                tm.classification.MulticlassF1Score(num_classes=5, average="macro"),
+            ]
+        )
+
+    ref_c, our_c = build(reference_tm), build(ours)
+    for batch in batches:
+        ref_out = ref_c(*to_torch(batch))
+        our_out = our_c(*to_jax(batch))
+        assert_tree_allclose(normalize(our_out), normalize(ref_out), 1e-5, 1e-4, "collection:forward")
+    assert_tree_allclose(normalize(our_c.compute()), normalize(ref_c.compute()), 1e-5, 1e-4, "collection:epoch")
+    # forward-only never merges groups — in EITHER framework (reference parity);
+    # the first plain update() folds all four stat-scores metrics into one group
+    assert len(our_c.compute_groups) == len(ref_c.compute_groups) == 4
+    ref_c.update(*to_torch(batches[0]))
+    our_c.update(*to_jax(batches[0]))
+    assert len(our_c.compute_groups) == len(ref_c.compute_groups) == 1, (
+        f"expected one compute group, got {our_c.compute_groups} vs ref {ref_c.compute_groups}"
+    )
+    assert_tree_allclose(normalize(our_c.compute()), normalize(ref_c.compute()), 1e-5, 1e-4, "collection:epoch2")
+
+
+def test_metric_collection_prefix_postfix(reference_tm):
+    ours = _ours()
+    batches = make_batches("bin_probs", 99)
+
+    def build(tm):
+        return tm.MetricCollection(
+            {"acc": tm.classification.BinaryAccuracy(), "prec": tm.classification.BinaryPrecision()},
+            prefix="val_",
+            postfix="_step",
+        )
+
+    ref_c, our_c = build(reference_tm), build(ours)
+    for batch in batches:
+        ref_c.update(*to_torch(batch))
+        our_c.update(*to_jax(batch))
+    ref_out, our_out = normalize(ref_c.compute()), normalize(our_c.compute())
+    assert set(our_out) == set(ref_out) == {"val_acc_step", "val_prec_step"}
+    assert_tree_allclose(our_out, ref_out, 1e-6, 1e-5, "collection:prefix")
+
+
+def test_classwise_wrapper(reference_tm):
+    ours = _ours()
+    batches = make_batches("mc_logits", 7)
+
+    def build(tm):
+        return tm.ClasswiseWrapper(tm.classification.MulticlassAccuracy(num_classes=5, average=None))
+
+    ref_m, our_m = build(reference_tm), build(ours)
+    for batch in batches:
+        ref_m.update(*to_torch(batch))
+        our_m.update(*to_jax(batch))
+    ref_out, our_out = normalize(ref_m.compute()), normalize(our_m.compute())
+    assert set(our_out) == set(ref_out)
+    assert_tree_allclose(our_out, ref_out, 1e-6, 1e-5, "classwise")
+
+
+def test_minmax_wrapper(reference_tm):
+    ours = _ours()
+    batches = make_batches("bin_probs", 11)
+
+    def build(tm):
+        return tm.MinMaxMetric(tm.classification.BinaryAccuracy())
+
+    ref_m, our_m = build(reference_tm), build(ours)
+    for batch in batches:
+        # forward drives the per-step min/max tracking in both frameworks
+        ref_m(*to_torch(batch))
+        our_m(*to_jax(batch))
+    assert_tree_allclose(normalize(our_m.compute()), normalize(ref_m.compute()), 1e-6, 1e-5, "minmax")
+
+
+def test_multioutput_wrapper(reference_tm):
+    ours = _ours()
+    batches = make_batches("reg_2d", 13)
+
+    def build(tm):
+        return tm.MultioutputWrapper(tm.regression.MeanSquaredError(), num_outputs=3)
+
+    ref_m, our_m = build(reference_tm), build(ours)
+    for batch in batches:
+        ref_m.update(*to_torch(batch))
+        our_m.update(*to_jax(batch))
+    assert_tree_allclose(normalize(our_m.compute()), normalize(ref_m.compute()), 1e-6, 1e-5, "multioutput")
+
+
+def test_multitask_wrapper(reference_tm):
+    ours = _ours()
+    cls_batches = make_batches("bin_probs", 17)
+    reg_batches = make_batches("reg", 19)
+
+    def build(tm):
+        return tm.MultitaskWrapper(
+            {
+                "classification": tm.classification.BinaryAccuracy(),
+                "regression": tm.regression.MeanSquaredError(),
+            }
+        )
+
+    ref_m, our_m = build(reference_tm), build(ours)
+    for cb, rb in zip(cls_batches, reg_batches):
+        ref_m.update(
+            {"classification": to_torch(cb[0]), "regression": to_torch(rb[0])},
+            {"classification": to_torch(cb[1]), "regression": to_torch(rb[1])},
+        )
+        our_m.update(
+            {"classification": to_jax(cb[0]), "regression": to_jax(rb[0])},
+            {"classification": to_jax(cb[1]), "regression": to_jax(rb[1])},
+        )
+    assert_tree_allclose(normalize(our_m.compute()), normalize(ref_m.compute()), 1e-6, 1e-5, "multitask")
+
+
+def test_running_mean_window(reference_tm):
+    """Windowed aggregation: RunningMean over window=3 must track the reference's
+    per-step forward values AND final windowed compute."""
+    ours = _ours()
+    rng = np.random.default_rng(23)
+    vals = [rng.standard_normal(4).astype(np.float32) for _ in range(6)]
+
+    ref_m = reference_tm.aggregation.RunningMean(window=3)
+    our_m = ours.aggregation.RunningMean(window=3)
+    for v in vals:
+        ref_step = ref_m(to_torch(v))
+        our_step = our_m(to_jax(v))
+        assert_tree_allclose(normalize(our_step), normalize(ref_step), 1e-6, 1e-5, "running:step")
+    assert_tree_allclose(normalize(our_m.compute()), normalize(ref_m.compute()), 1e-6, 1e-5, "running:final")
+
+
+def test_metric_tracker_best(reference_tm):
+    ours = _ours()
+    batches = make_batches("bin_probs", 29)
+
+    def build(tm):
+        return tm.MetricTracker(tm.classification.BinaryAccuracy(), maximize=True)
+
+    ref_m, our_m = build(reference_tm), build(ours)
+    for step in range(2):
+        ref_m.increment()
+        our_m.increment()
+        for batch in batches[step * 2 : step * 2 + 2]:
+            ref_m.update(*to_torch(batch))
+            our_m.update(*to_jax(batch))
+    assert_tree_allclose(
+        normalize(our_m.best_metric()), normalize(ref_m.best_metric()), 1e-6, 1e-5, "tracker:best"
+    )
+    assert_tree_allclose(
+        normalize(our_m.compute_all()), normalize(ref_m.compute_all()), 1e-6, 1e-5, "tracker:all"
+    )
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        lambda a, p: a + p,
+        lambda a, p: a * p,
+        lambda a, p: a - p,
+        lambda a, p: 2.0 * a + 0.5,
+        lambda a, p: a / (p + 1.0),
+        lambda a, p: abs(a - p),
+        lambda a, p: a**2,
+    ],
+    ids=["add", "mul", "sub", "affine", "div", "absdiff", "pow"],
+)
+def test_compositional_lazy_dag(reference_tm, expr):
+    """Operator-overload DAGs evaluate to the reference's value at compute time."""
+    ours = _ours()
+    batches = make_batches("bin_probs", 31)
+
+    def build(tm):
+        acc = tm.classification.BinaryAccuracy()
+        prec = tm.classification.BinaryPrecision()
+        return expr(acc, prec), acc, prec
+
+    ref_c, ref_a, ref_p = build(reference_tm)
+    our_c, our_a, our_p = build(ours)
+    for batch in batches:
+        ref_a.update(*to_torch(batch))
+        ref_p.update(*to_torch(batch))
+        our_a.update(*to_jax(batch))
+        our_p.update(*to_jax(batch))
+    assert_tree_allclose(normalize(our_c.compute()), normalize(ref_c.compute()), 1e-6, 1e-5, "compositional")
+
+
+def test_mean_metric_weighted(reference_tm):
+    ours = _ours()
+    rng = np.random.default_rng(37)
+    vals = [rng.standard_normal(8).astype(np.float32) for _ in range(4)]
+    weights = [rng.random(8).astype(np.float32) + 0.1 for _ in range(4)]
+
+    ref_m = reference_tm.MeanMetric()
+    our_m = ours.MeanMetric()
+    for v, w in zip(vals, weights):
+        ref_m.update(to_torch(v), to_torch(w))
+        our_m.update(to_jax(v), to_jax(w))
+    assert_tree_allclose(normalize(our_m.compute()), normalize(ref_m.compute()), 1e-6, 1e-5, "weighted-mean")

@@ -1,0 +1,203 @@
+"""Differential sweep: the reference executes side-by-side as the oracle.
+
+Each case drives identical seeded host inputs through the mounted reference
+(torch CPU) and the TPU build (jax CPU mesh conftest), comparing per-batch
+``forward``, epoch ``compute``, and a 2-replica ``merge_state`` fold against the
+reference's single-instance epoch — the reference's own class-tester protocol
+(``/root/reference/tests/unittests/helpers/testers.py:77-227``) with the gloo pool
+replaced by state-merge equivalence.
+
+Domains the oracle cannot execute here are excluded for cause, not silently:
+- detection (reference requires torchvision + pycocotools, absent) — covered by
+  pycocotools-pinned fixtures in ``tests/detection/``;
+- SDR (reference requires fast_bss_eval, absent) — covered by analytic goldens in
+  ``tests/audio/``;
+- PESQ/STOI (reference delegates to the same absent C packages) — call contract
+  pinned with mocked backends in ``tests/audio/``;
+- model-backed metrics (FID/KID/IS/LPIPS/CLIP/BERTScore: reference needs
+  torch_fidelity/lpips/transformers downloads, env-blocked) — converter parity in
+  ``tests/image/test_torch_numeric_parity.py``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+import pytest
+
+from tests.differential.generators import make_batches
+from tests.differential.harness import DiffCase, run_differential_case
+
+C = DiffCase
+
+CASES = [
+    # ---------------------------------------------------------------- classification: binary
+    C(id="binary_accuracy", path="classification.BinaryAccuracy", gen="bin_probs"),
+    C(id="binary_precision", path="classification.BinaryPrecision", gen="bin_probs"),
+    C(id="binary_recall", path="classification.BinaryRecall", gen="bin_probs"),
+    C(id="binary_f1", path="classification.BinaryF1Score", gen="bin_probs"),
+    C(id="binary_fbeta2", path="classification.BinaryFBetaScore", gen="bin_probs", args={"beta": 2.0}),
+    C(id="binary_specificity", path="classification.BinarySpecificity", gen="bin_probs"),
+    C(id="binary_hamming", path="classification.BinaryHammingDistance", gen="bin_probs"),
+    C(id="binary_stat_scores", path="classification.BinaryStatScores", gen="bin_probs"),
+    C(id="binary_confmat", path="classification.BinaryConfusionMatrix", gen="bin_probs"),
+    C(id="binary_jaccard", path="classification.BinaryJaccardIndex", gen="bin_probs"),
+    C(id="binary_matthews", path="classification.BinaryMatthewsCorrCoef", gen="bin_probs"),
+    C(id="binary_cohen_kappa", path="classification.BinaryCohenKappa", gen="bin_probs"),
+    C(id="binary_auroc", path="classification.BinaryAUROC", gen="bin_probs"),
+    C(id="binary_ap", path="classification.BinaryAveragePrecision", gen="bin_probs"),
+    C(id="binary_calibration_l1", path="classification.BinaryCalibrationError", gen="bin_probs", args={"n_bins": 10, "norm": "l1"}),
+    C(id="binary_calibration_max", path="classification.BinaryCalibrationError", gen="bin_probs", args={"n_bins": 10, "norm": "max"}),
+    C(id="binary_hinge", path="classification.BinaryHingeLoss", gen="bin_logits"),
+    C(id="binary_prc_binned", path="classification.BinaryPrecisionRecallCurve", gen="bin_probs", args={"thresholds": 21}),
+    C(id="binary_roc_binned", path="classification.BinaryROC", gen="bin_probs", args={"thresholds": 21}),
+    C(id="binary_prec_at_rec", path="classification.BinaryPrecisionAtFixedRecall", gen="bin_probs", args={"min_recall": 0.5}),
+    C(id="binary_rec_at_prec", path="classification.BinaryRecallAtFixedPrecision", gen="bin_probs", args={"min_precision": 0.5}),
+    C(id="binary_spec_at_sens", path="classification.BinarySpecificityAtSensitivity", gen="bin_probs", args={"min_sensitivity": 0.5}),
+    C(id="binary_group_stat_rates", path="classification.BinaryGroupStatRates", gen="bin_probs_grouped", args={"num_groups": 2}),
+    # ---------------------------------------------------------------- classification: multiclass
+    C(id="mc_accuracy_micro", path="classification.MulticlassAccuracy", gen="mc_logits", args={"num_classes": 5, "average": "micro"}),
+    C(id="mc_accuracy_macro", path="classification.MulticlassAccuracy", gen="mc_logits", args={"num_classes": 5, "average": "macro"}),
+    C(id="mc_accuracy_none_top2", path="classification.MulticlassAccuracy", gen="mc_logits", args={"num_classes": 5, "average": "none", "top_k": 2}),
+    C(id="mc_precision_macro", path="classification.MulticlassPrecision", gen="mc_logits", args={"num_classes": 5, "average": "macro"}),
+    C(id="mc_recall_weighted", path="classification.MulticlassRecall", gen="mc_logits", args={"num_classes": 5, "average": "weighted"}),
+    C(id="mc_f1_none", path="classification.MulticlassF1Score", gen="mc_logits", args={"num_classes": 5, "average": "none"}),
+    C(id="mc_fbeta05_macro", path="classification.MulticlassFBetaScore", gen="mc_logits", args={"beta": 0.5, "num_classes": 5, "average": "macro"}),
+    C(id="mc_specificity_micro", path="classification.MulticlassSpecificity", gen="mc_logits", args={"num_classes": 5, "average": "micro"}),
+    C(id="mc_hamming_macro", path="classification.MulticlassHammingDistance", gen="mc_logits", args={"num_classes": 5, "average": "macro"}),
+    C(id="mc_stat_scores", path="classification.MulticlassStatScores", gen="mc_logits", args={"num_classes": 5, "average": "none"}),
+    C(id="mc_confmat", path="classification.MulticlassConfusionMatrix", gen="mc_logits", args={"num_classes": 5}),
+    C(id="mc_confmat_norm_true", path="classification.MulticlassConfusionMatrix", gen="mc_logits", args={"num_classes": 5, "normalize": "true"}),
+    C(id="mc_jaccard", path="classification.MulticlassJaccardIndex", gen="mc_logits", args={"num_classes": 5}),
+    C(id="mc_matthews", path="classification.MulticlassMatthewsCorrCoef", gen="mc_logits", args={"num_classes": 5}),
+    C(id="mc_cohen_kappa", path="classification.MulticlassCohenKappa", gen="mc_logits", args={"num_classes": 5}),
+    C(id="mc_cohen_kappa_linear", path="classification.MulticlassCohenKappa", gen="mc_logits", args={"num_classes": 5, "weights": "linear"}),
+    C(id="mc_auroc_macro", path="classification.MulticlassAUROC", gen="mc_probs", args={"num_classes": 5, "average": "macro"}),
+    C(id="mc_ap_macro", path="classification.MulticlassAveragePrecision", gen="mc_probs", args={"num_classes": 5, "average": "macro"}),
+    C(id="mc_calibration", path="classification.MulticlassCalibrationError", gen="mc_probs", args={"num_classes": 5, "n_bins": 10}),
+    C(id="mc_hinge", path="classification.MulticlassHingeLoss", gen="mc_logits", args={"num_classes": 5}),
+    C(id="mc_hinge_squared", path="classification.MulticlassHingeLoss", gen="mc_logits", args={"num_classes": 5, "squared": True}),
+    C(id="mc_exact_match", path="classification.MulticlassExactMatch", gen="mc_labels_md", args={"num_classes": 5}),
+    C(id="mc_roc_binned", path="classification.MulticlassROC", gen="mc_probs", args={"num_classes": 5, "thresholds": 21}),
+    C(id="mc_prc_binned", path="classification.MulticlassPrecisionRecallCurve", gen="mc_probs", args={"num_classes": 5, "thresholds": 21}),
+    C(id="mc_rec_at_prec", path="classification.MulticlassRecallAtFixedPrecision", gen="mc_probs", args={"num_classes": 5, "min_precision": 0.5}),
+    C(id="dice", path="classification.Dice", gen="mc_logits", args={"num_classes": 5}),
+    # ---------------------------------------------------------------- classification: multilabel
+    C(id="ml_accuracy_macro", path="classification.MultilabelAccuracy", gen="ml_probs", args={"num_labels": 5, "average": "macro"}),
+    C(id="ml_precision_micro", path="classification.MultilabelPrecision", gen="ml_probs", args={"num_labels": 5, "average": "micro"}),
+    C(id="ml_recall_none", path="classification.MultilabelRecall", gen="ml_probs", args={"num_labels": 5, "average": "none"}),
+    C(id="ml_f1_macro", path="classification.MultilabelF1Score", gen="ml_probs", args={"num_labels": 5, "average": "macro"}),
+    C(id="ml_fbeta2", path="classification.MultilabelFBetaScore", gen="ml_probs", args={"beta": 2.0, "num_labels": 5}),
+    C(id="ml_specificity", path="classification.MultilabelSpecificity", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_hamming", path="classification.MultilabelHammingDistance", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_stat_scores", path="classification.MultilabelStatScores", gen="ml_probs", args={"num_labels": 5, "average": "none"}),
+    C(id="ml_confmat", path="classification.MultilabelConfusionMatrix", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_jaccard", path="classification.MultilabelJaccardIndex", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_matthews", path="classification.MultilabelMatthewsCorrCoef", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_auroc", path="classification.MultilabelAUROC", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_ap", path="classification.MultilabelAveragePrecision", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_exact_match", path="classification.MultilabelExactMatch", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_prc_binned", path="classification.MultilabelPrecisionRecallCurve", gen="ml_probs", args={"num_labels": 5, "thresholds": 21}),
+    C(id="ml_ranking_ap", path="classification.MultilabelRankingAveragePrecision", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_ranking_loss", path="classification.MultilabelRankingLoss", gen="ml_probs", args={"num_labels": 5}),
+    C(id="ml_coverage_error", path="classification.MultilabelCoverageError", gen="ml_probs", args={"num_labels": 5}),
+    # ---------------------------------------------------------------- regression (all 18)
+    C(id="mse", path="regression.MeanSquaredError", gen="reg"),
+    C(id="rmse", path="regression.MeanSquaredError", gen="reg", args={"squared": False}),
+    C(id="mae", path="regression.MeanAbsoluteError", gen="reg"),
+    C(id="mape", path="regression.MeanAbsolutePercentageError", gen="reg_pos"),
+    C(id="smape", path="regression.SymmetricMeanAbsolutePercentageError", gen="reg_pos"),
+    C(id="wmape", path="regression.WeightedMeanAbsolutePercentageError", gen="reg_pos"),
+    C(id="msle", path="regression.MeanSquaredLogError", gen="reg_pos"),
+    C(id="explained_variance", path="regression.ExplainedVariance", gen="reg_corr"),
+    C(id="pearson", path="regression.PearsonCorrCoef", gen="reg_corr", atol=1e-4, rtol=1e-3),
+    C(id="spearman", path="regression.SpearmanCorrCoef", gen="reg_corr", atol=1e-4, rtol=1e-3),
+    C(id="r2", path="regression.R2Score", gen="reg_corr", atol=1e-4, rtol=1e-3),
+    C(id="concordance", path="regression.ConcordanceCorrCoef", gen="reg_corr", atol=1e-4, rtol=1e-3),
+    C(id="cosine_sim", path="regression.CosineSimilarity", gen="reg_2d"),
+    C(id="kendall", path="regression.KendallRankCorrCoef", gen="reg_corr", atol=1e-4, rtol=1e-3),
+    C(id="kldiv", path="regression.KLDivergence", gen="kl_probs"),
+    C(id="log_cosh", path="regression.LogCoshError", gen="reg"),
+    C(id="tweedie_p0", path="regression.TweedieDevianceScore", gen="reg_pos", args={"power": 0.0}),
+    C(id="tweedie_p15", path="regression.TweedieDevianceScore", gen="reg_pos", args={"power": 1.5}),
+    C(id="minkowski_p3", path="regression.MinkowskiDistance", gen="reg", args={"p": 3.0}),
+    C(id="relative_squared_error", path="regression.RelativeSquaredError", gen="reg_corr", atol=1e-4, rtol=1e-3),
+    # ---------------------------------------------------------------- retrieval
+    C(id="retrieval_map", path="retrieval.RetrievalMAP", gen="retrieval"),
+    C(id="retrieval_mrr", path="retrieval.RetrievalMRR", gen="retrieval"),
+    C(id="retrieval_precision", path="retrieval.RetrievalPrecision", gen="retrieval", args={"top_k": 2}),
+    C(id="retrieval_recall", path="retrieval.RetrievalRecall", gen="retrieval", args={"top_k": 2}),
+    C(id="retrieval_fallout", path="retrieval.RetrievalFallOut", gen="retrieval", args={"top_k": 2}),
+    C(id="retrieval_ndcg", path="retrieval.RetrievalNormalizedDCG", gen="retrieval"),
+    C(id="retrieval_hit_rate", path="retrieval.RetrievalHitRate", gen="retrieval", args={"top_k": 2}),
+    C(id="retrieval_r_precision", path="retrieval.RetrievalRPrecision", gen="retrieval"),
+    # ---------------------------------------------------------------- image
+    C(id="ssim", path="image.StructuralSimilarityIndexMeasure", gen="img_correlated", args={"data_range": 1.0}, atol=1e-4, rtol=1e-3),
+    C(id="ms_ssim", path="image.MultiScaleStructuralSimilarityIndexMeasure", gen="img_large", args={"data_range": 1.0}, atol=1e-4, rtol=1e-3),
+    C(id="psnr", path="image.PeakSignalNoiseRatio", gen="img", args={"data_range": 1.0}),
+    C(id="uqi", path="image.UniversalImageQualityIndex", gen="img_correlated", atol=1e-4, rtol=1e-3),
+    C(id="sam", path="image.SpectralAngleMapper", gen="img_correlated", atol=1e-4, rtol=1e-3),
+    C(id="ergas", path="image.ErrorRelativeGlobalDimensionlessSynthesis", gen="img_correlated", atol=1e-3, rtol=1e-3),
+    C(id="rase", path="image.RelativeAverageSpectralError", gen="img_correlated", atol=1e-3, rtol=1e-3),
+    C(id="rmse_sw", path="image.RootMeanSquaredErrorUsingSlidingWindow", gen="img_correlated", atol=1e-4, rtol=1e-3),
+    C(id="d_lambda", path="image.SpectralDistortionIndex", gen="img_correlated", atol=1e-4, rtol=1e-3),
+    C(id="total_variation", path="image.TotalVariation", gen="img_single"),
+    C(id="psnrb", path="image.PeakSignalNoiseRatioWithBlockedEffect", gen="img_gray", atol=1e-4, rtol=1e-3),
+    # ---------------------------------------------------------------- audio
+    C(id="snr", path="audio.SignalNoiseRatio", gen="audio"),
+    C(id="si_snr", path="audio.ScaleInvariantSignalNoiseRatio", gen="audio"),
+    C(id="si_sdr", path="audio.ScaleInvariantSignalDistortionRatio", gen="audio"),
+    C(id="c_si_snr", path="audio.ComplexScaleInvariantSignalNoiseRatio", gen="audio_complex"),
+    C(
+        id="pit_si_snr",
+        path="audio.PermutationInvariantTraining",
+        gen="audio_multisrc",
+        args_resolve={"metric_func": "audio.scale_invariant_signal_noise_ratio"},
+    ),
+    # ---------------------------------------------------------------- text
+    C(id="wer", path="text.WordErrorRate", gen="text_pairs"),
+    C(id="cer", path="text.CharErrorRate", gen="text_pairs"),
+    C(id="mer", path="text.MatchErrorRate", gen="text_pairs"),
+    C(id="wil", path="text.WordInfoLost", gen="text_pairs"),
+    C(id="wip", path="text.WordInfoPreserved", gen="text_pairs"),
+    C(id="bleu", path="text.BLEUScore", gen="text_corpus"),
+    C(id="bleu_smooth", path="text.BLEUScore", gen="text_corpus", args={"smooth": True}),
+    C(id="sacre_bleu", path="text.SacreBLEUScore", gen="text_corpus", requires=("sacrebleu",)),
+    C(id="chrf", path="text.CHRFScore", gen="text_corpus"),
+    C(id="chrf_word", path="text.CHRFScore", gen="text_corpus", args={"n_word_order": 2}),
+    C(id="ter", path="text.TranslationEditRate", gen="text_corpus"),
+    C(id="eed", path="text.ExtendedEditDistance", gen="text_pairs"),
+    # rougeLsum excluded: its sentence splitter needs an nltk punkt download,
+    # impossible in this zero-egress env (the reference raises OSError asking to
+    # download); the other keys share none of that dependency
+    C(id="rouge", path="text.ROUGEScore", gen="text_pairs", requires=("rouge_score", "nltk"),
+      args={"rouge_keys": ("rouge1", "rouge2", "rougeL")}),
+    C(id="perplexity", path="text.Perplexity", gen="perplexity"),
+    C(id="squad", path="text.SQuAD", gen="squad"),
+    # ---------------------------------------------------------------- nominal
+    C(id="cramers_v", path="nominal.CramersV", gen="nominal", args={"num_classes": 4}),
+    C(id="pearsons_contingency", path="nominal.PearsonsContingencyCoefficient", gen="nominal", args={"num_classes": 4}),
+    C(id="tschuprows_t", path="nominal.TschuprowsT", gen="nominal", args={"num_classes": 4}),
+    C(id="theils_u", path="nominal.TheilsU", gen="nominal", args={"num_classes": 4}),
+    C(id="fleiss_kappa", path="nominal.FleissKappa", gen="fleiss", args={"mode": "counts"}),
+    # ---------------------------------------------------------------- aggregation
+    C(id="agg_mean", path="MeanMetric", gen="scalar"),
+    C(id="agg_sum", path="SumMetric", gen="scalar"),
+    C(id="agg_max", path="MaxMetric", gen="scalar"),
+    C(id="agg_min", path="MinMetric", gen="scalar"),
+    C(id="agg_cat", path="CatMetric", gen="scalar", check_merge=False),  # merge order-interleaves
+]
+
+
+def _missing(pkgs):
+    return [p for p in pkgs if importlib.util.find_spec(p) is None]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c.id for c in CASES])
+def test_differential(case, reference_tm):
+    missing = _missing(case.requires)
+    if missing:
+        pytest.skip(f"reference side needs {missing}")
+    seed = abs(hash(case.id)) % (2**31)
+    batches = make_batches(case.gen, seed, **case.gen_kwargs)
+    run_differential_case(case, batches, reference_tm)

@@ -1,0 +1,10 @@
+"""Minimal test-only shim for the `lightning_utilities` package.
+
+The mounted reference (`/root/reference/src/torchmetrics`) imports exactly three
+names from lightning_utilities (`utilities/imports.py:21`, `utilities/enums.py:16`):
+``compare_version``, ``package_available`` and ``StrEnum``. The real package is not
+installed in this environment; this ~60-line shim provides just those three so the
+reference can be imported side-by-side as a differential oracle. It lives under
+``tests/`` and is only ever put on ``sys.path`` by the differential-test conftest —
+it is not part of the torchmetrics_tpu package.
+"""

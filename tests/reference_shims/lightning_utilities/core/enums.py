@@ -1,0 +1,55 @@
+"""StrEnum shim matching the public behavior the reference relies on.
+
+The reference's ``EnumStr`` (``utilities/enums.py:20``) calls
+``super().from_str(value, source=...)`` and ``cls._allowed_matches(source)``;
+comparisons across the codebase are case-insensitive string equality.
+"""
+
+from enum import Enum
+from typing import List, Optional
+
+
+class StrEnum(str, Enum):
+    """An Enum whose members are (case-insensitively) comparable to strings."""
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> "StrEnum":
+        matched = cls.try_from_str(value, source=source)
+        if matched is None:
+            raise ValueError(
+                f"Invalid match: expected one of {cls._allowed_matches(source)}, but got {value}."
+            )
+        return matched
+
+    @classmethod
+    def try_from_str(cls, value: str, source: str = "key") -> Optional["StrEnum"]:
+        if source in ("key", "any"):
+            for member in cls:
+                if member.name.lower() == value.lower():
+                    return member
+        if source in ("value", "any"):
+            for member in cls:
+                if member.value.lower() == value.lower():
+                    return member
+        return None
+
+    @classmethod
+    def _allowed_matches(cls, source: str = "key") -> List[str]:
+        keys = [member.name.lower() for member in cls]
+        values = [member.value.lower() for member in cls]
+        if source == "key":
+            return keys
+        if source == "value":
+            return values
+        return keys + values
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
+        if isinstance(other, str):
+            return self.value.lower() == other.lower()
+        return False
+
+    def __hash__(self) -> int:
+        # case-insensitive __eq__ needs a matching case-insensitive hash
+        return hash(self.value.lower())

@@ -1,0 +1,40 @@
+"""compare_version / package_available shims (reference ``utilities/imports.py:21``)."""
+
+import importlib
+import importlib.util
+from typing import Callable, Optional
+
+from packaging.version import Version
+
+
+def package_available(package_name: str) -> bool:
+    try:
+        return importlib.util.find_spec(package_name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+def module_available(module_path: str) -> bool:
+    if not package_available(module_path.split(".")[0]):
+        return False
+    try:
+        importlib.import_module(module_path)
+    except ImportError:
+        return False
+    return True
+
+
+def compare_version(
+    package: str, op: Callable, version: str, use_base_version: bool = False
+) -> Optional[bool]:
+    try:
+        pkg = importlib.import_module(package)
+    except (ImportError, ModuleNotFoundError):
+        return False
+    try:
+        pkg_version = Version(pkg.__version__)
+    except (AttributeError, TypeError):
+        return None
+    if use_base_version:
+        pkg_version = Version(pkg_version.base_version)
+    return op(pkg_version, Version(version))

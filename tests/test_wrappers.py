@@ -436,12 +436,14 @@ def test_minmax_tracks_extrema_of_compute():
     mm = MinMaxMetric(BinaryAccuracy())
     out1 = mm(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 0, 0]))  # batch acc 0.5
     np.testing.assert_allclose(float(out1["raw"]), 0.5, atol=1e-6)
-    # reference-parity quirk: every forward() resets the extrema before re-applying
-    # the batch (full-state path), so after a second forward min == max == batch value
+    # reference parity (verified by executing the reference in
+    # tests/differential/test_orchestration.py): the extrema are plain attributes,
+    # untouched by the full-state forward's mid-step reset(), so they track the
+    # running min/max of per-batch values across forwards
     out2 = mm(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))  # batch acc 1.0
     np.testing.assert_allclose(float(out2["raw"]), 1.0, atol=1e-6)
     np.testing.assert_allclose(float(out2["max"]), 1.0, atol=1e-6)
-    np.testing.assert_allclose(float(out2["min"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(out2["min"]), 0.5, atol=1e-6)
     # reference-parity: forward's full-state path caches only the wrapper's OWN
     # states (none), so the base metric keeps only the LAST batch across forwards
     # (metric.py _forward_full_state_update cache = self._defaults) — epoch compute
@@ -467,15 +469,18 @@ def test_minmax_update_path_accumulates():
     np.testing.assert_allclose(float(out2["max"]), 1.0, atol=1e-6)
 
 
-def test_minmax_reset_clears_extrema():
+def test_minmax_reset_preserves_extrema():
+    """Reference parity: reset() clears the base metric but NOT the extrema —
+    min_val/max_val are unregistered attributes in the reference too (verified by
+    side-by-side execution in tests/differential/test_orchestration.py)."""
     from torchmetrics_tpu.wrappers import MinMaxMetric
     from torchmetrics_tpu.classification import BinaryAccuracy
 
     mm = MinMaxMetric(BinaryAccuracy())
-    mm(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+    mm(jnp.asarray([1, 0]), jnp.asarray([1, 1]))  # batch acc 0.5
     mm.reset()
-    out = mm(jnp.asarray([1, 1]), jnp.asarray([1, 1]))
-    np.testing.assert_allclose(float(out["min"]), 1.0, atol=1e-6)
+    out = mm(jnp.asarray([1, 1]), jnp.asarray([1, 1]))  # batch acc 1.0
+    np.testing.assert_allclose(float(out["min"]), 0.5, atol=1e-6)
     np.testing.assert_allclose(float(out["max"]), 1.0, atol=1e-6)
 
 

@@ -36,6 +36,18 @@ _LABEL_F32_BOUND_MSG = (
 )
 
 
+def _check_packed_label_bound(name: str, labels_2d: np.ndarray, counts: np.ndarray) -> None:
+    """Raise when any VALID-row label magnitude breaks f32 exactness (|v| >= 2**24).
+
+    Rows past each image's count are padding and may hold sentinels; they are
+    never read back, so they are exempt.
+    """
+    valid = np.arange(labels_2d.shape[-1]) < np.asarray(counts).reshape(-1, 1)
+    masked = np.abs(np.where(valid, labels_2d, 0))
+    if masked.size and float(masked.max()) >= 2**24:
+        raise ValueError(_LABEL_F32_BOUND_MSG.format(name, int(masked.max())))
+
+
 def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     """Host-side pairwise IoU used inside the ragged evaluation loops."""
     if det.size == 0 or gt.size == 0:
@@ -240,13 +252,10 @@ class MeanAveragePrecision(Metric):
             # (numpy/lists) are checked here for an early, per-call error; device
             # arrays are checked once at compute on the already-fetched buffers
             # (see _unpack_into), preserving the single-fetch-at-compute invariant.
-            # Only rows within num_boxes count — padding slots may hold sentinels.
-            if isinstance(lbl, (np.ndarray, list, tuple, int)) and isinstance(cnt, (np.ndarray, list, tuple, int)):
+            if isinstance(lbl, (np.ndarray, list, tuple)) and isinstance(cnt, (np.ndarray, list, tuple, int)):
                 lbl_np = np.asarray(lbl)
-                valid = np.arange(lbl_np.shape[-1]) < np.asarray(cnt).reshape(-1, 1)
-                masked = np.abs(np.where(valid, lbl_np, 0))
-                if masked.size and int(masked.max()) >= 2**24:
-                    raise ValueError(_LABEL_F32_BOUND_MSG.format(name, int(masked.max())))
+                if lbl_np.ndim >= 2:  # malformed shapes fall through to pack-time validation
+                    _check_packed_label_bound(name, lbl_np, np.asarray(cnt))
         if self.box_format != "xyxy":
             p_boxes = _box_convert(p_boxes.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy").reshape(b, m, 4)
             t_boxes = _box_convert(t_boxes.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy").reshape(*t_boxes.shape)
@@ -300,22 +309,20 @@ class MeanAveragePrecision(Metric):
         packed_t = _bulk_to_host(self.packed_targets)
         t_counts = _bulk_to_host(self.packed_target_counts)
         for pp, pc, tt, tc in zip(packed_p, p_counts, packed_t, t_counts):
-            # f32-exactness bound, checked on the already-fetched host buffers (any
-            # original id with |v| >= 2**24 lands here with |packed| >= 2**24, so
-            # detection after the cast is sound; ids that were device arrays at
-            # update time could not be checked without an extra fetch). Only rows
-            # within each image's count — padding slots may hold sentinels.
-            for name, col, cnt in (("preds", pp[..., 5], pc), ("target", tt[..., 4], tc)):
-                valid = np.arange(col.shape[-1]) < np.asarray(cnt).reshape(-1, 1)
-                masked = np.abs(np.where(valid, col, 0.0))
-                if masked.size and float(masked.max()) >= 2**24:
-                    raise ValueError(_LABEL_F32_BOUND_MSG.format(name, int(masked.max())))
+            # count-range check FIRST: an out-of-range count would make the label
+            # bound check below misread sentinel padding as real labels
             if (pc < 0).any() or (pc > pp.shape[1]).any() or (tc < 0).any() or (tc > tt.shape[1]).any():
                 raise ValueError(
                     f"Packed num_boxes out of range: counts must lie in [0, padded width]"
                     f" ({pp.shape[1]} preds / {tt.shape[1]} target) — a count past the padding"
                     " would silently drop boxes"
                 )
+            # f32-exactness bound, checked on the already-fetched host buffers (any
+            # original id with |v| >= 2**24 lands here with |packed| >= 2**24, so
+            # detection after the cast is sound; ids that were device arrays at
+            # update time could not be checked without an extra fetch)
+            _check_packed_label_bound("preds", pp[..., 5], pc)
+            _check_packed_label_bound("target", tt[..., 4], tc)
             for i in range(pp.shape[0]):
                 n = int(pc[i])
                 dets.append(pp[i, :n, :4].astype(np.float32))
