@@ -1,0 +1,15 @@
+# One-command entry points (reference Makefile:22-26 analogue).
+
+.PHONY: test test-fast bench multichip
+
+test:            ## full gate: CPU-mesh suite + doctests + differential + distributed worlds
+	bash scripts/ci.sh
+
+test-fast:       ## same gate minus the execute-the-reference differential sweep
+	bash scripts/ci.sh fast
+
+bench:           ## one JSON line on the current accelerator
+	python bench.py
+
+multichip:       ## compile-check the sharded path on an 8-virtual-device CPU mesh
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"

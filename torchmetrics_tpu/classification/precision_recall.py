@@ -19,7 +19,18 @@ Array = jax.Array
 
 
 class BinaryPrecision(BinaryStatScores):
-    """Precision for binary tasks (reference ``precision_recall.py``)."""
+    """Precision for binary tasks (reference ``precision_recall.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.precision_recall import BinaryPrecision
+        >>> metric = BinaryPrecision()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.6667
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -68,7 +68,18 @@ class _AbstractRanking(Metric):
 
 
 class MultilabelCoverageError(_AbstractRanking):
-    """Coverage error (reference ``ranking.py``)."""
+    """Coverage error (reference ``ranking.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> from torchmetrics_tpu.classification.ranking import MultilabelCoverageError
+        >>> metric = MultilabelCoverageError(num_labels=3)
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        1.6667
+    """
 
     higher_is_better: bool = False
     _update_fn = staticmethod(_multilabel_coverage_error_update)

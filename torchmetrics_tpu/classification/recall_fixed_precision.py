@@ -29,7 +29,18 @@ Array = jax.Array
 
 
 class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
-    """Max recall at a minimum precision, binary task (reference ``:46-176``)."""
+    """Max recall at a minimum precision, binary task (reference ``:46-176``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.recall_fixed_precision import BinaryRecallAtFixedPrecision
+        >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        >>> _ = metric.update(preds, target)
+        >>> print(tuple(round(float(v), 4) for v in metric.compute()))
+        (1.0, 0.35)
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True

@@ -23,7 +23,18 @@ Array = jax.Array
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
-    """ROC for binary tasks (reference ``roc.py``)."""
+    """ROC for binary tasks (reference ``roc.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.roc import BinaryROC
+        >>> metric = BinaryROC(thresholds=5)
+        >>> _ = metric.update(preds, target)
+        >>> print(tuple(v.shape for v in metric.compute()))
+        ((5,), (5,), (5,))
+    """
 
     def compute(self):
         """(fpr, tpr, thresholds)."""

@@ -26,7 +26,18 @@ Array = jax.Array
 
 
 class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
-    """Max specificity at a minimum sensitivity, binary task (reference ``:46-127``)."""
+    """Max specificity at a minimum sensitivity, binary task (reference ``:46-127``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.specificity_sensitivity import BinarySpecificityAtSensitivity
+        >>> metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+        >>> _ = metric.update(preds, target)
+        >>> print(tuple(round(float(v), 4) for v in metric.compute()))
+        (1.0, 0.75)
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True

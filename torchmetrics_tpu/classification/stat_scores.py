@@ -79,7 +79,18 @@ class _AbstractStatScores(Metric):
 
 
 class BinaryStatScores(_AbstractStatScores):
-    """tp/fp/tn/fn for binary tasks (reference ``classification/stat_scores.py:85-182``)."""
+    """tp/fp/tn/fn for binary tasks (reference ``classification/stat_scores.py:85-182``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.classification.stat_scores import BinaryStatScores
+        >>> metric = BinaryStatScores()
+        >>> _ = metric.update(preds, target)
+        >>> print([round(float(x), 4) for x in metric.compute()])
+        [2.0, 1.0, 2.0, 1.0, 3.0]
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None

@@ -9,7 +9,18 @@ from torchmetrics_tpu.functional.detection.helpers import _box_ciou
 
 
 class CompleteIntersectionOverUnion(IntersectionOverUnion):
-    """Mean CIoU over matched boxes; invalid pairs get the reference's -2 floor."""
+    """Mean CIoU over matched boxes; invalid pairs get the reference's -2 floor.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = [{'boxes': jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), 'scores': jnp.asarray([0.9]), 'labels': jnp.asarray([0])}]
+        >>> target = [{'boxes': jnp.asarray([[12.0, 10.0, 58.0, 62.0]]), 'labels': jnp.asarray([0])}]
+        >>> from torchmetrics_tpu.detection.ciou import CompleteIntersectionOverUnion
+        >>> metric = CompleteIntersectionOverUnion()
+        >>> _ = metric.update(preds, target)
+        >>> print({k: round(float(v), 4) for k, v in sorted(metric.compute().items())})
+        {'ciou': 0.8871}
+    """
 
     _iou_type: str = "ciou"
     _invalid_val: float = -2.0

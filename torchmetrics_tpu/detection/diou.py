@@ -9,7 +9,18 @@ from torchmetrics_tpu.functional.detection.helpers import _box_diou
 
 
 class DistanceIntersectionOverUnion(IntersectionOverUnion):
-    """Mean DIoU over matched boxes; DIoU ranges in [-1, 1] so invalid pairs get -1."""
+    """Mean DIoU over matched boxes; DIoU ranges in [-1, 1] so invalid pairs get -1.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = [{'boxes': jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), 'scores': jnp.asarray([0.9]), 'labels': jnp.asarray([0])}]
+        >>> target = [{'boxes': jnp.asarray([[12.0, 10.0, 58.0, 62.0]]), 'labels': jnp.asarray([0])}]
+        >>> from torchmetrics_tpu.detection.diou import DistanceIntersectionOverUnion
+        >>> metric = DistanceIntersectionOverUnion()
+        >>> _ = metric.update(preds, target)
+        >>> print({k: round(float(v), 4) for k, v in sorted(metric.compute().items())})
+        {'diou': 0.8872}
+    """
 
     _iou_type: str = "diou"
     _invalid_val: float = -1.0

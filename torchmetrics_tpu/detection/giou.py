@@ -9,7 +9,18 @@ from torchmetrics_tpu.functional.detection.helpers import _box_giou
 
 
 class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
-    """Mean GIoU over matched boxes; GIoU ranges in [-1, 1] so invalid pairs get -1."""
+    """Mean GIoU over matched boxes; GIoU ranges in [-1, 1] so invalid pairs get -1.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = [{'boxes': jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), 'scores': jnp.asarray([0.9]), 'labels': jnp.asarray([0])}]
+        >>> target = [{'boxes': jnp.asarray([[12.0, 10.0, 58.0, 62.0]]), 'labels': jnp.asarray([0])}]
+        >>> from torchmetrics_tpu.detection.giou import GeneralizedIntersectionOverUnion
+        >>> metric = GeneralizedIntersectionOverUnion()
+        >>> _ = metric.update(preds, target)
+        >>> print({k: round(float(v), 4) for k, v in sorted(metric.compute().items())})
+        {'giou': 0.8843}
+    """
 
     _iou_type: str = "giou"
     _invalid_val: float = -1.0

@@ -48,6 +48,25 @@ def _check_packed_label_bound(name: str, labels_2d: np.ndarray, counts: np.ndarr
         raise ValueError(_LABEL_F32_BOUND_MSG.format(name, int(masked.max())))
 
 
+def _validate_packed_batch(pp: np.ndarray, pc: np.ndarray, tt: np.ndarray, tc: np.ndarray) -> None:
+    """Shared packed-batch invariants for both compute paths (native + fallback).
+
+    Count-range check FIRST: an out-of-range count would make the label bound
+    check misread sentinel padding as real labels. The f32-exactness bound runs
+    on the already-fetched host buffers (any original id with |v| >= 2**24 lands
+    here with |packed| >= 2**24, so detection after the cast is sound; device
+    arrays at update time could not be checked without an extra fetch).
+    """
+    if (pc < 0).any() or (pc > pp.shape[1]).any() or (tc < 0).any() or (tc > tt.shape[1]).any():
+        raise ValueError(
+            f"Packed num_boxes out of range: counts must lie in [0, padded width]"
+            f" ({pp.shape[1]} preds / {tt.shape[1]} target) — a count past the padding"
+            " would silently drop boxes"
+        )
+    _check_packed_label_bound("preds", pp[..., 5], pc)
+    _check_packed_label_bound("target", tt[..., 4], tc)
+
+
 def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     """Host-side pairwise IoU used inside the ragged evaluation loops."""
     if det.size == 0 or gt.size == 0:
@@ -132,7 +151,18 @@ def _area(values, iou_type: str) -> np.ndarray:
 
 
 class MeanAveragePrecision(Metric):
-    """mAP/mAR for object detection with COCOeval semantics (reference ``mean_ap.py:150``)."""
+    """mAP/mAR for object detection with COCOeval semantics (reference ``mean_ap.py:150``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = [{'boxes': jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), 'scores': jnp.asarray([0.9]), 'labels': jnp.asarray([0])}]
+        >>> target = [{'boxes': jnp.asarray([[12.0, 10.0, 58.0, 62.0]]), 'labels': jnp.asarray([0])}]
+        >>> from torchmetrics_tpu.detection.mean_ap import MeanAveragePrecision
+        >>> metric = MeanAveragePrecision()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(round(float(metric.compute()['map']), 4)), 4))
+        0.8
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True
@@ -309,20 +339,7 @@ class MeanAveragePrecision(Metric):
         packed_t = _bulk_to_host(self.packed_targets)
         t_counts = _bulk_to_host(self.packed_target_counts)
         for pp, pc, tt, tc in zip(packed_p, p_counts, packed_t, t_counts):
-            # count-range check FIRST: an out-of-range count would make the label
-            # bound check below misread sentinel padding as real labels
-            if (pc < 0).any() or (pc > pp.shape[1]).any() or (tc < 0).any() or (tc > tt.shape[1]).any():
-                raise ValueError(
-                    f"Packed num_boxes out of range: counts must lie in [0, padded width]"
-                    f" ({pp.shape[1]} preds / {tt.shape[1]} target) — a count past the padding"
-                    " would silently drop boxes"
-                )
-            # f32-exactness bound, checked on the already-fetched host buffers (any
-            # original id with |v| >= 2**24 lands here with |packed| >= 2**24, so
-            # detection after the cast is sound; ids that were device arrays at
-            # update time could not be checked without an extra fetch)
-            _check_packed_label_bound("preds", pp[..., 5], pc)
-            _check_packed_label_bound("target", tt[..., 4], tc)
+            _validate_packed_batch(pp, pc, tt, tc)
             for i in range(pp.shape[0]):
                 n = int(pc[i])
                 dets.append(pp[i, :n, :4].astype(np.float32))
@@ -343,6 +360,16 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> Dict[str, Array]:
         """COCOeval over the buffered epoch (reference ``mean_ap.py:846-875``)."""
+        if self.iou_type == "bbox":
+            from torchmetrics_tpu.native import coco_eval_bbox_available
+
+            # the native evaluator's PR-interpolation cursor assumes ascending
+            # rec_thresholds (the COCO default); anything else rides the
+            # per-threshold-searchsorted Python path so both paths stay exact
+            rec = np.asarray(self.rec_thresholds)
+            if coco_eval_bbox_available() and bool(np.all(np.diff(rec) >= 0)):
+                return self._compute_native_bbox()
+
         # ONE batched D2H fetch per list state (RLE lists are already host data)
         dets = _bulk_to_host(self.detections)
         det_scores = _bulk_to_host(self.detection_scores)
@@ -353,6 +380,91 @@ class MeanAveragePrecision(Metric):
 
         classes = self._get_classes(det_labels, gt_labels)
         precisions, recalls = self._calculate(classes, dets, det_scores, det_labels, gts, gt_labels)
+        return self._finalize(precisions, recalls, classes)
+
+    def _compute_native_bbox(self) -> Dict[str, Array]:
+        """Epoch-end compute on the C++ fast path: flat epoch arrays, one call.
+
+        Replaces the per-image Python unpack + per-(class, image) evaluation loop
+        with vectorized numpy flattening (packed states extract by mask, no
+        per-image slicing) and a single ``coco_eval_bbox`` call that does
+        bucketing, per-image score sort, IoU, greedy matching, and PR-curve
+        accumulation natively. Results are bit-identical to the Python fallback
+        (pinned by ``tests/detection/test_native_eval_parity.py``).
+        """
+        from torchmetrics_tpu.native import coco_eval_bbox
+
+        det_parts, score_parts, dlab_parts, dimg_parts = [], [], [], []
+        gt_parts, glab_parts, gimg_parts = [], [], []
+
+        # per-image list states (images 0..n_list-1, same ordering as _unpack_into)
+        dets_l = _bulk_to_host(self.detections)
+        scores_l = _bulk_to_host(self.detection_scores)
+        dlab_l = [l.reshape(-1) for l in _bulk_to_host(self.detection_labels)]
+        gts_l = _bulk_to_host(self.groundtruths)
+        glab_l = [l.reshape(-1) for l in _bulk_to_host(self.groundtruth_labels)]
+        n_img = len(gts_l)
+        if n_img:
+            det_parts += [np.asarray(d).reshape(-1, 4) for d in dets_l]
+            score_parts += [np.asarray(s).reshape(-1) for s in scores_l]
+            dlab_parts += dlab_l
+            dimg_parts.append(np.repeat(np.arange(n_img), [len(s) for s in dlab_l]))
+            gt_parts += [np.asarray(g).reshape(-1, 4) for g in gts_l]
+            glab_parts += glab_l
+            gimg_parts.append(np.repeat(np.arange(n_img), [len(g) for g in glab_l]))
+
+        # packed batch states: masked extraction, zero per-image Python work
+        packed_p = _bulk_to_host(self.packed_preds)
+        p_counts = _bulk_to_host(self.packed_pred_counts)
+        packed_t = _bulk_to_host(self.packed_targets)
+        t_counts = _bulk_to_host(self.packed_target_counts)
+        for pp, pc, tt, tc in zip(packed_p, p_counts, packed_t, t_counts):
+            _validate_packed_batch(pp, pc, tt, tc)
+            b = pp.shape[0]
+            pmask = np.arange(pp.shape[1]) < pc.reshape(-1, 1)
+            tmask = np.arange(tt.shape[1]) < tc.reshape(-1, 1)
+            det_parts.append(pp[..., :4][pmask])
+            score_parts.append(pp[..., 4][pmask])
+            dlab_parts.append(pp[..., 5][pmask].astype(np.int64))
+            dimg_parts.append(np.broadcast_to((n_img + np.arange(b))[:, None], pmask.shape)[pmask])
+            gt_parts.append(tt[..., :4][tmask])
+            glab_parts.append(tt[..., 4][tmask].astype(np.int64))
+            gimg_parts.append(np.broadcast_to((n_img + np.arange(b))[:, None], tmask.shape)[tmask])
+            n_img += b
+
+        cat = lambda parts, empty: np.concatenate(parts) if parts else empty  # noqa: E731
+        det_boxes = cat(det_parts, np.zeros((0, 4)))
+        det_scores = cat(score_parts, np.zeros(0))
+        det_labels = cat(dlab_parts, np.zeros(0, np.int64)).astype(np.int64)
+        det_img = cat(dimg_parts, np.zeros(0, np.int64))
+        gt_boxes = cat(gt_parts, np.zeros((0, 4)))
+        gt_labels = cat(glab_parts, np.zeros(0, np.int64)).astype(np.int64)
+        gt_img = cat(gimg_parts, np.zeros(0, np.int64))
+
+        if det_labels.size or gt_labels.size:
+            classes = np.unique(np.concatenate([det_labels, gt_labels])).astype(int).tolist()
+        else:
+            classes = []
+        sorted_ids = np.asarray(classes, dtype=np.int64)
+        precisions, recalls = coco_eval_bbox(
+            det_boxes,
+            det_scores,
+            det_img,
+            np.searchsorted(sorted_ids, det_labels),
+            gt_boxes,
+            gt_img,
+            np.searchsorted(sorted_ids, gt_labels),
+            n_img,
+            len(classes),
+            np.asarray(self.iou_thresholds, dtype=np.float64),
+            np.asarray(self.rec_thresholds),
+            np.asarray(list(self.bbox_area_ranges.values()), dtype=np.float64),
+            np.asarray(self.max_detection_thresholds, dtype=np.int64),
+        )
+        return self._finalize(precisions, recalls, classes)
+
+    def _finalize(self, precisions: np.ndarray, recalls: np.ndarray, classes: List[int]) -> Dict[str, Array]:
+        """Summarize precision/recall tensors into the COCO headline dict."""
         map_val, mar_val = self._summarize_results(precisions, recalls)
 
         map_per_class: Any = np.array([-1.0])
@@ -368,14 +480,18 @@ class MeanAveragePrecision(Metric):
             map_per_class = np.array(map_list, dtype=np.float32)
             mar_max_per_class = np.array(mar_list, dtype=np.float32)
 
+        # dtype casts and squeezes happen in NUMPY, then one compile-free
+        # device_put per value: jnp.asarray(..., dtype)/.squeeze() here would
+        # trace + compile ~6 tiny XLA programs (~4 s cold) inside every fresh
+        # process's first epoch-end compute
         metrics: Dict[str, Array] = {}
-        metrics.update({k: jnp.asarray(v, dtype=jnp.float32) for k, v in map_val.items()})
-        metrics.update({k: jnp.asarray(v, dtype=jnp.float32) for k, v in mar_val.items()})
-        metrics["map_per_class"] = jnp.asarray(map_per_class, dtype=jnp.float32).squeeze()
-        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(
-            mar_max_per_class, dtype=jnp.float32
-        ).squeeze()
-        metrics["classes"] = jnp.asarray(np.array(classes), dtype=jnp.int32).squeeze()
+        metrics.update({k: jax.device_put(np.asarray(v, np.float32)) for k, v in map_val.items()})
+        metrics.update({k: jax.device_put(np.asarray(v, np.float32)) for k, v in mar_val.items()})
+        metrics["map_per_class"] = jax.device_put(np.asarray(map_per_class, np.float32).squeeze())
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jax.device_put(
+            np.asarray(mar_max_per_class, np.float32).squeeze()
+        )
+        metrics["classes"] = jax.device_put(np.asarray(classes, np.int32).squeeze())
         return metrics
 
     def _evaluate_pair(
@@ -463,6 +579,7 @@ class MeanAveragePrecision(Metric):
         max_detections = self.max_detection_thresholds[-1]
         thresholds = np.asarray(self.iou_thresholds, dtype=np.float64)
         area_ranges = np.asarray(list(self.bbox_area_ranges.values()), dtype=np.float64)
+
 
         class_imgs: Dict[int, List[int]] = {c: [] for c in class_ids}
         for idx in range(nb_imgs):

@@ -26,7 +26,18 @@ Array = jax.Array
 
 
 class PanopticQuality(Metric):
-    """Panoptic Quality with per-category sum states (reference ``panoptic_qualities.py:27-215``)."""
+    """Panoptic Quality with per-category sum states (reference ``panoptic_qualities.py:27-215``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2]]])
+        >>> target = jnp.asarray([[[0, 1], [0, 1], [6, 0], [7, 0], [1, 0]]])
+        >>> from torchmetrics_tpu.detection.panoptic_qualities import PanopticQuality
+        >>> metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.5
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -75,7 +75,17 @@ def signal_distortion_ratio(
 
 
 def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SI-SDR in dB (reference ``sdr.py:193-244``)."""
+    """SI-SDR in dB (reference ``sdr.py:193-244``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(1)
+        >>> target = jax.random.normal(key, (2, 100))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.fold_in(key, 1), (2, 100))
+        >>> from torchmetrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+        >>> print([round(float(x), 4) for x in scale_invariant_signal_distortion_ratio(preds, target)])
+        [21.438, 20.9752]
+    """
     _check_same_shape(preds, target)
     eps = float(jnp.finfo(jnp.asarray(preds).dtype).eps)
     if zero_mean:

@@ -12,7 +12,17 @@ Array = jax.Array
 
 
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SNR in dB over the trailing time axis (reference ``snr.py:22-63``)."""
+    """SNR in dB over the trailing time axis (reference ``snr.py:22-63``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(1)
+        >>> target = jax.random.normal(key, (2, 100))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.fold_in(key, 1), (2, 100))
+        >>> from torchmetrics_tpu.functional.audio.snr import signal_noise_ratio
+        >>> print([round(float(x), 4) for x in signal_noise_ratio(preds, target)])
+        [21.4689, 20.9864]
+    """
     _check_same_shape(preds, target)
     eps = float(jnp.finfo(jnp.asarray(preds).dtype).eps)
     if zero_mean:

@@ -86,7 +86,16 @@ def binary_average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """AP for binary tasks (reference ``average_precision.py:80-148``)."""
+    """AP for binary tasks (reference ``average_precision.py:80-148``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.average_precision import binary_average_precision
+        >>> print(round(float(binary_average_precision(preds, target)), 4))
+        0.9167
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)

@@ -104,7 +104,16 @@ def binary_calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """ECE for binary tasks (reference ``calibration_error.py:139-...``)."""
+    """ECE for binary tasks (reference ``calibration_error.py:139-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.calibration_error import binary_calibration_error
+        >>> print(round(float(binary_calibration_error(preds, target)), 4))
+        0.3167
+    """
     if validate_args:
         _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
         _binary_calibration_error_tensor_validation(preds, target, ignore_index)

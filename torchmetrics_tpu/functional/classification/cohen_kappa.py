@@ -63,7 +63,16 @@ def binary_cohen_kappa(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Kappa for binary tasks (reference ``cohen_kappa.py:58-...``)."""
+    """Kappa for binary tasks (reference ``cohen_kappa.py:58-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.cohen_kappa import binary_cohen_kappa
+        >>> print(round(float(binary_cohen_kappa(preds, target)), 4))
+        0.3333
+    """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
         _validate_weights(weights)

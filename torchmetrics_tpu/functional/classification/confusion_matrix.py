@@ -124,7 +124,16 @@ def binary_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """2x2 confusion matrix for binary tasks (reference ``confusion_matrix.py:151-211``)."""
+    """2x2 confusion matrix for binary tasks (reference ``confusion_matrix.py:151-211``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.confusion_matrix import binary_confusion_matrix
+        >>> print(binary_confusion_matrix(preds, target).shape)
+        (2, 2)
+    """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)

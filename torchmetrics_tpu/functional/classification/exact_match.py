@@ -49,7 +49,16 @@ def multiclass_exact_match(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Exact match for multidim multiclass tasks (reference ``exact_match.py:57-...``)."""
+    """Exact match for multidim multiclass tasks (reference ``exact_match.py:57-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        >>> target = jnp.asarray([2, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.exact_match import multiclass_exact_match
+        >>> print(round(float(multiclass_exact_match(preds, target, num_classes=3)), 4))
+        0.5
+    """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)

@@ -58,7 +58,16 @@ def binary_fbeta_score(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """F-beta for binary tasks (reference ``f_beta.py:73-...``)."""
+    """F-beta for binary tasks (reference ``f_beta.py:73-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.f_beta import binary_fbeta_score
+        >>> print(round(float(binary_fbeta_score(preds, target, beta=1.0)), 4))
+        0.6667
+    """
     if validate_args:
         _validate_beta(beta)
     tp, fp, tn, fn = _binary_stat_scores_pipeline(

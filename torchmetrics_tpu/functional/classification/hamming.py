@@ -53,7 +53,16 @@ def binary_hamming_distance(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Hamming distance for binary tasks (reference ``hamming.py``)."""
+    """Hamming distance for binary tasks (reference ``hamming.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.hamming import binary_hamming_distance
+        >>> print(round(float(binary_hamming_distance(preds, target)), 4))
+        0.3333
+    """
     tp, fp, tn, fn = _binary_stat_scores_pipeline(
         preds, target, threshold, multidim_average, ignore_index, validate_args
     )

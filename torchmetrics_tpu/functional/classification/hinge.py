@@ -65,7 +65,16 @@ def binary_hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = False,
 ) -> Array:
-    """Hinge loss for binary tasks (reference ``hinge.py:72-...``)."""
+    """Hinge loss for binary tasks (reference ``hinge.py:72-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.hinge import binary_hinge_loss
+        >>> print(round(float(binary_hinge_loss(preds, target)), 4))
+        0.8167
+    """
     if validate_args:
         _binary_hinge_loss_arg_validation(squared, ignore_index)
         _binary_hinge_loss_tensor_validation(preds, target, ignore_index)

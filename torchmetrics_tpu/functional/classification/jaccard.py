@@ -75,7 +75,16 @@ def binary_jaccard_index(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """IoU for binary tasks (reference ``jaccard.py:97-...``)."""
+    """IoU for binary tasks (reference ``jaccard.py:97-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.jaccard import binary_jaccard_index
+        >>> print(round(float(binary_jaccard_index(preds, target)), 4))
+        0.5
+    """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)

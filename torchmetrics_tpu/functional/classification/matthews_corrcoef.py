@@ -73,7 +73,16 @@ def binary_matthews_corrcoef(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """MCC for binary tasks (reference ``matthews_corrcoef.py:81-...``)."""
+    """MCC for binary tasks (reference ``matthews_corrcoef.py:81-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.matthews_corrcoef import binary_matthews_corrcoef
+        >>> print(round(float(binary_matthews_corrcoef(preds, target)), 4))
+        0.3333
+    """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)

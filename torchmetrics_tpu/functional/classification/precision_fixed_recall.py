@@ -50,7 +50,16 @@ def binary_precision_at_fixed_recall(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array]:
-    r"""Highest precision given a minimum recall floor, binary task (reference ``:63-134``)."""
+    r"""Highest precision given a minimum recall floor, binary task (reference ``:63-134``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.precision_fixed_recall import binary_precision_at_fixed_recall
+        >>> print(tuple(round(float(v), 4) for v in binary_precision_at_fixed_recall(preds, target, min_recall=0.5)))
+        (1.0, 0.75)
+    """
     if validate_args:
         _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index, arg_name="min_recall")
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)

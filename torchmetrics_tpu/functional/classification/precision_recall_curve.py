@@ -228,7 +228,16 @@ def binary_precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array, Array]:
-    """PR curve for binary tasks (reference ``precision_recall_curve.py:278-...``)."""
+    """PR curve for binary tasks (reference ``precision_recall_curve.py:278-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.precision_recall_curve import binary_precision_recall_curve
+        >>> print(tuple(v.shape for v in binary_precision_recall_curve(preds, target, thresholds=5)))
+        ((6,), (6,), (5,))
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)

@@ -64,7 +64,16 @@ def multilabel_coverage_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Coverage error (reference ``ranking.py:58-108``)."""
+    """Coverage error (reference ``ranking.py:58-108``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [0, 1, 1]])
+        >>> from torchmetrics_tpu.functional.classification.ranking import multilabel_coverage_error
+        >>> print(round(float(multilabel_coverage_error(preds, target, num_labels=3)), 4))
+        1.6667
+    """
     if validate_args:
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold=0.0, ignore_index=ignore_index)
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)

@@ -103,7 +103,16 @@ def binary_recall_at_fixed_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array]:
-    r"""Highest recall given a minimum precision floor, binary task (reference ``:84-154``)."""
+    r"""Highest recall given a minimum precision floor, binary task (reference ``:84-154``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.recall_fixed_precision import binary_recall_at_fixed_precision
+        >>> print(tuple(round(float(v), 4) for v in binary_recall_at_fixed_precision(preds, target, min_precision=0.5)))
+        (1.0, 0.35)
+    """
     if validate_args:
         _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)

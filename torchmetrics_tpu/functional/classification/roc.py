@@ -90,7 +90,16 @@ def binary_roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array, Array]:
-    """ROC for binary tasks (reference ``roc.py:84-...``)."""
+    """ROC for binary tasks (reference ``roc.py:84-...``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.roc import binary_roc
+        >>> print(tuple(v.shape for v in binary_roc(preds, target, thresholds=5)))
+        ((5,), (5,), (5,))
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)

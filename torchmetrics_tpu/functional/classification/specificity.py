@@ -49,7 +49,16 @@ def binary_specificity(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Specificity for binary tasks (reference ``specificity.py``)."""
+    """Specificity for binary tasks (reference ``specificity.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.specificity import binary_specificity
+        >>> print(round(float(binary_specificity(preds, target)), 4))
+        0.6667
+    """
     tp, fp, tn, fn = _binary_stat_scores_pipeline(
         preds, target, threshold, multidim_average, ignore_index, validate_args
     )

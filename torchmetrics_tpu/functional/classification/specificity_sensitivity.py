@@ -87,7 +87,16 @@ def binary_specificity_at_sensitivity(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Tuple[Array, Array]:
-    r"""Highest specificity given a minimum sensitivity floor, binary task (reference ``:96-165``)."""
+    r"""Highest specificity given a minimum sensitivity floor, binary task (reference ``:96-165``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.specificity_sensitivity import binary_specificity_at_sensitivity
+        >>> print(tuple(round(float(v), 4) for v in binary_specificity_at_sensitivity(preds, target, min_sensitivity=0.5)))
+        (1.0, 0.75)
+    """
     if validate_args:
         _binary_specificity_at_sensitivity_arg_validation(min_sensitivity, thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)

@@ -144,7 +144,16 @@ def binary_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """tp/fp/tn/fn/support for binary tasks (reference ``stat_scores.py:138-210``)."""
+    """tp/fp/tn/fn/support for binary tasks (reference ``stat_scores.py:138-210``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.75, 0.05, 0.35, 0.75, 0.05, 0.65])
+        >>> target = jnp.asarray([1, 0, 1, 1, 0, 0])
+        >>> from torchmetrics_tpu.functional.classification.stat_scores import binary_stat_scores
+        >>> print([round(float(x), 4) for x in binary_stat_scores(preds, target)])
+        [2.0, 1.0, 2.0, 1.0, 3.0]
+    """
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
         _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)

@@ -75,7 +75,17 @@ def spectral_distortion_index(
     p: int = 1,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """D_lambda (reference ``d_lambda.py:103-147``)."""
+    """D_lambda (reference ``d_lambda.py:103-147``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.d_lambda import spectral_distortion_index
+        >>> print(round(float(spectral_distortion_index(preds, target)), 4))
+        0.0002
+    """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
     preds, target = _spectral_distortion_index_update(preds, target)

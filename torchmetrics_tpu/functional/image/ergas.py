@@ -49,6 +49,16 @@ def error_relative_global_dimensionless_synthesis(
     ratio: Union[int, float] = 4,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """ERGAS (reference ``ergas.py:95-133``)."""
+    """ERGAS (reference ``ergas.py:95-133``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
+        >>> print(round(float(error_relative_global_dimensionless_synthesis(preds, target)), 4))
+        63.5037
+    """
     preds, target = _ergas_update(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

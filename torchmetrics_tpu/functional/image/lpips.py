@@ -32,7 +32,17 @@ _SCALE = jnp.asarray([0.458, 0.448, 0.450])[None, :, None, None]
 
 
 def normalize_tensor(in_feat: Array, eps: float = 1e-10) -> Array:
-    """Unit-normalize along channels (reference ``lpips.py:187-190``)."""
+    """Unit-normalize along channels (reference ``lpips.py:187-190``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.lpips import normalize_tensor
+        >>> print(normalize_tensor(preds, target).shape)
+        (2, 3, 16, 16)
+    """
     norm_factor = jnp.sqrt(jnp.sum(in_feat**2, axis=1, keepdims=True))
     return in_feat / (norm_factor + eps)
 

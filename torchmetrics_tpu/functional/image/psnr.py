@@ -56,7 +56,17 @@ def peak_signal_noise_ratio(
     reduction: Optional[str] = "elementwise_mean",
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Array:
-    """PSNR (reference ``psnr.py:90-142``)."""
+    """PSNR (reference ``psnr.py:90-142``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+        >>> print(round(float(peak_signal_noise_ratio(preds, target)), 4))
+        19.7839
+    """
     if dim is None and reduction != "elementwise_mean":
         from torchmetrics_tpu.utilities.prints import rank_zero_warn
 

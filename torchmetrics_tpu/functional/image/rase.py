@@ -41,7 +41,17 @@ def _rase_compute(rmse_map: Array, target_sum: Array, total_images: Array, windo
 
 
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
-    """RASE (reference ``rase.py:75-107``)."""
+    """RASE (reference ``rase.py:75-107``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.rase import relative_average_spectral_error
+        >>> print(round(float(relative_average_spectral_error(preds, target)), 4))
+        1024.0444
+    """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
     rmse_map, target_sum, total_images = _rase_update(

@@ -56,7 +56,17 @@ def _rmse_sw_compute(
 def root_mean_squared_error_using_sliding_window(
     preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
 ) -> Union[Optional[Array], Tuple[Optional[Array], Array]]:
-    """Windowed RMSE (reference ``rmse_sw.py:96-131``)."""
+    """Windowed RMSE (reference ``rmse_sw.py:96-131``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.rmse_sw import root_mean_squared_error_using_sliding_window
+        >>> print(round(float(root_mean_squared_error_using_sliding_window(preds, target)), 4))
+        0.0763
+    """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
     rmse_val_sum, rmse_map, total_images = _rmse_sw_update(

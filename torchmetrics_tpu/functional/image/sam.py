@@ -39,6 +39,16 @@ def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elemen
 
 
 def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
-    """SAM (reference ``sam.py:80-118``)."""
+    """SAM (reference ``sam.py:80-118``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.sam import spectral_angle_mapper
+        >>> print(round(float(spectral_angle_mapper(preds, target)), 4))
+        0.0869
+    """
     preds, target = _sam_update(preds, target)
     return _sam_compute(preds, target, reduction)

@@ -85,6 +85,16 @@ def universal_image_quality_index(
     sigma: Sequence[float] = (1.5, 1.5),
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """UQI (reference ``uqi.py:122-161``)."""
+    """UQI (reference ``uqi.py:122-161``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.functional.image.uqi import universal_image_quality_index
+        >>> print(round(float(universal_image_quality_index(preds, target)), 4))
+        0.9589
+    """
     preds, target = _uqi_update(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction)

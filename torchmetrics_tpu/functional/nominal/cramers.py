@@ -68,6 +68,14 @@ def cramers_v(
 
     Category values may be arbitrary (floats, non-contiguous ints): they are densified
     before binning, unlike the reference which requires 0..k-1 codes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 0, 0])
+        >>> from torchmetrics_tpu.functional.nominal.cramers import cramers_v
+        >>> print(round(float(cramers_v(preds, target)), 4))
+        0.4677
     """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_dense_update(

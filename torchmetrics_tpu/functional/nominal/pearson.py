@@ -53,6 +53,14 @@ def pearsons_contingency_coefficient(
     r"""Pearson's contingency coefficient between two categorical series (reference ``pearson.py:73-127``).
 
     Category values may be arbitrary; they are densified before binning.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 0, 0])
+        >>> from torchmetrics_tpu.functional.nominal.pearson import pearsons_contingency_coefficient
+        >>> print(round(float(pearsons_contingency_coefficient(preds, target)), 4))
+        0.6631
     """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_dense_update(

@@ -66,6 +66,14 @@ def theils_u(
     r"""Theil's U: how much knowing ``target`` reduces uncertainty in ``preds``.
 
     Asymmetric: ``U(preds|target) != U(target|preds)`` (reference ``theils_u.py:106-147``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 0, 0])
+        >>> from torchmetrics_tpu.functional.nominal.theils_u import theils_u
+        >>> print(round(float(theils_u(preds, target)), 4))
+        0.4427
     """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_dense_update(

@@ -67,6 +67,14 @@ def tschuprows_t(
     r"""Tschuprow's T association between two categorical series (reference ``tschuprows.py:88-143``).
 
     Category values may be arbitrary; they are densified before binning.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 0, 0])
+        >>> from torchmetrics_tpu.functional.nominal.tschuprows import tschuprows_t
+        >>> print(round(float(tschuprows_t(preds, target)), 4))
+        0.4677
     """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _nominal_dense_update(

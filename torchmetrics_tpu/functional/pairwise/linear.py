@@ -26,6 +26,15 @@ def pairwise_linear_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    r"""Pairwise linear similarity between rows of ``x`` (and ``y``) (reference ``linear.py:41-84``)."""
+    r"""Pairwise linear similarity between rows of ``x`` (and ``y``) (reference ``linear.py:41-84``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        >>> target = jnp.asarray([[1.0, 2.5], [2.5, 4.0], [5.5, 6.5]])
+        >>> from torchmetrics_tpu.functional.pairwise.linear import pairwise_linear_similarity
+        >>> print(pairwise_linear_similarity(preds, target).shape)
+        (3, 3)
+    """
     distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

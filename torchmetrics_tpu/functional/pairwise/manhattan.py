@@ -27,6 +27,15 @@ def pairwise_manhattan_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    r"""Pairwise manhattan distances between rows of ``x`` (and ``y``) (reference ``manhattan.py:41-85``)."""
+    r"""Pairwise manhattan distances between rows of ``x`` (and ``y``) (reference ``manhattan.py:41-85``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        >>> target = jnp.asarray([[1.0, 2.5], [2.5, 4.0], [5.5, 6.5]])
+        >>> from torchmetrics_tpu.functional.pairwise.manhattan import pairwise_manhattan_distance
+        >>> print(pairwise_manhattan_distance(preds, target).shape)
+        (3, 3)
+    """
     distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

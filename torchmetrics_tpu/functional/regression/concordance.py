@@ -36,7 +36,16 @@ def _concordance_corrcoef_compute(
 
 
 def concordance_corrcoef(preds: Array, target: Array) -> Array:
-    """Concordance correlation (reference ``concordance.py:34-69``)."""
+    """Concordance correlation (reference ``concordance.py:34-69``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.concordance import concordance_corrcoef
+        >>> print(round(float(concordance_corrcoef(preds, target)), 4))
+        0.9777
+    """
     d = preds.shape[1] if preds.ndim == 2 else 1
     _temp = jnp.zeros(d, dtype=jnp.result_type(preds, jnp.float32)).squeeze()
     mean_x, mean_y, var_x = _temp, _temp, _temp

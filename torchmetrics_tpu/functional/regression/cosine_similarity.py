@@ -38,6 +38,15 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    """Cosine similarity (reference ``cosine_similarity.py:63-92``)."""
+    """Cosine similarity (reference ``cosine_similarity.py:63-92``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        >>> target = jnp.asarray([[1.0, 2.5], [2.5, 4.0], [5.5, 6.5]])
+        >>> from torchmetrics_tpu.functional.regression.cosine_similarity import cosine_similarity
+        >>> print(round(float(cosine_similarity(preds, target)), 4))
+        2.9929
+    """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

@@ -60,7 +60,16 @@ def _explained_variance_compute(
 
 
 def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
-    """Explained variance (reference ``explained_variance.py:100-137``)."""
+    """Explained variance (reference ``explained_variance.py:100-137``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.explained_variance import explained_variance
+        >>> print(round(float(explained_variance(preds, target)), 4))
+        0.9572
+    """
     if multioutput not in ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}")
     n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)

@@ -194,7 +194,16 @@ def kendall_rank_corrcoef(
     t_test: bool = False,
     alternative: Optional[str] = "two-sided",
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Kendall's tau (reference ``kendall.py:294-355``)."""
+    """Kendall's tau (reference ``kendall.py:294-355``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.kendall import kendall_rank_corrcoef
+        >>> print(round(float(kendall_rank_corrcoef(preds, target)), 4))
+        1.0
+    """
     if not isinstance(t_test, bool):
         raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {type(t_test)}.")
     if t_test and alternative is None:

@@ -37,7 +37,16 @@ def _log_cosh_error_compute(sum_log_cosh_error: Array, n_obs: Array) -> Array:
 
 
 def log_cosh_error(preds: Array, target: Array) -> Array:
-    """Log-cosh error (reference ``log_cosh.py:58-85``)."""
+    """Log-cosh error (reference ``log_cosh.py:58-85``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.log_cosh import log_cosh_error
+        >>> print(round(float(log_cosh_error(preds, target)), 4))
+        0.1685
+    """
     sum_log_cosh_error, n_obs = _log_cosh_error_update(
         preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[-1]
     )

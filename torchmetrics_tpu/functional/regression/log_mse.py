@@ -25,6 +25,15 @@ def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Union[i
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """MSLE (reference ``log_mse.py:56-79``)."""
+    """MSLE (reference ``log_mse.py:56-79``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.log_mse import mean_squared_log_error
+        >>> print(round(float(mean_squared_log_error(preds, target)), 4))
+        0.0286
+    """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

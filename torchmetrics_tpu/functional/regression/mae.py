@@ -27,6 +27,15 @@ def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Union[int, Array])
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """MAE (reference ``mae.py:53-72``)."""
+    """MAE (reference ``mae.py:53-72``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.mae import mean_absolute_error
+        >>> print(round(float(mean_absolute_error(preds, target)), 4))
+        0.5
+    """
     sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
     return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -29,6 +29,15 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: U
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """MAPE (reference ``mape.py:60-86``)."""
+    """MAPE (reference ``mape.py:60-86``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.mape import mean_absolute_percentage_error
+        >>> print(round(float(mean_absolute_percentage_error(preds, target)), 4))
+        0.3274
+    """
     sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -26,6 +26,15 @@ def _minkowski_distance_compute(distance: Array, p: float) -> Array:
 
 
 def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
-    """Minkowski distance (reference ``minkowski.py:55-80``)."""
+    """Minkowski distance (reference ``minkowski.py:55-80``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.minkowski import minkowski_distance
+        >>> print(round(float(minkowski_distance(preds, target, p=3.0)), 4))
+        1.0772
+    """
     minkowski_dist_sum = _minkowski_distance_update(preds, targets, p)
     return _minkowski_distance_compute(minkowski_dist_sum, p)

@@ -78,6 +78,15 @@ def r2_score(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
-    """R² (reference ``r2.py:113-160``)."""
+    """R² (reference ``r2.py:113-160``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.r2 import r2_score
+        >>> print(round(float(r2_score(preds, target)), 4))
+        0.9486
+    """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)

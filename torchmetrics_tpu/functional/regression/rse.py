@@ -28,6 +28,15 @@ def _relative_squared_error_compute(
 
 
 def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
-    """RSE (reference ``rse.py:48-77``)."""
+    """RSE (reference ``rse.py:48-77``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.rse import relative_squared_error
+        >>> print(round(float(relative_squared_error(preds, target)), 4))
+        0.0514
+    """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, n_obs, squared=squared)

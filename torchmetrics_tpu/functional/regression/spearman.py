@@ -59,7 +59,16 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman ρ (reference ``spearman.py:99-125``)."""
+    """Spearman ρ (reference ``spearman.py:99-125``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.spearman import spearman_corrcoef
+        >>> print(round(float(spearman_corrcoef(preds, target)), 4))
+        1.0
+    """
     preds, target = _spearman_corrcoef_update(
         preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[-1]
     )

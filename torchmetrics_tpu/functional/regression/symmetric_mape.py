@@ -31,6 +31,15 @@ def _symmetric_mean_absolute_percentage_error_compute(
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """SMAPE (reference ``symmetric_mape.py:61-85``)."""
+    """SMAPE (reference ``symmetric_mape.py:61-85``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.symmetric_mape import symmetric_mean_absolute_percentage_error
+        >>> print(round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4))
+        0.2455
+    """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -66,6 +66,15 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
-    """Tweedie deviance (reference ``tweedie_deviance.py:103-142``)."""
+    """Tweedie deviance (reference ``tweedie_deviance.py:103-142``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score
+        >>> print(round(float(tweedie_deviance_score(preds, target, power=1.5)), 4))
+        0.112
+    """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

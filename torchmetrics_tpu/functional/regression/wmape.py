@@ -28,6 +28,15 @@ def _weighted_mean_absolute_percentage_error_compute(
 
 
 def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """WMAPE (reference ``wmape.py:53-79``)."""
+    """WMAPE (reference ``wmape.py:53-79``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.functional.regression.wmape import weighted_mean_absolute_percentage_error
+        >>> print(round(float(weighted_mean_absolute_percentage_error(preds, target)), 4))
+        0.16
+    """
     sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
     return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

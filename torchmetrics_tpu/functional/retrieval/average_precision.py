@@ -18,6 +18,14 @@ def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int
     Branch-free form: with documents sorted by score, ``j = cumsum(rel)`` and the sum of
     ``rel * j / rank`` divided by the number of relevant retrieved docs equals the
     reference's loop over relevant positions (``average_precision.py:22-60``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+        >>> print(round(float(retrieval_average_precision(preds, target)), 4))
+        0.8333
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
 

@@ -13,7 +13,16 @@ Array = jax.Array
 
 
 def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """Fraction of the non-relevant documents retrieved in the top k (reference ``fall_out.py:22-60``)."""
+    """Fraction of the non-relevant documents retrieved in the top k (reference ``fall_out.py:22-60``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+        >>> print(round(float(retrieval_fall_out(preds, target)), 4))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
 
     top_k = preds.shape[-1] if top_k is None else top_k

@@ -13,7 +13,16 @@ Array = jax.Array
 
 
 def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """1.0 when any relevant document lands in the top k (reference ``hit_rate.py:22-57``)."""
+    """1.0 when any relevant document lands in the top k (reference ``hit_rate.py:22-57``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
+        >>> print(round(float(retrieval_hit_rate(preds, target)), 4))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
 
     if top_k is None:

@@ -19,7 +19,16 @@ def _dcg(target: Array) -> Array:
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """nDCG over a single query; graded (non-binary) relevance allowed (reference ``ndcg.py:27-74``)."""
+    """nDCG over a single query; graded (non-binary) relevance allowed (reference ``ndcg.py:27-74``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+        >>> print(round(float(retrieval_normalized_dcg(preds, target)), 4))
+        0.9197
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
 
     top_k = preds.shape[-1] if top_k is None else top_k

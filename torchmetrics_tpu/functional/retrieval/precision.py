@@ -15,7 +15,16 @@ Array = jax.Array
 def retrieval_precision(
     preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Array:
-    """Fraction of the top-k retrieved documents that are relevant (reference ``precision.py:22-63``)."""
+    """Fraction of the top-k retrieved documents that are relevant (reference ``precision.py:22-63``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.precision import retrieval_precision
+        >>> print(round(float(retrieval_precision(preds, target)), 4))
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
 
     if not isinstance(adaptive_k, bool):

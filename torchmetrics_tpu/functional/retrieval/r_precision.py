@@ -15,6 +15,14 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
 
     Branch-free: with docs sorted by score, the count of relevant docs in the first R
     slots is ``sum(rel * (rank <= R))`` — no dynamic slicing by a traced R.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
+        >>> print(round(float(retrieval_r_precision(preds, target)), 4))
+        0.5
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
 

@@ -13,7 +13,16 @@ Array = jax.Array
 
 
 def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """Fraction of the relevant documents retrieved in the top k (reference ``recall.py:22-58``)."""
+    """Fraction of the relevant documents retrieved in the top k (reference ``recall.py:22-58``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, True, False, True])
+        >>> from torchmetrics_tpu.functional.retrieval.recall import retrieval_recall
+        >>> print(round(float(retrieval_recall(preds, target)), 4))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
 
     if top_k is None:

@@ -109,7 +109,15 @@ def bleu_score(
     smooth: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> Array:
-    """BLEU (reference ``bleu.py:138-195``)."""
+    """BLEU (reference ``bleu.py:138-195``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.bleu import bleu_score
+        >>> print(round(float(bleu_score(preds, target)), 4))
+        0.4586
+    """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
     if len(preds_) != len(target_):

@@ -34,6 +34,14 @@ def _cer_compute(errors: Array, total: Array) -> Array:
 
 
 def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """CER (reference ``cer.py:64-87``)."""
+    """CER (reference ``cer.py:64-87``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.cer import char_error_rate
+        >>> print(round(float(char_error_rate(preds, target)), 4))
+        0.2432
+    """
     errors, total = _cer_update(preds, target)
     return _cer_compute(errors, total)

@@ -208,7 +208,15 @@ def chrf_score(
     whitespace: bool = False,
     return_sentence_level_score: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """chrF / chrF++ (reference ``chrf.py:524-612``)."""
+    """chrF / chrF++ (reference ``chrf.py:524-612``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.chrf import chrf_score
+        >>> print(round(float(chrf_score(preds, target)), 4))
+        0.5819
+    """
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
     if not isinstance(n_word_order, int) or n_word_order < 0:

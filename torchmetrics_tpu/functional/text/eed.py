@@ -160,7 +160,15 @@ def extended_edit_distance(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """EED (reference ``eed.py:255-313``)."""
+    """EED (reference ``eed.py:255-313``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.eed import extended_edit_distance
+        >>> print(round(float(extended_edit_distance(preds, target)), 4))
+        0.2456
+    """
     for param_name, param in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
         if not isinstance(param, float) or param < 0:
             raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")

@@ -34,6 +34,14 @@ def _mer_compute(errors: Array, total: Array) -> Array:
 
 
 def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """MER (reference ``mer.py:66-90``)."""
+    """MER (reference ``mer.py:66-90``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.mer import match_error_rate
+        >>> print(round(float(match_error_rate(preds, target)), 4))
+        0.2222
+    """
     errors, total = _mer_update(preds, target)
     return _mer_compute(errors, total)

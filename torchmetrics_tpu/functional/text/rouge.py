@@ -72,7 +72,21 @@ def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> np.n
 
 
 def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
-    """Length of the longest common subsequence."""
+    """Length of the longest common subsequence.
+
+    Rides the native C++ two-row DP when available (ROUGE-L only needs the
+    length; the pure-Python table was ~2/3 of rouge-score wall time): tokens map
+    to local int ids, the DP runs in ``native/match.cpp``.
+    """
+    if pred_tokens and target_tokens:
+        from torchmetrics_tpu.native.rle_mask import lcs_len
+
+        ids: dict = {}
+        a = np.fromiter((ids.setdefault(t, len(ids)) for t in pred_tokens), np.int64, len(pred_tokens))
+        b = np.fromiter((ids.setdefault(t, len(ids)) for t in target_tokens), np.int64, len(target_tokens))
+        native = lcs_len(a, b)
+        if native is not None:
+            return native
     return int(_lcs_table(pred_tokens, target_tokens)[-1, -1])
 
 

@@ -161,7 +161,15 @@ def sacre_bleu_score(
     lowercase: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> Array:
-    """SacreBLEU (reference ``sacre_bleu.py:276-342``)."""
+    """SacreBLEU (reference ``sacre_bleu.py:276-342``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+        >>> print(round(float(sacre_bleu_score(preds, target)), 4))
+        0.0
+    """
     if tokenize not in AVAILABLE_TOKENIZERS:
         raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
     if len(preds) != len(target):

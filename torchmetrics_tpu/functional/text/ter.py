@@ -373,7 +373,15 @@ def translation_edit_rate(
     asian_support: bool = False,
     return_sentence_level_score: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """TER (reference ``ter.py:521-586``)."""
+    """TER (reference ``ter.py:521-586``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.ter import translation_edit_rate
+        >>> print(round(float(translation_edit_rate(preds, target)), 4))
+        0.2222
+    """
     if not isinstance(normalize, bool):
         raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
     if not isinstance(no_punctuation, bool):

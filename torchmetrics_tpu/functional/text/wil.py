@@ -38,6 +38,14 @@ def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Arra
 
 
 def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """WIL (reference ``wil.py:74-98``)."""
+    """WIL (reference ``wil.py:74-98``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.wil import word_information_lost
+        >>> print(round(float(word_information_lost(preds, target)), 4))
+        0.3194
+    """
     errors, target_total, preds_total = _wil_update(preds, target)
     return _wil_compute(errors, target_total, preds_total)

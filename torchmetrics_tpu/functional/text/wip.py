@@ -38,6 +38,14 @@ def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Arra
 
 
 def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
-    """WIP (reference ``wip.py:74-97``)."""
+    """WIP (reference ``wip.py:74-97``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.functional.text.wip import word_information_preserved
+        >>> print(round(float(word_information_preserved(preds, target)), 4))
+        0.6806
+    """
     errors, target_total, preds_total = _wip_update(preds, target)
     return _wip_compute(errors, target_total, preds_total)

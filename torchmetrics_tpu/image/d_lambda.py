@@ -17,7 +17,19 @@ Array = jax.Array
 
 
 class SpectralDistortionIndex(Metric):
-    """D_lambda (reference ``d_lambda.py:26-123``)."""
+    """D_lambda (reference ``d_lambda.py:26-123``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.image.d_lambda import SpectralDistortionIndex
+        >>> metric = SpectralDistortionIndex()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.0002
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -14,7 +14,19 @@ Array = jax.Array
 
 
 class RelativeAverageSpectralError(Metric):
-    """RASE (reference ``rase.py:25-108``)."""
+    """RASE (reference ``rase.py:25-108``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.image.rase import RelativeAverageSpectralError
+        >>> metric = RelativeAverageSpectralError()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        1024.0444
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -14,7 +14,19 @@ Array = jax.Array
 
 
 class SpectralAngleMapper(Metric):
-    """SAM (reference ``sam.py:26-123``)."""
+    """SAM (reference ``sam.py:26-123``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.image.sam import SpectralAngleMapper
+        >>> metric = SpectralAngleMapper()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.0869
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -23,7 +23,19 @@ Array = jax.Array
 
 
 class StructuralSimilarityIndexMeasure(Metric):
-    """SSIM (reference ``ssim.py:33-219``)."""
+    """SSIM (reference ``ssim.py:33-219``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key = jax.random.PRNGKey(42)
+        >>> preds = jax.random.uniform(key, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + 0.1
+        >>> from torchmetrics_tpu.image.ssim import StructuralSimilarityIndexMeasure
+        >>> metric = StructuralSimilarityIndexMeasure()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.9591
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True

@@ -1,0 +1,51 @@
+"""Flax-variable <-> npz serialization for converted backbone weights.
+
+The model-backed metrics (FID/KID/IS, LPIPS, and the HF-backed text/multimodal
+stack) accept converted weights; this module defines the on-disk format the
+``scripts/convert_backbones.py`` recipe produces: one ``.npz`` whose keys are
+``/``-joined paths into the flax variables pytree (``params/Conv_0/kernel``),
+loadable without torch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for key, val in tree.items():
+        path = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            out.update(_flatten(val, path))
+        else:
+            out[path] = np.asarray(val)
+    return out
+
+
+def save_variables_npz(path: str, variables: Dict[str, Any]) -> int:
+    """Write a flax variables pytree to ``path``; returns total parameter count."""
+    flat = _flatten(variables)
+    np.savez(path, **flat)
+    return int(sum(v.size for v in flat.values()))
+
+
+def load_variables_npz(path: str) -> Dict[str, Any]:
+    """Load a converted-backbone npz back into the nested flax variables pytree."""
+    tree: Dict[str, Any] = {}
+    with np.load(path) as data:
+        for key in data.files:
+            node = tree
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = jnp.asarray(data[key])
+    return tree
+
+
+def count_params(variables: Dict[str, Any]) -> int:
+    """Total leaf-array element count — the cheap integrity check for a conversion."""
+    return int(sum(v.size for v in _flatten(variables).values()))

@@ -8,6 +8,8 @@ works even without a toolchain.
 """
 
 from torchmetrics_tpu.native.rle_mask import (
+    coco_eval_bbox,
+    coco_eval_bbox_available,
     coco_match,
     native_available,
     rle_area,
@@ -16,4 +18,13 @@ from torchmetrics_tpu.native.rle_mask import (
     rle_iou,
 )
 
-__all__ = ["coco_match", "native_available", "rle_area", "rle_decode", "rle_encode", "rle_iou"]
+__all__ = [
+    "coco_eval_bbox",
+    "coco_eval_bbox_available",
+    "coco_match",
+    "native_available",
+    "rle_area",
+    "rle_decode",
+    "rle_encode",
+    "rle_iou",
+]

@@ -79,6 +79,19 @@ def _compile_and_load() -> Optional[ctypes.CDLL]:
         f64p, ctypes.c_int64, f64p, ctypes.c_int64,
         u8p, u8p, u8p,
     ]
+    lib.lcs_len.restype = ctypes.c_int64
+    lib.lcs_len.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64]
+    lib.coco_eval_bbox.restype = None
+    lib.coco_eval_bbox.argtypes = [
+        f64p, f64p, i64p, i64p, ctypes.c_int64,
+        f64p, i64p, i64p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64,
+        f64p, ctypes.c_int64,
+        f64p, ctypes.c_int64,
+        f64p, ctypes.c_int64,
+        i64p, ctypes.c_int64,
+        f64p, f64p,
+    ]
     return lib
 
 
@@ -280,3 +293,96 @@ def coco_match(
         out_of_range = (det_areas < lo) | (det_areas > hi)
         det_ignore[ai] |= ~det_matches[ai] & out_of_range[None, :]
     return det_matches, det_ignore, gt_ignore_out
+
+
+def coco_eval_bbox_available() -> bool:
+    """Whether the epoch-level C++ bbox evaluator is usable."""
+    return _lib() is not None
+
+
+def coco_eval_bbox(
+    det_boxes: np.ndarray,
+    det_scores: np.ndarray,
+    det_img: np.ndarray,
+    det_cls: np.ndarray,
+    gt_boxes: np.ndarray,
+    gt_img: np.ndarray,
+    gt_cls: np.ndarray,
+    n_img: int,
+    n_cls: int,
+    iou_thrs: np.ndarray,
+    rec_thrs: np.ndarray,
+    area_ranges: np.ndarray,
+    max_dets: np.ndarray,
+):
+    """Epoch-level COCO bbox evaluation — the whole accumulate stage in one C++ call.
+
+    Args:
+        det_boxes/gt_boxes: ``(N, 4)`` xyxy epoch concatenations.
+        det_scores: ``(Nd,)``.
+        det_img/gt_img: ``(N,)`` image indices in ``[0, n_img)``.
+        det_cls/gt_cls: ``(N,)`` class INDICES in ``[0, n_cls)`` (pre-mapped).
+        iou_thrs/rec_thrs: threshold grids; area_ranges ``(A, 2)``;
+        max_dets: ascending max-detection thresholds (last = truncation cap).
+
+    Returns:
+        ``(precision, recall)`` with shapes ``(T, R, C, A, M)`` / ``(T, C, A, M)``,
+        cells untouched by data at ``-1`` — identical semantics to the Python
+        ``_calculate``/``_accumulate`` path in ``detection/mean_ap.py``.
+    """
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native coco_eval_bbox requires the compiled kernel")
+    det_boxes = np.ascontiguousarray(det_boxes.reshape(-1, 4), dtype=np.float64)
+    gt_boxes = np.ascontiguousarray(gt_boxes.reshape(-1, 4), dtype=np.float64)
+    det_scores = np.ascontiguousarray(det_scores, dtype=np.float64)
+    det_img = np.ascontiguousarray(det_img, dtype=np.int64)
+    det_cls = np.ascontiguousarray(det_cls, dtype=np.int64)
+    gt_img = np.ascontiguousarray(gt_img, dtype=np.int64)
+    gt_cls = np.ascontiguousarray(gt_cls, dtype=np.int64)
+    iou_thrs = np.ascontiguousarray(iou_thrs, dtype=np.float64)
+    rec_thrs = np.ascontiguousarray(rec_thrs, dtype=np.float64)
+    area_ranges = np.ascontiguousarray(area_ranges, dtype=np.float64)
+    max_dets = np.ascontiguousarray(max_dets, dtype=np.int64)
+
+    t, r, a, m = len(iou_thrs), len(rec_thrs), area_ranges.shape[0], len(max_dets)
+    precision = -np.ones((t, r, n_cls, a, m), dtype=np.float64)
+    recall = -np.ones((t, n_cls, a, m), dtype=np.float64)
+
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.coco_eval_bbox(
+        det_boxes.ctypes.data_as(f64p),
+        det_scores.ctypes.data_as(f64p),
+        det_img.ctypes.data_as(i64p),
+        det_cls.ctypes.data_as(i64p),
+        ctypes.c_int64(det_scores.shape[0]),
+        gt_boxes.ctypes.data_as(f64p),
+        gt_img.ctypes.data_as(i64p),
+        gt_cls.ctypes.data_as(i64p),
+        ctypes.c_int64(gt_img.shape[0]),
+        ctypes.c_int64(n_img), ctypes.c_int64(n_cls),
+        iou_thrs.ctypes.data_as(f64p), ctypes.c_int64(t),
+        rec_thrs.ctypes.data_as(f64p), ctypes.c_int64(r),
+        area_ranges.ctypes.data_as(f64p), ctypes.c_int64(a),
+        max_dets.ctypes.data_as(i64p), ctypes.c_int64(m),
+        precision.ctypes.data_as(f64p),
+        recall.ctypes.data_as(f64p),
+    )
+    return precision, recall
+
+
+def lcs_len(a_ids: np.ndarray, b_ids: np.ndarray) -> Optional[int]:
+    """LCS length over int64 token-id sequences, or None when the kernel is absent."""
+    lib = _lib()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a_ids, dtype=np.int64)
+    b = np.ascontiguousarray(b_ids, dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    return int(
+        lib.lcs_len(
+            a.ctypes.data_as(i64p), ctypes.c_int64(a.shape[0]),
+            b.ctypes.data_as(i64p), ctypes.c_int64(b.shape[0]),
+        )
+    )

@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class FleissKappa(Metric):
-    """Fleiss' kappa with a concatenated counts-matrix state (reference ``fleiss_kappa.py:27-120``)."""
+    """Fleiss' kappa with a concatenated counts-matrix state (reference ``fleiss_kappa.py:27-120``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> ratings = jnp.asarray([[2, 1, 0], [1, 1, 1], [0, 2, 1], [3, 0, 0]])
+        >>> from torchmetrics_tpu.nominal.fleiss_kappa import FleissKappa
+        >>> metric = FleissKappa(mode='counts')
+        >>> _ = metric.update(ratings)
+        >>> print(round(float(metric.compute()), 4))
+        0.0455
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

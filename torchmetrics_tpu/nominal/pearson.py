@@ -18,7 +18,18 @@ Array = jax.Array
 
 
 class PearsonsContingencyCoefficient(Metric):
-    """Pearson's contingency coefficient over a device table (reference ``pearson.py:28-136``)."""
+    """Pearson's contingency coefficient over a device table (reference ``pearson.py:28-136``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 0, 0])
+        >>> from torchmetrics_tpu.nominal.pearson import PearsonsContingencyCoefficient
+        >>> metric = PearsonsContingencyCoefficient(num_classes=3)
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.6631
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

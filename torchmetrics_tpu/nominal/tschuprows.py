@@ -15,7 +15,18 @@ Array = jax.Array
 
 
 class TschuprowsT(Metric):
-    """Tschuprow's T over a device table (reference ``tschuprows.py:26-133``)."""
+    """Tschuprow's T over a device table (reference ``tschuprows.py:26-133``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 0, 0])
+        >>> from torchmetrics_tpu.nominal.tschuprows import TschuprowsT
+        >>> metric = TschuprowsT(num_classes=3)
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.4677
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

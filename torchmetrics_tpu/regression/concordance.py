@@ -17,7 +17,18 @@ Array = jax.Array
 
 
 class ConcordanceCorrCoef(PearsonCorrCoef):
-    """CCC from the Pearson moment states (reference ``concordance.py:19-100``)."""
+    """CCC from the Pearson moment states (reference ``concordance.py:19-100``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.concordance import ConcordanceCorrCoef
+        >>> metric = ConcordanceCorrCoef()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.9777
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True

@@ -20,7 +20,18 @@ Array = jax.Array
 
 
 class CosineSimilarity(Metric):
-    """Row-wise cosine similarity (reference ``cosine_similarity.py:25-96``)."""
+    """Row-wise cosine similarity (reference ``cosine_similarity.py:25-96``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        >>> target = jnp.asarray([[1.0, 2.5], [2.5, 4.0], [5.5, 6.5]])
+        >>> from torchmetrics_tpu.regression.cosine_similarity import CosineSimilarity
+        >>> metric = CosineSimilarity()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        2.9929
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True

@@ -22,7 +22,18 @@ Array = jax.Array
 
 
 class KendallRankCorrCoef(Metric):
-    """Kendall's tau (reference ``kendall.py:36-171``)."""
+    """Kendall's tau (reference ``kendall.py:36-171``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.kendall import KendallRankCorrCoef
+        >>> metric = KendallRankCorrCoef()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        1.0
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

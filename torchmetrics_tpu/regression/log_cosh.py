@@ -14,7 +14,18 @@ Array = jax.Array
 
 
 class LogCoshError(Metric):
-    """Log-cosh error (reference ``log_cosh.py:25-109``)."""
+    """Log-cosh error (reference ``log_cosh.py:25-109``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.log_cosh import LogCoshError
+        >>> metric = LogCoshError()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.1685
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

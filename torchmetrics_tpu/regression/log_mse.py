@@ -17,7 +17,18 @@ Array = jax.Array
 
 
 class MeanSquaredLogError(Metric):
-    """MSLE (reference ``log_mse.py:26-95``)."""
+    """MSLE (reference ``log_mse.py:26-95``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.log_mse import MeanSquaredLogError
+        >>> metric = MeanSquaredLogError()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.0286
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -28,7 +28,18 @@ Array = jax.Array
 
 
 class MeanAbsolutePercentageError(Metric):
-    """MAPE (reference ``mape.py:26-102``)."""
+    """MAPE (reference ``mape.py:26-102``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.mape import MeanAbsolutePercentageError
+        >>> metric = MeanAbsolutePercentageError()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.3274
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -18,7 +18,18 @@ Array = jax.Array
 
 
 class MinkowskiDistance(Metric):
-    """Minkowski distance of order p (reference ``minkowski.py:25-102``)."""
+    """Minkowski distance of order p (reference ``minkowski.py:25-102``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.minkowski import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3.0)
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        1.0772
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

@@ -17,7 +17,18 @@ Array = jax.Array
 
 
 class RelativeSquaredError(R2Score):
-    """RSE (reference ``rse.py:24-105``)."""
+    """RSE (reference ``rse.py:24-105``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.rse import RelativeSquaredError
+        >>> metric = RelativeSquaredError()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.0514
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

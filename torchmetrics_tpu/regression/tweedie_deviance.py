@@ -17,7 +17,18 @@ Array = jax.Array
 
 
 class TweedieDevianceScore(Metric):
-    """Tweedie deviance (reference ``tweedie_deviance.py:25-115``)."""
+    """Tweedie deviance (reference ``tweedie_deviance.py:25-115``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> from torchmetrics_tpu.regression.tweedie_deviance import TweedieDevianceScore
+        >>> metric = TweedieDevianceScore(power=1.5)
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.112
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = False

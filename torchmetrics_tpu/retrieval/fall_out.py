@@ -13,7 +13,19 @@ Array = jax.Array
 
 
 class RetrievalFallOut(RetrievalMetric):
-    """Fall-out@k per query; queries with no *negative* target are the empty ones."""
+    """Fall-out@k per query; queries with no *negative* target are the empty ones.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> from torchmetrics_tpu.retrieval.fall_out import RetrievalFallOut
+        >>> metric = RetrievalFallOut()
+        >>> _ = metric.update(preds, target, indexes=indexes)
+        >>> print(round(float(metric.compute()), 4))
+        1.0
+    """
 
     higher_is_better: bool = False
     _empty_on_negatives: bool = True

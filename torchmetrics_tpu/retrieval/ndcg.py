@@ -13,7 +13,19 @@ Array = jax.Array
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """nDCG@k per query with graded relevance, batched over the dense rank matrix."""
+    """nDCG@k per query with graded relevance, batched over the dense rank matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> from torchmetrics_tpu.retrieval.ndcg import RetrievalNormalizedDCG
+        >>> metric = RetrievalNormalizedDCG()
+        >>> _ = metric.update(preds, target, indexes=indexes)
+        >>> print(round(float(metric.compute()), 4))
+        0.9599
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, **kwargs: Any) -> None:

@@ -13,7 +13,19 @@ Array = jax.Array
 
 
 class RetrievalPrecision(RetrievalMetric):
-    """Precision@k per query, averaged (reference semantics incl. ``adaptive_k``)."""
+    """Precision@k per query, averaged (reference semantics incl. ``adaptive_k``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> from torchmetrics_tpu.retrieval.precision import RetrievalPrecision
+        >>> metric = RetrievalPrecision()
+        >>> _ = metric.update(preds, target, indexes=indexes)
+        >>> print(round(float(metric.compute()), 4))
+        0.4167
+    """
 
     def __init__(
         self,

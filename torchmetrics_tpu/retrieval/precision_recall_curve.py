@@ -99,7 +99,19 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
-    """Max recall@k whose precision@k clears ``min_precision`` (reference ``:265-354``)."""
+    """Max recall@k whose precision@k clears ``min_precision`` (reference ``:265-354``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> from torchmetrics_tpu.retrieval.precision_recall_curve import RetrievalRecallAtFixedPrecision
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+        >>> _ = metric.update(preds, target, indexes=indexes)
+        >>> print(tuple(round(float(v), 4) for v in metric.compute()))
+        (1.0, 3.0)
+    """
 
     def __init__(
         self,

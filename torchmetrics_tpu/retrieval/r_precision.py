@@ -11,7 +11,19 @@ Array = jax.Array
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """Precision at the R-th rank, R = per-query relevant count (branch-free mask form)."""
+    """Precision at the R-th rank, R = per-query relevant count (branch-free mask form).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> from torchmetrics_tpu.retrieval.r_precision import RetrievalRPrecision
+        >>> metric = RetrievalRPrecision()
+        >>> _ = metric.update(preds, target, indexes=indexes)
+        >>> print(round(float(metric.compute()), 4))
+        0.75
+    """
 
     def _metric_dense(self, preds_mat: Array, target_mat: Array, valid: Array) -> Array:
         ranks = jnp.arange(1, target_mat.shape[-1] + 1)

@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class ExtendedEditDistance(Metric):
-    """EED with a per-sentence score list state (reference ``eed.py:26-123``)."""
+    """EED with a per-sentence score list state (reference ``eed.py:26-123``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.text.eed import ExtendedEditDistance
+        >>> metric = ExtendedEditDistance()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.2456
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = False

@@ -10,7 +10,17 @@ from torchmetrics_tpu.text.bleu import BLEUScore
 
 
 class SacreBLEUScore(BLEUScore):
-    """SacreBLEU — BLEU states + sacrebleu tokenizers (reference ``sacre_bleu.py:31-115``)."""
+    """SacreBLEU — BLEU states + sacrebleu tokenizers (reference ``sacre_bleu.py:31-115``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.text.sacre_bleu import SacreBLEUScore
+        >>> metric = SacreBLEUScore()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.0
+    """
 
     def __init__(
         self,

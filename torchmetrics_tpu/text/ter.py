@@ -15,7 +15,17 @@ Array = jax.Array
 
 
 class TranslationEditRate(Metric):
-    """TER (reference ``ter.py:27-127``)."""
+    """TER (reference ``ter.py:27-127``).
+
+    Example:
+        >>> preds = ['the cat sat on the mat', 'hello world']
+        >>> target = ['the cat sat on a mat', 'hello there world']
+        >>> from torchmetrics_tpu.text.ter import TranslationEditRate
+        >>> metric = TranslationEditRate()
+        >>> _ = metric.update(preds, target)
+        >>> print(round(float(metric.compute()), 4))
+        0.2222
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = False
