@@ -1,0 +1,210 @@
+"""Differential edge-case grids: the argument corners where implementations
+usually diverge, executed against the reference on identical inputs.
+
+The zoo sweep (test_zoo.py) pins default configurations; this module sweeps the
+edge arguments — ignore_index, top_k, samplewise multidim averaging, custom
+thresholds, weighted/none averages, pairwise reductions — one reference
+execution per cell.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.differential.harness import assert_tree_allclose, normalize, to_jax, to_torch
+
+
+def _mc_batches(seed, batch=32, c=5, n=4):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.standard_normal((batch, c)).astype(np.float32), rng.integers(0, c, batch))
+        for _ in range(n)
+    ]
+
+
+def _mc_multidim(seed, batch=8, c=4, extra=6, n=3):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.standard_normal((batch, c, extra)).astype(np.float32),
+            rng.integers(0, c, (batch, extra)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _run(reference_tm, path, kwargs, batches, atol=1e-5, rtol=1e-4):
+    import torchmetrics_tpu as ours_pkg
+
+    def resolve(root):
+        obj = root
+        for part in path.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    ref_m = resolve(reference_tm)(**kwargs)
+    our_m = resolve(ours_pkg)(**kwargs)
+    for batch in batches:
+        ref_m.update(*to_torch(batch))
+        our_m.update(*to_jax(batch))
+    assert_tree_allclose(
+        normalize(our_m.compute()), normalize(ref_m.compute()), atol, rtol, f"{path}{kwargs}"
+    )
+
+
+@pytest.mark.parametrize("ignore_index", [-1, 0, 2])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_mc_accuracy_ignore_index_grid(reference_tm, ignore_index, average):
+    rng = np.random.default_rng(99)
+    batches = []
+    for _ in range(3):
+        preds = rng.standard_normal((32, 5)).astype(np.float32)
+        target = rng.integers(0, 5, 32)
+        target[rng.random(32) < 0.25] = ignore_index
+        batches.append((preds, target))
+    _run(
+        reference_tm,
+        "classification.MulticlassAccuracy",
+        {"num_classes": 5, "average": average, "ignore_index": ignore_index},
+        batches,
+    )
+
+
+@pytest.mark.parametrize("top_k", [1, 2, 3])
+def test_mc_topk_grid(reference_tm, top_k):
+    for path in ("classification.MulticlassAccuracy", "classification.MulticlassPrecision"):
+        _run(
+            reference_tm,
+            path,
+            {"num_classes": 5, "average": "macro", "top_k": top_k},
+            _mc_batches(7 + top_k),
+        )
+
+
+@pytest.mark.parametrize(
+    "path,extra",
+    [
+        ("classification.MulticlassAccuracy", {}),
+        ("classification.MulticlassF1Score", {}),
+        ("classification.MulticlassStatScores", {}),
+        ("classification.MulticlassHammingDistance", {}),
+    ],
+    ids=["accuracy", "f1", "stat_scores", "hamming"],
+)
+def test_mc_samplewise_multidim(reference_tm, path, extra):
+    _run(
+        reference_tm,
+        path,
+        {"num_classes": 4, "multidim_average": "samplewise", "average": "macro", **extra},
+        _mc_multidim(11),
+        # samplewise returns per-sample vectors; merge concatenates across batches
+    )
+
+
+@pytest.mark.parametrize("threshold", [0.3, 0.5, 0.8])
+def test_binary_threshold_grid(reference_tm, threshold):
+    rng = np.random.default_rng(5)
+    batches = [(rng.random(64).astype(np.float32), rng.integers(0, 2, 64)) for _ in range(3)]
+    for path in ("classification.BinaryAccuracy", "classification.BinaryStatScores"):
+        _run(reference_tm, path, {"threshold": threshold}, batches)
+
+
+@pytest.mark.parametrize("ml_average", ["micro", "macro", "weighted", "none"])
+def test_multilabel_average_grid(reference_tm, ml_average):
+    rng = np.random.default_rng(13)
+    batches = [
+        (rng.random((24, 4)).astype(np.float32), rng.integers(0, 2, (24, 4))) for _ in range(3)
+    ]
+    _run(
+        reference_tm,
+        "classification.MultilabelFBetaScore",
+        {"beta": 2.0, "num_labels": 4, "average": ml_average},
+        batches,
+    )
+
+
+@pytest.mark.parametrize("thresholds", [None, 5, [0.1, 0.5, 0.9]])
+def test_binary_auroc_threshold_modes(reference_tm, thresholds):
+    rng = np.random.default_rng(17)
+    batches = [(rng.random(48).astype(np.float32), rng.integers(0, 2, 48)) for _ in range(3)]
+    _run(reference_tm, "classification.BinaryAUROC", {"thresholds": thresholds}, batches)
+
+
+@pytest.mark.parametrize(
+    "fn_name,kwargs",
+    [
+        ("pairwise_cosine_similarity", {}),
+        ("pairwise_euclidean_distance", {}),
+        ("pairwise_manhattan_distance", {}),
+        ("pairwise_minkowski_distance", {"exponent": 3}),
+        ("pairwise_linear_similarity", {}),
+        ("pairwise_cosine_similarity", {"reduction": "mean"}),
+        ("pairwise_euclidean_distance", {"reduction": "sum"}),
+    ],
+    ids=["cos", "euc", "man", "mink3", "lin", "cos_mean", "euc_sum"],
+)
+def test_pairwise_functional_differential(reference_tm, fn_name, kwargs):
+    import torch
+
+    import jax.numpy as jnp
+
+    import torchmetrics_tpu.functional as ours_fn
+
+    rng = np.random.default_rng(19)
+    x = rng.standard_normal((10, 6)).astype(np.float32)
+    y = rng.standard_normal((8, 6)).astype(np.float32)
+    ref = getattr(reference_tm.functional, fn_name)(torch.tensor(x), torch.tensor(y), **kwargs)
+    ours = getattr(ours_fn, fn_name)(jnp.asarray(x), jnp.asarray(y), **kwargs)
+    assert_tree_allclose(normalize(ours), normalize(ref), 1e-5, 1e-4, fn_name)
+
+
+@pytest.mark.parametrize("zero_division_seed", [23, 29])
+def test_absent_class_none_average(reference_tm, zero_division_seed):
+    """Classes absent from both preds and target: 'none' averages must agree on
+    the fill policy (the classic divergence spot)."""
+    rng = np.random.default_rng(zero_division_seed)
+    # class 4 never appears in target; class 3 never predicted
+    batches = []
+    for _ in range(3):
+        preds = rng.standard_normal((32, 5)).astype(np.float32)
+        preds[:, 3] = -100.0
+        target = rng.integers(0, 3, 32)
+        batches.append((preds, target))
+    for path in (
+        "classification.MulticlassPrecision",
+        "classification.MulticlassRecall",
+        "classification.MulticlassF1Score",
+    ):
+        _run(reference_tm, path, {"num_classes": 5, "average": "none"}, batches)
+
+
+def test_regression_multioutput_grid(reference_tm):
+    rng = np.random.default_rng(31)
+    batches = [
+        (
+            rng.standard_normal((24, 3)).astype(np.float32),
+            rng.standard_normal((24, 3)).astype(np.float32),
+        )
+        for _ in range(3)
+    ]
+    # (reference 1.0.0rc0's MeanSquaredError predates num_outputs — not comparable)
+    _run(reference_tm, "regression.ExplainedVariance", {"multioutput": "raw_values"}, batches)
+    _run(reference_tm, "regression.R2Score", {"num_outputs": 3, "multioutput": "raw_values"}, batches, atol=1e-4, rtol=1e-3)
+    _run(reference_tm, "regression.PearsonCorrCoef", {"num_outputs": 3}, batches, atol=1e-4, rtol=1e-3)
+
+
+def test_retrieval_empty_target_actions(reference_tm):
+    """Groups with no positives: every empty_target_action policy must agree."""
+    rng = np.random.default_rng(37)
+    idx = np.repeat(np.arange(4), 6)
+    tgt = rng.integers(0, 2, 24)
+    tgt[idx == 2] = 0  # group 2 has NO positives
+    preds = rng.random(24).astype(np.float32)
+    for action in ("neg", "pos", "skip"):
+        _run(
+            reference_tm,
+            "retrieval.RetrievalMAP",
+            {"empty_target_action": action},
+            [(preds, tgt, idx)],
+        )
