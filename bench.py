@@ -25,12 +25,23 @@ headline accuracy scenario; the other scenarios ride in ``extras`` of the same l
 
 Axon tunnel rule: ALL device timings complete (block_until_ready only) before anything
 is fetched or printed — a single D2H fetch drops the stream into ~100ms polling mode.
+
+Failure policy (the r05 lesson — one transient backend failure erased the whole
+round's perf evidence): backend acquisition runs with bounded retries + a probe
+timeout, every scenario is individually try/except'd into a status marker
+(``"ok"`` / ``"tpu_unavailable"`` / ``"error:..."``), the JSON always prints,
+and the exit code is ALWAYS 0. On a non-TPU backend the device scenarios
+downscale to bounded micro shapes instead of running TPU-sized scans on CPU for
+hours. ``--smoke`` runs only the bounded scenarios (CI gate: rc=0 + status
+markers present on a CPU-only machine).
 """
 
+import argparse
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -63,6 +74,46 @@ _SCENARIO_BYTES = {
     "perplexity_us": PPL_BATCH * PPL_SEQ * PPL_VOCAB * 4 + PPL_BATCH * PPL_SEQ * 4,
     "det_iou_us": 2 * DET_IMGS * DET_BOXES * 4 * 4 + DET_IMGS * DET_BOXES * DET_BOXES * 4,
 }
+
+
+def _acquire_backend(max_tries=3, backoff_s=2.0, probe_timeout_s=120.0):
+    """Bounded-retry backend acquisition that can neither raise nor hang.
+
+    ``jax.devices()`` under a wedged accelerator plugin has been observed to
+    block for minutes; the probe runs on a daemon thread with a timeout so a
+    hung init degrades to an explicit ``tpu_unavailable`` marker instead of
+    stalling the whole bench (the caller must then avoid ALL further jax work
+    and exit via ``os._exit`` so the stuck thread cannot block shutdown).
+    """
+    result = {}
+
+    def probe():
+        try:
+            import jax
+
+            devs = jax.devices()
+            result["devices"] = {
+                "platform": devs[0].platform,
+                "device_kind": getattr(devs[0], "device_kind", ""),
+                "n_devices": len(devs),
+            }
+        except Exception as err:  # noqa: BLE001 — init failure IS the signal here
+            result["error"] = f"{type(err).__name__}: {str(err)[:300]}"
+
+    last_error = None
+    for attempt in range(1, max_tries + 1):
+        result.clear()
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        th.join(probe_timeout_s)
+        if th.is_alive():
+            return {"status": "tpu_unavailable", "error": "backend init timed out", "attempts": attempt, "hung": True}
+        if "devices" in result:
+            return {"status": "ok", "attempts": attempt, **result["devices"]}
+        last_error = result.get("error")
+        if attempt < max_tries:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+    return {"status": "tpu_unavailable", "error": last_error, "attempts": max_tries}
 
 
 def _time_jitted(step, state, *args, int_probe=None):
@@ -258,6 +309,164 @@ def bench_ours():
     results["det_iou_us"] = _time_jitted(iou_step, jnp.asarray(0.0), dets, gts)
 
     return results
+
+
+def bench_engine(micro=False):
+    """Fused update engine counters + µs/step: the driver-verified evidence that
+    the dispatch-floor attack works (ISSUE 1 acceptance).
+
+    Three paths over the SAME stat-scores-family collection (macro accuracy +
+    macro precision sharing one compute group, micro accuracy, confusion
+    matrix — 3 group owners, 4 metrics):
+
+    - ``fused``: compute groups + one-dispatch collection step (engine/fusion.py)
+    - ``per_metric``: no groups, each metric its own compiled step (4 dispatches)
+    - ``eager``: the engine disabled — the reference-style Python hot path
+
+    Counters come straight from the engines' EngineStats, so "0 retraces after
+    warmup" and the dispatch reduction are recorded numbers. A ragged tail
+    probe records the shape-bucket budget.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassPrecision,
+    )
+    from torchmetrics_tpu.engine import engine_context
+
+    batch, classes = (256, 10) if micro else (8192, 100)
+    steps = 30 if micro else 200
+    warmup = 4
+
+    key = jax.random.PRNGKey(42)
+    preds = jax.random.normal(key, (batch, classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, classes, dtype=jnp.int32)
+
+    def build(compiled=None):
+        kw = dict(validate_args=False, compiled_update=compiled)
+        return {
+            "acc_macro": MulticlassAccuracy(classes, average="macro", **kw),
+            "prec_macro": MulticlassPrecision(classes, average="macro", **kw),
+            "acc_micro": MulticlassAccuracy(classes, average="micro", **kw),
+            "cm": MulticlassConfusionMatrix(classes, **kw),
+        }
+
+    def run_steps(mc, n):
+        for _ in range(n):
+            mc.update(preds, target)
+        # re-anchor group views before reading: a donated owner step leaves view
+        # members holding dead buffers until materialization (public accessors —
+        # items/values/compute — do this themselves)
+        mc._materialize_group_views()
+        jax.block_until_ready([getattr(m, s) for m in mc._modules.values() for s in m._defaults])
+
+    out = {"batch": batch, "classes": classes, "steps": steps}
+
+    with engine_context(True, donate=True):
+        # -- fused: compute groups + one dispatch per collection step ----------
+        fused_mc = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+        run_steps(fused_mc, warmup)
+        fst = fused_mc._fused_engine.stats
+        traces_at_warmup = fst.traces
+        d0 = fst.dispatches
+        t0 = time.perf_counter()
+        run_steps(fused_mc, steps)
+        fused_s = time.perf_counter() - t0
+        out["fused_us_per_step"] = round(fused_s / steps * 1e6, 2)
+        out["fused_dispatches_per_step"] = round((fst.dispatches - d0) / steps, 3)
+        out["fused_metrics_per_dispatch"] = round(fst.metrics_updated / max(fst.dispatches, 1), 2)
+        out["retraces_after_warmup"] = fst.traces - traces_at_warmup
+        out["fused_traces"] = fst.traces
+        out["fused_cache_hits"] = fst.cache_hits
+        out["donated_dispatches"] = fst.donated_dispatches
+        out["donation_copies"] = fst.donation_copies
+        out["eager_fallbacks"] = fst.eager_fallbacks
+        out["bytes_moved_per_step"] = round(fst.bytes_moved / max(fst.dispatches, 1))
+
+        # -- per-metric compiled: same metrics, no grouping, no fusion ---------
+        per_mc = MetricCollection(build(), compute_groups=False, fused_dispatch=False)
+        run_steps(per_mc, warmup)
+        engines = [m._engine for m in per_mc._modules.values() if m._engine is not None]
+        d0 = sum(e.stats.dispatches for e in engines)
+        t0 = time.perf_counter()
+        run_steps(per_mc, steps)
+        per_s = time.perf_counter() - t0
+        engines = [m._engine for m in per_mc._modules.values() if m._engine is not None]
+        out["per_metric_us_per_step"] = round(per_s / steps * 1e6, 2)
+        out["per_metric_dispatches_per_step"] = round(
+            (sum(e.stats.dispatches for e in engines) - d0) / steps, 3
+        )
+        out["dispatch_reduction"] = round(
+            out["per_metric_dispatches_per_step"] / max(out["fused_dispatches_per_step"], 1e-9), 2
+        )
+
+        # -- ragged tail: bucket budget over a stream of odd batch sizes -------
+        ragged_mc = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+        rng = np.random.RandomState(7)
+        sizes = [batch, batch - 3, batch // 2 - 1, batch // 4 + 1, batch, batch - 7, batch // 2]
+        for n in sizes:
+            p = jnp.asarray(rng.rand(n, classes).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, classes, n).astype(np.int32))
+            ragged_mc.update(p, t)
+        ragged_mc._materialize_group_views()
+        jax.block_until_ready(
+            [getattr(m, s) for m in ragged_mc._modules.values() for s in m._defaults]
+        )
+        rst = ragged_mc._fused_engine.stats
+        out["ragged_steps"] = len(sizes)
+        out["ragged_traces"] = rst.traces
+        out["ragged_bucket_count"] = len(rst.bucket_sizes)
+        out["ragged_pad_rows"] = rst.bucket_pad_rows
+
+    # -- eager baseline: engine off, reference-style per-op hot path -----------
+    eager_mc = MetricCollection(build(compiled=False), compute_groups=False, fused_dispatch=False)
+    run_steps(eager_mc, warmup)
+    t0 = time.perf_counter()
+    run_steps(eager_mc, steps)
+    out["eager_us_per_step"] = round((time.perf_counter() - t0) / steps * 1e6, 2)
+    out["fused_vs_eager_speedup"] = round(out["eager_us_per_step"] / max(out["fused_us_per_step"], 1e-9), 2)
+    return out
+
+
+def bench_micro_device(n_steps=200):
+    """Bounded stand-in for the device scenarios when no TPU is present: a tiny
+    jitted accuracy scan whose only job is to prove the measurement path runs
+    end-to-end on whatever backend exists (numbers are NOT comparable to the
+    TPU-scale scenarios and are labeled accordingly)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _multiclass_stat_scores_format_update,
+    )
+
+    b, c = 256, 50
+    key = jax.random.PRNGKey(0)
+    preds = jax.random.normal(key, (b, c), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.fold_in(key, 1), (b,), 0, c, dtype=jnp.int32)
+
+    @jax.jit
+    def many(state, preds, target):
+        def body(s, e):
+            tp, fp, tn, fn = _multiclass_stat_scores_format_update(
+                preds, target + e.astype(jnp.int32) * 0, c, 1, "macro", "global", None
+            )
+            return (s[0] + tp, s[1] + fp, s[2] + tn, s[3] + fn), None
+
+        return lax.scan(body, state, jnp.arange(n_steps))[0]
+
+    state = tuple(jnp.zeros(c, jnp.int32) for _ in range(4))
+    s = many(state, preds, target)
+    jax.block_until_ready(s)
+    t0 = time.perf_counter()
+    s = many(state, preds, target)
+    jax.block_until_ready(s)
+    return round((time.perf_counter() - t0) / n_steps * 1e6, 2)
 
 
 def bench_torch():
@@ -590,13 +799,19 @@ from torchmetrics_tpu.parallel import EvalMesh
 mesh = EvalMesh(n)
 
 # metric state coalesced into one flat per-chip vector -> a single collective per sync
-synced = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, mesh.axis), mesh=mesh.mesh,
-                               in_specs=P(mesh.axis), out_specs=P()))
+# jax >= 0.5 exports shard_map at the top level; 0.4.x keeps it experimental
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+synced = jax.jit(_shard_map(lambda x: jax.lax.psum(x, mesh.axis), mesh=mesh.mesh,
+                            in_specs=P(mesh.axis), out_specs=P()))
 # dispatch floor: the same sharded program WITHOUT the collective — on a single-host
 # virtual mesh every shard is dispatched serially on one core, so this floor is the
 # emulation's cost, not collective geometry
-noop = jax.jit(jax.shard_map(lambda x: x * 1.0000001, mesh=mesh.mesh,
-                             in_specs=P(mesh.axis), out_specs=P(mesh.axis)))
+noop = jax.jit(_shard_map(lambda x: x * 1.0000001, mesh=mesh.mesh,
+                          in_specs=P(mesh.axis), out_specs=P(mesh.axis)))
 # config #2's per-chip state: binned curve 200*10*2*2 + confusion matrix 10*10 = 8100
 flat = mesh.shard_batch(jnp.ones((n, 8100)))
 
@@ -666,21 +881,82 @@ def _hbm_peak_gbps():
     return None, kind
 
 
-def main():
-    ours = bench_ours()  # all device timings complete before any host work
-    peak_gbps, device_kind = _hbm_peak_gbps()
-    try:
-        baseline = bench_torch()
-    except Exception:
-        baseline = {}
-    sync_sweep = {}
-    for n in (8, 16, 32, 64, 128):
-        try:
-            sync_sweep[n] = bench_sync_latency(n)
-        except Exception as err:
-            print(f"sync probe failed for {n} devices: {err}", file=sys.stderr)
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="bounded scenarios only (engine counters + micro device probe); the CI gate",
+    )
+    args = parser.parse_args(argv)
 
-    extras = {"accuracy_fused_gate": ours.pop("accuracy_fused_gate", None)}
+    statuses = {}
+    extras = {}
+    ours = {}
+    baseline = {}
+    sync_sweep = {}
+    peak_gbps, device_kind = None, ""
+
+    backend = _acquire_backend(
+        max_tries=1 if args.smoke else 3,
+        probe_timeout_s=60.0 if args.smoke else 180.0,
+    )
+    backend_ok = backend["status"] == "ok"
+    # the axon tunnel's devices report platform "tpu" (r04 evidence) but match
+    # on device_kind too so a plugin spelling change cannot silently demote the
+    # real-TPU run to the micro fallback
+    on_tpu = backend_ok and (
+        backend.get("platform") in ("tpu", "axon")
+        or "tpu" in str(backend.get("device_kind", "")).lower()
+    )
+    if not on_tpu:
+        # explicit marker the driver greps for — present whether the backend is
+        # missing entirely or merely fell back to a host platform
+        statuses["tpu"] = "tpu_unavailable"
+
+    if backend_ok:
+        try:
+            extras["engine"] = bench_engine(micro=not on_tpu or args.smoke)
+            statuses["engine"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["engine"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        if on_tpu and not args.smoke:
+            try:
+                ours = bench_ours()  # all device timings complete before any host work
+                statuses["device_scenarios"] = "ok"
+            except Exception as err:  # noqa: BLE001
+                statuses["device_scenarios"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+            peak_gbps, device_kind = _hbm_peak_gbps()
+        else:
+            # no TPU: a bounded micro probe proves the measurement path instead
+            # of running TPU-sized scans on a host backend for hours
+            try:
+                extras["micro_accuracy_us"] = bench_micro_device()
+                statuses["device_scenarios"] = "tpu_unavailable_micro_fallback"
+            except Exception as err:  # noqa: BLE001
+                statuses["device_scenarios"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+            device_kind = backend.get("device_kind", backend.get("platform", ""))
+    else:
+        # a wedged plugin may have left a stuck init thread behind: do NO further
+        # jax work of any kind in this process
+        statuses["engine"] = "tpu_unavailable"
+        statuses["device_scenarios"] = "tpu_unavailable"
+
+    if not args.smoke:
+        try:
+            baseline = bench_torch()
+            statuses["torch_baseline"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["torch_baseline"] = f"error:{type(err).__name__}"
+        for n in (8, 16, 32, 64, 128):
+            try:
+                sync_sweep[n] = bench_sync_latency(n)
+            except Exception as err:  # noqa: BLE001
+                print(f"sync probe failed for {n} devices: {err}", file=sys.stderr)
+                statuses[f"sync_mesh{n}"] = "error"
+
+    extras["accuracy_fused_gate"] = ours.pop("accuracy_fused_gate", None)
     for key, stats in ours.items():
         ours_us = stats["med"]
         extras[key.replace("_us", "_us_ours")] = round(ours_us, 2)
@@ -703,44 +979,55 @@ def main():
         if key in baseline:
             extras[key.replace("_us", "_us_torch")] = round(baseline[key], 2)
             extras[key.replace("_us", "_speedup")] = round(baseline[key] / ours_us, 3)
-    try:
-        map_ms, map_val = bench_map_epoch_end()
-        extras["map300_compute_ms"] = round(map_ms, 1)
-        extras["map300_value"] = round(map_val, 4)
-    except Exception as err:
-        print(f"map epoch-end probe failed: {err}", file=sys.stderr)
-    try:
-        map5k_ms, map5k_update_ms, map5k_val = bench_map_coco_scale()
-        extras["map5000_compute_ms"] = round(map5k_ms, 1)
-        extras["map5000_update_ms"] = round(map5k_update_ms, 1)
-        extras["map5000_value"] = round(map5k_val, 4)
-    except Exception as err:
-        print(f"map coco-scale probe failed: {err}", file=sys.stderr)
-    try:
-        # same-epoch head-to-head at 1000 images: ours vs the executing reference
-        map1k_ms, map1k_update_ms, map1k_val = bench_map_coco_scale(n_images=1000)
-        extras["map1000_compute_ms"] = round(map1k_ms, 1)
-        extras["map1000_value"] = round(map1k_val, 4)
-        ref = bench_map_reference(n_images=1000)
-        if ref is not None:
-            ref_ms, ref_update_ms, ref_val = ref
-            extras["map1000_compute_ms_ref"] = round(ref_ms, 1)
-            extras["map1000_update_ms_ref"] = round(ref_update_ms, 1)
-            extras["map1000_value_ref"] = round(ref_val, 4)
-            extras["map1000_compute_speedup"] = round(ref_ms / map1k_ms, 2)
-            extras["map1000_value_agree"] = bool(abs(ref_val - map1k_val) < 5e-3)
-    except Exception as err:
-        print(f"map reference-baseline probe failed: {err}", file=sys.stderr)
-    try:
-        rouge_min, rouge_med, _ = bench_rouge()
-        extras["rouge200_ms"] = round(rouge_min, 1)
-        extras["rouge200_ms_median"] = round(rouge_med, 1)
-        ref_rouge = bench_rouge_reference()
-        if ref_rouge is not None:
-            extras["rouge200_ms_ref"] = round(ref_rouge[0], 1)
-            extras["rouge200_speedup"] = round(ref_rouge[0] / rouge_min, 2)
-    except Exception as err:
-        print(f"rouge probe failed: {err}", file=sys.stderr)
+    if backend_ok and not args.smoke:
+        try:
+            map_ms, map_val = bench_map_epoch_end()
+            extras["map300_compute_ms"] = round(map_ms, 1)
+            extras["map300_value"] = round(map_val, 4)
+        except Exception as err:  # noqa: BLE001
+            print(f"map epoch-end probe failed: {err}", file=sys.stderr)
+            statuses["map300"] = f"error:{type(err).__name__}"
+    if backend_ok and on_tpu and not args.smoke:
+        # the epoch-scale mAP head-to-heads are minutes of wall-clock; only the
+        # TPU configuration produces numbers the docs may quote
+        try:
+            map5k_ms, map5k_update_ms, map5k_val = bench_map_coco_scale()
+            extras["map5000_compute_ms"] = round(map5k_ms, 1)
+            extras["map5000_update_ms"] = round(map5k_update_ms, 1)
+            extras["map5000_value"] = round(map5k_val, 4)
+        except Exception as err:  # noqa: BLE001
+            print(f"map coco-scale probe failed: {err}", file=sys.stderr)
+            statuses["map5000"] = f"error:{type(err).__name__}"
+        try:
+            # same-epoch head-to-head at 1000 images: ours vs the executing reference
+            map1k_ms, map1k_update_ms, map1k_val = bench_map_coco_scale(n_images=1000)
+            extras["map1000_compute_ms"] = round(map1k_ms, 1)
+            extras["map1000_value"] = round(map1k_val, 4)
+            ref = bench_map_reference(n_images=1000)
+            if ref is not None:
+                ref_ms, ref_update_ms, ref_val = ref
+                extras["map1000_compute_ms_ref"] = round(ref_ms, 1)
+                extras["map1000_update_ms_ref"] = round(ref_update_ms, 1)
+                extras["map1000_value_ref"] = round(ref_val, 4)
+                extras["map1000_compute_speedup"] = round(ref_ms / map1k_ms, 2)
+                extras["map1000_value_agree"] = bool(abs(ref_val - map1k_val) < 5e-3)
+        except Exception as err:  # noqa: BLE001
+            print(f"map reference-baseline probe failed: {err}", file=sys.stderr)
+            statuses["map1000"] = f"error:{type(err).__name__}"
+    # gated on backend_ok: rouge imports torchmetrics_tpu → jax in-process, which
+    # must never run after a hung backend probe (stuck import lock / wedged plugin)
+    if backend_ok and not args.smoke:
+        try:
+            rouge_min, rouge_med, _ = bench_rouge()
+            extras["rouge200_ms"] = round(rouge_min, 1)
+            extras["rouge200_ms_median"] = round(rouge_med, 1)
+            ref_rouge = bench_rouge_reference()
+            if ref_rouge is not None:
+                extras["rouge200_ms_ref"] = round(ref_rouge[0], 1)
+                extras["rouge200_speedup"] = round(ref_rouge[0] / rouge_min, 2)
+        except Exception as err:  # noqa: BLE001
+            print(f"rouge probe failed: {err}", file=sys.stderr)
+            statuses["rouge"] = f"error:{type(err).__name__}"
 
     for n, (sync_us, noop_us, noise_us) in sync_sweep.items():
         extras[f"mesh{n}_sync_us"] = round(sync_us, 2)
@@ -756,26 +1043,48 @@ def main():
             # below the paired-diff noise band: quote as "<= noise", not a trend
             extras[f"mesh{n}_marginal_below_noise"] = True
 
-    acc_med = ours["accuracy_us"]["med"]
-    vs = baseline.get("accuracy_us", acc_med) / acc_med
+    acc = ours.get("accuracy_us")
+    acc_med = acc["med"] if acc else None
+    vs = round(baseline.get("accuracy_us", acc_med) / acc_med, 3) if acc_med else None
+    overall = "ok" if all(s == "ok" or s.startswith("tpu_unavailable") for s in statuses.values()) else "partial"
+    if statuses.get("tpu") == "tpu_unavailable":
+        overall = "tpu_unavailable" if overall == "ok" else overall
     print(
         json.dumps(
             {
                 "metric": "multiclass_accuracy_8192x1000_update_us_per_step",
-                "value": round(acc_med, 2),
+                "value": round(acc_med, 2) if acc_med else None,
                 "unit": "us/step",
                 # ratio vs the reference's update stage re-expressed in eager torch on
                 # CPU (the reference CI's own configuration; no CUDA device here) —
                 # NOT a same-silicon comparison
-                "vs_baseline": round(vs, 3),
+                "vs_baseline": vs,
                 "baseline": "torch-eager-cpu",
                 "device": device_kind,
                 "hbm_peak_gbps": peak_gbps,
+                # explicit degradation markers: one transient backend failure must
+                # never again erase a round's perf evidence (BENCH_r05 rc=1)
+                "status": overall,
+                "statuses": statuses,
+                "backend": backend,
                 "extras": extras,
             }
         )
     )
+    sys.stdout.flush()
+    if backend.get("hung"):
+        # a stuck backend-init thread must not block interpreter shutdown
+        os._exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as err:  # noqa: BLE001 — the bench NEVER exits nonzero
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({"status": "error", "error": f"{type(err).__name__}: {str(err)[:300]}"}))
+    sys.exit(0)

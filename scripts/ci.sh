@@ -19,6 +19,27 @@ python -m pytest "${TARGET[@]}" "${ARGS[@]}"
 status=$?
 
 echo
+echo "=== bench smoke (CPU) ==="
+# The r05 regression class: bench.py must degrade to partial JSON with explicit
+# status markers and rc=0 when no TPU exists — never die with a traceback.
+BENCH_OUT=$(mktemp)
+JAX_PLATFORMS=cpu python bench.py --smoke > "$BENCH_OUT"
+bench_rc=$?
+if [[ $bench_rc -ne 0 ]]; then
+  echo "bench smoke: FAILED (rc=$bench_rc — must be 0 even without a TPU)"
+  status=1
+elif ! grep -q '"status"' "$BENCH_OUT" || ! grep -q '"tpu_unavailable"' "$BENCH_OUT"; then
+  echo "bench smoke: FAILED (missing status markers in output)"
+  status=1
+elif ! grep -q '"retraces_after_warmup": 0' "$BENCH_OUT" || ! grep -q '"dispatch_reduction"' "$BENCH_OUT"; then
+  echo "bench smoke: FAILED (engine counters missing from output)"
+  status=1
+else
+  echo "bench smoke: ok (rc=0, status markers + engine counters present)"
+fi
+rm -f "$BENCH_OUT"
+
+echo
 echo "=== gate summary ==="
 if [[ $status -eq 0 ]]; then
   echo "RESULT: green (exit 0). Skips above are environment-gated (pesq/pystoi/"

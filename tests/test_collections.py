@@ -252,3 +252,52 @@ def test_add_metrics_after_group_formation_rechecks():
     np.testing.assert_allclose(
         np.asarray(mc.compute()["recall"]), np.asarray(solo.compute()), atol=1e-6
     )
+
+
+def test_fused_dispatch_group_parity_vs_per_metric_updates():
+    """With the fused engine on, one collection step dispatches every compute
+    group owner inside a single XLA executable; values must match per-metric
+    (unfused, ungrouped) eager updates exactly — including a ragged tail."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.engine import engine_context
+
+    kw = dict(validate_args=False)
+    rng = np.random.RandomState(21)
+    batches = [
+        (jnp.asarray(rng.rand(n, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, n)))
+        for n in (64, 64, 33, 64, 7)
+    ]
+    with engine_context(True, donate=True):
+        fused = MetricCollection(
+            {
+                "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro", **kw),
+                "prec_macro": MulticlassPrecision(NUM_CLASSES, average="macro", **kw),
+                "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro", **kw),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, **kw),
+            },
+            fused_dispatch=True,
+        )
+        for p, t in batches:
+            fused.update(p, t)
+        # the stat-scores family shares one group; its owner plus the other
+        # owners ran as ONE dispatch per post-discovery step
+        stats = fused._fused_engine.stats
+        assert stats.metrics_updated >= 3 * stats.dispatches
+        out = fused.compute()
+    per_metric = MetricCollection(
+        {
+            "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            "prec_macro": MulticlassPrecision(NUM_CLASSES, average="macro"),
+            "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "cm": MulticlassConfusionMatrix(NUM_CLASSES),
+        },
+        compute_groups=False,
+        fused_dispatch=False,
+    )
+    for p, t in batches:
+        per_metric.update(p, t)
+    expected = per_metric.compute()
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(out[k]), np.asarray(expected[k]), atol=1e-7, err_msg=k
+        )

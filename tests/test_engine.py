@@ -1,0 +1,528 @@
+"""Fused update engine tests (engine/): compiled-step cache, shape buckets,
+donation safety, fallbacks, and collection-level dispatch fusion."""
+
+import pickle
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassPrecision,
+)
+from torchmetrics_tpu.engine import engine_context, engine_report
+from torchmetrics_tpu.metric import Metric
+
+NUM_CLASSES = 5
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(n, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, n)))
+        for n in sizes
+    ]
+
+
+def _run(metric, batches):
+    for p, t in batches:
+        metric.update(p, t)
+    return np.asarray(metric.compute())
+
+
+# ---------------------------------------------------------------- retrace counts
+
+
+def test_fixed_shape_stream_compiles_once():
+    """Steady state on fixed shapes is one cached dispatch: after warmup (the
+    first step may shift the state dtype signature, e.g. int32 defaults
+    promoting under x64 — exactly as the eager path's states do), every
+    further step is a cache hit with ZERO retraces."""
+    batches = _batches([32] * 10)
+    with engine_context(True, donate=True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        for p, t in batches[:2]:  # warmup: signature stabilizes
+            m.update(p, t)
+        traces_after_warmup = m._engine.stats.traces
+        assert traces_after_warmup <= 2
+        for p, t in batches[2:]:
+            m.update(p, t)
+        out = np.asarray(m.compute())
+        st = m._engine.stats
+        assert st.traces == traces_after_warmup  # 0 retraces after warmup
+        assert st.cache_hits == 10 - traces_after_warmup
+        assert st.eager_fallbacks == 0
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    np.testing.assert_allclose(out, _run(ref, batches), atol=1e-7)
+
+
+def test_ragged_stream_stays_within_bucket_budget():
+    """Ragged batch sizes ride power-of-two buckets: compiled variants are
+    bounded by the bucket count, not by the number of distinct sizes."""
+    sizes = [1, 3, 5, 7, 8, 9, 11, 15, 17, 23, 31, 33, 40, 12, 2, 29]
+    batches = _batches(sizes, seed=1)
+    with engine_context(True, donate=True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        out = _run(m, batches)
+        st = m._engine.stats
+        # sizes spread over buckets {8, 16, 32, 64}: compiled variants bounded by
+        # buckets x (pre/post state-dtype warmup), never by the 16 distinct sizes
+        assert st.traces <= 8
+        assert len(st.bucket_sizes) <= 4
+        assert st.eager_fallbacks == 0
+        assert st.bucket_pad_rows == sum(
+            max(b - n, 0) for n, b in zip(sizes, (8, 8, 8, 8, 8, 16, 16, 16, 32, 32, 32, 64, 64, 16, 8, 32))
+        )
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    np.testing.assert_allclose(out, _run(ref, batches), atol=1e-7)
+
+
+def test_confusion_matrix_bucketed_parity():
+    batches = _batches([9, 17, 5, 32, 1], seed=2)
+    with engine_context(True, donate=True):
+        m = MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False)
+        out = _run(m, batches)
+        assert m._engine.stats.eager_fallbacks == 0
+    ref = MulticlassConfusionMatrix(NUM_CLASSES)
+    np.testing.assert_array_equal(out, _run(ref, batches))
+
+
+# ---------------------------------------------------------------- donation safety
+
+
+def test_donation_correct_after_reset():
+    """reset() restores the registered defaults; a donated first step after the
+    reset must copy (not consume) the shared default buffers."""
+    batches = _batches([32] * 3, seed=3)
+    with engine_context(True, donate=True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        _run(m, batches)
+        m.reset()
+        out_epoch2 = _run(m, batches)
+        # second epoch over the same data equals a fresh metric: defaults survived
+        assert m._engine.stats.donation_copies >= 4  # 4 state leaves shielded per epoch start
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    np.testing.assert_allclose(out_epoch2, _run(ref, batches), atol=1e-7)
+
+
+def test_donation_correct_after_clone():
+    """clone() drops the compiled cache; both halves keep independent, correct state."""
+    batches = _batches([32] * 4, seed=4)
+    with engine_context(True, donate=True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        for p, t in batches[:2]:
+            m.update(p, t)
+        twin = m.clone()
+        assert twin._engine is None  # executables never travel across clone
+        for p, t in batches[2:]:
+            m.update(p, t)
+        out_full, out_half = np.asarray(m.compute()), np.asarray(twin.compute())
+    ref_full = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    ref_half = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    np.testing.assert_allclose(out_full, _run(ref_full, batches), atol=1e-7)
+    np.testing.assert_allclose(out_half, _run(ref_half, batches[:2]), atol=1e-7)
+
+
+def test_compute_result_survives_next_update():
+    """A cached compute() result aliasing state must be shielded from donation."""
+    batches = _batches([16] * 3, seed=5)
+    with engine_context(True, donate=True):
+
+        class Holder(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.zeros(NUM_CLASSES), dist_reduce_fx="sum")
+
+            def update(self, p, t):
+                self.total = self.total + p.sum(0)
+
+            def compute(self):
+                return self.total  # returns the state array itself
+
+        m = Holder()
+        m.update(*batches[0])
+        held = m.compute()
+        first = np.asarray(held)
+        m.update(*batches[1])  # donates state; the held result must stay readable
+        np.testing.assert_allclose(np.asarray(held), first)
+
+
+def test_pickle_drops_engine():
+    with engine_context(True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        p, t = _batches([8], seed=6)[0]
+        m.update(p, t)
+        assert m._engine is not None
+        m2 = pickle.loads(pickle.dumps(m))
+        assert m2._engine is None
+        np.testing.assert_allclose(np.asarray(m2.compute()), np.asarray(m.compute()), atol=1e-7)
+
+
+# ---------------------------------------------------------------- fallbacks
+
+
+def test_value_dependent_validation_falls_back():
+    """validate_args=True runs np.unique on the inputs — untraceable, so the
+    engine demotes to eager, counts it, and the result stays correct."""
+    batches = _batches([16] * 3, seed=7)
+    with engine_context(True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro")  # validation on
+        out = _run(m, batches)
+        assert m._engine.stats.eager_fallbacks == 3
+        assert m._engine.stats.dispatches == 0
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    np.testing.assert_allclose(out, _run(ref, batches), atol=1e-7)
+
+
+def test_list_state_metric_falls_back():
+    with engine_context(True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", multidim_average="samplewise", validate_args=False)
+        p = jnp.asarray(np.random.RandomState(8).rand(4, NUM_CLASSES, 6))
+        t = jnp.asarray(np.random.RandomState(9).randint(0, NUM_CLASSES, (4, 6)))
+        m.update(p, t)
+        assert m._engine.stats.fallback_reasons.get("list-state") == 1
+
+
+def test_non_state_side_effect_aborts_compilation():
+    """An update that writes a non-state attribute has side effects a compiled
+    step would lose — it must run eagerly, not silently diverge."""
+    with engine_context(True):
+
+        class SideEffect(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+                self.last_batch = None
+
+            def update(self, x):
+                self.last_batch = x  # non-state write
+                self.total = self.total + x.sum()
+
+            def compute(self):
+                return self.total
+
+        m = SideEffect()
+        x = jnp.arange(4.0)
+        m.update(x)
+        m.update(x + 1)
+        assert m._engine.stats.eager_fallbacks == 2
+        assert m._engine.stats.dispatches == 0
+        assert m.last_batch is not None  # the eager side effect happened
+        np.testing.assert_allclose(float(m.compute()), float(x.sum() + (x + 1).sum()))
+
+
+def test_wrapper_metric_never_compiles_but_inner_does():
+    """A wrapper owning an inner Metric must run eagerly (tracing it would run
+    the inner metric's stateful host machinery once and leak tracers onto its
+    states); the inner metric's own engine still compiles the real work."""
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+
+    with engine_context(True, donate=True):
+        inner = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        wrapped = MinMaxMetric(inner)
+        batches = _batches([16] * 3, seed=20)
+        vals = [float(wrapped(p, t)["raw"]) for p, t in batches]
+        assert wrapped._engine is None or wrapped._engine.stats.dispatches == 0
+        assert inner._engine is not None and inner._engine.stats.dispatches > 0
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    expected = [float(ref(p, t)) for p, t in batches]
+    np.testing.assert_allclose(vals, expected, atol=1e-7)
+
+
+def test_nested_metric_guard():
+    """Registered-state wrappers around inner metrics are detected and demoted."""
+    from torchmetrics_tpu.engine.compiled import CompiledUpdate, holds_nested_metrics
+
+    class StatefulWrapper(Metric):
+        full_state_update = False
+
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+            self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, p, t):
+            self.inner.update(p, t)
+            self.count = self.count + 1.0
+
+        def compute(self):
+            return self.count
+
+    w = StatefulWrapper(MulticlassAccuracy(NUM_CLASSES, validate_args=False))
+    assert holds_nested_metrics(w)
+    assert CompiledUpdate(w)._disabled_reason == "nested-metric"
+
+
+def test_in_place_container_mutation_aborts_compilation():
+    """Appending to a non-state host list inside update is a side effect the
+    compiled path would drop — it must demote to eager AND the aborted trace's
+    append must be rolled back so the eager run doesn't double it."""
+    with engine_context(True):
+
+        class Logger(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+                self.batch_sizes = []
+
+            def update(self, x):
+                self.batch_sizes.append(int(x.shape[0]))  # in-place host mutation
+                self.total = self.total + x.sum()
+
+            def compute(self):
+                return self.total
+
+        m = Logger()
+        m.update(jnp.arange(4.0))
+        m.update(jnp.arange(4.0))
+        assert m._engine.stats.dispatches == 0
+        assert any("mutates non-state container" in r for r in m._engine.stats.fallback_reasons)
+        assert m.batch_sizes == [4, 4]  # exactly one append per eager update
+        np.testing.assert_allclose(float(m.compute()), 12.0)
+
+
+def test_same_length_dict_overwrite_aborts_compilation():
+    """A dict value overwrite keeps object identity AND length — the detector
+    must still catch it (element-identity comparison) and stay eager."""
+    with engine_context(True):
+
+        class DictMut(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+                self.info = {"last_n": None}
+
+            def update(self, x):
+                self.info["last_n"] = int(x.shape[0])
+                self.total = self.total + x.sum()
+
+            def compute(self):
+                return self.total
+
+        m = DictMut()
+        m.update(jnp.arange(4.0))
+        m.update(jnp.arange(3.0))
+        assert m._engine.stats.dispatches == 0
+        assert any("mutates non-state container" in r for r in m._engine.stats.fallback_reasons)
+        assert m.info["last_n"] == 3  # eager side effect ran once per step
+        np.testing.assert_allclose(float(m.compute()), 9.0)
+
+
+def test_compiled_update_kwarg_opt_out():
+    with engine_context(True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False, compiled_update=False)
+        p, t = _batches([8], seed=10)[0]
+        m.update(p, t)
+        assert m._engine is None
+
+
+def test_engine_report_aggregates():
+    with engine_context(True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        for p, t in _batches([16] * 4, seed=11):
+            m.update(p, t)
+        report = engine_report()
+        assert report["engines"] >= 1
+        assert report["traces"] >= 1
+        assert report["dispatches"] >= 4
+
+
+# ---------------------------------------------------------------- fused collections
+
+
+def test_fused_collection_single_dispatch_and_parity():
+    """A multi-group collection fuses every group owner's update into ONE
+    dispatch per step — and matches per-metric (unfused) updates exactly."""
+    kw = dict(validate_args=False)
+    batches = _batches([32] * 6, seed=12)
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            {
+                "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro", **kw),
+                "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro", **kw),
+                "prec_macro": MulticlassPrecision(NUM_CLASSES, average="macro", **kw),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, **kw),
+            }
+        )
+        for p, t in batches:
+            mc.update(p, t)
+        fused = mc._fused_engine.stats
+        # step 1 runs per-metric (group discovery); the 5 remaining steps fuse
+        # 3 group owners into one dispatch each: >= 3x dispatch reduction
+        assert fused.dispatches == 5
+        assert fused.metrics_updated == 15
+        assert fused.eager_fallbacks == 0
+        out = mc.compute()
+    ref = MetricCollection(
+        {
+            "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "prec_macro": MulticlassPrecision(NUM_CLASSES, average="macro"),
+            "cm": MulticlassConfusionMatrix(NUM_CLASSES),
+        },
+        fused_dispatch=False,
+        compute_groups=False,
+    )
+    for p, t in batches:
+        ref.update(p, t)
+    expected = ref.compute()
+    for k in expected:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(expected[k]), atol=1e-7, err_msg=k)
+
+
+def test_fused_collection_ragged_bucket_budget():
+    kw = dict(validate_args=False)
+    sizes = [32, 17, 9, 32, 5, 31, 12]
+    batches = _batches(sizes, seed=13)
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            {
+                "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro", **kw),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, **kw),
+                "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro", **kw),
+            }
+        )
+        for p, t in batches:
+            mc.update(p, t)
+        fused = mc._fused_engine.stats
+        assert fused.traces <= 3  # buckets {8, 16, 32}
+        out = mc.compute()
+    ref = MetricCollection(
+        {
+            "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            "cm": MulticlassConfusionMatrix(NUM_CLASSES),
+            "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro"),
+        },
+        fused_dispatch=False,
+    )
+    for p, t in batches:
+        ref.update(p, t)
+    expected = ref.compute()
+    for k in expected:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(expected[k]), atol=1e-7, err_msg=k)
+
+
+def test_fused_collection_survives_bad_member():
+    """One untraceable member (validate_args=True: host np.unique) is excluded
+    by the per-member trace probe; the rest still fuse into one dispatch."""
+    batches = _batches([32] * 4, seed=22)
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+                "prec_validating": MulticlassPrecision(NUM_CLASSES, average="micro"),  # validation on
+            }
+        )
+        for p, t in batches:
+            mc.update(p, t)
+        fst = mc._fused_engine.stats
+        assert fst.dispatches == 3  # steps 2-4 fused (step 1 = group discovery)
+        assert fst.metrics_updated == 6  # acc + cm fused; prec excluded each step
+        assert any(k.startswith("member:prec_validating:") for k in fst.fallback_reasons)
+        out = mc.compute()
+    ref = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            "cm": MulticlassConfusionMatrix(NUM_CLASSES),
+            "prec_validating": MulticlassPrecision(NUM_CLASSES, average="micro"),
+        },
+        fused_dispatch=False,
+    )
+    for p, t in batches:
+        ref.update(p, t)
+    expected = ref.compute()
+    for k in expected:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(expected[k]), atol=1e-7, err_msg=k)
+
+
+def test_fused_collection_honors_per_metric_opt_out():
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+                "opted_out": MulticlassAccuracy(
+                    NUM_CLASSES, average="micro", validate_args=False, compiled_update=False
+                ),
+            }
+        )
+        for p, t in _batches([16] * 3, seed=23):
+            mc.update(p, t)
+        assert mc._modules["opted_out"]._engine is None  # never compiled anywhere
+        fst = mc._fused_engine.stats
+        assert fst.metrics_updated == 2 * fst.dispatches  # only acc + cm fused
+
+
+def test_retained_member_handle_stays_valid_after_donated_steps():
+    """A group-member handle retained across donated collection steps must keep
+    reading live state (the collection re-anchors views every update)."""
+    batches = _batches([16] * 3, seed=24)
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            [
+                MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+                MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+            ]
+        )
+        handle = None
+        for p, t in batches:
+            mc.update(p, t)
+            if handle is None:
+                handle = mc["MulticlassPrecision"]  # view member, retained once
+        # reads the view's state arrays directly — they must be alive and current
+        val = float(handle.compute())
+    ref = MulticlassPrecision(NUM_CLASSES, average="macro")
+    np.testing.assert_allclose(val, float(_run(ref, batches)), atol=1e-7)
+
+
+def test_fused_collection_reset_epochs():
+    """Donated fused steps across reset() keep epochs independent and correct."""
+    kw = dict(validate_args=False)
+    batches = _batches([16] * 3, seed=14)
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", **kw),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, **kw),
+            }
+        )
+        for p, t in batches:
+            mc.update(p, t)
+        first = {k: np.asarray(v) for k, v in mc.compute().items()}
+        mc.reset()
+        for p, t in batches:
+            mc.update(p, t)
+        second = mc.compute()
+    for k in first:
+        np.testing.assert_allclose(np.asarray(second[k]), first[k], atol=1e-7, err_msg=k)
+
+
+def test_fused_collection_clone_is_independent():
+    kw = dict(validate_args=False)
+    batches = _batches([16] * 2, seed=15)
+    with engine_context(True, donate=True):
+        mc = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", **kw),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, **kw),
+            }
+        )
+        mc.update(*batches[0])
+        mc.update(*batches[0])
+        twin = mc.clone()
+        assert twin._fused_engine is None
+        twin.update(*batches[1])
+        out_orig, out_twin = mc.compute(), twin.compute()
+    assert not np.allclose(np.asarray(out_orig["cm"]), np.asarray(out_twin["cm"]))

@@ -503,8 +503,11 @@ def test_ragged_none_list_state_sync_raises(monkeypatch):
             return self.packs
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # probe layout: (world, n_list_attrs, [count, shape_fingerprint])
     monkeypatch.setattr(
-        multihost_utils, "process_allgather", lambda x, tiled=False: np.asarray([[2], [3]])
+        multihost_utils,
+        "process_allgather",
+        lambda x, tiled=False: np.asarray([[[2, 7]], [[3, 7]]]),
     )
     m = PackedDummy(dist_sync_fn=lambda x, group=None: [x, x], distributed_available_fn=lambda: True)
     m.update(jnp.ones((2, 3)))
@@ -512,9 +515,27 @@ def test_ragged_none_list_state_sync_raises(monkeypatch):
     with pytest.raises(TorchMetricsUserError, match="deadlock"):
         m._sync_dist(dist_sync_fn=m.dist_sync_fn)
 
-    # equal nonzero lengths: sync proceeds, each element gathered positionally
+    # EQUAL counts but mismatched per-element shapes (e.g. differing final
+    # packed-batch sizes per rank): the positional collectives would be
+    # shape-ragged — the same probe must fail loud on the fingerprint column
     monkeypatch.setattr(
-        multihost_utils, "process_allgather", lambda x, tiled=False: np.asarray([[2], [2]])
+        multihost_utils,
+        "process_allgather",
+        lambda x, tiled=False: np.asarray([[[2, 7]], [[2, 8]]]),
+    )
+    m_shape = PackedDummy(dist_sync_fn=lambda x, group=None: [x, x], distributed_available_fn=lambda: True)
+    m_shape.update(jnp.ones((2, 3)))
+    m_shape.update(jnp.ones((2, 3)))
+    with pytest.raises(TorchMetricsUserError, match="mismatched per-element shapes"):
+        m_shape._sync_dist(dist_sync_fn=m_shape.dist_sync_fn)
+
+    # equal lengths AND shapes: sync proceeds, each element gathered positionally.
+    # The mock echoes the real local probe so the recorded fingerprint matches
+    # what the implementation computes for two (2, 3) elements.
+    monkeypatch.setattr(
+        multihost_utils,
+        "process_allgather",
+        lambda x, tiled=False: np.stack([np.asarray(x), np.asarray(x)]),
     )
     m2 = PackedDummy(dist_sync_fn=lambda x, group=None: [x, x], distributed_available_fn=lambda: True)
     m2.update(jnp.ones((2, 3)))

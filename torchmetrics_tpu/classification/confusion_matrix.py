@@ -41,6 +41,10 @@ class BinaryConfusionMatrix(Metric):
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
 
+    # engine shape-bucketing opt-in: zero pad rows bincount into fixed cells
+    # whose contribution the compiled step subtracts (engine/bucketing.py)
+    _engine_row_additive = True
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -83,6 +87,10 @@ class MulticlassConfusionMatrix(Metric):
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
 
+    # engine shape-bucketing opt-in: zero pad rows bincount into fixed cells
+    # whose contribution the compiled step subtracts (engine/bucketing.py)
+    _engine_row_additive = True
+
     def __init__(
         self,
         num_classes: int,
@@ -124,6 +132,10 @@ class MultilabelConfusionMatrix(Metric):
     is_differentiable = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # engine shape-bucketing opt-in: zero pad rows bincount into fixed cells
+    # whose contribution the compiled step subtracts (engine/bucketing.py)
+    _engine_row_additive = True
 
     def __init__(
         self,

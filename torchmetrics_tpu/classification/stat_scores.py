@@ -45,6 +45,12 @@ class _AbstractStatScores(Metric):
     tn: Any
     fn: Any
 
+    # engine shape-bucketing opt-in: the "global" update is additive over batch
+    # rows onto sum-reduced states, so padded rows subtract cleanly (the engine
+    # additionally requires every state to be sum-reduced, which excludes the
+    # samplewise cat-list layout automatically)
+    _engine_row_additive = True
+
     def _create_state(self, size: int, multidim_average: str = "global") -> None:
         """Register the 4 counter states; tensors+sum for global, lists+cat for samplewise."""
         if multidim_average == "samplewise":

@@ -1,14 +1,25 @@
-"""MetricCollection with compute groups.
+"""MetricCollection — canonical-state compute groups and fused dispatch.
 
-Capability parity: reference ``src/torchmetrics/collections.py`` (618 LoC):
-``update:182``, ``_merge_compute_groups:209``, ``_equal_metric_states:244``,
-``_compute_groups_create_state_ref:269``, ``_compute_and_reduce:292``,
-``add_metrics:356``, group-aware ``keys/items/values:467-494``.
+Capability parity with the reference's ``MetricCollection`` (dict-of-metrics with
+one call pattern, automatic compute groups, prefix/postfix renaming, group-aware
+views), architected TPU-first instead of porting the reference's
+attribute-aliasing design:
 
-TPU-first twist: states are immutable ``jax.Array``s, so "sharing by reference" is a
-cheap copy of array references from the group leader into members — no aliasing
-hazards, and ``copy_state`` semantics (reference breaks aliasing via deepcopy) are
-automatic because members can never mutate the leader's arrays.
+- **Canonical state + views.** Each :class:`_ComputeGroup` designates one member
+  as the canonical owner of the group's state; the remaining members are VIEWS
+  that receive the owner's array references only when someone looks at them
+  (``items``/``values``/``compute``). States are immutable ``jax.Array``s, so a
+  view can never corrupt the canonical copy and "breaking aliasing" (the
+  reference's deepcopy dance) reduces to shallow-copying list states on demand.
+- **Fused dispatch.** With the fused update engine enabled (``engine/``), one
+  collection step compiles every group owner's update body into a SINGLE XLA
+  executable with donated state buffers (``engine/fusion.py``) — an N-metric
+  step costs one dispatch instead of N, which is the difference that matters at
+  pod scale where the dispatch floor dominates the collective cost.
+- **Structure-first group discovery.** Groups merge by comparing a cheap
+  structural fingerprint (state names/kinds/shapes/dtypes) before any device
+  values are touched; only fingerprint-equal candidates pay the value
+  comparison. Single pass, no deepcopy, no fixed-point rescan.
 """
 
 from __future__ import annotations
@@ -22,12 +33,93 @@ from torchmetrics_tpu.utilities.data import allclose
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 
-class MetricCollection:
-    """Dict of metrics sharing one call pattern, with automatic compute groups (reference ``collections.py:34``).
+class _ComputeGroup:
+    """A set of metric names whose states are provably identical.
 
-    Metrics with identical states (e.g. accuracy/precision/recall over the same
-    stat-scores) form a compute group: only the group leader runs ``update``; members
-    receive the leader's state (array references) lazily.
+    The FIRST name is the canonical owner: it is the only member whose
+    ``update`` runs, and its state arrays are the group's single source of
+    truth. Everyone else is a view to be materialized from the owner.
+    """
+
+    __slots__ = ("names",)
+
+    def __init__(self, names: Sequence[str]) -> None:
+        self.names: List[str] = list(names)
+
+    @property
+    def owner(self) -> str:
+        return self.names[0]
+
+    def absorb(self, other: "_ComputeGroup") -> None:
+        self.names.extend(other.names)
+
+    def materialize_views(self, modules: Dict[str, Metric], copy: bool = False) -> None:
+        """Push the owner's state references into every view member.
+
+        Arrays are immutable so reference sharing is always safe; ``copy`` only
+        matters for list states, which are shallow-copied so a view appending
+        host-side cannot grow the canonical list.
+        """
+        owner = modules[self.owner]
+        for name in self.names[1:]:
+            view = modules[name]
+            for state in owner._defaults:
+                value = getattr(owner, state)
+                setattr(view, state, list(value) if copy and isinstance(value, list) else value)
+            view._update_count = owner._update_count
+            view._computed = None
+            # fold markers travel with the states they describe, else a view
+            # holding the owner's stacked None-reduced state would re-wrap it
+            view._none_folded = set(owner._none_folded)
+
+
+def _state_fingerprint(metric: Metric) -> Optional[tuple]:
+    """Structural digest of a metric's registered states; None if stateless.
+
+    Two metrics can only share a group when their fingerprints match, and
+    comparing fingerprints costs no device traffic — value equality (the only
+    part that reads arrays) runs strictly within a fingerprint bucket.
+    """
+    if not metric._defaults:
+        return None
+    sig = []
+    for key in sorted(metric._defaults):
+        val = getattr(metric, key)
+        if isinstance(val, list):
+            sig.append((key, "list", tuple((tuple(v.shape), str(v.dtype)) for v in val)))
+        else:
+            sig.append((key, "array", tuple(val.shape), str(val.dtype)))
+    return tuple(sig)
+
+
+def _states_equal(metric1: Metric, metric2: Metric) -> bool:
+    """Value equality of two structurally identical metrics' states."""
+    for key in metric1._defaults:
+        state1 = getattr(metric1, key)
+        state2 = getattr(metric2, key)
+        if isinstance(state1, list):
+            if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                return False
+        elif not allclose(state1, state2):
+            return False
+    return True
+
+
+class MetricCollection:
+    """Dict of metrics sharing one call pattern, with automatic compute groups.
+
+    Metrics whose states are provably identical (e.g. accuracy/precision/recall
+    over the same stat-scores) form a compute group: only the canonical owner
+    runs ``update``; the other members are views onto its state.
+
+    Args:
+        metrics: a Metric/MetricCollection, a sequence of them, or a name->metric dict.
+        prefix: string prepended to every result key.
+        postfix: string appended to every result key.
+        compute_groups: True (discover automatically), False (off), or an
+            explicit list of name groups.
+        fused_dispatch: None (follow the engine policy — on for accelerator
+            backends), or force the one-dispatch fused collection step on/off.
 
     Example:
         >>> import jax.numpy as jnp
@@ -41,7 +133,7 @@ class MetricCollection:
         {'MulticlassAccuracy': 0.125, 'MulticlassPrecision': 0.0667}
     """
 
-    _groups: Dict[int, List[str]]
+    _groups: Dict[int, _ComputeGroup]
 
     def __init__(
         self,
@@ -50,13 +142,18 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        fused_dispatch: Optional[bool] = None,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
+        if fused_dispatch is not None and not isinstance(fused_dispatch, bool):
+            raise ValueError(f"Expected `fused_dispatch` to be a bool or None but got {fused_dispatch}")
+        self.fused_dispatch = fused_dispatch
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
+        self._fused_engine = None  # engine/fusion.py executable cache; built lazily
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -66,147 +163,146 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Per-metric ``forward`` (batch values); kwargs filtered per signature (reference ``:153-160``).
-    """
+        """Per-metric ``forward`` (batch values); kwargs filtered per signature."""
         return self._compute_and_reduce("forward", *args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update each compute group's leader only (reference ``collections.py:182-207``)."""
+        """One collection step: each group owner accumulates the batch once.
+
+        With the fused engine engaged, every owner's update lowers into a single
+        shared XLA dispatch; owners the engine cannot compile update eagerly.
+        The FIRST step runs every metric individually — group discovery needs
+        each metric's own post-update state to prove value equality.
+        """
         if self._groups_checked:
-            for cg in self._groups.values():
-                m0 = self._modules[cg[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
-            if self._state_is_copy:
-                self._compute_groups_create_state_ref()
+            owners = [(group.owner, self._modules[group.owner]) for group in self._groups.values()]
+            handled = self._fused_step(owners, args, kwargs)
+            for name, metric in owners:
+                if name not in handled:
+                    metric.update(*args, **metric._filter_kwargs(**kwargs))
+            donated = bool(handled) or any(
+                m._engine is not None and m._engine.stats.donated_dispatches for _, m in owners
+            )
+            if donated:
+                # re-anchor views NOW, not lazily at the next accessor: a donated
+                # owner step leaves view members holding DEAD buffers — a metric
+                # handle the user retained from an earlier __getitem__ must keep
+                # reading valid (fresh) state, exactly as it did pre-donation
                 self._state_is_copy = False
+                self._materialize_group_views()
+            elif self._state_is_copy:
+                # eager/undonated path keeps the lazy accessor-time propagation
+                self._materialize_group_views()
         else:
-            for m in self.values(copy_state=False):
-                m.update(*args, **m._filter_kwargs(**kwargs))
+            # group discovery needs each metric's own post-update state; run the
+            # pass eagerly — compiling a per-metric executable for members that
+            # become views (or fused-handled owners) one step later is pure waste
+            discovering = bool(self._enable_compute_groups)
+            for metric in self.values(copy_state=False):
+                if discovering:
+                    prior_override = metric.compiled_update
+                    metric.compiled_update = False
+                try:
+                    metric.update(*args, **metric._filter_kwargs(**kwargs))
+                finally:
+                    if discovering:
+                        metric.compiled_update = prior_override
             if self._enable_compute_groups:
-                self._merge_compute_groups()
-                self._compute_groups_create_state_ref()
+                self._discover_groups()
+                self._materialize_group_views()
                 self._groups_checked = True
 
-    def _merge_compute_groups(self) -> None:
-        """One-pass signature-bucketed group merge (behavior parity with reference
-        ``collections.py:209-242``, algorithm owned here).
+    def _fused_step(self, owners: List[Tuple[str, Metric]], args: tuple, kwargs: dict) -> set:
+        """Try the one-dispatch fused collection step; returns handled names."""
+        enabled = self.fused_dispatch
+        if enabled is None:
+            from torchmetrics_tpu.engine.config import engine_enabled
 
-        Each group is fingerprinted by its leader's state STRUCTURE
-        (``_state_signature``: sorted state names, container kinds, shapes, dtypes) —
-        pure metadata, no device work. Only groups with identical fingerprints can
-        possibly share state, so value comparison (``_states_allclose``, the only part
-        that touches arrays) runs within a bucket: each group folds into the first
-        bucket representative whose state values match, else becomes a new
-        representative. Single pass, no deepcopy, no fixed-point rescan — the
-        signature bucketing makes transitive merging fall out of representative
-        chaining instead of repeated O(n²) sweeps.
+            enabled = engine_enabled()
+        if not enabled or len(owners) < 2:
+            return set()
+        if self._fused_engine is None or [n for n, _ in self._fused_engine.metrics] != [n for n, _ in owners]:
+            from torchmetrics_tpu.engine.fusion import FusedUpdate
+
+            self._fused_engine = FusedUpdate(owners)
+        return self._fused_engine.step(args, kwargs) or set()
+
+    # ------------------------------------------------------------------ group discovery
+
+    def _discover_groups(self) -> None:
+        """Merge groups whose members' states are identical, one pass.
+
+        Candidates bucket by structural fingerprint (pure metadata); within a
+        bucket each group folds into the first representative whose state
+        VALUES match, else becomes a new representative. Transitive merging
+        falls out of representative chaining — no O(n²) rescans.
         """
-        merged: List[List[str]] = []
-        buckets: Dict[tuple, List[List[str]]] = {}
-        for members in self._groups.values():
-            leader = self._modules[members[0]]
-            sig = self._state_signature(leader)
-            if sig is None:  # stateless metrics never share a group
-                merged.append(members)
+        merged: List[_ComputeGroup] = []
+        buckets: Dict[tuple, List[_ComputeGroup]] = {}
+        for group in self._groups.values():
+            owner = self._modules[group.owner]
+            fingerprint = _state_fingerprint(owner)
+            if fingerprint is None:  # stateless metrics never share a group
+                merged.append(group)
                 continue
-            for rep_members in buckets.setdefault(sig, []):
-                if self._states_allclose(self._modules[rep_members[0]], leader):
-                    rep_members.extend(members)
+            for representative in buckets.setdefault(fingerprint, []):
+                if _states_equal(self._modules[representative.owner], owner):
+                    representative.absorb(group)
                     break
             else:
-                buckets[sig].append(members)
-                merged.append(members)
+                buckets[fingerprint].append(group)
+                merged.append(group)
         self._groups = dict(enumerate(merged))
+        self._fused_engine = None  # owner set changed; rebuild on next step
 
-    @staticmethod
-    def _state_signature(metric: Metric) -> Optional[tuple]:
-        """Structural fingerprint of a metric's registered states, or None if stateless.
-
-        Two metrics can only share a compute group when their fingerprints are equal;
-        comparing fingerprints costs no device traffic.
-        """
-        if not metric._defaults:
-            return None
-        sig = []
-        for key in sorted(metric._defaults):
-            val = getattr(metric, key)
-            if isinstance(val, list):
-                sig.append((key, "list", tuple((tuple(v.shape), str(v.dtype)) for v in val)))
-            else:
-                sig.append((key, "array", tuple(val.shape), str(val.dtype)))
-        return tuple(sig)
-
-    @staticmethod
-    def _states_allclose(metric1: Metric, metric2: Metric) -> bool:
-        """Value equality of two structurally identical metrics' states."""
-        for key in metric1._defaults:
-            state1 = getattr(metric1, key)
-            state2 = getattr(metric2, key)
-            if isinstance(state1, list):
-                if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
-                    return False
-            elif not allclose(state1, state2):
-                return False
-        return True
-
-    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
-        """Propagate leader state (array refs) to group members (reference ``collections.py:269-286``).
-
-        Arrays are immutable so ``copy`` only matters for list states (shallow-copied).
-        """
+    def _materialize_group_views(self, copy: bool = False) -> None:
+        """Push canonical (owner) state into every group's view members."""
         if not self._state_is_copy:
-            for cg in self._groups.values():
-                m0 = self._modules[cg[0]]
-                for i in range(1, len(cg)):
-                    mi = self._modules[cg[i]]
-                    for state in m0._defaults:
-                        m0_state = getattr(m0, state)
-                        setattr(mi, state, list(m0_state) if copy and isinstance(m0_state, list) else m0_state)
-                    mi._update_count = m0._update_count
-                    mi._computed = None
-                    # fold markers travel with the states they describe, else a member
-                    # holding the leader's stacked None-reduced state would re-wrap it
-                    mi._none_folded = set(m0._none_folded)
+            for group in self._groups.values():
+                group.materialize_views(self._modules, copy=copy)
         self._state_is_copy = copy
+
+    # retained name for callers/tests written against the reference-era API
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        self._materialize_group_views(copy)
 
     # ------------------------------------------------------------------ compute
 
     def compute(self) -> Dict[str, Any]:
-        """Per-metric compute into one flat dict (reference ``collections.py:288-291``)."""
+        """Per-metric compute into one flat (renamed) dict."""
         return self._compute_and_reduce("compute")
 
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Reference ``collections.py:292-326``."""
+        if method_name not in ("compute", "forward"):
+            raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
         result = {}
-        for k, m in self.items(keep_base=True, copy_state=False):
+        for name, metric in self.items(keep_base=True, copy_state=False):
             if method_name == "compute":
-                res = m.compute()
-            elif method_name == "forward":
-                res = m(*args, **m._filter_kwargs(**kwargs))
+                res = metric.compute()
             else:
-                raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
+                res = metric(*args, **metric._filter_kwargs(**kwargs))
             if isinstance(res, dict):
-                for key, v in res.items():
-                    if getattr(m, "prefix", None) is not None:
-                        key = f"{m.prefix}{key}"
-                    if getattr(m, "postfix", None) is not None:
-                        key = f"{key}{m.postfix}"
-                    result[key] = v
+                for key, value in res.items():
+                    if getattr(metric, "prefix", None) is not None:
+                        key = f"{metric.prefix}{key}"
+                    if getattr(metric, "postfix", None) is not None:
+                        key = f"{key}{metric.postfix}"
+                    result[key] = value
             else:
-                result[k] = res
+                result[name] = res
         return {self._set_name(k): v for k, v in result.items()}
 
     # ------------------------------------------------------------------ lifecycle
 
     def reset(self) -> None:
-        """Reset every metric (reference ``collections.py:328-334``)."""
-        for m in self.values(copy_state=False):
-            m.reset()
+        """Reset every metric; group views re-anchor to the (reset) owners."""
+        for metric in self.values(copy_state=False):
+            metric.reset()
         if self._enable_compute_groups and self._groups_checked:
-            self._compute_groups_create_state_ref()
+            self._materialize_group_views()
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
-        """Deep copy, optionally re-prefixed (reference ``collections.py:336-349``)."""
+        """Deep copy, optionally re-prefixed."""
         mc = deepcopy(self)
         if prefix:
             mc.prefix = self._check_arg(prefix, "prefix")
@@ -214,29 +310,35 @@ class MetricCollection:
             mc.postfix = self._check_arg(postfix, "postfix")
         return mc
 
+    def __getstate__(self) -> Dict[str, Any]:
+        """Compiled fused executables are per-process — never pickled/copied."""
+        state = self.__dict__.copy()
+        state["_fused_engine"] = None
+        return state
+
     def persistent(self, mode: bool = True) -> None:
-        """Toggle state persistence for all metrics (reference ``collections.py:351-354``)."""
-        for m in self.values(copy_state=False):
-            m.persistent(mode)
+        """Toggle state persistence for all metrics."""
+        for metric in self.values(copy_state=False):
+            metric.persistent(mode)
 
     def state_dict(self) -> Dict[str, Any]:
         """Flat state dict keyed by metric name."""
         destination: Dict[str, Any] = {}
-        for k, m in self.items(keep_base=True, copy_state=False):
-            m.state_dict(destination, prefix=f"{k}.")
+        for name, metric in self.items(keep_base=True, copy_state=False):
+            metric.state_dict(destination, prefix=f"{name}.")
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         """Restore from ``state_dict``."""
-        for k, m in self.items(keep_base=True, copy_state=False):
-            m.load_state_dict(state_dict, prefix=f"{k}.")
+        for name, metric in self.items(keep_base=True, copy_state=False):
+            metric.load_state_dict(state_dict, prefix=f"{name}.")
 
     # ------------------------------------------------------------------ membership
 
     def add_metrics(
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
     ) -> None:
-        """Register metrics from dict/sequence/instance (reference ``collections.py:356-420``)."""
+        """Register metrics from dict/sequence/instance."""
         if isinstance(metrics, Metric):
             metrics = [metrics]
         if isinstance(metrics, Sequence):
@@ -293,31 +395,32 @@ class MetricCollection:
             )
 
         self._groups_checked = False
+        self._fused_engine = None
         if self._enable_compute_groups:
             self._init_compute_groups()
         else:
             self._groups = {}
 
     def _init_compute_groups(self) -> None:
-        """User-specified or singleton groups (reference ``collections.py:422-441``)."""
+        """Seed groups: user-specified lists, or one singleton per metric."""
         if isinstance(self._enable_compute_groups, list):
-            self._groups = dict(enumerate(self._enable_compute_groups))
-            for v in self._groups.values():
-                for metric in v:
+            for names in self._enable_compute_groups:
+                for metric in names:
                     if metric not in self._modules:
                         raise ValueError(
                             f"Input {metric} in `compute_groups` argument does not match a metric in the"
                             f" collection. Please make sure that {self._enable_compute_groups} matches"
                             f" {list(self._modules.keys())}"
                         )
+            self._groups = {i: _ComputeGroup(names) for i, names in enumerate(self._enable_compute_groups)}
             self._groups_checked = True
         else:
-            self._groups = {i: [str(k)] for i, k in enumerate(self._modules.keys())}
+            self._groups = {i: _ComputeGroup([str(k)]) for i, k in enumerate(self._modules.keys())}
 
     @property
     def compute_groups(self) -> Dict[int, List[str]]:
-        """Current compute groups (reference ``collections.py:443-446``)."""
-        return self._groups
+        """Current compute groups as ``{index: [member names]}``."""
+        return {i: list(group.names) for i, group in self._groups.items()}
 
     # ------------------------------------------------------------------ dict protocol
 
@@ -341,26 +444,26 @@ class MetricCollection:
         return key in self._modules
 
     def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
-        """Metric names (reference ``collections.py:467-475``)."""
+        """Metric names (renamed unless ``keep_base``)."""
         if keep_base:
             return self._modules.keys()
         return self._to_renamed_ordered_dict().keys()
 
     def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
-        """(name, metric) pairs; propagates group state first (reference ``collections.py:477-488``)."""
-        self._compute_groups_create_state_ref(copy_state)
+        """(name, metric) pairs; materializes group views first."""
+        self._materialize_group_views(copy_state)
         if keep_base:
             return self._modules.items()
         return self._to_renamed_ordered_dict().items()
 
     def values(self, copy_state: bool = True) -> Iterable[Metric]:
-        """Metrics; propagates group state first (reference ``collections.py:490-498``)."""
-        self._compute_groups_create_state_ref(copy_state)
+        """Metrics; materializes group views first."""
+        self._materialize_group_views(copy_state)
         return self._modules.values()
 
     def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
-        """Metric by (renamed) key (reference ``collections.py:500-514``)."""
-        self._compute_groups_create_state_ref(copy_state)
+        """Metric by (renamed) key."""
+        self._materialize_group_views(copy_state)
         if self.prefix or self.postfix:
             key = key.removeprefix(self.prefix or "").removesuffix(self.postfix or "")
         return self._modules[key]
@@ -382,19 +485,19 @@ class MetricCollection:
         return repr_str + "\n)"
 
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
-        """Cast all metric states (reference ``collections.py`` dtype transfer)."""
-        for m in self.values(copy_state=False):
-            m.set_dtype(dst_type)
+        """Cast all metric states."""
+        for metric in self.values(copy_state=False):
+            metric.set_dtype(dst_type)
         return self
 
     def to(self, device: Any) -> "MetricCollection":
         """Move all metric states to ``device``."""
-        for m in self.values(copy_state=False):
-            m.to(device)
+        for metric in self.values(copy_state=False):
+            metric.to(device)
         return self
 
     def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None, together: bool = False) -> Any:
-        """Plot all metrics (reference ``collections.py`` plot)."""
+        """Plot all metrics, together or one figure each."""
         import matplotlib.pyplot as plt
 
         if val is None:

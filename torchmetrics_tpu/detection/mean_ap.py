@@ -68,9 +68,16 @@ def _validate_packed_batch(pp: np.ndarray, pc: np.ndarray, tt: np.ndarray, tc: n
 
 
 def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
-    """Host-side pairwise IoU used inside the ragged evaluation loops."""
+    """Host-side pairwise IoU used inside the ragged evaluation loops.
+
+    Boxes ingest as float64 to match the C++ evaluator (``coco_eval_bbox``
+    takes f64 boxes), so a threshold-straddling IoU cannot flip between the
+    native path and this fallback on float32 rounding alone.
+    """
     if det.size == 0 or gt.size == 0:
         return np.zeros((det.shape[0], gt.shape[0]))
+    det = det.astype(np.float64, copy=False)
+    gt = gt.astype(np.float64, copy=False)
     area1 = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
     area2 = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
     lt = np.maximum(det[:, None, :2], gt[None, :, :2])
@@ -146,6 +153,9 @@ def _area(values, iou_type: str) -> np.ndarray:
     if values.size == 0:
         return np.zeros((values.shape[0],))
     if iou_type == "bbox":
+        # f64 ingestion mirrors the C++ evaluator's area computation, keeping the
+        # area-range ignore decisions identical between the two paths
+        values = values.astype(np.float64, copy=False)
         return (values[:, 2] - values[:, 0]) * (values[:, 3] - values[:, 1])
     return values.reshape(values.shape[0], -1).sum(axis=1)
 
@@ -389,8 +399,11 @@ class MeanAveragePrecision(Metric):
         with vectorized numpy flattening (packed states extract by mask, no
         per-image slicing) and a single ``coco_eval_bbox`` call that does
         bucketing, per-image score sort, IoU, greedy matching, and PR-curve
-        accumulation natively. Results are bit-identical to the Python fallback
-        (pinned by ``tests/detection/test_native_eval_parity.py``).
+        accumulation natively. The Python fallback ingests boxes as float64
+        exactly like this path does (``_np_box_iou``/``_area``), so the two
+        agree bit-for-bit on f32-representable inputs (pinned by
+        ``tests/detection/test_native_eval_parity.py``); score TIE ordering at
+        identical float scores remains sort-implementation-defined in both.
         """
         from torchmetrics_tpu.native import coco_eval_bbox
 

@@ -1,0 +1,98 @@
+"""Shape buckets for ragged final batches.
+
+An eval epoch whose last batch is short (or a stream of odd sizes) would force
+one XLA trace per distinct batch size. Instead, inputs pad up to the next
+power-of-two bucket, so the number of compiled variants is bounded by
+``O(log2(max_batch))`` regardless of how ragged the stream is.
+
+Correctness comes from a pad-subtract identity rather than per-metric masking
+hooks: for a metric whose every state is SUM-reduced and whose ``update`` is
+additive over batch rows (``new = old + Σ_r g(row_r)``), a pad row contributes a
+fixed, state-independent delta ``g(pad_row)``. The compiled step therefore
+computes, inside the SAME graph,
+
+    out      = update(state, padded_inputs)            # includes pad garbage
+    pad_unit = update(zeros_like(state), one_pad_row)  # = g(pad_row), a constant subgraph
+    result   = out - n_pad * pad_unit
+
+with ``n_pad`` a traced scalar — one executable serves every batch size in the
+bucket, including the exact-fit case (``n_pad = 0``). Eligibility is explicit:
+the metric class opts in with ``_engine_row_additive = True`` (the stat-scores
+family, confusion matrices) AND every registered state must reduce with
+``sum``; anything else skips bucketing and simply compiles per exact shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.engine import config
+from torchmetrics_tpu.utilities.data import dim_zero_sum
+
+
+def next_bucket(n: int, min_bucket: Optional[int] = None) -> int:
+    """Smallest power-of-two bucket holding ``n`` rows (floored at ``MIN_BUCKET``).
+
+    Example:
+        >>> from torchmetrics_tpu.engine.bucketing import next_bucket
+        >>> [next_bucket(n) for n in (1, 8, 9, 100)]
+        [8, 8, 16, 128]
+    """
+    b = min_bucket if min_bucket is not None else config.MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def bucket_eligible(metric: Any) -> bool:
+    """Whether ``metric`` supports the pad-subtract identity."""
+    if not getattr(metric, "_engine_row_additive", False):
+        return False
+    reductions = getattr(metric, "_reductions", {})
+    return bool(reductions) and all(fn is dim_zero_sum for fn in reductions.values())
+
+
+def batch_size(args: Sequence[Any]) -> Optional[int]:
+    """The shared leading-axis size of the inputs, or None when there isn't one."""
+    sizes = {a.shape[0] for a in args if getattr(a, "ndim", 0) >= 1}
+    if len(sizes) != 1:
+        return None
+    return sizes.pop()
+
+
+def pad_args(args: Sequence[Any], bucket: int) -> Tuple[Any, ...]:
+    """Zero-pad every batched input's leading axis up to ``bucket`` rows.
+
+    Zero rows are the universal pad: integer inputs land on class/label 0 and
+    float inputs on 0.0 — both valid update inputs for the eligible metric
+    families, and the pad-subtract identity removes whatever they contribute.
+    """
+    import jax.numpy as jnp
+
+    out = []
+    for a in args:
+        if getattr(a, "ndim", 0) >= 1 and a.shape[0] < bucket:
+            pad_widths = [(0, bucket - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+            out.append(jnp.pad(a, pad_widths))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def pad_row_constants(args: Sequence[Any]) -> Tuple[Optional[np.ndarray], ...]:
+    """One-row zero inputs matching ``args``' trailing shapes — the trace-time
+    constants from which the compiled step derives the per-pad-row contribution.
+
+    Non-batched (0-d) inputs yield ``None``: their live TRACED value must feed
+    the unit computation — baking the first-seen value as a constant would make
+    the subtraction wrong when that input changes under the same signature.
+    """
+    out = []
+    for a in args:
+        if getattr(a, "ndim", 0) >= 1:
+            out.append(np.zeros((1,) + tuple(a.shape[1:]), dtype=np.dtype(str(a.dtype))))
+        else:
+            out.append(None)
+    return tuple(out)

@@ -1,0 +1,358 @@
+"""Per-metric compiled-step cache with donated state buffers.
+
+A metric's ``update`` mutates ``self.<state>`` attributes. The engine re-expresses
+one update call as a pure function ``state_pytree -> state_pytree`` by swapping
+traced state values onto the metric, running the original update body, and
+collecting the resulting attributes — then compiles that function once per
+``(state treedef, input shapes/dtypes)`` signature with ``donate_argnums=(0,)``
+so XLA reuses the old state buffers for the new state in place (the pjit
+donation pattern). Steady state is ONE cached dispatch per step: no Python
+re-trace, no per-op dispatch, no state copy.
+
+Anything that cannot trace — list states, non-array inputs, value-dependent
+host validation, side effects on non-state attributes — falls back to the
+eager path and is counted in :class:`EngineStats`, never silently dropped.
+
+Donation safety: a donated buffer is dead after dispatch, so leaves that are
+also referenced OUTSIDE the state slot (the registered defaults that
+``reset()`` restores, a ``sync()`` snapshot in ``_cache``, a cached
+``compute()`` result the user may still hold) are copied first. The copy shows
+up as ``donation_copies`` and only ever happens on the first step after a
+reset/compute — steady-state steps donate without copying.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine.stats import EngineStats
+
+_FALLBACK = object()  # cache sentinel: this signature is known-uncompilable
+
+
+class _Ineligible(Exception):
+    """Raised inside a trace to abort compilation with a recorded reason."""
+
+
+def _is_jax_array(x: Any) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    return isinstance(x, (jax.Array, jnp.ndarray)) and not isinstance(x, (list, tuple))
+
+
+def _is_metric_like(x: Any) -> bool:
+    # duck-typed (no Metric import — engine must stay import-acyclic with metric.py)
+    return hasattr(x, "_defaults") and hasattr(x, "update") and hasattr(x, "compute")
+
+
+def holds_nested_metrics(metric: Any) -> bool:
+    """True when ``metric`` owns inner Metric objects (wrappers, compositions).
+
+    Tracing such an update would run the INNER metrics' stateful host machinery
+    once at trace time and assign tracer values to their states — a silent
+    corruption the per-attribute side-effect check cannot see (the inner object
+    identity never changes). Wrappers therefore always run eagerly; their inner
+    metrics' own engines still compile the actual work.
+    """
+    for v in metric.__dict__.values():
+        if _is_metric_like(v):
+            return True
+        if isinstance(v, (list, tuple)) and any(_is_metric_like(x) for x in v):
+            return True
+        if isinstance(v, dict) and any(_is_metric_like(x) for x in v.values()):
+            return True
+    return False
+
+
+def traced_update(metric: Any, state: Dict[str, Any], args: Sequence[Any], kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Run ``metric``'s original update as ``state -> state`` (trace-safe).
+
+    The metric's ``__dict__`` is snapshotted and restored wholesale, so a trace
+    can never leak tracer values onto the live object. An update with side
+    effects a compiled step would lose — rebinding a non-state attribute, or
+    growing/shrinking a mutable one in place (``self.seen.append(...)``) —
+    aborts compilation via :class:`_Ineligible` instead of silently diverging.
+    """
+    names = tuple(metric._defaults)
+    snapshot = dict(metric.__dict__)
+    # shallow content copies of mutable non-state containers: an in-place
+    # mutation during an aborted trace must be rolled back, or the eager
+    # fallback would re-run it and double the side effect
+    containers = {
+        k: (list(v) if isinstance(v, list) else dict(v) if isinstance(v, dict) else set(v))
+        for k, v in snapshot.items()
+        if k not in names and isinstance(v, (list, dict, set))
+    }
+    try:
+        for k in names:
+            object.__setattr__(metric, k, state[k])
+        metric._raw_update(*args, **kwargs)
+        out = {k: getattr(metric, k) for k in names}
+        for k, v in metric.__dict__.items():
+            if k in names:
+                continue
+            if snapshot.get(k, _FALLBACK) is not v:
+                raise _Ineligible(f"update writes non-state attribute {k!r}")
+            if k in containers and _container_changed(v, containers[k]):
+                raise _Ineligible(f"update mutates non-state container {k!r} in place")
+        return out
+    finally:
+        metric.__dict__.clear()
+        metric.__dict__.update(snapshot)
+        for k, saved in containers.items():
+            live = snapshot[k]
+            if _container_changed(live, saved):
+                if isinstance(live, list):
+                    live[:] = saved
+                else:  # dict and set both restore via clear + update
+                    live.clear()
+                    live.update(saved)
+
+
+def _container_changed(live: Any, saved: Any) -> bool:
+    """Shallow in-place change detection by length + element IDENTITY.
+
+    ``==`` would recurse into element values (arrays raise on bool coercion);
+    identity comparison catches the realistic mutations — append/pop, dict
+    value overwrite, set add/remove — without touching element semantics.
+    """
+    if len(live) != len(saved):
+        return True
+    if isinstance(live, list):
+        return any(a is not b for a, b in zip(live, saved))
+    if isinstance(live, dict):
+        return live.keys() != saved.keys() or any(live[k] is not saved[k] for k in saved)
+    return live != saved  # sets hold hashables only; equality is safe
+
+
+def protected_ids(metric: Any) -> set:
+    """ids of arrays that outlive the state slot and must not be donated."""
+    import jax
+
+    ids = set()
+    for v in metric._defaults.values():
+        if not isinstance(v, list):
+            ids.add(id(v))
+    if getattr(metric, "_cache", None):
+        for leaf in jax.tree_util.tree_leaves(metric._cache):
+            ids.add(id(leaf))
+    if getattr(metric, "_computed", None) is not None:
+        for leaf in jax.tree_util.tree_leaves(metric._computed):
+            ids.add(id(leaf))
+    return ids
+
+
+def shield_state(state: Dict[str, Any], metric: Any, stats: EngineStats) -> Dict[str, Any]:
+    """Copy state leaves whose buffers are aliased outside the state slot."""
+    import jax.numpy as jnp
+
+    shared = protected_ids(metric)
+    out = {}
+    for k, v in state.items():
+        if id(v) in shared:
+            out[k] = jnp.array(v, copy=True)
+            stats.donation_copies += 1
+        else:
+            out[k] = v
+    return out
+
+
+def make_step(run, bucketed: bool, inputs: Sequence[Any]):
+    """Compile ``run(state_pytree, flat_inputs) -> state_pytree`` into a jitted
+    step with the state pytree donated (policy permitting).
+
+    Shared by the per-metric and the fused engines — the pad-subtract identity
+    and the donation flag live HERE, once. With ``bucketed`` the step takes a
+    traced ``n_pad`` scalar and subtracts the pad rows' contribution in-graph
+    (see ``engine/bucketing.py``); ``tree_map`` keeps it agnostic to whether the
+    state pytree is one metric's dict or a fused dict-of-dicts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.engine import bucketing, config
+
+    if bucketed:
+        pad_rows = bucketing.pad_row_constants(inputs)
+
+        def step(state, n_pad, *flat):
+            out = run(state, flat)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state)
+            # per-pad-row contribution: constant zero rows for batched inputs,
+            # the live traced value for non-batched ones
+            unit_flat = [c if c is not None else flat[i] for i, c in enumerate(pad_rows)]
+            unit = run(zeros, unit_flat)
+            return jax.tree_util.tree_map(lambda o, u: o - u * n_pad.astype(o.dtype), out, unit)
+
+    else:
+
+        def step(state, *flat):
+            return run(state, flat)
+
+    donate = config.donation_enabled()
+    return jax.jit(step, donate_argnums=(0,) if donate else ()), donate
+
+
+def input_signature(inputs: Sequence[Any]) -> Optional[Tuple]:
+    """Shape/dtype key for the inputs, or None when something is not an array.
+
+    Tracers are rejected: an update already executing under someone else's
+    trace (a user-jitted step) must keep the pre-engine eager semantics — the
+    engine only owns dispatches it issues from host level.
+    """
+    import jax
+
+    sig = []
+    for a in inputs:
+        if isinstance(a, jax.core.Tracer):
+            return None
+        if _is_jax_array(a) or isinstance(a, np.ndarray):
+            sig.append((tuple(a.shape), str(a.dtype)))
+        else:
+            return None
+    return tuple(sig)
+
+
+def _nbytes(x: Any) -> int:
+    return getattr(x, "nbytes", 0)
+
+
+class CompiledUpdate:
+    """Compiled-step cache for ONE metric instance.
+
+    Created lazily by :meth:`Metric._engine_step` on the first engine-enabled
+    update; excluded from pickling/cloning (executables are rebuilt per
+    process/instance).
+    """
+
+    def __init__(self, metric: Any) -> None:
+        self._metric = metric
+        self._cache: Dict[Tuple, Any] = {}
+        self.stats = EngineStats(type(metric).__name__)
+        self._bucket_ok: Optional[bool] = None
+        defaults = metric._defaults
+        self._disabled_reason: Optional[str] = None
+        if not defaults:
+            self._disabled_reason = "stateless"
+        elif any(isinstance(d, list) for d in defaults.values()):
+            self._disabled_reason = "list-state"
+        elif holds_nested_metrics(metric):
+            self._disabled_reason = "nested-metric"
+
+    # ------------------------------------------------------------------ step
+
+    def step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> bool:
+        """Try to run one update through a compiled executable.
+
+        Returns True when the step was handled (states written back); False
+        requests the eager fallback. Never raises for eligibility reasons.
+        """
+        st = self.stats
+        if self._disabled_reason is not None:
+            st.fallback(self._disabled_reason)
+            return False
+        m = self._metric
+
+        state: Dict[str, Any] = {}
+        for k in m._defaults:
+            v = getattr(m, k)
+            if not _is_jax_array(v):
+                st.fallback("non-array-state")
+                return False
+            state[k] = v
+
+        kw_names = tuple(sorted(kwargs))
+        inputs = list(args) + [kwargs[k] for k in kw_names]
+        in_sig = input_signature(inputs)
+        if in_sig is None:
+            st.fallback("non-array-input")
+            return False
+
+        # shape-bucket ragged batches for eligible (row-additive, sum-reduced) metrics
+        if self._bucket_ok is None:
+            self._bucket_ok = bucketing.bucket_eligible(m)
+        n_pad = 0
+        bucketed = False
+        if self._bucket_ok and config.BUCKETING_ENABLED:
+            n = bucketing.batch_size(inputs)
+            if n is not None and n > 0:
+                bucket = bucketing.next_bucket(n)
+                n_pad = bucket - n
+                inputs = list(bucketing.pad_args(inputs, bucket))
+                in_sig = input_signature(inputs)
+                bucketed = True
+                st.bucketed_steps += 1
+                st.bucket_pad_rows += n_pad
+                st.bucket_sizes.add(bucket)
+
+        state_sig = tuple((k, tuple(v.shape), str(v.dtype)) for k, v in state.items())
+        key = (bucketed, len(args), kw_names, state_sig, in_sig, self._device_token(state))
+
+        entry = self._cache.get(key)
+        if entry is _FALLBACK:
+            st.fallback("uncompilable-signature")
+            return False
+
+        first = entry is None
+        if first:
+            entry = self._compile(len(args), kw_names, bucketed, inputs)
+        fn, donate = entry
+
+        if donate:
+            state = shield_state(state, m, st)
+
+        try:
+            if bucketed:
+                out = fn(state, np.int32(n_pad), *inputs)
+            else:
+                out = fn(state, *inputs)
+        except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
+            if not first:
+                raise  # a cached executable failing on matching shapes is a real bug
+            self._cache[key] = _FALLBACK
+            reason = str(exc) if isinstance(exc, _Ineligible) else f"trace-failed:{type(exc).__name__}"
+            st.fallback(reason)
+            return False
+
+        if first:
+            st.traces += 1
+            self._cache[key] = entry
+        else:
+            st.cache_hits += 1
+        st.dispatches += 1
+        st.metrics_updated += 1
+        if donate:
+            st.donated_dispatches += 1
+        else:
+            st.donation_fallbacks += 1
+        st.bytes_moved += sum(_nbytes(v) for v in state.values()) + sum(_nbytes(a) for a in inputs)
+
+        for k, v in out.items():
+            setattr(m, k, v)
+        return True
+
+    # ------------------------------------------------------------------ build
+
+    def _compile(self, n_args: int, kw_names: Tuple[str, ...], bucketed: bool, inputs: Sequence[Any]):
+        m = self._metric
+
+        def run(state, flat):
+            call_args = tuple(flat[:n_args])
+            call_kwargs = dict(zip(kw_names, flat[n_args:]))
+            return traced_update(m, state, call_args, call_kwargs)
+
+        return make_step(run, bucketed, inputs)
+
+    @staticmethod
+    def _device_token(state: Dict[str, Any]) -> str:
+        """Best-effort device component of the cache key — ``to(device)`` must recompile."""
+        for v in state.values():
+            try:
+                return str(next(iter(v.devices())))
+            except Exception:
+                break
+        return ""

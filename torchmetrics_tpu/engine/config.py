@@ -1,0 +1,87 @@
+"""Engine enablement and policy knobs.
+
+Resolution order for "is the engine on?" (first hit wins):
+
+1. per-metric ``Metric(compiled_update=True/False)`` — handled by the caller;
+2. an active :func:`engine_context` / :func:`set_engine_enabled` override;
+3. ``TORCHMETRICS_TPU_ENGINE`` env var (``"1"``/``"0"``);
+4. auto: on when the default JAX backend is an accelerator (tpu/gpu), off on
+   CPU — on CPU the per-op dispatch the engine removes costs microseconds, and
+   buffer donation is a backend no-op, so compiling every metric would only tax
+   test suites with XLA compile time.
+
+Donation follows the same auto rule (donating on CPU is silently ignored by
+JAX, so forcing it on is harmless — tests do exactly that to exercise the
+protection logic).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Generator, Optional
+
+# "axon" is the tunneled-TPU plugin's registration name; its devices report
+# platform "tpu" (BENCH_r04 fused-gate evidence) but the default-backend string
+# can surface either spelling depending on the jax version
+_ACCELERATORS = ("tpu", "gpu", "cuda", "rocm", "axon")
+
+# module-level override: None = defer to env var / auto
+_enabled_override: Optional[bool] = None
+_donate_override: Optional[bool] = None
+
+# bucketing policy (see engine/bucketing.py)
+BUCKETING_ENABLED = True
+MIN_BUCKET = 8
+
+
+def _default_backend() -> str:
+    # shared with the fused-op dispatch gates: init failure degrades to "cpu"
+    from torchmetrics_tpu.ops._dispatch import default_backend
+
+    return default_backend()
+
+
+def engine_enabled() -> bool:
+    """Whether the fused update engine engages for metrics without a per-metric override."""
+    if _enabled_override is not None:
+        return _enabled_override
+    env = os.environ.get("TORCHMETRICS_TPU_ENGINE")
+    if env is not None and env.strip() in ("0", "1"):
+        return env.strip() == "1"
+    return _default_backend() in _ACCELERATORS
+
+
+def set_engine_enabled(value: Optional[bool]) -> None:
+    """Force the engine on/off process-wide; ``None`` restores auto resolution."""
+    global _enabled_override
+    if value is not None and not isinstance(value, bool):
+        raise ValueError(f"Expected `value` to be a bool or None but got {value}")
+    _enabled_override = value
+
+
+def donation_enabled() -> bool:
+    """Whether compiled steps donate their state buffers."""
+    if _donate_override is not None:
+        return _donate_override
+    return _default_backend() in _ACCELERATORS
+
+
+def set_donation_enabled(value: Optional[bool]) -> None:
+    """Force donation on/off (``None`` = auto). Donation on CPU is a JAX no-op."""
+    global _donate_override
+    _donate_override = value
+
+
+@contextmanager
+def engine_context(enabled: bool = True, donate: Optional[bool] = None) -> Generator:
+    """Scoped engine enablement — the bench and the tests use this."""
+    global _enabled_override, _donate_override
+    prev_e, prev_d = _enabled_override, _donate_override
+    _enabled_override = enabled
+    if donate is not None:
+        _donate_override = donate
+    try:
+        yield
+    finally:
+        _enabled_override, _donate_override = prev_e, prev_d

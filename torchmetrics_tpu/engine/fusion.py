@@ -1,0 +1,192 @@
+"""Collection-level dispatch fusion.
+
+A ``MetricCollection`` step over N compute-group leaders costs N dispatches even
+when every leader is individually compiled — at pod scale the dispatch floor is
+the bottleneck (BENCH_r04: 6.2 ms floor vs 1.7 ms collective marginal at 128
+chips). :class:`FusedUpdate` traces every fusable leader's update body into one
+``jax.jit`` executable over the combined state pytree ``{name: {state: leaf}}``
+with the whole pytree donated, so the N-metric step is a single dispatch and the
+members' updates fuse into one XLA program (shared subcomputations — e.g. the
+argmax/one-hot of a stat-scores family — dedupe inside XLA instead of being
+recomputed per metric).
+
+Members that cannot fuse — list states, a ``compiled_update=False`` opt-out,
+an update that fails a cheap per-member ``jax.eval_shape`` trace probe (host
+validation, side effects) — are excluded up front and reported back to the
+caller to update eagerly; one bad metric never un-fuses the rest.
+Shape-bucketing applies when every eligible member supports the pad-subtract
+identity (see ``engine/bucketing.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine.compiled import (
+    _FALLBACK,
+    _is_jax_array,
+    holds_nested_metrics,
+    input_signature,
+    make_step,
+    shield_state,
+    traced_update,
+)
+from torchmetrics_tpu.engine.stats import EngineStats
+
+
+class FusedUpdate:
+    """One compiled executable updating several metrics' states per step."""
+
+    def __init__(self, metrics: Sequence[Tuple[str, Any]]) -> None:
+        self.metrics: List[Tuple[str, Any]] = list(metrics)
+        self._cache: Dict[Tuple, Any] = {}
+        self.stats = EngineStats("fused:" + ",".join(type(m).__name__ for _, m in self.metrics))
+
+    def step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[Set[str]]:
+        """Run one fused step; returns the set of member names handled.
+
+        ``None`` means nothing was fused — the caller runs every member
+        eagerly. A non-empty result may still omit members (they were
+        ineligible or failed the trace probe); the caller updates those
+        eagerly, and their own per-metric engines still apply.
+        """
+        st = self.stats
+        if kwargs:
+            # per-member kwarg filtering inside one executable is not supported;
+            # positional calls are the collection hot path
+            st.fallback("kwargs")
+            return None
+        inputs = list(args)
+        in_sig = input_signature(inputs)
+        if in_sig is None:
+            st.fallback("non-array-input")
+            return None
+
+        members: List[Tuple[str, Any]] = []
+        states: Dict[str, Dict[str, Any]] = {}
+        for name, m in self.metrics:
+            if m.compiled_update is False:  # the per-metric opt-out outranks fusion
+                continue
+            if not m._defaults or any(isinstance(d, list) for d in m._defaults.values()):
+                continue
+            if holds_nested_metrics(m):
+                continue
+            mstate = {k: getattr(m, k) for k in m._defaults}
+            if all(_is_jax_array(v) for v in mstate.values()):
+                members.append((name, m))
+                states[name] = mstate
+        if len(members) < 2:
+            st.fallback("too-few-members")
+            return None
+
+        n_pad = 0
+        bucketed = False
+        if config.BUCKETING_ENABLED and all(bucketing.bucket_eligible(m) for _, m in members):
+            n = bucketing.batch_size(inputs)
+            if n is not None and n > 0:
+                bucket = bucketing.next_bucket(n)
+                n_pad = bucket - n
+                inputs = list(bucketing.pad_args(inputs, bucket))
+                in_sig = input_signature(inputs)
+                bucketed = True
+                st.bucketed_steps += 1
+                st.bucket_pad_rows += n_pad
+                st.bucket_sizes.add(bucket)
+
+        state_sig = tuple(
+            (name, tuple((k, tuple(v.shape), str(v.dtype)) for k, v in states[name].items()))
+            for name, _ in members
+        )
+        key = (bucketed, state_sig, in_sig)
+        entry = self._cache.get(key)
+        if entry is _FALLBACK:
+            st.fallback("uncompilable-signature")
+            return None
+
+        first = entry is None
+        if first:
+            entry = self._compile(members, states, bucketed, inputs)
+            if entry is None:  # fewer than 2 members survived the trace probes
+                self._cache[key] = _FALLBACK
+                st.fallback("too-few-traceable-members")
+                return None
+        fn, donate, fused_names = entry
+        fused = [(name, m) for name, m in members if name in fused_names]
+        fused_states = {name: states[name] for name, _ in fused}
+
+        if donate:
+            fused_states = {
+                name: shield_state(fused_states[name], m, st) for name, m in fused
+            }
+
+        try:
+            if bucketed:
+                out = fn(fused_states, np.int32(n_pad), *inputs)
+            else:
+                out = fn(fused_states, *inputs)
+        except Exception as exc:  # noqa: BLE001 — a compile-time failure demotes the key
+            if not first:
+                raise
+            self._cache[key] = _FALLBACK
+            st.fallback(f"trace-failed:{type(exc).__name__}")
+            return None
+
+        if first:
+            st.traces += 1
+            self._cache[key] = entry
+        else:
+            st.cache_hits += 1
+        st.dispatches += 1
+        st.metrics_updated += len(fused)
+        if donate:
+            st.donated_dispatches += 1
+        else:
+            st.donation_fallbacks += 1
+        st.bytes_moved += sum(
+            v.nbytes for mstate in fused_states.values() for v in mstate.values()
+        ) + sum(getattr(a, "nbytes", 0) for a in inputs)
+
+        handled: Set[str] = set()
+        for name, m in fused:
+            for k, v in out[name].items():
+                setattr(m, k, v)
+            # the wrapped-update bookkeeping the eager path would have done
+            m._computed = None
+            m._update_count += 1
+            handled.add(name)
+        return handled
+
+    def _compile(
+        self,
+        members: Sequence[Tuple[str, Any]],
+        states: Dict[str, Dict[str, Any]],
+        bucketed: bool,
+        inputs: Sequence[Any],
+    ):
+        """Probe each member's traceability, then compile the survivors as one step.
+
+        The ``jax.eval_shape`` probe runs the member's update abstractly (no XLA
+        compile), so one metric with host-side validation or update side effects
+        is excluded — with its reason counted — instead of poisoning the whole
+        fused executable.
+        """
+        import jax
+
+        fusable: List[Tuple[str, Any]] = []
+        for name, m in members:
+            try:
+                jax.eval_shape(lambda s, *f, _m=m: traced_update(_m, s, f, {}), states[name], *inputs)
+                fusable.append((name, m))
+            except Exception as exc:  # noqa: BLE001 — probe failure excludes ONE member
+                self.stats.fallback_reasons[f"member:{name}:{type(exc).__name__}"] += 1
+        if len(fusable) < 2:
+            return None
+
+        def run_all(fused_states, flat):
+            return {name: traced_update(m, fused_states[name], tuple(flat), {}) for name, m in fusable}
+
+        fn, donate = make_step(run_all, bucketed, inputs)
+        return fn, donate, frozenset(name for name, _ in fusable)

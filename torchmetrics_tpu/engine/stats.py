@@ -1,0 +1,92 @@
+"""Engine introspection counters.
+
+Every :class:`CompiledUpdate`/:class:`FusedUpdate` owns an :class:`EngineStats`;
+all live instances register in a module-level weak set so :func:`engine_report`
+can aggregate a process-wide view without keeping dead metrics alive. The
+counters are the driver-verifiable evidence surface: ``bench.py`` exports them
+so "0 retraces after warmup" and "one dispatch per fused step" are recorded
+numbers, not claims.
+"""
+
+from __future__ import annotations
+
+import weakref
+from collections import Counter
+from typing import Any, Dict
+
+_REGISTRY: "weakref.WeakSet[EngineStats]" = weakref.WeakSet()
+
+_COUNTER_FIELDS = (
+    "traces",  # signatures compiled (each = one XLA trace+compile)
+    "cache_hits",  # steps served by an already-compiled executable
+    "dispatches",  # compiled executions (fused: 1 per N-metric step)
+    "metrics_updated",  # metric-updates performed via compiled steps (fused: N per step)
+    "eager_fallbacks",  # steps that fell back to the eager Python path
+    "donated_dispatches",  # dispatches that donated the state pytree
+    "donation_copies",  # state leaves copied pre-dispatch to protect shared buffers
+    "donation_fallbacks",  # dispatches that skipped donation (backend/policy)
+    "bucketed_steps",  # steps that rode a shape bucket
+    "bucket_pad_rows",  # total pad rows added across bucketed steps
+    "bytes_moved",  # input + state bytes entering compiled dispatches
+)
+
+
+class EngineStats:
+    """Mutable counter block for one engine instance."""
+
+    __slots__ = ("owner", "fallback_reasons", "bucket_sizes", "__weakref__", *_COUNTER_FIELDS)
+
+    def __init__(self, owner: str = "") -> None:
+        self.owner = owner
+        self.fallback_reasons: Counter = Counter()
+        self.bucket_sizes: set = set()
+        for f in _COUNTER_FIELDS:
+            setattr(self, f, 0)
+        _REGISTRY.add(self)
+
+    def fallback(self, reason: str) -> None:
+        self.eager_fallbacks += 1
+        self.fallback_reasons[reason] += 1
+
+    def reset(self) -> None:
+        for f in _COUNTER_FIELDS:
+            setattr(self, f, 0)
+        self.fallback_reasons.clear()
+        self.bucket_sizes.clear()
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {f: getattr(self, f) for f in _COUNTER_FIELDS}
+        out["owner"] = self.owner
+        out["bucket_count"] = len(self.bucket_sizes)
+        if self.fallback_reasons:
+            out["fallback_reasons"] = dict(self.fallback_reasons)
+        return out
+
+    def __repr__(self) -> str:
+        body = ", ".join(f"{f}={getattr(self, f)}" for f in _COUNTER_FIELDS if getattr(self, f))
+        return f"EngineStats({self.owner!r}, {body})"
+
+
+def engine_report() -> Dict[str, Any]:
+    """Aggregate counters over every live engine in the process."""
+    total: Dict[str, Any] = {f: 0 for f in _COUNTER_FIELDS}
+    reasons: Counter = Counter()
+    buckets: set = set()
+    engines = 0
+    for st in list(_REGISTRY):
+        engines += 1
+        for f in _COUNTER_FIELDS:
+            total[f] += getattr(st, f)
+        reasons.update(st.fallback_reasons)
+        buckets |= st.bucket_sizes
+    total["engines"] = engines
+    total["bucket_count"] = len(buckets)
+    if reasons:
+        total["fallback_reasons"] = dict(reasons)
+    return total
+
+
+def reset_engine_stats() -> None:
+    """Zero every live engine's counters (bench scenario isolation)."""
+    for st in list(_REGISTRY):
+        st.reset()

@@ -115,6 +115,13 @@ class Metric:
             raise ValueError(
                 f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}"
             )
+        # fused update engine (engine/): None = follow the process-wide policy
+        # (auto-on when the default backend is an accelerator), True/False forces
+        self.compiled_update = kwargs.pop("compiled_update", None)
+        if self.compiled_update is not None and not isinstance(self.compiled_update, bool):
+            raise ValueError(
+                f"Expected keyword argument `compiled_update` to be a `bool` or `None` but got {self.compiled_update}"
+            )
 
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
@@ -139,6 +146,9 @@ class Metric:
         # initialize state
         self._cache: Optional[Dict[str, Union[List[Array], Array]]] = None
         self._is_synced = False
+        # per-instance compiled-step cache (engine/compiled.py), created lazily on
+        # the first engine-enabled update; never pickled/cloned (rebuilt per process)
+        self._engine = None
         # dist_reduce_fx=None array states that currently hold a stacked
         # (shards, *default.shape) layout — tracked explicitly so folding never has
         # to guess from ndim (a state whose legitimate per-update shape is one rank
@@ -434,16 +444,45 @@ class Metric:
                 if (fn == dim_zero_cat or fn is None) and isinstance(self._defaults[attr], list)
             ]
             if list_attrs:
+                import zlib
+
                 from jax.experimental import multihost_utils
 
-                # count = number of collectives this rank will enter for the attr: a
-                # state folded to a single array (merge_state snapshot) enters one
-                local_counts = jnp.asarray(
-                    [len(x) if isinstance(x, list) else 1 for x in (input_dict[a] for a in list_attrs)]
+                def _shape_fingerprint(x: Any) -> int:
+                    """Stable digest of the per-element shapes of a list state.
+
+                    Equal counts do NOT imply matching collectives: None-reduced
+                    list states sync one collective PER ELEMENT, so ranks holding
+                    the same number of elements with different per-position
+                    shapes (e.g. differing final packed-batch sizes) still enter
+                    shape-ragged collectives that can crash or wedge the world.
+                    crc32 over the flattened (rank, *dims) sequence is
+                    process-stable (unlike ``hash``) and rides in the same
+                    fixed-shape probe as the counts.
+                    """
+                    elements = x if isinstance(x, list) else [x]
+                    dims: List[int] = []
+                    for el in elements:
+                        shp = tuple(getattr(el, "shape", ()))
+                        dims.append(len(shp))
+                        dims.extend(int(d) for d in shp)
+                    # mask to a positive int32 so the probe array never depends on
+                    # the x64 flag (crc32 is uint32; int64 would truncate without x64)
+                    return zlib.crc32(np.asarray(dims, dtype=np.int64).tobytes()) & 0x7FFFFFFF
+
+                # per attr: [count, shape fingerprint]. count = number of collectives
+                # this rank will enter (a state folded to a single array enters one);
+                # ONE fixed-shape gather covers both probes for every list state.
+                local_probe = jnp.asarray(
+                    [
+                        [len(x) if isinstance(x, list) else 1, _shape_fingerprint(x)]
+                        for x in (input_dict[a] for a in list_attrs)
+                    ],
+                    dtype=jnp.int32,
                 )
-                counts = np.asarray(multihost_utils.process_allgather(local_counts, tiled=False))
+                probe = np.asarray(multihost_utils.process_allgather(local_probe, tiled=False))
                 for idx, attr in enumerate(list_attrs):
-                    col = counts[:, idx]
+                    col = probe[:, idx, 0]
                     is_cat = self._reductions[attr] == dim_zero_cat
                     # cat: pre-concat above leaves 0 or 1 elements, so only mixed
                     # emptiness can occur; None: exact positional alignment required.
@@ -456,6 +495,20 @@ class Metric:
                             " world. Ensure every process sees the same number of"
                             " updates before compute(), or skip syncing"
                             " (sync_on_compute=False) for ragged epochs."
+                        )
+                    fps = probe[:, idx, 1]
+                    if not is_cat and fps.max() != fps.min():
+                        # equal counts, mismatched per-element shapes: the positional
+                        # collectives would be shape-ragged — fail loud on every rank
+                        raise TorchMetricsUserError(
+                            f"Cannot sync list state `{attr}`: processes hold equal"
+                            f" element counts but mismatched per-element shapes"
+                            f" (shape fingerprints {fps.tolist()}). Positional"
+                            " collectives over a None-reduced list state require"
+                            " identical per-position shapes on every rank — e.g."
+                            " differing final packed-batch sizes must be padded to a"
+                            " common shape before update, or skip syncing"
+                            " (sync_on_compute=False)."
                         )
 
         output_dict = apply_to_collection(
@@ -551,6 +604,8 @@ class Metric:
     # ------------------------------------------------------------------ wrapping
 
     def _wrap_update(self, update: Callable) -> Callable:
+        self._raw_update = update  # unwrapped body — what the engine traces
+
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
@@ -558,11 +613,27 @@ class Metric:
             # host-side trace span: shows up in jax.profiler / Perfetto timelines so
             # metric updates are attributable inside a profiled training step (SURVEY §5.1)
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-                update(*args, **kwargs)
+                if not self._engine_step(args, kwargs):
+                    update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
         return wrapped_func
+
+    def _engine_step(self, args: tuple, kwargs: Dict[str, Any]) -> bool:
+        """Route one update through the fused engine; False = run eagerly."""
+        if self.compiled_update is False:
+            return False
+        if self.compiled_update is None:
+            from torchmetrics_tpu.engine.config import engine_enabled
+
+            if not engine_enabled():
+                return False
+        if self._engine is None:
+            from torchmetrics_tpu.engine.compiled import CompiledUpdate
+
+            self._engine = CompiledUpdate(self)
+        return self._engine.step(args, kwargs)
 
     def _move_list_states_to_cpu(self) -> None:
         """Move list states to host memory to free HBM (reference ``metric.py:442-447``)."""
@@ -650,13 +721,16 @@ class Metric:
         return deepcopy(self)
 
     def __getstate__(self) -> Dict[str, Any]:
-        """Drop wrapped bound methods for pickling (reference ``metric.py:644-648``)."""
-        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_update_signature")}
+        """Drop wrapped bound methods + compiled executables for pickling (reference ``metric.py:644-648``)."""
+        drop = ("update", "compute", "_update_signature", "_raw_update", "_engine")
+        return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         """Re-wrap update/compute on unpickle (reference ``metric.py:650-655``)."""
         self.__dict__.update(state)
         self.__dict__.setdefault("_none_folded", set())
+        self.__dict__.setdefault("compiled_update", None)
+        self._engine = None  # executables are per-process/per-instance; rebuilt lazily
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]

@@ -5,6 +5,19 @@ from __future__ import annotations
 import jax
 
 
+def default_backend() -> str:
+    """Best-effort default JAX backend platform name.
+
+    Shared by the fused-op gates and the update engine's enablement logic
+    (``engine/config.py``): a backend-init failure must degrade to the eager
+    CPU path, never propagate out of a dispatch decision.
+    """
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
 def inputs_on_tpu(x) -> bool:
     """Whether ``x`` lives on (or will be placed on) a TPU.
 

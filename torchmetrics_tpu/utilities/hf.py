@@ -47,7 +47,18 @@ def _is_repo_not_found(exc: Exception) -> bool:
     Matched by exception class name (``huggingface_hub`` raises dedicated types) plus
     the two stable identifier-level messages, so a wording tweak in format-level
     errors can never suppress the ``from_pt`` conversion retry.
+
+    A top-level error that explicitly names the missing FLAX weights
+    (``flax_model``/``from_pt``) is a weights-format failure no matter what sits
+    in its ``__cause__``/``__context__`` chain: some transformers versions
+    surface a cached torch-only checkpoint in offline mode as a
+    missing-flax_model error whose chain carries ``LocalEntryNotFoundError`` —
+    the ``from_pt`` retry succeeds FROM CACHE there, so offline/connection
+    names in the chain must not veto it.
     """
+    msg = str(exc)
+    if "flax_model" in msg or "from_pt" in msg:
+        return False
     names = set()
     stack, seen = [exc], set()
     while stack:
@@ -70,7 +81,6 @@ def _is_repo_not_found(exc: Exception) -> bool:
         "ConnectTimeout",
     }:
         return True
-    msg = str(exc)
     return (
         "is not a valid model identifier" in msg
         or "is not a local folder" in msg
