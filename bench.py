@@ -432,6 +432,127 @@ def bench_engine(micro=False):
     return out
 
 
+def bench_epoch(micro=False):
+    """Fused epoch engine counters: packed single-collective sync + cached
+    sync→compute executables (ISSUE 2 acceptance evidence).
+
+    Emulates a 2-process world in-process (``process_allgather`` mocked to
+    stack two copies of the local buffer — both "ranks" hold identical state,
+    so packed and eager syncs must agree exactly) over the same 4-metric
+    stat-scores collection as ``bench_engine``:
+
+    - ``eager``: engine off — one collective per state tensor plus one shape
+      gather per state (the per-tensor ``gather_all_tensors`` path)
+    - ``packed``: engine on — ONE metadata exchange at most + one collective
+      per (role, dtype) buffer for the WHOLE collection, fold + compute served
+      from cached executables (0 re-traces after the warmup cycle)
+
+    Counters come straight from EngineStats, so "O(dtypes) collectives per
+    sync" and "0 compute retraces after warmup" are recorded numbers.
+    """
+    import time as _time
+    from unittest import mock
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassPrecision,
+    )
+    from torchmetrics_tpu.engine import engine_context
+
+    batch, classes = (256, 10) if micro else (4096, 100)
+    n_batches, cycles, world = 4, 4, 2
+
+    key = jax.random.PRNGKey(7)
+    batches = [
+        (
+            jax.random.normal(jax.random.fold_in(key, 2 * i), (batch, classes), jnp.float32),
+            jax.random.randint(jax.random.fold_in(key, 2 * i + 1), (batch,), 0, classes, jnp.int32),
+        )
+        for i in range(n_batches)
+    ]
+
+    def build(compiled=None):
+        kw = dict(validate_args=False, compiled_update=compiled)
+        return {
+            "acc_macro": MulticlassAccuracy(classes, average="macro", **kw),
+            "prec_macro": MulticlassPrecision(classes, average="macro", **kw),
+            "acc_micro": MulticlassAccuracy(classes, average="micro", **kw),
+            "cm": MulticlassConfusionMatrix(classes, **kw),
+        }
+
+    calls = {"n": 0}
+
+    def fake_allgather(x, tiled=False):
+        calls["n"] += 1
+        return np.stack([np.asarray(x)] * world)
+
+    out = {"batch": batch, "classes": classes, "world": world, "cycles": cycles}
+    with mock.patch.object(jax, "process_count", lambda: world), mock.patch.object(
+        multihost_utils, "process_allgather", fake_allgather
+    ):
+        # -- eager baseline: per-tensor collectives, engine off ----------------
+        mc_e = MetricCollection(build(compiled=False), compute_groups=False, fused_dispatch=False)
+        for m in mc_e._modules.values():
+            m.distributed_available_fn = lambda: True
+        for p, t in batches:
+            mc_e.update(p, t)
+        calls["n"] = 0
+        t0 = _time.perf_counter()
+        eager_res = mc_e.compute()
+        out["eager_epoch_ms"] = round((_time.perf_counter() - t0) * 1e3, 2)
+        out["eager_collectives_per_sync"] = calls["n"]
+
+        # -- packed: engine on, compute groups + collection-wide plan ----------
+        with engine_context(True):
+            mc = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+            for m in mc._modules.values():
+                m.distributed_available_fn = lambda: True
+            epoch_ms = []
+            warmup_traces = None
+            for cycle in range(cycles):
+                mc.reset()  # each cycle is one epoch over the same batches
+                for p, t in batches:
+                    mc.update(p, t)
+                t0 = _time.perf_counter()
+                packed_res = mc.compute()
+                epoch_ms.append((_time.perf_counter() - t0) * 1e3)
+                if cycle == 0:
+                    est = mc._epoch_sync.stats
+                    engines = [
+                        m._epoch for m in mc._modules.values() if m._epoch is not None
+                    ]
+                    warmup_traces = est.sync_fold_traces + sum(
+                        e.stats.compute_traces + e.stats.sync_fold_traces for e in engines
+                    )
+            est = mc._epoch_sync.stats
+            engines = [m._epoch for m in mc._modules.values() if m._epoch is not None]
+            final_traces = est.sync_fold_traces + sum(
+                e.stats.compute_traces + e.stats.sync_fold_traces for e in engines
+            )
+            out["packed_collectives_per_sync"] = int(round(est.sync_collectives / est.packed_syncs))
+            out["packed_metadata_gathers_per_sync"] = int(
+                round(est.sync_metadata_gathers / est.packed_syncs)
+            )
+            out["packed_syncs"] = est.packed_syncs
+            out["sync_bytes_per_sync"] = int(round(est.sync_bytes_moved / est.packed_syncs))
+            out["epoch_compute_retraces_after_warmup"] = final_traces - warmup_traces
+            out["packed_epoch_ms_warm"] = round(sorted(epoch_ms[1:])[len(epoch_ms[1:]) // 2], 2)
+            out["collective_reduction"] = round(
+                out["eager_collectives_per_sync"] / max(out["packed_collectives_per_sync"], 1), 1
+            )
+            out["parity_ok"] = all(
+                bool(np.allclose(np.asarray(packed_res[k]), np.asarray(eager_res[k]), atol=1e-6))
+                for k in eager_res
+            )
+    return out
+
+
 def bench_micro_device(n_steps=200):
     """Bounded stand-in for the device scenarios when no TPU is present: a tiny
     jitted accuracy scan whose only job is to prove the measurement path runs
@@ -921,6 +1042,12 @@ def main(argv=None):
         except Exception as err:  # noqa: BLE001
             statuses["engine"] = f"error:{type(err).__name__}: {str(err)[:200]}"
 
+        try:
+            extras["epoch"] = bench_epoch(micro=not on_tpu or args.smoke)
+            statuses["epoch"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["epoch"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
         if on_tpu and not args.smoke:
             try:
                 ours = bench_ours()  # all device timings complete before any host work
@@ -941,6 +1068,7 @@ def main(argv=None):
         # a wedged plugin may have left a stuck init thread behind: do NO further
         # jax work of any kind in this process
         statuses["engine"] = "tpu_unavailable"
+        statuses["epoch"] = "tpu_unavailable"
         statuses["device_scenarios"] = "tpu_unavailable"
 
     if not args.smoke:

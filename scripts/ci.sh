@@ -34,8 +34,15 @@ elif ! grep -q '"status"' "$BENCH_OUT" || ! grep -q '"tpu_unavailable"' "$BENCH_
 elif ! grep -q '"retraces_after_warmup": 0' "$BENCH_OUT" || ! grep -q '"dispatch_reduction"' "$BENCH_OUT"; then
   echo "bench smoke: FAILED (engine counters missing from output)"
   status=1
+elif ! grep -qE '"packed_collectives_per_sync": [12],' "$BENCH_OUT"; then
+  # epoch engine gate: a sync must cost O(dtypes) collectives, not O(states)
+  echo "bench smoke: FAILED (epoch packed sync not O(dtypes) collectives)"
+  status=1
+elif ! grep -q '"epoch_compute_retraces_after_warmup": 0' "$BENCH_OUT" || ! grep -q '"parity_ok": true' "$BENCH_OUT"; then
+  echo "bench smoke: FAILED (epoch engine retraced after warmup or diverged from eager sync)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch counters present)"
 fi
 rm -f "$BENCH_OUT"
 

@@ -1,0 +1,595 @@
+"""Fused epoch engine tests (engine/epoch.py + parallel/packing.py): packed
+single-collective sync, cached sync→compute executables, counters, donation
+safety after sync, and the eager-fallback accounting."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassPrecision,
+)
+from torchmetrics_tpu.engine import engine_context
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.parallel.packing import PackedSyncPlan
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+DISTRIBUTED = staticmethod(lambda: True)
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(n, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, n)))
+        for n in sizes
+    ]
+
+
+def _identical_rank_world(monkeypatch, world=2):
+    """Every rank holds this process's state: allgather = stack world copies."""
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+    )
+
+
+class RichStates(Metric):
+    """One metric exercising every reduction kind the packed plan supports."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(NUM_CLASSES), dist_reduce_fx="sum")
+        self.add_state("avg", jnp.asarray(0.0), dist_reduce_fx="mean")
+        self.add_state("peak", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.add_state("trough", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("raw", jnp.zeros((2,)), dist_reduce_fx=None)
+        self.add_state("tail", [], dist_reduce_fx="cat")
+        self.add_state("packs", [], dist_reduce_fx=None)
+        self.add_state("prod", jnp.ones(()), dist_reduce_fx=lambda s: jnp.prod(s, axis=0))
+
+    def update(self, x):
+        self.total = self.total + x.sum(0)
+        self.avg = x.mean()
+        self.peak = jnp.maximum(self.peak, x.max())
+        self.trough = jnp.minimum(self.trough, x.min())
+        self.raw = x.sum(0)[:2]
+        self.tail.append(x[:, 0])
+        self.packs.append(x[:2])
+        self.prod = self.prod * 1.5
+
+    def compute(self):
+        return self.total.sum() + self.avg
+
+
+def _states(m):
+    return {a: getattr(m, a) for a in m._defaults}
+
+
+def _assert_states_equal(got, want):
+    for attr, w in want.items():
+        g = got[attr]
+        if isinstance(w, list):
+            assert isinstance(g, list) and len(g) == len(w), attr
+            for a, b in zip(g, w):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6, err_msg=attr)
+        else:
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-6, err_msg=attr)
+
+
+# ------------------------------------------------------------- packed sync parity
+
+
+def test_packed_sync_world1_parity_all_reductions():
+    """On a 1-process world the packed sync needs ZERO collectives and must
+    leave exactly the states the eager per-tensor sync leaves."""
+    x = jnp.asarray(np.random.RandomState(0).rand(8, NUM_CLASSES).astype(np.float32))
+
+    eager = RichStates(distributed_available_fn=lambda: True, compiled_update=False)
+    eager.update(x)
+    eager.sync(distributed_available=lambda: True)
+    want = _states(eager)
+
+    with engine_context(True):
+        m = RichStates(distributed_available_fn=lambda: True)
+        m.compiled_update = None  # engine decides; context forces on
+        m.update(x)
+        local = _states(m)
+        m.sync(distributed_available=lambda: True)
+        st = m._epoch.stats
+        assert st.packed_syncs == 1
+        assert st.sync_collectives == 0  # world 1: gathered view is local[None]
+        assert st.sync_metadata_gathers == 0
+        _assert_states_equal(_states(m), want)
+        m.unsync()
+        _assert_states_equal(_states(m), local)
+
+
+def test_packed_sync_world2_identical_ranks_parity(monkeypatch):
+    """World-2 emulation (every rank = this rank): packed sync == eager sync."""
+    _identical_rank_world(monkeypatch)
+    x = jnp.asarray(np.random.RandomState(1).rand(8, NUM_CLASSES).astype(np.float32))
+
+    eager = RichStates(
+        dist_sync_fn=lambda t, group=None: [t, t],
+        distributed_available_fn=lambda: True,
+        compiled_update=False,
+    )
+    eager.update(x)
+    eager.sync(dist_sync_fn=eager.dist_sync_fn, distributed_available=lambda: True)
+    want = _states(eager)
+
+    with engine_context(True):
+        m = RichStates(distributed_available_fn=lambda: True)
+        m.update(x)
+        m.sync(distributed_available=lambda: True)
+        st = m._epoch.stats
+        assert st.packed_syncs == 1
+        # one gather buffer per dtype + one reduce buffer per dtype, bounded by
+        # dtypes — NOT by the 8 states (eager would enter >= 8 collectives +
+        # per-state shape gathers)
+        assert 1 <= st.sync_collectives <= 4
+        assert st.sync_metadata_gathers == 1  # cat/none-list states are dynamic
+        _assert_states_equal(_states(m), want)
+
+
+def test_packed_ragged_cat_plan_level():
+    """Plan-level world-2 with genuinely DIFFERENT ranks: ragged cat states
+    concatenate in rank order; None list elements interleave element-major."""
+    a = RichStates(compiled_update=False)
+    b = RichStates(compiled_update=False)
+    xa = jnp.asarray(np.random.RandomState(2).rand(3, NUM_CLASSES).astype(np.float32))
+    xb = jnp.asarray(np.random.RandomState(3).rand(5, NUM_CLASSES).astype(np.float32))
+    a.update(xa)
+    b.update(xb[:3])  # none-list elements must match per-position shapes
+    b.tail = [xb[:, 0]]  # cat state may be ragged across ranks
+
+    plan_a = PackedSyncPlan([("", a)], world_size=2)
+    plan_b = PackedSyncPlan([("", b)], world_size=2)
+    meta = np.stack([plan_a.metadata_local(), plan_b.metadata_local()])
+    plan_a.finalize(meta)
+    plan_b.finalize(meta)
+    bufs_a, bufs_b = plan_a.pack(), plan_b.pack()
+    gathered = {k: jnp.stack([bufs_a[k], bufs_b[k]]) for k in bufs_a}
+    out = jax.jit(plan_a.make_fold())(gathered)[""]
+
+    np.testing.assert_allclose(
+        np.asarray(out["tail"]),
+        np.concatenate([np.asarray(xa[:, 0]), np.asarray(xb[:, 0])]),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(np.asarray(out["total"]), np.asarray(a.total + b.total), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["avg"]), (float(a.avg) + float(b.avg)) / 2, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["prod"]), float(a.prod) * float(b.prod), atol=1e-6)
+    # none-list: element-major interleave [e0@r0, e0@r1, ...]
+    assert len(out["packs"]) == 2
+    np.testing.assert_allclose(np.asarray(out["packs"][0]), np.asarray(a.packs[0]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["packs"][1]), np.asarray(b.packs[0]), atol=1e-6)
+    # none-array: stacked with a leading world axis
+    assert out["raw"].shape == (2, 2)
+
+
+def test_packed_list_guard_errors_fail_loud(monkeypatch):
+    """Cross-rank list raggedness must raise the same fail-loud errors the
+    eager guard raises — on every rank, before any ragged collective."""
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    class PackedDummy(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("packs", default=[], dist_reduce_fx=None)
+
+        def update(self, x):
+            self.packs.append(jnp.asarray(x))
+
+        def compute(self):
+            return self.packs
+
+    def world_meta(delta):
+        def fake(x, tiled=False):
+            local = np.asarray(x)
+            return np.stack([local, local + np.asarray(delta, dtype=local.dtype)])
+
+        return fake
+
+    with engine_context(True):
+        m = PackedDummy(distributed_available_fn=lambda: True)
+        m.update(jnp.ones((2, 3)))
+        monkeypatch.setattr(multihost_utils, "process_allgather", world_meta([1, 0]))
+        with pytest.raises(TorchMetricsUserError, match="deadlock"):
+            m.sync(distributed_available=lambda: True)
+
+        m2 = PackedDummy(distributed_available_fn=lambda: True)
+        m2.update(jnp.ones((2, 3)))
+        monkeypatch.setattr(multihost_utils, "process_allgather", world_meta([0, 1]))
+        with pytest.raises(TorchMetricsUserError, match="mismatched per-element shapes"):
+            m2.sync(distributed_available=lambda: True)
+
+
+# ------------------------------------------------------------- fused sync→compute
+
+
+def test_fused_sync_compute_world2_parity(monkeypatch):
+    """compute() on a distributed metric rides the fused chain: packed exchange
+    + ONE executable doing unpack → folds → compute; value == eager."""
+    _identical_rank_world(monkeypatch)
+    batches = _batches([16] * 3, seed=4)
+
+    eager = MulticlassAccuracy(
+        NUM_CLASSES,
+        average="macro",
+        dist_sync_fn=lambda t, group=None: [t, t],
+        distributed_available_fn=lambda: True,
+        compiled_update=False,
+    )
+    for p, t in batches:
+        eager.update(p, t)
+    want = float(eager.compute())
+
+    with engine_context(True):
+        m = MulticlassAccuracy(
+            NUM_CLASSES, average="macro", validate_args=False, distributed_available_fn=lambda: True
+        )
+        for p, t in batches:
+            m.update(p, t)
+        got = float(m.compute())
+        st = m._epoch.stats
+        assert st.packed_syncs == 1
+        # O(dtypes): one reduce buffer per state dtype (x64 promotion can split
+        # the int states across int32/int64), never one collective per state
+        assert 1 <= st.sync_collectives <= 2
+        assert st.sync_metadata_gathers == 0  # fixed shapes: rank-invariant plan
+        assert st.compute_dispatches == 1  # the fused executable IS the compute
+        assert not m._is_synced  # auto-unsynced, local state restored
+    np.testing.assert_allclose(got, want, atol=1e-7)
+
+    # a second epoch over the same shapes re-uses the cached executables
+    with engine_context(True):
+        for p, t in batches:
+            m.update(p, t)
+        traces_before = (m._epoch.stats.compute_traces, m._epoch.stats.sync_fold_traces)
+        m.compute()
+        assert (m._epoch.stats.compute_traces, m._epoch.stats.sync_fold_traces) == traces_before
+        assert m._epoch.stats.compute_cache_hits >= 1
+
+
+def test_cached_compute_zero_retraces_after_warmup():
+    """Non-distributed compute() dispatches a cached executable: repeated
+    update→compute cycles record ZERO re-traces after the first."""
+    batches = _batches([32] * 5, seed=5)
+    with engine_context(True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        vals = []
+        for p, t in batches:
+            m.update(p, t)
+            vals.append(float(m.compute()))  # update invalidated the cache
+        st = m._epoch.stats
+        assert st.compute_traces == 1
+        assert st.compute_dispatches == 5
+        assert st.compute_cache_hits == 4
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    expected = []
+    for p, t in batches:
+        ref.update(p, t)
+        expected.append(float(ref.compute()))
+    np.testing.assert_allclose(vals, expected, atol=1e-7)
+
+
+def test_untraceable_compute_falls_back_counted():
+    """A compute with host-side work demotes to eager — counted, value correct."""
+
+    class HostCompute(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + x.sum()
+
+        def compute(self):
+            return float(np.asarray(self.total))  # host readback: untraceable
+
+    with engine_context(True):
+        m = HostCompute()
+        m.update(jnp.arange(4.0))
+        assert m.compute() == 6.0
+        assert any("compute" in r for r in m._epoch.stats.fallback_reasons)
+        m.update(jnp.arange(4.0))
+        assert m.compute() == 12.0  # the demoted signature stays eager, still right
+        assert m._epoch.stats.compute_dispatches == 0
+
+
+def test_compute_writing_state_falls_back():
+    """compute() that rebinds a state has side effects a cached executable
+    would lose — it must run eagerly, not silently diverge."""
+
+    class Finalizing(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + x.sum()
+
+        def compute(self):
+            self.total = self.total / 2  # in-place finalization (bad practice, but legal)
+            return self.total
+
+    with engine_context(True):
+        m = Finalizing()
+        m.update(jnp.asarray([8.0]))
+        assert float(m.compute()) == 4.0
+        assert float(m.total) == 4.0  # the eager side effect happened
+        assert m._epoch.stats.compute_dispatches == 0
+
+
+def test_custom_dist_sync_fn_counted_fallback(monkeypatch):
+    """A custom gather fn keeps the eager per-tensor path — counted."""
+    _identical_rank_world(monkeypatch)
+    with engine_context(True):
+        m = MulticlassAccuracy(
+            NUM_CLASSES,
+            average="micro",
+            validate_args=False,
+            dist_sync_fn=lambda t, group=None: [t, t],
+            distributed_available_fn=lambda: True,
+        )
+        p, t = _batches([8], seed=6)[0]
+        m.update(p, t)
+        m.compute()
+        assert m._epoch is not None
+        assert m._epoch.stats.packed_syncs == 0
+        assert m._epoch.stats.fallback_reasons.get("sync:custom-dist-sync-fn", 0) >= 1
+
+
+# ------------------------------------------------------------- collection epoch sync
+
+
+def _collection(**kw):
+    return {
+        "acc_macro": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False, **kw),
+        "prec_macro": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False, **kw),
+        "acc_micro": MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False, **kw),
+        "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False, **kw),
+    }
+
+
+def test_collection_epoch_sync_single_collective(monkeypatch):
+    """The acceptance scenario: a 4-metric stat-scores collection syncs its
+    whole epoch state in <= 2 collectives + <= 1 metadata gather (vs >= 8
+    per-state collectives + per-state shape gathers on the eager path), with
+    zero re-traces on later epochs."""
+    _identical_rank_world(monkeypatch)
+    batches = _batches([32] * 3, seed=7)
+
+    # eager baseline: count every process_allgather the per-tensor path issues
+    from jax.experimental import multihost_utils
+
+    real_gather = multihost_utils.process_allgather
+    calls = {"n": 0}
+
+    def counting(x, tiled=False):
+        calls["n"] += 1
+        return real_gather(x, tiled=tiled)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", counting)
+    mc_eager = MetricCollection(_collection(compiled_update=False), compute_groups=False, fused_dispatch=False)
+    for m in mc_eager._modules.values():
+        m.distributed_available_fn = lambda: True
+    for p, t in batches:
+        mc_eager.update(p, t)
+    want = mc_eager.compute()
+    eager_collectives = calls["n"]
+    assert eager_collectives >= 8
+
+    calls["n"] = 0
+    with engine_context(True):
+        mc = MetricCollection(_collection(), compute_groups=True, fused_dispatch=True)
+        for m in mc._modules.values():
+            m.distributed_available_fn = lambda: True
+        for p, t in batches:
+            mc.update(p, t)
+        got = mc.compute()
+        st = mc._epoch_sync.stats
+        assert st.packed_syncs == 1
+        assert st.sync_collectives <= 2
+        assert st.sync_metadata_gathers <= 1
+        assert calls["n"] <= 3  # the counter matches reality, not just itself
+        # every owner auto-unsynced; local accumulation still live
+        assert all(not m._is_synced for m in mc._modules.values())
+
+        # later epochs: same shapes, ZERO new fold traces
+        for p, t in batches:
+            mc.update(p, t)
+        folds_before = st.sync_fold_traces
+        mc.compute()
+        assert st.sync_fold_traces == folds_before
+        assert st.packed_syncs == 2
+
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), atol=1e-7, err_msg=k)
+
+
+def test_collection_epoch_sync_skips_opted_out_members(monkeypatch):
+    """compiled_update=False members keep their own eager sync — excluded from
+    the packed plan AND still world-synced during the member pass (a member
+    whose sync was silently disabled would return its local-only value)."""
+    class PredSum(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("value", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, p, t):
+            self.value = self.value + p.sum()
+
+        def compute(self):
+            return self.value
+
+    _identical_rank_world(monkeypatch)
+    batches = _batches([16] * 2, seed=8)
+    with engine_context(True):
+        mods = _collection()
+        opted_out = PredSum(compiled_update=False)
+        mods["opted_out"] = opted_out
+        mc = MetricCollection(mods, compute_groups=False, fused_dispatch=True)
+        for m in mc._modules.values():
+            m.distributed_available_fn = lambda: True
+        for p, t in batches:
+            mc.update(p, t)
+        local_sum = float(opted_out.value)
+        out = mc.compute()
+        packed_names = mc._epoch_sync.names
+        assert "opted_out" not in packed_names
+        assert len(packed_names) == 4
+        # the excluded member ran its OWN eager world sync: 2 identical ranks
+        np.testing.assert_allclose(float(out["opted_out"]), 2 * local_sum, rtol=1e-6)
+        # and every member's auto-sync flag is restored for later epochs
+        assert all(m._to_sync for m in mc._modules.values())
+
+
+def test_packed_subworld_pads_to_full_world_max():
+    """process_group sub-worlds: every rank enters the full-world collective,
+    so ragged cat buffers must pad to the ALL-ranks max (a non-member with
+    more rows would otherwise make the allgather shape-ragged), while the fold
+    reads only the members' rows."""
+    replicas = []
+    rows = (2, 2, 5)  # rank 2 (a NON-member) holds the most rows
+    for r, n in enumerate(rows):
+        m = RichStates(compiled_update=False)
+        m.update(jnp.asarray(np.random.RandomState(20 + r).rand(3, NUM_CLASSES), dtype=jnp.float32))
+        m.tail = [jnp.arange(float(n)) + 10 * r]
+        replicas.append(m)
+
+    plans = [PackedSyncPlan([("", m)], world_size=3, process_group=[0, 1]) for m in replicas]
+    meta = np.stack([p.metadata_local() for p in plans])
+    for p in plans:
+        p.finalize(meta)
+    packed = [p.pack() for p in plans]
+    for key in packed[0]:
+        sizes = {int(b[key].size) for b in (packed[0], packed[1], packed[2])}
+        assert len(sizes) == 1, f"ragged collective buffer for {key}: {sizes}"
+    gathered = {k: jnp.stack([b[k] for b in packed]) for k in packed[0]}
+    out = jax.jit(plans[0].make_fold())(gathered)[""]
+    # members-only fold: rank 2's 5 rows are excluded
+    np.testing.assert_allclose(
+        np.asarray(out["tail"]), np.concatenate([np.arange(2.0), np.arange(2.0) + 10]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["total"]), np.asarray(replicas[0].total + replicas[1].total), atol=1e-5
+    )
+
+
+# ------------------------------------------------------------- donation safety
+
+
+def test_donation_after_sync_snapshot_safe(monkeypatch):
+    """The pre-sync snapshot (`_cache`) and the synced states must survive
+    donated update steps: synced values are fresh fold outputs (never aliased
+    into donated buffers), and unsync restores live local state."""
+    _identical_rank_world(monkeypatch)
+    batches = _batches([32] * 4, seed=9)
+    with engine_context(True, donate=True):
+        m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        m.distributed_available_fn = lambda: True
+        for p, t in batches[:2]:
+            m.update(p, t)
+        m.sync(distributed_available=lambda: True)
+        synced = {a: getattr(m, a) for a in m._defaults}
+        m.unsync()
+        for p, t in batches[2:]:
+            m.update(p, t)  # donated steps on the restored local buffers
+        # the synced snapshot taken BEFORE those donated steps is still readable
+        for a, v in synced.items():
+            assert np.asarray(v) is not None
+        got = float(m.compute())
+    # world-2 identical ranks double every count; macro accuracy is scale-free,
+    # so the synced compute equals the plain 4-batch eager value
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    for p, t in batches:
+        ref.update(p, t)
+    np.testing.assert_allclose(got, float(ref.compute()), atol=1e-7)
+
+
+def test_dist_sync_on_step_forward_packed(monkeypatch):
+    """forward with dist_sync_on_step rides the packed path per step and the
+    restored local state stays correct afterwards (the _cache-alias hazard)."""
+    _identical_rank_world(monkeypatch)
+    batches = _batches([16] * 3, seed=10)
+    with engine_context(True, donate=True):
+        m = MulticlassAccuracy(
+            NUM_CLASSES, average="micro", validate_args=False, dist_sync_on_step=True
+        )
+        m.distributed_available_fn = lambda: True
+        step_vals = [float(m(p, t)) for p, t in batches]
+    ref = MulticlassAccuracy(NUM_CLASSES, average="micro")
+    # identical-rank world: the synced step value equals the local batch value
+    expected = [float(ref(p, t)) for p, t in batches]
+    np.testing.assert_allclose(step_vals, expected, atol=1e-7)
+
+
+# ------------------------------------------------------------- satellite coverage
+
+
+def test_gather_all_tensors_scalar_skips_shape_gather(monkeypatch):
+    """0-d states have exactly one possible shape: no metadata exchange."""
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.parallel import gather_all_tensors
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    calls = {"n": 0}
+
+    def fake(x, tiled=False):
+        calls["n"] += 1
+        return np.stack([np.asarray(x)] * 2)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake)
+
+    out = gather_all_tensors(jnp.asarray(3.0))
+    assert calls["n"] == 1 and len(out) == 2  # data gather only
+
+    calls["n"] = 0
+    out = gather_all_tensors(jnp.arange(4.0), assume_equal_shapes=True)
+    assert calls["n"] == 1 and len(out) == 2
+
+    calls["n"] = 0
+    gather_all_tensors(jnp.arange(4.0))
+    assert calls["n"] == 2  # default nd path still exchanges shapes
+
+
+def test_bincount_scatter_add_in_graph():
+    """_bincount stays a single in-graph scatter-add: weighted, jittable with a
+    static minlength, loud when the bin count would need a host readback."""
+    from torchmetrics_tpu.utilities.data import _bincount
+
+    x = jnp.asarray([0, 1, 1, 3, 1, 0])
+    np.testing.assert_array_equal(np.asarray(_bincount(x, minlength=5)), np.bincount(np.asarray(x), minlength=5))
+    w = jnp.asarray([1, 2, 2, 1, 2, 1])
+    np.testing.assert_array_equal(
+        np.asarray(_bincount(x, minlength=5, weights=w)),
+        np.bincount(np.asarray(x), weights=np.asarray(w), minlength=5).astype(np.int64),
+    )
+    # negative (masked/ignored) indices drop instead of crashing the scatter
+    np.testing.assert_array_equal(
+        np.asarray(_bincount(jnp.asarray([-1, 0, 2]), minlength=3)), [1, 0, 1]
+    )
+    jitted = jax.jit(lambda v: _bincount(v, minlength=5))
+    np.testing.assert_array_equal(np.asarray(jitted(x)), np.bincount(np.asarray(x), minlength=5))
+    with pytest.raises(ValueError, match="static"):
+        jax.jit(lambda v: _bincount(v))(x)

@@ -80,9 +80,18 @@ def test_metric_update_on_mesh_sharded_batch(mesh8):
     np.testing.assert_allclose(got, np.asarray(ref.compute()), atol=1e-6)
 
 
+def _shard_map():
+    """jax >= 0.5 exports shard_map at the top level; 0.4.x keeps it experimental."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
 def test_metric_inside_shard_map_psum(mesh8):
     """Metric update stages inside shard_map; psum-reduced state == full-data metric."""
-    from jax import shard_map
+    shard_map = _shard_map()
     from torchmetrics_tpu.functional.classification.confusion_matrix import (
         _multiclass_confusion_matrix_format,
         _multiclass_confusion_matrix_update,
@@ -233,3 +242,62 @@ def test_metric_compute_under_jit_with_mesh(mesh8):
     out = fn(mesh8.shard_batch(preds), mesh8.shard_batch(target))
     ref = multiclass_accuracy(preds, target, num_classes=NUM_CLASSES, average="micro")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+# ---------------------------------------------------------------- axis helpers
+# Direct unit tests for the mode-1 collective wrappers (parallel/sync.py) under
+# shard_map — previously only exercised indirectly through larger graphs.
+
+
+def _axis_apply(mesh8, fn, x, out_spec, check_rep=True):
+    shard_map = _shard_map()
+    step = jax.jit(
+        shard_map(
+            lambda v: fn(v, mesh8.axis),
+            mesh=mesh8.mesh,
+            in_specs=(P(mesh8.axis),),
+            out_specs=out_spec,
+            check_rep=check_rep,
+        )
+    )
+    return step(mesh8.shard_batch(x))
+
+
+def test_axis_sum_matches_host_sum(mesh8):
+    from torchmetrics_tpu.parallel import axis_sum
+
+    x = jnp.asarray(np.random.RandomState(10).rand(8, 6).astype(np.float32))
+    out = _axis_apply(mesh8, axis_sum, x, P())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0, keepdims=True), rtol=1e-6)
+
+
+def test_axis_mean_matches_host_mean(mesh8):
+    from torchmetrics_tpu.parallel import axis_mean
+
+    x = jnp.asarray(np.random.RandomState(11).rand(8, 6).astype(np.float32))
+    out = _axis_apply(mesh8, axis_mean, x, P())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).mean(0, keepdims=True), rtol=1e-6)
+
+
+def test_axis_max_min_match_host(mesh8):
+    from torchmetrics_tpu.parallel import axis_max, axis_min
+
+    x = jnp.asarray(np.random.RandomState(12).randn(8, 6).astype(np.float32))
+    out_max = _axis_apply(mesh8, axis_max, x, P())
+    out_min = _axis_apply(mesh8, axis_min, x, P())
+    np.testing.assert_allclose(np.asarray(out_max), np.asarray(x).max(0, keepdims=True))
+    np.testing.assert_allclose(np.asarray(out_min), np.asarray(x).min(0, keepdims=True))
+
+
+def test_axis_gather_stacks_world(mesh8):
+    """axis_gather adds a leading world dim holding every shard in rank order."""
+    from torchmetrics_tpu.parallel import axis_gather
+
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(8, 2))
+    # all_gather's replication is not statically inferrable on every jax
+    # version — the value IS replicated, so disable the static check only
+    out = _axis_apply(mesh8, axis_gather, x, P(), check_rep=False)
+    # each shard holds (1, 2); the gather returns the replicated (world=8, 1, 2)
+    # stack of every shard in rank order
+    assert out.shape == (8, 1, 2)
+    np.testing.assert_allclose(np.asarray(out).reshape(8, 2), np.asarray(x))

@@ -154,6 +154,7 @@ class MetricCollection:
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
         self._fused_engine = None  # engine/fusion.py executable cache; built lazily
+        self._epoch_sync = None  # engine/epoch.py collection-wide packed sync; lazy
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -269,8 +270,94 @@ class MetricCollection:
     # ------------------------------------------------------------------ compute
 
     def compute(self) -> Dict[str, Any]:
-        """Per-metric compute into one flat (renamed) dict."""
-        return self._compute_and_reduce("compute")
+        """Per-metric compute into one flat (renamed) dict.
+
+        With the epoch engine engaged (``engine/epoch.py``), every eligible
+        compute-group owner syncs up front in ONE packed exchange — a single
+        metadata gather + O(dtypes) collectives for the WHOLE collection,
+        instead of one collective per state per member — then each member
+        computes on the synced canonical states (through its cached compute
+        executable) and the owners unsync afterwards.
+        """
+        restore = self._packed_epoch_sync()
+        try:
+            return self._compute_and_reduce("compute")
+        finally:
+            restore()
+
+    def _packed_epoch_sync(self):
+        """Pack-sync the group owners ahead of the member compute pass.
+
+        Returns a restore callable (always safe to call) that re-enables
+        per-member auto-sync and unsyncs any owner the member pass left synced.
+        """
+        enabled = self.fused_dispatch
+        if enabled is None:
+            from torchmetrics_tpu.engine.config import engine_enabled
+
+            enabled = engine_enabled()
+
+        def noop() -> None:
+            return None
+
+        if not enabled:
+            return noop
+        if self._groups_checked and self._groups:
+            owners = [(group.owner, self._modules[group.owner]) for group in self._groups.values()]
+        else:
+            owners = list(self._modules.items())
+        eligible = []
+        for name, m in owners:
+            # per-metric opt-outs and anything needing special sync semantics
+            # (custom gather fn, host states, sub-world groups) sync themselves
+            if not m._to_sync or m._is_synced or m.dist_sync_fn is not None:
+                continue
+            if m.compute_on_cpu or m.compiled_update is False or m.process_group is not None:
+                continue
+            da = m.distributed_available_fn
+            if callable(da) and da():
+                eligible.append((name, m))
+        if len(eligible) < 2:
+            return noop
+        from torchmetrics_tpu.engine.epoch import CollectionEpoch
+
+        names = [n for n, _ in eligible]
+        if self._epoch_sync is None or self._epoch_sync.names != names:
+            self._epoch_sync = CollectionEpoch(names)
+        snapshots = {name: m._copy_state_refs() for name, m in eligible}
+        if not self._epoch_sync.packed_sync(eligible):
+            return noop
+        for name, m in eligible:
+            m._cache = snapshots[name]
+            m._is_synced = True
+        # disable auto-sync ONLY for members the packed exchange covered: the
+        # synced owners and their group views (which receive the owners' world
+        # state). Ineligible members (custom dist_sync_fn, compute_on_cpu,
+        # process_group, opt-outs) must keep syncing themselves.
+        packed_owners = {name for name, _ in eligible}
+        if self._groups_checked and self._groups:
+            covered = set()
+            for group in self._groups.values():
+                if group.owner in packed_owners:
+                    covered.update(group.names)
+        else:
+            covered = packed_owners
+        disabled = []
+        for name, m in self._modules.items():
+            if name in covered and m._to_sync:
+                m._to_sync = False
+                disabled.append(m)
+        self._state_is_copy = False  # re-anchor views onto the synced owners
+
+        def restore() -> None:
+            for m in disabled:
+                m._to_sync = True
+            for _, m in eligible:
+                if m._is_synced:  # a member pass normally unsyncs owners itself
+                    m.unsync()
+            self._state_is_copy = False  # next accessor re-anchors local state
+
+        return restore
 
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         if method_name not in ("compute", "forward"):
@@ -314,6 +401,7 @@ class MetricCollection:
         """Compiled fused executables are per-process — never pickled/copied."""
         state = self.__dict__.copy()
         state["_fused_engine"] = None
+        state["_epoch_sync"] = None
         return state
 
     def persistent(self, mode: bool = True) -> None:

@@ -24,6 +24,15 @@ dispatch floor — not the kernels — dominates (BENCH_r04: 6.2 ms dispatch flo
   hits, fallbacks, donation copies, bytes moved) surfaced through
   :func:`engine_report` and exported by ``bench.py`` so the win is
   driver-verified rather than asserted.
+- :class:`~torchmetrics_tpu.engine.epoch.EpochEngine` /
+  :class:`~torchmetrics_tpu.engine.epoch.CollectionEpoch` — the epoch-boundary
+  half: packed single-collective sync
+  (:class:`~torchmetrics_tpu.parallel.packing.PackedSyncPlan`: one metadata
+  gather + one collective per (role, dtype) buffer for ALL states of a metric
+  — or of every compute-group owner of a ``MetricCollection``) and cached
+  ``compute()`` / fused ``sync→reduce-fold→compute`` executables keyed by
+  state signature, with collectives-per-sync / bytes-moved / compute-retrace
+  counters riding the same :func:`engine_report` surface.
 
 Enablement is TPU-first: ``auto`` engages the engine when the default JAX
 backend is an accelerator and stays out of the way on CPU (where dispatch is
@@ -45,12 +54,15 @@ from torchmetrics_tpu.engine.config import (
     engine_enabled,
     set_engine_enabled,
 )
+from torchmetrics_tpu.engine.epoch import CollectionEpoch, EpochEngine
 from torchmetrics_tpu.engine.fusion import FusedUpdate
 from torchmetrics_tpu.engine.stats import EngineStats, engine_report, reset_engine_stats
 
 __all__ = [
+    "CollectionEpoch",
     "CompiledUpdate",
     "EngineStats",
+    "EpochEngine",
     "FusedUpdate",
     "engine_context",
     "engine_enabled",

@@ -1,0 +1,389 @@
+"""Fused epoch engine — packed sync and cached sync→compute executables.
+
+PR 1's update engine removed the per-step dispatch floor; the epoch boundary
+was still eager: one host collective per state tensor (and per list element)
+behind per-state metadata gathers, and a full Python re-trace of ``compute()``
+every epoch end. This module bounds the epoch boundary the same way the update
+engine bounded the step:
+
+- :class:`EpochEngine` (one per :class:`~torchmetrics_tpu.metric.Metric`):
+
+  * **Packed sync** — all of a metric's states ride one
+    :class:`~torchmetrics_tpu.parallel.packing.PackedSyncPlan`: at most one
+    metadata gather + one collective per (role, dtype) buffer, with the unpack
+    and every state's ``dist_reduce_fx`` fold compiled into ONE cached
+    executable keyed by the plan signature.
+  * **Cached compute** — ``compute()`` traces once per state signature into a
+    ``jax.jit`` executable (:func:`traced_compute` swaps traced states onto
+    the metric exactly like the update engine's ``traced_update``); repeated
+    epoch ends are a single cached dispatch, zero re-traces.
+  * **Fused sync→reduce-fold→compute** — when both are compilable, the fold
+    and the compute body lower into the SAME graph: epoch end is one metadata
+    gather + O(dtypes) collectives + one dispatch returning both the synced
+    states and the final value.
+
+- :class:`CollectionEpoch` (one per ``MetricCollection``): a single plan spans
+  every compute-group owner, so an N-metric collection syncs in O(dtypes)
+  collectives total instead of per-metric × per-state.
+
+Anything that cannot ride the packed/cached path — custom ``dist_sync_fn``,
+``compute_on_cpu``, host-object list states, untraceable computes — falls back
+to the eager path with the reason counted in :class:`EngineStats`
+(``fallback_reasons``), never silently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.engine.compiled import (
+    _FALLBACK,
+    _Ineligible,
+    _container_changed,
+    _is_jax_array,
+    holds_nested_metrics,
+)
+from torchmetrics_tpu.engine.stats import EngineStats
+from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError, all_gather_backbone
+
+#: sentinel: the packed sync succeeded but the compute half must run outside
+#: the fused graph (untraceable compute) — callers compute eagerly on the
+#: freshly synced states.
+NO_VALUE = object()
+
+
+def traced_compute(metric: Any, state: Dict[str, Any]) -> Any:
+    """Run ``metric``'s original compute body as ``state -> value`` (trace-safe).
+
+    Mirrors ``traced_update``: the metric's ``__dict__`` is snapshotted and
+    restored wholesale so a trace can never leak tracers onto the live object,
+    and a compute with side effects a cached executable would lose — rebinding
+    a state or non-state attribute, mutating a host container in place —
+    aborts compilation via :class:`_Ineligible` instead of silently diverging.
+    """
+    names = tuple(metric._defaults)
+    snapshot = dict(metric.__dict__)
+    containers = {
+        k: (list(v) if isinstance(v, list) else dict(v) if isinstance(v, dict) else set(v))
+        for k, v in snapshot.items()
+        if k not in names and isinstance(v, (list, dict, set))
+    }
+    try:
+        for k in names:
+            object.__setattr__(metric, k, state[k])
+        value = metric._raw_compute()
+        for k, v in metric.__dict__.items():
+            if k in names:
+                if v is not state[k]:
+                    raise _Ineligible(f"compute rebinds state {k!r}")
+                continue
+            if snapshot.get(k, _FALLBACK) is not v:
+                raise _Ineligible(f"compute writes non-state attribute {k!r}")
+            if k in containers and _container_changed(v, containers[k]):
+                raise _Ineligible(f"compute mutates non-state container {k!r} in place")
+        return value
+    finally:
+        metric.__dict__.clear()
+        metric.__dict__.update(snapshot)
+        for k, saved in containers.items():
+            live = snapshot[k]
+            if _container_changed(live, saved):
+                if isinstance(live, list):
+                    live[:] = saved
+                else:
+                    live.clear()
+                    live.update(saved)
+
+
+def _state_signature(state: Dict[str, Any]) -> Optional[Tuple]:
+    """Shape/dtype cache key over a (possibly list-valued) state dict."""
+    sig: List[Any] = []
+    for k, v in state.items():
+        if _is_jax_array(v):
+            sig.append((k, tuple(v.shape), str(v.dtype)))
+        elif isinstance(v, list):
+            if not all(_is_jax_array(x) for x in v):
+                return None
+            sig.append((k, "list", tuple((tuple(x.shape), str(x.dtype)) for x in v)))
+        else:
+            return None
+    return tuple(sig)
+
+
+def _collect_state(metric: Any) -> Optional[Dict[str, Any]]:
+    state: Dict[str, Any] = {}
+    for k in metric._defaults:
+        v = getattr(metric, k)
+        if _is_jax_array(v):
+            state[k] = v
+        elif isinstance(v, list) and all(_is_jax_array(x) for x in v):
+            state[k] = list(v)
+        else:
+            return None
+    return state
+
+
+def _world_size() -> int:
+    import jax
+
+    try:
+        return jax.process_count()
+    except Exception:  # noqa: BLE001 — un-initialized backend reads as world 1
+        return 1
+
+
+def _exchange(
+    plan: PackedSyncPlan, stats: EngineStats
+) -> Dict[str, Any]:
+    """Run the metadata exchange + buffer collectives for ``plan``.
+
+    One-process worlds skip the collectives entirely (the gathered view is the
+    local buffer with a world axis of 1) — packed sync then costs ZERO host
+    transfers, which is exactly the single-chip epoch cost the north star asks
+    for. Metadata validation errors propagate (fail loud on every rank).
+    """
+    meta = plan.metadata_local()
+    if meta is None:
+        plan.finalize(None)
+    elif plan.world_size == 1:
+        plan.finalize(meta[None, :])
+    else:
+        gathered_meta = all_gather_backbone(meta)
+        stats.sync_metadata_gathers += 1
+        plan.finalize(np.asarray(gathered_meta))
+    local = plan.pack()
+    gathered: Dict[str, Any] = {}
+    for key in sorted(local):  # deterministic collective order on every rank
+        buf = local[key]
+        if plan.world_size == 1:
+            gathered[key] = buf[None]
+            continue
+        gathered[key] = all_gather_backbone(buf)
+        stats.sync_collectives += 1
+        stats.sync_bytes_moved += int(getattr(buf, "nbytes", 0)) * plan.world_size
+    return gathered
+
+
+def _write_synced(metric: Any, states: Dict[str, Any], plan: PackedSyncPlan, owner: str) -> None:
+    for attr, val in states.items():
+        setattr(metric, attr, val)
+    for attr in plan.none_folded_attrs(owner):
+        metric._none_folded.add(attr)
+
+
+def _run_fold(
+    plan: PackedSyncPlan, gathered: Dict[str, Any], cache: Dict[Tuple, Any], stats: EngineStats
+) -> Optional[Dict[str, Dict[str, Any]]]:
+    """Dispatch the plan's fold through the signature-keyed executable cache.
+
+    Returns the folded ``{owner: {attr: value}}`` dict, or None when the fold
+    cannot trace (counted; a CACHED executable failing re-raises — that is a
+    real bug, not an eligibility miss). Shared by the per-metric and the
+    collection engines so the fallback/counter semantics cannot drift apart.
+    """
+    sig = plan.signature()
+    entry = cache.get(sig)
+    first = entry is None
+    if first:
+        import jax
+
+        entry = jax.jit(plan.make_fold())
+    try:
+        folded = entry(gathered)
+    except Exception as exc:  # noqa: BLE001 — an untraceable custom fold demotes
+        if not first:
+            raise
+        stats.fallback(f"sync:fold-trace-failed:{type(exc).__name__}")
+        return None
+    if first:
+        cache[sig] = entry
+        stats.sync_fold_traces += 1
+    return folded
+
+
+class EpochEngine:
+    """Packed-sync + cached-compute cache for ONE metric instance.
+
+    Created lazily by :meth:`Metric._epoch_engine`; excluded from
+    pickling/cloning (executables are rebuilt per process/instance).
+    """
+
+    def __init__(self, metric: Any) -> None:
+        self._metric = metric
+        self.stats = EngineStats("epoch:" + type(metric).__name__)
+        self._fold_cache: Dict[Tuple, Any] = {}
+        self._fused_cache: Dict[Tuple, Any] = {}
+        self._compute_cache: Dict[Tuple, Any] = {}
+        self._compute_ok = not holds_nested_metrics(metric) and "_raw_compute" in metric.__dict__
+
+    # ------------------------------------------------------------------ sync
+
+    def _plan(self, process_group: Optional[Sequence[int]]) -> Optional[PackedSyncPlan]:
+        try:
+            return PackedSyncPlan([("", self._metric)], _world_size(), process_group)
+        except PackingError as exc:
+            self.stats.fallback(f"sync:{exc}")
+            return None
+
+    def packed_sync(self, process_group: Optional[Sequence[int]] = None) -> bool:
+        """Fold-only packed sync; writes synced states onto the metric.
+
+        Returns True when handled; False requests the eager per-tensor path.
+        """
+        plan = self._plan(process_group)
+        if plan is None:
+            return False
+        gathered = _exchange(plan, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats)
+        if folded is None:
+            return False
+        _write_synced(self._metric, folded.get("", {}), plan, "")
+        self.stats.packed_syncs += 1
+        return True
+
+    def sync_and_compute(self, process_group: Optional[Sequence[int]] = None):
+        """The fused chain: packed exchange → one executable doing
+        unpack + reduce-fold + compute in a single graph.
+
+        Returns ``None`` when nothing was done (caller goes fully eager), or
+        ``(value,)`` after writing the synced states; ``value`` is
+        :data:`NO_VALUE` when the compute half must run eagerly on the synced
+        states (the sync half still rode the packed path).
+        """
+        m = self._metric
+        plan = self._plan(process_group)
+        if plan is None:
+            return None
+        gathered = _exchange(plan, self.stats)
+        sig = ("fused", plan.signature())
+        entry = self._fused_cache.get(sig)
+        if entry is _FALLBACK or not self._compute_ok:
+            return self._fold_then_no_value(plan, gathered)
+        first = entry is None
+        if first:
+            import jax
+
+            fold = plan.make_fold()
+
+            def fused(bufs):
+                states = fold(bufs).get("", {})
+                return states, traced_compute(m, states)
+
+            entry = jax.jit(fused)
+        try:
+            states, value = entry(gathered)
+        except Exception as exc:  # noqa: BLE001 — untraceable compute: sync still packed
+            if not first:
+                raise
+            self._fused_cache[sig] = _FALLBACK
+            reason = str(exc) if isinstance(exc, _Ineligible) else f"fused-trace-failed:{type(exc).__name__}"
+            self.stats.fallback(reason)
+            return self._fold_then_no_value(plan, gathered)
+        if first:
+            self._fused_cache[sig] = entry
+            self.stats.compute_traces += 1
+            self.stats.sync_fold_traces += 1
+        else:
+            self.stats.compute_cache_hits += 1
+        self.stats.compute_dispatches += 1
+        self.stats.packed_syncs += 1
+        _write_synced(m, states, plan, "")
+        return (value,)
+
+    def _fold_then_no_value(self, plan: PackedSyncPlan, gathered: Dict[str, Any]):
+        """Fold-only completion for an exchange whose compute half can't fuse."""
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats)
+        if folded is None:
+            return None
+        _write_synced(self._metric, folded.get("", {}), plan, "")
+        self.stats.packed_syncs += 1
+        return (NO_VALUE,)
+
+    # ------------------------------------------------------------------ compute
+
+    def cached_compute(self) -> Tuple[bool, Any]:
+        """Dispatch ``compute()`` through a cached executable.
+
+        Returns ``(True, value)`` when handled; ``(False, None)`` requests the
+        eager compute (reason counted).
+        """
+        m = self._metric
+        if not self._compute_ok:
+            self.stats.fallback("compute:nested-metric")
+            return False, None
+        if m.compute_on_cpu:
+            self.stats.fallback("compute:compute-on-cpu")
+            return False, None
+        state = _collect_state(m)
+        sig = _state_signature(state) if state is not None else None
+        if sig is None:
+            self.stats.fallback("compute:non-array-state")
+            return False, None
+        key = (sig, self._device_token(state))
+        entry = self._compute_cache.get(key)
+        if entry is _FALLBACK:
+            self.stats.fallback("compute:uncompilable-signature")
+            return False, None
+        first = entry is None
+        if first:
+            import jax
+
+            entry = jax.jit(lambda s: traced_compute(m, s))
+        try:
+            value = entry(state)
+        except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
+            if not first:
+                raise
+            self._compute_cache[key] = _FALLBACK
+            reason = str(exc) if isinstance(exc, _Ineligible) else f"compute-trace-failed:{type(exc).__name__}"
+            self.stats.fallback(reason)
+            return False, None
+        if first:
+            self._compute_cache[key] = entry
+            self.stats.compute_traces += 1
+        else:
+            self.stats.compute_cache_hits += 1
+        self.stats.compute_dispatches += 1
+        return True, value
+
+    @staticmethod
+    def _device_token(state: Dict[str, Any]) -> str:
+        import jax
+
+        for v in jax.tree_util.tree_leaves(state):
+            try:
+                return str(next(iter(v.devices())))
+            except Exception:  # noqa: BLE001
+                break
+        return ""
+
+
+class CollectionEpoch:
+    """One packed plan spanning every compute-group owner of a collection."""
+
+    def __init__(self, names: Sequence[str]) -> None:
+        self.names: List[str] = list(names)
+        self.stats = EngineStats("epoch:collection[" + ",".join(names) + "]")
+        self._fold_cache: Dict[Tuple, Any] = {}
+
+    def packed_sync(self, owners: Sequence[Tuple[str, Any]]) -> bool:
+        """Sync every owner's states in one exchange; True when handled.
+
+        On success each owner holds its synced (folded) states; the CALLER is
+        responsible for the pre-sync snapshots and ``_is_synced`` bookkeeping.
+        """
+        try:
+            plan = PackedSyncPlan(list(owners), _world_size(), None)
+        except PackingError as exc:
+            self.stats.fallback(f"sync:{exc}")
+            return False
+        gathered = _exchange(plan, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats)
+        if folded is None:
+            return False
+        for name, metric in owners:
+            _write_synced(metric, folded.get(name, {}), plan, name)
+        self.stats.packed_syncs += 1
+        return True

@@ -28,6 +28,15 @@ _COUNTER_FIELDS = (
     "bucketed_steps",  # steps that rode a shape bucket
     "bucket_pad_rows",  # total pad rows added across bucketed steps
     "bytes_moved",  # input + state bytes entering compiled dispatches
+    # --- epoch engine (engine/epoch.py): packed sync + cached compute ---
+    "packed_syncs",  # packed epoch syncs completed (vs eager per-tensor syncs)
+    "sync_collectives",  # buffer collectives issued across all packed syncs
+    "sync_metadata_gathers",  # metadata exchanges issued (0 for rank-invariant plans)
+    "sync_bytes_moved",  # bytes through packed-sync collectives (gathered view)
+    "sync_fold_traces",  # fold / fused sync→compute executables compiled
+    "compute_traces",  # compute executables compiled (retraces = growth after warmup)
+    "compute_dispatches",  # cached compute dispatches (incl. fused sync→compute)
+    "compute_cache_hits",  # compute dispatches served without a re-trace
 )
 
 

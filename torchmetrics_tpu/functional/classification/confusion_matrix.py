@@ -55,8 +55,14 @@ def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) ->
 
 
 def _bincount_2d(mapping: Array, weights: Array, n_bins: int) -> Array:
-    """Weighted deterministic bincount; negative indices are dropped."""
-    return jnp.zeros(n_bins, dtype=jnp.int32).at[mapping].add(weights.astype(jnp.int32), mode="drop")
+    """Weighted deterministic bincount; negative indices are dropped.
+
+    Thin alias over the shared in-graph scatter-add (``utilities/data._bincount``)
+    so every counting path lowers through the same single-scatter kernel.
+    """
+    from torchmetrics_tpu.utilities.data import _bincount
+
+    return _bincount(mapping, minlength=n_bins, weights=weights)
 
 
 # ------------------------------------------------------------------------------ binary

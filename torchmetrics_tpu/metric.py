@@ -149,6 +149,9 @@ class Metric:
         # per-instance compiled-step cache (engine/compiled.py), created lazily on
         # the first engine-enabled update; never pickled/cloned (rebuilt per process)
         self._engine = None
+        # per-instance epoch engine (engine/epoch.py): packed sync + cached
+        # compute executables; same lifecycle as _engine
+        self._epoch = None
         # dist_reduce_fx=None array states that currently hold a stacked
         # (shards, *default.shape) layout — tracked explicitly so folding never has
         # to guess from ndim (a state whose legitimate per-update shape is one rank
@@ -226,6 +229,29 @@ class Metric:
             self._forward_cache = self._forward_reduce_state_update(*args, **kwargs)
         return self._forward_cache
 
+    @contextmanager
+    def _batch_value_context(self) -> Generator:
+        """Shared sync/cache guard for forward's batch-value compute.
+
+        Both forward paths need the same dance: sync only when
+        ``dist_sync_on_step`` asks for it, never auto-unsync mid-forward, and
+        keep ``compute_on_cpu`` from moving the throwaway batch state to host —
+        then restore every flag and invalidate the computed cache. Previously
+        copied verbatim into both paths (and drifted once); one guard now.
+        """
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        _temp_compute_on_cpu = self.compute_on_cpu
+        self.compute_on_cpu = False
+        try:
+            yield
+        finally:
+            self._is_synced = False
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            self.compute_on_cpu = _temp_compute_on_cpu
+
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Safe two-``update`` forward path (reference ``metric.py:273-315``).
 
@@ -235,25 +261,13 @@ class Metric:
         self.update(*args, **kwargs)
         _update_count = self._update_count
 
-        self._to_sync = self.dist_sync_on_step
-        self._should_unsync = False
-        _temp_compute_on_cpu = self.compute_on_cpu
-        self.compute_on_cpu = False
-
-        cache = self._copy_state_refs()
-
-        self.reset()
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
-
-        self._restore_state_refs(cache)
-        self._update_count = _update_count
-
-        self._is_synced = False
-        self._should_unsync = True
-        self._to_sync = self.sync_on_compute
-        self._computed = None
-        self.compute_on_cpu = _temp_compute_on_cpu
+        with self._batch_value_context():
+            cache = self._copy_state_refs()
+            self.reset()
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
+            self._restore_state_refs(cache)
+            self._update_count = _update_count
 
         return batch_val
 
@@ -263,22 +277,11 @@ class Metric:
         _update_count = self._update_count
         self.reset()
 
-        self._to_sync = self.dist_sync_on_step
-        self._should_unsync = False
-        _temp_compute_on_cpu = self.compute_on_cpu
-        self.compute_on_cpu = False
-
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
-
-        self._update_count = _update_count + 1
-        self._reduce_states(global_state)
-
-        self._is_synced = False
-        self._should_unsync = True
-        self._to_sync = self.sync_on_compute
-        self._computed = None
-        self.compute_on_cpu = _temp_compute_on_cpu
+        with self._batch_value_context():
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
+            self._update_count = _update_count + 1
+            self._reduce_states(global_state)
 
         return batch_val
 
@@ -558,6 +561,21 @@ class Metric:
         if not should_sync or not is_distributed:
             return
 
+        if dist_sync_fn is None and self._packed_sync_allowed():
+            # fused epoch path: one metadata gather + O(dtypes) collectives for
+            # ALL states, fold compiled into one cached executable
+            snapshot = self._copy_state_refs()
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.sync"):
+                handled = self._epoch_engine().packed_sync(
+                    process_group=process_group or self.process_group
+                )
+            if handled:
+                self._cache = snapshot
+                self._is_synced = True
+                return
+        elif dist_sync_fn is not None and self._epoch_enabled():
+            self._epoch_engine().stats.fallback("sync:custom-dist-sync-fn")
+
         if dist_sync_fn is None:
             dist_sync_fn = gather_all_tensors
 
@@ -622,18 +640,77 @@ class Metric:
 
     def _engine_step(self, args: tuple, kwargs: Dict[str, Any]) -> bool:
         """Route one update through the fused engine; False = run eagerly."""
-        if self.compiled_update is False:
+        if not self._epoch_enabled():
             return False
-        if self.compiled_update is None:
-            from torchmetrics_tpu.engine.config import engine_enabled
-
-            if not engine_enabled():
-                return False
         if self._engine is None:
             from torchmetrics_tpu.engine.compiled import CompiledUpdate
 
             self._engine = CompiledUpdate(self)
         return self._engine.step(args, kwargs)
+
+    def _epoch_enabled(self) -> bool:
+        """Shared engine-enablement resolution (per-metric kwarg > overrides > auto)."""
+        if self.compiled_update is False:
+            return False
+        if self.compiled_update is None:
+            from torchmetrics_tpu.engine.config import engine_enabled
+
+            return engine_enabled()
+        return True
+
+    def _epoch_engine(self):
+        """Lazy per-instance epoch engine (engine/epoch.py)."""
+        if self._epoch is None:
+            from torchmetrics_tpu.engine.epoch import EpochEngine
+
+            self._epoch = EpochEngine(self)
+        return self._epoch
+
+    def _packed_sync_allowed(self) -> bool:
+        """Whether sync may ride the packed single-collective plan."""
+        if not self._epoch_enabled():
+            return False
+        if self.compute_on_cpu:
+            # list states live on host by request; the packed buffers would
+            # drag them back through the device — eager path, counted
+            self._epoch_engine().stats.fallback("sync:compute-on-cpu")
+            return False
+        return True
+
+    def _epoch_sync_for_compute(self) -> Optional[tuple]:
+        """The fused sync→reduce-fold→compute chain for this compute() call.
+
+        Returns ``None`` when ineligible (the caller runs the classic
+        sync_context path, whose ``sync`` may still ride the packed plan), or a
+        1-tuple ``(value,)`` after the packed exchange has run and the synced
+        states are written — ``value`` is ``engine.epoch.NO_VALUE`` when only
+        the sync half fused (compute runs eagerly on the synced states).
+        """
+        if self._is_synced or not self._to_sync:
+            return None
+        if self.dist_sync_fn is not None or self.compute_on_cpu:
+            return None
+        da = self.distributed_available_fn
+        if not (callable(da) and da()):
+            return None
+        if not self._epoch_enabled():
+            return None
+        eng = self._epoch_engine()
+        snapshot = self._copy_state_refs()
+        res = eng.sync_and_compute(process_group=self.process_group)
+        if res is None:
+            return None
+        self._cache = snapshot
+        self._is_synced = True
+        return res
+
+    def _engine_compute(self, compute: Callable, args: tuple, kwargs: Dict[str, Any]) -> Any:
+        """Dispatch compute through the cached executable when possible."""
+        if not args and not kwargs and self._epoch_enabled():
+            handled, value = self._epoch_engine().cached_compute()
+            if handled:
+                return value
+        return compute(*args, **kwargs)
 
     def _move_list_states_to_cpu(self) -> None:
         """Move list states to host memory to free HBM (reference ``metric.py:442-447``)."""
@@ -644,6 +721,8 @@ class Metric:
                 setattr(self, key, [jax.device_put(v, cpu) for v in current_val])
 
     def _wrap_compute(self, compute: Callable) -> Callable:
+        self._raw_compute = compute  # unwrapped body — what the epoch engine traces
+
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
             if self._update_count == 0:
@@ -655,12 +734,30 @@ class Metric:
             if self._computed is not None:
                 return self._computed
 
-            with self.sync_context(
-                dist_sync_fn=self.dist_sync_fn,
-                should_sync=self._to_sync,
-                should_unsync=self._should_unsync,
-            ), jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
-                value = _squeeze_if_scalar(compute(*args, **kwargs))
+            fused = None
+            if not args and not kwargs:
+                # fused epoch chain: packed exchange + one executable doing
+                # unpack → dist_reduce_fx folds → compute in a single graph
+                fused = self._epoch_sync_for_compute()
+            if fused is not None:
+                from torchmetrics_tpu.engine.epoch import NO_VALUE
+
+                try:
+                    value = fused[0]
+                    if value is NO_VALUE:  # sync fused, compute runs on synced states
+                        with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                            value = self._engine_compute(compute, args, kwargs)
+                    value = _squeeze_if_scalar(value)
+                finally:
+                    if self._is_synced and self._should_unsync:
+                        self.unsync()
+            else:
+                with self.sync_context(
+                    dist_sync_fn=self.dist_sync_fn,
+                    should_sync=self._to_sync,
+                    should_unsync=self._should_unsync,
+                ), jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                    value = _squeeze_if_scalar(self._engine_compute(compute, args, kwargs))
 
             if self.compute_with_cache:
                 self._computed = value
@@ -722,7 +819,7 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Drop wrapped bound methods + compiled executables for pickling (reference ``metric.py:644-648``)."""
-        drop = ("update", "compute", "_update_signature", "_raw_update", "_engine")
+        drop = ("update", "compute", "_update_signature", "_raw_update", "_raw_compute", "_engine", "_epoch")
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -731,6 +828,7 @@ class Metric:
         self.__dict__.setdefault("_none_folded", set())
         self.__dict__.setdefault("compiled_update", None)
         self._engine = None  # executables are per-process/per-instance; rebuilt lazily
+        self._epoch = None
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]

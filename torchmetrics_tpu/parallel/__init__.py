@@ -1,5 +1,6 @@
 """TPU-native communication backend (mesh collectives; SURVEY §5.8)."""
 
+from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError
 from torchmetrics_tpu.parallel.sync import (
     EvalMesh,
     axis_gather,
@@ -13,6 +14,8 @@ from torchmetrics_tpu.parallel.sync import (
 
 __all__ = [
     "EvalMesh",
+    "PackedSyncPlan",
+    "PackingError",
     "axis_gather",
     "axis_max",
     "axis_mean",

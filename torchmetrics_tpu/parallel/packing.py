@@ -1,0 +1,474 @@
+"""Packed single-collective state sync — the epoch-boundary communication plan.
+
+The eager sync path (``Metric._sync_dist``) issues one host collective PER state
+tensor — and one per list-state element — each behind its own metadata gather.
+At epoch end a 4-metric stat-scores collection therefore pays ≥ 8 collectives
+for a few KB of state. This module replaces that with a bounded plan:
+
+1. **One metadata exchange** (when needed at all): a single fixed-shape int32
+   gather carrying, for every dynamic state, its leading-dim size / element
+   count plus a shape fingerprint. Plans whose states are all fixed-shape
+   (every shape equals its registered default's — the common
+   sum/mean/max/min case) are *rank-invariant* and skip the exchange entirely.
+2. **One all-gather per (role, dtype) buffer**: every sum/mean-reduced state
+   packs into a flat ``reduce:{dtype}`` buffer (the gather-then-sum fold is the
+   ``psum`` of the host world; on a mesh backbone the same buffer rides an
+   actual ``psum``), and everything else — max/min, raw ``None``-stacked
+   arrays, custom folds, ragged ``cat`` states and list-state elements — packs
+   into a ``gather:{dtype}`` buffer, ragged segments padded to the world max
+   known from the metadata.
+3. **One fold graph**: unpacking + every state's ``dist_reduce_fx`` fold lower
+   into a single jittable function (:meth:`PackedSyncPlan.make_fold`), cached
+   by the caller per :meth:`PackedSyncPlan.signature`.
+
+A plan can span several metrics (``MetricCollection`` compute-group owners), so
+an entire collection syncs in O(dtypes) collectives regardless of how many
+metrics and states are live.
+
+Eligibility is explicit: anything the pack cannot express — host-object list
+elements, list states with a non-``cat``/``None`` reduction, states that are
+not arrays — raises :class:`PackingError` at plan build and the caller falls
+back to the eager per-tensor path (counted, never silent). Cross-rank layout
+violations that would deadlock the eager path (ragged list counts, mismatched
+element shapes) are detected from the metadata exchange and fail loud on every
+rank with the same errors the eager guard raises.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.utilities.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = ["PackedSyncPlan", "PackingError", "all_gather_backbone"]
+
+_KIND_BY_FN = {
+    dim_zero_sum: "sum",
+    dim_zero_mean: "mean",
+    dim_zero_max: "max",
+    dim_zero_min: "min",
+}
+
+# metadata entry tags (first int of nothing — entries are positional, tags are
+# implicit in the spec order; kept here as documentation of the 2-int layout)
+_META_INTS_PER_ENTRY = 2
+
+
+def _is_array(x: Any) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    return isinstance(x, (jax.Array, jnp.ndarray)) and not isinstance(x, (list, tuple))
+
+
+def _fingerprint(dims: Sequence[int]) -> int:
+    """Process-stable digest of a dim sequence (crc32, masked to positive int32)."""
+    return zlib.crc32(np.asarray(list(dims), dtype=np.int64).tobytes()) & 0x7FFFFFFF
+
+
+def all_gather_backbone(x: Any) -> Any:
+    """The host collective: one ``process_allgather`` returning ``(world, ...)``.
+
+    Isolated here so tests and benches can monkeypatch a fake world, and so a
+    future mesh backbone (``axis_gather``/``axis_sum`` inside ``shard_map``)
+    can slot in without touching the plan logic.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    return jnp.asarray(multihost_utils.process_allgather(x, tiled=False))
+
+
+class PackingError(Exception):
+    """This state layout cannot ride the packed plan — fall back to eager sync."""
+
+
+class _Spec:
+    """One state's slot in the packed buffers."""
+
+    __slots__ = (
+        "owner", "attr", "kind", "fold_fn", "dtype", "shape", "elem_shapes",
+        "group", "offset", "size", "world_dim0", "pad_to", "needs_meta",
+        "was_list", "packed_value",
+    )
+
+    def __init__(self, owner: str, attr: str, kind: str, dtype: str, fold_fn: Optional[Callable] = None):
+        self.owner = owner
+        self.attr = attr
+        self.kind = kind  # sum | mean | max | min | none-array | custom | cat | none-list
+        self.fold_fn = fold_fn  # custom callable folds only
+        self.dtype = dtype
+        self.shape: Tuple[int, ...] = ()
+        self.elem_shapes: Tuple[Tuple[int, ...], ...] = ()  # none-list only
+        self.group = ""
+        self.offset = 0
+        self.size = 0  # flat length of this spec's segment (incl. ragged padding)
+        self.world_dim0: Tuple[int, ...] = ()  # cat only: per-MEMBER true dim0
+        self.pad_to = 0  # cat only: FULL-WORLD max dim0 (every rank packs the collective)
+        self.needs_meta = False
+        self.was_list = False
+        self.packed_value = None  # cat lists: concatenated once at build time
+
+
+class PackedSyncPlan:
+    """Sync plan over one or more metrics' registered states.
+
+    Usage (the epoch engine drives this)::
+
+        plan = PackedSyncPlan([(name, metric), ...], world_size, process_group)
+        meta = plan.metadata_local()            # None when rank-invariant
+        plan.finalize(world_meta)               # world_meta None when meta was
+        local = plan.pack()                     # {buffer_key: flat device array}
+        gathered = {k: backbone(v) for ...}     # ONE collective per buffer
+        fold = jax.jit(plan.make_fold())        # cached by plan.signature()
+        states = fold(gathered)                 # {owner: {attr: synced value}}
+    """
+
+    def __init__(
+        self,
+        metrics: Sequence[Tuple[str, Any]],
+        world_size: int,
+        process_group: Optional[Sequence[int]] = None,
+    ) -> None:
+        if world_size < 1:
+            raise PackingError("world size < 1")
+        self.world_size = int(world_size)
+        self.members: Tuple[int, ...] = (
+            tuple(range(self.world_size)) if process_group is None else tuple(int(i) for i in process_group)
+        )
+        self._metrics = list(metrics)
+        self._finalized = False
+        self._group_sizes: Dict[str, int] = {}
+        self.specs: List[_Spec] = []
+        self.empty_lists: List[Tuple[str, str]] = []  # cat/none lists empty on this rank
+        self._build()
+
+    # ------------------------------------------------------------------ build
+
+    def _build(self) -> None:
+        import jax.numpy as jnp
+
+        for owner, metric in self._metrics:
+            for attr, red in metric._reductions.items():
+                val = getattr(metric, attr)
+                default = metric._defaults[attr]
+                if isinstance(default, list):
+                    if red is dim_zero_cat or red is None:
+                        self._add_list_spec(owner, metric, attr, red, val)
+                    else:
+                        raise PackingError(f"list state {attr!r} with non-cat reduction")
+                    continue
+                if not _is_array(val):
+                    raise PackingError(f"state {attr!r} is not an array")
+                kind = _KIND_BY_FN.get(red)
+                fold_fn = None
+                if kind is None:
+                    if red is dim_zero_cat:
+                        kind = "cat"
+                    elif red is None:
+                        kind = "none-array"
+                    elif callable(red):
+                        kind, fold_fn = "custom", red
+                    else:
+                        raise PackingError(f"unsupported reduction for state {attr!r}")
+                spec = _Spec(owner, attr, kind, str(val.dtype), fold_fn)
+                spec.shape = tuple(int(d) for d in val.shape)
+                spec.size = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
+                if kind == "cat":
+                    # dim 0 may differ per rank; trailing dims must agree
+                    if not spec.shape:
+                        spec.shape = (1,)
+                        spec.size = 1
+                    spec.needs_meta = True
+                else:
+                    # non-cat folds need equal shapes on every rank (the eager
+                    # path's jnp.stack has the same requirement); a state that
+                    # has drifted from its registered default's shape gets a
+                    # verification entry in the metadata exchange
+                    spec.needs_meta = tuple(getattr(default, "shape", ())) != spec.shape
+                spec.group = ("reduce:" if kind in ("sum", "mean") else "gather:") + spec.dtype
+                self.specs.append(spec)
+
+    def _add_list_spec(self, owner: str, metric: Any, attr: str, red: Any, val: Any) -> None:
+        import jax.numpy as jnp
+
+        elements = val if isinstance(val, list) else [val]
+        if not all(_is_array(x) for x in elements):
+            raise PackingError(f"list state {attr!r} holds host objects")
+        if red is dim_zero_cat:
+            if not elements:
+                self.empty_lists.append((owner, attr))
+                # still participates in the metadata exchange via a zero-row
+                # entry so mixed emptiness across ranks fails loud
+                spec = _Spec(owner, attr, "cat", "", None)
+                spec.shape = (0,)
+                spec.size = 0
+                spec.needs_meta = True
+                spec.was_list = True
+                self.specs.append(spec)
+                return
+            cat = dim_zero_cat(elements)
+            spec = _Spec(owner, attr, "cat", str(cat.dtype), None)
+            spec.shape = tuple(int(d) for d in cat.shape)
+            spec.size = int(np.prod(spec.shape, dtype=np.int64))
+            spec.needs_meta = True
+            spec.was_list = True
+            spec.packed_value = cat  # concatenated ONCE; pack() reuses it
+            spec.group = "gather:" + spec.dtype
+            self.specs.append(spec)
+            return
+        # None-reduced list: positional per-element semantics, equal counts and
+        # per-position shapes required on every rank (the eager guard's rule)
+        spec = _Spec(owner, attr, "none-list", str(elements[0].dtype) if elements else "", None)
+        spec.elem_shapes = tuple(tuple(int(d) for d in e.shape) for e in elements)
+        if elements and any(str(e.dtype) != spec.dtype for e in elements):
+            raise PackingError(f"list state {attr!r} mixes element dtypes")
+        spec.size = int(sum(np.prod(s, dtype=np.int64) if s else 1 for s in spec.elem_shapes))
+        spec.needs_meta = True
+        spec.was_list = True
+        if elements:
+            spec.group = "gather:" + spec.dtype
+        self.specs.append(spec)
+
+    # ------------------------------------------------------------------ metadata
+
+    @property
+    def rank_invariant(self) -> bool:
+        """True when every shape is provably identical on all ranks — the
+        metadata exchange is skipped entirely (zero extra collectives)."""
+        return not any(s.needs_meta for s in self.specs)
+
+    def metadata_local(self) -> Optional[np.ndarray]:
+        """Fixed-shape int32 probe covering every dynamic state, or None."""
+        entries: List[int] = []
+        for s in self.specs:
+            if not s.needs_meta:
+                continue
+            if s.kind == "cat":
+                dim0 = s.shape[0] if s.size else 0
+                entries += [dim0, _fingerprint(s.shape[1:]) if s.size else 0]
+            elif s.kind == "none-list":
+                dims: List[int] = []
+                for es in s.elem_shapes:
+                    dims.append(len(es))
+                    dims.extend(es)
+                entries += [len(s.elem_shapes), _fingerprint(dims)]
+            else:  # static-shape verification entry
+                entries += [s.size, _fingerprint(s.shape)]
+        if not entries:
+            return None
+        return np.asarray(entries, dtype=np.int32)
+
+    def finalize(self, world_meta: Optional[np.ndarray]) -> None:
+        """Validate the exchanged metadata and freeze buffer offsets.
+
+        ``world_meta`` is the gathered ``(world, n_entries)`` probe (None when
+        :meth:`metadata_local` returned None). Raises
+        :class:`~torchmetrics_tpu.utilities.exceptions.TorchMetricsUserError`
+        for layouts that would deadlock/corrupt the eager path — symmetric on
+        every rank, since every rank sees the same world metadata.
+        """
+        if world_meta is not None:
+            world_meta = np.asarray(world_meta)
+            idx = 0
+            for s in self.specs:
+                if not s.needs_meta:
+                    continue
+                # layout validation runs over the FULL world: every rank —
+                # sub-world member or not — enters the same buffer collectives,
+                # so a layout mismatch anywhere wedges everyone
+                counts = world_meta[:, idx]
+                prints = world_meta[:, idx + 1]
+                idx += _META_INTS_PER_ENTRY
+                if s.kind == "cat":
+                    nonzero = prints[counts > 0]
+                    if nonzero.size and (nonzero.max() != nonzero.min()):
+                        raise TorchMetricsUserError(
+                            f"Cannot sync state `{s.attr}`: processes hold mismatched"
+                            f" trailing shapes for the cat-reduced state (shape"
+                            f" fingerprints {prints.tolist()})."
+                        )
+                    if not s.group and counts.max() > 0:
+                        # empty cat LIST: the element dtype (hence the buffer
+                        # layout) is unknowable on this rank while others hold rows
+                        raise TorchMetricsUserError(
+                            f"Cannot sync list state `{s.attr}`: processes hold differing"
+                            f" element counts {counts.tolist()} — ranks with fewer elements"
+                            " would skip collectives the rest enter and deadlock the"
+                            " world. Ensure every process sees the same number of"
+                            " updates before compute(), or skip syncing"
+                            " (sync_on_compute=False) for ragged epochs."
+                        )
+                    s.world_dim0 = tuple(int(counts[i]) for i in self.members)
+                    s.pad_to = int(counts.max())  # non-members pack the collective too
+                elif s.kind == "none-list":
+                    if counts.max() != counts.min():
+                        raise TorchMetricsUserError(
+                            f"Cannot sync list state `{s.attr}`: processes hold differing"
+                            f" element counts {counts.tolist()} — ranks with fewer elements"
+                            " would skip collectives the rest enter and deadlock the"
+                            " world. Ensure every process sees the same number of"
+                            " updates before compute(), or skip syncing"
+                            " (sync_on_compute=False) for ragged epochs."
+                        )
+                    if counts.max() > 0 and prints.max() != prints.min():
+                        raise TorchMetricsUserError(
+                            f"Cannot sync list state `{s.attr}`: processes hold equal"
+                            f" element counts but mismatched per-element shapes"
+                            f" (shape fingerprints {prints.tolist()}). Positional"
+                            " collectives over a None-reduced list state require"
+                            " identical per-position shapes on every rank."
+                        )
+                else:  # static verification
+                    if counts.max() != counts.min() or prints.max() != prints.min():
+                        raise TorchMetricsUserError(
+                            f"Cannot sync state `{s.attr}`: processes hold mismatched"
+                            f" shapes (sizes {counts.tolist()}, fingerprints"
+                            f" {prints.tolist()}); non-cat reductions require identical"
+                            " state shapes on every rank."
+                        )
+        # pad ragged cat segments to the FULL-WORLD max and freeze offsets
+        offsets: Dict[str, int] = {}
+        for s in self.specs:
+            if s.kind == "cat" and s.pad_to:
+                trailing = int(np.prod(s.shape[1:], dtype=np.int64)) if len(s.shape) > 1 else 1
+                s.size = s.pad_to * trailing
+            if not s.group:
+                continue
+            s.offset = offsets.get(s.group, 0)
+            offsets[s.group] = s.offset + s.size
+        self._group_sizes = dict(offsets)
+        self._finalized = True
+
+    # ------------------------------------------------------------------ pack
+
+    def buffer_keys(self) -> List[str]:
+        return sorted(self._group_sizes)
+
+    def pack(self) -> Dict[str, Any]:
+        """Concatenate every local state into its flat per-(role, dtype) buffer."""
+        import jax.numpy as jnp
+
+        if not self._finalized:
+            raise RuntimeError("finalize() must run before pack()")
+        segments: Dict[str, List[Any]] = {k: [] for k in self._group_sizes}
+        by_owner = dict(self._metrics)
+        for s in self.specs:
+            if not s.group or s.size == 0:
+                continue
+            val = getattr(by_owner[s.owner], s.attr)
+            if s.kind == "none-list":
+                flat = jnp.concatenate([jnp.ravel(e) for e in val]) if val else jnp.zeros((0,))
+            elif s.kind == "cat":
+                arr = s.packed_value if s.was_list else jnp.asarray(val)
+                if arr.ndim == 0:
+                    arr = arr.reshape(1)
+                flat = jnp.ravel(arr)
+                if flat.size < s.size:  # ragged: pad to the world max
+                    flat = jnp.pad(flat, (0, s.size - flat.size))
+            else:
+                flat = jnp.ravel(jnp.asarray(val))
+            segments[s.group].append(flat)
+        return {k: jnp.concatenate(v) for k, v in segments.items() if v}
+
+    # ------------------------------------------------------------------ fold
+
+    def signature(self) -> Tuple:
+        """Cache key for the fold executable: full static layout + world geometry."""
+        return (
+            self.world_size,
+            self.members,
+            tuple(sorted(self._group_sizes.items())),
+            tuple(
+                (
+                    s.owner, s.attr, s.kind, s.dtype, s.shape, s.elem_shapes,
+                    s.group, s.offset, s.size, s.world_dim0, s.was_list, s.fold_fn,
+                )
+                for s in self.specs
+            ),
+            tuple(self.empty_lists),
+        )
+
+    def make_fold(self) -> Callable[[Dict[str, Any]], Dict[str, Dict[str, Any]]]:
+        """Pure ``gathered buffers -> {owner: {attr: synced value}}`` fold.
+
+        Jittable: every slice boundary is a static Python int from the plan, so
+        the unpack + every state's ``dist_reduce_fx`` fold lower into one XLA
+        graph. The caller jits and caches it per :meth:`signature`.
+        """
+        if not self._finalized:
+            raise RuntimeError("finalize() must run before make_fold()")
+        specs = list(self.specs)
+        members = list(self.members)
+        empty = list(self.empty_lists)
+
+        def fold(gathered: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+            import jax.numpy as jnp
+
+            out: Dict[str, Dict[str, Any]] = {}
+            for s in specs:
+                dest = out.setdefault(s.owner, {})
+                if s.kind == "cat" and (not s.group or (s.world_dim0 and max(s.world_dim0) == 0)):
+                    # empty on every rank: lists stay [], arrays keep a 0-row shape
+                    dest[s.attr] = (
+                        [] if s.was_list or not s.group
+                        else jnp.zeros((0,) + s.shape[1:], dtype=s.dtype)
+                    )
+                    continue
+                if s.kind == "none-list" and not s.elem_shapes:
+                    dest[s.attr] = []
+                    continue
+                seg = gathered[s.group][:, s.offset : s.offset + s.size]
+                seg = seg[jnp.asarray(members)] if members != list(range(self.world_size)) else seg
+                if s.kind in ("sum", "mean", "max", "min", "none-array", "custom"):
+                    stacked = seg.reshape((len(members),) + s.shape)
+                    if s.kind == "sum":
+                        dest[s.attr] = stacked.sum(axis=0)
+                    elif s.kind == "mean":
+                        dest[s.attr] = stacked.mean(axis=0)
+                    elif s.kind == "max":
+                        dest[s.attr] = stacked.max(axis=0)
+                    elif s.kind == "min":
+                        dest[s.attr] = stacked.min(axis=0)
+                    elif s.kind == "none-array":
+                        dest[s.attr] = stacked
+                    else:
+                        dest[s.attr] = s.fold_fn(stacked)
+                elif s.kind == "cat":
+                    trailing = s.shape[1:]
+                    tsize = int(np.prod(trailing, dtype=np.int64)) if trailing else 1
+                    dims = s.world_dim0 or (s.shape[0],) * len(members)
+                    parts = [
+                        seg[r, : dims[r] * tsize].reshape((dims[r],) + trailing)
+                        for r in range(len(members))
+                        if dims[r]
+                    ]
+                    dest[s.attr] = jnp.concatenate(parts, axis=0)
+                else:  # none-list: element-major interleave, eager-path order
+                    elems: List[Any] = []
+                    off = 0
+                    for es in s.elem_shapes:
+                        esize = int(np.prod(es, dtype=np.int64)) if es else 1
+                        for r in range(len(members)):
+                            elems.append(seg[r, off : off + esize].reshape(es))
+                        off += esize
+                    dest[s.attr] = elems
+            for owner, attr in empty:
+                out.setdefault(owner, {}).setdefault(attr, [])
+            return out
+
+        return fold
+
+    def none_folded_attrs(self, owner: str) -> List[str]:
+        """Attrs whose synced value carries a new leading shard axis."""
+        return [s.attr for s in self.specs if s.owner == owner and s.kind == "none-array"]

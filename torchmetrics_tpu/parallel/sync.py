@@ -95,7 +95,9 @@ def _simple_gather_all_tensors(result: Array, group: Any, world_size: int) -> Li
     return [jnp.asarray(gathered[i]) for i in range(world_size)]
 
 
-def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
+def gather_all_tensors(
+    result: Array, group: Optional[Any] = None, assume_equal_shapes: bool = False
+) -> List[Array]:
     """Gather one (possibly ragged along dim 0) array from every process.
 
     Mirrors reference ``utilities/distributed.py:96-146``: gather shapes first; if all
@@ -107,6 +109,14 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     defining a sub-world: the gather still rides the full-world collective (DCN
     bandwidth is the same), but only the group's members are returned, so reductions
     see exactly the sub-world state.
+
+    ``assume_equal_shapes`` skips the shape-metadata exchange entirely when the
+    caller can prove the shape is rank-invariant (e.g. a ``dist_sync_fn``
+    wrapper syncing only fixed-shape states; the packed-sync plan reaches the
+    same effect through its own rank-invariance analysis in
+    ``parallel/packing.py``). Scalars skip it unconditionally: a 0-d array has
+    exactly one possible shape, so the old path's metadata gather bought
+    nothing.
     """
     if not jit_distributed_available():
         return [result]
@@ -115,6 +125,10 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     world_size = jax.process_count()
     members = list(range(world_size)) if group is None else [int(i) for i in group]
     result = jnp.asarray(result)
+
+    if assume_equal_shapes or result.ndim == 0:
+        gathered = _simple_gather_all_tensors(result, group, world_size)
+        return [gathered[i] for i in members]
 
     local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
     all_shapes = multihost_utils.process_allgather(local_shape, tiled=False)

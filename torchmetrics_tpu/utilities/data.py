@@ -135,16 +135,33 @@ def _squeeze_if_scalar(data: Any) -> Any:
     return apply_to_collection(data, (jnp.ndarray, jax.Array), _squeeze_scalar_element_tensor)
 
 
-def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
-    """Deterministic bincount as one scatter-add.
+def _bincount(x: Array, minlength: Optional[int] = None, weights: Optional[Array] = None) -> Array:
+    """Deterministic (optionally weighted) bincount as one scatter-add.
 
-    The reference needs a loop fallback on XLA/MPS/deterministic-CUDA
-    (``data.py:211-241``); on TPU ``zeros.at[x].add(1)`` is already deterministic and
-    compiles to a single fused scatter. ``minlength`` must be static for XLA.
+    The reference needs a Python-loop fallback on XLA/MPS/deterministic-CUDA
+    (``data.py:211-241``); here bincount is always ``zeros.at[x].add(...)`` — one
+    fused scatter XLA lowers deterministically — so confusion-matrix and
+    histogram updates stay in-graph instead of O(bins) host iterations.
+    Negative / out-of-range indices are dropped (``mode="drop"``), which is what
+    the ignore-index masking upstream relies on.
+
+    ``minlength`` must be static for XLA. Omitting it requires reading
+    ``max(x)`` on the host, which cannot happen under a trace — inside ``jit``
+    (or the fused update engine) pass the bin count explicitly.
     """
     if minlength is None:
+        if isinstance(x, jax.core.Tracer):
+            raise ValueError(
+                "_bincount under jit/trace requires a static `minlength`; deriving it"
+                " from max(x) needs a host readback the graph cannot contain."
+            )
         minlength = int(jnp.max(x)) + 1 if x.size else 1
-    return jnp.zeros(minlength, dtype=jnp.int32).at[x].add(1, mode="drop")
+    updates = jnp.ones_like(x, dtype=jnp.int32) if weights is None else weights.astype(jnp.int32)
+    # negative indices would WRAP (jax .at[] keeps numpy indexing semantics);
+    # zero their updates so masked/ignored entries truly drop, matching the
+    # mode="drop" treatment of too-large indices
+    updates = jnp.where(x < 0, 0, updates)
+    return jnp.zeros(minlength, dtype=jnp.int32).at[x].add(updates, mode="drop")
 
 
 def _cumsum(x: Array, dim: int = 0) -> Array:
