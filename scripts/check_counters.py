@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Counter-regression gate: diff a fresh ``bench.py --smoke`` against the envelope.
+
+The engine's perf claims are recorded counters, not timings — dispatches per
+step, collectives per sync, retraces after warmup, host transfers. Timings vary
+with the machine; the counters must not. This gate re-runs the smoke bench (or
+reads an existing output via ``--bench-json``), extracts the counter envelope,
+and fails CI when any counter regresses past the committed baseline
+(``BENCH_r07.json`` by default) or violates an absolute invariant:
+
+- ``fused_dispatches_per_step``   <= baseline (one dispatch per collection step)
+- ``retraces_after_warmup``       <= baseline (0: warm loop never recompiles)
+- ``packed_collectives_per_sync`` <= baseline (O(dtypes), not O(states))
+- ``packed_metadata_gathers_per_sync`` <= baseline
+- ``epoch_compute_retraces_after_warmup`` <= baseline (0)
+- ``parity_ok``                   is true (packed sync == eager sync values)
+- ``host_transfers`` / ``epoch_host_transfers`` == 0 — the engine + epoch
+  scenarios run under the diag STRICT transfer guard; any unsanctioned
+  device→host readback in the hot loop either raises (failing the scenario)
+  or lands in these counters
+- ``retraces_uncaused`` / ``epoch_retraces_uncaused`` == 0 — every warm-loop
+  retrace in the flight recorder must carry an attributed cause
+- ``recorder_overhead_pct``       < 2.0 — the flight recorder's bound on the
+  engine scenario (per-event record cost x events/step vs step time)
+
+Counters ABSENT from an older baseline fall back to their absolute bound, so
+the gate tightens automatically as the envelope gains fields. Exit code 0 =
+all green; 1 = regression (each violation printed); 2 = bench run itself broke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (scenario, counter, kind, absolute_bound)
+#   kind "max": fresh <= max(baseline, absolute)   — counted regressions
+#   kind "abs": fresh <= absolute                  — invariants, baseline-independent
+#   kind "true": fresh must be truthy
+_CHECKS = (
+    ("engine", "fused_dispatches_per_step", "max", 1.0),
+    ("engine", "retraces_after_warmup", "max", 0),
+    ("engine", "eager_fallbacks", "max", 0),
+    ("engine", "host_transfers", "abs", 0),
+    ("engine", "retraces_uncaused", "abs", 0),
+    ("engine", "recorder_overhead_pct", "abs", 2.0),
+    ("epoch", "packed_collectives_per_sync", "max", 2),
+    ("epoch", "packed_metadata_gathers_per_sync", "max", 1),
+    ("epoch", "epoch_compute_retraces_after_warmup", "max", 0),
+    ("epoch", "parity_ok", "true", None),
+    ("epoch", "epoch_host_transfers", "abs", 0),
+    ("epoch", "epoch_retraces_uncaused", "abs", 0),
+)
+
+_TOL = 1e-6  # float slop for per-step ratios
+
+
+def _run_smoke() -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"bench --smoke produced no JSON (rc={proc.returncode}): {proc.stderr[-500:]!r}")
+
+
+def check(fresh: dict, baseline: dict) -> int:
+    failures = []
+    rows = []
+    statuses = fresh.get("statuses", {})
+    for scenario in ("engine", "epoch"):
+        status = statuses.get(scenario, "missing")
+        if status != "ok":
+            failures.append(f"scenario {scenario!r} did not complete: {status}")
+    f_extras = fresh.get("extras", {})
+    b_extras = baseline.get("extras", {})
+    for scenario, counter, kind, absolute in _CHECKS:
+        got = f_extras.get(scenario, {}).get(counter)
+        base = b_extras.get(scenario, {}).get(counter)
+        if got is None:
+            failures.append(f"{scenario}.{counter}: missing from the fresh run")
+            continue
+        if kind == "true":
+            ok = bool(got)
+            bound = "true"
+        elif kind == "abs" or base is None:
+            ok = float(got) <= float(absolute) + _TOL
+            bound = f"<= {absolute}"
+        else:  # max: no worse than the committed envelope (or the absolute floor)
+            limit = max(float(base), float(absolute))
+            ok = float(got) <= limit + _TOL
+            bound = f"<= {limit:g} (baseline {base})"
+        rows.append((f"{scenario}.{counter}", got, bound, "ok" if ok else "REGRESSED"))
+        if not ok:
+            failures.append(f"{scenario}.{counter}: {got} violates {bound}")
+
+    width = max(len(r[0]) for r in rows) if rows else 0
+    for name, got, bound, verdict in rows:
+        print(f"  {name:<{width}}  {got!s:>10}  {bound:<28} {verdict}")
+    if failures:
+        print("\ncounter gate: FAILED")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\ncounter gate: ok (hot loop holds its counter envelope + 0 host transfers)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--baseline", default=os.path.join(REPO, "BENCH_r07.json"),
+                        help="committed bench envelope to gate against")
+    parser.add_argument("--bench-json", default=None,
+                        help="existing bench output to check; omitted = run bench.py --smoke fresh")
+    args = parser.parse_args(argv)
+
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    try:
+        if args.bench_json:
+            with open(args.bench_json) as fh:
+                fresh = json.load(fh)
+        else:
+            fresh = _run_smoke()
+    except Exception as err:  # noqa: BLE001 — a broken bench is its own failure class
+        print(f"counter gate: could not obtain a fresh bench run: {type(err).__name__}: {err}")
+        return 2
+    return check(fresh, baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,17 @@ elif ! grep -q '"epoch_compute_retraces_after_warmup": 0' "$BENCH_OUT" || ! grep
 else
   echo "bench smoke: ok (rc=0, status markers + engine + epoch counters present)"
 fi
+
+echo
+echo "=== counter regression gate (diag) ==="
+# Diffs the smoke run's counters against the committed BENCH_r07.json envelope.
+# The engine + epoch scenarios run under the diag STRICT transfer guard, so this
+# also gates the zero-host-transfer invariant (0 transfers recorded), uncaused
+# warm retraces, and the flight-recorder overhead bound (< 2%).
+if ! python scripts/check_counters.py --baseline BENCH_r07.json --bench-json "$BENCH_OUT"; then
+  echo "counter gate: FAILED (see violations above)"
+  status=1
+fi
 rm -f "$BENCH_OUT"
 
 echo

@@ -77,6 +77,40 @@ def test_native_eval_empty_epoch(monkeypatch):
     assert float(out["map"]) == -1.0
 
 
+def _match_once(thr: float, iou_value: float):
+    """Run coco_match on a single det/gt pair with a crafted IoU value."""
+    return rm.coco_match(
+        np.asarray([[iou_value]], dtype=np.float64),
+        np.asarray([100.0]),
+        np.asarray([100.0]),
+        np.asarray([thr], dtype=np.float64),
+        np.asarray([[0.0, 1e10]], dtype=np.float64),
+    )
+
+
+@pytest.mark.parametrize("thr", [0.5, 0.75])
+def test_exact_threshold_iou_is_not_a_match(thr, monkeypatch):
+    """Pin the strict `IoU > thr` convention, in BOTH kernels (ADVICE round 5).
+
+    pycocotools would match an IoU exactly at the threshold (`iou >= thr -
+    1e-10`); this codebase deliberately does not — the divergence is documented
+    in the `native/match.cpp` header and `docs/pages/performance.md`, and this
+    test is the tripwire that a future kernel change cannot silently flip one
+    side of the convention.
+    """
+    for use_native in (True, False):
+        if use_native and not rm.native_available():
+            continue
+        if not use_native:
+            monkeypatch.setattr(rm, "_LIB", None)
+            monkeypatch.setattr(rm, "_COMPILE_ATTEMPTED", True)
+        label = "native" if use_native else "numpy-fallback"
+        det_matches, _, _ = _match_once(thr, thr)  # exactly ON the threshold
+        assert not det_matches.any(), f"{label}: IoU == thr must NOT match (strict convention)"
+        det_matches, _, _ = _match_once(thr, thr + 1e-9)  # just above
+        assert det_matches.all(), f"{label}: IoU just above thr must match"
+
+
 def test_unsorted_rec_thresholds_falls_back_to_python_path(monkeypatch):
     """The native PR-interpolation cursor assumes ascending rec_thresholds; a
     descending grid must take the per-threshold Python path and still match a

@@ -61,6 +61,60 @@ def test_save_does_not_mutate_persistence_flags(tmp_path):
     assert metric.state_dict() == {}  # non-persistent states still excluded
 
 
+def test_npz_fallback_roundtrip(tmp_path, monkeypatch):
+    """The orbax-absent path: save/restore via the numpy ``.npz`` file.
+
+    Covers the whole fallback contract in one resume scenario: list states
+    (packed + length-tagged), the update-count ride-along, and identical
+    continued accumulation after restore — plus the path-extension rule
+    (``path`` without ``.npz`` still round-trips).
+    """
+    from torchmetrics_tpu.utilities import checkpoint as ckpt
+
+    monkeypatch.setattr(ckpt, "_ORBAX_AVAILABLE", False)
+
+    metric = BinaryPrecisionRecallCurve(thresholds=None)  # unbounded cat list states
+    metric.update(jnp.asarray([0.2, 0.7, 0.4]), jnp.asarray([0, 1, 1]))
+    metric.update(jnp.asarray([0.6, 0.3]), jnp.asarray([1, 0]))
+    save_metric_state(metric, str(tmp_path / "ckpt"))  # no .npz suffix on purpose
+    assert (tmp_path / "ckpt.npz").is_file()  # plain numpy archive, no orbax dir
+
+    restored = restore_metric_state(BinaryPrecisionRecallCurve(thresholds=None), str(tmp_path / "ckpt"))
+    assert restored._update_count == metric._update_count
+    assert isinstance(restored.preds, list) and len(restored.preds) == len(metric.preds)
+    for got, want in zip(restored.compute(), metric.compute()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    # resuming continues accumulation identically (update-count weighting intact)
+    batch = (jnp.asarray([0.9, 0.1]), jnp.asarray([1, 1]))
+    metric.update(*batch)
+    restored.update(*batch)
+    assert restored._update_count == metric._update_count
+    for got, want in zip(restored.compute(), metric.compute()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_npz_fallback_scalar_and_collection(tmp_path, monkeypatch):
+    """npz fallback over a collection: array states + counts per member."""
+    from torchmetrics_tpu.utilities import checkpoint as ckpt
+
+    monkeypatch.setattr(ckpt, "_ORBAX_AVAILABLE", False)
+    coll = MetricCollection({"acc": MulticlassAccuracy(num_classes=3, average="micro"), "mean": MeanMetric()})
+    coll["acc"].update(jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.2, 0.7]]), jnp.asarray([0, 2]))
+    coll["mean"].update(jnp.asarray(4.0))
+    coll["mean"].update(jnp.asarray(8.0))
+    save_metric_state(coll, str(tmp_path / "ckpt.npz"))
+
+    restored = restore_metric_state(
+        MetricCollection({"acc": MulticlassAccuracy(num_classes=3, average="micro"), "mean": MeanMetric()}),
+        str(tmp_path / "ckpt.npz"),
+    )
+    got = {k: float(v) for k, v in restored.compute().items()}
+    want = {k: float(v) for k, v in coll.compute().items()}
+    assert got == want
+    assert restored["mean"]._update_count == 2
+
+
 def test_restore_clears_compute_cache(tmp_path):
     src = MeanMetric()
     src.update(jnp.asarray(10.0))

@@ -26,8 +26,10 @@ from __future__ import annotations
 
 from collections import OrderedDict
 from copy import deepcopy
+from time import perf_counter as _perf_counter
 from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
+from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.data import allclose
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
@@ -93,15 +95,24 @@ def _state_fingerprint(metric: Metric) -> Optional[tuple]:
 
 
 def _states_equal(metric1: Metric, metric2: Metric) -> bool:
-    """Value equality of two structurally identical metrics' states."""
-    for key in metric1._defaults:
-        state1 = getattr(metric1, key)
-        state2 = getattr(metric2, key)
-        if isinstance(state1, list):
-            if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+    """Value equality of two structurally identical metrics' states.
+
+    Runs ONCE per collection, on the first step (group discovery). The value
+    comparison necessarily reads device state back to the host, so it is a
+    sanctioned boundary for the diag transfer guard — a strict-guarded hot
+    loop must not flag the one-time discovery as a hot-loop readback.
+    """
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    with transfer_allowed("group-discovery"):
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if isinstance(state1, list):
+                if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            elif not allclose(state1, state2):
                 return False
-        elif not allclose(state1, state2):
-            return False
     return True
 
 
@@ -176,11 +187,19 @@ class MetricCollection:
         each metric's own post-update state to prove value equality.
         """
         if self._groups_checked:
+            rec = _diag.active_recorder()
+            t_step = _perf_counter() if rec is not None else 0.0
             owners = [(group.owner, self._modules[group.owner]) for group in self._groups.values()]
             handled = self._fused_step(owners, args, kwargs)
             for name, metric in owners:
                 if name not in handled:
                     metric.update(*args, **metric._filter_kwargs(**kwargs))
+            if rec is not None:
+                rec.record(
+                    "collection.step", type(self).__name__,
+                    dur_us=round((_perf_counter() - t_step) * 1e6, 3),
+                    owners=len(owners), fused=len(handled),
+                )
             donated = bool(handled) or any(
                 m._engine is not None and m._engine.stats.donated_dispatches for _, m in owners
             )

@@ -1,0 +1,49 @@
+"""Diagnostics subsystem — engine flight recorder, transfer guard, reports.
+
+Always available, near-zero overhead when off. Three pieces:
+
+- :mod:`~torchmetrics_tpu.diag.trace` — a contextvar-scoped ring-buffer flight
+  recorder of structured engine events (dispatches, traces and retraces *with
+  attributed cause*, packed-sync collectives with role/dtype/bytes, every
+  eager fallback with its reason). Enable per scope with :func:`diag_context`
+  or process-wide with ``TORCHMETRICS_TPU_TRACE=1``.
+- :mod:`~torchmetrics_tpu.diag.transfer_guard` — proves the zero-host-transfer
+  invariant: run the hot loop under :func:`transfer_guard` ("strict" raises on
+  any device→host readback, "log" records it); sanctioned collective
+  boundaries pass via :func:`transfer_allowed`.
+- :mod:`~torchmetrics_tpu.diag.report` — merges events with the engine
+  counters into a per-metric report (:func:`diag_report`) and exports the
+  stream as JSON (:func:`export_json`) or a Perfetto-loadable chrome trace
+  (:func:`export_chrome_trace`).
+
+See ``docs/pages/observability.md`` for the event taxonomy, the retrace-cause
+glossary, and the Perfetto how-to.
+"""
+
+from torchmetrics_tpu.diag.report import diag_report, export_chrome_trace, export_json
+from torchmetrics_tpu.diag.trace import (
+    FlightRecorder,
+    TraceEvent,
+    active_recorder,
+    attribute_retrace,
+    clear_recorder,
+    diag_context,
+    record,
+)
+from torchmetrics_tpu.diag.transfer_guard import TransferGuardError, transfer_allowed, transfer_guard
+
+__all__ = [
+    "FlightRecorder",
+    "TraceEvent",
+    "TransferGuardError",
+    "active_recorder",
+    "attribute_retrace",
+    "clear_recorder",
+    "diag_context",
+    "diag_report",
+    "export_chrome_trace",
+    "export_json",
+    "record",
+    "transfer_allowed",
+    "transfer_guard",
+]

@@ -1,0 +1,150 @@
+"""Diag reporting — aggregate events + engine counters; JSON / chrome-trace export.
+
+Three consumers, one data path:
+
+- :func:`diag_report` merges the process-wide engine counters
+  (:func:`~torchmetrics_tpu.engine.stats.engine_report`) with the flight
+  recorder's event stream into one per-metric timing/counter report — the
+  "what did my epoch actually cost" dict.
+- :func:`export_json` dumps the raw event stream (for offline diffing and the
+  counter-regression tooling).
+- :func:`export_chrome_trace` writes the events in the Chrome Trace Event
+  format (``{"traceEvents": [...]}``), loadable in Perfetto
+  (https://ui.perfetto.dev) — dispatch/step events with measured ``dur_us``
+  become duration ("X") slices on a per-owner track; everything else becomes
+  an instant ("i") marker. Durations are HOST-side spans (dispatch + Python
+  bookkeeping); device kernel time is asynchronous and belongs to
+  ``jax.profiler`` traces, which these markers are designed to sit alongside.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional
+
+from torchmetrics_tpu.diag.trace import FlightRecorder, TraceEvent, active_recorder
+
+__all__ = ["diag_report", "export_chrome_trace", "export_json"]
+
+# kinds whose events carry dur_us and render as duration slices
+_SPAN_KINDS = frozenset(
+    {"update.dispatch", "fused.dispatch", "compute.dispatch", "collection.step", "sync.exchange"}
+)
+
+
+def _events_of(recorder: Optional[FlightRecorder]) -> List[TraceEvent]:
+    rec = recorder if recorder is not None else active_recorder()
+    return rec.snapshot() if rec is not None else []
+
+
+def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) -> Dict[str, Any]:
+    """One merged observability dict: engine counters + event aggregation.
+
+    Returns::
+
+        {
+          "counters": engine_report(),          # process-wide EngineStats sums
+          "events": {kind: count},              # exact, drop-proof
+          "dropped": int,                       # ring-buffer overflow count
+          "per_metric": {owner: {"dispatches", "host_us", "traces", "retraces",
+                                 "fallbacks"}},
+          "retraces": [{"owner", "kind", "cause"}],   # every recorded retrace
+          "host_transfers": int,                # transfer.host + transfer.blocked
+          "collective_bytes": int,              # bytes through sanctioned collectives
+        }
+
+    ``reset=True`` clears the engine counters and THIS report's recorder
+    afterwards — the explicitly passed one, or the active one when none is
+    passed (never an unrelated recorder that merely happens to be active).
+    """
+    from torchmetrics_tpu.engine.stats import engine_report, reset_engine_counters
+
+    rec = recorder if recorder is not None else active_recorder()
+    events = rec.snapshot() if rec is not None else []
+    counts: Counter = Counter(rec.counts) if rec is not None else Counter()
+
+    per_metric: Dict[str, Dict[str, Any]] = defaultdict(
+        lambda: {"dispatches": 0, "host_us": 0.0, "traces": 0, "retraces": 0, "fallbacks": 0}
+    )
+    retraces: List[Dict[str, Any]] = []
+    collective_bytes = 0
+    for ev in events:
+        slot = per_metric[ev.owner or "<process>"]
+        if ev.kind in _SPAN_KINDS:
+            slot["dispatches"] += 1
+            slot["host_us"] += float(ev.data.get("dur_us", 0.0))
+        elif ev.kind.endswith(".trace"):
+            slot["traces"] += 1
+        elif ev.kind.endswith(".retrace") or ev.kind.endswith("fold_retrace"):
+            slot["retraces"] += 1
+            retraces.append({"owner": ev.owner, "kind": ev.kind, "cause": ev.data.get("cause", "")})
+        elif ev.kind == "fallback":
+            slot["fallbacks"] += 1
+        elif ev.kind == "collective":
+            collective_bytes += int(ev.data.get("bytes", 0))
+
+    out: Dict[str, Any] = {
+        "counters": engine_report(),
+        "events": dict(counts),
+        "dropped": rec.dropped if rec is not None else 0,
+        "per_metric": {k: dict(v) for k, v in per_metric.items()},
+        "retraces": retraces,
+        "host_transfers": counts.get("transfer.host", 0) + counts.get("transfer.blocked", 0),
+        "collective_bytes": collective_bytes,
+    }
+    if reset:
+        reset_engine_counters()
+        if rec is not None:
+            rec.clear()
+    return out
+
+
+def export_json(path: str, recorder: Optional[FlightRecorder] = None) -> int:
+    """Write the raw event stream as a JSON list; returns the event count."""
+    events = _events_of(recorder)
+    payload = [
+        {"seq": ev.seq, "ts_us": round(ev.ts * 1e6, 3), "kind": ev.kind, "owner": ev.owner, **ev.data}
+        for ev in events
+    ]
+    with open(path, "w") as fh:
+        json.dump(payload, fh, default=str)
+    return len(payload)
+
+
+def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) -> int:
+    """Write the events as a Perfetto-loadable chrome trace; returns the count.
+
+    Layout: one process (pid 0, "torchmetrics_tpu"), one thread track per event
+    owner. Events with a measured ``dur_us`` become complete ("X") slices
+    ending at their record timestamp; the rest are thread-scoped instants.
+    """
+    events = _events_of(recorder)
+    tids: Dict[str, int] = {}
+    trace_events: List[Dict[str, Any]] = [
+        {"ph": "M", "pid": 0, "name": "process_name", "args": {"name": "torchmetrics_tpu"}}
+    ]
+    for ev in events:
+        owner = ev.owner or "<process>"
+        tid = tids.setdefault(owner, len(tids) + 1)
+        ts_us = ev.ts * 1e6
+        dur = float(ev.data.get("dur_us", 0.0))
+        entry: Dict[str, Any] = {
+            "name": ev.kind,
+            "pid": 0,
+            "tid": tid,
+            "args": {k: (v if isinstance(v, (int, float, bool, str)) else str(v)) for k, v in ev.data.items()},
+        }
+        if ev.kind in _SPAN_KINDS and dur > 0.0:
+            # recorded AFTER the span: the slice ends at ev.ts
+            entry.update(ph="X", ts=round(ts_us - dur, 3), dur=round(dur, 3))
+        else:
+            entry.update(ph="i", ts=round(ts_us, 3), s="t")
+        trace_events.append(entry)
+    for owner, tid in tids.items():
+        trace_events.append(
+            {"ph": "M", "pid": 0, "tid": tid, "name": "thread_name", "args": {"name": owner}}
+        )
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": trace_events, "displayTimeUnit": "ms"}, fh)
+    return len(events)

@@ -1,0 +1,195 @@
+"""Hot-loop transfer guard — prove (or log) the zero-host-transfer invariant.
+
+The north star demands ``update()``/``compute()`` with **zero host transfers in
+the hot loop**: through a tunneled TPU every device→host readback costs ~0.6 ms
+regardless of size and drops the stream into polling mode. This module makes
+the invariant checkable instead of aspirational:
+
+- :func:`transfer_guard` runs a section in ``"strict"`` mode (any observed
+  device→host readback raises :class:`TransferGuardError`) or ``"log"`` mode
+  (readbacks are recorded as ``transfer.host`` events in the flight recorder
+  and allowed through). The bench engine/epoch scenarios and the diag tests run
+  under strict mode — completing the section IS the proof of 0 host transfers.
+- :func:`transfer_allowed` marks a *sanctioned* boundary inside a guarded
+  section: the packed-sync collective backbone
+  (:func:`~torchmetrics_tpu.parallel.packing.all_gather_backbone`) and the
+  metadata exchange are the designated places where state legitimately crosses
+  the host — those transfers are recorded as ``collective`` events with
+  role/dtype/bytes, not flagged as violations.
+
+Two detection layers (both installed for the guarded scope only):
+
+1. **The native JAX guard** (``jax.transfer_guard_device_to_host``):
+   authoritative on real accelerators, where any D2H copy — however reached —
+   trips it. On the CPU backend it is inert: "device" buffers are host memory
+   and ``np.asarray`` rides the zero-copy buffer protocol, so no transfer ever
+   happens at the runtime level.
+2. **A Python-level readback detector**, so the invariant is testable on the
+   CPU-only CI image: scoped wrappers on ``jax.Array``'s host-materialisation
+   points (the ``_value`` property behind ``float()``/``int()``/``tolist()``/
+   printing, and ``__array__`` behind ``jax.device_get``) plus the
+   ``numpy.asarray``/``numpy.array`` entry points (which on CPU bypass
+   ``__array__`` via the buffer protocol). Coverage is the realistic readback
+   surface of metric code, not every conceivable C-level escape hatch — on
+   accelerators layer 1 closes the gap.
+
+The hooks are installed on entry and fully removed on exit (refcounted for
+nesting), so un-guarded code pays nothing. Guarded sections are expected to be
+single-threaded (bench scenarios, tests); the mode itself is contextvar-scoped.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Generator
+
+from torchmetrics_tpu.diag import trace
+
+__all__ = ["TransferGuardError", "transfer_allowed", "transfer_guard"]
+
+_MODES = ("strict", "log")
+
+
+class TransferGuardError(RuntimeError):
+    """A device→host readback happened inside a strict transfer-guard scope."""
+
+
+_MODE_VAR: "ContextVar[str]" = ContextVar("tm_tpu_transfer_guard_mode", default="off")
+_ALLOW_VAR: "ContextVar[int]" = ContextVar("tm_tpu_transfer_allow_depth", default=0)
+
+# hook refcount + saved originals (module-level: installation is process-global,
+# activation is contextvar-scoped)
+_install_depth = 0
+_saved: dict = {}
+
+
+def _observe(op: str) -> None:
+    """Handle one observed readback under the active mode."""
+    mode = _MODE_VAR.get()
+    if mode == "off" or _ALLOW_VAR.get() > 0:
+        return
+    if mode == "log":
+        trace.record("transfer.host", "", op=op)
+        return
+    trace.record("transfer.blocked", "", op=op)
+    raise TransferGuardError(
+        f"device->host readback via {op!r} inside a strict transfer-guard scope."
+        " The metric hot loop must not fetch device values; move the readback"
+        " to the epoch boundary, or wrap a sanctioned collective/export point"
+        " in torchmetrics_tpu.diag.transfer_allowed()."
+    )
+
+
+def _install_hooks() -> None:
+    """Wrap the host-readback entry points (refcounted; idempotent)."""
+    global _install_depth
+    _install_depth += 1
+    if _install_depth > 1:
+        return
+    import numpy as np
+
+    import jax._src.array as _jarray
+
+    impl = _jarray.ArrayImpl
+    orig_value = impl.__dict__["_value"]
+    orig_array = impl.__dict__["__array__"]
+    orig_asarray = np.asarray
+    orig_nparray = np.array
+    _saved.update(
+        {"_value": orig_value, "__array__": orig_array, "asarray": orig_asarray, "array": orig_nparray}
+    )
+
+    def guarded_value(self):  # noqa: ANN001 — property fget
+        _observe("jax.Array._value")
+        return orig_value.fget(self)
+
+    def guarded_dunder_array(self, *args: Any, **kwargs: Any):
+        _observe("jax.Array.__array__")
+        return orig_array(self, *args, **kwargs)
+
+    # signature-transparent wrappers: numpy's first parameters are positional
+    # in practice but legally keyword (`np.asarray(a=x)`, `np.array(object=x)`),
+    # and third-party code must keep working unchanged inside a guarded scope
+    def guarded_asarray(*args: Any, **kwargs: Any):
+        a = args[0] if args else kwargs.get("a")
+        if isinstance(a, impl):
+            _observe("np.asarray")
+        return orig_asarray(*args, **kwargs)
+
+    def guarded_nparray(*args: Any, **kwargs: Any):
+        a = args[0] if args else kwargs.get("object")
+        if isinstance(a, impl):
+            _observe("np.array")
+        return orig_nparray(*args, **kwargs)
+
+    impl._value = property(guarded_value)
+    impl.__array__ = guarded_dunder_array
+    np.asarray = guarded_asarray
+    np.array = guarded_nparray
+
+
+def _uninstall_hooks() -> None:
+    global _install_depth
+    _install_depth -= 1
+    if _install_depth > 0:
+        return
+    import numpy as np
+
+    import jax._src.array as _jarray
+
+    impl = _jarray.ArrayImpl
+    impl._value = _saved["_value"]
+    impl.__array__ = _saved["__array__"]
+    np.asarray = _saved["asarray"]
+    np.array = _saved["array"]
+    _saved.clear()
+
+
+@contextmanager
+def transfer_guard(mode: str = "strict") -> Generator[None, None, None]:
+    """Run a section with device→host readbacks disallowed (or logged).
+
+    Args:
+        mode: ``"strict"`` — any readback raises :class:`TransferGuardError`
+            (and is recorded as a ``transfer.blocked`` event);
+            ``"log"`` — readbacks are recorded as ``transfer.host`` events and
+            allowed through.
+
+    The native JAX device-to-host guard engages alongside the Python detector:
+    on real accelerators it catches transfer paths no Python hook can see.
+    """
+    if mode not in _MODES:
+        raise ValueError(f"transfer_guard mode must be one of {_MODES}, got {mode!r}")
+    import jax
+
+    _install_hooks()
+    token = _MODE_VAR.set(mode)
+    try:
+        with jax.transfer_guard_device_to_host("disallow" if mode == "strict" else "log"):
+            yield
+    finally:
+        _MODE_VAR.reset(token)
+        _uninstall_hooks()
+
+
+@contextmanager
+def transfer_allowed(label: str = "") -> Generator[None, None, None]:
+    """Sanction a host-transfer boundary inside a guarded section.
+
+    Used by the packed-sync backbone around its collectives and the metadata
+    exchange — the declared places where state must cross the host. Transfers
+    inside this scope pass both detection layers without raising or logging a
+    violation (they are separately recorded as ``collective`` events).
+    """
+    depth_token = _ALLOW_VAR.set(_ALLOW_VAR.get() + 1)
+    try:
+        if _MODE_VAR.get() == "off":
+            yield
+        else:
+            import jax
+
+            with jax.transfer_guard_device_to_host("allow"):
+                yield
+    finally:
+        _ALLOW_VAR.reset(depth_token)

@@ -21,9 +21,13 @@ dispatch floor — not the kernels — dominates (BENCH_r04: 6.2 ms dispatch flo
   ``MetricCollection`` trace into ONE executable, so an N-metric step costs one
   dispatch instead of N.
 - :mod:`~torchmetrics_tpu.engine.stats` — per-engine counters (traces, cache
-  hits, fallbacks, donation copies, bytes moved) surfaced through
-  :func:`engine_report` and exported by ``bench.py`` so the win is
-  driver-verified rather than asserted.
+  hits, fallbacks, donation copies, bytes moved, retrace causes) surfaced
+  through :func:`engine_report` and exported by ``bench.py`` so the win is
+  driver-verified rather than asserted. Every hot path additionally emits
+  structured events into the :mod:`torchmetrics_tpu.diag` flight recorder
+  (dispatches, retraces with attributed cause, collectives, fallbacks), and
+  the bench scenarios run under the diag strict transfer guard to prove the
+  zero-host-transfer invariant — see ``docs/pages/observability.md``.
 - :class:`~torchmetrics_tpu.engine.epoch.EpochEngine` /
   :class:`~torchmetrics_tpu.engine.epoch.CollectionEpoch` — the epoch-boundary
   half: packed single-collective sync

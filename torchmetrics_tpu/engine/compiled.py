@@ -23,14 +23,38 @@ reset/compute — steady-state steps donate without copying.
 
 from __future__ import annotations
 
+from time import perf_counter
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine.stats import EngineStats
 
 _FALLBACK = object()  # cache sentinel: this signature is known-uncompilable
+
+
+def signature_fingerprint(
+    treedef: Tuple, state_sig: Tuple, in_sig: Tuple, bucket: Optional[int], device: str
+) -> Dict[str, Any]:
+    """Structured digest of a compile signature for retrace-cause attribution.
+
+    Splits the flat cache key into the aspects a retrace can be blamed on —
+    pytree structure, dtypes, shapes, shape bucket, device — so
+    :func:`torchmetrics_tpu.diag.trace.attribute_retrace` can diff a new
+    signature against previously compiled ones and name the minimal change
+    (``bucket-miss`` vs ``dtype-change`` vs ``treedef-change`` …).
+    ``state_sig`` entries are ``(name, shape, dtype)``; ``in_sig`` entries are
+    ``(shape, dtype)``.
+    """
+    return {
+        "treedef": (treedef, tuple(k for k, _, _ in state_sig)),
+        "dtype": (tuple(d for _, _, d in state_sig), tuple(d for _, d in in_sig)),
+        "shape": (tuple(s for _, s, _ in state_sig), tuple(s for s, _ in in_sig)),
+        "bucket": bucket,
+        "device": device,
+    }
 
 
 class _Ineligible(Exception):
@@ -232,6 +256,7 @@ class CompiledUpdate:
     def __init__(self, metric: Any) -> None:
         self._metric = metric
         self._cache: Dict[Tuple, Any] = {}
+        self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}  # key -> signature fingerprint (retrace attribution)
         self.stats = EngineStats(type(metric).__name__)
         self._bucket_ok: Optional[bool] = None
         defaults = metric._defaults
@@ -277,6 +302,7 @@ class CompiledUpdate:
             self._bucket_ok = bucketing.bucket_eligible(m)
         n_pad = 0
         bucketed = False
+        bucket: Optional[int] = None
         if self._bucket_ok and config.BUCKETING_ENABLED:
             n = bucketing.batch_size(inputs)
             if n is not None and n > 0:
@@ -305,6 +331,8 @@ class CompiledUpdate:
         if donate:
             state = shield_state(state, m, st)
 
+        rec = _diag.active_recorder()
+        t_dispatch = perf_counter() if rec is not None else 0.0
         try:
             if bucketed:
                 out = fn(state, np.int32(n_pad), *inputs)
@@ -321,6 +349,16 @@ class CompiledUpdate:
         if first:
             st.traces += 1
             self._cache[key] = entry
+            fp = signature_fingerprint((len(args), kw_names), state_sig, in_sig, bucket, key[-1])
+            cause = _diag.attribute_retrace(fp, list(self._fingerprints.values()))
+            self._fingerprints[key] = fp
+            if cause != "initial":
+                st.retrace_causes[cause] += 1
+            if rec is not None:
+                rec.record(
+                    "update.trace" if cause == "initial" else "update.retrace",
+                    st.owner, cause=cause, bucket=bucket, signatures=len(self._fingerprints),
+                )
         else:
             st.cache_hits += 1
         st.dispatches += 1
@@ -329,7 +367,14 @@ class CompiledUpdate:
             st.donated_dispatches += 1
         else:
             st.donation_fallbacks += 1
-        st.bytes_moved += sum(_nbytes(v) for v in state.values()) + sum(_nbytes(a) for a in inputs)
+        bytes_moved = sum(_nbytes(v) for v in state.values()) + sum(_nbytes(a) for a in inputs)
+        st.bytes_moved += bytes_moved
+        if rec is not None:
+            rec.record(
+                "update.dispatch", st.owner,
+                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3),
+                donated=donate, bucketed=bucketed, pad_rows=n_pad, bytes=bytes_moved, cached=not first,
+            )
 
         for k, v in out.items():
             setattr(m, k, v)

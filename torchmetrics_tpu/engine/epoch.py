@@ -34,10 +34,13 @@ to the eager path with the reason counted in :class:`EngineStats`
 
 from __future__ import annotations
 
+from time import perf_counter
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
     _Ineligible,
@@ -125,6 +128,43 @@ def _collect_state(metric: Any) -> Optional[Dict[str, Any]]:
     return state
 
 
+def _plan_fingerprint(plan: PackedSyncPlan) -> Dict[str, Any]:
+    """Signature digest of a packed plan for retrace-cause attribution.
+
+    A fold/fused executable recompiling after warmup is attributed to the
+    nearest-changed aspect: the spec layout (``treedef-change``), a state dtype
+    (``dtype-change``), per-rank shapes/raggedness (``shape-change``), or the
+    world geometry / buffer layout (``plan-change``).
+    """
+    return {
+        "treedef": tuple((s.owner, s.attr, s.kind, s.was_list) for s in plan.specs),
+        "dtype": tuple(s.dtype for s in plan.specs),
+        "shape": tuple((s.shape, s.elem_shapes, s.world_dim0) for s in plan.specs),
+        "plan": (plan.world_size, plan.members, tuple(sorted(plan._group_sizes.items()))),
+    }
+
+
+def _compute_fingerprint(sig: Tuple, device: str) -> Dict[str, Any]:
+    """Signature digest of a compute-state signature (see ``_state_signature``).
+
+    List lengths live in the ``shape`` aspect: a list state growing between
+    epochs is a shape change of the same pytree slot, not a new treedef.
+    """
+    names: List[Any] = []
+    dtypes: List[Any] = []
+    shapes: List[Any] = []
+    for entry in sig:
+        if entry[1] == "list":
+            names.append((entry[0], "list"))
+            dtypes.append(tuple(d for _, d in entry[2]))
+            shapes.append(tuple(s for s, _ in entry[2]))
+        else:
+            names.append((entry[0], "array"))
+            shapes.append(entry[1])
+            dtypes.append(entry[2])
+    return {"treedef": tuple(names), "dtype": tuple(dtypes), "shape": tuple(shapes), "device": device}
+
+
 def _world_size() -> int:
     import jax
 
@@ -144,25 +184,40 @@ def _exchange(
     transfers, which is exactly the single-chip epoch cost the north star asks
     for. Metadata validation errors propagate (fail loud on every rank).
     """
+    rec = _diag.active_recorder()
+    t0 = perf_counter() if rec is not None else 0.0
     meta = plan.metadata_local()
+    had_meta = False
     if meta is None:
         plan.finalize(None)
     elif plan.world_size == 1:
         plan.finalize(meta[None, :])
     else:
-        gathered_meta = all_gather_backbone(meta)
+        had_meta = True
+        # sanctioned boundary: the metadata probe is host data by design — every
+        # rank must inspect the world layout before entering the buffer collectives
+        with transfer_allowed("sync-metadata"):
+            gathered_meta = np.asarray(all_gather_backbone(meta, label="meta"))
         stats.sync_metadata_gathers += 1
-        plan.finalize(np.asarray(gathered_meta))
+        plan.finalize(gathered_meta)
     local = plan.pack()
     gathered: Dict[str, Any] = {}
+    bytes_moved = 0
     for key in sorted(local):  # deterministic collective order on every rank
         buf = local[key]
         if plan.world_size == 1:
             gathered[key] = buf[None]
             continue
-        gathered[key] = all_gather_backbone(buf)
+        gathered[key] = all_gather_backbone(buf, label=key)
         stats.sync_collectives += 1
-        stats.sync_bytes_moved += int(getattr(buf, "nbytes", 0)) * plan.world_size
+        bytes_moved += int(getattr(buf, "nbytes", 0)) * plan.world_size
+    stats.sync_bytes_moved += bytes_moved
+    if rec is not None:
+        rec.record(
+            "sync.exchange", stats.owner,
+            dur_us=round((perf_counter() - t0) * 1e6, 3),
+            world=plan.world_size, buffers=len(local), metadata=had_meta, bytes=bytes_moved,
+        )
     return gathered
 
 
@@ -174,7 +229,11 @@ def _write_synced(metric: Any, states: Dict[str, Any], plan: PackedSyncPlan, own
 
 
 def _run_fold(
-    plan: PackedSyncPlan, gathered: Dict[str, Any], cache: Dict[Tuple, Any], stats: EngineStats
+    plan: PackedSyncPlan,
+    gathered: Dict[str, Any],
+    cache: Dict[Tuple, Any],
+    stats: EngineStats,
+    fingerprints: List[Dict[str, Any]],
 ) -> Optional[Dict[str, Dict[str, Any]]]:
     """Dispatch the plan's fold through the signature-keyed executable cache.
 
@@ -182,6 +241,9 @@ def _run_fold(
     cannot trace (counted; a CACHED executable failing re-raises — that is a
     real bug, not an eligibility miss). Shared by the per-metric and the
     collection engines so the fallback/counter semantics cannot drift apart.
+    ``fingerprints`` is the caller-owned list of previously compiled plan
+    fingerprints — a fold compile past the first is attributed and recorded as
+    a ``sync.fold_retrace`` with its cause.
     """
     sig = plan.signature()
     entry = cache.get(sig)
@@ -200,6 +262,15 @@ def _run_fold(
     if first:
         cache[sig] = entry
         stats.sync_fold_traces += 1
+        fp = _plan_fingerprint(plan)
+        cause = _diag.attribute_retrace(fp, fingerprints)
+        fingerprints.append(fp)
+        if cause != "initial":
+            stats.retrace_causes[cause] += 1
+        _diag.record(
+            "sync.fold_trace" if cause == "initial" else "sync.fold_retrace",
+            stats.owner, cause=cause,
+        )
     return folded
 
 
@@ -216,6 +287,10 @@ class EpochEngine:
         self._fold_cache: Dict[Tuple, Any] = {}
         self._fused_cache: Dict[Tuple, Any] = {}
         self._compute_cache: Dict[Tuple, Any] = {}
+        # compiled-signature fingerprints per cache, for retrace-cause attribution
+        self._fold_fps: List[Dict[str, Any]] = []
+        self._fused_fps: List[Dict[str, Any]] = []
+        self._compute_fps: List[Dict[str, Any]] = []
         self._compute_ok = not holds_nested_metrics(metric) and "_raw_compute" in metric.__dict__
 
     # ------------------------------------------------------------------ sync
@@ -236,7 +311,7 @@ class EpochEngine:
         if plan is None:
             return False
         gathered = _exchange(plan, self.stats)
-        folded = _run_fold(plan, gathered, self._fold_cache, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
         if folded is None:
             return False
         _write_synced(self._metric, folded.get("", {}), plan, "")
@@ -272,6 +347,8 @@ class EpochEngine:
                 return states, traced_compute(m, states)
 
             entry = jax.jit(fused)
+        rec = _diag.active_recorder()
+        t_dispatch = perf_counter() if rec is not None else 0.0
         try:
             states, value = entry(gathered)
         except Exception as exc:  # noqa: BLE001 — untraceable compute: sync still packed
@@ -285,16 +362,31 @@ class EpochEngine:
             self._fused_cache[sig] = entry
             self.stats.compute_traces += 1
             self.stats.sync_fold_traces += 1
+            fp = _plan_fingerprint(plan)
+            cause = _diag.attribute_retrace(fp, self._fused_fps)
+            self._fused_fps.append(fp)
+            if cause != "initial":
+                self.stats.retrace_causes[cause] += 1
+            if rec is not None:
+                rec.record(
+                    "compute.trace" if cause == "initial" else "compute.retrace",
+                    self.stats.owner, cause=cause, fused=True,
+                )
         else:
             self.stats.compute_cache_hits += 1
         self.stats.compute_dispatches += 1
         self.stats.packed_syncs += 1
+        if rec is not None:
+            rec.record(
+                "compute.dispatch", self.stats.owner,
+                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3), fused=True, cached=not first,
+            )
         _write_synced(m, states, plan, "")
         return (value,)
 
     def _fold_then_no_value(self, plan: PackedSyncPlan, gathered: Dict[str, Any]):
         """Fold-only completion for an exchange whose compute half can't fuse."""
-        folded = _run_fold(plan, gathered, self._fold_cache, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
         if folded is None:
             return None
         _write_synced(self._metric, folded.get("", {}), plan, "")
@@ -331,6 +423,8 @@ class EpochEngine:
             import jax
 
             entry = jax.jit(lambda s: traced_compute(m, s))
+        rec = _diag.active_recorder()
+        t_dispatch = perf_counter() if rec is not None else 0.0
         try:
             value = entry(state)
         except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
@@ -343,9 +437,24 @@ class EpochEngine:
         if first:
             self._compute_cache[key] = entry
             self.stats.compute_traces += 1
+            fp = _compute_fingerprint(sig, key[1])
+            cause = _diag.attribute_retrace(fp, self._compute_fps)
+            self._compute_fps.append(fp)
+            if cause != "initial":
+                self.stats.retrace_causes[cause] += 1
+            if rec is not None:
+                rec.record(
+                    "compute.trace" if cause == "initial" else "compute.retrace",
+                    self.stats.owner, cause=cause, fused=False,
+                )
         else:
             self.stats.compute_cache_hits += 1
         self.stats.compute_dispatches += 1
+        if rec is not None:
+            rec.record(
+                "compute.dispatch", self.stats.owner,
+                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3), fused=False, cached=not first,
+            )
         return True, value
 
     @staticmethod
@@ -367,6 +476,7 @@ class CollectionEpoch:
         self.names: List[str] = list(names)
         self.stats = EngineStats("epoch:collection[" + ",".join(names) + "]")
         self._fold_cache: Dict[Tuple, Any] = {}
+        self._fold_fps: List[Dict[str, Any]] = []
 
     def packed_sync(self, owners: Sequence[Tuple[str, Any]]) -> bool:
         """Sync every owner's states in one exchange; True when handled.
@@ -380,7 +490,7 @@ class CollectionEpoch:
             self.stats.fallback(f"sync:{exc}")
             return False
         gathered = _exchange(plan, self.stats)
-        folded = _run_fold(plan, gathered, self._fold_cache, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
         if folded is None:
             return False
         for name, metric in owners:

@@ -20,10 +20,12 @@ identity (see ``engine/bucketing.py``).
 
 from __future__ import annotations
 
+from time import perf_counter
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
@@ -43,7 +45,28 @@ class FusedUpdate:
     def __init__(self, metrics: Sequence[Tuple[str, Any]]) -> None:
         self.metrics: List[Tuple[str, Any]] = list(metrics)
         self._cache: Dict[Tuple, Any] = {}
+        self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}  # key -> fingerprint (retrace attribution)
         self.stats = EngineStats("fused:" + ",".join(type(m).__name__ for _, m in self.metrics))
+
+    @staticmethod
+    def _fingerprint(state_sig: Tuple, in_sig: Tuple, bucket: Optional[int]) -> Dict[str, Any]:
+        """Structured signature digest (see ``compiled.signature_fingerprint``).
+
+        The fused treedef covers member names AND each member's state names —
+        a member joining/leaving the fusable set reads as ``treedef-change``.
+        """
+        return {
+            "treedef": tuple((name, tuple(k for k, _, _ in sig)) for name, sig in state_sig),
+            "dtype": (
+                tuple(d for _, sig in state_sig for _, _, d in sig),
+                tuple(d for _, d in in_sig),
+            ),
+            "shape": (
+                tuple(s for _, sig in state_sig for _, s, _ in sig),
+                tuple(s for s, _ in in_sig),
+            ),
+            "bucket": bucket,
+        }
 
     def step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Optional[Set[str]]:
         """Run one fused step; returns the set of member names handled.
@@ -84,6 +107,7 @@ class FusedUpdate:
 
         n_pad = 0
         bucketed = False
+        bucket: Optional[int] = None
         if config.BUCKETING_ENABLED and all(bucketing.bucket_eligible(m) for _, m in members):
             n = bucketing.batch_size(inputs)
             if n is not None and n > 0:
@@ -122,6 +146,8 @@ class FusedUpdate:
                 name: shield_state(fused_states[name], m, st) for name, m in fused
             }
 
+        rec = _diag.active_recorder()
+        t_dispatch = perf_counter() if rec is not None else 0.0
         try:
             if bucketed:
                 out = fn(fused_states, np.int32(n_pad), *inputs)
@@ -137,6 +163,17 @@ class FusedUpdate:
         if first:
             st.traces += 1
             self._cache[key] = entry
+            fused_sig = tuple((name, sig) for name, sig in state_sig if name in fused_names)
+            fp = self._fingerprint(fused_sig, in_sig, bucket)
+            cause = _diag.attribute_retrace(fp, list(self._fingerprints.values()))
+            self._fingerprints[key] = fp
+            if cause != "initial":
+                st.retrace_causes[cause] += 1
+            if rec is not None:
+                rec.record(
+                    "fused.trace" if cause == "initial" else "fused.retrace",
+                    st.owner, cause=cause, bucket=bucket, members=len(fused),
+                )
         else:
             st.cache_hits += 1
         st.dispatches += 1
@@ -145,9 +182,17 @@ class FusedUpdate:
             st.donated_dispatches += 1
         else:
             st.donation_fallbacks += 1
-        st.bytes_moved += sum(
+        bytes_moved = sum(
             v.nbytes for mstate in fused_states.values() for v in mstate.values()
         ) + sum(getattr(a, "nbytes", 0) for a in inputs)
+        st.bytes_moved += bytes_moved
+        if rec is not None:
+            rec.record(
+                "fused.dispatch", st.owner,
+                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3),
+                donated=donate, bucketed=bucketed, pad_rows=n_pad, bytes=bytes_moved,
+                members=len(fused), cached=not first,
+            )
 
         handled: Set[str] = set()
         for name, m in fused:
@@ -182,6 +227,7 @@ class FusedUpdate:
                 fusable.append((name, m))
             except Exception as exc:  # noqa: BLE001 — probe failure excludes ONE member
                 self.stats.fallback_reasons[f"member:{name}:{type(exc).__name__}"] += 1
+                _diag.record("fused.exclude", self.stats.owner, member=name, reason=type(exc).__name__)
         if len(fusable) < 2:
             return None
 

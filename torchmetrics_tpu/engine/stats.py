@@ -14,6 +14,8 @@ import weakref
 from collections import Counter
 from typing import Any, Dict
 
+from torchmetrics_tpu.diag import trace as _diag
+
 _REGISTRY: "weakref.WeakSet[EngineStats]" = weakref.WeakSet()
 
 _COUNTER_FIELDS = (
@@ -43,12 +45,13 @@ _COUNTER_FIELDS = (
 class EngineStats:
     """Mutable counter block for one engine instance."""
 
-    __slots__ = ("owner", "fallback_reasons", "bucket_sizes", "__weakref__", *_COUNTER_FIELDS)
+    __slots__ = ("owner", "fallback_reasons", "bucket_sizes", "retrace_causes", "__weakref__", *_COUNTER_FIELDS)
 
     def __init__(self, owner: str = "") -> None:
         self.owner = owner
         self.fallback_reasons: Counter = Counter()
         self.bucket_sizes: set = set()
+        self.retrace_causes: Counter = Counter()  # attributed causes of post-initial compiles
         for f in _COUNTER_FIELDS:
             setattr(self, f, 0)
         _REGISTRY.add(self)
@@ -56,12 +59,16 @@ class EngineStats:
     def fallback(self, reason: str) -> None:
         self.eager_fallbacks += 1
         self.fallback_reasons[reason] += 1
+        # every eager fallback is also a flight-recorder fact (diag/trace.py);
+        # the single hook here keeps every engine's fallback sites covered
+        _diag.record("fallback", self.owner, reason=reason)
 
     def reset(self) -> None:
         for f in _COUNTER_FIELDS:
             setattr(self, f, 0)
         self.fallback_reasons.clear()
         self.bucket_sizes.clear()
+        self.retrace_causes.clear()
 
     def as_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {f: getattr(self, f) for f in _COUNTER_FIELDS}
@@ -69,6 +76,8 @@ class EngineStats:
         out["bucket_count"] = len(self.bucket_sizes)
         if self.fallback_reasons:
             out["fallback_reasons"] = dict(self.fallback_reasons)
+        if self.retrace_causes:
+            out["retrace_causes"] = dict(self.retrace_causes)
         return out
 
     def __repr__(self) -> str:
@@ -76,10 +85,20 @@ class EngineStats:
         return f"EngineStats({self.owner!r}, {body})"
 
 
-def engine_report() -> Dict[str, Any]:
-    """Aggregate counters over every live engine in the process."""
+def engine_report(include_events: bool = False, reset: bool = False) -> Dict[str, Any]:
+    """Aggregate counters over every live engine in the process.
+
+    Args:
+        include_events: attach the active flight recorder's per-kind event
+            counts (and drop count) under ``"diag"`` — empty when recording is
+            off (see :func:`torchmetrics_tpu.diag.diag_context`).
+        reset: zero every engine's counters AND clear the diag ring buffer
+            after reading, so bench scenarios and tests start the next
+            measurement from a clean recorder.
+    """
     total: Dict[str, Any] = {f: 0 for f in _COUNTER_FIELDS}
     reasons: Counter = Counter()
+    causes: Counter = Counter()
     buckets: set = set()
     engines = 0
     for st in list(_REGISTRY):
@@ -87,15 +106,41 @@ def engine_report() -> Dict[str, Any]:
         for f in _COUNTER_FIELDS:
             total[f] += getattr(st, f)
         reasons.update(st.fallback_reasons)
+        causes.update(st.retrace_causes)
         buckets |= st.bucket_sizes
     total["engines"] = engines
     total["bucket_count"] = len(buckets)
     if reasons:
         total["fallback_reasons"] = dict(reasons)
+    if causes:
+        total["retrace_causes"] = dict(causes)
+    if include_events:
+        rec = _diag.active_recorder()
+        total["diag"] = (
+            {"events": dict(rec.counts), "dropped": rec.dropped} if rec is not None else {"events": {}, "dropped": 0}
+        )
+    if reset:
+        reset_engine_stats()
     return total
 
 
-def reset_engine_stats() -> None:
-    """Zero every live engine's counters (bench scenario isolation)."""
+def reset_engine_counters() -> None:
+    """Zero every live engine's counters, leaving any recorder untouched.
+
+    For callers that manage their own :class:`~torchmetrics_tpu.diag.trace.
+    FlightRecorder` lifetime (``diag_report(rec, reset=True)`` clears the
+    recorder it actually reported on, not whichever happens to be active).
+    """
     for st in list(_REGISTRY):
         st.reset()
+
+
+def reset_engine_stats() -> None:
+    """Zero every live engine's counters AND the active diag ring buffer.
+
+    The shared reset keeps the two evidence surfaces (counters, flight
+    recorder) in lockstep: a bench scenario that resets one but not the other
+    would attribute the previous scenario's retrace events to the fresh run.
+    """
+    reset_engine_counters()
+    _diag.clear_recorder()

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.parallel.sync import gather_all_tensors, jit_distributed_available
 from torchmetrics_tpu.utilities.data import (
     _flatten,
@@ -579,6 +580,7 @@ class Metric:
         if dist_sync_fn is None:
             dist_sync_fn = gather_all_tensors
 
+        _diag.record("sync.eager", type(self).__name__)
         self._cache = self._copy_state_refs()
         with jax.profiler.TraceAnnotation(f"{type(self).__name__}.sync"):
             self._sync_dist(dist_sync_fn, process_group=process_group)
@@ -632,6 +634,11 @@ class Metric:
             # metric updates are attributable inside a profiled training step (SURVEY §5.1)
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
                 if not self._engine_step(args, kwargs):
+                    # engine-disabled updates leave no engine counters behind; the
+                    # flight-recorder event keeps eager steps visible in the same
+                    # timeline as compiled dispatches (engine fallbacks additionally
+                    # carry their reason via EngineStats.fallback)
+                    _diag.record("update.eager", type(self).__name__)
                     update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()

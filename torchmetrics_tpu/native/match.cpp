@@ -10,8 +10,23 @@
 // - per area range, ground truths are stably partitioned: in-range first,
 //   out-of-range (ignored) last; matching considers only unmatched, non-ignored
 //   gts; ties resolve to the lowest partitioned index (numpy argmax semantics);
-// - a detection matches the best such gt if IoU > threshold (strict);
+// - a detection matches the best such gt if IoU > threshold (STRICT inequality);
 // - unmatched detections whose own area is out of range are marked ignored.
+//
+// Threshold convention (deliberate, test-pinned divergence from pycocotools):
+// pycocotools seeds its per-detection running best at `min(thr, 1 - 1e-10)`,
+// which makes a gt with IoU EXACTLY equal to the threshold matchable
+// (effectively `iou >= thr - 1e-10`), and additionally lets "crowd" gts match
+// after all real gts were exhausted. This kernel — and the numpy fallback and
+// the epoch-level evaluator below, which share the rule — uses strict
+// `IoU > thr` and never matches ignored gts. The two conventions differ only
+// when an IoU sits exactly ON a threshold (easy to construct with integer
+// boxes at thr 0.5, measure-zero for float predictions) or when crowd
+// annotations are present (the update API does not ingest `iscrowd`).
+// Exact-threshold behaviour is pinned by
+// tests/detection/test_native_eval_parity.py::test_exact_threshold_iou_is_not_a_match;
+// if pycocotools parity at exact-threshold IoU ever becomes a requirement,
+// change BOTH kernels and the numpy fallback together to `best >= thr - 1e-10`.
 
 #include <algorithm>
 #include <cstdint>

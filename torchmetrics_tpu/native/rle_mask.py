@@ -240,6 +240,15 @@ def coco_match(
         ``(A, T, D)`` / ``(A, G)`` bool; gt flags are in the per-area partitioned
         order (in-range gts first). Semantics identical to the numpy fallback —
         see ``match.cpp`` for the pinned rules.
+
+    Threshold convention: a detection matches only when ``IoU > thr`` (STRICT),
+    in both the C++ kernel and the numpy fallback below. pycocotools instead
+    admits IoUs exactly at the threshold (``iou >= thr - 1e-10``) and lets
+    crowd gts match after real gts are exhausted; the divergence is observable
+    only at exact-threshold IoUs (e.g. integer boxes at thr 0.5) and is pinned
+    by ``tests/detection/test_native_eval_parity.py`` — see the ``match.cpp``
+    header for the full rationale and the alignment recipe should parity at
+    the boundary ever be required.
     """
     iou = np.ascontiguousarray(iou, dtype=np.float64)
     det_areas = np.ascontiguousarray(det_areas, dtype=np.float64)

@@ -76,17 +76,29 @@ def _fingerprint(dims: Sequence[int]) -> int:
     return zlib.crc32(np.asarray(list(dims), dtype=np.int64).tobytes()) & 0x7FFFFFFF
 
 
-def all_gather_backbone(x: Any) -> Any:
+def all_gather_backbone(x: Any, label: str = "") -> Any:
     """The host collective: one ``process_allgather`` returning ``(world, ...)``.
 
     Isolated here so tests and benches can monkeypatch a fake world, and so a
     future mesh backbone (``axis_gather``/``axis_sum`` inside ``shard_map``)
     can slot in without touching the plan logic.
+
+    This is THE sanctioned host-transfer boundary of the packed sync: the body
+    runs inside :func:`~torchmetrics_tpu.diag.transfer_allowed` (state must
+    cross hosts here by definition, so a strict transfer guard over the epoch
+    must not flag it) and each issue is recorded as a ``collective`` flight-
+    recorder event carrying its role/dtype ``label`` (the plan's buffer key,
+    e.g. ``"reduce:int32"``, or ``"meta"``) and payload bytes.
     """
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
-    return jnp.asarray(multihost_utils.process_allgather(x, tiled=False))
+    from torchmetrics_tpu.diag import trace as _diag
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    _diag.record("collective", "", label=label, bytes=int(getattr(x, "nbytes", 0)))
+    with transfer_allowed("collective:" + label):
+        return jnp.asarray(multihost_utils.process_allgather(x, tiled=False))
 
 
 class PackingError(Exception):

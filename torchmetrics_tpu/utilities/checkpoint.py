@@ -73,7 +73,7 @@ def save_metric_state(metric: Any, path: str) -> None:
     if _ORBAX_AVAILABLE:
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(os.path.abspath(path), flat, force=True)
-    else:  # pragma: no cover
+    else:
         np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
 
 
@@ -82,7 +82,7 @@ def restore_metric_state(metric: Any, path: str) -> Any:
     if _ORBAX_AVAILABLE and os.path.isdir(path):
         ckptr = ocp.PyTreeCheckpointer()
         flat = ckptr.restore(os.path.abspath(path))
-    else:  # pragma: no cover
+    else:
         npz = np.load(path if path.endswith(".npz") else path + ".npz")
         flat = dict(npz)
     metric.load_state_dict(_from_saveable(flat))
