@@ -469,6 +469,69 @@ def bench_engine(micro=False):
     out["recorder_overhead_pct"] = round(
         100.0 * per_event_us * out["recorder_events_per_step"] / max(out["fused_us_per_step"], 1e-9), 4
     )
+
+    # -- telemetry: per-executable cost/memory ledger + live state footprint ---
+    # (diag/costs.py, populated at compile time from XLA's own analyses; this
+    # snapshot covers every executable the scenarios above compiled)
+    from torchmetrics_tpu.diag.costs import ledger_snapshot, state_footprint
+
+    led = ledger_snapshot()
+    out["ledger_executables"] = led["totals"]["executables"]
+    out["ledger_flops_total"] = round(led["totals"]["flops"], 1)
+    out["ledger_bytes_accessed_total"] = round(led["totals"]["bytes_accessed"], 1)
+    out["ledger_peak_bytes_max"] = led["totals"]["peak_bytes_max"]
+    out["ledger_compile_ms_total"] = round(led["totals"]["compile_ms"], 2)
+    out["ledger_donation_savings_bytes"] = led["totals"]["donation_savings_bytes"]
+    out["ledger"] = [
+        {
+            "owner": e["owner"], "kind": e["kind"], "signature": e["signature"],
+            "flops": e["flops"], "bytes_accessed": e["bytes_accessed"],
+            "peak_bytes": e["peak_bytes"], "compile_ms": round(e["compile_ms"], 2),
+            "donation_savings_bytes": e["donation_savings_bytes"],
+        }
+        for e in led["executables"]
+    ]
+    out["state_footprint"] = state_footprint(diag_mc)
+
+    # -- health sentinels: in-graph NaN detection with ZERO hot-loop host
+    # transfers. A healthy stream keeps flags == 0; a planted NaN raises the
+    # bit inside the compiled update; both run under the STRICT transfer guard
+    # and only the sanctioned epoch-end read fetches the bitmask.
+    from torchmetrics_tpu.diag.sentinel import FLAG_NAN, read_sentinel, sentinel_context
+    from torchmetrics_tpu.diag.telemetry import export_prometheus
+    from torchmetrics_tpu.metric import Metric as _Metric
+
+    class _FloatSum(_Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + x.sum()
+
+        def compute(self):
+            return self.total
+
+    xs = jnp.ones((64,), jnp.float32)
+    xs_nan = xs.at[7].set(jnp.nan)
+    with engine_context(True, donate=True), sentinel_context(True), diag_context(
+        capacity=2048
+    ) as srec, transfer_guard("strict"):
+        healthy = _FloatSum(compiled_update=True)
+        for _ in range(8):
+            healthy.update(xs)
+        poisoned = _FloatSum(compiled_update=True)
+        poisoned.update(xs_nan)
+        poisoned.update(xs)  # the bit is sticky: later clean batches keep it raised
+        clean_read = read_sentinel(healthy)
+        nan_read = read_sentinel(poisoned)
+    out["sentinel_flags"] = clean_read["flags"]
+    out["sentinel_nan_flagged"] = bool(nan_read["flags"] & FLAG_NAN)
+    out["sentinel_bits"] = nan_read["bits"]
+    out["sentinel_host_transfers"] = srec.count("transfer.host", "transfer.blocked")
+    out["telemetry_prometheus_lines"] = len([ln for ln in export_prometheus().splitlines() if ln])
     return out
 
 

@@ -22,26 +22,39 @@ and fails CI when any counter regresses past the committed baseline
   retrace in the flight recorder must carry an attributed cause
 - ``recorder_overhead_pct``       < 2.0 — the flight recorder's bound on the
   engine scenario (per-event record cost x events/step vs step time)
+- ``sentinel_flags`` == 0 and ``sentinel_host_transfers`` == 0 — the
+  sentinel-enabled run stays healthy on clean data AND does no hot-loop host
+  transfer; ``sentinel_nan_flagged`` must be true (a planted NaN IS detected)
+- ``ledger_executables`` truthy and the compile-time / peak-bytes envelope
+  (``ledger_compile_ms_total``, ``ledger_peak_bytes_max``) within 2x of the
+  committed baseline — compile wall-time is machine-dependent, so its gate is
+  a runaway detector, not a tight bound
 
-Counters ABSENT from an older baseline fall back to their absolute bound, so
-the gate tightens automatically as the envelope gains fields. Exit code 0 =
-all green; 1 = regression (each violation printed); 2 = bench run itself broke.
+The baseline defaults to the NEWEST ``BENCH_r*.json`` in the repo root (pass
+``--baseline`` to pin one) — a stale envelope can no longer be compared
+against silently. Counters ABSENT from an older baseline fall back to their
+absolute bound, so the gate tightens automatically as the envelope gains
+fields. Exit code 0 = all green; 1 = regression (each violation printed);
+2 = bench run itself broke.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (scenario, counter, kind, absolute_bound)
-#   kind "max": fresh <= max(baseline, absolute)   — counted regressions
-#   kind "abs": fresh <= absolute                  — invariants, baseline-independent
-#   kind "true": fresh must be truthy
+#   kind "max":   fresh <= max(baseline, absolute)    — counted regressions
+#   kind "abs":   fresh <= absolute                   — invariants, baseline-independent
+#   kind "slack": fresh <= max(2 x baseline, absolute) — machine-dependent envelopes
+#   kind "true":  fresh must be truthy
 _CHECKS = (
     ("engine", "fused_dispatches_per_step", "max", 1.0),
     ("engine", "retraces_after_warmup", "max", 0),
@@ -49,6 +62,13 @@ _CHECKS = (
     ("engine", "host_transfers", "abs", 0),
     ("engine", "retraces_uncaused", "abs", 0),
     ("engine", "recorder_overhead_pct", "abs", 2.0),
+    ("engine", "sentinel_flags", "abs", 0),
+    ("engine", "sentinel_nan_flagged", "true", None),
+    ("engine", "sentinel_host_transfers", "abs", 0),
+    ("engine", "ledger_executables", "true", None),
+    ("engine", "telemetry_prometheus_lines", "true", None),
+    ("engine", "ledger_compile_ms_total", "slack", 60000.0),
+    ("engine", "ledger_peak_bytes_max", "slack", 1 << 28),
     ("epoch", "packed_collectives_per_sync", "max", 2),
     ("epoch", "packed_metadata_gathers_per_sync", "max", 1),
     ("epoch", "epoch_compute_retraces_after_warmup", "max", 0),
@@ -56,6 +76,23 @@ _CHECKS = (
     ("epoch", "epoch_host_transfers", "abs", 0),
     ("epoch", "epoch_retraces_uncaused", "abs", 0),
 )
+
+
+def newest_baseline(repo: str = REPO) -> str:
+    """The highest-numbered ``BENCH_r*.json`` in the repo root.
+
+    The gate previously hardcoded one envelope file, which silently went stale
+    the moment a newer round was committed; defaulting to the newest keeps the
+    comparison honest without a flag on every invocation.
+    """
+    rounds = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        match = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if match:
+            rounds.append((int(match.group(1)), path))
+    if not rounds:
+        raise FileNotFoundError(f"no BENCH_r*.json envelope found in {repo}")
+    return max(rounds)[1]
 
 _TOL = 1e-6  # float slop for per-step ratios
 
@@ -96,6 +133,10 @@ def check(fresh: dict, baseline: dict) -> int:
         elif kind == "abs" or base is None:
             ok = float(got) <= float(absolute) + _TOL
             bound = f"<= {absolute}"
+        elif kind == "slack":  # machine-dependent envelope: runaway detector only
+            limit = max(2.0 * float(base), float(absolute))
+            ok = float(got) <= limit + _TOL
+            bound = f"<= {limit:g} (2x baseline {base})"
         else:  # max: no worse than the committed envelope (or the absolute floor)
             limit = max(float(base), float(absolute))
             ok = float(got) <= limit + _TOL
@@ -118,13 +159,16 @@ def check(fresh: dict, baseline: dict) -> int:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--baseline", default=os.path.join(REPO, "BENCH_r07.json"),
-                        help="committed bench envelope to gate against")
+    parser.add_argument("--baseline", default=None,
+                        help="committed bench envelope to gate against"
+                             " (default: the newest BENCH_r*.json in the repo root)")
     parser.add_argument("--bench-json", default=None,
                         help="existing bench output to check; omitted = run bench.py --smoke fresh")
     args = parser.parse_args(argv)
 
-    with open(args.baseline) as fh:
+    baseline_path = args.baseline or newest_baseline()
+    print(f"baseline: {os.path.basename(baseline_path)}")
+    with open(baseline_path) as fh:
         baseline = json.load(fh)
     try:
         if args.bench_json:

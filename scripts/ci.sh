@@ -41,17 +41,28 @@ elif ! grep -qE '"packed_collectives_per_sync": [12],' "$BENCH_OUT"; then
 elif ! grep -q '"epoch_compute_retraces_after_warmup": 0' "$BENCH_OUT" || ! grep -q '"parity_ok": true' "$BENCH_OUT"; then
   echo "bench smoke: FAILED (epoch engine retraced after warmup or diverged from eager sync)"
   status=1
+elif ! grep -q '"sentinel_nan_flagged": true' "$BENCH_OUT" || ! grep -q '"sentinel_host_transfers": 0' "$BENCH_OUT"; then
+  # telemetry gate: the in-graph health sentinel must detect a planted NaN
+  # with zero hot-loop host transfers under the STRICT guard
+  echo "bench smoke: FAILED (sentinel did not flag the planted NaN with 0 host transfers)"
+  status=1
+elif ! grep -q '"ledger_executables"' "$BENCH_OUT" || ! grep -q '"ledger_compile_ms_total"' "$BENCH_OUT"; then
+  echo "bench smoke: FAILED (cost/memory ledger missing from output)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry counters present)"
 fi
 
 echo
 echo "=== counter regression gate (diag) ==="
-# Diffs the smoke run's counters against the committed BENCH_r07.json envelope.
-# The engine + epoch scenarios run under the diag STRICT transfer guard, so this
-# also gates the zero-host-transfer invariant (0 transfers recorded), uncaused
-# warm retraces, and the flight-recorder overhead bound (< 2%).
-if ! python scripts/check_counters.py --baseline BENCH_r07.json --bench-json "$BENCH_OUT"; then
+# Diffs the smoke run's counters against the NEWEST committed BENCH_r*.json
+# envelope (check_counters picks it automatically — a stale envelope can no
+# longer be silently compared against). The engine + epoch scenarios run under
+# the diag STRICT transfer guard, so this also gates the zero-host-transfer
+# invariant (0 transfers recorded), uncaused warm retraces, the recorder
+# overhead bound (< 2%), sentinel health (flags == 0 on clean data, the
+# planted NaN detected), and the compile-time/peak-bytes ledger envelope.
+if ! python scripts/check_counters.py --bench-json "$BENCH_OUT"; then
   echo "counter gate: FAILED (see violations above)"
   status=1
 fi

@@ -1,0 +1,467 @@
+"""Telemetry layer tests (diag/costs.py + diag/sentinel.py + diag/telemetry.py):
+the cost/memory ledger, in-graph health sentinels under the strict transfer
+guard, the cross-rank divergence audit, Prometheus/JSONL exports, and the
+byte-stability + tooling satellites."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.diag import (
+    audit_context,
+    diag_context,
+    diag_report,
+    export_chrome_trace,
+    export_jsonl,
+    export_prometheus,
+    ledger_snapshot,
+    read_sentinel,
+    sentinel_context,
+    telemetry_snapshot,
+    transfer_guard,
+)
+from torchmetrics_tpu.diag.sentinel import (
+    FLAG_NAN,
+    FLAG_NEGATIVE_COUNT,
+    FLAG_POS_INF,
+    SENTINEL_BITS,
+)
+from torchmetrics_tpu.diag.telemetry import SAMPLE_RE
+from torchmetrics_tpu.engine import engine_context, engine_report, reset_engine_stats
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.parallel.packing import PackedSyncPlan
+
+DISTRIBUTED = staticmethod(lambda: True)
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    reset_engine_stats()
+    yield
+    reset_engine_stats()
+
+
+def _identical_rank_world(monkeypatch, world=2):
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+    )
+
+
+class FloatSum(Metric):
+    """Minimal float-state metric: a NaN/Inf in the input lands in the state."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+class IntCount(Metric):
+    """Signed-int count state for the negative-count sentinel bit."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.count = self.count + x.sum().astype(jnp.int32)
+
+    def compute(self):
+        return self.count
+
+
+# ------------------------------------------------------------------ prometheus
+
+
+def parse_exposition(text):
+    """Minimal Prometheus text-exposition parser: {(name, labels): value}."""
+    samples = {}
+    types = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert mtype in ("counter", "gauge", "histogram", "summary"), mtype
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        match = SAMPLE_RE.match(line)
+        assert match is not None, f"unparseable sample line: {line!r}"
+        labels = tuple(sorted((match.group("labels") or "").split(","))) if match.group("labels") else ()
+        samples[(match.group("name"), labels)] = float(match.group("value"))
+    return samples, types
+
+
+def test_prometheus_roundtrip_through_parser():
+    with engine_context(True):
+        m = FloatSum(compiled_update=True)
+        for _ in range(3):
+            m.update(jnp.ones((4,)))
+    snap = telemetry_snapshot()
+    text = export_prometheus(snapshot=snap)
+    samples, types = parse_exposition(text)
+    assert samples, "exposition output is empty"
+    # every sample's metric family carries a TYPE header
+    for (name, _), _value in samples.items():
+        family = name[: -len("_total")] if name.endswith("_total") else name
+        assert name in types or family in types, f"sample {name} has no TYPE header"
+    # counter values round-trip exactly
+    counters = snap["counters"]
+    assert samples[("tm_tpu_dispatches_total", ())] == counters["dispatches"]
+    assert samples[("tm_tpu_traces_total", ())] == counters["traces"]
+    assert samples[("tm_tpu_ledger_executables", ())] == snap["ledger"]["totals"]["executables"]
+
+
+def test_prometheus_deterministic_and_writes_file(tmp_path):
+    with engine_context(True):
+        m = FloatSum(compiled_update=True)
+        m.update(jnp.ones((4,)))
+    path = str(tmp_path / "metrics.prom")
+    first = export_prometheus(path)
+    second = export_prometheus()
+    assert first == second  # byte-stable for unchanged state
+    with open(path) as fh:
+        assert fh.read() == first
+
+
+def test_jsonl_export_appends_parseable_lines(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    export_jsonl(path)
+    export_jsonl(path)
+    with open(path) as fh:
+        lines = [json.loads(line) for line in fh]
+    assert len(lines) == 2
+    assert "counters" in lines[0] and "ledger" in lines[0]
+
+
+# ------------------------------------------------------------------ sentinels
+
+
+def test_planted_nan_sets_sentinel_under_world2_packed_sync(monkeypatch):
+    """The acceptance scenario: a NaN planted in an update body raises the
+    sentinel bit through compiled update -> packed world-2 sync -> fused
+    compute, with ZERO host transfers under the STRICT guard until the
+    sanctioned epoch-end read."""
+    _identical_rank_world(monkeypatch)
+    x = jnp.ones((8,), jnp.float32).at[3].set(jnp.nan)
+    with engine_context(True), sentinel_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = lambda: True
+        with transfer_guard("strict"):
+            m.update(x)
+            m.compute()
+            flagged = read_sentinel(m)  # sanctioned boundary: passes the guard
+    assert flagged["flags"] & FLAG_NAN
+    assert "nan" in flagged["bits"]
+    assert rec.count("transfer.host", "transfer.blocked") == 0
+
+
+def test_clean_stream_keeps_sentinel_zero_and_guard_silent():
+    with engine_context(True), sentinel_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        with transfer_guard("strict"):
+            for _ in range(4):
+                m.update(jnp.ones((8,)))
+            result = read_sentinel(m)
+    assert result == {"owner": "FloatSum", "flags": 0, "bits": []}
+    assert rec.count("transfer.host", "transfer.blocked") == 0
+
+
+def test_sentinel_bit_is_sticky_across_clean_batches():
+    x_nan = jnp.ones((4,)).at[0].set(jnp.nan)
+    with engine_context(True), sentinel_context(True):
+        m = FloatSum(compiled_update=True)
+        m.update(x_nan)
+        m.update(jnp.ones((4,)) - jnp.nan_to_num(m.total) * 0)  # clean batch
+    assert read_sentinel(m)["flags"] & FLAG_NAN
+
+
+def test_negative_count_bit_on_sum_reduced_int_state():
+    with engine_context(True), sentinel_context(True):
+        m = IntCount(compiled_update=True)
+        m.update(jnp.asarray([-5.0]))
+    assert read_sentinel(m)["flags"] & FLAG_NEGATIVE_COUNT
+
+
+def test_pos_inf_bit_and_inf_default_exemption():
+    with engine_context(True), sentinel_context(True):
+        bad = FloatSum(compiled_update=True)
+        bad.update(jnp.asarray([jnp.inf]))
+        assert read_sentinel(bad)["flags"] & FLAG_POS_INF
+
+        class Peak(Metric):
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                # MinMetric/MaxMetric idiom: an Inf default is the legitimate
+                # "no data yet" sentinel and must not raise the health bit
+                self.add_state("peak", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+            def update(self, x):
+                self.peak = jnp.maximum(self.peak, x.max())
+
+            def compute(self):
+                return self.peak
+
+        ok = Peak(compiled_update=True)
+        ok.update(jnp.asarray([1.0]))  # peak was -inf pre-update; stays finite after
+        second = Peak(compiled_update=True)
+        second.update(jnp.asarray([-jnp.inf]))  # keeps the -inf default: exempt state
+        assert read_sentinel(ok)["flags"] == 0
+        assert read_sentinel(second)["flags"] == 0
+        # the exemption must hold through the compute value check too: the
+        # Inf-default idiom legitimately COMPUTES ±Inf with no data
+        second.distributed_available_fn = lambda: False
+        assert float(second.compute()) == float("-inf")
+        assert read_sentinel(second)["flags"] == 0
+
+
+def test_metric_reset_clears_sentinel():
+    x_nan = jnp.ones((4,)).at[0].set(jnp.nan)
+    with engine_context(True), sentinel_context(True):
+        m = FloatSum(compiled_update=True)
+        m.update(x_nan)
+        assert read_sentinel(m)["flags"] != 0
+        m.reset()
+        assert read_sentinel(m)["flags"] == 0
+
+
+def test_sentinel_rides_fused_collection_dispatch():
+    classes = 5
+    preds = jnp.asarray(np.random.RandomState(0).rand(16, classes))
+    target = jnp.asarray(np.random.RandomState(1).randint(0, classes, 16))
+    with engine_context(True), sentinel_context(True):
+        mc = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(classes, validate_args=False),
+                "prec": MulticlassPrecision(classes, validate_args=False),
+            },
+            compute_groups=False,
+            fused_dispatch=True,
+        )
+        for _ in range(3):
+            mc.update(preds, target)
+        owners = list(mc._modules.values())
+    assert all(getattr(m, "_sentinel_flags", None) is not None for m in owners)
+    assert all(read_sentinel(m)["flags"] == 0 for m in owners)
+
+
+# ------------------------------------------------------------------ cost ledger
+
+
+def test_ledger_records_cost_and_memory_per_executable():
+    with engine_context(True, donate=True):
+        m = FloatSum(compiled_update=True)
+        for _ in range(3):
+            m.update(jnp.ones((16,)))
+    led = ledger_snapshot()
+    assert led["totals"]["executables"] >= 1
+    entry = next(e for e in led["executables"] if e["kind"] == "update" and e["owner"] == "FloatSum")
+    assert entry["compile_ms"] > 0
+    # the CPU backend implements both analyses; real flops/bytes must surface
+    assert entry["flops"] and entry["flops"] > 0
+    assert entry["bytes_accessed"] and entry["bytes_accessed"] > 0
+    assert entry["peak_bytes"] and entry["peak_bytes"] > 0
+    assert entry["donation_savings_bytes"] > 0  # donate=True: state bytes recorded
+
+
+def test_ledger_covers_epoch_executables(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    with engine_context(True):
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = lambda: True
+        m.update(jnp.ones((4,)))
+        m.compute()
+    kinds = {e["kind"] for e in ledger_snapshot()["executables"]}
+    assert "update" in kinds
+    assert "sync-compute" in kinds or "sync-fold" in kinds
+
+
+def test_reset_engine_stats_clears_ledger_and_sentinels():
+    with engine_context(True), sentinel_context(True):
+        m = FloatSum(compiled_update=True)
+        m.update(jnp.ones((4,)).at[0].set(jnp.nan))
+    assert ledger_snapshot()["totals"]["executables"] >= 1
+    assert read_sentinel(m)["flags"] != 0
+    reset_engine_stats()
+    assert ledger_snapshot()["totals"]["executables"] == 0
+    assert read_sentinel(m)["flags"] == 0  # registry sentinels zeroed too
+
+
+def test_state_footprint_metric_and_collection_dedupe():
+    m = FloatSum()
+    foot = m.state_footprint()
+    total_bytes = int(np.asarray(m.total).nbytes)
+    assert foot["per_state"]["total"] == total_bytes
+    assert foot["total_bytes"] == total_bytes
+
+    classes = 5
+    preds = jnp.asarray(np.random.RandomState(0).rand(16, classes))
+    target = jnp.asarray(np.random.RandomState(1).randint(0, classes, 16))
+    mc = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(classes, average="macro", validate_args=False),
+            "prec": MulticlassPrecision(classes, average="macro", validate_args=False),
+        },
+        compute_groups=True,
+    )
+    mc.update(preds, target)
+    foot = mc.state_footprint()
+    # acc/prec share one compute group: the view member's buffers ARE the
+    # owner's, so the deduplicated footprint is half the nominal sum
+    assert foot["shared_bytes"] > 0
+    assert foot["unique_bytes"] + foot["shared_bytes"] == foot["total_bytes"]
+
+
+# ------------------------------------------------------------------ divergence audit
+
+
+class RankInvariant(Metric):
+    full_state_update = False
+    _rank_invariant_states = frozenset({"table"})
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("table", jnp.arange(4.0), dist_reduce_fx="max")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+def test_audit_flags_divergent_rank_invariant_state():
+    with audit_context(True):
+        m = RankInvariant()
+        plan = PackedSyncPlan([("", m)], 2, None)
+        meta = plan.metadata_local()
+        assert meta is not None  # the audit entries force the metadata exchange
+        perturbed = meta.copy()
+        # rank 1 holds a different `table`: flip its value fingerprint
+        table_pos = [i for i, s in enumerate(plan._audit_specs()) if s.attr == "table"][0]
+        perturbed[-len(plan._audit_specs()) * 2 + 2 * table_pos] ^= 0x5A5A
+        plan.finalize(np.stack([meta, perturbed]))
+    flagged = {a["attr"]: a["flag"] for a in plan.audit_results}
+    assert flagged["table"] == "rank-invariant-divergence"
+
+
+def test_audit_duplicate_suspect_and_event(monkeypatch):
+    """Identical sum-state fingerprints on every rank mean the fold will
+    double-count — the audit reports duplicate-suspect with attribution."""
+    _identical_rank_world(monkeypatch)
+    with engine_context(True), audit_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = lambda: True
+        m.update(jnp.ones((4,)))
+        m.compute()
+    audits = [e for e in rec.snapshot() if e.kind == "sync.audit"]
+    assert audits and audits[0].data["flag"] == "duplicate-suspect"
+    assert audits[0].data["attr"] == "total"
+    assert engine_report()["sync_divergence_flags"] == 0  # suspects are not divergence
+
+
+def test_audit_off_means_no_metadata_overhead():
+    m = FloatSum()
+    plan = PackedSyncPlan([("", m)], 2, None)
+    assert plan.metadata_local() is None  # fixed-shape plan stays gather-free
+
+
+def test_audit_skips_world1_and_zero_default_states(monkeypatch):
+    with audit_context(True):
+        # world 1: no cross-rank comparison can flag — no fingerprint readback
+        plan = PackedSyncPlan([("", FloatSum())], 1, None)
+        assert not plan.audit and plan.metadata_local() is None
+    # world 2, but the sum state is still at its all-zero default on every
+    # rank: identical fingerprints are NOT suspicious (nothing accumulated)
+    _identical_rank_world(monkeypatch)
+    with engine_context(True), audit_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.update(jnp.zeros((4,)))  # accumulates exactly 0.0
+        m.distributed_available_fn = lambda: True
+        m.compute()
+    assert not [e for e in rec.snapshot() if e.kind == "sync.audit"]
+
+
+# ------------------------------------------------------------------ exports & tooling
+
+
+def test_chrome_trace_collective_events_get_role_tracks(tmp_path, monkeypatch):
+    _identical_rank_world(monkeypatch)
+    with engine_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = lambda: True
+        m.update(jnp.ones((4,)))
+        m.compute()
+        path = str(tmp_path / "trace.json")
+        export_chrome_trace(path, rec)
+    with open(path) as fh:
+        trace = json.load(fh)
+    names = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    role_tracks = {n for n in names if n.startswith("collective:")}
+    assert role_tracks, f"no per-role collective track in {sorted(names)}"
+    collective_events = [e for e in trace["traceEvents"] if e.get("name") == "collective"]
+    assert collective_events and all("bytes" in e["args"] for e in collective_events)
+
+
+def test_reports_are_byte_stable():
+    with engine_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.update(jnp.ones((4,)))
+        m.update(jnp.ones((6,)))  # forces a second signature -> retrace causes
+        first = json.dumps(diag_report(rec), sort_keys=False, default=str)
+        second = json.dumps(diag_report(rec), sort_keys=False, default=str)
+    assert first == second
+    report = engine_report()
+    if "retrace_causes" in report:
+        assert list(report["retrace_causes"]) == sorted(report["retrace_causes"])
+    if "fallback_reasons" in report:
+        assert list(report["fallback_reasons"]) == sorted(report["fallback_reasons"])
+
+
+def test_check_counters_picks_newest_baseline(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_counters", os.path.join(os.path.dirname(__file__), "..", "scripts", "check_counters.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for name in ("BENCH_r02.json", "BENCH_r10.json", "BENCH_r9.json", "BENCH_rX.json"):
+        (tmp_path / name).write_text("{}")
+    assert os.path.basename(mod.newest_baseline(str(tmp_path))) == "BENCH_r10.json"
+    repo_default = mod.newest_baseline()
+    assert os.path.basename(repo_default).startswith("BENCH_r")
+
+
+def test_sentinel_bits_documented_and_disjoint():
+    bits = list(SENTINEL_BITS.values())
+    assert len(bits) == len(set(bits))
+    for a in bits:
+        assert a & (a - 1) == 0  # single-bit masks only

@@ -435,6 +435,16 @@ class MetricCollection:
             metric.state_dict(destination, prefix=f"{name}.")
         return destination
 
+    def state_footprint(self) -> Dict[str, Any]:
+        """Live HBM bytes held by member states, deduplicating the buffers
+        compute-group view members share with their owner (``unique_bytes`` is
+        what the device actually holds; ``shared_bytes`` is the view overlap).
+        See ``torchmetrics_tpu.diag.costs.state_footprint``."""
+        self._materialize_group_views()
+        from torchmetrics_tpu.diag.costs import state_footprint
+
+        return state_footprint(self)
+
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         """Restore from ``state_dict``."""
         for name, metric in self.items(keep_base=True, copy_state=False):

@@ -1,6 +1,6 @@
-"""Diagnostics subsystem — engine flight recorder, transfer guard, reports.
+"""Diagnostics subsystem — flight recorder, transfer guard, telemetry layer.
 
-Always available, near-zero overhead when off. Three pieces:
+Always available, near-zero overhead when off. Six pieces:
 
 - :mod:`~torchmetrics_tpu.diag.trace` — a contextvar-scoped ring-buffer flight
   recorder of structured engine events (dispatches, traces and retraces *with
@@ -11,16 +11,40 @@ Always available, near-zero overhead when off. Three pieces:
   invariant: run the hot loop under :func:`transfer_guard` ("strict" raises on
   any device→host readback, "log" records it); sanctioned collective
   boundaries pass via :func:`transfer_allowed`.
+- :mod:`~torchmetrics_tpu.diag.costs` — per-executable cost & memory ledger
+  populated at compile time from XLA's own ``cost_analysis`` /
+  ``memory_analysis`` (flops, bytes accessed, peak bytes, compile wall-time,
+  donation savings), plus the live :func:`state_footprint` of a metric or
+  collection.
+- :mod:`~torchmetrics_tpu.diag.sentinel` — opt-in in-graph health sentinels:
+  a per-metric int32 bitmask (NaN / ±Inf / overflow-suspect / negative-count)
+  folded into the compiled update/compute graphs, ORed cross-rank by the
+  packed sync, read on the host only at the sanctioned epoch-end boundary.
+  Also hosts the cross-rank divergence-audit knob.
+- :mod:`~torchmetrics_tpu.diag.telemetry` — the scrapeable surface:
+  :func:`telemetry_snapshot` (one merged dict), :func:`export_prometheus`
+  (text exposition format), :func:`export_jsonl`.
 - :mod:`~torchmetrics_tpu.diag.report` — merges events with the engine
   counters into a per-metric report (:func:`diag_report`) and exports the
   stream as JSON (:func:`export_json`) or a Perfetto-loadable chrome trace
   (:func:`export_chrome_trace`).
 
 See ``docs/pages/observability.md`` for the event taxonomy, the retrace-cause
-glossary, and the Perfetto how-to.
+glossary, the ledger field glossary, the sentinel bit layout, and the
+Prometheus scrape example.
 """
 
+from torchmetrics_tpu.diag.costs import ledger_snapshot, reset_ledger, state_footprint
 from torchmetrics_tpu.diag.report import diag_report, export_chrome_trace, export_json
+from torchmetrics_tpu.diag.sentinel import (
+    SENTINEL_BITS,
+    audit_context,
+    read_sentinel,
+    reset_sentinels,
+    sentinel_context,
+    sentinel_report,
+)
+from torchmetrics_tpu.diag.telemetry import export_jsonl, export_prometheus, telemetry_snapshot
 from torchmetrics_tpu.diag.trace import (
     FlightRecorder,
     TraceEvent,
@@ -33,17 +57,29 @@ from torchmetrics_tpu.diag.trace import (
 from torchmetrics_tpu.diag.transfer_guard import TransferGuardError, transfer_allowed, transfer_guard
 
 __all__ = [
+    "SENTINEL_BITS",
     "FlightRecorder",
     "TraceEvent",
     "TransferGuardError",
     "active_recorder",
     "attribute_retrace",
+    "audit_context",
     "clear_recorder",
     "diag_context",
     "diag_report",
     "export_chrome_trace",
     "export_json",
+    "export_jsonl",
+    "export_prometheus",
+    "ledger_snapshot",
+    "read_sentinel",
     "record",
+    "reset_ledger",
+    "reset_sentinels",
+    "sentinel_context",
+    "sentinel_report",
+    "state_footprint",
+    "telemetry_snapshot",
     "transfer_allowed",
     "transfer_guard",
 ]

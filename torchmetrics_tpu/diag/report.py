@@ -52,11 +52,18 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
           "retraces": [{"owner", "kind", "cause"}],   # every recorded retrace
           "host_transfers": int,                # transfer.host + transfer.blocked
           "collective_bytes": int,              # bytes through sanctioned collectives
+          "ledger": {...},                      # cost/memory ledger totals (diag/costs.py)
+          "sentinels": [...],                   # per-metric health bitmasks (diag/sentinel.py)
         }
 
-    ``reset=True`` clears the engine counters and THIS report's recorder
-    afterwards — the explicitly passed one, or the active one when none is
-    passed (never an unrelated recorder that merely happens to be active).
+    Dict sections are deterministically sorted so two reports of the same
+    state serialize byte-identically (the counter gate diffs JSON exports).
+
+    ``reset=True`` clears every surface this report covers afterwards — the
+    engine counters, THIS report's recorder (the explicitly passed one, or the
+    active one when none is passed; never an unrelated recorder that merely
+    happens to be active), the cost ledger, and the sentinel registry — so a
+    later report never attributes this run's compiles or flags to the next.
     """
     from torchmetrics_tpu.engine.stats import engine_report, reset_engine_counters
 
@@ -84,19 +91,29 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         elif ev.kind == "collective":
             collective_bytes += int(ev.data.get("bytes", 0))
 
+    from torchmetrics_tpu.diag.costs import ledger_snapshot
+    from torchmetrics_tpu.diag.sentinel import sentinel_report
+
     out: Dict[str, Any] = {
         "counters": engine_report(),
-        "events": dict(counts),
+        "events": {k: counts[k] for k in sorted(counts)},
         "dropped": rec.dropped if rec is not None else 0,
-        "per_metric": {k: dict(v) for k, v in per_metric.items()},
+        "per_metric": {k: dict(per_metric[k]) for k in sorted(per_metric)},
         "retraces": retraces,
         "host_transfers": counts.get("transfer.host", 0) + counts.get("transfer.blocked", 0),
         "collective_bytes": collective_bytes,
+        "ledger": ledger_snapshot()["totals"],
+        "sentinels": sentinel_report(),
     }
     if reset:
+        from torchmetrics_tpu.diag.costs import reset_ledger
+        from torchmetrics_tpu.diag.sentinel import reset_sentinels
+
         reset_engine_counters()
         if rec is not None:
             rec.clear()
+        reset_ledger()
+        reset_sentinels()
     return out
 
 
@@ -118,6 +135,10 @@ def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) ->
     Layout: one process (pid 0, "torchmetrics_tpu"), one thread track per event
     owner. Events with a measured ``dur_us`` become complete ("X") slices
     ending at their record timestamp; the rest are thread-scoped instants.
+    Packed-sync ``collective`` events get a dedicated per-role track
+    (``collective:reduce:int32``, ``collective:meta``, …) with their byte
+    counts in ``args``, so sync cost sits visually next to compute cost
+    instead of vanishing into the anonymous process track.
     """
     events = _events_of(recorder)
     tids: Dict[str, int] = {}
@@ -125,7 +146,10 @@ def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) ->
         {"ph": "M", "pid": 0, "name": "process_name", "args": {"name": "torchmetrics_tpu"}}
     ]
     for ev in events:
-        owner = ev.owner or "<process>"
+        if ev.kind == "collective":
+            owner = "collective:" + str(ev.data.get("label") or "?")
+        else:
+            owner = ev.owner or "<process>"
         tid = tids.setdefault(owner, len(tids) + 1)
         ts_us = ev.ts * 1e6
         dur = float(ev.data.get("dur_us", 0.0))

@@ -1,0 +1,317 @@
+"""In-graph health sentinels — device-side NaN/Inf/overflow detection with
+zero hot-loop host transfers, plus the cross-rank divergence-audit knob.
+
+A NaN in a metric state is invisible until ``compute()`` returns garbage —
+and the classic way to look for it (``jnp.isnan(state).any()`` then a Python
+``if``) is a device→host readback, exactly what the hot loop must not do.
+Sentinels solve this **inside the compiled graphs**:
+
+- every sentinel-enabled metric carries one extra int32 scalar
+  (``metric._sentinel_flags``, pytree key ``__sentinel__`` inside compiled
+  steps) holding a sticky bitmask;
+- the engines fold :func:`update_flags` into the compiled ``update`` body
+  (and :func:`value_flags` into cached/fused ``compute``), so health checking
+  costs a few fused reductions per step and stays entirely on device;
+- the packed sync (``parallel/packing.py``) folds the bitmask cross-rank by
+  bitwise OR — per-bit max, so a flag raised on ANY rank survives the fold;
+- the bitmask reaches the host only at a declared epoch-end boundary:
+  :func:`read_sentinel` wraps its readback in ``transfer_allowed`` so a
+  strict transfer-guarded epoch stays clean.
+
+Bit layout (sticky — bits only ever set until :func:`reset_sentinels` or
+``Metric.reset``):
+
+======================  ====  ====================================================
+``nan``                 0x01  a float state contains NaN
+``pos_inf``             0x02  a float state contains +Inf (skipped for states whose
+                              registered default already holds +Inf, e.g. MinMetric)
+``neg_inf``             0x04  a float state contains -Inf (same default exemption)
+``overflow_suspect``    0x08  an integer state's magnitude crossed half its dtype
+                              range — the next epochs may wrap
+``negative_count``      0x10  a sum/mean-reduced integer state went negative
+                              (counts must not)
+======================  ====  ====================================================
+
+Enablement (first hit wins): :func:`sentinel_context` /
+:func:`set_sentinel_enabled`, then the ``TORCHMETRICS_TPU_SENTINEL`` env var
+(``"1"`` on, ``"0"``/unset off). Enable on EVERY rank of a world — the
+sentinel scalar joins the packed sync buffers, and asymmetric enablement
+would desynchronize the buffer layout.
+
+The divergence audit (:func:`audit_context` / ``TORCHMETRICS_TPU_AUDIT``)
+lives here too: it piggybacks per-state value fingerprints (crc32 of the
+dtype-stable float64-cast buffer + element count) on the packed sync's int32
+metadata gather and flags rank-divergent states that a metric declares
+rank-invariant (``Metric._rank_invariant_states``) *before* the fold corrupts
+them — see ``parallel/packing.py`` and ``docs/pages/observability.md``.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from contextlib import contextmanager
+from typing import Any, Dict, Generator, List, Optional
+
+__all__ = [
+    "SENTINEL_BITS",
+    "audit_context",
+    "audit_enabled",
+    "ensure_flags",
+    "read_sentinel",
+    "reset_sentinels",
+    "sentinel_context",
+    "sentinel_enabled",
+    "sentinel_report",
+    "set_audit_enabled",
+    "set_sentinel_enabled",
+    "update_flags",
+    "value_flags",
+]
+
+SENTINEL_ENV_VAR = "TORCHMETRICS_TPU_SENTINEL"
+AUDIT_ENV_VAR = "TORCHMETRICS_TPU_AUDIT"
+
+#: reserved pytree key for the sentinel scalar inside compiled step states
+STATE_KEY = "__sentinel__"
+#: the attribute carrying the live bitmask on a metric instance
+ATTR = "_sentinel_flags"
+
+FLAG_NAN = 0x01
+FLAG_POS_INF = 0x02
+FLAG_NEG_INF = 0x04
+FLAG_OVERFLOW = 0x08
+FLAG_NEGATIVE_COUNT = 0x10
+
+SENTINEL_BITS = {
+    "nan": FLAG_NAN,
+    "pos_inf": FLAG_POS_INF,
+    "neg_inf": FLAG_NEG_INF,
+    "overflow_suspect": FLAG_OVERFLOW,
+    "negative_count": FLAG_NEGATIVE_COUNT,
+}
+
+_enabled_override: Optional[bool] = None
+_audit_override: Optional[bool] = None
+
+# metrics currently carrying a sentinel scalar, for process-wide reporting.
+# Keyed by id(): Metric.__hash__ covers the CURRENT state-array ids (reference
+# semantics), so a hash-based WeakSet would re-insert the same metric after
+# every update — an unbounded leak on the hot loop. id() is stable for the
+# object's lifetime and the weak value drops the entry at collection.
+_REGISTRY: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def sentinel_enabled() -> bool:
+    """Whether compiled steps fold the health sentinel into their graphs."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get(SENTINEL_ENV_VAR, "").strip() == "1"
+
+
+def set_sentinel_enabled(value: Optional[bool]) -> None:
+    """Force sentinels on/off process-wide; ``None`` restores the env/default."""
+    global _enabled_override
+    _enabled_override = value
+
+
+@contextmanager
+def sentinel_context(enabled: bool = True) -> Generator[None, None, None]:
+    """Scoped sentinel enablement (tests, benches). Toggling mid-stream
+    retraces the affected signatures once (``treedef-change``)."""
+    global _enabled_override
+    prev = _enabled_override
+    _enabled_override = enabled
+    try:
+        yield
+    finally:
+        _enabled_override = prev
+
+
+def audit_enabled() -> bool:
+    """Whether packed-sync plans piggyback the cross-rank divergence audit."""
+    if _audit_override is not None:
+        return _audit_override
+    return os.environ.get(AUDIT_ENV_VAR, "").strip() == "1"
+
+
+def set_audit_enabled(value: Optional[bool]) -> None:
+    global _audit_override
+    _audit_override = value
+
+
+@contextmanager
+def audit_context(enabled: bool = True) -> Generator[None, None, None]:
+    """Scoped divergence-audit enablement. Enable on EVERY rank — the audit
+    entries extend the metadata probe, which must be layout-identical
+    world-wide."""
+    global _audit_override
+    prev = _audit_override
+    _audit_override = enabled
+    try:
+        yield
+    finally:
+        _audit_override = prev
+
+
+# ------------------------------------------------------------------ flags math
+
+
+def ensure_flags(metric: Any) -> Any:
+    """The metric's sentinel scalar, created (and check plan cached) on first use.
+
+    The one-time setup inspects the registered DEFAULT values to exempt
+    states that legitimately hold ±Inf (MinMetric/MaxMetric-style sentinels);
+    that inspection reads concrete host values, so it runs inside a
+    ``transfer_allowed`` boundary — setup is once per metric, not hot-loop.
+    """
+    flags = getattr(metric, ATTR, None)
+    if flags is None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+        with transfer_allowed("sentinel-setup"):
+            inf_ok = {}
+            for name, default in metric._defaults.items():
+                if isinstance(default, list):
+                    inf_ok[name] = False
+                    continue
+                arr = np.asarray(default)
+                inf_ok[name] = bool(np.isinf(arr).any()) if arr.dtype.kind == "f" else False
+        metric._sentinel_inf_default = inf_ok
+        flags = jnp.zeros((), jnp.int32)
+        setattr(metric, ATTR, flags)
+    _REGISTRY[id(metric)] = metric
+    return flags
+
+
+def _flag_if(cond: Any, bit: int) -> Any:
+    import jax.numpy as jnp
+
+    return jnp.where(cond, jnp.int32(bit), jnp.int32(0))
+
+
+def update_flags(prev: Any, states: Dict[str, Any], metric: Any) -> Any:
+    """Fold health checks over updated states into the sticky bitmask (jittable).
+
+    Called inside the compiled update body — ``states`` are traced values, the
+    checks lower into the same XLA graph as the update itself.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.utilities.data import dim_zero_mean, dim_zero_sum
+
+    inf_exempt = getattr(metric, "_sentinel_inf_default", {})
+    flags = prev
+    for name, value in states.items():
+        leaves = value if isinstance(value, list) else [value]
+        for leaf in leaves:
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is None:
+                continue
+            if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+                flags = flags | _flag_if(jnp.isnan(leaf).any(), FLAG_NAN)
+                if not inf_exempt.get(name, False):
+                    real = jnp.real(leaf) if jnp.issubdtype(dtype, jnp.complexfloating) else leaf
+                    flags = flags | _flag_if(jnp.isposinf(real).any(), FLAG_POS_INF)
+                    flags = flags | _flag_if(jnp.isneginf(real).any(), FLAG_NEG_INF)
+            elif jnp.issubdtype(dtype, jnp.signedinteger):
+                info = jnp.iinfo(dtype)
+                half = info.max // 2
+                flags = flags | _flag_if(((leaf > half) | (leaf < -half)).any(), FLAG_OVERFLOW)
+                if metric._reductions.get(name) in (dim_zero_sum, dim_zero_mean):
+                    flags = flags | _flag_if((leaf < 0).any(), FLAG_NEGATIVE_COUNT)
+            elif jnp.issubdtype(dtype, jnp.unsignedinteger):
+                info = jnp.iinfo(dtype)
+                flags = flags | _flag_if((leaf > info.max // 2).any(), FLAG_OVERFLOW)
+    return flags
+
+
+def value_flags(prev: Any, value: Any, metric: Any = None) -> Any:
+    """Fold NaN/Inf checks over a compute() result into the bitmask (jittable).
+
+    A metric whose final value is NaN or ±Inf is unhealthy regardless of what
+    its states look like (0/0 divisions surface here first). Metrics using the
+    Inf-default idiom (MinMetric/MaxMetric: "no data yet" IS ±Inf) keep the
+    same exemption :func:`update_flags` applies — their no-update compute
+    legitimately returns the Inf default, so only NaN is checked for them.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    check_inf = not (metric is not None and any(getattr(metric, "_sentinel_inf_default", {}).values()))
+    flags = prev
+    for leaf in jax.tree_util.tree_leaves(value):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None or not (
+            jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating)
+        ):
+            continue
+        flags = flags | _flag_if(jnp.isnan(leaf).any(), FLAG_NAN)
+        if check_inf:
+            real = jnp.real(leaf) if jnp.issubdtype(dtype, jnp.complexfloating) else leaf
+            flags = flags | _flag_if(jnp.isposinf(real).any(), FLAG_POS_INF)
+            flags = flags | _flag_if(jnp.isneginf(real).any(), FLAG_NEG_INF)
+    return flags
+
+
+# ------------------------------------------------------------------ surfacing
+
+
+def _bit_names(mask: int) -> List[str]:
+    return [name for name, bit in SENTINEL_BITS.items() if mask & bit]
+
+
+def read_sentinel(metric: Any) -> Dict[str, Any]:
+    """Epoch-end host readout of a metric's sentinel — the SANCTIONED boundary.
+
+    Returns ``{"owner", "flags", "bits"}``; ``flags == 0`` and ``bits == []``
+    when the metric is healthy or carries no sentinel. The device→host read
+    runs inside ``transfer_allowed`` so a strict-guarded epoch stays clean.
+    """
+    value = getattr(metric, ATTR, None)
+    if value is None:
+        return {"owner": type(metric).__name__, "flags": 0, "bits": []}
+    import numpy as np
+
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    with transfer_allowed("sentinel-read"):
+        mask = int(np.asarray(value))
+    return {"owner": type(metric).__name__, "flags": mask, "bits": _bit_names(mask)}
+
+
+def sentinel_report() -> List[Dict[str, Any]]:
+    """Sanctioned readout of every registered sentinel, aggregated per owner.
+
+    Instances of the same metric class fold into ONE row (flags ORed,
+    ``instances`` counted): rows are unique per ``owner`` and deterministically
+    ordered — flagged owners first — regardless of registry iteration order,
+    so Prometheus exports never emit duplicate label sets and repeated exports
+    of the same state are byte-identical.
+    """
+    by_owner: Dict[str, Dict[str, Any]] = {}
+    for metric in list(_REGISTRY.values()):
+        row = read_sentinel(metric)
+        slot = by_owner.setdefault(row["owner"], {"owner": row["owner"], "flags": 0, "instances": 0})
+        slot["flags"] |= row["flags"]
+        slot["instances"] += 1
+    rows = [
+        {"owner": o, "flags": s["flags"], "bits": _bit_names(s["flags"]), "instances": s["instances"]}
+        for o, s in by_owner.items()
+    ]
+    rows.sort(key=lambda r: (r["flags"] == 0, r["owner"]))
+    return rows
+
+
+def reset_sentinels() -> None:
+    """Zero every registered sentinel and clear the registry
+    (``reset_engine_stats`` calls this)."""
+    import jax.numpy as jnp
+
+    for metric in list(_REGISTRY.values()):
+        if getattr(metric, ATTR, None) is not None:
+            setattr(metric, ATTR, jnp.zeros((), jnp.int32))
+    _REGISTRY.clear()

@@ -1,0 +1,186 @@
+"""Telemetry exporter — the scrapeable surface over counters, ledger, sentinels.
+
+A production metrics stack scrapes; it does not attach a debugger. This module
+renders everything the diag subsystem knows — engine counters, retrace causes,
+fallback reasons, flight-recorder event counts, the cost/memory ledger, and
+the sentinel health states — as:
+
+- :func:`telemetry_snapshot` — one merged, JSON-serializable dict (the
+  machine-readable superset);
+- :func:`export_prometheus` — Prometheus **text exposition format** (version
+  0.0.4: ``# HELP``/``# TYPE`` headers, ``name{label="value"} 1.0`` samples),
+  suitable for a textfile collector or a pull endpoint;
+- :func:`export_jsonl` — append-one-line-per-snapshot JSON-lines, for offline
+  diffing and long-running tail dashboards.
+
+Everything is deterministically ordered (sorted metric names, sorted label
+sets) so two exports of the same state are byte-identical — the counter
+regression gate and the tests rely on that.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from torchmetrics_tpu.diag.trace import FlightRecorder, active_recorder
+
+__all__ = ["export_jsonl", "export_prometheus", "telemetry_snapshot"]
+
+_PREFIX = "tm_tpu"
+
+# EngineStats fields exported as monotonic counters (everything countable);
+# HELP strings double as the field glossary for scrape-side dashboards.
+_COUNTER_HELP = {
+    "traces": "update executables compiled",
+    "cache_hits": "update steps served by a cached executable",
+    "dispatches": "compiled update executions",
+    "metrics_updated": "metric-updates performed via compiled steps",
+    "eager_fallbacks": "steps that fell back to the eager Python path",
+    "donated_dispatches": "dispatches that donated the state pytree",
+    "donation_copies": "state leaves copied pre-dispatch to shield shared buffers",
+    "donation_fallbacks": "dispatches that skipped donation",
+    "bucketed_steps": "steps that rode a shape bucket",
+    "bucket_pad_rows": "total pad rows added across bucketed steps",
+    "bytes_moved": "input+state bytes entering compiled dispatches",
+    "packed_syncs": "packed epoch syncs completed",
+    "sync_collectives": "buffer collectives issued across packed syncs",
+    "sync_metadata_gathers": "metadata exchanges issued",
+    "sync_bytes_moved": "bytes through packed-sync collectives",
+    "sync_fold_traces": "fold / fused sync-compute executables compiled",
+    "sync_divergence_flags": "rank-divergent rank-invariant states flagged by the audit",
+    "compute_traces": "compute executables compiled",
+    "compute_dispatches": "cached compute dispatches",
+    "compute_cache_hits": "compute dispatches served without a re-trace",
+}
+
+
+def _escape(value: Any) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_value(value: Any) -> str:
+    """Full-precision sample rendering: ``%g`` would truncate byte/flops
+    counters past 6 significant digits, silently corrupting scraped rates."""
+    number = float(value)
+    if number.is_integer() and abs(number) < 2**63:
+        return str(int(number))
+    return repr(number)
+
+
+def _sample(name: str, labels: Dict[str, Any], value: Any) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {_format_value(value)}"
+    return f"{name} {_format_value(value)}"
+
+
+def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, Any]:
+    """One merged observability dict: counters + events + ledger + sentinels.
+
+    ``recorder`` defaults to the active flight recorder (event counts are
+    empty when recording is off). Purely a read — nothing is reset.
+    """
+    from torchmetrics_tpu.diag.costs import ledger_snapshot
+    from torchmetrics_tpu.diag.sentinel import sentinel_report
+    from torchmetrics_tpu.engine.stats import engine_report
+
+    rec = recorder if recorder is not None else active_recorder()
+    counters = engine_report()
+    return {
+        "counters": counters,
+        "events": dict(sorted(rec.counts.items())) if rec is not None else {},
+        "dropped": rec.dropped if rec is not None else 0,
+        "ledger": ledger_snapshot(),
+        "sentinels": sentinel_report(),
+    }
+
+
+def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """Render a telemetry snapshot as Prometheus text exposition format.
+
+    Returns the exposition text; additionally writes it to ``path`` when
+    given. The output parses with any exposition-format consumer (the test
+    suite round-trips it through a minimal parser).
+    """
+    snap = snapshot if snapshot is not None else telemetry_snapshot()
+    counters = snap.get("counters", {})
+    lines: List[str] = []
+
+    def emit(name: str, mtype: str, help_text: str, samples: List[Tuple[Dict[str, Any], Any]]) -> None:
+        if not samples:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lines.append(_sample(name, labels, value))
+
+    for field in sorted(_COUNTER_HELP):
+        if field in counters:
+            emit(f"{_PREFIX}_{field}_total", "counter", _COUNTER_HELP[field], [({}, counters[field])])
+    emit(f"{_PREFIX}_engines", "gauge", "live engine instances", [({}, counters.get("engines", 0))])
+    emit(
+        f"{_PREFIX}_retrace_causes_total", "counter", "attributed causes of post-warmup compiles",
+        [({"cause": c}, n) for c, n in sorted(counters.get("retrace_causes", {}).items())],
+    )
+    emit(
+        f"{_PREFIX}_fallback_reasons_total", "counter", "eager fallbacks by reason",
+        [({"reason": r}, n) for r, n in sorted(counters.get("fallback_reasons", {}).items())],
+    )
+    emit(
+        f"{_PREFIX}_events_total", "counter", "flight-recorder events by kind",
+        [({"kind": k}, n) for k, n in sorted(snap.get("events", {}).items())],
+    )
+    emit(
+        f"{_PREFIX}_events_dropped_total", "counter", "flight-recorder ring-buffer drops",
+        [({}, snap.get("dropped", 0))],
+    )
+
+    ledger = snap.get("ledger", {})
+    totals = ledger.get("totals", {})
+    emit(f"{_PREFIX}_ledger_executables", "gauge", "compiled executables in the cost ledger",
+         [({}, totals.get("executables", 0))])
+    emit(f"{_PREFIX}_ledger_compile_ms_total", "counter", "XLA compile wall-time across executables",
+         [({}, totals.get("compile_ms", 0.0))])
+    for field, help_text in (
+        ("flops", "XLA-estimated flops per execution"),
+        ("bytes_accessed", "XLA-estimated bytes accessed per execution"),
+        ("peak_bytes", "peak (args+outputs+temps+code) bytes of the executable"),
+        ("donation_savings_bytes", "state bytes the donation avoided copying"),
+    ):
+        emit(
+            f"{_PREFIX}_ledger_{field}", "gauge", help_text,
+            [
+                ({"owner": e["owner"], "kind": e["kind"], "signature": e["signature"]}, e[field])
+                for e in ledger.get("executables", [])
+                if e.get(field) is not None
+            ],
+        )
+
+    emit(
+        f"{_PREFIX}_sentinel_flags", "gauge", "health-sentinel bitmask per metric (0 = healthy)",
+        [({"owner": s["owner"]}, s["flags"]) for s in snap.get("sentinels", [])],
+    )
+    text = "\n".join(lines) + "\n" if lines else ""
+    if path is not None:
+        with open(path, "w") as fh:
+            fh.write(text)
+    return text
+
+
+def export_jsonl(path: str, snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Append one snapshot as a single JSON line; returns the snapshot."""
+    snap = snapshot if snapshot is not None else telemetry_snapshot()
+    with open(path, "a") as fh:
+        fh.write(json.dumps(snap, sort_keys=True, default=str) + "\n")
+    return snap
+
+
+#: minimal exposition-format sample line (used by the test-suite parser too)
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|\d*\.\d+(?:[eE][-+]?\d+)?|Inf|NaN))$"
+)

@@ -42,6 +42,7 @@ Event taxonomy (the ``kind`` field; full glossary in
 ``collective``         one backbone collective (``label`` = role:dtype, bytes)
 ``sync.fold_trace/fold_retrace``  fold executable compiles (``cause``)
 ``sync.eager``         a sync that fell back to the per-tensor eager path
+``sync.audit``         a divergence-audit finding (``attr``, ``flag``)
 ``compute.trace/retrace``  compute executable compiles (``cause``)
 ``compute.dispatch``   one cached/fused compute execution (``dur_us``)
 ``collection.step``    one MetricCollection update step (``dur_us``, ``owners``, ``fused``)

@@ -28,6 +28,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import costs as _costs
+from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine.stats import EngineStats
@@ -210,7 +212,16 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any]):
             # the live traced value for non-batched ones
             unit_flat = [c if c is not None else flat[i] for i, c in enumerate(pad_rows)]
             unit = run(zeros, unit_flat)
-            return jax.tree_util.tree_map(lambda o, u: o - u * n_pad.astype(o.dtype), out, unit)
+
+            def subtract(path, o, u):
+                # the sentinel bitmask is not row-additive: pad rows cannot
+                # raise health flags (they are zeros), so the mask passes
+                # through the pad-subtract identity untouched
+                if any(getattr(p, "key", None) == _sentinel.STATE_KEY for p in path):
+                    return o
+                return o - u * n_pad.astype(o.dtype)
+
+            return jax.tree_util.tree_map_with_path(subtract, out, unit)
 
     else:
 
@@ -315,6 +326,11 @@ class CompiledUpdate:
                 st.bucket_pad_rows += n_pad
                 st.bucket_sizes.add(bucket)
 
+        # opt-in health sentinel: the int32 bitmask joins the state pytree so
+        # the checks lower into the SAME executable as the update body
+        if _sentinel.sentinel_enabled():
+            state[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
+
         state_sig = tuple((k, tuple(v.shape), str(v.dtype)) for k, v in state.items())
         key = (bucketed, len(args), kw_names, state_sig, in_sig, self._device_token(state))
 
@@ -324,16 +340,19 @@ class CompiledUpdate:
             return False
 
         first = entry is None
-        if first:
-            entry = self._compile(len(args), kw_names, bucketed, inputs)
-        fn, donate = entry
-
-        if donate:
-            state = shield_state(state, m, st)
-
         rec = _diag.active_recorder()
         t_dispatch = perf_counter() if rec is not None else 0.0
         try:
+            if first:
+                # tracing (and the AOT cost-ledger compile) happens here, so a
+                # trace failure lands in the same demote-to-eager handler the
+                # lazy first dispatch used
+                entry = self._compile(len(args), kw_names, bucketed, inputs, state, n_pad)
+            fn, donate = entry
+            if donate:
+                state = shield_state(state, m, st)
+            if rec is not None:
+                t_dispatch = perf_counter()
             if bucketed:
                 out = fn(state, np.int32(n_pad), *inputs)
             else:
@@ -376,21 +395,43 @@ class CompiledUpdate:
                 donated=donate, bucketed=bucketed, pad_rows=n_pad, bytes=bytes_moved, cached=not first,
             )
 
+        sentinel_out = out.pop(_sentinel.STATE_KEY, None)
+        if sentinel_out is not None:
+            setattr(m, _sentinel.ATTR, sentinel_out)
         for k, v in out.items():
             setattr(m, k, v)
         return True
 
     # ------------------------------------------------------------------ build
 
-    def _compile(self, n_args: int, kw_names: Tuple[str, ...], bucketed: bool, inputs: Sequence[Any]):
+    def _compile(
+        self,
+        n_args: int,
+        kw_names: Tuple[str, ...],
+        bucketed: bool,
+        inputs: Sequence[Any],
+        example_state: Dict[str, Any],
+        n_pad: int,
+    ):
         m = self._metric
 
         def run(state, flat):
+            state = dict(state)
+            sentinel = state.pop(_sentinel.STATE_KEY, None)
             call_args = tuple(flat[:n_args])
             call_kwargs = dict(zip(kw_names, flat[n_args:]))
-            return traced_update(m, state, call_args, call_kwargs)
+            out = traced_update(m, state, call_args, call_kwargs)
+            if sentinel is not None:
+                out[_sentinel.STATE_KEY] = _sentinel.update_flags(sentinel, out, m)
+            return out
 
-        return make_step(run, bucketed, inputs)
+        fn, donate = make_step(run, bucketed, inputs)
+        # ahead-of-time compile: same single trace+compile as the lazy first
+        # dispatch, but the Compiled handle feeds the diag cost/memory ledger
+        example = (example_state, np.int32(n_pad), *inputs) if bucketed else (example_state, *inputs)
+        donated = sum(_nbytes(v) for v in example_state.values()) if donate else 0
+        fn = _costs.aot_compile(fn, owner=self.stats.owner, kind="update", args=example, donated_bytes=donated)
+        return fn, donate
 
     @staticmethod
     def _device_token(state: Dict[str, Any]) -> str:

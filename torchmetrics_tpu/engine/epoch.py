@@ -39,6 +39,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import costs as _costs
+from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
 from torchmetrics_tpu.engine.compiled import (
@@ -212,6 +214,16 @@ def _exchange(
         stats.sync_collectives += 1
         bytes_moved += int(getattr(buf, "nbytes", 0)) * plan.world_size
     stats.sync_bytes_moved += bytes_moved
+    # divergence audit (opt-in): the metadata exchange carried per-state value
+    # fingerprints; surface what the cross-rank comparison found
+    for finding in getattr(plan, "audit_results", ()):
+        if finding.get("flag"):
+            if finding["flag"] == "rank-invariant-divergence":
+                stats.sync_divergence_flags += 1
+            _diag.record(
+                "sync.audit", finding["owner"] or stats.owner,
+                attr=finding["attr"], flag=finding["flag"], divergent=finding["divergent"],
+            )
     if rec is not None:
         rec.record(
             "sync.exchange", stats.owner,
@@ -248,11 +260,13 @@ def _run_fold(
     sig = plan.signature()
     entry = cache.get(sig)
     first = entry is None
-    if first:
-        import jax
-
-        entry = jax.jit(plan.make_fold())
     try:
+        if first:
+            import jax
+
+            entry = _costs.aot_compile(
+                jax.jit(plan.make_fold()), owner=stats.owner, kind="sync-fold", args=(gathered,)
+            )
         folded = entry(gathered)
     except Exception as exc:  # noqa: BLE001 — an untraceable custom fold demotes
         if not first:
@@ -337,19 +351,30 @@ class EpochEngine:
         if entry is _FALLBACK or not self._compute_ok:
             return self._fold_then_no_value(plan, gathered)
         first = entry is None
-        if first:
-            import jax
-
-            fold = plan.make_fold()
-
-            def fused(bufs):
-                states = fold(bufs).get("", {})
-                return states, traced_compute(m, states)
-
-            entry = jax.jit(fused)
         rec = _diag.active_recorder()
         t_dispatch = perf_counter() if rec is not None else 0.0
         try:
+            if first:
+                import jax
+
+                fold = plan.make_fold()
+
+                def fused(bufs):
+                    states = fold(bufs).get("", {})
+                    value = traced_compute(m, states)
+                    if _sentinel.ATTR in states:
+                        # the final value's health folds into the same graph:
+                        # a NaN/Inf compute output raises the (already
+                        # cross-rank-ORed) sentinel without any host read
+                        states = dict(states)
+                        states[_sentinel.ATTR] = _sentinel.value_flags(states[_sentinel.ATTR], value, m)
+                    return states, value
+
+                entry = _costs.aot_compile(
+                    jax.jit(fused), owner=self.stats.owner, kind="sync-compute", args=(gathered,)
+                )
+            if rec is not None:
+                t_dispatch = perf_counter()
             states, value = entry(gathered)
         except Exception as exc:  # noqa: BLE001 — untraceable compute: sync still packed
             if not first:
@@ -413,20 +438,38 @@ class EpochEngine:
         if sig is None:
             self.stats.fallback("compute:non-array-state")
             return False, None
-        key = (sig, self._device_token(state))
+        sentinel_in = getattr(m, _sentinel.ATTR, None) if _sentinel.sentinel_enabled() else None
+        has_sentinel = sentinel_in is not None
+        key = (sig, self._device_token(state), has_sentinel)
         entry = self._compute_cache.get(key)
         if entry is _FALLBACK:
             self.stats.fallback("compute:uncompilable-signature")
             return False, None
         first = entry is None
-        if first:
-            import jax
-
-            entry = jax.jit(lambda s: traced_compute(m, s))
         rec = _diag.active_recorder()
         t_dispatch = perf_counter() if rec is not None else 0.0
         try:
-            value = entry(state)
+            if first:
+                import jax
+
+                if has_sentinel:
+                    # value-health checks ride the same cached executable
+                    def compute_with_sentinel(s, flags):
+                        value = traced_compute(m, s)
+                        return value, _sentinel.value_flags(flags, value, m)
+
+                    jitted = jax.jit(compute_with_sentinel)
+                    example: tuple = (state, sentinel_in)
+                else:
+                    jitted = jax.jit(lambda s: traced_compute(m, s))
+                    example = (state,)
+                entry = _costs.aot_compile(jitted, owner=self.stats.owner, kind="compute", args=example)
+            if rec is not None:
+                t_dispatch = perf_counter()
+            if has_sentinel:
+                value, sentinel_out = entry(state, sentinel_in)
+            else:
+                value = entry(state)
         except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
             if not first:
                 raise
@@ -434,10 +477,15 @@ class EpochEngine:
             reason = str(exc) if isinstance(exc, _Ineligible) else f"compute-trace-failed:{type(exc).__name__}"
             self.stats.fallback(reason)
             return False, None
+        if has_sentinel:
+            setattr(m, _sentinel.ATTR, sentinel_out)
         if first:
             self._compute_cache[key] = entry
             self.stats.compute_traces += 1
             fp = _compute_fingerprint(sig, key[1])
+            # the sentinel joins the executable's pytree: a toggle must read
+            # as treedef-change, not as an unattributed ("unknown") retrace
+            fp["treedef"] = (fp["treedef"], has_sentinel)
             cause = _diag.attribute_retrace(fp, self._compute_fps)
             self._compute_fps.append(fp)
             if cause != "initial":

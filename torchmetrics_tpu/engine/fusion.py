@@ -25,6 +25,8 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import costs as _costs
+from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine.compiled import (
@@ -99,6 +101,8 @@ class FusedUpdate:
                 continue
             mstate = {k: getattr(m, k) for k in m._defaults}
             if all(_is_jax_array(v) for v in mstate.values()):
+                if _sentinel.sentinel_enabled():
+                    mstate[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
                 members.append((name, m))
                 states[name] = mstate
         if len(members) < 2:
@@ -132,7 +136,12 @@ class FusedUpdate:
 
         first = entry is None
         if first:
-            entry = self._compile(members, states, bucketed, inputs)
+            try:
+                entry = self._compile(members, states, bucketed, inputs)
+            except Exception as exc:  # noqa: BLE001 — a compile-time failure demotes the key
+                self._cache[key] = _FALLBACK
+                st.fallback(f"trace-failed:{type(exc).__name__}")
+                return None
             if entry is None:  # fewer than 2 members survived the trace probes
                 self._cache[key] = _FALLBACK
                 st.fallback("too-few-traceable-members")
@@ -196,6 +205,9 @@ class FusedUpdate:
 
         handled: Set[str] = set()
         for name, m in fused:
+            sentinel_out = out[name].pop(_sentinel.STATE_KEY, None)
+            if sentinel_out is not None:
+                setattr(m, _sentinel.ATTR, sentinel_out)
             for k, v in out[name].items():
                 setattr(m, k, v)
             # the wrapped-update bookkeeping the eager path would have done
@@ -232,7 +244,22 @@ class FusedUpdate:
             return None
 
         def run_all(fused_states, flat):
-            return {name: traced_update(m, fused_states[name], tuple(flat), {}) for name, m in fusable}
+            out = {}
+            for name, m in fusable:
+                mstate = dict(fused_states[name])
+                sentinel = mstate.pop(_sentinel.STATE_KEY, None)
+                updated = traced_update(m, mstate, tuple(flat), {})
+                if sentinel is not None:
+                    updated[_sentinel.STATE_KEY] = _sentinel.update_flags(sentinel, updated, m)
+                out[name] = updated
+            return out
 
         fn, donate = make_step(run_all, bucketed, inputs)
+        # AOT compile for the diag cost ledger (same single trace+compile)
+        example_states = {name: states[name] for name, _ in fusable}
+        example = (example_states, np.int32(0), *inputs) if bucketed else (example_states, *inputs)
+        donated = (
+            sum(v.nbytes for mstate in example_states.values() for v in mstate.values()) if donate else 0
+        )
+        fn = _costs.aot_compile(fn, owner=self.stats.owner, kind="fused", args=example, donated_bytes=donated)
         return fn, donate, frozenset(name for name, _ in fusable)

@@ -36,6 +36,7 @@ _COUNTER_FIELDS = (
     "sync_metadata_gathers",  # metadata exchanges issued (0 for rank-invariant plans)
     "sync_bytes_moved",  # bytes through packed-sync collectives (gathered view)
     "sync_fold_traces",  # fold / fused sync→compute executables compiled
+    "sync_divergence_flags",  # rank-divergent rank-invariant states flagged by the audit
     "compute_traces",  # compute executables compiled (retraces = growth after warmup)
     "compute_dispatches",  # cached compute dispatches (incl. fused sync→compute)
     "compute_cache_hits",  # compute dispatches served without a re-trace
@@ -74,10 +75,11 @@ class EngineStats:
         out: Dict[str, Any] = {f: getattr(self, f) for f in _COUNTER_FIELDS}
         out["owner"] = self.owner
         out["bucket_count"] = len(self.bucket_sizes)
+        # sorted: JSON exports of the same state must be byte-identical
         if self.fallback_reasons:
-            out["fallback_reasons"] = dict(self.fallback_reasons)
+            out["fallback_reasons"] = {k: self.fallback_reasons[k] for k in sorted(self.fallback_reasons)}
         if self.retrace_causes:
-            out["retrace_causes"] = dict(self.retrace_causes)
+            out["retrace_causes"] = {k: self.retrace_causes[k] for k in sorted(self.retrace_causes)}
         return out
 
     def __repr__(self) -> str:
@@ -110,14 +112,17 @@ def engine_report(include_events: bool = False, reset: bool = False) -> Dict[str
         buckets |= st.bucket_sizes
     total["engines"] = engines
     total["bucket_count"] = len(buckets)
+    # deterministically sorted: byte-stable JSON exports (see diag/telemetry.py)
     if reasons:
-        total["fallback_reasons"] = dict(reasons)
+        total["fallback_reasons"] = {k: reasons[k] for k in sorted(reasons)}
     if causes:
-        total["retrace_causes"] = dict(causes)
+        total["retrace_causes"] = {k: causes[k] for k in sorted(causes)}
     if include_events:
         rec = _diag.active_recorder()
         total["diag"] = (
-            {"events": dict(rec.counts), "dropped": rec.dropped} if rec is not None else {"events": {}, "dropped": 0}
+            {"events": {k: rec.counts[k] for k in sorted(rec.counts)}, "dropped": rec.dropped}
+            if rec is not None
+            else {"events": {}, "dropped": 0}
         )
     if reset:
         reset_engine_stats()
@@ -136,11 +141,18 @@ def reset_engine_counters() -> None:
 
 
 def reset_engine_stats() -> None:
-    """Zero every live engine's counters AND the active diag ring buffer.
+    """Zero every live engine's counters, the diag ring buffer, the cost
+    ledger, AND the sentinel registry.
 
-    The shared reset keeps the two evidence surfaces (counters, flight
-    recorder) in lockstep: a bench scenario that resets one but not the other
-    would attribute the previous scenario's retrace events to the fresh run.
+    The shared reset keeps every evidence surface (counters, flight recorder,
+    per-executable costs, health sentinels) in lockstep: a bench scenario that
+    resets one but not the others would attribute the previous scenario's
+    events/costs/flags to the fresh run.
     """
+    from torchmetrics_tpu.diag.costs import reset_ledger
+    from torchmetrics_tpu.diag.sentinel import reset_sentinels
+
     reset_engine_counters()
     _diag.clear_recorder()
+    reset_ledger()
+    reset_sentinels()

@@ -81,6 +81,12 @@ class Metric:
     plot_lower_bound: Optional[float] = None
     plot_upper_bound: Optional[float] = None
     plot_legend_name: Optional[str] = None
+    #: state names whose VALUES must be identical on every rank (constants,
+    #: threshold tables, …). The opt-in divergence audit
+    #: (``torchmetrics_tpu.diag.audit_context`` / ``TORCHMETRICS_TPU_AUDIT=1``)
+    #: fingerprints these during the packed sync's metadata exchange and flags
+    #: cross-rank divergence before the fold corrupts them.
+    _rank_invariant_states: frozenset = frozenset()
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None
@@ -819,6 +825,17 @@ class Metric:
         self._cache = None
         self._is_synced = False
         self._none_folded = set()
+        if self.__dict__.get("_sentinel_flags") is not None:
+            # the health sentinel is sticky across updates/syncs but a reset
+            # starts a fresh accumulation — flags from the previous epoch
+            # must not bleed into the next one
+            self._sentinel_flags = jnp.zeros((), jnp.int32)
+
+    def state_footprint(self) -> Dict[str, Any]:
+        """Live HBM bytes held by this metric's states (see ``diag/costs.py``)."""
+        from torchmetrics_tpu.diag.costs import state_footprint
+
+        return state_footprint(self)
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference ``metric.py:640-642``)."""

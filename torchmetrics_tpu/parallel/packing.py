@@ -36,10 +36,13 @@ rank with the same errors the eager guard raises.
 
 from __future__ import annotations
 
+import functools
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from torchmetrics_tpu.diag import sentinel as _sentinel
 
 from torchmetrics_tpu.utilities.data import (
     dim_zero_cat,
@@ -163,6 +166,14 @@ class PackedSyncPlan:
         self._group_sizes: Dict[str, int] = {}
         self.specs: List[_Spec] = []
         self.empty_lists: List[Tuple[str, str]] = []  # cat/none lists empty on this rank
+        # divergence audit (opt-in, diag/sentinel.py): per-state value
+        # fingerprints piggyback on the metadata gather; enablement is frozen
+        # at plan build and MUST match on every rank (layout symmetry — safe
+        # to gate on world_size, which is identical everywhere). One-process
+        # worlds skip it entirely: no cross-rank comparison can ever flag.
+        self.audit = _sentinel.audit_enabled() and self.world_size > 1
+        self.audit_results: List[Dict[str, Any]] = []
+        self._audit_nonzero: List[bool] = []  # local-buffer any() per audited spec
         self._build()
 
     # ------------------------------------------------------------------ build
@@ -209,6 +220,21 @@ class PackedSyncPlan:
                     # verification entry in the metadata exchange
                     spec.needs_meta = tuple(getattr(default, "shape", ())) != spec.shape
                 spec.group = ("reduce:" if kind in ("sum", "mean") else "gather:") + spec.dtype
+                self.specs.append(spec)
+            # health sentinel (diag/sentinel.py): the int32 bitmask rides the
+            # gather buffer and folds cross-rank by bitwise OR, so a flag
+            # raised on ANY rank survives the sync. Membership is a function of
+            # the ENABLEMENT KNOB alone — never of whether this particular
+            # metric happens to carry a residual flags attribute — so ranks
+            # with different sentinel history cannot desynchronize the buffer
+            # layout as long as the knob matches world-wide (the documented
+            # rule); a missing bitmask is created here (zero, one-time).
+            sentinel_val = _sentinel.ensure_flags(metric) if _sentinel.sentinel_enabled() else None
+            if _is_array(sentinel_val):
+                spec = _Spec(owner, _sentinel.ATTR, "sentinel", str(sentinel_val.dtype))
+                spec.shape = tuple(int(d) for d in sentinel_val.shape)
+                spec.size = 1
+                spec.group = "gather:" + spec.dtype
                 self.specs.append(spec)
 
     def _add_list_spec(self, owner: str, metric: Any, attr: str, red: Any, val: Any) -> None:
@@ -260,8 +286,24 @@ class PackedSyncPlan:
         metadata exchange is skipped entirely (zero extra collectives)."""
         return not any(s.needs_meta for s in self.specs)
 
+    #: spec kinds the divergence audit fingerprints (fixed-shape array states;
+    #: cat/list states are ragged by design and the sentinel is already ORed)
+    _AUDITABLE = ("sum", "mean", "max", "min", "none-array", "custom")
+
+    def _audit_specs(self) -> List[_Spec]:
+        return [s for s in self.specs if s.kind in self._AUDITABLE]
+
     def metadata_local(self) -> Optional[np.ndarray]:
-        """Fixed-shape int32 probe covering every dynamic state, or None."""
+        """Fixed-shape int32 probe covering every dynamic state, or None.
+
+        With the divergence audit on, every fixed-shape array state appends a
+        ``(value fingerprint, element count)`` pair: a crc32 of the state's
+        full float64-cast buffer — dtype-stable, so the x64 warmup's
+        int32→int64 promotion does not read as divergence, while
+        sum-preserving divergence (permuted rows, NaN-vs-zero) still changes
+        the digest. Reading the values is a host transfer by design and rides
+        the same sanctioned boundary as the gather itself.
+        """
         entries: List[int] = []
         for s in self.specs:
             if not s.needs_meta:
@@ -277,6 +319,25 @@ class PackedSyncPlan:
                 entries += [len(s.elem_shapes), _fingerprint(dims)]
             else:  # static-shape verification entry
                 entries += [s.size, _fingerprint(s.shape)]
+        if self.audit:
+            from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+            by_owner = dict(self._metrics)
+            self._audit_nonzero = []
+            with transfer_allowed("sync-audit"):
+                for s in self._audit_specs():
+                    value = np.asarray(getattr(by_owner[s.owner], s.attr))
+                    if np.iscomplexobj(value):
+                        value = np.abs(value)  # magnitude keeps the digest dtype-stable
+                    # digest the FULL float64-cast buffer, not a sum: summing
+                    # would miss sum-preserving divergence (permuted rows,
+                    # NaN-vs-zero), which is exactly what the audit must catch
+                    value = np.ascontiguousarray(value.astype(np.float64))
+                    self._audit_nonzero.append(bool(value.any()))
+                    entries += [
+                        zlib.crc32(value.tobytes()) & 0x7FFFFFFF,
+                        int(value.size) & 0x7FFFFFFF,
+                    ]
         if not entries:
             return None
         return np.asarray(entries, dtype=np.int32)
@@ -349,6 +410,46 @@ class PackedSyncPlan:
                             f" {prints.tolist()}); non-cat reductions require identical"
                             " state shapes on every rank."
                         )
+            if self.audit:
+                # divergence audit: compare every fixed-shape state's value
+                # fingerprint across ranks BEFORE the fold destroys the
+                # per-rank view. Divergence is normal for accumulating states
+                # (each rank saw different batches); it is flagged only for
+                # states the metric declares rank-invariant. Identical
+                # sum/mean fingerprints are the opposite smell — every rank
+                # appears to have accumulated the same stream, so the fold
+                # will double-count — reported as "duplicate-suspect".
+                by_owner = dict(self._metrics)
+                self.audit_results = []
+                for spec_i, s in enumerate(self._audit_specs()):
+                    fps = world_meta[:, idx]
+                    sizes = world_meta[:, idx + 1]
+                    idx += _META_INTS_PER_ENTRY
+                    divergent = bool(fps.max() != fps.min() or sizes.max() != sizes.min())
+                    declared = getattr(by_owner[s.owner], "_rank_invariant_states", ()) or ()
+                    # identical fingerprints imply every rank's buffer equals
+                    # the local one, so the LOCAL any() check is world-valid:
+                    # all-zero (still-at-default) states are not suspicious
+                    local_nonzero = spec_i < len(self._audit_nonzero) and self._audit_nonzero[spec_i]
+                    if divergent and s.attr in declared:
+                        flag = "rank-invariant-divergence"
+                    elif (
+                        not divergent
+                        and local_nonzero
+                        and s.kind in ("sum", "mean")
+                        and np.issubdtype(np.dtype(s.dtype), np.floating)
+                    ):
+                        # float accumulations over DIFFERENT data are never
+                        # bitwise identical — identical float sums mean every
+                        # rank saw the same stream and the fold double-counts.
+                        # Integer count states are exempt: balanced sharding
+                        # legitimately produces equal counts on every rank.
+                        flag = "duplicate-suspect"
+                    else:
+                        flag = ""
+                    self.audit_results.append(
+                        {"owner": s.owner, "attr": s.attr, "kind": s.kind, "divergent": divergent, "flag": flag}
+                    )
         # pad ragged cat segments to the FULL-WORLD max and freeze offsets
         offsets: Dict[str, int] = {}
         for s in self.specs:
@@ -442,7 +543,14 @@ class PackedSyncPlan:
                     continue
                 seg = gathered[s.group][:, s.offset : s.offset + s.size]
                 seg = seg[jnp.asarray(members)] if members != list(range(self.world_size)) else seg
-                if s.kind in ("sum", "mean", "max", "min", "none-array", "custom"):
+                if s.kind == "sentinel":
+                    # per-bit max == bitwise OR: a health flag raised on ANY
+                    # rank survives the cross-rank fold
+                    stacked = seg.reshape((len(members),))
+                    dest[s.attr] = functools.reduce(
+                        jnp.bitwise_or, [stacked[r] for r in range(len(members))]
+                    ).reshape(s.shape)
+                elif s.kind in ("sum", "mean", "max", "min", "none-array", "custom"):
                     stacked = seg.reshape((len(members),) + s.shape)
                     if s.kind == "sum":
                         dest[s.attr] = stacked.sum(axis=0)
