@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Counter trajectory across committed bench rounds, plus a key-counter gate.
+
+``check_counters.py`` answers "did THIS run regress against the newest
+envelope?"; this script answers the longitudinal question — *how has each key
+counter moved across every committed round?* — and catches the slow-boil
+class of regression a single-baseline diff cannot see (a counter creeping up
+one "within-slack" notch per PR).
+
+Reads every ``BENCH_r*.json`` in the repo root in round order and prints one
+trajectory table: dispatches/step, collectives/sync, metadata gathers/sync,
+retraces after warmup, recorder & profiler overhead %, compile_ms. Counters a
+round predates print as ``-`` (older envelopes legitimately lack newer
+fields).
+
+With ``--bench-json`` (a fresh ``bench.py --smoke`` output) the script also
+gates: each KEY counter of the fresh run must not regress past the newest
+committed baseline beyond the existing slack rules (count-shaped counters:
+no worse than the baseline; machine-dependent envelopes: within 2x). Exit 0 =
+informational print or all-green gate; 1 = a key counter regressed; 2 = no
+rounds found / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (scenario, counter, gate) — gate "max" = fresh <= baseline, "slack" = fresh
+# <= 2x baseline, None = trajectory display only
+_TRACKED = (
+    ("engine", "fused_dispatches_per_step", "max"),
+    ("engine", "per_metric_dispatches_per_step", None),
+    ("engine", "retraces_after_warmup", "max"),
+    ("engine", "eager_fallbacks", "max"),
+    ("epoch", "packed_collectives_per_sync", "max"),
+    ("epoch", "packed_metadata_gathers_per_sync", "max"),
+    ("epoch", "epoch_compute_retraces_after_warmup", "max"),
+    ("engine", "recorder_overhead_pct", "slack"),
+    ("engine", "profiler_overhead_pct", "slack"),
+    ("engine", "ledger_compile_ms_total", "slack"),
+)
+
+_TOL = 1e-6
+
+
+def rounds(repo: str = REPO):
+    """[(round_number, path)] for every committed BENCH_r*.json, in order."""
+    found = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        match = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if match:
+            found.append((int(match.group(1)), path))
+    return sorted(found)
+
+
+def _counter(payload: dict, scenario: str, counter: str):
+    return payload.get("extras", {}).get(scenario, {}).get(counter)
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return str(value).lower()
+    if isinstance(value, float):
+        return f"{value:g}"
+    return str(value)
+
+
+def print_trajectory(history) -> None:
+    names = [f"{s}.{c}" for s, c, _ in _TRACKED]
+    name_w = max(len(n) for n in names)
+    cols = [f"r{num:02d}" for num, _ in history]
+    col_w = max(10, max((len(c) for c in cols), default=3))
+    print(f"  {'counter':<{name_w}}  " + "  ".join(f"{c:>{col_w}}" for c in cols))
+    for (scenario, counter, _), name in zip(_TRACKED, names):
+        cells = [_fmt(_counter(p, scenario, counter)) for _, p in history]
+        print(f"  {name:<{name_w}}  " + "  ".join(f"{c:>{col_w}}" for c in cells))
+
+
+def gate(fresh: dict, baseline: dict, baseline_name: str) -> int:
+    failures = []
+    for scenario, counter, kind in _TRACKED:
+        if kind is None:
+            continue
+        got = _counter(fresh, scenario, counter)
+        base = _counter(baseline, scenario, counter)
+        if got is None or base is None:
+            continue  # check_counters owns missing-field handling
+        limit = 2.0 * float(base) if kind == "slack" else float(base)
+        if float(got) > limit + _TOL:
+            failures.append(
+                f"{scenario}.{counter}: {got} regressed past the {baseline_name}"
+                f" envelope ({'2x ' if kind == 'slack' else ''}{base})"
+            )
+    if failures:
+        print("\nbench trend gate: FAILED")
+        for failure in failures:
+            print(f"  - {failure}")
+        return 1
+    print(f"\nbench trend gate: ok (key counters hold the {baseline_name} envelope)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--bench-json", default=None,
+                        help="fresh bench output to gate against the newest committed round"
+                             " (omitted = print the trajectory only)")
+    args = parser.parse_args(argv)
+
+    history = []
+    for num, path in rounds():
+        try:
+            with open(path) as fh:
+                history.append((num, json.load(fh)))
+        except (OSError, json.JSONDecodeError) as err:
+            print(f"bench trend: skipping unreadable {os.path.basename(path)}: {err}")
+    if not history:
+        print("bench trend: no BENCH_r*.json rounds found")
+        return 2
+
+    print(f"bench counter trajectory over {len(history)} committed rounds:")
+    print_trajectory(history)
+
+    if args.bench_json is None:
+        return 0
+    try:
+        with open(args.bench_json) as fh:
+            fresh = json.load(fh)
+    except (OSError, json.JSONDecodeError) as err:
+        print(f"bench trend: cannot read --bench-json: {err}")
+        return 2
+    newest_num, newest = history[-1]
+    return gate(fresh, newest, f"BENCH_r{newest_num:02d}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

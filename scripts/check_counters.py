@@ -29,6 +29,15 @@ and fails CI when any counter regresses past the committed baseline
   (``ledger_compile_ms_total``, ``ledger_peak_bytes_max``) within 2x of the
   committed baseline — compile wall-time is machine-dependent, so its gate is
   a runaway detector, not a tight bound
+- ``profiler_overhead_pct`` < 2.0 — the sampled-probe bound on the profiled
+  engine scenario (mean blocking wait x 1/every_n vs step time, analytic like
+  the recorder bound); ``profile_probes`` truthy (sampling actually engaged)
+  and ``profile_host_transfers`` == 0 (probes ride the sanctioned boundary)
+- ``telemetry_histogram_series`` truthy — the Prometheus export carries the
+  latency histogram families (``_bucket``/``_sum``/``_count``)
+- ``sync_straggler_flags`` == 0 on the CLEAN epoch run, while the
+  planted-straggler run must flag (``straggler_flagged``) the CORRECT rank
+  (``straggler_rank_correct``) with zero unsanctioned transfers
 
 The baseline defaults to the NEWEST ``BENCH_r*.json`` in the repo root (pass
 ``--baseline`` to pin one) — a stale envelope can no longer be compared
@@ -67,14 +76,22 @@ _CHECKS = (
     ("engine", "sentinel_host_transfers", "abs", 0),
     ("engine", "ledger_executables", "true", None),
     ("engine", "telemetry_prometheus_lines", "true", None),
+    ("engine", "telemetry_histogram_series", "true", None),
     ("engine", "ledger_compile_ms_total", "slack", 60000.0),
     ("engine", "ledger_peak_bytes_max", "slack", 1 << 28),
+    ("engine", "profile_probes", "true", None),
+    ("engine", "profile_host_transfers", "abs", 0),
+    ("engine", "profiler_overhead_pct", "abs", 2.0),
     ("epoch", "packed_collectives_per_sync", "max", 2),
     ("epoch", "packed_metadata_gathers_per_sync", "max", 1),
     ("epoch", "epoch_compute_retraces_after_warmup", "max", 0),
     ("epoch", "parity_ok", "true", None),
     ("epoch", "epoch_host_transfers", "abs", 0),
     ("epoch", "epoch_retraces_uncaused", "abs", 0),
+    ("epoch", "sync_straggler_flags", "abs", 0),
+    ("epoch", "straggler_flagged", "true", None),
+    ("epoch", "straggler_rank_correct", "true", None),
+    ("epoch", "straggler_host_transfers", "abs", 0),
 )
 
 

@@ -49,8 +49,27 @@ elif ! grep -q '"sentinel_nan_flagged": true' "$BENCH_OUT" || ! grep -q '"sentin
 elif ! grep -q '"ledger_executables"' "$BENCH_OUT" || ! grep -q '"ledger_compile_ms_total"' "$BENCH_OUT"; then
   echo "bench smoke: FAILED (cost/memory ledger missing from output)"
   status=1
+elif ! grep -q '"straggler_rank_correct": true' "$BENCH_OUT" || ! grep -q '"sync_straggler_flags": 0' "$BENCH_OUT"; then
+  # profiling gate: the planted world-2 straggler must attribute the correct
+  # rank while the clean packed run stays skew-free
+  echo "bench smoke: FAILED (straggler not attributed / clean run flagged a straggler)"
+  status=1
+elif ! grep -q '"profile_host_transfers": 0' "$BENCH_OUT" || ! grep -q '"dispatch_p99_us"' "$BENCH_OUT"; then
+  echo "bench smoke: FAILED (profiled run missing p50/p99 histograms or did a host transfer)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling counters present)"
+fi
+
+echo
+echo "=== bench counter trend ==="
+# Longitudinal view across every committed BENCH_r*.json round (informational)
+# + hard failure when a key counter regresses past the newest committed
+# envelope beyond the slack rules (the slow-boil regression class a single
+# baseline diff cannot see).
+if ! python scripts/bench_trend.py --bench-json "$BENCH_OUT"; then
+  echo "bench trend: FAILED (key counter regressed past the newest envelope)"
+  status=1
 fi
 
 echo

@@ -1,0 +1,365 @@
+"""Profiling-layer tests (diag/profile.py + diag/hist.py + diag/timeline.py):
+histogram quantile error bounds, sampled completion probes under the strict
+transfer guard, packed-sync straggler detection in an emulated two-rank world
+(one rank genuinely sleeping pre-sync), merged-timeline determinism, and the
+profile-off zero-probe invariant."""
+
+import json
+import time
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.diag import (
+    diag_context,
+    export_prometheus,
+    profile_context,
+    profile_snapshot,
+    telemetry_snapshot,
+    transfer_guard,
+)
+from torchmetrics_tpu.diag import hist as hist_mod
+from torchmetrics_tpu.diag import profile as profile_mod
+from torchmetrics_tpu.diag import timeline as timeline_mod
+from torchmetrics_tpu.diag.hist import GROWTH, Histogram
+from torchmetrics_tpu.diag.timeline import merge_timelines, resolve_arrivals, stamp_arrival
+from torchmetrics_tpu.engine import engine_context, engine_report, reset_engine_stats
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+DISTRIBUTED = staticmethod(lambda: True)
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    reset_engine_stats()
+    yield
+    reset_engine_stats()
+
+
+class FloatSum(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+def _world2(monkeypatch, gather):
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather", gather)
+
+
+# ------------------------------------------------------------------ histograms
+
+
+@pytest.mark.parametrize("q", [0.5, 0.9, 0.99])
+def test_histogram_quantile_error_bound_vs_exact(q):
+    """The estimate is the upper bound of the exact sample's bucket: for every
+    in-range distribution, exact <= estimate <= exact * GROWTH."""
+    rng = np.random.RandomState(17)
+    for samples in (
+        rng.lognormal(mean=4.0, sigma=1.5, size=5000),  # latency-shaped tail
+        rng.uniform(1.0, 1e6, size=3000),
+        np.full(100, 42.0),  # degenerate: all equal
+    ):
+        hist = Histogram()
+        for v in samples:
+            hist.record(float(v))
+        exact = float(np.sort(samples)[max(1, int(np.ceil(q * len(samples)))) - 1])
+        est = hist.quantile(q)
+        assert est >= exact * (1 - 1e-9), f"q={q}: {est} < exact {exact}"
+        assert est <= exact * GROWTH * (1 + 1e-9), f"q={q}: {est} > {GROWTH}x exact {exact}"
+
+
+def test_histogram_fixed_memory_and_overflow():
+    hist = Histogram()
+    n_slots = len(hist.counts)
+    for v in [0.0, 1e-9, 3.5, 1e12, 2.5e9]:  # under- and overflow included
+        hist.record(v)
+    hist.record(float("nan"))  # must not poison sum/min/max
+    assert len(hist.counts) == n_slots  # bounded: no per-event storage
+    assert hist.total == 5
+    assert hist.max == 1e12
+    # overflow ranks report the recorded max, not a fake top boundary
+    assert hist.quantile(1.0) == 1e12
+    # the cumulative bucket list ends with the +Inf bucket == total count
+    assert hist.nonempty_buckets()[-1] == (None, 5)
+
+
+def test_histogram_registry_snapshot_sorted_and_reset():
+    hist_mod.observe("B", "update", "dispatch_us", 5.0)
+    hist_mod.observe("A", "update", "dispatch_us", 2.0)
+    rows = hist_mod.histograms_snapshot()
+    assert [r["owner"] for r in rows] == ["A", "B"]
+    assert all(r["count"] == 1 and r["p50"] is not None for r in rows)
+    hist_mod.reset_histograms()
+    assert hist_mod.histograms_snapshot() == []
+
+
+# ------------------------------------------------------------------ probes
+
+
+def test_profile_off_records_zero_probes():
+    with engine_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        for _ in range(6):
+            m.update(jnp.ones((4,)))
+    assert profile_snapshot()["probes"] == 0
+    assert engine_report()["profile_probes"] == 0
+    assert rec.counts.get("update.probe", 0) == 0
+    # no device_us series was fed either
+    assert not any(r["series"] == "device_us" for r in hist_mod.histograms_snapshot())
+
+
+def test_sampled_probes_under_strict_guard():
+    """Every Nth warm dispatch blocks at a sanctioned boundary: device_us is
+    measured, and the strict transfer guard stays silent throughout."""
+    with engine_context(True), profile_context(every_n=2), diag_context() as rec, transfer_guard("strict"):
+        m = FloatSum(compiled_update=True)
+        for _ in range(9):  # 1 cold + 8 warm -> 4 probes at every_n=2
+            m.update(jnp.ones((4,)))
+    assert rec.count("transfer.host", "transfer.blocked") == 0
+    probes = [e for e in rec.snapshot() if e.kind == "update.probe"]
+    assert len(probes) == 4
+    assert all(e.data["device_us"] > 0 for e in probes)
+    assert engine_report()["profile_probes"] == 4
+    snap = profile_snapshot()
+    assert snap["probes"] == 4 and snap["per_site"]["FloatSum:update"]["warm_dispatches"] == 8
+    rows = {(r["kind"], r["series"]): r for r in hist_mod.histograms_snapshot()}
+    assert rows[("update", "device_us")]["count"] == 4
+    assert rows[("update", "dispatch_us")]["count"] == 9
+
+
+def test_dispatch_events_carry_dispatch_us_with_deprecated_alias():
+    with engine_context(True), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.update(jnp.ones((4,)))
+    (ev,) = [e for e in rec.snapshot() if e.kind == "update.dispatch"]
+    assert ev.data["dispatch_us"] > 0
+    assert ev.data["dur_us"] == ev.data["dispatch_us"]  # one-release alias
+
+
+def test_eager_update_timed_into_histograms():
+    with diag_context() as rec:
+        m = FloatSum(compiled_update=False)
+        m.update(jnp.ones((4,)))
+    (ev,) = [e for e in rec.snapshot() if e.kind == "update.eager"]
+    assert ev.data["dispatch_us"] > 0 and ev.data["dur_us"] == ev.data["dispatch_us"]
+    assert any(
+        r["kind"] == "eager" and r["series"] == "dispatch_us" for r in hist_mod.histograms_snapshot()
+    )
+
+
+def test_profile_context_validates_and_env_parsing(monkeypatch):
+    with pytest.raises(ValueError):
+        profile_context(every_n=0).__enter__()
+    monkeypatch.setenv(profile_mod.PROFILE_ENV_VAR, "8")
+    assert profile_mod.active_profile() == 8
+    monkeypatch.setenv(profile_mod.PROFILE_ENV_VAR, "1")
+    assert profile_mod.active_profile() == profile_mod.DEFAULT_EVERY_N
+    monkeypatch.setenv(profile_mod.PROFILE_ENV_VAR, "0")
+    assert profile_mod.active_profile() is None
+
+
+# ------------------------------------------------------------------ straggler
+
+
+def test_planted_straggler_world2_attributes_correct_rank(monkeypatch):
+    """World-2 in-process; 'rank 1' genuinely sleeps before stamping its
+    barrier arrival. After a calibration sync, the skew is measured, the
+    straggler flag counts, and the event names rank 1 — all under STRICT."""
+    plant = {"on": False}
+
+    def gather(x, tiled=False):
+        # the metadata probe is the only HOST ndarray through the gather —
+        # state buffers arrive as jax arrays and must never be perturbed
+        is_meta = isinstance(x, np.ndarray) and x.ndim == 1 and x.dtype == np.int32
+        arr = np.asarray(x)
+        rows = [arr, arr]
+        if plant["on"] and is_meta:
+            time.sleep(0.03)  # rank 1 straggles into the packed sync
+            rows[1] = stamp_arrival(arr)
+        return np.stack(rows)
+
+    _world2(monkeypatch, gather)
+    with engine_context(True), profile_context(every_n=4), diag_context() as rec, transfer_guard("strict"):
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = DISTRIBUTED
+        m.update(jnp.ones((4,)))
+        m.compute()  # calibration sync: anchors the barrier-exit stamps
+        eng = m._epoch
+        assert eng.stats.sync_straggler_flags == 0
+        m.reset()
+        m.update(jnp.ones((4,)))
+        plant["on"] = True
+        m.compute()
+    assert eng.stats.sync_straggler_flags == 1
+    (ev,) = [e for e in rec.snapshot() if e.kind == "sync.straggler"]
+    assert ev.data["rank"] == 1
+    assert 20_000 < ev.data["skew_us"] < 2_000_000  # ~30 ms sleep, generous slack
+    assert rec.count("transfer.host", "transfer.blocked") == 0
+
+
+def test_straggler_below_threshold_not_flagged(monkeypatch):
+    _world2(monkeypatch, lambda x, tiled=False: np.stack([np.asarray(x)] * 2))
+    with engine_context(True), profile_context(every_n=4), diag_context() as rec:
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = DISTRIBUTED
+        for _ in range(2):  # identical-rank stamps: zero skew, even calibrated
+            m.update(jnp.ones((4,)))
+            m.compute()
+            m.reset()
+    assert engine_report()["sync_straggler_flags"] == 0
+    assert rec.counts.get("sync.straggler", 0) == 0
+
+
+def test_timeline_layout_version_mismatch_fails_loud(monkeypatch):
+    """A rank gathering a foreign layout version (asymmetric profiling
+    enablement) must error on the metadata, not mis-parse it."""
+
+    def gather(x, tiled=False):
+        is_meta = isinstance(x, np.ndarray) and x.ndim == 1 and x.dtype == np.int32
+        arr = np.asarray(x)
+        if not is_meta:
+            return np.stack([arr, arr])
+        bad = np.array(arr, copy=True)
+        bad[-3] = 99  # the version slot of "rank 1"
+        return np.stack([arr, bad])
+
+    _world2(monkeypatch, gather)
+    with engine_context(True), profile_context(every_n=4):
+        m = FloatSum(compiled_update=True)
+        m.distributed_available_fn = DISTRIBUTED
+        m.update(jnp.ones((4,)))
+        with pytest.raises(TorchMetricsUserError, match="timeline layout"):
+            m.compute()
+
+
+def test_resolve_arrivals_offset_correction():
+    # rank 1's clock runs 500 us ahead: same true arrival, skewed raw stamps
+    res = resolve_arrivals(prev_post=[1000, 1500], arrivals=[2000, 2500], local_rank=0)
+    assert res["calibrated"] and res["offsets_us"] == [0, 500]
+    assert res["corrected_us"] == [2000, 2000] and res["skew_us"] == 0
+    # uncalibrated (a rank has no prior sync): raw arrivals, zero offsets
+    res = resolve_arrivals(prev_post=[0, 1500], arrivals=[2000, 2600], local_rank=0)
+    assert not res["calibrated"] and res["offsets_us"] == [0, 0]
+    assert res["skew_us"] == 600 and res["last_rank"] == 1
+
+
+# ------------------------------------------------------------------ timeline merge
+
+
+def _two_rank_streams():
+    streams = []
+    for rank, offset in ((0, 0.0), (1, 250.0)):
+        with diag_context() as rec:
+            m = FloatSum(compiled_update=True)
+            with engine_context(True):
+                for _ in range(3):
+                    m.update(jnp.ones((2,)))
+        streams.append({"rank": rank, "events": rec.snapshot(), "clock_offset_us": offset})
+    return streams
+
+
+def test_merge_timelines_deterministic_and_monotone(tmp_path):
+    streams = _two_rank_streams()
+    trace_a = merge_timelines(streams, path=str(tmp_path / "merged.json"))
+    trace_b = merge_timelines(streams)
+    # byte-stable: identical inputs serialize identically
+    assert json.dumps(trace_a, sort_keys=True) == json.dumps(trace_b, sort_keys=True)
+    with open(tmp_path / "merged.json") as fh:
+        assert json.load(fh) == trace_a
+    events = trace_a["traceEvents"]
+    # per-rank process tracks with metadata names
+    names = {e["pid"]: e["args"]["name"] for e in events if e.get("name") == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    # per-rank clocks stay monotone after offset correction (slices compare by
+    # END time = ts + dur; the recorder stamps events at completion)
+    for rank in (0, 1):
+        ends = [
+            e["ts"] + e.get("dur", 0.0)
+            for e in events
+            if e.get("pid") == rank and e.get("ph") in ("X", "i")
+        ]
+        assert ends == sorted(ends)
+
+
+def test_merge_timelines_accepts_export_json_shape():
+    streams = _two_rank_streams()
+    as_dicts = [
+        {
+            "rank": s["rank"],
+            "clock_offset_us": s["clock_offset_us"],
+            "events": [
+                {"seq": e.seq, "ts_us": e.ts * 1e6, "kind": e.kind, "owner": e.owner, **e.data}
+                for e in s["events"]
+            ],
+        }
+        for s in streams
+    ]
+    a = merge_timelines(streams)
+    b = merge_timelines(as_dicts)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+# ------------------------------------------------------------------ exposition
+
+
+def test_prometheus_histogram_exposition_conformance():
+    from tests.test_telemetry import parse_exposition
+
+    with engine_context(True), profile_context(every_n=2), diag_context():
+        m = FloatSum(compiled_update=True)
+        for _ in range(7):
+            m.update(jnp.ones((4,)))
+    text = export_prometheus()
+    samples, types = parse_exposition(text)
+    fam = "tm_tpu_dispatch_latency_seconds"
+    assert types[fam] == "histogram"
+    assert types["tm_tpu_device_latency_seconds"] == "histogram"
+    buckets = [
+        (labels, v) for (name, labels), v in samples.items() if name == f"{fam}_bucket"
+        and any(l.startswith('kind="update"') for l in labels)
+    ]
+    assert buckets, "no _bucket samples for the update dispatch histogram"
+    # cumulative counts are monotone in le, and +Inf equals _count
+    def le_of(labels):
+        raw = next(l for l in labels if l.startswith('le="')).split('"')[1]
+        return float("inf") if raw == "+Inf" else float(raw)
+
+    ordered = sorted(buckets, key=lambda kv: le_of(kv[0]))
+    values = [v for _, v in ordered]
+    assert values == sorted(values)
+    count_key = next(
+        (name, labels) for (name, labels) in samples
+        if name == f"{fam}_count" and any(l.startswith('kind="update"') for l in labels)
+    )
+    assert values[-1] == samples[count_key] == 7
+    sum_key = (f"{fam}_sum", count_key[1])
+    assert samples[sum_key] > 0
+    # latency is exported in SECONDS: 7 dispatches on CPU take well under 7s
+    assert samples[sum_key] < 7.0
+
+
+def test_snapshot_includes_histograms_and_profile_sections():
+    with engine_context(True), profile_context(every_n=2), diag_context():
+        m = FloatSum(compiled_update=True)
+        for _ in range(5):
+            m.update(jnp.ones((4,)))
+        snap = telemetry_snapshot()
+    assert snap["profile"]["active"] and snap["profile"]["every_n"] == 2
+    assert snap["profile"]["probes"] >= 1
+    rows = {(r["kind"], r["series"]) for r in snap["histograms"]}
+    assert ("update", "dispatch_us") in rows and ("update", "device_us") in rows

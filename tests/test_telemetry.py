@@ -91,8 +91,48 @@ class IntCount(Metric):
 # ------------------------------------------------------------------ prometheus
 
 
+#: unit suffixes the exposition conventions recognise for this exporter; any
+#: series introduced from the profiling layer onward MUST end in one of these
+#: (before a histogram's _bucket/_sum/_count or a counter's _total)
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_flops")
+
+#: families whose value is a pure EVENT/OBJECT COUNT or an enum bitmask — the
+#: exposition conventions require no unit suffix for those (`http_requests_total`
+#: style). Any series measuring a physical quantity (time, size, rate) must
+#: NOT be added here; give it a `_seconds`/`_bytes`/`_flops` spelling instead.
+UNITLESS_COUNT_FAMILIES = {
+    "tm_tpu_traces", "tm_tpu_cache_hits", "tm_tpu_dispatches", "tm_tpu_metrics_updated",
+    "tm_tpu_eager_fallbacks", "tm_tpu_donated_dispatches", "tm_tpu_donation_copies",
+    "tm_tpu_donation_fallbacks", "tm_tpu_bucketed_steps", "tm_tpu_bucket_pad_rows",
+    "tm_tpu_packed_syncs", "tm_tpu_sync_collectives", "tm_tpu_sync_metadata_gathers",
+    "tm_tpu_sync_fold_traces", "tm_tpu_sync_divergence_flags", "tm_tpu_sync_straggler_flags",
+    "tm_tpu_compute_traces", "tm_tpu_compute_dispatches", "tm_tpu_compute_cache_hits",
+    "tm_tpu_profile_probes", "tm_tpu_engines", "tm_tpu_retrace_causes",
+    "tm_tpu_fallback_reasons", "tm_tpu_events", "tm_tpu_events_dropped",
+    "tm_tpu_ledger_executables", "tm_tpu_sentinel_flags",
+}
+
+
+def _family_of(name):
+    """Strip the sample-level suffixes down to the TYPE-header family name."""
+    for suffix in ("_bucket", "_sum", "_count", "_total"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def _base_of(family):
+    """The unit-bearing base: family minus a trailing _total (counters)."""
+    return family[: -len("_total")] if family.endswith("_total") else family
+
+
 def parse_exposition(text):
-    """Minimal Prometheus text-exposition parser: {(name, labels): value}."""
+    """Minimal Prometheus text-exposition parser: {(name, labels): value}.
+
+    Beyond syntax, enforces the unit-suffix convention: every family must end
+    in a recognised unit (``_seconds``/``_bytes``/``_flops``) or sit in the
+    explicit legacy allowlist — a NEW unitless series fails the parse.
+    """
     samples = {}
     types = {}
     for line in text.splitlines():
@@ -107,8 +147,14 @@ def parse_exposition(text):
             continue
         match = SAMPLE_RE.match(line)
         assert match is not None, f"unparseable sample line: {line!r}"
+        name = match.group("name")
+        base = _base_of(_family_of(name))
+        assert base.endswith(UNIT_SUFFIXES) or base in UNITLESS_COUNT_FAMILIES, (
+            f"series {name!r} lacks a unit suffix ({UNIT_SUFFIXES}) and is not a"
+            " recognised count/enum family — name new series with their unit"
+        )
         labels = tuple(sorted((match.group("labels") or "").split(","))) if match.group("labels") else ()
-        samples[(match.group("name"), labels)] = float(match.group("value"))
+        samples[(name, labels)] = float(match.group("value"))
     return samples, types
 
 
@@ -123,13 +169,31 @@ def test_prometheus_roundtrip_through_parser():
     assert samples, "exposition output is empty"
     # every sample's metric family carries a TYPE header
     for (name, _), _value in samples.items():
-        family = name[: -len("_total")] if name.endswith("_total") else name
-        assert name in types or family in types, f"sample {name} has no TYPE header"
+        assert name in types or _family_of(name) in types, f"sample {name} has no TYPE header"
     # counter values round-trip exactly
     counters = snap["counters"]
     assert samples[("tm_tpu_dispatches_total", ())] == counters["dispatches"]
     assert samples[("tm_tpu_traces_total", ())] == counters["traces"]
     assert samples[("tm_tpu_ledger_executables", ())] == snap["ledger"]["totals"]["executables"]
+    # unit-suffix conformance of the renamed families (the satellite fix):
+    # bytes/seconds land as the name suffix, the unitless spellings are gone
+    assert ("tm_tpu_moved_bytes_total", ()) in samples
+    assert ("tm_tpu_ledger_compile_seconds_total", ()) in samples
+    assert not any(name == "tm_tpu_bytes_moved_total" for name, _ in samples)
+    assert not any(name == "tm_tpu_ledger_compile_ms_total" for name, _ in samples)
+    assert samples[("tm_tpu_ledger_compile_seconds_total", ())] == pytest.approx(
+        snap["ledger"]["totals"]["compile_ms"] / 1e3
+    )
+
+
+def test_prometheus_rejects_unitless_new_series():
+    """The minimal parser IS the conformance gate: a hypothetical unitless
+    new series must fail it."""
+    with pytest.raises(AssertionError, match="unit suffix"):
+        parse_exposition("tm_tpu_new_fancy_latency 1.0\n")
+    # unit-suffixed spellings of the same series pass
+    parse_exposition("tm_tpu_new_fancy_latency_seconds 1.0\n")
+    parse_exposition("tm_tpu_new_fancy_size_bytes_total 2\n")
 
 
 def test_prometheus_deterministic_and_writes_file(tmp_path):

@@ -29,6 +29,8 @@ from copy import deepcopy
 from time import perf_counter as _perf_counter
 from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.data import allclose
@@ -188,18 +190,23 @@ class MetricCollection:
         """
         if self._groups_checked:
             rec = _diag.active_recorder()
-            t_step = _perf_counter() if rec is not None else 0.0
+            measuring = rec is not None or _profile.active_profile() is not None
+            t_step = _perf_counter() if measuring else 0.0
             owners = [(group.owner, self._modules[group.owner]) for group in self._groups.values()]
             handled = self._fused_step(owners, args, kwargs)
             for name, metric in owners:
                 if name not in handled:
                     metric.update(*args, **metric._filter_kwargs(**kwargs))
-            if rec is not None:
-                rec.record(
-                    "collection.step", type(self).__name__,
-                    dur_us=round((_perf_counter() - t_step) * 1e6, 3),
-                    owners=len(owners), fused=len(handled),
-                )
+            if measuring:
+                step_us = round((_perf_counter() - t_step) * 1e6, 3)
+                _hist.observe(type(self).__name__, "collection", "dispatch_us", step_us)
+                if rec is not None:
+                    # dur_us: deprecated alias of dispatch_us, kept one release
+                    rec.record(
+                        "collection.step", type(self).__name__,
+                        dispatch_us=step_us, dur_us=step_us,
+                        owners=len(owners), fused=len(handled),
+                    )
             donated = bool(handled) or any(
                 m._engine is not None and m._engine.stats.donated_dispatches for _, m in owners
             )

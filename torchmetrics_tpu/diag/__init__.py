@@ -1,6 +1,7 @@
-"""Diagnostics subsystem — flight recorder, transfer guard, telemetry layer.
+"""Diagnostics subsystem — flight recorder, transfer guard, telemetry,
+profiling layer.
 
-Always available, near-zero overhead when off. Six pieces:
+Always available, near-zero overhead when off. Nine pieces:
 
 - :mod:`~torchmetrics_tpu.diag.trace` — a contextvar-scoped ring-buffer flight
   recorder of structured engine events (dispatches, traces and retraces *with
@@ -28,6 +29,21 @@ Always available, near-zero overhead when off. Six pieces:
   counters into a per-metric report (:func:`diag_report`) and exports the
   stream as JSON (:func:`export_json`) or a Perfetto-loadable chrome trace
   (:func:`export_chrome_trace`).
+- :mod:`~torchmetrics_tpu.diag.profile` — runtime profiling: every engine
+  dispatch is annotated ``tm:<owner>:<kind>:<signature>`` for native
+  XLA/Perfetto attribution, and opt-in sampled completion probes
+  (:func:`profile_context` / ``TORCHMETRICS_TPU_PROFILE``) measure true
+  ``device_us`` on every Nth warm dispatch without breaking the strict
+  transfer guard on unsampled steps.
+- :mod:`~torchmetrics_tpu.diag.hist` — fixed-memory log-bucketed latency/size
+  histograms per (owner, kind): p50/p90/p99 in :func:`diag_report` /
+  :func:`telemetry_snapshot`, proper ``histogram`` exposition in
+  :func:`export_prometheus`.
+- :mod:`~torchmetrics_tpu.diag.timeline` — cross-rank timeline merge
+  (:func:`merge_timelines`: one Perfetto trace with per-rank process tracks)
+  and packed-sync straggler detection from barrier timestamps piggybacked on
+  the metadata gather (``sync.straggler`` events +
+  ``EngineStats.sync_straggler_flags``).
 
 See ``docs/pages/observability.md`` for the event taxonomy, the retrace-cause
 glossary, the ledger field glossary, the sentinel bit layout, and the
@@ -35,7 +51,16 @@ Prometheus scrape example.
 """
 
 from torchmetrics_tpu.diag.costs import ledger_snapshot, reset_ledger, state_footprint
+from torchmetrics_tpu.diag.hist import histograms_snapshot, reset_histograms
+from torchmetrics_tpu.diag.profile import (
+    profile_context,
+    profile_snapshot,
+    set_profile_every_n,
+    set_straggler_threshold_us,
+    straggler_threshold_us,
+)
 from torchmetrics_tpu.diag.report import diag_report, export_chrome_trace, export_json
+from torchmetrics_tpu.diag.timeline import merge_timelines
 from torchmetrics_tpu.diag.sentinel import (
     SENTINEL_BITS,
     audit_context,
@@ -71,14 +96,22 @@ __all__ = [
     "export_json",
     "export_jsonl",
     "export_prometheus",
+    "histograms_snapshot",
     "ledger_snapshot",
+    "merge_timelines",
+    "profile_context",
+    "profile_snapshot",
     "read_sentinel",
     "record",
+    "reset_histograms",
     "reset_ledger",
     "reset_sentinels",
     "sentinel_context",
     "sentinel_report",
+    "set_profile_every_n",
+    "set_straggler_threshold_us",
     "state_footprint",
+    "straggler_threshold_us",
     "telemetry_snapshot",
     "transfer_allowed",
     "transfer_guard",

@@ -1,0 +1,167 @@
+"""Fixed-memory log-bucketed latency/size histograms (HDR-style).
+
+The flight recorder answers "what happened"; averages answer almost nothing
+about latency — a mean dispatch time hides the p99 stall that actually gates a
+pod-scale step. This module records latency *distributions* with **bounded
+memory and no per-event storage**: each histogram is a fixed array of integer
+bucket counts over geometrically spaced boundaries, so recording is O(log
+buckets) (one ``bisect`` + one increment), a million samples cost the same
+bytes as ten, and quantiles come out with a guaranteed relative error bound.
+
+Bucket scheme (the HDR trade): boundaries grow by a constant factor
+``GROWTH = 2**(1/4)`` (four sub-buckets per octave), spanning
+``2**-2 .. 2**30`` — for microsecond latencies that is 0.25 µs to ~18 minutes,
+for byte sizes 0.25 B to 1 GiB. The counts array is 130 fixed int slots: one
+per boundary (values at or below the first bound share bucket 0 — there is no
+separate underflow slot) plus one overflow slot past the top. A quantile
+estimate returns the **upper bound** of the bucket holding that rank, so for
+any in-range sample quantile ``q``: ``q <= estimate <= q * GROWTH`` — a
+≤ 18.92% one-sided relative error, verified against exact quantiles in
+``tests/test_profile.py``.
+
+Histograms live in a process-wide registry keyed by ``(owner, kind, series)``
+— e.g. ``("fused:...", "fused", "dispatch_us")`` — and are fed by the engine
+hot paths only while something is observing (an active flight recorder or an
+active profile scope), so the un-observed hot loop pays nothing. Series names
+end in their unit (``_us``, ``_bytes``); the Prometheus exporter
+(:mod:`~torchmetrics_tpu.diag.telemetry`) renders them as proper
+``histogram`` families (``_bucket``/``_sum``/``_count`` with ``le`` labels)
+under unit-suffixed names (``_seconds``, ``_bytes``).
+
+``reset_histograms()`` participates in the shared
+:func:`~torchmetrics_tpu.engine.stats.reset_engine_stats` lockstep so a bench
+scenario can never attribute the previous scenario's tail to the fresh run.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from math import ceil
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "BOUNDS",
+    "GROWTH",
+    "Histogram",
+    "histograms_snapshot",
+    "observe",
+    "reset_histograms",
+]
+
+#: per-bucket growth factor: 4 sub-buckets per octave => <= 2**(1/4)-1 ~ 18.92%
+#: one-sided relative quantile error
+GROWTH = 2.0 ** 0.25
+
+#: geometric bucket upper bounds, 2**-2 .. 2**30 in quarter-octave steps
+#: (129 boundaries; +1 overflow slot). Shared by every histogram — boundaries
+#: are class-level constants, per-instance memory is the counts array only.
+BOUNDS: Tuple[float, ...] = tuple(2.0 ** (i / 4.0) for i in range(-8, 121))
+
+_N = len(BOUNDS)  # counts array length is _N + 1 (last slot = overflow)
+
+
+class Histogram:
+    """One fixed-memory log-bucketed histogram (counts + sum + min/max)."""
+
+    __slots__ = ("counts", "total", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts: List[int] = [0] * (_N + 1)
+        self.total = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def record(self, value: float) -> None:
+        """O(log buckets): one bisect + one increment. Never raises."""
+        v = float(value)
+        if v != v:  # NaN would silently poison sum/min/max
+            return
+        # bisect_left on the shared boundary tuple: first bound >= v; values
+        # past the top land in the overflow slot, <= 2**-2 in bucket 0
+        self.counts[bisect_left(BOUNDS, v)] += 1
+        self.total += 1
+        self.sum += v
+        self.min = v if self.min is None or v < self.min else self.min
+        self.max = v if self.max is None or v > self.max else self.max
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper bound of the bucket holding the ``q``-quantile sample.
+
+        Rank convention matches ``sorted(samples)[ceil(q * n) - 1]`` (the
+        "higher" interpolation), so for any recorded sample the estimate is
+        within ``[exact, exact * GROWTH]`` while the sample is in bucket
+        range; overflow-bucket ranks return the recorded ``max`` (exact-free
+        but honest — better than pretending the top boundary was the tail).
+        """
+        if self.total == 0:
+            return None
+        rank = min(self.total, max(1, ceil(q * self.total)))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                return BOUNDS[i] if i < _N else self.max
+        return self.max  # unreachable: cum == total >= rank
+
+    def nonempty_buckets(self) -> List[Tuple[Optional[float], int]]:
+        """Cumulative ``(upper_bound, cumulative_count)`` pairs at non-empty
+        buckets; the final pair's bound is ``None`` (the +Inf bucket)."""
+        out: List[Tuple[Optional[float], int]] = []
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c:
+                cum += c
+                out.append((BOUNDS[i] if i < _N else None, cum))
+        if not out or out[-1][0] is not None:
+            out.append((None, cum))
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "count": self.total,
+            "sum": round(self.sum, 3),
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+    def __repr__(self) -> str:
+        return f"Histogram(n={self.total}, p50={self.quantile(0.5)}, p99={self.quantile(0.99)})"
+
+
+# process-wide registry: (owner, kind, series) -> Histogram. Bounded by the
+# live (owner, kind) population x ~5 series names — not by event volume.
+_REGISTRY: Dict[Tuple[str, str, str], Histogram] = {}
+
+
+def observe(owner: str, kind: str, series: str, value: float) -> None:
+    """Record one sample into the ``(owner, kind, series)`` histogram.
+
+    Call sites gate on "is anything observing" (active recorder or active
+    profile scope) — this function itself always records.
+    """
+    hist = _REGISTRY.get((owner, kind, series))
+    if hist is None:
+        hist = _REGISTRY[(owner, kind, series)] = Histogram()
+    hist.record(value)
+
+
+def histograms_snapshot() -> List[Dict[str, Any]]:
+    """Every live histogram as a sorted row (byte-stable JSON ordering)."""
+    return [
+        {"owner": owner, "kind": kind, "series": series, **hist.as_dict()}
+        for (owner, kind, series), hist in sorted(_REGISTRY.items())
+    ]
+
+
+def histogram_items() -> List[Tuple[Tuple[str, str, str], Histogram]]:
+    """Sorted live ``((owner, kind, series), Histogram)`` pairs (exporter use)."""
+    return sorted(_REGISTRY.items())
+
+
+def reset_histograms() -> None:
+    """Drop every histogram (``reset_engine_stats`` calls this in lockstep)."""
+    _REGISTRY.clear()

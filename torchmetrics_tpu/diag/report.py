@@ -10,11 +10,14 @@ Three consumers, one data path:
   counter-regression tooling).
 - :func:`export_chrome_trace` writes the events in the Chrome Trace Event
   format (``{"traceEvents": [...]}``), loadable in Perfetto
-  (https://ui.perfetto.dev) — dispatch/step events with measured ``dur_us``
-  become duration ("X") slices on a per-owner track; everything else becomes
-  an instant ("i") marker. Durations are HOST-side spans (dispatch + Python
-  bookkeeping); device kernel time is asynchronous and belongs to
+  (https://ui.perfetto.dev) — dispatch/step events with a measured
+  ``dispatch_us`` (``dur_us`` is the deprecated alias) become duration ("X")
+  slices on a per-owner track; everything else becomes an instant ("i")
+  marker. Durations are HOST-side spans (async launch + Python bookkeeping);
+  device kernel time belongs to sampled ``device_us`` probes and to native
   ``jax.profiler`` traces, which these markers are designed to sit alongside.
+  Multi-rank streams merge into one trace via
+  :func:`torchmetrics_tpu.diag.timeline.merge_timelines`.
 """
 
 from __future__ import annotations
@@ -47,14 +50,25 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
           "counters": engine_report(),          # process-wide EngineStats sums
           "events": {kind: count},              # exact, drop-proof
           "dropped": int,                       # ring-buffer overflow count
-          "per_metric": {owner: {"dispatches", "host_us", "traces", "retraces",
+          "per_metric": {owner: {"dispatches", "dispatch_us", "device_us",
+                                 "probes", "host_us" (deprecated alias of
+                                 dispatch_us), "traces", "retraces",
                                  "fallbacks"}},
           "retraces": [{"owner", "kind", "cause"}],   # every recorded retrace
           "host_transfers": int,                # transfer.host + transfer.blocked
           "collective_bytes": int,              # bytes through sanctioned collectives
           "ledger": {...},                      # cost/memory ledger totals (diag/costs.py)
           "sentinels": [...],                   # per-metric health bitmasks (diag/sentinel.py)
+          "histograms": [...],                  # latency/size distributions (diag/hist.py):
+                                                # per (owner, kind, series) p50/p90/p99
+          "profile": {...},                     # sampled-probe accounting (diag/profile.py)
         }
+
+    Naming: ``dispatch_us`` is HOST wall-time around the **async** dispatch —
+    the launch cost, NOT device time (``host_us`` is its deprecated alias,
+    kept one release). True completion latency lives in ``device_us``,
+    populated only by sampled profiling probes (``profile_context`` /
+    ``TORCHMETRICS_TPU_PROFILE``).
 
     Dict sections are deterministically sorted so two reports of the same
     state serialize byte-identically (the counter gate diffs JSON exports).
@@ -62,8 +76,9 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
     ``reset=True`` clears every surface this report covers afterwards — the
     engine counters, THIS report's recorder (the explicitly passed one, or the
     active one when none is passed; never an unrelated recorder that merely
-    happens to be active), the cost ledger, and the sentinel registry — so a
-    later report never attributes this run's compiles or flags to the next.
+    happens to be active), the cost ledger, the sentinel registry, the
+    histograms, and the probe accounting — so a later report never attributes
+    this run's compiles or flags to the next.
     """
     from torchmetrics_tpu.engine.stats import engine_report, reset_engine_counters
 
@@ -72,7 +87,10 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
     counts: Counter = Counter(rec.counts) if rec is not None else Counter()
 
     per_metric: Dict[str, Dict[str, Any]] = defaultdict(
-        lambda: {"dispatches": 0, "host_us": 0.0, "traces": 0, "retraces": 0, "fallbacks": 0}
+        lambda: {
+            "dispatches": 0, "dispatch_us": 0.0, "device_us": 0.0, "probes": 0,
+            "traces": 0, "retraces": 0, "fallbacks": 0,
+        }
     )
     retraces: List[Dict[str, Any]] = []
     collective_bytes = 0
@@ -80,7 +98,10 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         slot = per_metric[ev.owner or "<process>"]
         if ev.kind in _SPAN_KINDS:
             slot["dispatches"] += 1
-            slot["host_us"] += float(ev.data.get("dur_us", 0.0))
+            slot["dispatch_us"] += float(ev.data.get("dispatch_us", ev.data.get("dur_us", 0.0)))
+        elif ev.kind.endswith(".probe"):
+            slot["probes"] += 1
+            slot["device_us"] += float(ev.data.get("device_us", 0.0))
         elif ev.kind.endswith(".trace"):
             slot["traces"] += 1
         elif ev.kind.endswith(".retrace") or ev.kind.endswith("fold_retrace"):
@@ -90,8 +111,12 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
             slot["fallbacks"] += 1
         elif ev.kind == "collective":
             collective_bytes += int(ev.data.get("bytes", 0))
+    for slot in per_metric.values():
+        slot["host_us"] = slot["dispatch_us"]  # deprecated alias, one release
 
     from torchmetrics_tpu.diag.costs import ledger_snapshot
+    from torchmetrics_tpu.diag.hist import histograms_snapshot
+    from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
 
     out: Dict[str, Any] = {
@@ -104,9 +129,13 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         "collective_bytes": collective_bytes,
         "ledger": ledger_snapshot()["totals"],
         "sentinels": sentinel_report(),
+        "histograms": histograms_snapshot(),
+        "profile": profile_snapshot(),
     }
     if reset:
         from torchmetrics_tpu.diag.costs import reset_ledger
+        from torchmetrics_tpu.diag.hist import reset_histograms
+        from torchmetrics_tpu.diag.profile import reset_profile
         from torchmetrics_tpu.diag.sentinel import reset_sentinels
 
         reset_engine_counters()
@@ -114,6 +143,8 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
             rec.clear()
         reset_ledger()
         reset_sentinels()
+        reset_histograms()
+        reset_profile()
     return out
 
 
@@ -133,8 +164,9 @@ def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) ->
     """Write the events as a Perfetto-loadable chrome trace; returns the count.
 
     Layout: one process (pid 0, "torchmetrics_tpu"), one thread track per event
-    owner. Events with a measured ``dur_us`` become complete ("X") slices
-    ending at their record timestamp; the rest are thread-scoped instants.
+    owner. Events with a measured ``dispatch_us`` (or legacy ``dur_us``)
+    become complete ("X") slices ending at their record timestamp; the rest
+    are thread-scoped instants.
     Packed-sync ``collective`` events get a dedicated per-role track
     (``collective:reduce:int32``, ``collective:meta``, …) with their byte
     counts in ``args``, so sync cost sits visually next to compute cost
@@ -152,7 +184,7 @@ def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) ->
             owner = ev.owner or "<process>"
         tid = tids.setdefault(owner, len(tids) + 1)
         ts_us = ev.ts * 1e6
-        dur = float(ev.data.get("dur_us", 0.0))
+        dur = float(ev.data.get("dispatch_us", ev.data.get("dur_us", 0.0)))
         entry: Dict[str, Any] = {
             "name": ev.kind,
             "pid": 0,

@@ -2,8 +2,11 @@
 
 A production metrics stack scrapes; it does not attach a debugger. This module
 renders everything the diag subsystem knows — engine counters, retrace causes,
-fallback reasons, flight-recorder event counts, the cost/memory ledger, and
-the sentinel health states — as:
+fallback reasons, flight-recorder event counts, the cost/memory ledger, the
+sentinel health states, the fixed-memory latency/size histograms
+(``diag/hist.py``, exported as proper ``histogram`` families with
+``_bucket``/``_sum``/``_count`` and ``le`` labels under unit-suffixed
+``_seconds``/``_bytes`` names), and the profiler's probe accounting — as:
 
 - :func:`telemetry_snapshot` — one merged, JSON-serializable dict (the
   machine-readable superset);
@@ -50,9 +53,31 @@ _COUNTER_HELP = {
     "sync_bytes_moved": "bytes through packed-sync collectives",
     "sync_fold_traces": "fold / fused sync-compute executables compiled",
     "sync_divergence_flags": "rank-divergent rank-invariant states flagged by the audit",
+    "sync_straggler_flags": "packed syncs whose arrival skew exceeded the straggler threshold",
     "compute_traces": "compute executables compiled",
     "compute_dispatches": "cached compute dispatches",
     "compute_cache_hits": "compute dispatches served without a re-trace",
+    "profile_probes": "warm dispatches followed by a sampled completion probe",
+}
+
+# exposition-convention names for counters whose field name buries the unit:
+# per https://prometheus.io/docs/practices/naming/ the base unit is the name
+# SUFFIX (before _total), so `bytes_moved` exports as `moved_bytes`
+_COUNTER_EXPORT_NAME = {
+    "bytes_moved": "moved_bytes",
+    "sync_bytes_moved": "sync_moved_bytes",
+}
+
+# histogram series (diag/hist.py, recorded in µs / bytes) -> exposition
+# family name + value scale. Latencies export in SECONDS, sizes in BYTES —
+# unit-suffixed per the exposition conventions (the test parser rejects
+# unitless new series).
+_HIST_SERIES = {
+    "dispatch_us": ("dispatch_latency_seconds", 1e-6, "host wall-time of the async dispatch launch"),
+    "device_us": ("device_latency_seconds", 1e-6, "sampled dispatch-to-completion latency (profiling probes)"),
+    "sync_us": ("sync_latency_seconds", 1e-6, "packed-sync exchange wall-time"),
+    "compute_us": ("compute_latency_seconds", 1e-6, "cached/fused compute dispatch wall-time"),
+    "sync_bytes": ("sync_size_bytes", 1.0, "bytes through packed-sync collectives per exchange"),
 }
 
 
@@ -84,6 +109,8 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
     empty when recording is off). Purely a read — nothing is reset.
     """
     from torchmetrics_tpu.diag.costs import ledger_snapshot
+    from torchmetrics_tpu.diag.hist import histograms_snapshot
+    from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
     from torchmetrics_tpu.engine.stats import engine_report
 
@@ -95,6 +122,8 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
         "dropped": rec.dropped if rec is not None else 0,
         "ledger": ledger_snapshot(),
         "sentinels": sentinel_report(),
+        "histograms": histograms_snapshot(),
+        "profile": profile_snapshot(),
     }
 
 
@@ -119,7 +148,8 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
 
     for field in sorted(_COUNTER_HELP):
         if field in counters:
-            emit(f"{_PREFIX}_{field}_total", "counter", _COUNTER_HELP[field], [({}, counters[field])])
+            name = _COUNTER_EXPORT_NAME.get(field, field)
+            emit(f"{_PREFIX}_{name}_total", "counter", _COUNTER_HELP[field], [({}, counters[field])])
     emit(f"{_PREFIX}_engines", "gauge", "live engine instances", [({}, counters.get("engines", 0))])
     emit(
         f"{_PREFIX}_retrace_causes_total", "counter", "attributed causes of post-warmup compiles",
@@ -142,16 +172,18 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
     totals = ledger.get("totals", {})
     emit(f"{_PREFIX}_ledger_executables", "gauge", "compiled executables in the cost ledger",
          [({}, totals.get("executables", 0))])
-    emit(f"{_PREFIX}_ledger_compile_ms_total", "counter", "XLA compile wall-time across executables",
-         [({}, totals.get("compile_ms", 0.0))])
-    for field, help_text in (
-        ("flops", "XLA-estimated flops per execution"),
-        ("bytes_accessed", "XLA-estimated bytes accessed per execution"),
-        ("peak_bytes", "peak (args+outputs+temps+code) bytes of the executable"),
-        ("donation_savings_bytes", "state bytes the donation avoided copying"),
+    # unit-suffixed per the exposition conventions (seconds, not the ms the
+    # in-repo ledger dicts carry — JSON exports keep their field names)
+    emit(f"{_PREFIX}_ledger_compile_seconds_total", "counter", "XLA compile wall-time across executables",
+         [({}, totals.get("compile_ms", 0.0) / 1e3)])
+    for field, export_name, help_text in (
+        ("flops", "flops", "XLA-estimated flops per execution"),
+        ("bytes_accessed", "accessed_bytes", "XLA-estimated bytes accessed per execution"),
+        ("peak_bytes", "peak_bytes", "peak (args+outputs+temps+code) bytes of the executable"),
+        ("donation_savings_bytes", "donation_savings_bytes", "state bytes the donation avoided copying"),
     ):
         emit(
-            f"{_PREFIX}_ledger_{field}", "gauge", help_text,
+            f"{_PREFIX}_ledger_{export_name}", "gauge", help_text,
             [
                 ({"owner": e["owner"], "kind": e["kind"], "signature": e["signature"]}, e[field])
                 for e in ledger.get("executables", [])
@@ -163,6 +195,34 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
         f"{_PREFIX}_sentinel_flags", "gauge", "health-sentinel bitmask per metric (0 = healthy)",
         [({"owner": s["owner"]}, s["flags"]) for s in snap.get("sentinels", [])],
     )
+
+    # latency/size distributions as PROPER histogram exposition: cumulative
+    # `_bucket` samples with `le` labels (non-empty buckets + the mandatory
+    # +Inf), `_sum`, `_count`. One family per series, (owner, kind) labels.
+    from torchmetrics_tpu.diag.hist import histogram_items
+
+    by_family: Dict[str, List[Tuple[Dict[str, Any], Any]]] = {}
+    for (owner, kind, series), hist in histogram_items():
+        family = _HIST_SERIES.get(series)
+        if family is None:
+            continue
+        name, scale, _ = family
+        labels = {"owner": owner, "kind": kind}
+        rows = by_family.setdefault(name, [])
+        for bound, cum in hist.nonempty_buckets():
+            le = "+Inf" if bound is None else repr(bound * scale)
+            rows.append(({**labels, "le": le}, ("bucket", cum)))
+        rows.append((labels, ("sum", hist.sum * scale)))
+        rows.append((labels, ("count", hist.total)))
+    for series, (name, _, help_text) in sorted(_HIST_SERIES.items(), key=lambda kv: kv[1][0]):
+        rows = by_family.get(name)
+        if not rows:
+            continue
+        lines.append(f"# HELP {_PREFIX}_{name} {help_text}")
+        lines.append(f"# TYPE {_PREFIX}_{name} histogram")
+        for labels, (suffix, value) in rows:
+            lines.append(_sample(f"{_PREFIX}_{name}_{suffix}", labels, value))
+
     text = "\n".join(lines) + "\n" if lines else ""
     if path is not None:
         with open(path, "w") as fh:

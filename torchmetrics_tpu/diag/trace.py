@@ -34,22 +34,31 @@ Event taxonomy (the ``kind`` field; full glossary in
 =====================  ========================================================
 ``update.trace``       first compile of an update signature (``cause="initial"``)
 ``update.retrace``     a later compile — ``cause`` attributes it (see below)
-``update.dispatch``    one compiled update execution (``dur_us``, donation info)
-``update.eager``       an update that ran the eager Python body
-``fused.trace/retrace/dispatch``  the collection-fused analogues
+``update.dispatch``    one compiled update execution (``dispatch_us``, donation info)
+``update.probe``       a sampled completion probe (``device_us`` — true latency)
+``update.eager``       an update that ran the eager Python body (``dispatch_us``)
+``fused.trace/retrace/dispatch/probe``  the collection-fused analogues
 ``fused.exclude``      a member excluded from the fused executable (``reason``)
 ``sync.exchange``      one packed sync exchange (world, buffers, metadata)
 ``collective``         one backbone collective (``label`` = role:dtype, bytes)
 ``sync.fold_trace/fold_retrace``  fold executable compiles (``cause``)
 ``sync.eager``         a sync that fell back to the per-tensor eager path
 ``sync.audit``         a divergence-audit finding (``attr``, ``flag``)
+``sync.straggler``     a packed sync whose corrected arrival skew crossed the
+                       threshold (``rank`` = the straggler, ``skew_us``)
 ``compute.trace/retrace``  compute executable compiles (``cause``)
-``compute.dispatch``   one cached/fused compute execution (``dur_us``)
-``collection.step``    one MetricCollection update step (``dur_us``, ``owners``, ``fused``)
+``compute.dispatch``   one cached/fused compute execution (``dispatch_us``)
+``compute.probe``      a sampled compute completion probe (``device_us``)
+``collection.step``    one MetricCollection update step (``dispatch_us``, ``owners``, ``fused``)
 ``fallback``           every eager fallback, with its reason string
 ``transfer.host``      a device→host readback observed in ``log`` guard mode
 ``transfer.blocked``   a readback the ``strict`` guard refused
 =====================  ========================================================
+
+Timing fields: ``dispatch_us`` is HOST wall-time around an **asynchronous**
+dispatch — the launch cost, not device time (``dur_us`` is its deprecated
+alias, kept one release). True completion latency is ``device_us``, measured
+only on sampled probe events (:mod:`torchmetrics_tpu.diag.profile`).
 
 Retrace causes (:func:`attribute_retrace`): ``bucket-miss``, ``dtype-change``,
 ``treedef-change``, ``shape-change``, ``plan-change``, ``device-change`` —

@@ -40,6 +40,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from torchmetrics_tpu.diag import costs as _costs
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
@@ -48,6 +50,8 @@ from torchmetrics_tpu.engine.compiled import (
     _Ineligible,
     _container_changed,
     _is_jax_array,
+    annotation_scope,
+    completion_probe,
     holds_nested_metrics,
 )
 from torchmetrics_tpu.engine.stats import EngineStats
@@ -187,7 +191,8 @@ def _exchange(
     for. Metadata validation errors propagate (fail loud on every rank).
     """
     rec = _diag.active_recorder()
-    t0 = perf_counter() if rec is not None else 0.0
+    measuring = rec is not None or _profile.active_profile() is not None
+    t0 = perf_counter() if measuring else 0.0
     meta = plan.metadata_local()
     had_meta = False
     if meta is None:
@@ -224,10 +229,32 @@ def _exchange(
                 "sync.audit", finding["owner"] or stats.owner,
                 attr=finding["attr"], flag=finding["flag"], divergent=finding["divergent"],
             )
+    # cross-rank timeline (diag/timeline.py, piggybacked on the metadata
+    # gather): offset-corrected barrier arrivals attribute the straggler rank;
+    # a skew past the threshold is a counted, recorded fact
+    timeline = getattr(plan, "timeline_result", None)
+    if timeline is not None:
+        skew = timeline["skew_us"]
+        if timeline["calibrated"] and skew > _profile.straggler_threshold_us():
+            stats.sync_straggler_flags += 1
+            _diag.record(
+                "sync.straggler", stats.owner,
+                rank=timeline["last_rank"], skew_us=skew,
+                corrected_us=tuple(timeline["corrected_us"]),
+                offsets_us=tuple(timeline["offsets_us"]),
+            )
+    if _profile.active_profile() is not None:
+        # barrier-exit anchor: the NEXT sync's gathered prev_post stamps
+        # estimate per-rank clock offsets from this collective's exit
+        _profile.note_sync_exit()
+    sync_us = round((perf_counter() - t0) * 1e6, 3) if measuring else 0.0
+    if measuring:
+        _hist.observe(stats.owner, "sync", "sync_us", sync_us)
+        _hist.observe(stats.owner, "sync", "sync_bytes", bytes_moved)
     if rec is not None:
         rec.record(
             "sync.exchange", stats.owner,
-            dur_us=round((perf_counter() - t0) * 1e6, 3),
+            dispatch_us=sync_us, dur_us=sync_us,
             world=plan.world_size, buffers=len(local), metadata=had_meta, bytes=bytes_moved,
         )
     return gathered
@@ -261,13 +288,18 @@ def _run_fold(
     entry = cache.get(sig)
     first = entry is None
     try:
-        if first:
-            import jax
+        import jax
 
-            entry = _costs.aot_compile(
-                jax.jit(plan.make_fold()), owner=stats.owner, kind="sync-fold", args=(gathered,)
+        if first:
+            entry = (
+                _costs.aot_compile(
+                    jax.jit(plan.make_fold()), owner=stats.owner, kind="sync-fold", args=(gathered,)
+                ),
+                annotation_scope(stats.owner, "sync-fold", sig),
             )
-        folded = entry(gathered)
+        fn, scope = entry
+        with jax.profiler.TraceAnnotation(scope):
+            folded = fn(gathered)
     except Exception as exc:  # noqa: BLE001 — an untraceable custom fold demotes
         if not first:
             raise
@@ -352,16 +384,20 @@ class EpochEngine:
             return self._fold_then_no_value(plan, gathered)
         first = entry is None
         rec = _diag.active_recorder()
-        t_dispatch = perf_counter() if rec is not None else 0.0
+        profiling = _profile.active_profile() is not None
+        measuring = rec is not None or profiling
+        t_dispatch = perf_counter() if measuring else 0.0
         try:
-            if first:
-                import jax
+            import jax
 
+            if first:
                 fold = plan.make_fold()
+                owner = self.stats.owner
 
                 def fused(bufs):
                     states = fold(bufs).get("", {})
-                    value = traced_compute(m, states)
+                    with jax.named_scope(f"{owner}:compute"):
+                        value = traced_compute(m, states)
                     if _sentinel.ATTR in states:
                         # the final value's health folds into the same graph:
                         # a NaN/Inf compute output raises the (already
@@ -370,12 +406,17 @@ class EpochEngine:
                         states[_sentinel.ATTR] = _sentinel.value_flags(states[_sentinel.ATTR], value, m)
                     return states, value
 
-                entry = _costs.aot_compile(
-                    jax.jit(fused), owner=self.stats.owner, kind="sync-compute", args=(gathered,)
+                entry = (
+                    _costs.aot_compile(
+                        jax.jit(fused), owner=owner, kind="sync-compute", args=(gathered,)
+                    ),
+                    annotation_scope(owner, "sync-compute", sig),
                 )
-            if rec is not None:
+            fn, scope = entry
+            if measuring:
                 t_dispatch = perf_counter()
-            states, value = entry(gathered)
+            with jax.profiler.TraceAnnotation(scope):
+                states, value = fn(gathered)
         except Exception as exc:  # noqa: BLE001 — untraceable compute: sync still packed
             if not first:
                 raise
@@ -401,11 +442,22 @@ class EpochEngine:
             self.stats.compute_cache_hits += 1
         self.stats.compute_dispatches += 1
         self.stats.packed_syncs += 1
+        dispatch_us = round((perf_counter() - t_dispatch) * 1e6, 3) if measuring else 0.0
+        if measuring:
+            # both families: a compute dispatch IS a dispatch (kind label keeps
+            # it separable) AND feeds the compute-specific latency series
+            _hist.observe(self.stats.owner, "compute", "dispatch_us", dispatch_us)
+            _hist.observe(self.stats.owner, "compute", "compute_us", dispatch_us)
+        device_us = None
+        if profiling and not first:
+            device_us = completion_probe(value, self.stats.owner, "compute", self.stats, t_dispatch)
         if rec is not None:
             rec.record(
                 "compute.dispatch", self.stats.owner,
-                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3), fused=True, cached=not first,
+                dispatch_us=dispatch_us, dur_us=dispatch_us, fused=True, cached=not first,
             )
+            if device_us is not None:
+                rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)
         _write_synced(m, states, plan, "")
         return (value,)
 
@@ -447,29 +499,43 @@ class EpochEngine:
             return False, None
         first = entry is None
         rec = _diag.active_recorder()
-        t_dispatch = perf_counter() if rec is not None else 0.0
+        profiling = _profile.active_profile() is not None
+        measuring = rec is not None or profiling
+        t_dispatch = perf_counter() if measuring else 0.0
         try:
-            if first:
-                import jax
+            import jax
 
+            if first:
+                owner = self.stats.owner
                 if has_sentinel:
                     # value-health checks ride the same cached executable
                     def compute_with_sentinel(s, flags):
-                        value = traced_compute(m, s)
+                        with jax.named_scope(f"{owner}:compute"):
+                            value = traced_compute(m, s)
                         return value, _sentinel.value_flags(flags, value, m)
 
                     jitted = jax.jit(compute_with_sentinel)
                     example: tuple = (state, sentinel_in)
                 else:
-                    jitted = jax.jit(lambda s: traced_compute(m, s))
+
+                    def compute_only(s):
+                        with jax.named_scope(f"{owner}:compute"):
+                            return traced_compute(m, s)
+
+                    jitted = jax.jit(compute_only)
                     example = (state,)
-                entry = _costs.aot_compile(jitted, owner=self.stats.owner, kind="compute", args=example)
-            if rec is not None:
+                entry = (
+                    _costs.aot_compile(jitted, owner=owner, kind="compute", args=example),
+                    annotation_scope(owner, "compute", key),
+                )
+            fn, scope = entry
+            if measuring:
                 t_dispatch = perf_counter()
-            if has_sentinel:
-                value, sentinel_out = entry(state, sentinel_in)
-            else:
-                value = entry(state)
+            with jax.profiler.TraceAnnotation(scope):
+                if has_sentinel:
+                    value, sentinel_out = fn(state, sentinel_in)
+                else:
+                    value = fn(state)
         except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
             if not first:
                 raise
@@ -498,11 +564,20 @@ class EpochEngine:
         else:
             self.stats.compute_cache_hits += 1
         self.stats.compute_dispatches += 1
+        dispatch_us = round((perf_counter() - t_dispatch) * 1e6, 3) if measuring else 0.0
+        if measuring:
+            _hist.observe(self.stats.owner, "compute", "dispatch_us", dispatch_us)
+            _hist.observe(self.stats.owner, "compute", "compute_us", dispatch_us)
+        device_us = None
+        if profiling and not first:
+            device_us = completion_probe(value, self.stats.owner, "compute", self.stats, t_dispatch)
         if rec is not None:
             rec.record(
                 "compute.dispatch", self.stats.owner,
-                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3), fused=False, cached=not first,
+                dispatch_us=dispatch_us, dur_us=dispatch_us, fused=False, cached=not first,
             )
+            if device_us is not None:
+                rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)
         return True, value
 
     @staticmethod

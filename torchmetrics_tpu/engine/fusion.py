@@ -26,12 +26,16 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from torchmetrics_tpu.diag import costs as _costs
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
     _is_jax_array,
+    annotation_scope,
+    completion_probe,
     holds_nested_metrics,
     input_signature,
     make_step,
@@ -137,7 +141,7 @@ class FusedUpdate:
         first = entry is None
         if first:
             try:
-                entry = self._compile(members, states, bucketed, inputs)
+                entry = self._compile(members, states, bucketed, inputs, key)
             except Exception as exc:  # noqa: BLE001 — a compile-time failure demotes the key
                 self._cache[key] = _FALLBACK
                 st.fallback(f"trace-failed:{type(exc).__name__}")
@@ -146,7 +150,7 @@ class FusedUpdate:
                 self._cache[key] = _FALLBACK
                 st.fallback("too-few-traceable-members")
                 return None
-        fn, donate, fused_names = entry
+        fn, donate, fused_names, scope = entry
         fused = [(name, m) for name, m in members if name in fused_names]
         fused_states = {name: states[name] for name, _ in fused}
 
@@ -156,12 +160,17 @@ class FusedUpdate:
             }
 
         rec = _diag.active_recorder()
-        t_dispatch = perf_counter() if rec is not None else 0.0
+        profiling = _profile.active_profile() is not None
+        measuring = rec is not None or profiling
+        t_dispatch = perf_counter() if measuring else 0.0
         try:
-            if bucketed:
-                out = fn(fused_states, np.int32(n_pad), *inputs)
-            else:
-                out = fn(fused_states, *inputs)
+            import jax
+
+            with jax.profiler.TraceAnnotation(scope):
+                if bucketed:
+                    out = fn(fused_states, np.int32(n_pad), *inputs)
+                else:
+                    out = fn(fused_states, *inputs)
         except Exception as exc:  # noqa: BLE001 — a compile-time failure demotes the key
             if not first:
                 raise
@@ -195,13 +204,21 @@ class FusedUpdate:
             v.nbytes for mstate in fused_states.values() for v in mstate.values()
         ) + sum(getattr(a, "nbytes", 0) for a in inputs)
         st.bytes_moved += bytes_moved
+        dispatch_us = round((perf_counter() - t_dispatch) * 1e6, 3) if measuring else 0.0
+        if measuring:
+            _hist.observe(st.owner, "fused", "dispatch_us", dispatch_us)
+        device_us = None
+        if profiling and not first:
+            device_us = completion_probe(out, st.owner, "fused", st, t_dispatch)
         if rec is not None:
             rec.record(
                 "fused.dispatch", st.owner,
-                dur_us=round((perf_counter() - t_dispatch) * 1e6, 3),
+                dispatch_us=dispatch_us, dur_us=dispatch_us,
                 donated=donate, bucketed=bucketed, pad_rows=n_pad, bytes=bytes_moved,
                 members=len(fused), cached=not first,
             )
+            if device_us is not None:
+                rec.record("fused.probe", st.owner, dispatch_us=dispatch_us, device_us=device_us)
 
         handled: Set[str] = set()
         for name, m in fused:
@@ -222,6 +239,7 @@ class FusedUpdate:
         states: Dict[str, Dict[str, Any]],
         bucketed: bool,
         inputs: Sequence[Any],
+        key: Tuple,
     ):
         """Probe each member's traceability, then compile the survivors as one step.
 
@@ -248,7 +266,10 @@ class FusedUpdate:
             for name, m in fusable:
                 mstate = dict(fused_states[name])
                 sentinel = mstate.pop(_sentinel.STATE_KEY, None)
-                updated = traced_update(m, mstate, tuple(flat), {})
+                # per-member named_scope: inside the ONE fused executable each
+                # member's ops still attribute to their own metric in profiles
+                with jax.named_scope(f"{name}:update"):
+                    updated = traced_update(m, mstate, tuple(flat), {})
                 if sentinel is not None:
                     updated[_sentinel.STATE_KEY] = _sentinel.update_flags(sentinel, updated, m)
                 out[name] = updated
@@ -262,4 +283,4 @@ class FusedUpdate:
             sum(v.nbytes for mstate in example_states.values() for v in mstate.values()) if donate else 0
         )
         fn = _costs.aot_compile(fn, owner=self.stats.owner, kind="fused", args=example, donated_bytes=donated)
-        return fn, donate, frozenset(name for name, _ in fusable)
+        return fn, donate, frozenset(name for name, _ in fusable), annotation_scope(self.stats.owner, "fused", key)

@@ -37,9 +37,12 @@ _COUNTER_FIELDS = (
     "sync_bytes_moved",  # bytes through packed-sync collectives (gathered view)
     "sync_fold_traces",  # fold / fused sync→compute executables compiled
     "sync_divergence_flags",  # rank-divergent rank-invariant states flagged by the audit
+    "sync_straggler_flags",  # packed syncs whose arrival skew exceeded the straggler threshold
     "compute_traces",  # compute executables compiled (retraces = growth after warmup)
     "compute_dispatches",  # cached compute dispatches (incl. fused sync→compute)
     "compute_cache_hits",  # compute dispatches served without a re-trace
+    # --- profiling layer (diag/profile.py): sampled completion probes ---
+    "profile_probes",  # warm dispatches followed by a sanctioned block_until_ready probe
 )
 
 
@@ -142,17 +145,23 @@ def reset_engine_counters() -> None:
 
 def reset_engine_stats() -> None:
     """Zero every live engine's counters, the diag ring buffer, the cost
-    ledger, AND the sentinel registry.
+    ledger, the sentinel registry, the latency histograms, AND the profiler's
+    probe accounting.
 
     The shared reset keeps every evidence surface (counters, flight recorder,
-    per-executable costs, health sentinels) in lockstep: a bench scenario that
-    resets one but not the others would attribute the previous scenario's
-    events/costs/flags to the fresh run.
+    per-executable costs, health sentinels, latency distributions, probe
+    counts) in lockstep: a bench scenario that resets one but not the others
+    would attribute the previous scenario's events/costs/flags/tails to the
+    fresh run.
     """
     from torchmetrics_tpu.diag.costs import reset_ledger
+    from torchmetrics_tpu.diag.hist import reset_histograms
+    from torchmetrics_tpu.diag.profile import reset_profile
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
 
     reset_engine_counters()
     _diag.clear_recorder()
     reset_ledger()
     reset_sentinels()
+    reset_histograms()
+    reset_profile()

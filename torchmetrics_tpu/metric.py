@@ -26,12 +26,15 @@ import functools
 import inspect
 from contextlib import contextmanager
 from copy import deepcopy
+from time import perf_counter
 from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.parallel.sync import gather_all_tensors, jit_distributed_available
 from torchmetrics_tpu.utilities.data import (
@@ -643,9 +646,22 @@ class Metric:
                     # engine-disabled updates leave no engine counters behind; the
                     # flight-recorder event keeps eager steps visible in the same
                     # timeline as compiled dispatches (engine fallbacks additionally
-                    # carry their reason via EngineStats.fallback)
-                    _diag.record("update.eager", type(self).__name__)
-                    update(*args, **kwargs)
+                    # carry their reason via EngineStats.fallback), timed so the
+                    # eager launch cost lands in the same latency histograms
+                    rec = _diag.active_recorder()
+                    measuring = rec is not None or _profile.active_profile() is not None
+                    if not measuring:
+                        update(*args, **kwargs)
+                    else:
+                        t0 = perf_counter()
+                        update(*args, **kwargs)
+                        dispatch_us = round((perf_counter() - t0) * 1e6, 3)
+                        _hist.observe(type(self).__name__, "eager", "dispatch_us", dispatch_us)
+                        if rec is not None:
+                            rec.record(
+                                "update.eager", type(self).__name__,
+                                dispatch_us=dispatch_us, dur_us=dispatch_us,
+                            )
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 

@@ -42,7 +42,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
+from torchmetrics_tpu.diag import timeline as _timeline
 
 from torchmetrics_tpu.utilities.data import (
     dim_zero_cat,
@@ -174,6 +176,14 @@ class PackedSyncPlan:
         self.audit = _sentinel.audit_enabled() and self.world_size > 1
         self.audit_results: List[Dict[str, Any]] = []
         self._audit_nonzero: List[bool] = []  # local-buffer any() per audited spec
+        # cross-rank timeline (opt-in via profiling, diag/profile.py): barrier
+        # pre/post timestamps piggyback on the metadata gather, layout-versioned.
+        # Same symmetry rule as sentinel/audit: enablement is a function of the
+        # knob alone and MUST match on every rank; a rank-invariant plan loses
+        # its zero-metadata shortcut while profiling is on (one gather buys the
+        # whole straggler/clock-offset story — a deliberate, documented cost).
+        self.timeline = _profile.timeline_enabled() and self.world_size > 1
+        self.timeline_result: Optional[Dict[str, Any]] = None
         self._build()
 
     # ------------------------------------------------------------------ build
@@ -303,6 +313,11 @@ class PackedSyncPlan:
         sum-preserving divergence (permuted rows, NaN-vs-zero) still changes
         the digest. Reading the values is a host transfer by design and rides
         the same sanctioned boundary as the gather itself.
+
+        With profiling on (``diag/profile.py``), a layout-versioned timestamp
+        triple (``diag/timeline.py``) is appended LAST — the cross-rank
+        clock-offset / straggler story costs zero extra collectives, but a
+        rank-invariant plan does lose its skip-the-gather shortcut.
         """
         entries: List[int] = []
         for s in self.specs:
@@ -338,6 +353,11 @@ class PackedSyncPlan:
                         zlib.crc32(value.tobytes()) & 0x7FFFFFFF,
                         int(value.size) & 0x7FFFFFFF,
                     ]
+        if self.timeline:
+            # [layout version, previous barrier exit, current barrier arrival]
+            # — appended LAST so the straggler tooling (and emulated-world test
+            # helpers) can address the stamps without replaying the spec walk
+            entries += _timeline.timeline_entries()
         if not entries:
             return None
         return np.asarray(entries, dtype=np.int32)
@@ -450,6 +470,24 @@ class PackedSyncPlan:
                     self.audit_results.append(
                         {"owner": s.owner, "attr": s.attr, "kind": s.kind, "divergent": divergent, "flag": flag}
                     )
+            if self.timeline:
+                versions = world_meta[:, idx]
+                prev_post = world_meta[:, idx + 1]
+                arrivals = world_meta[:, idx + 2]
+                idx += _timeline.TIMELINE_META_INTS
+                if int(versions.max()) != int(versions.min()) or int(versions.max()) != _timeline.LAYOUT_VERSION:
+                    # asymmetric profiling enablement (or a future layout bump)
+                    # would mis-parse every later entry — fail loud on all ranks
+                    raise TorchMetricsUserError(
+                        f"Cannot sync: processes disagree on the packed-sync timeline"
+                        f" layout (versions {versions.tolist()}, expected"
+                        f" {_timeline.LAYOUT_VERSION}). Profiling"
+                        " (TORCHMETRICS_TPU_PROFILE / profile_context) extends the"
+                        " metadata layout and must be enabled on every rank or none."
+                    )
+                self.timeline_result = _timeline.resolve_arrivals(
+                    prev_post, arrivals, self._local_rank()
+                )
         # pad ragged cat segments to the FULL-WORLD max and freeze offsets
         offsets: Dict[str, int] = {}
         for s in self.specs:
@@ -462,6 +500,15 @@ class PackedSyncPlan:
             offsets[s.group] = s.offset + s.size
         self._group_sizes = dict(offsets)
         self._finalized = True
+
+    @staticmethod
+    def _local_rank() -> int:
+        import jax
+
+        try:
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 — un-initialized backend reads as rank 0
+            return 0
 
     # ------------------------------------------------------------------ pack
 
