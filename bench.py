@@ -785,6 +785,110 @@ def bench_epoch(micro=False):
         )
         out["timeline_ranks"] = 2
         out["timeline_merged_events"] = len(merged["traceEvents"])
+
+        # clean-run fault counters: the guarded packed cycles above ran with NO
+        # faults planted — degraded folds / retries must both read zero (gated)
+        est_g = mc_g._epoch_sync.stats
+        out["sync_degraded_folds"] = est_g.sync_degraded_folds
+        out["sync_retries_clean"] = est_g.sync_retries
+
+        # -- chaos: planted faults at the collective boundary, STRICT guard ----
+        # (parallel/faults.py + parallel/resilience.py). Every recovery path is
+        # exercised through the PRODUCTION code path — the same bounded
+        # collectives, the same degraded re-plan, zero unsanctioned transfers.
+        import tempfile
+
+        from torchmetrics_tpu.parallel import (
+            CollectiveTimeout,
+            RankDrop,
+            fault_context,
+            resilience_context,
+        )
+        from torchmetrics_tpu.parallel.elastic import (
+            restore_resharded,
+            save_state_shard,
+            shard_path,
+        )
+
+        # local (unsynced) reference: what a survivor fold over the identical-
+        # rank world {0} must produce after the planted rank-drop
+        mc_local = MetricCollection(build(compiled=False), compute_groups=False, fused_dispatch=False)
+        for m in mc_local._modules.values():
+            m.distributed_available_fn = lambda: False
+        for p, t in batches:
+            mc_local.update(p, t)
+        local_res = mc_local.compute()
+
+        with engine_context(True), diag_context(capacity=8192) as crec, transfer_guard("strict"):
+            # 1) planted collective timeout -> bounded retry recovers, full parity
+            with resilience_context(retries=2, backoff_ms=1), fault_context(
+                CollectiveTimeout(times=1)
+            ):
+                mc_t = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+                for m in mc_t._modules.values():
+                    m.distributed_available_fn = lambda: True
+                for p, t in batches:
+                    mc_t.update(p, t)
+                timeout_res = mc_t.compute()
+            t_stats = [mc_t._epoch_sync.stats] + [
+                m._epoch.stats for m in mc_t._modules.values() if m._epoch is not None
+            ]
+
+            # 2) planted rank drop -> degraded fold over the survivors, with the
+            # excluded rank named at every surface (event, counter, Prometheus)
+            with resilience_context(retries=0, backoff_ms=1), fault_context(RankDrop(rank=1)):
+                mc_d = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+                for m in mc_d._modules.values():
+                    m.distributed_available_fn = lambda: True
+                for p, t in batches:
+                    mc_d.update(p, t)
+                degraded_res = mc_d.compute()
+            d_stats = [mc_d._epoch_sync.stats] + [
+                m._epoch.stats for m in mc_d._modules.values() if m._epoch is not None
+            ]
+
+            # 3) world-2 -> world-1 checkpoint-reshard round-trip: both "ranks"
+            # of the identical-rank world save atomic shards; a fresh world-1
+            # collection restores the folded state and must compute identically
+            # to the packed world-2 sync
+            ckpt_dir = tempfile.mkdtemp(prefix="tm_reshard_")
+            for rank in range(world):
+                save_state_shard(
+                    mc_g, shard_path(os.path.join(ckpt_dir, "ck"), rank, world),
+                    rank=rank, world_size=world,
+                )
+            mc_r = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+            for m in mc_r._modules.values():
+                m.distributed_available_fn = lambda: False  # restored world is 1 rank
+            restore_resharded(mc_r, ckpt_dir, rank=0, world_size=1)
+            reshard_res = mc_r.compute()
+
+        out["fault_timeout_retries"] = sum(s.sync_retries for s in t_stats)
+        out["fault_timeout_degraded_folds"] = sum(s.sync_degraded_folds for s in t_stats)
+        out["fault_timeout_parity_ok"] = all(
+            bool(np.allclose(np.asarray(timeout_res[k]), np.asarray(eager_res[k]), atol=1e-6))
+            for k in eager_res
+        )
+        out["degraded_folds"] = sum(s.sync_degraded_folds for s in d_stats)
+        degraded_events = [e for e in crec.snapshot() if e.kind == "sync.degraded"]
+        out["degraded_rank"] = degraded_events[-1].data["rank"] if degraded_events else None
+        out["degraded_rank_correct"] = bool(degraded_events) and all(
+            e.data["rank"] == 1 for e in degraded_events
+        )
+        out["degraded_parity_ok"] = all(
+            bool(np.allclose(np.asarray(degraded_res[k]), np.asarray(local_res[k]), atol=1e-6))
+            for k in local_res
+        )
+        # the world-2 fold over these batches is already computed and gated:
+        # eager_res (parity-asserted against the packed path above) IS the
+        # reshard round-trip's target — identical compute() after the resize
+        out["reshard_roundtrip_ok"] = all(
+            bool(np.allclose(np.asarray(reshard_res[k]), np.asarray(eager_res[k]), atol=1e-6))
+            for k in eager_res
+        )
+        out["reshard_saved_world"] = world
+        out["fault_host_transfers"] = crec.count("transfer.host", "transfer.blocked")
+        out["fault_retry_events"] = crec.counts.get("sync.retry", 0)
     return out
 
 

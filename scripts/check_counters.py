@@ -38,6 +38,16 @@ and fails CI when any counter regresses past the committed baseline
 - ``sync_straggler_flags`` == 0 on the CLEAN epoch run, while the
   planted-straggler run must flag (``straggler_flagged``) the CORRECT rank
   (``straggler_rank_correct``) with zero unsanctioned transfers
+- fault-tolerance proofs (``parallel/resilience.py`` + ``parallel/faults.py``):
+  the planted collective timeout recovers by bounded retry with full parity
+  (``fault_timeout_retries`` truthy, ``fault_timeout_parity_ok``), the planted
+  rank-drop folds in degraded mode excluding exactly the dead rank
+  (``degraded_rank_correct``, ``degraded_parity_ok``), the world-2 -> world-1
+  checkpoint-reshard round-trip computes identically
+  (``reshard_roundtrip_ok``), the CLEAN run pays nothing
+  (``sync_degraded_folds`` == 0, ``sync_retries_clean`` == 0), and the whole
+  chaos block does zero unsanctioned host transfers
+  (``fault_host_transfers`` == 0)
 
 The baseline defaults to the NEWEST ``BENCH_r*.json`` in the repo root (pass
 ``--baseline`` to pin one) — a stale envelope can no longer be compared
@@ -92,6 +102,18 @@ _CHECKS = (
     ("epoch", "straggler_flagged", "true", None),
     ("epoch", "straggler_rank_correct", "true", None),
     ("epoch", "straggler_host_transfers", "abs", 0),
+    # fault-tolerance gates (parallel/resilience.py + faults.py, PR 6): the
+    # planted chaos scenarios must RECOVER — and the clean run must not pay
+    ("epoch", "sync_degraded_folds", "abs", 0),  # clean guarded run never degrades
+    ("epoch", "sync_retries_clean", "abs", 0),  # ...nor spends retries
+    ("epoch", "fault_timeout_retries", "true", None),  # planted timeout DID retry
+    ("epoch", "fault_timeout_degraded_folds", "abs", 0),  # ...and retry sufficed
+    ("epoch", "fault_timeout_parity_ok", "true", None),  # recovered with full parity
+    ("epoch", "degraded_folds", "true", None),  # planted rank-drop DID degrade
+    ("epoch", "degraded_rank_correct", "true", None),  # ...excluding the right rank
+    ("epoch", "degraded_parity_ok", "true", None),  # survivor fold matches
+    ("epoch", "reshard_roundtrip_ok", "true", None),  # world-2 -> world-1 identical compute
+    ("epoch", "fault_host_transfers", "abs", 0),  # chaos ran under the STRICT guard
 )
 
 

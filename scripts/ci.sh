@@ -57,8 +57,18 @@ elif ! grep -q '"straggler_rank_correct": true' "$BENCH_OUT" || ! grep -q '"sync
 elif ! grep -q '"profile_host_transfers": 0' "$BENCH_OUT" || ! grep -q '"dispatch_p99_us"' "$BENCH_OUT"; then
   echo "bench smoke: FAILED (profiled run missing p50/p99 histograms or did a host transfer)"
   status=1
+elif ! grep -q '"fault_timeout_parity_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"degraded_rank_correct": true' "$BENCH_OUT" \
+  || ! grep -q '"reshard_roundtrip_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"fault_host_transfers": 0' "$BENCH_OUT"; then
+  # chaos smoke (fault-tolerance gate): the planted collective timeout must
+  # recover by retry with parity, the planted rank-drop must fold in degraded
+  # mode excluding the correct rank, the world-2 -> world-1 checkpoint reshard
+  # must compute identically — all with zero unsanctioned host transfers
+  echo "bench smoke: FAILED (planted-fault recovery proofs missing or degraded)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos counters present)"
 fi
 
 echo

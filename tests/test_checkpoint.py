@@ -125,3 +125,212 @@ def test_restore_clears_compute_cache(tmp_path):
     assert float(live.compute()) == 99.0  # caches
     restore_metric_state(live, str(tmp_path / "ckpt"))
     assert float(live.compute()) == 10.0  # cache invalidated, restored state wins
+
+
+# ---------------------------------------------------------------- elastic reshard
+# (parallel/elastic.py: atomic version-stamped CRC shards, N->M restore with the
+# fold re-planned + recompiled through the packed-sync machinery)
+
+import os
+
+import jax
+
+from torchmetrics_tpu import CatMetric
+from torchmetrics_tpu.parallel.elastic import (
+    SNAPSHOT_VERSION,
+    SnapshotIntegrityError,
+    SnapshotReshardError,
+    SnapshotVersionError,
+    restore_resharded,
+    save_state_shard,
+    shard_path,
+)
+
+
+def _two_rank_shards(tmp_path, name="ck"):
+    """A world-2 'run': two ranks with DIFFERENT batches, shards saved."""
+    base = str(tmp_path / name)
+    metrics = []
+    for rank in range(2):
+        m = MulticlassAccuracy(num_classes=3, average="micro")
+        preds = jnp.asarray(np.random.RandomState(rank).rand(6, 3))
+        target = jnp.asarray(np.random.RandomState(100 + rank).randint(0, 3, 6))
+        m.update(preds, target)
+        save_state_shard(m, shard_path(base, rank, 2), rank=rank, world_size=2)
+        metrics.append(m)
+    # the world-2 synced result: fold (sum) of both ranks' states
+    synced = MulticlassAccuracy(num_classes=3, average="micro")
+    for m in metrics:
+        for attr in synced._defaults:
+            setattr(synced, attr, getattr(synced, attr) + getattr(m, attr))
+    synced._update_count = sum(m._update_count for m in metrics)
+    return metrics, float(synced.compute())
+
+
+def test_reshard_world2_to_world1_fold_parity(tmp_path):
+    _, want = _two_rank_shards(tmp_path)
+    fresh = MulticlassAccuracy(num_classes=3, average="micro")
+    restore_resharded(fresh, str(tmp_path), rank=0, world_size=1)
+    assert float(fresh.compute()) == want
+    assert fresh._update_count == 2  # sum-preserving count split
+
+
+def test_reshard_world2_to_world3_fold_parity(tmp_path):
+    """3 restored ranks re-folded must equal the original world-2 fold."""
+    _, want = _two_rank_shards(tmp_path)
+    restored = []
+    for rank in range(3):
+        f = MulticlassAccuracy(num_classes=3, average="micro")
+        restore_resharded(f, str(tmp_path), rank=rank, world_size=3)
+        restored.append(f)
+    refold = MulticlassAccuracy(num_classes=3, average="micro")
+    for f in restored:
+        for attr in refold._defaults:
+            setattr(refold, attr, getattr(refold, attr) + getattr(f, attr))
+    assert float(refold.compute()) == want
+    assert sum(f._update_count for f in restored) == 2  # count total preserved
+
+
+def test_reshard_same_world_identity(tmp_path):
+    metrics, _ = _two_rank_shards(tmp_path)
+    f = MulticlassAccuracy(num_classes=3, average="micro")
+    restore_resharded(f, str(tmp_path), rank=1, world_size=2)
+    for attr in f._defaults:
+        np.testing.assert_array_equal(np.asarray(getattr(f, attr)), np.asarray(getattr(metrics[1], attr)))
+    assert f._update_count == metrics[1]._update_count
+
+
+def test_reshard_cat_list_states_split_in_order(tmp_path):
+    base = str(tmp_path / "cat")
+    sources = []
+    for rank in range(2):
+        c = CatMetric()
+        c.update(jnp.arange(3.0) + 10 * rank)
+        save_state_shard(c, shard_path(base, rank, 2), rank=rank, world_size=2)
+        sources.append(c)
+    chunks = []
+    for rank in range(3):
+        f = CatMetric()
+        restore_resharded(f, str(tmp_path), rank=rank, world_size=3)
+        chunks.append(np.concatenate([np.asarray(v) for v in f.value]) if f.value else np.zeros((0,)))
+    want = np.concatenate([np.concatenate([np.asarray(v) for v in c.value]) for c in sources])
+    np.testing.assert_array_equal(np.concatenate(chunks), want)
+
+
+def test_corrupted_shard_fails_loud_and_deterministically(tmp_path):
+    _two_rank_shards(tmp_path)
+    victim = str(tmp_path / shard_path("ck", 0, 2))
+    # rewrite the archive with a tampered payload but the STALE crc stamp
+    flat = dict(np.load(victim, allow_pickle=False))
+    key = next(k for k in flat if not k.startswith("__"))
+    flat[key] = np.asarray(flat[key]) + 1
+    with open(victim, "wb") as fh:
+        np.savez(fh, **flat)
+    fresh = MulticlassAccuracy(num_classes=3, average="micro")
+    # every rank that attempts the restore gets the same loud, typed error
+    for rank in range(2):
+        with pytest.raises(SnapshotIntegrityError, match="integrity check"):
+            restore_resharded(fresh, str(tmp_path), rank=rank, world_size=2)
+
+
+def test_corrupted_shard_falls_back_to_last_good(tmp_path):
+    good_dir = tmp_path / "good"
+    bad_dir = tmp_path / "bad"
+    good_dir.mkdir(), bad_dir.mkdir()
+    _, want = _two_rank_shards(good_dir)
+    _two_rank_shards(bad_dir)
+    victim = str(bad_dir / shard_path("ck", 1, 2))
+    flat = dict(np.load(victim, allow_pickle=False))
+    key = next(k for k in flat if not k.startswith("__"))
+    flat[key] = np.asarray(flat[key]) * 7
+    with open(victim, "wb") as fh:
+        np.savez(fh, **flat)
+    fresh = MulticlassAccuracy(num_classes=3, average="micro")
+    restore_resharded(fresh, str(bad_dir), rank=0, world_size=1, last_good=str(good_dir))
+    assert float(fresh.compute()) == want
+
+
+def test_atomic_write_leftover_tmp_ignored(tmp_path):
+    """A crash mid-write leaves only a .tmp — restore never reads it."""
+    _, want = _two_rank_shards(tmp_path)
+    # simulate the crash artifact: a half-written tmp next to the good shards
+    with open(str(tmp_path / "ck.rank0-of-2.npz.tmp"), "wb") as fh:
+        fh.write(b"PARTIAL WRITE GARBAGE")
+    fresh = MulticlassAccuracy(num_classes=3, average="micro")
+    restore_resharded(fresh, str(tmp_path), rank=0, world_size=1)
+    assert float(fresh.compute()) == want
+
+
+def test_version_mismatch_fails_loud_on_every_rank(tmp_path, monkeypatch):
+    _two_rank_shards(tmp_path)
+    victim = str(tmp_path / shard_path("ck", 0, 2))
+    flat = dict(np.load(victim, allow_pickle=False))
+    flat["__elastic_version__"] = np.asarray(SNAPSHOT_VERSION + 1)
+    # re-stamp a VALID crc so only the version check can object
+    from torchmetrics_tpu.parallel.elastic import _payload_crc
+
+    flat["__crc__"] = np.asarray(_payload_crc(flat), dtype=np.uint32)
+    with open(victim, "wb") as fh:
+        np.savez(fh, **flat)
+    fresh = MulticlassAccuracy(num_classes=3, average="micro")
+    for rank in range(2):
+        with pytest.raises(SnapshotVersionError, match="layout version"):
+            restore_resharded(fresh, str(tmp_path), rank=rank, world_size=2)
+
+
+def test_incomplete_shard_set_fails_loud(tmp_path):
+    _two_rank_shards(tmp_path)
+    os.remove(str(tmp_path / shard_path("ck", 1, 2)))
+    fresh = MulticlassAccuracy(num_classes=3, average="micro")
+    with pytest.raises(SnapshotIntegrityError, match="incomplete"):
+        restore_resharded(fresh, str(tmp_path), rank=0, world_size=1)
+
+
+def test_unsupported_reduction_reshard_fails_loud(tmp_path):
+    from torchmetrics_tpu.metric import Metric
+
+    class CustomFold(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("prod", jnp.ones(()), dist_reduce_fx=lambda s: jnp.prod(s, axis=0))
+
+        def update(self, x):
+            self.prod = self.prod * x
+
+        def compute(self):
+            return self.prod
+
+    base = str(tmp_path / "ck")
+    for rank in range(2):
+        m = CustomFold()
+        m.update(jnp.asarray(2.0 + rank))
+        save_state_shard(m, shard_path(base, rank, 2), rank=rank, world_size=2)
+    fresh = CustomFold()
+    # same-world restore of custom folds IS supported (identity)
+    restore_resharded(fresh, str(tmp_path), rank=0, world_size=2)
+    assert float(fresh.prod) == 2.0
+    with pytest.raises(SnapshotReshardError, match="custom"):
+        restore_resharded(CustomFold(), str(tmp_path), rank=0, world_size=1)
+
+
+def test_reshard_collection_roundtrip(tmp_path):
+    base = str(tmp_path / "ck")
+    sources = []
+    for rank in range(2):
+        coll = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3, average="micro"), "mean": MeanMetric()}
+        )
+        coll["acc"].update(jnp.asarray(np.random.RandomState(rank).rand(4, 3)), jnp.asarray([0, 1, 2, 1]))
+        coll["mean"].update(jnp.asarray(float(rank + 2)))
+        save_state_shard(coll, shard_path(base, rank, 2), rank=rank, world_size=2)
+        sources.append(coll)
+    fresh = MetricCollection(
+        {"acc": MulticlassAccuracy(num_classes=3, average="micro"), "mean": MeanMetric()}
+    )
+    restore_resharded(fresh, str(tmp_path), rank=0, world_size=1)
+    got = {k: float(v) for k, v in fresh.compute().items()}
+    # expected: the world-2 fold — sum states add across ranks
+    want_mean = (2.0 + 3.0) / 2.0
+    assert got["mean"] == want_mean

@@ -1,0 +1,419 @@
+"""Fault-tolerance layer tests (parallel/resilience.py, parallel/faults.py,
+engine/epoch.py degraded mode): bounded collectives with typed classification,
+retry/backoff recovery, degraded-mode folding over surviving membership, payload
+CRC integrity, the eager-path deadline, and the deterministic injection harness."""
+
+import os
+import time
+from unittest import mock
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.diag import diag_context
+from torchmetrics_tpu.engine import engine_context
+from torchmetrics_tpu.parallel import (
+    CollectiveTimeout,
+    CollectiveTimeoutError,
+    CorruptPayload,
+    DelayRank,
+    RankDrop,
+    RankUnreachableError,
+    fault_context,
+    gather_all_tensors,
+    resilience_context,
+)
+from torchmetrics_tpu.parallel import faults as faults_mod
+from torchmetrics_tpu.parallel import resilience as res_mod
+
+NUM_CLASSES = 5
+
+
+def _identical_rank_world(monkeypatch, world=2):
+    """Every rank holds this process's state: allgather = stack world copies."""
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+    )
+
+
+def _metric(**kw):
+    m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False, **kw)
+    m.distributed_available_fn = lambda: True
+    return m
+
+
+def _batch(seed=0, n=16):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(n, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, n)),
+    )
+
+
+# ------------------------------------------------------------------ harness
+
+
+def test_fault_due_is_deterministic_and_bounded():
+    f = CollectiveTimeout(label="reduce:*", times=2, after=1)
+    assert not f.due("meta", None)  # label mismatch consumes nothing
+    assert not f.due("reduce:int32", None)  # skipped by `after`
+    assert f.due("reduce:int32", None)
+    assert f.due("reduce:float32", None)
+    assert not f.due("reduce:int32", None)  # times exhausted
+    assert f.fired == 2
+
+
+def test_rank_scoped_fault_respects_membership():
+    f = RankDrop(rank=1)
+    assert f.due("reduce:int32", (0, 1))
+    # the degraded re-plan removed rank 1: the fault neither fires nor counts
+    assert not f.due("reduce:int32", (0,))
+    assert f.due("reduce:int32", None)  # unknown membership = full world
+
+
+def test_rank_scoped_fault_requires_rank():
+    with pytest.raises(ValueError, match="requires a target rank"):
+        DelayRank(rank=None, delay_ms=5)  # type: ignore[arg-type]
+
+
+def test_fault_context_scopes_and_restores():
+    assert faults_mod.active_faults() == ()
+    with fault_context(CollectiveTimeout()) as planted:
+        assert faults_mod.active_faults() == planted
+    assert faults_mod.active_faults() == ()
+
+
+# ------------------------------------------------------------------ policy
+
+
+def test_policy_env_parsing(monkeypatch):
+    monkeypatch.setenv(res_mod.DEADLINE_ENV_VAR, "1500")
+    monkeypatch.setenv(res_mod.RETRIES_ENV_VAR, "5")
+    monkeypatch.setenv(res_mod.BACKOFF_ENV_VAR, "10")
+    monkeypatch.setenv(res_mod.DEGRADED_ENV_VAR, "0")
+    policy = res_mod.current_policy()
+    assert policy.deadline_ms == 1500.0
+    assert policy.retries == 5
+    assert policy.backoff_ms == 10.0
+    assert policy.degraded is False
+
+
+def test_policy_defaults_add_no_deadline(monkeypatch):
+    for var in (res_mod.DEADLINE_ENV_VAR, res_mod.RETRIES_ENV_VAR, res_mod.BACKOFF_ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    policy = res_mod.current_policy()
+    assert policy.deadline_ms is None  # unbounded = zero extra machinery
+    assert policy.degraded is True
+
+
+def test_resilience_context_overrides_env(monkeypatch):
+    monkeypatch.setenv(res_mod.DEADLINE_ENV_VAR, "1500")
+    with resilience_context(deadline_ms=50, retries=0) as policy:
+        assert res_mod.current_policy() is policy
+        assert policy.deadline_ms == 50.0
+    assert res_mod.current_policy().deadline_ms == 1500.0
+
+
+# ------------------------------------------------------------------ bounded collectives
+
+
+def test_deadline_escapes_hanging_collective(monkeypatch):
+    """A genuinely hanging collective returns a typed timeout, not a hang."""
+    _identical_rank_world(monkeypatch)
+    from jax.experimental import multihost_utils
+
+    def hanging(x, tiled=False):
+        time.sleep(5.0)
+        return np.stack([np.asarray(x)] * 2)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", hanging)
+    t0 = time.perf_counter()
+    with resilience_context(deadline_ms=100, retries=0):
+        with pytest.raises(CollectiveTimeoutError) as err:
+            gather_all_tensors(jnp.ones((3,)))
+    assert time.perf_counter() - t0 < 2.0  # escaped well before the 5 s hang
+    assert err.value.label == "eager:shape"  # the ragged path's FIRST collective
+
+
+def test_eager_path_timeout_is_typed_and_retryable(monkeypatch):
+    """The eager gather (the EngineStats.fallback path) recovers by retry."""
+    _identical_rank_world(monkeypatch)
+    with resilience_context(retries=1, backoff_ms=1), fault_context(
+        CollectiveTimeout(label="eager:state", times=1)
+    ):
+        out = gather_all_tensors(jnp.arange(4.0))
+    assert len(out) == 2
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4.0))
+
+
+def test_retry_exhaustion_raises_typed_error(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    with resilience_context(retries=1, backoff_ms=1), fault_context(
+        CollectiveTimeout(times=None)  # every attempt times out
+    ):
+        with pytest.raises(CollectiveTimeoutError) as err:
+            gather_all_tensors(jnp.arange(4.0))
+    assert err.value.attempts == 2  # initial + 1 bounded retry
+
+
+def test_rank_drop_is_not_retryable(monkeypatch):
+    """A dead rank fails immediately — retrying cannot resurrect it."""
+    _identical_rank_world(monkeypatch)
+    calls = {"n": 0}
+    from jax.experimental import multihost_utils
+
+    orig = multihost_utils.process_allgather
+
+    def counting(x, tiled=False):
+        calls["n"] += 1
+        return np.stack([np.asarray(x)] * 2)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", counting)
+    with resilience_context(retries=5, backoff_ms=1), fault_context(RankDrop(rank=1)):
+        with pytest.raises(RankUnreachableError) as err:
+            gather_all_tensors(jnp.arange(4.0))
+    assert err.value.rank == 1
+    assert calls["n"] == 0  # failed before ever entering the collective again
+
+
+def test_corrupt_payload_detected_and_retried(monkeypatch):
+    """Bit-flipped local row fails the CRC echo check; the retry recovers."""
+    _identical_rank_world(monkeypatch)
+    with diag_context() as rec, resilience_context(
+        retries=2, backoff_ms=1, verify_payload=True
+    ), fault_context(CorruptPayload(rank=0, times=1)):
+        out = gather_all_tensors(jnp.arange(4.0))
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4.0))
+    assert rec.counts.get("sync.retry", 0) == 1
+
+
+# ------------------------------------------------------------------ packed path
+
+
+def test_packed_sync_timeout_recovers_with_parity(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch()
+
+    eager = _metric(compiled_update=False)
+    eager.update(preds, target)
+    want = float(eager.compute())
+
+    with engine_context(True), resilience_context(retries=2, backoff_ms=1), fault_context(
+        CollectiveTimeout(times=1)
+    ), diag_context() as rec:
+        m = _metric()
+        m.update(preds, target)
+        got = float(m.compute())
+    st = m._epoch.stats
+    assert got == want
+    assert st.sync_retries == 1
+    assert st.sync_degraded_folds == 0
+    assert rec.counts.get("sync.retry", 0) == 1
+
+
+def test_packed_sync_rank_drop_degrades_excluding_correct_rank(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(1)
+
+    local = _metric(compiled_update=False)
+    local.update(preds, target)
+    want_local = float(local.compute())  # survivor fold of the identical world
+
+    with engine_context(True), resilience_context(retries=0, backoff_ms=1), fault_context(
+        RankDrop(rank=1)
+    ), diag_context() as rec:
+        m = _metric()
+        m.update(preds, target)
+        got = float(m.compute())
+    st = m._epoch.stats
+    assert got == want_local
+    assert st.sync_degraded_folds == 1
+    degraded = [e for e in rec.snapshot() if e.kind == "sync.degraded"]
+    assert degraded and degraded[-1].data["rank"] == 1
+    assert degraded[-1].data["survivors"] == (0,)
+
+
+def test_degraded_disallowed_raises_instead(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(2)
+    with engine_context(True), resilience_context(retries=0, degraded=False), fault_context(
+        RankDrop(rank=1)
+    ):
+        m = _metric()
+        m.update(preds, target)
+        with pytest.raises(RankUnreachableError):
+            m.compute()
+
+
+def test_delayed_rank_past_deadline_names_the_rank(monkeypatch):
+    """DelayRank genuinely sleeps; past the deadline the timeout carries the
+    culprit rank, so the degraded fold excludes exactly it."""
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(3)
+    with engine_context(True), resilience_context(
+        deadline_ms=10, retries=0, backoff_ms=1
+    ), fault_context(DelayRank(rank=1, delay_ms=30, times=None)), diag_context() as rec:
+        m = _metric()
+        m.update(preds, target)
+        m.compute()
+    st = m._epoch.stats
+    assert st.sync_degraded_folds == 1
+    degraded = [e for e in rec.snapshot() if e.kind == "sync.degraded"]
+    assert degraded[-1].data["rank"] == 1
+    assert degraded[-1].data["error"] == "CollectiveTimeoutError"
+
+
+def test_degraded_plan_is_membership_keyed(monkeypatch):
+    """A degraded fold never reuses the full-world executable: the plan
+    signature carries the membership, so the caches stay disjoint."""
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(4)
+    with engine_context(True), resilience_context(retries=0, backoff_ms=1):
+        m = _metric()
+        m.update(preds, target)
+        m.compute()  # clean full-world sync compiles the (0, 1) fold
+        traces_full = m._epoch.stats.sync_fold_traces
+        m.reset()
+        m.update(preds, target)
+        with fault_context(RankDrop(rank=1)):
+            m.compute()  # degraded (0,)-fold must compile separately
+        assert m._epoch.stats.sync_fold_traces == traces_full + 1
+        assert m._epoch.stats.sync_degraded_folds == 1
+
+
+def test_collection_packed_sync_degrades_once_for_all_owners(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(5)
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    # two DISTINCT compute groups (different state layouts) so the sync rides
+    # the collection-wide CollectionEpoch plan, not a single owner's engine
+    build = lambda: {
+        "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+        "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+    }
+    # the survivor fold over the identical-rank world {0} equals the plain
+    # LOCAL compute (no sync) — NOT the full-world fold, which doubles counts
+    local_mc = MetricCollection(build(), compute_groups=False, fused_dispatch=False)
+    for m in local_mc._modules.values():
+        m.compiled_update = False
+        m.distributed_available_fn = lambda: False  # the emulated world is world-2
+    local_mc.update(preds, target)
+    want = {k: np.asarray(v) for k, v in local_mc.compute().items()}
+
+    with engine_context(True), resilience_context(retries=0, backoff_ms=1), fault_context(
+        RankDrop(rank=1)
+    ):
+        mc = MetricCollection(build(), compute_groups=True, fused_dispatch=True)
+        for m in mc._modules.values():
+            m.distributed_available_fn = lambda: True
+        mc.update(preds, target)
+        got = {k: np.asarray(v) for k, v in mc.compute().items()}
+    # identical-rank world: survivor fold == local fold
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], atol=1e-6, err_msg=k)
+    assert mc._epoch_sync.stats.sync_degraded_folds == 1
+
+
+def test_clean_run_has_zero_fault_counters(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(6)
+    with engine_context(True), diag_context() as rec:
+        m = _metric()
+        m.update(preds, target)
+        m.compute()
+    st = m._epoch.stats
+    assert st.sync_retries == 0
+    assert st.sync_degraded_folds == 0
+    assert rec.counts.get("sync.retry", 0) == 0
+    assert rec.counts.get("sync.degraded", 0) == 0
+    assert rec.counts.get("sync.fault", 0) == 0
+
+
+def test_degraded_counter_rides_prometheus(monkeypatch):
+    _identical_rank_world(monkeypatch)
+    from torchmetrics_tpu.diag.telemetry import export_prometheus
+    from torchmetrics_tpu.engine.stats import reset_engine_stats
+
+    reset_engine_stats()
+    preds, target = _batch(7)
+    with engine_context(True), resilience_context(retries=0, backoff_ms=1), fault_context(
+        RankDrop(rank=1)
+    ):
+        m = _metric()
+        m.update(preds, target)
+        m.compute()
+    text = export_prometheus()
+    assert "tm_tpu_sync_degraded_folds_total 1" in text
+    reset_engine_stats()
+
+
+def test_in_flight_timeout_is_not_retried(monkeypatch):
+    """A watchdog escape never re-enters the collective: the abandoned call
+    may still complete, and a re-issued collective would desequence the rank's
+    stream against its peers. Retries>0 must not change that."""
+    _identical_rank_world(monkeypatch)
+    from jax.experimental import multihost_utils
+
+    calls = {"n": 0}
+
+    def hanging(x, tiled=False):
+        calls["n"] += 1
+        time.sleep(5.0)
+        return np.stack([np.asarray(x)] * 2)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", hanging)
+    t0 = time.perf_counter()
+    with resilience_context(deadline_ms=100, retries=5, backoff_ms=1):
+        with pytest.raises(CollectiveTimeoutError) as err:
+            gather_all_tensors(jnp.ones((3,)))
+    assert time.perf_counter() - t0 < 2.0  # ONE deadline, not six
+    assert err.value.in_flight is True
+    assert err.value.retryable is False
+    assert calls["n"] == 1
+
+
+def test_straggler_hint_is_consumed_once():
+    res_mod.note_straggler(3)
+    assert res_mod.consume_straggler_hint() == 3
+    assert res_mod.consume_straggler_hint() is None  # spent — stale blame impossible
+
+
+def test_failed_degrade_does_not_count_a_degraded_fold(monkeypatch):
+    """Both ranks of a world-2 die: the degrade itself fails — the typed error
+    propagates and sync_degraded_folds stays 0 (counted on COMPLETION only)."""
+    _identical_rank_world(monkeypatch)
+    preds, target = _batch(8)
+    with engine_context(True), resilience_context(retries=0, backoff_ms=1), fault_context(
+        RankDrop(rank=1), RankDrop(rank=0)
+    ):
+        m = _metric()
+        m.update(preds, target)
+        with pytest.raises(RankUnreachableError):
+            m.compute()
+    assert m._epoch.stats.sync_degraded_folds == 0
+
+
+def test_eager_timeout_error_env_deadline(monkeypatch):
+    """The env knob alone (no context) bounds the collective."""
+    _identical_rank_world(monkeypatch)
+    from jax.experimental import multihost_utils
+
+    def hanging(x, tiled=False):
+        time.sleep(5.0)
+        return np.stack([np.asarray(x)] * 2)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", hanging)
+    monkeypatch.setenv(res_mod.DEADLINE_ENV_VAR, "100")
+    monkeypatch.setenv(res_mod.RETRIES_ENV_VAR, "0")
+    t0 = time.perf_counter()
+    with pytest.raises(CollectiveTimeoutError):
+        gather_all_tensors(jnp.ones((2, 2)))
+    assert time.perf_counter() - t0 < 2.0

@@ -54,6 +54,8 @@ _COUNTER_HELP = {
     "sync_fold_traces": "fold / fused sync-compute executables compiled",
     "sync_divergence_flags": "rank-divergent rank-invariant states flagged by the audit",
     "sync_straggler_flags": "packed syncs whose arrival skew exceeded the straggler threshold",
+    "sync_retries": "bounded-collective retries spent inside packed exchanges",
+    "sync_degraded_folds": "packed syncs folded over a degraded (survivor) membership",
     "compute_traces": "compute executables compiled",
     "compute_dispatches": "cached compute dispatches",
     "compute_cache_hits": "compute dispatches served without a re-trace",
@@ -113,6 +115,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
     from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
     from torchmetrics_tpu.engine.stats import engine_report
+    from torchmetrics_tpu.parallel.resilience import resilience_snapshot
 
     rec = recorder if recorder is not None else active_recorder()
     counters = engine_report()
@@ -124,6 +127,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
         "sentinels": sentinel_report(),
         "histograms": histograms_snapshot(),
         "profile": profile_snapshot(),
+        "resilience": resilience_snapshot(),
     }
 
 

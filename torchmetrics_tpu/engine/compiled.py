@@ -291,7 +291,9 @@ def input_signature(inputs: Sequence[Any]) -> Optional[Tuple]:
         if isinstance(a, jax.core.Tracer):
             return None
         if _is_jax_array(a) or isinstance(a, np.ndarray):
-            sig.append((tuple(a.shape), str(a.dtype)))
+            # dtype OBJECT, not str(dtype): numpy re-derives the name string on
+            # every call and this key is rebuilt on every warm step
+            sig.append((tuple(a.shape), a.dtype))
         else:
             return None
     return tuple(sig)
@@ -376,7 +378,7 @@ class CompiledUpdate:
         if _sentinel.sentinel_enabled():
             state[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
 
-        state_sig = tuple((k, tuple(v.shape), str(v.dtype)) for k, v in state.items())
+        state_sig = tuple((k, tuple(v.shape), v.dtype) for k, v in state.items())
         key = (bucketed, len(args), kw_names, state_sig, in_sig, self._device_token(state))
 
         entry = self._cache.get(key)
@@ -395,7 +397,7 @@ class CompiledUpdate:
                 # trace failure lands in the same demote-to-eager handler the
                 # lazy first dispatch used
                 entry = self._compile(len(args), kw_names, bucketed, inputs, state, n_pad, key)
-            fn, donate, scope = entry
+            fn, donate, scope, step_bytes = entry
             if donate:
                 state = shield_state(state, m, st)
             if measuring:
@@ -439,7 +441,8 @@ class CompiledUpdate:
             st.donated_dispatches += 1
         else:
             st.donation_fallbacks += 1
-        bytes_moved = sum(_nbytes(v) for v in state.values()) + sum(_nbytes(a) for a in inputs)
+        # static per-signature byte count, computed once at compile time
+        bytes_moved = step_bytes
         st.bytes_moved += bytes_moved
         dispatch_us = round((perf_counter() - t_dispatch) * 1e6, 3) if measuring else 0.0
         if measuring:
@@ -503,7 +506,8 @@ class CompiledUpdate:
         example = (example_state, np.int32(n_pad), *inputs) if bucketed else (example_state, *inputs)
         donated = sum(_nbytes(v) for v in example_state.values()) if donate else 0
         fn = _costs.aot_compile(fn, owner=owner, kind="update", args=example, donated_bytes=donated)
-        return fn, donate, annotation_scope(owner, "update", key)
+        step_bytes = sum(_nbytes(v) for v in example_state.values()) + sum(_nbytes(a) for a in inputs)
+        return fn, donate, annotation_scope(owner, "update", key), step_bytes
 
     @staticmethod
     def _device_token(state: Dict[str, Any]) -> str:

@@ -55,6 +55,7 @@ from torchmetrics_tpu.engine.compiled import (
     holds_nested_metrics,
 )
 from torchmetrics_tpu.engine.stats import EngineStats
+from torchmetrics_tpu.parallel import resilience as _resilience
 from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError, all_gather_backbone
 
 #: sentinel: the packed sync succeeded but the compute half must run outside
@@ -180,7 +181,69 @@ def _world_size() -> int:
         return 1
 
 
+def _degraded_replan(
+    plan: PackedSyncPlan, stats: EngineStats, exc: "_resilience.SyncFaultError"
+) -> PackedSyncPlan:
+    """Re-plan over the surviving membership after a classified sync fault.
+
+    The culprit comes from the fault itself when it names a rank (rank-drop,
+    a delayed rank past the deadline) or from the PR-5 straggler detector's
+    last attribution otherwise. No culprit, degraded mode disallowed, or no
+    survivors left => the typed error propagates (fail loud beats fold wrong).
+    The re-plan is membership-keyed: ``plan.signature()`` includes ``members``,
+    so the degraded fold compiles (and caches) separately from the full-world
+    one, and the ``degraded`` marker + ``sync.degraded`` event + counter keep
+    the partial result observable at every surface.
+    """
+    policy = _resilience.current_policy()
+    # fresh evidence only: the fault names its culprit, or the MOST RECENT
+    # flagged straggler does (consume-once — a stale attribution must not
+    # silently exclude a healthy rank's data epochs later)
+    culprit = exc.rank if exc.rank is not None else _resilience.consume_straggler_hint()
+    if not policy.degraded or culprit is None or culprit not in plan.members or len(plan.members) < 2:
+        raise exc
+    survivors = tuple(m for m in plan.members if m != culprit)
+    _diag.record(
+        "sync.degraded", stats.owner,
+        rank=int(culprit), error=type(exc).__name__, label=exc.label,
+        survivors=survivors, attempts=exc.attempts,
+    )
+    replanned = PackedSyncPlan(plan._metrics, plan.world_size, survivors)
+    replanned.degraded = True
+    replanned.excluded_ranks = plan.excluded_ranks + (int(culprit),)
+    return replanned
+
+
 def _exchange(
+    plan: PackedSyncPlan, stats: EngineStats
+) -> Tuple[Dict[str, Any], PackedSyncPlan]:
+    """Run the (fault-bounded) exchange; returns ``(gathered, live plan)``.
+
+    The live plan is the one the caller must fold/cache against: a classified
+    collective fault (timeout past the deadline, unreachable rank — typed
+    errors from ``parallel/resilience.py``, never an indefinite hang) degrades
+    the sync onto a re-planned surviving membership when policy allows, so the
+    returned plan may exclude the culprit rank. Retries spent inside the
+    bounded collectives are folded into ``stats.sync_retries``.
+    """
+    retries_before = _resilience.total_retries()
+    try:
+        while True:
+            try:
+                gathered = _exchange_once(plan, stats)
+                if plan.degraded:
+                    # counted on COMPLETION, not on the replan decision — a
+                    # degrade that itself fails must not read as a degraded fold
+                    stats.sync_degraded_folds += 1
+                return gathered, plan
+            except _resilience.SyncFaultError as exc:
+                # each pass excludes exactly one culprit; bounded by world size
+                plan = _degraded_replan(plan, stats, exc)
+    finally:
+        stats.sync_retries += _resilience.total_retries() - retries_before
+
+
+def _exchange_once(
     plan: PackedSyncPlan, stats: EngineStats
 ) -> Dict[str, Any]:
     """Run the metadata exchange + buffer collectives for ``plan``.
@@ -204,7 +267,7 @@ def _exchange(
         # sanctioned boundary: the metadata probe is host data by design — every
         # rank must inspect the world layout before entering the buffer collectives
         with transfer_allowed("sync-metadata"):
-            gathered_meta = np.asarray(all_gather_backbone(meta, label="meta"))
+            gathered_meta = np.asarray(all_gather_backbone(meta, label="meta", members=plan.members))
         stats.sync_metadata_gathers += 1
         plan.finalize(gathered_meta)
     local = plan.pack()
@@ -215,7 +278,7 @@ def _exchange(
         if plan.world_size == 1:
             gathered[key] = buf[None]
             continue
-        gathered[key] = all_gather_backbone(buf, label=key)
+        gathered[key] = all_gather_backbone(buf, label=key, members=plan.members)
         stats.sync_collectives += 1
         bytes_moved += int(getattr(buf, "nbytes", 0)) * plan.world_size
     stats.sync_bytes_moved += bytes_moved
@@ -237,6 +300,9 @@ def _exchange(
         skew = timeline["skew_us"]
         if timeline["calibrated"] and skew > _profile.straggler_threshold_us():
             stats.sync_straggler_flags += 1
+            # remember the attribution: a later collective timeout with no
+            # culprit of its own degrades onto this rank's exclusion
+            _resilience.note_straggler(timeline["last_rank"])
             _diag.record(
                 "sync.straggler", stats.owner,
                 rank=timeline["last_rank"], skew_us=skew,
@@ -356,7 +422,7 @@ class EpochEngine:
         plan = self._plan(process_group)
         if plan is None:
             return False
-        gathered = _exchange(plan, self.stats)
+        gathered, plan = _exchange(plan, self.stats)
         folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
         if folded is None:
             return False
@@ -377,7 +443,7 @@ class EpochEngine:
         plan = self._plan(process_group)
         if plan is None:
             return None
-        gathered = _exchange(plan, self.stats)
+        gathered, plan = _exchange(plan, self.stats)
         sig = ("fused", plan.signature())
         entry = self._fused_cache.get(sig)
         if entry is _FALLBACK or not self._compute_ok:
@@ -612,7 +678,7 @@ class CollectionEpoch:
         except PackingError as exc:
             self.stats.fallback(f"sync:{exc}")
             return False
-        gathered = _exchange(plan, self.stats)
+        gathered, plan = _exchange(plan, self.stats)
         folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
         if folded is None:
             return False

@@ -52,6 +52,11 @@ class FusedUpdate:
         self.metrics: List[Tuple[str, Any]] = list(metrics)
         self._cache: Dict[Tuple, Any] = {}
         self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}  # key -> fingerprint (retrace attribution)
+        # structural eligibility is frozen per member on first sight, exactly as
+        # CompiledUpdate freezes `_disabled_reason` at engine construction —
+        # re-walking every member's __dict__ for nested metrics on EVERY step
+        # was the dominant warm-path cost in the r09 regression bisect
+        self._member_ok: Dict[str, bool] = {}
         self.stats = EngineStats("fused:" + ",".join(type(m).__name__ for _, m in self.metrics))
 
     @staticmethod
@@ -99,9 +104,13 @@ class FusedUpdate:
         for name, m in self.metrics:
             if m.compiled_update is False:  # the per-metric opt-out outranks fusion
                 continue
-            if not m._defaults or any(isinstance(d, list) for d in m._defaults.values()):
-                continue
-            if holds_nested_metrics(m):
+            ok = self._member_ok.get(name)
+            if ok is None:
+                ok = bool(m._defaults) and not any(
+                    isinstance(d, list) for d in m._defaults.values()
+                ) and not holds_nested_metrics(m)
+                self._member_ok[name] = ok
+            if not ok:
                 continue
             mstate = {k: getattr(m, k) for k in m._defaults}
             if all(_is_jax_array(v) for v in mstate.values()):
@@ -128,8 +137,10 @@ class FusedUpdate:
                 st.bucket_pad_rows += n_pad
                 st.bucket_sizes.add(bucket)
 
+        # dtype OBJECTS, not str(dtype): numpy re-derives the name string on
+        # every call (no caching) and the warm loop builds this key per step
         state_sig = tuple(
-            (name, tuple((k, tuple(v.shape), str(v.dtype)) for k, v in states[name].items()))
+            (name, tuple((k, tuple(v.shape), v.dtype) for k, v in states[name].items()))
             for name, _ in members
         )
         key = (bucketed, state_sig, in_sig)
@@ -150,7 +161,7 @@ class FusedUpdate:
                 self._cache[key] = _FALLBACK
                 st.fallback("too-few-traceable-members")
                 return None
-        fn, donate, fused_names, scope = entry
+        fn, donate, fused_names, scope, step_bytes = entry
         fused = [(name, m) for name, m in members if name in fused_names]
         fused_states = {name: states[name] for name, _ in fused}
 
@@ -200,9 +211,9 @@ class FusedUpdate:
             st.donated_dispatches += 1
         else:
             st.donation_fallbacks += 1
-        bytes_moved = sum(
-            v.nbytes for mstate in fused_states.values() for v in mstate.values()
-        ) + sum(getattr(a, "nbytes", 0) for a in inputs)
+        # bytes are a pure function of the cache key's shapes/dtypes — computed
+        # once at compile time, not re-derived through jax dtype machinery per step
+        bytes_moved = step_bytes
         st.bytes_moved += bytes_moved
         dispatch_us = round((perf_counter() - t_dispatch) * 1e6, 3) if measuring else 0.0
         if measuring:
@@ -283,4 +294,13 @@ class FusedUpdate:
             sum(v.nbytes for mstate in example_states.values() for v in mstate.values()) if donate else 0
         )
         fn = _costs.aot_compile(fn, owner=self.stats.owner, kind="fused", args=example, donated_bytes=donated)
-        return fn, donate, frozenset(name for name, _ in fusable), annotation_scope(self.stats.owner, "fused", key)
+        step_bytes = sum(
+            v.nbytes for mstate in example_states.values() for v in mstate.values()
+        ) + sum(getattr(a, "nbytes", 0) for a in inputs)
+        return (
+            fn,
+            donate,
+            frozenset(name for name, _ in fusable),
+            annotation_scope(self.stats.owner, "fused", key),
+            step_bytes,
+        )

@@ -38,6 +38,8 @@ _COUNTER_FIELDS = (
     "sync_fold_traces",  # fold / fused sync→compute executables compiled
     "sync_divergence_flags",  # rank-divergent rank-invariant states flagged by the audit
     "sync_straggler_flags",  # packed syncs whose arrival skew exceeded the straggler threshold
+    "sync_retries",  # bounded-collective retries spent inside packed exchanges
+    "sync_degraded_folds",  # packed syncs folded over a degraded (survivor) membership
     "compute_traces",  # compute executables compiled (retraces = growth after warmup)
     "compute_dispatches",  # cached compute dispatches (incl. fused sync→compute)
     "compute_cache_hits",  # compute dispatches served without a re-trace
@@ -158,6 +160,7 @@ def reset_engine_stats() -> None:
     from torchmetrics_tpu.diag.hist import reset_histograms
     from torchmetrics_tpu.diag.profile import reset_profile
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
+    from torchmetrics_tpu.parallel.resilience import reset_resilience
 
     reset_engine_counters()
     _diag.clear_recorder()
@@ -165,3 +168,4 @@ def reset_engine_stats() -> None:
     reset_sentinels()
     reset_histograms()
     reset_profile()
+    reset_resilience()

@@ -459,7 +459,7 @@ class Metric:
             if list_attrs:
                 import zlib
 
-                from jax.experimental import multihost_utils
+                from torchmetrics_tpu.parallel.sync import _bounded_allgather
 
                 def _shape_fingerprint(x: Any) -> int:
                     """Stable digest of the per-element shapes of a list state.
@@ -493,7 +493,9 @@ class Metric:
                     ],
                     dtype=jnp.int32,
                 )
-                probe = np.asarray(multihost_utils.process_allgather(local_probe, tiled=False))
+                # bounded like every other eager collective: the deadlock-guard
+                # probe itself must not be able to deadlock
+                probe = np.asarray(_bounded_allgather(local_probe, "eager:list-guard"))
                 for idx, attr in enumerate(list_attrs):
                     col = probe[:, idx, 0]
                     is_cat = self._reductions[attr] == dim_zero_cat

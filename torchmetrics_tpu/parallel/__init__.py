@@ -1,6 +1,34 @@
-"""TPU-native communication backend (mesh collectives; SURVEY §5.8)."""
+"""TPU-native communication backend (mesh collectives; SURVEY §5.8).
 
+Fault tolerance rides the same package: ``resilience`` bounds every host
+collective (deadline / retry / typed errors), ``faults`` injects deterministic
+chaos at that boundary, and ``elastic`` reshards checkpointed state across
+world sizes. See ``docs/pages/reliability.md``.
+"""
+
+from torchmetrics_tpu.parallel.elastic import (
+    SnapshotIntegrityError,
+    SnapshotReshardError,
+    SnapshotVersionError,
+    restore_resharded,
+    save_state_shard,
+)
+from torchmetrics_tpu.parallel.faults import (
+    CollectiveTimeout,
+    CorruptPayload,
+    DelayRank,
+    RankDrop,
+    fault_context,
+)
 from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError
+from torchmetrics_tpu.parallel.resilience import (
+    CollectiveTimeoutError,
+    PayloadCorruptError,
+    RankUnreachableError,
+    SyncFaultError,
+    resilience_context,
+    resilience_snapshot,
+)
 from torchmetrics_tpu.parallel.sync import (
     EvalMesh,
     axis_gather,
@@ -13,14 +41,30 @@ from torchmetrics_tpu.parallel.sync import (
 )
 
 __all__ = [
+    "CollectiveTimeout",
+    "CollectiveTimeoutError",
+    "CorruptPayload",
+    "DelayRank",
     "EvalMesh",
     "PackedSyncPlan",
     "PackingError",
+    "PayloadCorruptError",
+    "RankDrop",
+    "RankUnreachableError",
+    "SnapshotIntegrityError",
+    "SnapshotReshardError",
+    "SnapshotVersionError",
+    "SyncFaultError",
     "axis_gather",
     "axis_max",
     "axis_mean",
     "axis_min",
     "axis_sum",
+    "fault_context",
     "gather_all_tensors",
     "jit_distributed_available",
+    "resilience_context",
+    "resilience_snapshot",
+    "restore_resharded",
+    "save_state_shard",
 ]

@@ -1,0 +1,237 @@
+"""Deterministic fault injection at the collective boundary.
+
+Every recovery path in the fault-tolerance layer — bounded retry, degraded-mode
+folding, payload-CRC rejection — is only as trustworthy as its exercise. This
+harness plants faults at exactly the boundary the resilience wrapper guards
+(:func:`~torchmetrics_tpu.parallel.resilience.bounded_collective`, which every
+``all_gather_backbone`` and eager ``gather_all_tensors`` call rides), so tests,
+``bench.py`` chaos scenarios, and CI all drive the *production* code path — no
+parallel test-only shims.
+
+Design rules:
+
+- **Deterministic and seed-free.** A fault fires on the Nth matching call
+  (``after`` skips, ``times`` bounds), never on a random draw — a chaos run is
+  reproducible byte-for-byte.
+- **Membership-aware.** Rank-scoped faults (``RankDrop``, ``DelayRank``,
+  ``CorruptPayload``) consult the live membership the caller passes: a rank
+  excluded by a degraded re-plan no longer fires its fault. That is the
+  harness's model of a reformed communicator over the survivors — exactly the
+  behavior a real elastic runtime exhibits after it evicts a dead rank.
+- **Scoped.** ``fault_context(...)`` is a contextvar scope; nothing leaks into
+  the process after the ``with`` block.
+
+Fault kinds (all raise/act through the resilience wrapper's classification):
+
+=====================  ======================================================
+``CollectiveTimeout``  the matching collective raises
+                       :class:`~torchmetrics_tpu.parallel.resilience.
+                       CollectiveTimeoutError` (simulating a deadline expiry)
+``RankDrop``           a rank is unreachable: matching collectives raise
+                       :class:`RankUnreachableError` *while the rank is in the
+                       live membership* — persistent by default
+``DelayRank``          a rank genuinely sleeps before the collective; when the
+                       sleep exceeds the configured deadline the call times
+                       out *naming that rank*
+``CorruptPayload``     the gathered result's row for ``rank`` is bit-flipped
+                       after the collective (transport corruption); the CRC
+                       echo check classifies it
+=====================  ======================================================
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Generator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CollectiveTimeout",
+    "CorruptPayload",
+    "DelayRank",
+    "Fault",
+    "RankDrop",
+    "active_faults",
+    "apply_after",
+    "apply_before",
+    "fault_context",
+]
+
+
+class Fault:
+    """One deterministic injection rule.
+
+    Args:
+        label: collective label to match — ``None`` matches any, a trailing
+            ``*`` matches by prefix (``"reduce:*"``), otherwise exact.
+        rank: the rank this fault models (required for rank-scoped kinds).
+        times: matching calls that fire (``None`` = every one; the default 1
+            keeps "fires once, recovery retries succeed" the natural shape).
+        after: matching calls to skip before the first fire.
+    """
+
+    kind = ""
+    rank_scoped = False
+
+    def __init__(
+        self,
+        label: Optional[str] = None,
+        rank: Optional[int] = None,
+        times: Optional[int] = 1,
+        after: int = 0,
+    ) -> None:
+        if self.rank_scoped and rank is None:
+            raise ValueError(f"{type(self).__name__} requires a target rank")
+        self.label = label
+        self.rank = rank
+        self.times = times
+        self.after = int(after)
+        self.fired = 0
+        self._seen = 0
+
+    def _matches(self, label: str) -> bool:
+        if self.label is None:
+            return True
+        if self.label.endswith("*"):
+            return label.startswith(self.label[:-1])
+        return label == self.label
+
+    def due(self, label: str, members: Optional[Sequence[int]]) -> bool:
+        """Consume one matching call; True when this one fires.
+
+        Membership is a *precondition*, not a consumption: a rank-scoped fault
+        whose rank has been excluded from the live membership neither fires
+        nor counts the call (the reformed communicator no longer talks to it).
+        """
+        if not self._matches(label):
+            return False
+        if self.rank_scoped and members is not None and self.rank not in members:
+            return False
+        self._seen += 1
+        if self._seen <= self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+class CollectiveTimeout(Fault):
+    """The matching collective times out (a planted deadline expiry)."""
+
+    kind = "timeout"
+
+
+class RankDrop(Fault):
+    """``rank`` is dead: matching collectives fail while it is in the world.
+
+    Persistent by default (``times=None``) — a dead rank stays dead; recovery
+    is the degraded re-plan that removes it from the membership, after which
+    this fault's membership precondition stops it firing.
+    """
+
+    kind = "rank-drop"
+    rank_scoped = True
+
+    def __init__(self, rank: int, label: Optional[str] = None, times: Optional[int] = None, after: int = 0):
+        super().__init__(label=label, rank=rank, times=times, after=after)
+
+
+class DelayRank(Fault):
+    """``rank`` arrives late: the call genuinely sleeps ``delay_ms`` first.
+
+    With a deadline configured and ``delay_ms`` past it, the collective times
+    out *naming the delayed rank* — the measured-not-forged ethos of the PR-5
+    planted-straggler scenarios.
+    """
+
+    kind = "delay"
+    rank_scoped = True
+
+    def __init__(self, rank: int, delay_ms: float, label: Optional[str] = None, times: Optional[int] = 1, after: int = 0):
+        super().__init__(label=label, rank=rank, times=times, after=after)
+        self.delay_ms = float(delay_ms)
+
+
+class CorruptPayload(Fault):
+    """Bit-flip the gathered row of ``rank`` after the collective returns."""
+
+    kind = "corrupt"
+    rank_scoped = True
+
+    def __init__(self, rank: int, label: Optional[str] = None, times: Optional[int] = 1, after: int = 0):
+        super().__init__(label=label, rank=rank, times=times, after=after)
+
+
+_FAULTS_VAR: "ContextVar[Tuple[Fault, ...]]" = ContextVar("tm_tpu_faults", default=())
+
+
+@contextmanager
+def fault_context(*faults: Fault) -> Generator[Tuple[Fault, ...], None, None]:
+    """Scope the given faults over every bounded collective inside the block."""
+    for f in faults:
+        if not isinstance(f, Fault):
+            raise TypeError(f"expected Fault instances, got {type(f).__name__}")
+    token = _FAULTS_VAR.set(_FAULTS_VAR.get() + tuple(faults))
+    try:
+        yield tuple(faults)
+    finally:
+        _FAULTS_VAR.reset(token)
+
+
+def active_faults() -> Tuple[Fault, ...]:
+    return _FAULTS_VAR.get()
+
+
+def apply_before(
+    label: str,
+    members: Optional[Sequence[int]],
+    deadline_ms: Optional[float],
+    attempt: int,
+) -> None:
+    """Fire pre-collective faults (timeout / drop / delay) for this call."""
+    from torchmetrics_tpu.parallel import resilience as _res
+
+    for fault in _FAULTS_VAR.get():
+        if fault.kind == "timeout" and fault.due(label, members):
+            raise _res.CollectiveTimeoutError(
+                f"planted collective timeout on {label!r} (attempt {attempt})",
+                label=label,
+                rank=fault.rank,
+                attempts=attempt,
+            )
+        if fault.kind == "rank-drop" and fault.due(label, members):
+            raise _res.RankUnreachableError(
+                f"planted rank-drop: rank {fault.rank} unreachable in {label!r}",
+                label=label,
+                rank=fault.rank,
+                attempts=attempt,
+            )
+        if fault.kind == "delay" and fault.due(label, members):
+            time.sleep(fault.delay_ms / 1e3)  # the rank is GENUINELY late
+            if deadline_ms is not None and fault.delay_ms > deadline_ms:
+                raise _res.CollectiveTimeoutError(
+                    f"rank {fault.rank} exceeded the {deadline_ms:g} ms deadline on"
+                    f" {label!r} (arrived after {fault.delay_ms:g} ms, attempt {attempt})",
+                    label=label,
+                    rank=fault.rank,
+                    attempts=attempt,
+                )
+
+
+def apply_after(label: str, members: Optional[Sequence[int]], gathered: Any) -> Any:
+    """Fire post-collective faults (payload corruption) on the gathered rows."""
+    out = gathered
+    for fault in _FAULTS_VAR.get():
+        if fault.kind != "corrupt" or not fault.due(label, members):
+            continue
+        arr = np.array(np.asarray(out), copy=True)
+        if arr.ndim >= 1 and fault.rank is not None and fault.rank < arr.shape[0]:
+            row = np.ascontiguousarray(arr[fault.rank])
+            flipped = row.view(np.uint8) ^ np.uint8(0xFF)  # bit-flip every byte
+            arr[fault.rank] = flipped.view(row.dtype).reshape(row.shape)
+        out = arr
+    return out

@@ -81,7 +81,7 @@ def _fingerprint(dims: Sequence[int]) -> int:
     return zlib.crc32(np.asarray(list(dims), dtype=np.int64).tobytes()) & 0x7FFFFFFF
 
 
-def all_gather_backbone(x: Any, label: str = "") -> Any:
+def all_gather_backbone(x: Any, label: str = "", members: Optional[Sequence[int]] = None) -> Any:
     """The host collective: one ``process_allgather`` returning ``(world, ...)``.
 
     Isolated here so tests and benches can monkeypatch a fake world, and so a
@@ -94,16 +94,34 @@ def all_gather_backbone(x: Any, label: str = "") -> Any:
     must not flag it) and each issue is recorded as a ``collective`` flight-
     recorder event carrying its role/dtype ``label`` (the plan's buffer key,
     e.g. ``"reduce:int32"``, or ``"meta"``) and payload bytes.
+
+    The raw collective rides :func:`~torchmetrics_tpu.parallel.resilience.
+    bounded_collective`: a configured deadline/retry policy bounds it (typed
+    :class:`~torchmetrics_tpu.parallel.resilience.SyncFaultError` instead of an
+    indefinite hang), and the fault-injection harness (``parallel/faults.py``)
+    plants its faults here — ``members`` is the plan's live membership, which
+    rank-scoped faults consult (a degraded re-plan's excluded rank no longer
+    fires). With no policy and no faults active the wrapper is a direct call.
     """
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     from torchmetrics_tpu.diag import trace as _diag
     from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+    from torchmetrics_tpu.parallel.resilience import bounded_collective
 
     _diag.record("collective", "", label=label, bytes=int(getattr(x, "nbytes", 0)))
     with transfer_allowed("collective:" + label):
-        return jnp.asarray(multihost_utils.process_allgather(x, tiled=False))
+        # the lambda re-reads process_allgather at call time so retries see the
+        # live (possibly monkeypatched) collective
+        return jnp.asarray(
+            bounded_collective(
+                lambda: multihost_utils.process_allgather(x, tiled=False),
+                label=label,
+                payload=x,
+                members=members,
+            )
+        )
 
 
 class PackingError(Exception):
@@ -184,6 +202,15 @@ class PackedSyncPlan:
         # whole straggler/clock-offset story — a deliberate, documented cost).
         self.timeline = _profile.timeline_enabled() and self.world_size > 1
         self.timeline_result: Optional[Dict[str, Any]] = None
+        # degraded-mode markers (engine/epoch.py sets them on a re-plan over
+        # surviving membership): a partial fold is never a silent fact — the
+        # marker rides the plan, the count rides EngineStats.sync_degraded_folds,
+        # the event rides the flight recorder, the series rides Prometheus.
+        # Membership-keyed invalidation is structural: `members` is part of
+        # signature(), so a degraded fold can never be served by a full-world
+        # cached executable (or vice versa).
+        self.degraded = False
+        self.excluded_ranks: Tuple[int, ...] = ()
         self._build()
 
     # ------------------------------------------------------------------ build

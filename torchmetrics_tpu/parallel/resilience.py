@@ -1,0 +1,384 @@
+"""Bounded collectives — deadline, retry/backoff, and typed fault taxonomy.
+
+The reference library trusts ``torch.distributed`` absolutely: a hung or dead
+rank wedges every epoch-end ``gather_all_tensors`` forever. This module bounds
+every host collective the package issues (the packed-sync backbone in
+``parallel/packing.py`` AND the eager per-tensor path in ``parallel/sync.py``)
+with an explicit policy:
+
+- **Deadline** (``TORCHMETRICS_TPU_SYNC_DEADLINE_MS`` / ``resilience_context``):
+  the collective runs on a watchdog thread; if it has not returned within the
+  deadline the caller gets a :class:`CollectiveTimeoutError` instead of an
+  indefinite hang. (The abandoned worker thread is a daemon — the underlying
+  collective cannot be cancelled, only *escaped*; document-level honesty, the
+  same trade every collective-timeout implementation makes.) No deadline
+  configured = the wrapper adds zero machinery to the call.
+- **Bounded retry + exponential backoff** (``TORCHMETRICS_TPU_SYNC_RETRIES`` /
+  ``TORCHMETRICS_TPU_SYNC_BACKOFF_MS``): *retryable* failures (timeout,
+  payload corruption — transient by nature) re-enter the collective up to the
+  bound, sleeping ``backoff_ms * 2**attempt`` between attempts; each retry is
+  a counted ``sync.retry`` flight-recorder fact.
+- **Classification**: every failure surfaces as a typed
+  :class:`SyncFaultError` subclass — :class:`CollectiveTimeoutError`,
+  :class:`RankUnreachableError` (not retryable: a dead rank does not come back
+  because we asked again; degraded-mode folding in ``engine/epoch.py`` is the
+  remedy), :class:`PayloadCorruptError` (CRC mismatch, retryable).
+- **Payload integrity** (``verify_payload``): the wrapper fingerprints the
+  local buffer (crc32 over its raw bytes — the same digest family the PR-4
+  divergence audit stamps into the metadata gather) and verifies the gathered
+  result echoes it bit-exactly at this rank's row. That catches loopback/
+  transport corruption of the local shard; *cross-rank* value integrity is the
+  opt-in audit's job (it carries every rank's state CRCs in the metadata
+  exchange).
+
+Fault injection (``parallel/faults.py``) plugs in at exactly this boundary, so
+every recovery path above is exercisable deterministically in tests and bench
+chaos scenarios without a real multi-host world.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Callable, Dict, Generator, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "BACKOFF_ENV_VAR",
+    "DEADLINE_ENV_VAR",
+    "DEGRADED_ENV_VAR",
+    "RETRIES_ENV_VAR",
+    "CollectiveTimeoutError",
+    "PayloadCorruptError",
+    "RankUnreachableError",
+    "ResiliencePolicy",
+    "SyncFaultError",
+    "bounded_collective",
+    "consume_straggler_hint",
+    "current_policy",
+    "last_straggler_rank",
+    "note_straggler",
+    "reset_resilience",
+    "resilience_context",
+    "resilience_snapshot",
+]
+
+#: hard wall-clock bound (ms) on one host collective; unset/0 = unbounded
+DEADLINE_ENV_VAR = "TORCHMETRICS_TPU_SYNC_DEADLINE_MS"
+#: bounded retries for retryable faults (timeout / corrupt payload)
+RETRIES_ENV_VAR = "TORCHMETRICS_TPU_SYNC_RETRIES"
+#: base backoff (ms) between retries; attempt k sleeps base * 2**k
+BACKOFF_ENV_VAR = "TORCHMETRICS_TPU_SYNC_BACKOFF_MS"
+#: "0" forbids degraded-mode folding over surviving membership (default allowed)
+DEGRADED_ENV_VAR = "TORCHMETRICS_TPU_DEGRADED"
+
+DEFAULT_RETRIES = 2
+DEFAULT_BACKOFF_MS = 25.0
+
+
+class SyncFaultError(RuntimeError):
+    """A host collective failed in a *classified* way instead of hanging.
+
+    ``label`` is the collective's buffer key (``"reduce:int32"``, ``"meta"``,
+    ``"eager:state"`` …); ``rank`` names the culprit when one is known (the
+    degraded-mode re-plan in ``engine/epoch.py`` folds over the survivors);
+    ``attempts`` is how many tries the bounded-retry policy spent.
+    """
+
+    retryable = False
+
+    def __init__(self, message: str, label: str = "", rank: Optional[int] = None, attempts: int = 1):
+        super().__init__(message)
+        self.label = label
+        self.rank = rank
+        self.attempts = attempts
+
+
+class CollectiveTimeoutError(SyncFaultError):
+    """The collective exceeded the configured deadline.
+
+    Retryable as a class — a *planted* deadline expiry (fault harness, or a
+    delayed rank classified before the collective was issued) is transient by
+    nature. A timeout that escaped an **in-flight** collective via the
+    watchdog is marked ``retryable = False`` per instance (and
+    ``in_flight = True``): the abandoned worker may still complete its
+    collective later, so re-entering would desequence this rank's collective
+    stream against its peers — silent corruption, strictly worse than the
+    typed error. Recovery for that case is the degraded re-plan or the
+    operator's restart policy, both explicit and observable.
+    """
+
+    retryable = True
+    in_flight = False
+
+
+class RankUnreachableError(SyncFaultError):
+    """A rank is gone from the world (NOT retryable — degrade or fail)."""
+
+    retryable = False
+
+
+class PayloadCorruptError(SyncFaultError):
+    """The gathered payload failed its CRC integrity check (retryable)."""
+
+    retryable = True
+
+
+class ResiliencePolicy:
+    """Resolved knob set governing one collective call."""
+
+    __slots__ = ("deadline_ms", "retries", "backoff_ms", "degraded", "verify_payload")
+
+    def __init__(
+        self,
+        deadline_ms: Optional[float] = None,
+        retries: int = DEFAULT_RETRIES,
+        backoff_ms: float = DEFAULT_BACKOFF_MS,
+        degraded: bool = True,
+        verify_payload: bool = False,
+    ) -> None:
+        self.deadline_ms = None if not deadline_ms else float(deadline_ms)
+        self.retries = max(0, int(retries))
+        self.backoff_ms = max(0.0, float(backoff_ms))
+        self.degraded = bool(degraded)
+        self.verify_payload = bool(verify_payload)
+
+
+_POLICY_VAR: "ContextVar[Optional[ResiliencePolicy]]" = ContextVar("tm_tpu_resilience", default=None)
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def current_policy() -> ResiliencePolicy:
+    """The policy in force: an active ``resilience_context`` scope, else env."""
+    scoped = _POLICY_VAR.get()
+    if scoped is not None:
+        return scoped
+    retries = _env_float(RETRIES_ENV_VAR)
+    backoff = _env_float(BACKOFF_ENV_VAR)
+    return ResiliencePolicy(
+        deadline_ms=_env_float(DEADLINE_ENV_VAR),
+        retries=DEFAULT_RETRIES if retries is None else int(retries),
+        backoff_ms=DEFAULT_BACKOFF_MS if backoff is None else backoff,
+        degraded=os.environ.get(DEGRADED_ENV_VAR, "").strip() != "0",
+    )
+
+
+@contextmanager
+def resilience_context(
+    deadline_ms: Optional[float] = None,
+    retries: int = DEFAULT_RETRIES,
+    backoff_ms: float = DEFAULT_BACKOFF_MS,
+    degraded: bool = True,
+    verify_payload: bool = False,
+) -> Generator[ResiliencePolicy, None, None]:
+    """Scoped collective-resilience policy (tests, benches, serving loops)."""
+    policy = ResiliencePolicy(deadline_ms, retries, backoff_ms, degraded, verify_payload)
+    token = _POLICY_VAR.set(policy)
+    try:
+        yield policy
+    finally:
+        _POLICY_VAR.reset(token)
+
+
+# ------------------------------------------------------------------ counters
+
+# module-level fact surface (reset in the reset_engine_stats lockstep); the
+# epoch engine diffs total_retries() around an exchange to feed EngineStats
+_COUNTS: Dict[str, int] = {}
+
+#: the last straggler rank the packed-sync timeline named (diag/timeline.py);
+#: a timeout that does not know its culprit falls back to this attribution
+_last_straggler: Optional[int] = None
+
+
+def _count(key: str) -> None:
+    _COUNTS[key] = _COUNTS.get(key, 0) + 1
+
+
+def total_retries() -> int:
+    return _COUNTS.get("retries", 0)
+
+
+def note_straggler(rank: int) -> None:
+    """Remember the rank the straggler detector last named (degraded-fold hint)."""
+    global _last_straggler
+    _last_straggler = int(rank)
+
+
+def last_straggler_rank() -> Optional[int]:
+    return _last_straggler
+
+
+def consume_straggler_hint() -> Optional[int]:
+    """Read AND clear the straggler hint — each attribution is spent once.
+
+    The degraded re-plan blames a rank only on fresh evidence: either the
+    fault itself names one, or the most recent flagged straggler does. A
+    consumed (or never-set) hint means an anonymous fault propagates as its
+    typed error instead of silently excluding a possibly-healthy rank's data
+    on stale attribution — fail loud beats fold wrong.
+    """
+    global _last_straggler
+    rank, _last_straggler = _last_straggler, None
+    return rank
+
+
+def resilience_snapshot() -> Dict[str, Any]:
+    """Counters + policy view (deterministically sorted, byte-stable JSON)."""
+    policy = current_policy()
+    return {
+        "counts": {k: _COUNTS[k] for k in sorted(_COUNTS)},
+        "deadline_ms": policy.deadline_ms,
+        "retries": policy.retries,
+        "backoff_ms": policy.backoff_ms,
+        "degraded": policy.degraded,
+        "last_straggler_rank": _last_straggler,
+    }
+
+
+def reset_resilience() -> None:
+    """Zero the fault/retry counters (``reset_engine_stats`` lockstep); the
+    policy knobs are configuration, not measurement, and survive."""
+    global _last_straggler
+    _COUNTS.clear()
+    _last_straggler = None
+
+
+# ------------------------------------------------------------------ the wrapper
+
+
+def _payload_crc(payload: Any) -> Optional[int]:
+    """crc32 over the payload's raw bytes; None when it has no buffer view."""
+    try:
+        arr = np.asarray(payload)
+        return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+    except Exception:  # noqa: BLE001 — non-array payloads just skip verification
+        return None
+
+
+def _local_rank() -> int:
+    import jax
+
+    try:
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — un-initialized backend reads as rank 0
+        return 0
+
+
+def _call_with_deadline(call: Callable[[], Any], deadline_ms: float, label: str, attempts: int) -> Any:
+    """Run ``call`` on a watchdog thread; escape with a typed timeout.
+
+    The worker is a daemon: a genuinely hung collective cannot be cancelled
+    from the host side, so the caller *escapes* (typed error, degraded-fold
+    option) while the dead thread is abandoned — strictly better than the
+    reference behavior (the whole process wedges forever).
+    """
+    box: Dict[str, Any] = {}
+
+    def run() -> None:
+        try:
+            box["out"] = call()
+        except BaseException as exc:  # noqa: BLE001 — re-raised on the caller thread
+            box["err"] = exc
+
+    worker = threading.Thread(target=run, daemon=True, name=f"tm-collective-{label}")
+    worker.start()
+    worker.join(deadline_ms / 1e3)
+    if worker.is_alive():
+        err = CollectiveTimeoutError(
+            f"collective {label!r} exceeded the {deadline_ms:g} ms deadline"
+            f" (attempt {attempts}); the epoch would have hung without it."
+            " The in-flight collective was abandoned, so this error is not"
+            " retried — re-entering could desequence the collective stream"
+            " if the abandoned call later completes",
+            label=label,
+            rank=None,  # culprit attribution is the degraded re-plan's job
+            attempts=attempts,
+        )
+        err.retryable = False
+        err.in_flight = True
+        raise err
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
+def bounded_collective(
+    call: Callable[[], Any],
+    label: str = "",
+    payload: Any = None,
+    members: Optional[Sequence[int]] = None,
+) -> Any:
+    """Run one host collective under the active resilience policy.
+
+    ``call`` performs the raw collective (re-invoked on retry); ``payload`` is
+    the local buffer (CRC echo verification); ``members`` is the plan's live
+    membership — the fault-injection harness consults it so a rank excluded by
+    a degraded re-plan no longer fires its fault (the harness's model of a
+    reformed communicator).
+
+    Raises a typed :class:`SyncFaultError` subclass when the policy's bounds
+    are exhausted — never hangs past a configured deadline, never retries
+    unboundedly, never mislabels a failure as a generic crash.
+    """
+    from torchmetrics_tpu.diag import trace as _diag
+    from torchmetrics_tpu.parallel import faults as _faults
+
+    policy = current_policy()
+    local_crc = _payload_crc(payload) if policy.verify_payload else None
+    attempt = 0
+    while True:
+        attempts = attempt + 1
+        try:
+            _faults.apply_before(label, members, policy.deadline_ms, attempts)
+            if policy.deadline_ms is not None:
+                out = _call_with_deadline(call, policy.deadline_ms, label, attempts)
+            else:
+                out = call()
+            out = _faults.apply_after(label, members, out)
+            if local_crc is not None:
+                rank = _local_rank()
+                got = np.asarray(out)
+                if rank < got.shape[0]:
+                    echo_crc = zlib.crc32(np.ascontiguousarray(got[rank]).tobytes()) & 0xFFFFFFFF
+                    if echo_crc != local_crc:
+                        raise PayloadCorruptError(
+                            f"collective {label!r}: gathered row {rank} does not echo the"
+                            f" local payload (crc {echo_crc:#010x} != {local_crc:#010x},"
+                            f" attempt {attempts})",
+                            label=label,
+                            rank=rank,
+                            attempts=attempts,
+                        )
+            return out
+        except SyncFaultError as exc:
+            exc.attempts = attempts
+            _count(f"fault:{type(exc).__name__}")
+            if not exc.retryable or attempt >= policy.retries:
+                _diag.record(
+                    "sync.fault", "", label=label, error=type(exc).__name__,
+                    rank=exc.rank, attempts=attempts, retryable=exc.retryable,
+                )
+                raise
+            _count("retries")
+            _diag.record(
+                "sync.retry", "", label=label, error=type(exc).__name__,
+                rank=exc.rank, attempt=attempts, backoff_ms=policy.backoff_ms * (2 ** attempt),
+            )
+            if policy.backoff_ms:
+                time.sleep(policy.backoff_ms * (2 ** attempt) / 1e3)
+            attempt += 1

@@ -85,13 +85,29 @@ def axis_min(x: Array, axis_name: str) -> Array:
 # Mode 2 — host-level process collectives (DCN / multi-host path)
 # --------------------------------------------------------------------------------------
 
-def _simple_gather_all_tensors(result: Array, group: Any, world_size: int) -> List[Array]:
-    """Equal-shape gather (reference ``distributed.py:90-94``)."""
+def _bounded_allgather(x: Any, label: str) -> Any:
+    """One eager-path ``process_allgather`` under the resilience policy.
+
+    The eager (non-engine) sync path — every fallback counted by
+    ``EngineStats.fallback`` lands here — must not be able to deadlock either:
+    the same deadline/retry/typed-error policy that bounds the packed backbone
+    (``parallel/resilience.py``) bounds these collectives, and the fault
+    harness (``parallel/faults.py``) can plant at them via ``eager:*`` labels.
+    """
     from jax.experimental import multihost_utils
 
+    from torchmetrics_tpu.parallel.resilience import bounded_collective
+
+    return bounded_collective(
+        lambda: multihost_utils.process_allgather(x, tiled=False), label=label, payload=x
+    )
+
+
+def _simple_gather_all_tensors(result: Array, group: Any, world_size: int) -> List[Array]:
+    """Equal-shape gather (reference ``distributed.py:90-94``)."""
     # process_allgather returns host numpy — convert so downstream reductions see
     # device arrays like every other sync mode
-    gathered = multihost_utils.process_allgather(result, tiled=False)
+    gathered = _bounded_allgather(result, "eager:state")
     return [jnp.asarray(gathered[i]) for i in range(world_size)]
 
 
@@ -120,8 +136,6 @@ def gather_all_tensors(
     """
     if not jit_distributed_available():
         return [result]
-    from jax.experimental import multihost_utils
-
     world_size = jax.process_count()
     members = list(range(world_size)) if group is None else [int(i) for i in group]
     result = jnp.asarray(result)
@@ -131,7 +145,7 @@ def gather_all_tensors(
         return [gathered[i] for i in members]
 
     local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
-    all_shapes = multihost_utils.process_allgather(local_shape, tiled=False)
+    all_shapes = _bounded_allgather(local_shape, "eager:shape")
     all_shapes = [tuple(int(d) for d in all_shapes[i]) for i in range(world_size)]
 
     # EVERY process participates in the underlying collective (sub-worlds only
@@ -140,13 +154,13 @@ def gather_all_tensors(
     # non-member with a larger shape a negative pad, killing it while the members
     # deadlock in the collective (caught by the world-3 sub-group test).
     if all(s == all_shapes[0] for s in all_shapes):
-        gathered = multihost_utils.process_allgather(result, tiled=False)
+        gathered = _bounded_allgather(result, "eager:state")
         return [jnp.asarray(gathered[i]) for i in members]
 
     max_shape = tuple(max(s[d] for s in all_shapes) for d in range(result.ndim))
     pad = [(0, m - s) for m, s in zip(max_shape, result.shape)]
     padded = jnp.pad(result, pad)
-    gathered = multihost_utils.process_allgather(padded, tiled=False)
+    gathered = _bounded_allgather(padded, "eager:state")
     out = []
     for i in members:
         slices = tuple(slice(0, d) for d in all_shapes[i])
