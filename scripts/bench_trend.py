@@ -9,9 +9,10 @@ one "within-slack" notch per PR).
 
 Reads every ``BENCH_r*.json`` in the repo root in round order and prints one
 trajectory table: dispatches/step, collectives/sync, metadata gathers/sync,
-retraces after warmup, recorder & profiler overhead %, compile_ms. Counters a
-round predates print as ``-`` (older envelopes legitimately lack newer
-fields).
+retraces after warmup, recorder & profiler overhead %, compile_ms, quarantined
+batches and fallback-ladder retries. Counters a round predates print as ``-``
+(older envelopes legitimately lack newer fields — including whole scenarios
+and ``"extras": null`` rounds from before the counter era).
 
 With ``--bench-json`` (a fresh ``bench.py --smoke`` output) the script also
 gates: each KEY counter of the fresh run must not regress past the newest
@@ -45,6 +46,13 @@ _TRACKED = (
     ("engine", "recorder_overhead_pct", "slack"),
     ("engine", "profiler_overhead_pct", "slack"),
     ("engine", "ledger_compile_ms_total", "slack"),
+    # transactional layer (engine/txn.py, PR 7): quarantine + fallback ladder.
+    # quarantined_batches tracks the PLANTED poison count (exactness is
+    # check_counters' job); the clean-run and host-transfer counters gate.
+    ("txn", "quarantined_batches", None),
+    ("txn", "ladder_retries", None),
+    ("txn", "quarantine_host_transfers", "max"),
+    ("txn", "clean_quarantined_batches", "max"),
 )
 
 _TOL = 1e-6
@@ -61,7 +69,16 @@ def rounds(repo: str = REPO):
 
 
 def _counter(payload: dict, scenario: str, counter: str):
-    return payload.get("extras", {}).get(scenario, {}).get(counter)
+    # older rounds predate whole scenarios and may carry ``"extras": null`` or
+    # a non-dict scenario slot (a tpu_unavailable status marker): every level
+    # of the walk must tolerate that, not KeyError/AttributeError on it
+    extras = payload.get("extras")
+    if not isinstance(extras, dict):
+        return None
+    block = extras.get(scenario)
+    if not isinstance(block, dict):
+        return None
+    return block.get(counter)
 
 
 def _fmt(value) -> str:

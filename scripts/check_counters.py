@@ -38,6 +38,17 @@ and fails CI when any counter regresses past the committed baseline
 - ``sync_straggler_flags`` == 0 on the CLEAN epoch run, while the
   planted-straggler run must flag (``straggler_flagged``) the CORRECT rank
   (``straggler_rank_correct``) with zero unsanctioned transfers
+- transactional-integrity proofs (``engine/txn.py`` + ``parallel/elastic.py``):
+  the poisoned-stream run quarantines EXACTLY the planted batch count
+  (``quarantined_batches`` == ``quarantine_planted``, ``quarantined_match``)
+  with byte-identical final values (``parity_ok``), zero hot-loop host
+  transfers (``quarantine_host_transfers`` == 0) and zero warm retraces (the
+  admission prelude lives inside the already-compiled step); the CLEAN run
+  quarantines nothing (``clean_quarantined_batches`` == 0); the planted
+  compile-OOM steps down the fallback ladder with parity (``ladder_retries``
+  truthy, ``ladder_parity_ok``); SIGTERM mid-run leaves a restorable
+  last-good snapshot whose ``restore_latest()`` fingerprint matches on every
+  rank (``sigterm_snapshot_ok``)
 - fault-tolerance proofs (``parallel/resilience.py`` + ``parallel/faults.py``):
   the planted collective timeout recovers by bounded retry with full parity
   (``fault_timeout_retries`` truthy, ``fault_timeout_parity_ok``), the planted
@@ -114,6 +125,20 @@ _CHECKS = (
     ("epoch", "degraded_parity_ok", "true", None),  # survivor fold matches
     ("epoch", "reshard_roundtrip_ok", "true", None),  # world-2 -> world-1 identical compute
     ("epoch", "fault_host_transfers", "abs", 0),  # chaos ran under the STRICT guard
+    # transactional-integrity gates (engine/txn.py + parallel/elastic.py, PR 7):
+    # "eqfield" compares two counters of the SAME fresh run — exactness, not an
+    # envelope (the planted poison count is the run's own ground truth)
+    ("txn", "quarantined_batches", "eqfield", "quarantine_planted"),
+    ("txn", "quarantined_match", "true", None),  # ...and every fused member agrees
+    ("txn", "parity_ok", "true", None),  # quarantined == clean-skip, byte-identical
+    ("txn", "quarantine_host_transfers", "abs", 0),  # flag never read in the hot loop
+    ("txn", "quarantine_retraces_after_warmup", "abs", 0),  # prelude doesn't retrace
+    ("txn", "quarantine_retraces_uncaused", "abs", 0),
+    ("txn", "clean_quarantined_batches", "abs", 0),  # healthy data pays nothing
+    ("txn", "ladder_retries", "true", None),  # planted OOM DID step down a bucket
+    ("txn", "ladder_parity_ok", "true", None),  # ...and the chunked step matches
+    ("txn", "ladder_host_transfers", "abs", 0),
+    ("txn", "sigterm_snapshot_ok", "true", None),  # restore_latest fingerprint parity
 )
 
 
@@ -154,21 +179,31 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch"):
+    for scenario in ("engine", "epoch", "txn"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")
-    f_extras = fresh.get("extras", {})
-    b_extras = baseline.get("extras", {})
+
+    def _slot(payload: dict, scenario: str) -> dict:
+        # older rounds carry ``"extras": null`` or status strings in scenario
+        # slots — every level must tolerate that, not KeyError on it
+        extras = payload.get("extras")
+        block = extras.get(scenario) if isinstance(extras, dict) else None
+        return block if isinstance(block, dict) else {}
+
     for scenario, counter, kind, absolute in _CHECKS:
-        got = f_extras.get(scenario, {}).get(counter)
-        base = b_extras.get(scenario, {}).get(counter)
+        got = _slot(fresh, scenario).get(counter)
+        base = _slot(baseline, scenario).get(counter)
         if got is None:
             failures.append(f"{scenario}.{counter}: missing from the fresh run")
             continue
         if kind == "true":
             ok = bool(got)
             bound = "true"
+        elif kind == "eqfield":  # exactness against a sibling counter of the SAME run
+            expected = _slot(fresh, scenario).get(absolute)
+            ok = expected is not None and float(got) == float(expected)
+            bound = f"== {absolute} ({expected})"
         elif kind == "abs" or base is None:
             ok = float(got) <= float(absolute) + _TOL
             bound = f"<= {absolute}"

@@ -67,8 +67,20 @@ elif ! grep -q '"fault_timeout_parity_ok": true' "$BENCH_OUT" \
   # must compute identically — all with zero unsanctioned host transfers
   echo "bench smoke: FAILED (planted-fault recovery proofs missing or degraded)"
   status=1
+elif ! grep -q '"quarantined_match": true' "$BENCH_OUT" \
+  || ! grep -q '"quarantine_host_transfers": 0' "$BENCH_OUT" \
+  || ! grep -q '"clean_quarantined_batches": 0' "$BENCH_OUT" \
+  || ! grep -q '"ladder_parity_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"sigterm_snapshot_ok": true' "$BENCH_OUT"; then
+  # transactional-integrity smoke (engine/txn.py gate): the poisoned stream
+  # must quarantine exactly the planted batches in-graph (zero host transfers,
+  # byte-identical final values), the clean run must quarantine nothing, the
+  # planted compile-OOM must step down the fallback ladder with parity, and a
+  # SIGTERM'd run must leave a restore_latest()-able fingerprint-exact snapshot
+  echo "bench smoke: FAILED (state-transaction quarantine/ladder/snapshot proofs missing or degraded)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn counters present)"
 fi
 
 echo

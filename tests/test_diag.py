@@ -78,7 +78,7 @@ class _HostReader(Metric):
 
 def test_recorder_off_records_nothing():
     assert trace_mod.active_recorder() is None
-    trace_mod.record("update.dispatch", "nobody", dur_us=1.0)  # must be a no-op
+    trace_mod.record("update.dispatch", "nobody", dispatch_us=1.0)  # must be a no-op
     assert trace_mod.active_recorder() is None
 
 
@@ -183,7 +183,7 @@ def test_fused_step_emits_dispatch_and_trace_events():
     assert rec.counts["fused.dispatch"] == 3  # step 1 is the eager discovery pass
     assert rec.counts["collection.step"] == 3
     dispatches = [e for e in rec.snapshot() if e.kind == "fused.dispatch"]
-    assert all(e.data["dur_us"] > 0 and e.data["members"] >= 2 for e in dispatches)
+    assert all(e.data["dispatch_us"] > 0 and e.data["members"] >= 2 for e in dispatches)
 
 
 def test_fallback_events_carry_reason():
@@ -307,7 +307,7 @@ def test_chrome_trace_export_schema(tmp_path):
 
 def test_export_json_roundtrips(tmp_path):
     with diag_context() as rec:
-        trace_mod.record("update.dispatch", "M", dur_us=2.0, bytes=128)
+        trace_mod.record("update.dispatch", "M", dispatch_us=2.0, bytes=128)
         trace_mod.record("fallback", "M", reason="list-state")
     path = str(tmp_path / "events.json")
     assert export_json(path, rec) == 2
@@ -326,7 +326,8 @@ def test_diag_report_aggregates_per_metric():
         rep = diag_report(rec)
     slot = rep["per_metric"]["MulticlassAccuracy"]
     assert slot["dispatches"] == 2 and slot["traces"] == 1 and slot["retraces"] == 1
-    assert slot["host_us"] > 0
+    assert slot["dispatch_us"] > 0
+    assert "host_us" not in slot  # deprecated alias retired after its one-release window
     # under x64 the same step also promotes the states, so the dtype outranks
     # the bucket in the attribution; either way the retrace carries a cause
     expected = "dtype-change" if jax.config.jax_enable_x64 else "bucket-miss"
@@ -338,7 +339,7 @@ def test_diag_report_aggregates_per_metric():
 def test_diag_report_reset_clears_the_reported_recorder():
     """reset=True must clear the recorder the report covered, active or not."""
     with diag_context() as rec:
-        trace_mod.record("update.dispatch", "M", dur_us=1.0)
+        trace_mod.record("update.dispatch", "M", dispatch_us=1.0)
     # rec is no longer active; reset must still clear it (and only it)
     with diag_context() as other:
         trace_mod.record("fallback", "N", reason="x")
@@ -349,7 +350,7 @@ def test_diag_report_reset_clears_the_reported_recorder():
 
 def test_engine_report_reset_clears_diag_buffer():
     with diag_context() as rec:
-        trace_mod.record("update.dispatch", "M", dur_us=1.0)
+        trace_mod.record("update.dispatch", "M", dispatch_us=1.0)
         assert len(rec.events) == 1
         report = engine_report(include_events=True, reset=True)
         assert report["diag"]["events"] == {"update.dispatch": 1}
@@ -394,7 +395,7 @@ def test_recorder_overhead_under_2pct_on_engine_scenario():
     n = 20000
     t0 = time.perf_counter()
     for _ in range(n):
-        probe.record("update.dispatch", "probe", dur_us=1.0, donated=True, bucketed=False, bytes=0)
+        probe.record("update.dispatch", "probe", dispatch_us=1.0, donated=True, bucketed=False, bytes=0)
     per_event_us = (time.perf_counter() - t0) / n * 1e6
 
     overhead_pct = 100.0 * per_event_us * events_per_step / step_us

@@ -142,13 +142,13 @@ def test_sampled_probes_under_strict_guard():
     assert rows[("update", "dispatch_us")]["count"] == 9
 
 
-def test_dispatch_events_carry_dispatch_us_with_deprecated_alias():
+def test_dispatch_events_carry_dispatch_us_only():
     with engine_context(True), diag_context() as rec:
         m = FloatSum(compiled_update=True)
         m.update(jnp.ones((4,)))
     (ev,) = [e for e in rec.snapshot() if e.kind == "update.dispatch"]
     assert ev.data["dispatch_us"] > 0
-    assert ev.data["dur_us"] == ev.data["dispatch_us"]  # one-release alias
+    assert "dur_us" not in ev.data  # deprecated alias retired after its one-release window
 
 
 def test_eager_update_timed_into_histograms():
@@ -156,7 +156,7 @@ def test_eager_update_timed_into_histograms():
         m = FloatSum(compiled_update=False)
         m.update(jnp.ones((4,)))
     (ev,) = [e for e in rec.snapshot() if e.kind == "update.eager"]
-    assert ev.data["dispatch_us"] > 0 and ev.data["dur_us"] == ev.data["dispatch_us"]
+    assert ev.data["dispatch_us"] > 0 and "dur_us" not in ev.data
     assert any(
         r["kind"] == "eager" and r["series"] == "dispatch_us" for r in hist_mod.histograms_snapshot()
     )

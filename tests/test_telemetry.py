@@ -107,6 +107,7 @@ UNITLESS_COUNT_FAMILIES = {
     "tm_tpu_packed_syncs", "tm_tpu_sync_collectives", "tm_tpu_sync_metadata_gathers",
     "tm_tpu_sync_fold_traces", "tm_tpu_sync_divergence_flags", "tm_tpu_sync_straggler_flags",
     "tm_tpu_sync_retries", "tm_tpu_sync_degraded_folds",
+    "tm_tpu_quarantined_batches", "tm_tpu_ladder_retries",
     "tm_tpu_compute_traces", "tm_tpu_compute_dispatches", "tm_tpu_compute_cache_hits",
     "tm_tpu_profile_probes", "tm_tpu_engines", "tm_tpu_retrace_causes",
     "tm_tpu_fallback_reasons", "tm_tpu_events", "tm_tpu_events_dropped",

@@ -1,0 +1,831 @@
+"""Transactional state integrity tests (engine/txn.py + parallel/elastic.py):
+in-graph batch quarantine with rollback, the compile/OOM fallback ladder, and
+preemption-safe continuous snapshots."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassConfusionMatrix
+from torchmetrics_tpu.diag import costs as costs_mod
+from torchmetrics_tpu.diag import diag_context, sentinel as sentinel_mod
+from torchmetrics_tpu.engine import engine_context, txn as txn_mod
+from torchmetrics_tpu.engine.txn import QuarantinedBatchError, quarantine_context
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.parallel.elastic import (
+    ContinuousSnapshotter,
+    SnapshotPolicy,
+    list_snapshots,
+    restore_latest,
+    save_state_shard,
+    shard_path,
+    state_fingerprint,
+)
+
+NUM_CLASSES = 5
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(n, NUM_CLASSES).astype(np.float32)),
+            jnp.asarray(rng.randint(0, NUM_CLASSES, n).astype(np.int32)),
+        )
+        for n in sizes
+    ]
+
+
+def _poison(preds):
+    return preds.at[0, 0].set(jnp.nan)
+
+
+def _states(m):
+    return {k: np.asarray(getattr(m, k)) for k in m._defaults}
+
+
+def _assert_byte_identical(got, want):
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k].dtype == want[k].dtype, k
+        assert got[k].tobytes() == want[k].tobytes(), f"state {k!r} differs"
+
+
+def _identical_rank_world(monkeypatch, world=2):
+    """Every rank holds this process's state: allgather = stack world copies."""
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+    )
+
+
+def _acc(**kw):
+    kw.setdefault("validate_args", False)
+    return MulticlassAccuracy(NUM_CLASSES, average="macro", **kw)
+
+
+# ------------------------------------------------------------------ quarantine
+
+
+@pytest.mark.parametrize("compiled", [False, True], ids=["eager", "compiled"])
+def test_planted_nan_state_byte_identical_to_skip(compiled):
+    """The core transaction claim: a poisoned batch leaves every state leaf
+    byte-identical to never having seen the batch — on BOTH update paths."""
+    batches = _batches([16] * 4, seed=1)
+    bad_preds = _poison(batches[2][0])
+
+    with engine_context(compiled, donate=True), quarantine_context(True):
+        m = _acc(compiled_update=compiled)
+        for i, (p, t) in enumerate(batches):
+            m.update(bad_preds if i == 2 else p, t)
+        skip = _acc(compiled_update=compiled)
+        for i, (p, t) in enumerate(batches):
+            if i != 2:
+                skip.update(p, t)
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        assert txn_mod.read_quarantine(skip)["count"] == 0
+        _assert_byte_identical(_states(m), _states(skip))
+    # _update_count still counts the attempted batch (the stream length), only
+    # the state contribution is rolled back
+    assert m._update_count == 4 and skip._update_count == 3
+
+
+def test_out_of_range_label_quarantined_compiled():
+    """Integer label bounds ride the same admission: target >= num_classes is
+    poison for a num_classes-declaring metric (jax scatter would WRAP it)."""
+    (p, t), (p2, t2) = _batches([8, 8], seed=2)
+    bad_t = t.at[3].set(NUM_CLASSES + 7)
+    with engine_context(True, donate=True), quarantine_context(True):
+        m = MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False)
+        m.update(p, t)
+        m.update(p2, bad_t)
+        skip = MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False)
+        skip.update(p, t)
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        _assert_byte_identical(_states(m), _states(skip))
+
+
+def test_quarantine_world2_packed_sync_counts_gather(monkeypatch):
+    """World-2 emulation: the quarantine counter rides the packed sync's reduce
+    buffer and SUMS across ranks, and the synced state equals the clean-skip
+    synced state byte-identically."""
+    _identical_rank_world(monkeypatch)
+    batches = _batches([16] * 3, seed=3)
+    bad_preds = _poison(batches[1][0])
+
+    with engine_context(True), quarantine_context(True):
+        m = _acc(distributed_available_fn=lambda: True)
+        skip = _acc(distributed_available_fn=lambda: True)
+        for i, (p, t) in enumerate(batches):
+            m.update(bad_preds if i == 1 else p, t)
+            if i != 1:
+                skip.update(p, t)
+        m.sync(distributed_available=lambda: True)
+        skip.sync(distributed_available=lambda: True)
+        # inside the sync window the counter is the WORLD total (both emulated
+        # ranks saw the poisoned batch), folded exactly like _update_count
+        assert int(np.asarray(getattr(m, txn_mod.ATTR))) == 2
+        _assert_byte_identical(_states(m), _states(skip))
+        m.unsync()
+        skip.unsync()
+        # unsync restores the LOCAL count — a later sync must not re-sum a sum
+        assert int(np.asarray(getattr(m, txn_mod.ATTR))) == 1
+        assert m._epoch.stats.packed_syncs == 1
+
+
+def test_quarantine_composes_with_bucketing_pads():
+    """Pad rows are zeros — finite and in-range by construction — so a ragged
+    clean stream quarantines NOTHING, and a poisoned ragged batch rolls back to
+    exactly the clean-skip accumulator (pad-subtract runs on the rejected
+    candidate, never on the preserved old state)."""
+    sizes = [16, 11, 7, 13]
+    batches = _batches(sizes, seed=4)
+    with engine_context(True, donate=True), quarantine_context(True):
+        clean = _acc(compiled_update=True)
+        for p, t in batches:
+            clean.update(p, t)
+        st = clean._engine.stats
+        assert st.bucketed_steps > 0 and st.bucket_pad_rows > 0
+        assert txn_mod.read_quarantine(clean)["count"] == 0
+
+        m = _acc(compiled_update=True)
+        for i, (p, t) in enumerate(batches):
+            m.update(_poison(p) if i == 2 else p, t)
+        skip = _acc(compiled_update=True)
+        for i, (p, t) in enumerate(batches):
+            if i != 2:
+                skip.update(p, t)
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        _assert_byte_identical(_states(m), _states(skip))
+
+
+def test_quarantine_fused_collection_members_agree():
+    """The fused path plans one admission per member; both members of a fused
+    collection quarantine the same planted batch."""
+    from torchmetrics_tpu import MetricCollection
+
+    batches = _batches([16] * 3, seed=5)
+    with engine_context(True, donate=True), quarantine_context(True):
+        mc = MetricCollection(
+            {
+                "acc": _acc(),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+            },
+            compute_groups=True,
+            fused_dispatch=True,
+        )
+        for i, (p, t) in enumerate(batches):
+            mc.update(_poison(p) if i == 1 else p, t)
+        mc._materialize_group_views()
+        counts = {name: txn_mod.read_quarantine(m)["count"] for name, m in mc._modules.items()}
+        assert counts == {"acc": 1, "cm": 1}
+
+
+def test_quarantined_batch_sets_poisoned_bit_not_nan():
+    """Sentinel composition: a quarantined batch raises ONLY input_poisoned —
+    the state genuinely stayed clean, so the sticky nan/inf bits stay clear."""
+    (p, t), _ = _batches([8, 8], seed=6)
+    with engine_context(True, donate=True), quarantine_context(True), sentinel_mod.sentinel_context(True):
+        m = _acc(compiled_update=True)
+        m.update(p, t)
+        m.update(_poison(p), t)
+        read = sentinel_mod.read_sentinel(m)
+    assert read["flags"] & sentinel_mod.FLAG_INPUT_POISONED
+    assert not read["flags"] & sentinel_mod.FLAG_NAN
+    assert not read["flags"] & sentinel_mod.FLAG_POS_INF
+
+
+def test_quarantine_counter_resets_with_metric():
+    (p, t), _ = _batches([8, 8], seed=7)
+    txn_mod.reset_quarantine()  # the registry is process-global: start clean
+    with quarantine_context(True):
+        m = _acc(compiled_update=False)
+        m.update(_poison(p), t)
+        report = txn_mod.quarantine_report()
+        assert {r["owner"]: r["count"] for r in report} == {"MulticlassAccuracy": 1}
+        m.reset()
+        assert txn_mod.read_quarantine(m)["count"] == 0
+        # growth surfaced before the reset stays attributed in EngineStats;
+        # the device counter itself restarts with the accumulator
+        assert all(row["count"] == 0 for row in txn_mod.quarantine_report())
+
+
+# ------------------------------------------------------------------ error mode
+
+
+@pytest.mark.parametrize("compiled", [False, True], ids=["eager", "compiled"])
+def test_error_mode_raises_before_any_mutation(compiled):
+    """TORCHMETRICS_TPU_QUARANTINE=error: both paths raise a typed error BEFORE
+    the accumulator or _update_count can move."""
+    (p, t), _ = _batches([8, 8], seed=8)
+    with engine_context(compiled, donate=True), quarantine_context("error"):
+        m = _acc(compiled_update=compiled)
+        m.update(p, t)
+        before = _states(m)
+        count_before = m._update_count
+        with pytest.raises(QuarantinedBatchError):
+            m.update(_poison(p), t)
+        assert m._update_count == count_before
+        _assert_byte_identical(_states(m), before)
+
+
+def test_error_mode_env_var(monkeypatch):
+    monkeypatch.setenv(txn_mod.QUARANTINE_ENV_VAR, "error")
+    (p, t), _ = _batches([8, 8], seed=9)
+    m = _acc(compiled_update=False)
+    with pytest.raises(QuarantinedBatchError):
+        m.update(_poison(p), t)
+    monkeypatch.setenv(txn_mod.QUARANTINE_ENV_VAR, "1")
+    m.update(_poison(p), t)  # quarantine mode: same batch is skipped, not raised
+    assert txn_mod.read_quarantine(m)["count"] == 1
+
+
+def test_error_mode_fused_collection():
+    from torchmetrics_tpu import MetricCollection
+
+    (p, t), _ = _batches([8, 8], seed=10)
+    with engine_context(True, donate=True), quarantine_context("error"):
+        mc = MetricCollection(
+            {
+                "acc": _acc(),
+                "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+            },
+            compute_groups=True,
+            fused_dispatch=True,
+        )
+        mc.update(p, t)
+        with pytest.raises(QuarantinedBatchError):
+            mc.update(_poison(p), t)
+
+
+# ------------------------------------------------------------------ fallback ladder
+
+
+class _FakeXlaRuntimeError(RuntimeError):
+    pass
+
+
+_FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+def _oom_buckets(monkeypatch, bad_buckets):
+    """aot_compile raises RESOURCE_EXHAUSTED whenever the example's batched
+    inputs sit in one of ``bad_buckets``."""
+    real = costs_mod.aot_compile
+
+    def flaky(fn, owner="", kind="", args=(), donated_bytes=0):
+        for a in args:
+            if getattr(a, "ndim", 0) >= 1 and getattr(a, "shape", (0,))[0] in bad_buckets:
+                raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
+        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+
+    monkeypatch.setattr(costs_mod, "aot_compile", flaky)
+
+
+def test_ladder_steps_down_one_bucket_in_order(monkeypatch):
+    """OOM at bucket 64 → the batch re-enters as two 32-bucket chunks, exact
+    parity, counted, and the signature is NOT permanently demoted."""
+    p, t = _batches([50], seed=11)[0]
+    with engine_context(True, donate=True), diag_context() as rec:
+        _oom_buckets(monkeypatch, {64})
+        m = _acc(compiled_update=True)
+        m.update(p, t)
+    ref = _acc(compiled_update=False)
+    ref.update(p, t)
+    assert np.asarray(m.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+    st = m._engine.stats
+    assert st.ladder_retries == 1
+    rungs = [(e.data["from_bucket"], e.data["to_bucket"]) for e in rec.snapshot() if e.kind == "update.ladder"]
+    assert rungs == [(64, 32)]
+
+
+def test_ladder_exhausted_falls_back_to_eager_with_parity(monkeypatch):
+    """Every rung OOMs: the ladder walks 64→32→16→8, then the step completes
+    eagerly — counted, typed, never a crashed step or a poisoned cache."""
+    p, t = _batches([50], seed=12)[0]
+    with engine_context(True, donate=True), diag_context() as rec:
+        _oom_buckets(monkeypatch, {8, 16, 32, 64})
+        m = _acc(compiled_update=True)
+        m.update(p, t)
+    ref = _acc(compiled_update=False)
+    ref.update(p, t)
+    assert np.asarray(m.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+    rungs = [(e.data["from_bucket"], e.data["to_bucket"]) for e in rec.snapshot() if e.kind == "update.ladder"]
+    assert rungs == [(64, 32), (32, 16), (16, 8)]
+    st = m._engine.stats
+    # the events narrate the attempted walk, but no rung ever APPLIED a chunk
+    # (every bucket OOM'd) — a failed attempt must not claim a retry
+    assert st.ladder_retries == 0
+    assert any("dispatch-resource-exhausted" in r for r in st.fallback_reasons)
+
+
+def test_structural_trace_failure_still_demotes_permanently():
+    """The ladder must not change the structural-failure contract: an
+    untraceable update body demotes its signature to eager exactly once."""
+    class HostyMetric(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("seen", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            # np.unique on a tracer is untraceable — the validate_args class
+            self.seen = self.seen + len(np.unique(np.asarray(x)))
+
+        def compute(self):
+            return self.seen
+
+    with engine_context(True, donate=True):
+        m = HostyMetric(compiled_update=True)
+        m.update(jnp.arange(8.0))
+        m.update(jnp.arange(8.0))
+        st = m._engine.stats
+        assert st.eager_fallbacks >= 1
+        assert st.ladder_retries == 0
+    assert float(m.compute()) == 16.0
+
+
+def test_persistent_transient_failure_demotes_after_budget(monkeypatch):
+    """A signature whose compile keeps raising RESOURCE_EXHAUSTED stops paying
+    a full compile attempt on every step: after TRANSIENT_RETRY_BUDGET
+    classified failures it demotes to eager like a structural failure, with
+    the ``-budget`` suffix distinguishing it from a one-off OOM."""
+    from torchmetrics_tpu.engine import config as engine_config
+
+    # no bucketing → the ladder has no smaller rung, so every failure charges
+    # the budget (with bucketing on, the ladder absorbs the OOM instead)
+    monkeypatch.setattr(engine_config, "BUCKETING_ENABLED", False)
+    attempts = {"n": 0}
+    real = costs_mod.aot_compile
+
+    def always_oom(fn, owner="", kind="", args=(), donated_bytes=0):
+        if kind == "update":
+            attempts["n"] += 1
+            raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
+        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+
+    monkeypatch.setattr(costs_mod, "aot_compile", always_oom)
+    batches = _batches([50] * (txn_mod.TRANSIENT_RETRY_BUDGET + 3), seed=13)
+    extra = _batches([50, 50], seed=14)
+    with engine_context(True, donate=True):
+        m = _acc(compiled_update=True)
+        for p, t in batches:
+            m.update(p, t)
+        st = m._engine.stats
+        # the budget is charged per signature: the x64 warmup step compiles
+        # under its own (pre-promotion) key, so at most BUDGET + 1 attempts
+        assert txn_mod.TRANSIENT_RETRY_BUDGET <= attempts["n"] <= txn_mod.TRANSIENT_RETRY_BUDGET + 1
+        assert st.fallback_reasons["dispatch-resource-exhausted-budget"] == 1
+        # ...and demotion is final: further steps never touch the compiler
+        settled = attempts["n"]
+        demoted = st.fallback_reasons["uncompilable-signature"]
+        for p, t in extra:
+            m.update(p, t)
+        assert attempts["n"] == settled
+        assert st.fallback_reasons["uncompilable-signature"] == demoted + 2
+    # every step still completed eagerly: exact parity with a clean run
+    ref = _acc(compiled_update=False)
+    for p, t in batches + extra:
+        ref.update(p, t)
+    assert np.asarray(m.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+
+
+# ------------------------------------------------------------------ snapshots
+
+
+def test_cadence_policy_update_off_by_one():
+    """every_updates=N: the Nth update since the last flush snapshots, updates
+    1..N-1 do not — counting restarts AFTER each flush."""
+    policy = SnapshotPolicy(every_updates=3)
+    assert not policy.due(1, 0.0)
+    assert not policy.due(2, 0.0)
+    assert policy.due(3, 0.0)
+    assert policy.due(4, 0.0)  # overdue still fires
+
+    (p, t), _ = _batches([8, 8], seed=13)
+    m = _acc(compiled_update=False)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        snap = ContinuousSnapshotter(m, d, policy=policy)
+        fired = []
+        for _ in range(7):
+            m.update(p, t)
+            fired.append(snap.note_update() is not None)
+        # updates 3 and 6 flush; 7 is the first of the NEXT window
+        assert fired == [False, False, True, False, False, True, False]
+        assert snap.flushes == 2
+        assert [seq for seq, _ in list_snapshots(d)] == [1, 2]
+
+
+def test_cadence_policy_seconds_and_env(monkeypatch):
+    clock = [0.0]
+    policy = SnapshotPolicy(every_seconds=2.5)
+    assert not policy.due(0, 2.4)
+    assert policy.due(0, 2.5)
+    monkeypatch.setenv("TORCHMETRICS_TPU_SNAPSHOT_EVERY", "500")
+    assert SnapshotPolicy.from_env().every_updates == 500
+    monkeypatch.setenv("TORCHMETRICS_TPU_SNAPSHOT_EVERY", "30s")
+    assert SnapshotPolicy.from_env().every_seconds == 30.0
+    # invalid values fail loud — a silently-disabled cadence is the data-loss
+    # mode the knob exists to prevent (typos included); only UNSET means None
+    for bad in ("bogus", "30sec", "0", "-5"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_SNAPSHOT_EVERY", bad)
+        with pytest.raises(TorchMetricsUserError):
+            SnapshotPolicy.from_env()
+    monkeypatch.delenv("TORCHMETRICS_TPU_SNAPSHOT_EVERY")
+    assert SnapshotPolicy.from_env() is None
+
+    (p, t), _ = _batches([8, 8], seed=14)
+    m = _acc(compiled_update=False)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        snap = ContinuousSnapshotter(m, d, policy=policy, clock=lambda: clock[0])
+        m.update(p, t)
+        assert snap.note_update() is None
+        clock[0] = 2.6
+        assert snap.note_update() is not None
+
+
+def test_restore_latest_walks_last_good_chain(tmp_path):
+    """A newest sequence that is incomplete or corrupt degrades to the previous
+    complete one — the automated last-good chain."""
+    (p, t), (p2, t2) = _batches([8, 8], seed=15)
+    m = _acc(compiled_update=False)
+    m.update(p, t)
+    good_fp = state_fingerprint(m)
+    save_state_shard(m, shard_path(str(tmp_path / "snap-000001"), 0, 2), rank=0, world_size=2)
+    save_state_shard(m, shard_path(str(tmp_path / "snap-000001"), 1, 2), rank=1, world_size=2)
+    # seq 2: preemption caught only rank 1 mid-flush — incomplete set
+    m.update(p2, t2)
+    save_state_shard(m, shard_path(str(tmp_path / "snap-000002"), 1, 2), rank=1, world_size=2)
+
+    fresh = _acc(compiled_update=False)
+    assert restore_latest(fresh, str(tmp_path), rank=0, world_size=2) == 1
+    assert state_fingerprint(fresh) == good_fp
+
+    # every sequence bad -> typed failure, never a silent empty restore
+    for path in list(tmp_path.iterdir()):
+        path.write_bytes(b"corrupt")
+    from torchmetrics_tpu.parallel.elastic import SnapshotIntegrityError
+
+    with pytest.raises(SnapshotIntegrityError):
+        restore_latest(_acc(compiled_update=False), str(tmp_path), rank=0, world_size=2)
+
+
+def test_snapshot_prune_keeps_complete_recent_sequences(tmp_path):
+    (p, t), _ = _batches([8, 8], seed=16)
+    m = _acc(compiled_update=False)
+    snap = ContinuousSnapshotter(m, str(tmp_path), policy=None, keep=2)
+    for _ in range(4):
+        m.update(p, t)
+        snap.flush()
+    seqs = [seq for seq, _ in list_snapshots(str(tmp_path))]
+    assert seqs == [3, 4]
+    assert restore_latest(_acc(compiled_update=False), str(tmp_path)) == 4
+
+
+_SIGTERM_CHILD = r"""
+import json, os, signal, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.parallel.elastic import ContinuousSnapshotter, SnapshotPolicy, state_fingerprint
+
+out_dir = sys.argv[1]
+m = MulticlassAccuracy(5, validate_args=False)
+fps = {}  # seq -> fingerprint at that completed flush
+
+def note():
+    # the seq advancing is the proof a shard was written; a preemption flush
+    # that landed mid-update SKIPS instead, and the restore then targets an
+    # older sequence whose fingerprint is already recorded here
+    if snap.seq and str(snap.seq) not in fps:
+        fps[str(snap.seq)] = state_fingerprint(m)
+
+def record_fp(signum, frame):
+    # runs LAST in the chain: the snapshotter's preemption flush already ran
+    # (or stood on the last completed snapshot)
+    note()
+    with open(os.path.join(out_dir, "fp.json"), "w") as fh:
+        json.dump(fps, fh)
+    signal.signal(signum, signal.SIG_DFL)
+    signal.raise_signal(signum)
+
+signal.signal(signal.SIGTERM, record_fp)
+snap = ContinuousSnapshotter(m, out_dir, policy=SnapshotPolicy(every_updates=3))
+snap.install_signal_handlers(signals=(signal.SIGTERM,))
+rng = np.random.RandomState(0)
+print("ready", flush=True)
+while True:
+    p = jnp.asarray(rng.rand(8, 5).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 5, 8).astype(np.int32))
+    m.update(p, t)
+    snap.note_update()
+    note()
+    time.sleep(0.01)
+"""
+
+
+def test_sigterm_flushes_final_shard_and_restore_latest_resumes(tmp_path):
+    """Preemption round-trip: SIGTERM mid-stream leaves a last-good snapshot
+    whose restore_latest() fingerprint matches the dying process's state."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_CHILD, str(tmp_path)],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        assert child.stdout.readline().strip() == "ready"
+        deadline = time.time() + 60.0
+        while time.time() < deadline and not list_snapshots(str(tmp_path)):
+            time.sleep(0.05)
+        assert list_snapshots(str(tmp_path)), "child never reached its first cadence flush"
+        time.sleep(0.1)  # land the kill mid-window, after a few more updates
+        child.terminate()
+        rc = child.wait(timeout=60)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    assert rc == -signal.SIGTERM
+
+    with open(tmp_path / "fp.json") as fh:
+        dying_fps = json.load(fh)
+    fresh = _acc(compiled_update=False)
+    seq = restore_latest(fresh, str(tmp_path))
+    assert seq == max(s for s, _ in list_snapshots(str(tmp_path)))
+    # compare against the fingerprint recorded when THAT sequence flushed: a
+    # kill landing mid-update skips the preemption flush, and the dying
+    # process's live state is then legitimately ahead of the last-good shard
+    assert state_fingerprint(fresh) == dying_fps[str(seq)]
+
+
+# ------------------------------------------------------------------ review-fix regressions
+
+
+def test_forward_mean_state_not_diluted_by_quarantined_batch():
+    """forward() under quarantine routes through the full-state path: a
+    count-weighted mean fold over a quarantined (default-state) batch would
+    dilute the global mean toward zero, which 'skip the batch' must not."""
+
+    class MeanMetric(Metric):
+        full_state_update = False  # would pick the reduce path without quarantine
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("value", jnp.zeros(()), dist_reduce_fx="mean")
+
+        def update(self, x):
+            self.value = x.mean()
+
+        def compute(self):
+            return self.value
+
+    x = jnp.asarray(np.float32(4.0)) * jnp.ones((8,), jnp.float32)
+    bad = x.at[0].set(jnp.nan)
+    with quarantine_context(True):
+        m = MeanMetric()
+        m.forward(x)
+        m.forward(bad)  # quarantined: global mean must stay exactly 4.0
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        assert np.asarray(m.value).tobytes() == np.asarray(jnp.float32(4.0)).tobytes()
+
+
+def test_all_quarantined_stream_warns_at_compute():
+    """A stream whose every batch is poisoned must not silently compute a
+    default-state epoch value — compute() surfaces it (and flushes the
+    counter at the sanctioned boundary)."""
+    (p, t), _ = _batches([8, 8], seed=21)
+    bad = _poison(p)
+    with engine_context(True, donate=True), quarantine_context(True):
+        m = _acc()
+        m.update(bad, t)
+        m.update(bad, t)
+        with pytest.warns(UserWarning, match="failed quarantine"):
+            m.compute()
+        assert m._engine.stats.quarantined_batches == 2  # flushed by compute
+
+
+def test_ladder_quarantines_whole_poisoned_batch(monkeypatch):
+    """Quarantine x ladder: a poisoned batch whose bucket OOMs is admitted
+    ONCE for the whole batch — never half-applied by per-chunk admission —
+    and a failed ladder attempt counts no retry."""
+    from torchmetrics_tpu.engine import config as engine_config
+
+    rows = engine_config.MIN_BUCKET * 4
+    rng = np.random.RandomState(22)
+    p = jnp.asarray(rng.rand(rows, NUM_CLASSES).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, NUM_CLASSES, rows).astype(np.int32))
+    bad = _poison(p)
+    bucket = 1 << (rows - 1).bit_length()
+
+    real_aot = costs_mod.aot_compile
+
+    def oom_on_big(fn, owner="", kind="", args=(), donated_bytes=0):
+        for a in args:
+            if getattr(a, "ndim", 0) >= 1 and getattr(a, "shape", (0,))[0] == bucket:
+                raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return real_aot(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+
+    monkeypatch.setattr(costs_mod, "aot_compile", oom_on_big)
+    with engine_context(True, donate=True), quarantine_context(True):
+        m = _acc(compiled_update=True)
+        m.update(bad, t)  # bucket OOMs -> ladder -> whole-batch quarantine
+        skip = _acc(compiled_update=True)
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        _assert_byte_identical(_states(m), _states(skip))
+        # the quarantined ladder handling is not a step-down retry
+        assert m._engine.stats.ladder_retries == 0
+
+
+def test_scrape_inside_sync_window_not_double_counted(monkeypatch):
+    """A sanctioned quarantine read INSIDE the sync window surfaces the world
+    total; after unsync restores the local counter, the next read must add
+    nothing (the local share was already inside the world total)."""
+    _identical_rank_world(monkeypatch)
+    batches = _batches([16] * 3, seed=23)
+    bad_preds = _poison(batches[1][0])
+
+    with engine_context(True), quarantine_context(True):
+        m = _acc(distributed_available_fn=lambda: True)
+        for i, (p, t) in enumerate(batches):
+            m.update(bad_preds if i == 1 else p, t)
+        m.sync(distributed_available=lambda: True)
+        assert txn_mod.read_quarantine(m)["count"] == 2  # world total surfaced
+        stats = m._epoch.stats
+        surfaced = stats.quarantined_batches
+        m.unsync()
+        # the restored local count (1) is already part of the reported 2
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        assert stats.quarantined_batches == surfaced
+
+
+def test_ladder_success_still_charges_transient_budget(monkeypatch):
+    """A bucket that OOMs on EVERY step must stop paying a full XLA compile
+    attempt per step even though the ladder keeps rescuing the batch: the
+    budget charges on each classified failure (ladder success included), and
+    the exhausted signature demotes to eager like a structural failure."""
+    compile_attempts = {"n": 0}
+    real = costs_mod.aot_compile
+
+    def flaky(fn, owner="", kind="", args=(), donated_bytes=0):
+        for a in args:
+            if getattr(a, "ndim", 0) >= 1 and getattr(a, "shape", (0,))[0] == 64:
+                compile_attempts["n"] += 1
+                raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
+        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+
+    monkeypatch.setattr(costs_mod, "aot_compile", flaky)
+    steps = txn_mod.TRANSIENT_RETRY_BUDGET + 2
+    batches = _batches([50] * steps, seed=27) + _batches([50] * 3, seed=28)
+    with engine_context(True, donate=True):
+        m = _acc(compiled_update=True)
+        for p, t in batches[:steps]:
+            m.update(p, t)
+        # budget-bounded: attempts stop at the cap, NOT one per step forever
+        # (+1 covers the x64-warmup key split — the first step's pre-promotion
+        # dtypes form their own signature with their own budget)
+        frozen = compile_attempts["n"]
+        assert frozen <= txn_mod.TRANSIENT_RETRY_BUDGET + 1
+        for p, t in batches[steps:]:
+            m.update(p, t)
+        assert compile_attempts["n"] == frozen  # demoted: zero recompiles per step
+    st = m._engine.stats
+    # the ladder rescued every pre-demotion step; the demoted remainder ran eager
+    assert st.ladder_retries == frozen
+    assert st.fallback_reasons.get("uncompilable-signature") == len(batches) - frozen
+    ref = _acc(compiled_update=False)
+    for p, t in batches:
+        ref.update(p, t)
+    assert np.asarray(m.compute()).tobytes() == np.asarray(ref.compute()).tobytes()
+
+
+def test_collection_error_mode_checks_admission_once_per_member(monkeypatch):
+    """=error mode on a MetricCollection: the collection-level pre-check covers
+    fused owners (which bypass the per-metric wrapper), and unfused owners must
+    not pay a SECOND blocking admission sync inside their own update wrapper."""
+    from torchmetrics_tpu import MetricCollection
+
+    calls = {"n": 0}
+    real = txn_mod.admission_check_or_raise
+
+    def counting(metric, args, kwargs):
+        calls["n"] += 1
+        return real(metric, args, kwargs)
+
+    monkeypatch.setattr(txn_mod, "admission_check_or_raise", counting)
+    (p, t), (p2, t2) = _batches([16, 16], seed=29)
+    with quarantine_context("error"):
+        mc = MetricCollection({"a": _acc(), "b": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False)})
+        mc.update(p, t)  # discovery step: every metric updates individually
+        owners = len(mc._groups)
+        calls["n"] = 0
+        mc.update(p2, t2)
+        assert calls["n"] == owners  # exactly once per owner, not twice
+        # the single check still fails loud pre-mutation
+        counts = {name: int(m._update_count) for name, m in mc._modules.items()}
+        with pytest.raises(QuarantinedBatchError):
+            mc.update(_poison(p2), t2)
+        assert {name: int(m._update_count) for name, m in mc._modules.items()} == counts
+
+
+# ---------------------------------------------------------------- review regressions (2)
+
+
+def test_merge_state_folds_quarantine_counter():
+    """Map-reduce merge: the incoming side's quarantine counter and reported
+    watermark fold ADDITIVELY — already-surfaced batches stay surfaced, each
+    side's unreported delta stays pending exactly once (no loss, no re-count)."""
+    (clean,) = _batches([8])
+    with quarantine_context(True):
+        a = _acc()
+        b = _acc()
+        for m in (a, b):
+            m.update(*clean)
+            m.update(_poison(clean[0]), clean[1])
+        assert txn_mod.read_quarantine(a)["count"] == 1  # a's batch: surfaced
+        a.merge_state(b)
+        # a's already-reported 1 stays reported; only b's batch is pending
+        assert a._quarantine_reported == 1
+        assert txn_mod.read_quarantine(a)["count"] == 2
+
+        # raw-dict merge whose count was fully surfaced on its home shard:
+        # nothing may re-open as an unreported delta here
+        fresh = _acc()
+        fresh.update(*clean)
+        state = {attr: getattr(fresh, attr) for attr in fresh._defaults}
+        state["_quarantined_count"] = jnp.asarray(3, jnp.int32)
+        state["_quarantine_reported"] = 3
+        a.merge_state(state)
+        assert int(np.asarray(a.__dict__["_quarantined_count"])) == 5
+        assert a._quarantine_reported == 5  # pending delta is zero
+
+
+def test_invalid_quarantine_env_fails_loud(monkeypatch):
+    """A typo in TORCHMETRICS_TPU_QUARANTINE must not silently disable the
+    protection the knob was set to enable (same contract as SnapshotPolicy)."""
+    monkeypatch.setenv("TORCHMETRICS_TPU_QUARANTINE", "eror")
+    with pytest.raises(TorchMetricsUserError, match="eror"):
+        txn_mod.quarantine_mode()
+    for off in ("", "0", "off", "OFF "):
+        monkeypatch.setenv("TORCHMETRICS_TPU_QUARANTINE", off)
+        assert txn_mod.quarantine_mode() == txn_mod.MODE_OFF
+
+
+def test_failed_flush_does_not_advance_seq(tmp_path, monkeypatch):
+    """`seq` is the last COMPLETED sequence: a save that dies (disk full) must
+    leave it standing on the last sequence with a restorable shard."""
+    from torchmetrics_tpu.parallel import elastic as elastic_mod
+
+    m = _acc()
+    m.update(*_batches([8])[0])
+    snap = ContinuousSnapshotter(m, str(tmp_path), policy=SnapshotPolicy(every_updates=1000))
+    snap.flush()
+    assert snap.seq == 1
+
+    def _enospc(*args, **kwargs):
+        raise OSError(28, "No space left on device")
+
+    with monkeypatch.context() as mp:
+        mp.setattr(elastic_mod, "save_state_shard", _enospc)
+        with pytest.raises(OSError):
+            snap.flush()
+    assert snap.seq == 1  # failed sequence was never written
+    snap.flush()
+    assert snap.seq == 2
+    restored = _acc()
+    assert restore_latest(restored, str(tmp_path)) == 2
+    assert state_fingerprint(restored) == state_fingerprint(m)
+
+
+def test_signal_handler_rearmed_after_survivable_delivery(tmp_path):
+    """A KeyboardInterrupt the training loop catches and continues from must
+    leave the preemption flush armed for the NEXT signal — not silently revert
+    to losing everything since the last cadence snapshot."""
+    m = _acc()
+    m.update(*_batches([8])[0])
+    snap = ContinuousSnapshotter(m, str(tmp_path), policy=SnapshotPolicy(every_updates=1000))
+    snap.install_signal_handlers(signals=(signal.SIGINT,))
+    try:
+        for expected_flushes in (1, 2):
+            with pytest.raises(KeyboardInterrupt):
+                signal.raise_signal(signal.SIGINT)
+            assert snap.flushes == expected_flushes
+            assert signal.getsignal(signal.SIGINT) == snap._on_signal
+    finally:
+        snap.uninstall_signal_handlers()

@@ -193,19 +193,30 @@ class MetricCollection:
             measuring = rec is not None or _profile.active_profile() is not None
             t_step = _perf_counter() if measuring else 0.0
             owners = [(group.owner, self._modules[group.owner]) for group in self._groups.values()]
+            from torchmetrics_tpu.engine import txn as _txn
+
+            if _txn.quarantine_error():
+                # fail-loud admission for the fused path too: FusedUpdate
+                # bypasses the per-metric update wrapper, so the pre-mutation
+                # check must run here — before any owner's state can change
+                for name, metric in owners:
+                    _txn.admission_check_or_raise(metric, args, metric._filter_kwargs(**kwargs))
             handled = self._fused_step(owners, args, kwargs)
             for name, metric in owners:
                 if name not in handled:
+                    if _txn.quarantine_error():
+                        # the collection-level pre-check above already admitted
+                        # this batch — the per-metric wrapper must not pay a
+                        # second blocking device sync for the same inputs
+                        metric._admission_prechecked = True
                     metric.update(*args, **metric._filter_kwargs(**kwargs))
             if measuring:
                 step_us = round((_perf_counter() - t_step) * 1e6, 3)
                 _hist.observe(type(self).__name__, "collection", "dispatch_us", step_us)
                 if rec is not None:
-                    # dur_us: deprecated alias of dispatch_us, kept one release
                     rec.record(
                         "collection.step", type(self).__name__,
-                        dispatch_us=step_us, dur_us=step_us,
-                        owners=len(owners), fused=len(handled),
+                        dispatch_us=step_us, owners=len(owners), fused=len(handled),
                     )
             donated = bool(handled) or any(
                 m._engine is not None and m._engine.stats.donated_dispatches for _, m in owners

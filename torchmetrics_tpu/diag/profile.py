@@ -1,6 +1,6 @@
 """Runtime profiling layer — device-time attribution and sampled completion probes.
 
-Every ``dur_us`` the flight recorder measured before this PR was host
+Every ``dispatch_us`` the flight recorder measured before this PR was host
 wall-time around an **asynchronous** dispatch: it tells you what the launch
 cost, not where device time went. This module closes that gap three ways
 without breaking the zero-host-transfer invariant on unsampled steps:

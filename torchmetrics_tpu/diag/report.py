@@ -11,7 +11,7 @@ Three consumers, one data path:
 - :func:`export_chrome_trace` writes the events in the Chrome Trace Event
   format (``{"traceEvents": [...]}``), loadable in Perfetto
   (https://ui.perfetto.dev) — dispatch/step events with a measured
-  ``dispatch_us`` (``dur_us`` is the deprecated alias) become duration ("X")
+  ``dispatch_us`` become duration ("X")
   slices on a per-owner track; everything else becomes an instant ("i")
   marker. Durations are HOST-side spans (async launch + Python bookkeeping);
   device kernel time belongs to sampled ``device_us`` probes and to native
@@ -30,7 +30,7 @@ from torchmetrics_tpu.diag.trace import FlightRecorder, TraceEvent, active_recor
 
 __all__ = ["diag_report", "export_chrome_trace", "export_json"]
 
-# kinds whose events carry dur_us and render as duration slices
+# kinds whose events carry dispatch_us and render as duration slices
 _SPAN_KINDS = frozenset(
     {"update.dispatch", "fused.dispatch", "compute.dispatch", "collection.step", "sync.exchange"}
 )
@@ -51,8 +51,7 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
           "events": {kind: count},              # exact, drop-proof
           "dropped": int,                       # ring-buffer overflow count
           "per_metric": {owner: {"dispatches", "dispatch_us", "device_us",
-                                 "probes", "host_us" (deprecated alias of
-                                 dispatch_us), "traces", "retraces",
+                                 "probes", "traces", "retraces",
                                  "fallbacks"}},
           "retraces": [{"owner", "kind", "cause"}],   # every recorded retrace
           "host_transfers": int,                # transfer.host + transfer.blocked
@@ -65,8 +64,9 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         }
 
     Naming: ``dispatch_us`` is HOST wall-time around the **async** dispatch —
-    the launch cost, NOT device time (``host_us`` is its deprecated alias,
-    kept one release). True completion latency lives in ``device_us``,
+    the launch cost, NOT device time (the ``host_us``/``dur_us`` aliases from
+    the profiling PR completed their one-release retention and are gone).
+    True completion latency lives in ``device_us``,
     populated only by sampled profiling probes (``profile_context`` /
     ``TORCHMETRICS_TPU_PROFILE``).
 
@@ -98,7 +98,7 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         slot = per_metric[ev.owner or "<process>"]
         if ev.kind in _SPAN_KINDS:
             slot["dispatches"] += 1
-            slot["dispatch_us"] += float(ev.data.get("dispatch_us", ev.data.get("dur_us", 0.0)))
+            slot["dispatch_us"] += float(ev.data.get("dispatch_us", 0.0))
         elif ev.kind.endswith(".probe"):
             slot["probes"] += 1
             slot["device_us"] += float(ev.data.get("device_us", 0.0))
@@ -111,9 +111,6 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
             slot["fallbacks"] += 1
         elif ev.kind == "collective":
             collective_bytes += int(ev.data.get("bytes", 0))
-    for slot in per_metric.values():
-        slot["host_us"] = slot["dispatch_us"]  # deprecated alias, one release
-
     from torchmetrics_tpu.diag.costs import ledger_snapshot
     from torchmetrics_tpu.diag.hist import histograms_snapshot
     from torchmetrics_tpu.diag.profile import profile_snapshot
@@ -164,7 +161,7 @@ def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) ->
     """Write the events as a Perfetto-loadable chrome trace; returns the count.
 
     Layout: one process (pid 0, "torchmetrics_tpu"), one thread track per event
-    owner. Events with a measured ``dispatch_us`` (or legacy ``dur_us``)
+    owner. Events with a measured ``dispatch_us``
     become complete ("X") slices ending at their record timestamp; the rest
     are thread-scoped instants.
     Packed-sync ``collective`` events get a dedicated per-role track
@@ -184,7 +181,7 @@ def export_chrome_trace(path: str, recorder: Optional[FlightRecorder] = None) ->
             owner = ev.owner or "<process>"
         tid = tids.setdefault(owner, len(tids) + 1)
         ts_us = ev.ts * 1e6
-        dur = float(ev.data.get("dispatch_us", ev.data.get("dur_us", 0.0)))
+        dur = float(ev.data.get("dispatch_us", 0.0))
         entry: Dict[str, Any] = {
             "name": ev.kind,
             "pid": 0,

@@ -30,6 +30,10 @@ Bit layout (sticky — bits only ever set until :func:`reset_sentinels` or
                               range — the next epochs may wrap
 ``negative_count``      0x10  a sum/mean-reduced integer state went negative
                               (counts must not)
+``input_poisoned``      0x20  a batch failed the quarantine admission check and
+                              was skipped in-graph (``engine/txn.py``) — the
+                              INPUT was poisoned but the state stayed clean, as
+                              opposed to the sticky state-corruption bits above
 ======================  ====  ====================================================
 
 Enablement (first hit wins): :func:`sentinel_context` /
@@ -82,6 +86,7 @@ FLAG_POS_INF = 0x02
 FLAG_NEG_INF = 0x04
 FLAG_OVERFLOW = 0x08
 FLAG_NEGATIVE_COUNT = 0x10
+FLAG_INPUT_POISONED = 0x20
 
 SENTINEL_BITS = {
     "nan": FLAG_NAN,
@@ -89,6 +94,7 @@ SENTINEL_BITS = {
     "neg_inf": FLAG_NEG_INF,
     "overflow_suspect": FLAG_OVERFLOW,
     "negative_count": FLAG_NEGATIVE_COUNT,
+    "input_poisoned": FLAG_INPUT_POISONED,
 }
 
 _enabled_override: Optional[bool] = None

@@ -47,6 +47,8 @@ _COUNTER_HELP = {
     "bucketed_steps": "steps that rode a shape bucket",
     "bucket_pad_rows": "total pad rows added across bucketed steps",
     "bytes_moved": "input+state bytes entering compiled dispatches",
+    "quarantined_batches": "poisoned batches skipped in-graph by the quarantine transaction",
+    "ladder_retries": "dispatch failures that stepped down the fallback ladder to a smaller bucket",
     "packed_syncs": "packed epoch syncs completed",
     "sync_collectives": "buffer collectives issued across packed syncs",
     "sync_metadata_gathers": "metadata exchanges issued",

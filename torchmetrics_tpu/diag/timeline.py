@@ -193,7 +193,7 @@ def merge_timelines(
                 owner = ev["owner"] or "<process>"
             tid = tids.setdefault((rank, owner), len(tids) + 1)
             ts = round(ev["ts_us"] - offset, 3)
-            dur = float(ev["data"].get("dispatch_us", ev["data"].get("dur_us", 0.0)))
+            dur = float(ev["data"].get("dispatch_us", 0.0))
             flat.append((ts, rank, ev["seq"], tid, ev["kind"], dur, ev["data"]))
 
     for ts, rank, seq, tid, kind, dur, data in sorted(flat, key=lambda x: (x[0], x[1], x[2])):

@@ -56,8 +56,8 @@ Event taxonomy (the ``kind`` field; full glossary in
 =====================  ========================================================
 
 Timing fields: ``dispatch_us`` is HOST wall-time around an **asynchronous**
-dispatch — the launch cost, not device time (``dur_us`` is its deprecated
-alias, kept one release). True completion latency is ``device_us``, measured
+dispatch — the launch cost, not device time. True completion latency is
+``device_us``, measured
 only on sampled probe events (:mod:`torchmetrics_tpu.diag.profile`).
 
 Retrace causes (:func:`attribute_retrace`): ``bucket-miss``, ``dtype-change``,

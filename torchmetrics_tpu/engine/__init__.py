@@ -61,6 +61,12 @@ from torchmetrics_tpu.engine.config import (
 from torchmetrics_tpu.engine.epoch import CollectionEpoch, EpochEngine
 from torchmetrics_tpu.engine.fusion import FusedUpdate
 from torchmetrics_tpu.engine.stats import EngineStats, engine_report, reset_engine_stats
+from torchmetrics_tpu.engine.txn import (
+    QuarantinedBatchError,
+    quarantine_context,
+    quarantine_report,
+    set_quarantine_mode,
+)
 
 __all__ = [
     "CollectionEpoch",
@@ -68,9 +74,13 @@ __all__ = [
     "EngineStats",
     "EpochEngine",
     "FusedUpdate",
+    "QuarantinedBatchError",
     "engine_context",
     "engine_enabled",
     "engine_report",
+    "quarantine_context",
+    "quarantine_report",
     "reset_engine_stats",
     "set_engine_enabled",
+    "set_quarantine_mode",
 ]

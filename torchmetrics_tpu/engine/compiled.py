@@ -35,6 +35,7 @@ from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
 
 
@@ -232,7 +233,26 @@ def shield_state(state: Dict[str, Any], metric: Any, stats: EngineStats) -> Dict
     return out
 
 
-def make_step(run, bucketed: bool, inputs: Sequence[Any]):
+def state_invalidated(metric: Any) -> bool:
+    """Whether any live state leaf is a donation-consumed (deleted) jax array.
+
+    A first execution that fails AFTER its dispatch donated the state pytree
+    leaves the metric's attrs pointing at dead buffers — no fallback (ladder
+    chunks, eager re-run) can read them, so the callers fail loud instead.
+    """
+    for k in getattr(metric, "_defaults", {}):
+        v = getattr(metric, k, None)
+        is_deleted = getattr(v, "is_deleted", None)
+        if callable(is_deleted):
+            try:
+                if is_deleted():
+                    return True
+            except Exception:  # noqa: BLE001 — an unreadable buffer is a dead buffer
+                return True
+    return False
+
+
+def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None):
     """Compile ``run(state_pytree, flat_inputs) -> state_pytree`` into a jitted
     step with the state pytree donated (policy permitting).
 
@@ -241,6 +261,12 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any]):
     traced ``n_pad`` scalar and subtracts the pad rows' contribution in-graph
     (see ``engine/bucketing.py``); ``tree_map`` keeps it agnostic to whether the
     state pytree is one metric's dict or a fused dict-of-dicts.
+
+    ``txn`` is the optional quarantine transaction (``engine/txn.py``),
+    ``(old_state, result, flat) -> result``, applied LAST — after the
+    pad-subtract identity — so a poisoned batch selects back to the exact
+    pre-update values (padding already removed from the rejected candidate,
+    never from the preserved old state).
     """
     import jax
     import jax.numpy as jnp
@@ -259,19 +285,24 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any]):
             unit = run(zeros, unit_flat)
 
             def subtract(path, o, u):
-                # the sentinel bitmask is not row-additive: pad rows cannot
-                # raise health flags (they are zeros), so the mask passes
-                # through the pad-subtract identity untouched
-                if any(getattr(p, "key", None) == _sentinel.STATE_KEY for p in path):
+                # the sentinel bitmask and the quarantine counter are not
+                # row-additive: pad rows cannot raise health flags or poison
+                # a batch (they are zeros), so both riders pass through the
+                # pad-subtract identity untouched
+                if any(
+                    getattr(p, "key", None) in (_sentinel.STATE_KEY, _txn.STATE_KEY) for p in path
+                ):
                     return o
                 return o - u * n_pad.astype(o.dtype)
 
-            return jax.tree_util.tree_map_with_path(subtract, out, unit)
+            result = jax.tree_util.tree_map_with_path(subtract, out, unit)
+            return txn(state, result, flat) if txn is not None else result
 
     else:
 
         def step(state, *flat):
-            return run(state, flat)
+            result = run(state, flat)
+            return txn(state, result, flat) if txn is not None else result
 
     donate = config.donation_enabled()
     return jax.jit(step, donate_argnums=(0,) if donate else ()), donate
@@ -315,6 +346,7 @@ class CompiledUpdate:
         self._metric = metric
         self._cache: Dict[Tuple, Any] = {}
         self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}  # key -> signature fingerprint (retrace attribution)
+        self._transient_fails: Dict[Tuple, int] = {}  # key -> classified-failure count (ladder budget)
         self.stats = EngineStats(type(metric).__name__)
         self._bucket_ok: Optional[bool] = None
         defaults = metric._defaults
@@ -377,6 +409,10 @@ class CompiledUpdate:
         # the checks lower into the SAME executable as the update body
         if _sentinel.sentinel_enabled():
             state[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
+        # opt-in quarantine: the device counter joins the pytree so the
+        # admission prelude + transactional select lower into the same graph
+        if _txn.quarantine_enabled():
+            state[_txn.STATE_KEY] = _txn.ensure_count(m)
 
         state_sig = tuple((k, tuple(v.shape), v.dtype) for k, v in state.items())
         key = (bucketed, len(args), kw_names, state_sig, in_sig, self._device_token(state))
@@ -415,8 +451,28 @@ class CompiledUpdate:
         except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
             if not first:
                 raise  # a cached executable failing on matching shapes is a real bug
-            self._cache[key] = _FALLBACK
-            reason = str(exc) if isinstance(exc, _Ineligible) else f"trace-failed:{type(exc).__name__}"
+            if state_invalidated(m):
+                # the failure escaped AFTER donation consumed the live state
+                # buffers: there is nothing intact to retry the batch against —
+                # fail loud here rather than crash the ladder/eager rung on
+                # deleted arrays a few frames later
+                raise
+            # budget charged whether or not the ladder rescues the step below —
+            # a ladder success must not reset the recompile meter
+            classified = _txn.classify_and_demote(
+                self._cache, _FALLBACK, self._transient_fails, key, exc
+            )
+            if classified is not None and bucketed and bucket is not None:
+                # fallback ladder rung 2: a transient backend failure (OOM on a
+                # fresh bucket) retries the batch as next-smaller-bucket chunks
+                if self._ladder_step(args, kwargs, bucket, classified):
+                    return True
+            if isinstance(exc, _Ineligible):
+                reason = str(exc)
+            elif classified is not None:
+                reason = f"dispatch-{classified}"
+            else:
+                reason = f"trace-failed:{type(exc).__name__}"
             st.fallback(reason)
             return False
 
@@ -453,11 +509,9 @@ class CompiledUpdate:
         if profiling and not first:
             device_us = completion_probe(list(out.values()), st.owner, "update", st, t_dispatch)
         if rec is not None:
-            # dur_us is the deprecated alias of dispatch_us (async launch cost,
-            # NOT device time) — kept one release for chrome-trace consumers
             rec.record(
                 "update.dispatch", st.owner,
-                dispatch_us=dispatch_us, dur_us=dispatch_us,
+                dispatch_us=dispatch_us,
                 donated=donate, bucketed=bucketed, pad_rows=n_pad, bytes=bytes_moved, cached=not first,
             )
             if device_us is not None:
@@ -466,8 +520,87 @@ class CompiledUpdate:
         sentinel_out = out.pop(_sentinel.STATE_KEY, None)
         if sentinel_out is not None:
             setattr(m, _sentinel.ATTR, sentinel_out)
+        quarantine_out = out.pop(_txn.STATE_KEY, None)
+        if quarantine_out is not None:
+            setattr(m, _txn.ATTR, quarantine_out)
         for k, v in out.items():
             setattr(m, k, v)
+        return True
+
+    # ------------------------------------------------------------------ ladder
+
+    def _ladder_step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], bucket: int, classified: str) -> bool:
+        """Fallback-ladder rung 2: retry the batch as half-bucket chunks.
+
+        A dispatch-time resource failure at bucket ``b`` re-enters the SAME
+        compiled machinery with the batch split at ``b/2`` — exact for the
+        row-additive metrics bucketing admits (chunked accumulation commutes
+        with the sum-reduced states). The first chunk's compile failing leaves
+        state untouched (returns False → the caller's eager rung takes the
+        whole batch); a residual chunk failing after the first applied runs
+        eagerly HERE with quarantine parity, because the caller's eager path
+        would re-apply rows the compiled chunks already accumulated.
+
+        Under quarantine the FULL batch is admitted once before chunking:
+        per-chunk admission would change the granularity of the contract (half
+        a poisoned batch applied, the counter counting chunks) — this path is
+        already exceptional, so one sanctioned read is the honest price.
+        """
+        half = bucket // 2
+        if half < config.MIN_BUCKET:
+            return False
+        kw_names = tuple(sorted(kwargs))
+        flat = list(args) + [kwargs[k] for k in kw_names]
+        n = bucketing.batch_size(flat)
+        if n is None or n <= half:
+            return False
+        st = self.stats
+        m = self._metric
+        if _txn.quarantine_enabled():
+            import jax.numpy as jnp
+
+            from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+            poisoned = _txn.build_admission(m, flat)(flat)
+            with transfer_allowed("quarantine-check"):
+                bad = bool(np.asarray(poisoned))
+            if bad:
+                setattr(m, _txn.ATTR, _txn.ensure_count(m) + jnp.int32(1))
+                if _sentinel.sentinel_enabled():
+                    setattr(
+                        m, _sentinel.ATTR,
+                        _sentinel.ensure_flags(m) | jnp.int32(_sentinel.FLAG_INPUT_POISONED),
+                    )
+                _diag.record(
+                    "update.ladder", st.owner,
+                    from_bucket=bucket, to_bucket=half, error=classified, rows=n, quarantined=True,
+                )
+                return True
+
+        # the event narrates the ATTEMPTED walk (failed rungs included); the
+        # counter below only counts a step-down that actually applied
+        _diag.record(
+            "update.ladder", st.owner,
+            from_bucket=bucket, to_bucket=half, error=classified, rows=n,
+        )
+
+        def chunk(lo: int, hi: int) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
+            sliced = [
+                a[lo:hi] if getattr(a, "ndim", 0) >= 1 and a.shape[0] == n else a for a in flat
+            ]
+            return tuple(sliced[: len(args)]), dict(zip(kw_names, sliced[len(args):]))
+
+        head_args, head_kwargs = chunk(0, half)
+        if not self.step(head_args, head_kwargs):
+            return False  # nothing applied — the whole batch goes eager upstream
+        # counted only once something actually stepped down (the head chunk is
+        # in): a failed ladder attempt must not claim a retry in the gates
+        st.ladder_retries += 1
+        rest_args, rest_kwargs = chunk(half, n)
+        if not self.step(rest_args, rest_kwargs):
+            # the head chunk is already folded in: the residue must run here
+            _txn.eager_apply(self._metric, rest_args, rest_kwargs)
+            st.fallback("ladder-eager-chunk")
         return True
 
     # ------------------------------------------------------------------ build
@@ -486,10 +619,12 @@ class CompiledUpdate:
 
         m = self._metric
         owner = self.stats.owner
+        quarantined = _txn.quarantine_enabled()
 
         def run(state, flat):
             state = dict(state)
             sentinel = state.pop(_sentinel.STATE_KEY, None)
+            qcount = state.pop(_txn.STATE_KEY, None)
             call_args = tuple(flat[:n_args])
             call_kwargs = dict(zip(kw_names, flat[n_args:]))
             # named_scope is trace-time only: the HLO ops of this update body
@@ -497,10 +632,25 @@ class CompiledUpdate:
             with jax.named_scope(f"{owner}:update"):
                 out = traced_update(m, state, call_args, call_kwargs)
             if sentinel is not None:
-                out[_sentinel.STATE_KEY] = _sentinel.update_flags(sentinel, out, m)
+                # with the quarantine transaction active the health checks fold
+                # over the SELECTED (post-transaction) states instead — a
+                # quarantined NaN input must not raise the nan bit on a state
+                # that stayed clean
+                out[_sentinel.STATE_KEY] = (
+                    sentinel if quarantined else _sentinel.update_flags(sentinel, out, m)
+                )
+            if qcount is not None:
+                out[_txn.STATE_KEY] = qcount
             return out
 
-        fn, donate = make_step(run, bucketed, inputs)
+        step_txn = None
+        if quarantined:
+            admission = _txn.build_admission(m, inputs)
+
+            def step_txn(old_state, result, flat):
+                return _txn.transact(m, old_state, result, admission(flat))
+
+        fn, donate = make_step(run, bucketed, inputs, txn=step_txn)
         # ahead-of-time compile: same single trace+compile as the lazy first
         # dispatch, but the Compiled handle feeds the diag cost/memory ledger
         example = (example_state, np.int32(n_pad), *inputs) if bucketed else (example_state, *inputs)

@@ -54,6 +54,7 @@ from torchmetrics_tpu.engine.compiled import (
     completion_probe,
     holds_nested_metrics,
 )
+from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
 from torchmetrics_tpu.parallel import resilience as _resilience
 from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError, all_gather_backbone
@@ -320,7 +321,7 @@ def _exchange_once(
     if rec is not None:
         rec.record(
             "sync.exchange", stats.owner,
-            dispatch_us=sync_us, dur_us=sync_us,
+            dispatch_us=sync_us,
             world=plan.world_size, buffers=len(local), metadata=had_meta, bytes=bytes_moved,
         )
     return gathered
@@ -403,6 +404,7 @@ class EpochEngine:
         self._fold_fps: List[Dict[str, Any]] = []
         self._fused_fps: List[Dict[str, Any]] = []
         self._compute_fps: List[Dict[str, Any]] = []
+        self._transient_fails: Dict[Tuple, int] = {}  # key -> classified-failure count (ladder budget)
         self._compute_ok = not holds_nested_metrics(metric) and "_raw_compute" in metric.__dict__
 
     # ------------------------------------------------------------------ sync
@@ -486,8 +488,15 @@ class EpochEngine:
         except Exception as exc:  # noqa: BLE001 — untraceable compute: sync still packed
             if not first:
                 raise
-            self._fused_cache[sig] = _FALLBACK
-            reason = str(exc) if isinstance(exc, _Ineligible) else f"fused-trace-failed:{type(exc).__name__}"
+            classified = _txn.classify_and_demote(
+                self._fused_cache, _FALLBACK, self._transient_fails, sig, exc
+            )
+            if isinstance(exc, _Ineligible):
+                reason = str(exc)
+            elif classified is not None:
+                reason = f"fused-dispatch-{classified}"
+            else:
+                reason = f"fused-trace-failed:{type(exc).__name__}"
             self.stats.fallback(reason)
             return self._fold_then_no_value(plan, gathered)
         if first:
@@ -520,7 +529,7 @@ class EpochEngine:
         if rec is not None:
             rec.record(
                 "compute.dispatch", self.stats.owner,
-                dispatch_us=dispatch_us, dur_us=dispatch_us, fused=True, cached=not first,
+                dispatch_us=dispatch_us, fused=True, cached=not first,
             )
             if device_us is not None:
                 rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)
@@ -605,8 +614,15 @@ class EpochEngine:
         except Exception as exc:  # noqa: BLE001 — any trace failure demotes to eager
             if not first:
                 raise
-            self._compute_cache[key] = _FALLBACK
-            reason = str(exc) if isinstance(exc, _Ineligible) else f"compute-trace-failed:{type(exc).__name__}"
+            classified = _txn.classify_and_demote(
+                self._compute_cache, _FALLBACK, self._transient_fails, key, exc
+            )
+            if isinstance(exc, _Ineligible):
+                reason = str(exc)
+            elif classified is not None:
+                reason = f"compute-dispatch-{classified}"
+            else:
+                reason = f"compute-trace-failed:{type(exc).__name__}"
             self.stats.fallback(reason)
             return False, None
         if has_sentinel:
@@ -640,7 +656,7 @@ class EpochEngine:
         if rec is not None:
             rec.record(
                 "compute.dispatch", self.stats.owner,
-                dispatch_us=dispatch_us, dur_us=dispatch_us, fused=False, cached=not first,
+                dispatch_us=dispatch_us, fused=False, cached=not first,
             )
             if device_us is not None:
                 rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)

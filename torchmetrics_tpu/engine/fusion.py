@@ -31,6 +31,7 @@ from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
     _is_jax_array,
@@ -52,6 +53,7 @@ class FusedUpdate:
         self.metrics: List[Tuple[str, Any]] = list(metrics)
         self._cache: Dict[Tuple, Any] = {}
         self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}  # key -> fingerprint (retrace attribution)
+        self._transient_fails: Dict[Tuple, int] = {}  # key -> classified-failure count (ladder budget)
         # structural eligibility is frozen per member on first sight, exactly as
         # CompiledUpdate freezes `_disabled_reason` at engine construction —
         # re-walking every member's __dict__ for nested metrics on EVERY step
@@ -116,6 +118,8 @@ class FusedUpdate:
             if all(_is_jax_array(v) for v in mstate.values()):
                 if _sentinel.sentinel_enabled():
                     mstate[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
+                if _txn.quarantine_enabled():
+                    mstate[_txn.STATE_KEY] = _txn.ensure_count(m)
                 members.append((name, m))
                 states[name] = mstate
         if len(members) < 2:
@@ -154,8 +158,15 @@ class FusedUpdate:
             try:
                 entry = self._compile(members, states, bucketed, inputs, key)
             except Exception as exc:  # noqa: BLE001 — a compile-time failure demotes the key
-                self._cache[key] = _FALLBACK
-                st.fallback(f"trace-failed:{type(exc).__name__}")
+                # transient resource failures do NOT poison the signature — the
+                # members fall back for THIS step (their per-metric engines may
+                # ladder down) and the fused path retries later
+                classified = _txn.classify_and_demote(
+                    self._cache, _FALLBACK, self._transient_fails, key, exc
+                )
+                st.fallback(
+                    f"dispatch-{classified}" if classified else f"trace-failed:{type(exc).__name__}"
+                )
                 return None
             if entry is None:  # fewer than 2 members survived the trace probes
                 self._cache[key] = _FALLBACK
@@ -185,8 +196,12 @@ class FusedUpdate:
         except Exception as exc:  # noqa: BLE001 — a compile-time failure demotes the key
             if not first:
                 raise
-            self._cache[key] = _FALLBACK
-            st.fallback(f"trace-failed:{type(exc).__name__}")
+            classified = _txn.classify_and_demote(
+                self._cache, _FALLBACK, self._transient_fails, key, exc
+            )
+            st.fallback(
+                f"dispatch-{classified}" if classified else f"trace-failed:{type(exc).__name__}"
+            )
             return None
 
         if first:
@@ -224,7 +239,7 @@ class FusedUpdate:
         if rec is not None:
             rec.record(
                 "fused.dispatch", st.owner,
-                dispatch_us=dispatch_us, dur_us=dispatch_us,
+                dispatch_us=dispatch_us,
                 donated=donate, bucketed=bucketed, pad_rows=n_pad, bytes=bytes_moved,
                 members=len(fused), cached=not first,
             )
@@ -236,6 +251,9 @@ class FusedUpdate:
             sentinel_out = out[name].pop(_sentinel.STATE_KEY, None)
             if sentinel_out is not None:
                 setattr(m, _sentinel.ATTR, sentinel_out)
+            quarantine_out = out[name].pop(_txn.STATE_KEY, None)
+            if quarantine_out is not None:
+                setattr(m, _txn.ATTR, quarantine_out)
             for k, v in out[name].items():
                 setattr(m, k, v)
             # the wrapped-update bookkeeping the eager path would have done
@@ -272,21 +290,41 @@ class FusedUpdate:
         if len(fusable) < 2:
             return None
 
+        quarantined = _txn.quarantine_enabled()
+
         def run_all(fused_states, flat):
             out = {}
             for name, m in fusable:
                 mstate = dict(fused_states[name])
                 sentinel = mstate.pop(_sentinel.STATE_KEY, None)
+                qcount = mstate.pop(_txn.STATE_KEY, None)
                 # per-member named_scope: inside the ONE fused executable each
                 # member's ops still attribute to their own metric in profiles
                 with jax.named_scope(f"{name}:update"):
                     updated = traced_update(m, mstate, tuple(flat), {})
                 if sentinel is not None:
-                    updated[_sentinel.STATE_KEY] = _sentinel.update_flags(sentinel, updated, m)
+                    # under quarantine the health checks fold over the
+                    # per-member SELECTED states inside the transaction instead
+                    updated[_sentinel.STATE_KEY] = (
+                        sentinel if quarantined else _sentinel.update_flags(sentinel, updated, m)
+                    )
+                if qcount is not None:
+                    updated[_txn.STATE_KEY] = qcount
                 out[name] = updated
             return out
 
-        fn, donate = make_step(run_all, bucketed, inputs)
+        step_txn = None
+        if quarantined:
+            # one admission plan per member: bounds (num_classes) are per-metric
+            admissions = {name: _txn.build_admission(m, inputs) for name, m in fusable}
+
+            def step_txn(old_states, result, flat):
+                return {
+                    name: _txn.transact(m, old_states[name], result[name], admissions[name](flat))
+                    for name, m in fusable
+                }
+
+        fn, donate = make_step(run_all, bucketed, inputs, txn=step_txn)
         # AOT compile for the diag cost ledger (same single trace+compile)
         example_states = {name: states[name] for name, _ in fusable}
         example = (example_states, np.int32(0), *inputs) if bucketed else (example_states, *inputs)

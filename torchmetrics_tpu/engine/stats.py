@@ -30,6 +30,9 @@ _COUNTER_FIELDS = (
     "bucketed_steps",  # steps that rode a shape bucket
     "bucket_pad_rows",  # total pad rows added across bucketed steps
     "bytes_moved",  # input + state bytes entering compiled dispatches
+    # --- transactional layer (engine/txn.py): quarantine + fallback ladder ---
+    "quarantined_batches",  # poisoned batches skipped in-graph (filled at the sanctioned read)
+    "ladder_retries",  # dispatch failures that stepped down to a smaller bucket
     # --- epoch engine (engine/epoch.py): packed sync + cached compute ---
     "packed_syncs",  # packed epoch syncs completed (vs eager per-tensor syncs)
     "sync_collectives",  # buffer collectives issued across all packed syncs
@@ -147,8 +150,8 @@ def reset_engine_counters() -> None:
 
 def reset_engine_stats() -> None:
     """Zero every live engine's counters, the diag ring buffer, the cost
-    ledger, the sentinel registry, the latency histograms, AND the profiler's
-    probe accounting.
+    ledger, the sentinel registry, the quarantine registry, the latency
+    histograms, AND the profiler's probe accounting.
 
     The shared reset keeps every evidence surface (counters, flight recorder,
     per-executable costs, health sentinels, latency distributions, probe
@@ -160,12 +163,14 @@ def reset_engine_stats() -> None:
     from torchmetrics_tpu.diag.hist import reset_histograms
     from torchmetrics_tpu.diag.profile import reset_profile
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
+    from torchmetrics_tpu.engine.txn import reset_quarantine
     from torchmetrics_tpu.parallel.resilience import reset_resilience
 
     reset_engine_counters()
     _diag.clear_recorder()
     reset_ledger()
     reset_sentinels()
+    reset_quarantine()
     reset_histograms()
     reset_profile()
     reset_resilience()

@@ -1,0 +1,544 @@
+"""Transactional state integrity — in-graph batch admission, quarantine, and
+the dispatch-failure fallback ladder.
+
+PR 4's sentinels detect a NaN **after** it has already destroyed a donated
+accumulator; this module prevents the destruction. Three pieces:
+
+- **Admission prelude** (:func:`build_admission`): a jittable per-batch check
+  compiled INTO the update executable — finite-check on float/complex inputs,
+  ``[0, num_classes)`` range bounds on integer label inputs of metrics that
+  declare ``num_classes`` — producing one traced boolean *poison flag* per
+  batch. No host transfer: the flag is data inside the graph, never read in
+  the hot loop.
+- **Transaction** (:func:`transact`): the state write becomes
+  ``jnp.where(poisoned, old, new)`` inside the SAME donated graph, so a
+  poisoned batch is **quarantined** — the accumulator keeps its pre-batch
+  values bit-exactly — instead of corrupting state. A per-metric device
+  counter (``metric._quarantined_count``, pytree key ``__quarantine__``)
+  increments in-graph; it reaches the host only at the sanctioned
+  :func:`read_quarantine` boundary (epoch end), where the delta lands in
+  ``EngineStats.quarantined_batches`` and an ``update.quarantine`` event.
+  With the sentinel enabled, a quarantined batch raises the dedicated
+  ``input_poisoned`` bit (``diag/sentinel.py``) while the ``nan``/``inf``
+  bits stay clear — "input was poisoned, state is clean" is distinguishable
+  from sticky state corruption at every surface.
+- **Fallback ladder** (:func:`classify_dispatch_error` + the engines): a
+  dispatch-time ``XlaRuntimeError`` / ``RESOURCE_EXHAUSTED`` on a fresh
+  bucket no longer aborts the step OR permanently poisons the signature
+  cache — the per-metric engine retries the next-smaller bucket (the batch
+  splits into half-bucket chunks, exact for the row-additive metrics
+  bucketing admits), then falls back to eager for this step only. Counted
+  (``EngineStats.ladder_retries``, ``update.ladder`` events), typed
+  (classified reason strings), never a crashed step.
+
+Modes (``TORCHMETRICS_TPU_QUARANTINE`` / :func:`quarantine_context`, first
+hit wins — override, then env):
+
+==========  ==============================================================
+``0``/unset  off — zero machinery on every path (the default)
+``1``        quarantine — poisoned batches are skipped in-graph, counted
+``error``    fail loud — the admission check runs on the HOST before any
+             state mutation and raises :class:`QuarantinedBatchError`
+             (one sanctioned device sync per step, by explicit request)
+==========  ==============================================================
+
+Enable the same mode on EVERY rank of a world: the quarantine counter rides
+the packed sync's reduce buffer (``parallel/packing.py`` sums it cross-rank,
+exactly like ``_update_count`` folds at checkpoint restore), so asymmetric
+enablement would desynchronize the buffer layout — the same rule the
+sentinel and the audit already document.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = [
+    "ATTR",
+    "MODE_ERROR",
+    "MODE_OFF",
+    "MODE_QUARANTINE",
+    "QUARANTINE_ENV_VAR",
+    "QuarantinedBatchError",
+    "STATE_KEY",
+    "admission_check_or_raise",
+    "build_admission",
+    "classify_and_demote",
+    "classify_dispatch_error",
+    "eager_apply",
+    "eager_update",
+    "ensure_count",
+    "quarantine_context",
+    "quarantine_enabled",
+    "quarantine_error",
+    "quarantine_mode",
+    "quarantine_report",
+    "read_quarantine",
+    "reset_quarantine",
+    "set_quarantine_mode",
+    "transact",
+]
+
+QUARANTINE_ENV_VAR = "TORCHMETRICS_TPU_QUARANTINE"
+
+#: reserved pytree key for the quarantine counter inside compiled step states
+STATE_KEY = "__quarantine__"
+#: the attribute carrying the live device counter on a metric instance
+ATTR = "_quarantined_count"
+
+MODE_OFF = "0"
+MODE_QUARANTINE = "1"
+MODE_ERROR = "error"
+
+_mode_override: Optional[str] = None
+
+# metrics currently carrying a quarantine counter, for process-wide reporting.
+# WeakValueDictionary keyed by id(): Metric.__hash__ covers current state-array
+# ids, so a hash-based WeakSet would leak one entry per update (the sentinel
+# registry documents the same trap).
+_REGISTRY: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+class QuarantinedBatchError(TorchMetricsUserError):
+    """``TORCHMETRICS_TPU_QUARANTINE=error``: a batch failed admission.
+
+    Raised BEFORE any state mutation — the metric's accumulator and
+    ``update_count`` are untouched, on the compiled and the eager path alike.
+    """
+
+
+# ------------------------------------------------------------------ policy
+
+
+def quarantine_mode() -> str:
+    """The active mode: :data:`MODE_OFF` / :data:`MODE_QUARANTINE` / :data:`MODE_ERROR`.
+
+    An unrecognized env value fails loud: a typo must not silently disable the
+    protection the knob was set to enable (same contract as
+    ``SnapshotPolicy.from_env``).
+    """
+    if _mode_override is not None:
+        return _mode_override
+    raw = os.environ.get(QUARANTINE_ENV_VAR, "").strip().lower()
+    if raw in ("", "0", "off"):
+        return MODE_OFF
+    if raw in ("1", "on", "quarantine"):
+        return MODE_QUARANTINE
+    if raw == "error":
+        return MODE_ERROR
+    raise TorchMetricsUserError(
+        f"{QUARANTINE_ENV_VAR}={raw!r} is not a recognized quarantine mode "
+        "(expected unset/'0'/'off', '1'/'on'/'quarantine', or 'error')"
+    )
+
+
+def quarantine_enabled() -> bool:
+    """Whether compiled/eager updates apply the in-graph quarantine transaction."""
+    return quarantine_mode() == MODE_QUARANTINE
+
+
+def quarantine_error() -> bool:
+    """Whether admission failures raise (fail-loud mode) instead of quarantining."""
+    return quarantine_mode() == MODE_ERROR
+
+
+def set_quarantine_mode(value: Optional[Any]) -> None:
+    """Force the mode process-wide; ``None`` restores env resolution.
+
+    Accepts ``True``/``"1"`` (quarantine), ``False``/``"0"`` (off), ``"error"``.
+    """
+    global _mode_override
+    _mode_override = _coerce_mode(value)
+
+
+def _coerce_mode(value: Optional[Any]) -> Optional[str]:
+    if value is None:
+        return None
+    if value is True:
+        return MODE_QUARANTINE
+    if value is False:
+        return MODE_OFF
+    mode = str(value).strip().lower()
+    if mode in (MODE_OFF, MODE_QUARANTINE, MODE_ERROR):
+        return mode
+    raise ValueError(f"quarantine mode must be one of '0', '1', 'error' (got {value!r})")
+
+
+@contextmanager
+def quarantine_context(mode: Any = True) -> Generator[None, None, None]:
+    """Scoped quarantine mode (tests, benches). Toggling mid-stream retraces
+    the affected signatures once (the counter rider is a ``treedef-change``)."""
+    global _mode_override
+    prev = _mode_override
+    _mode_override = _coerce_mode(mode)
+    try:
+        yield
+    finally:
+        _mode_override = prev
+
+
+# ------------------------------------------------------------------ admission
+
+
+def _input_bounds(metric: Any) -> Optional[int]:
+    """Integer label bound for range checks, when the metric declares one."""
+    bound = getattr(metric, "num_classes", None)
+    if isinstance(bound, bool) or not isinstance(bound, (int, np.integer)):
+        return None
+    return int(bound) if int(bound) > 0 else None
+
+
+def build_admission(metric: Any, inputs: Sequence[Any]) -> Callable[[Sequence[Any]], Any]:
+    """Jittable per-batch admission check, planned once per compile signature.
+
+    The plan is static (which input positions get which check, from the
+    example dtypes); the returned callable lowers into the caller's graph:
+    float/complex inputs contribute ``~isfinite(x).all()``, integer inputs of
+    a ``num_classes``-declaring metric contribute ``(x < 0) | (x >= bound)``.
+    Zero pad rows (``engine/bucketing.py``) are finite and in-range by
+    construction, so padding can never read as poison. Always returns a
+    callable — with nothing checkable the flag is a constant False that XLA
+    folds away.
+    """
+    checks: List[Tuple[int, str, Optional[int]]] = []
+    bound = _input_bounds(metric)
+    for i, a in enumerate(inputs):
+        dtype = getattr(a, "dtype", None)
+        if dtype is None:
+            continue
+        kind = np.dtype(dtype).kind
+        if kind in "fc":
+            checks.append((i, "finite", None))
+        elif kind in "iu" and bound is not None:
+            checks.append((i, "range", bound))
+
+    def admission(flat: Sequence[Any]) -> Any:
+        import jax.numpy as jnp
+
+        poisoned = jnp.asarray(False)
+        for i, check, b in checks:
+            x = flat[i]
+            if check == "finite":
+                poisoned = poisoned | ~jnp.isfinite(x).all()
+            else:
+                poisoned = poisoned | (x < 0).any() | (x >= b).any()
+        return poisoned
+
+    return admission
+
+
+def transact(metric: Any, old: Dict[str, Any], new: Dict[str, Any], poisoned: Any) -> Dict[str, Any]:
+    """The in-graph state transaction (jittable, runs inside the compiled step).
+
+    Every non-rider state leaf is selected against its pre-update value via
+    ``jnp.where(poisoned, old, new)``; the ``__quarantine__`` counter
+    increments by the flag; with the sentinel rider present, its health checks
+    fold over the SELECTED (final) states — a quarantined batch therefore
+    raises only the ``input_poisoned`` bit while ``nan``/``inf`` stay clear,
+    because the state genuinely stays clean.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.diag import sentinel as _sentinel
+
+    out: Dict[str, Any] = {}
+    selected: Dict[str, Any] = {}
+    for k, v in new.items():
+        if k in (STATE_KEY, _sentinel.STATE_KEY):
+            continue
+        sel = jnp.where(poisoned, old[k], v)
+        out[k] = sel
+        selected[k] = sel
+    if STATE_KEY in new:
+        out[STATE_KEY] = old[STATE_KEY] + poisoned.astype(jnp.int32)
+    if _sentinel.STATE_KEY in new:
+        flags = _sentinel.update_flags(new[_sentinel.STATE_KEY], selected, metric)
+        out[_sentinel.STATE_KEY] = flags | jnp.where(
+            poisoned, jnp.int32(_sentinel.FLAG_INPUT_POISONED), jnp.int32(0)
+        )
+    return out
+
+
+# ------------------------------------------------------------------ eager parity
+
+
+def _flat_inputs(args: Sequence[Any], kwargs: Dict[str, Any]) -> List[Any]:
+    return list(args) + [kwargs[k] for k in sorted(kwargs)]
+
+
+def admission_check_or_raise(metric: Any, args: Sequence[Any], kwargs: Dict[str, Any]) -> None:
+    """``=error`` mode: host-side admission check BEFORE any state mutation.
+
+    Fail-loud mode trades one sanctioned device sync per step for an
+    immediate, typed :class:`QuarantinedBatchError` — the explicit opposite
+    of the zero-transfer quarantine path, applied identically on the
+    compiled, fused, and eager routes (the check runs before dispatch).
+    """
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    inputs = _flat_inputs(args, kwargs)
+    poisoned = build_admission(metric, inputs)(inputs)
+    with transfer_allowed("quarantine-check"):
+        bad = bool(np.asarray(poisoned))
+    if bad:
+        _diag.record("update.quarantine", type(metric).__name__, mode="error")
+        raise QuarantinedBatchError(
+            f"batch failed admission for {type(metric).__name__}: a float input is"
+            " non-finite or an integer label is out of [0, num_classes)."
+            " TORCHMETRICS_TPU_QUARANTINE=error raises instead of quarantining;"
+            " use mode '1' to skip poisoned batches in-graph instead."
+        )
+
+
+def eager_update(metric: Any, run_update: Callable[[], None], args: Sequence[Any], kwargs: Dict[str, Any]) -> None:
+    """Quarantine-guarded eager update — the engine-off parity path.
+
+    Fixed-shape array states get the same zero-transfer treatment as the
+    compiled path (in-graph ``where`` select + counter increment). A state
+    whose shape/dtype/structure changed under the update (list appends, the
+    x64 first-step promotion) cannot be selected in-graph — the flag is read
+    at the sanctioned ``quarantine-check`` boundary and the pre-update refs
+    are restored wholesale on poison.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.diag import sentinel as _sentinel
+
+    inputs = _flat_inputs(args, kwargs)
+    admission = build_admission(metric, inputs)
+    old: Dict[str, Any] = {}
+    for k in metric._defaults:
+        v = getattr(metric, k)
+        old[k] = list(v) if isinstance(v, list) else v
+    poisoned = admission(inputs)
+    run_update()
+
+    selectable = True
+    for k, o in old.items():
+        new = getattr(metric, k)
+        if isinstance(o, list) or isinstance(new, list):
+            selectable = False
+            break
+        if (
+            getattr(new, "shape", None) is None
+            or getattr(o, "shape", None) is None
+            or tuple(new.shape) != tuple(o.shape)
+            or new.dtype != o.dtype
+        ):
+            selectable = False
+            break
+
+    count = ensure_count(metric)
+    if selectable:
+        for k, o in old.items():
+            setattr(metric, k, jnp.where(poisoned, o, getattr(metric, k)))
+        setattr(metric, ATTR, count + poisoned.astype(jnp.int32))
+        if _sentinel.sentinel_enabled():
+            flags = _sentinel.ensure_flags(metric)
+            setattr(
+                metric, _sentinel.ATTR,
+                flags | jnp.where(poisoned, jnp.int32(_sentinel.FLAG_INPUT_POISONED), jnp.int32(0)),
+            )
+        return
+
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    with transfer_allowed("quarantine-check"):
+        bad = bool(np.asarray(poisoned))
+    if bad:
+        for k, o in old.items():
+            setattr(metric, k, o)
+        setattr(metric, ATTR, count + jnp.int32(1))
+        if _sentinel.sentinel_enabled():
+            flags = _sentinel.ensure_flags(metric)
+            setattr(metric, _sentinel.ATTR, flags | jnp.int32(_sentinel.FLAG_INPUT_POISONED))
+        _diag.record("update.quarantine", type(metric).__name__, count=1, path="eager")
+
+
+def eager_apply(metric: Any, args: Sequence[Any], kwargs: Dict[str, Any]) -> None:
+    """Run a raw update with quarantine parity — the ladder's eager rung.
+
+    The fallback ladder applies this to a residual chunk the compiled path
+    could not take, so an OOM-demoted chunk still honors the admission
+    contract instead of sneaking poison past it.
+    """
+    if quarantine_enabled():
+        eager_update(metric, lambda: metric._raw_update(*args, **kwargs), args, kwargs)
+    else:
+        metric._raw_update(*args, **kwargs)
+
+
+# ------------------------------------------------------------------ fallback ladder
+
+
+#: consecutive classified compile failures of ONE signature before it is
+#: demoted like a structural failure — a PERSISTENT resource failure must not
+#: pay a full XLA compile attempt on every step forever
+TRANSIENT_RETRY_BUDGET = 3
+
+
+def transient_budget_exhausted(counts: Dict[Any, int], key: Any) -> bool:
+    """Count one classified failure for ``key``; True once the budget is spent.
+
+    The engines keep ``counts`` per cache: transient failures under the budget
+    leave the signature retryable (the next step may find memory freed), the
+    budget-exhausting one demotes it permanently — bounded recompile cost,
+    bounded event spam.
+    """
+    n = counts.get(key, 0) + 1
+    counts[key] = n
+    return n >= TRANSIENT_RETRY_BUDGET
+
+
+def classify_and_demote(
+    cache: Dict[Any, Any], fallback: Any, counts: Dict[Any, int], key: Any, exc: BaseException
+) -> Optional[str]:
+    """The single first-dispatch-failure policy shared by every engine cache.
+
+    Structural trace failures (:func:`classify_dispatch_error` -> None) demote
+    ``key`` to ``fallback`` permanently; classified transient failures leave it
+    retryable until :data:`TRANSIENT_RETRY_BUDGET` of them demote it anyway,
+    suffixing the classification with ``-budget``. Returns the (possibly
+    suffixed) classification, or None for a structural failure.
+    """
+    classified = classify_dispatch_error(exc)
+    if classified is None:
+        cache[key] = fallback
+    elif transient_budget_exhausted(counts, key):
+        cache[key] = fallback
+        classified = f"{classified}-budget"
+    return classified
+
+
+def classify_dispatch_error(exc: BaseException) -> Optional[str]:
+    """Classify a compile/dispatch failure as transient-resource vs structural.
+
+    Returns ``"resource-exhausted"`` (OOM-family), ``"xla-runtime"`` (other
+    backend runtime failures), or ``None`` for structural trace failures
+    (untraceable update bodies) — only the latter permanently demote a
+    signature to eager; classified failures step down the ladder and may
+    retry on a later step.
+    """
+    name = type(exc).__name__
+    text = f"{name}: {exc}".lower()
+    if "resource_exhausted" in text or "resource exhausted" in text or "out of memory" in text or name == "MemoryError":
+        return "resource-exhausted"
+    if name == "XlaRuntimeError":
+        return "xla-runtime"
+    return None
+
+
+# ------------------------------------------------------------------ counter surfacing
+
+
+def ensure_count(metric: Any) -> Any:
+    """The metric's device quarantine counter, created (zero) on first use."""
+    val = getattr(metric, ATTR, None)
+    if val is None:
+        import jax.numpy as jnp
+
+        val = jnp.zeros((), jnp.int32)
+        setattr(metric, ATTR, val)
+        metric._quarantine_reported = 0
+    _REGISTRY[id(metric)] = metric
+    return val
+
+
+def _stats_for(metric: Any):
+    """The EngineStats block quarantine deltas attribute to."""
+    eng = getattr(metric, "_engine", None)
+    if eng is not None:
+        return eng.stats
+    epoch = getattr(metric, "_epoch", None)
+    if epoch is not None:
+        return epoch.stats
+    st = metric.__dict__.get("_txn_stats")
+    if st is None:
+        from torchmetrics_tpu.engine.stats import EngineStats
+
+        st = EngineStats("txn:" + type(metric).__name__)
+        metric._txn_stats = st
+    return st
+
+
+def read_quarantine(metric: Any) -> Dict[str, Any]:
+    """Epoch-end host readout of the quarantine counter — the SANCTIONED boundary.
+
+    Returns ``{"owner", "count"}``. The device→host read runs inside
+    ``transfer_allowed("quarantine-read")`` so a strict-guarded epoch stays
+    clean; any growth since the last read lands in
+    ``EngineStats.quarantined_batches`` and one ``update.quarantine`` event
+    (the hot loop itself never reads the flag — events surface here, at the
+    declared boundary, by design). Read on unsynced state for this rank's
+    count, or inside a sync window for the world total.
+    """
+    val = getattr(metric, ATTR, None)
+    if val is None:
+        return {"owner": type(metric).__name__, "count": 0}
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    with transfer_allowed("quarantine-read"):
+        total = int(np.asarray(val))
+    reported = int(getattr(metric, "_quarantine_reported", 0))
+    if total > reported:
+        st = _stats_for(metric)
+        st.quarantined_batches += total - reported
+        _diag.record("update.quarantine", type(metric).__name__, count=total - reported, total=total)
+    if total != reported:
+        metric._quarantine_reported = total
+    return {"owner": type(metric).__name__, "count": total}
+
+
+def mark_reported(metric: Any) -> None:
+    """Align the reported watermark with the LIVE counter, surfacing nothing.
+
+    ``unsync`` calls this when a sanctioned read happened inside the sync
+    window: that read surfaced the WORLD total (which already contains this
+    rank's local count), so after the local counter is restored the watermark
+    must equal it — restoring the pre-sync watermark instead would re-open the
+    local share as an unreported delta and double-count it at the next read.
+    """
+    val = getattr(metric, ATTR, None)
+    if val is None:
+        return
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    with transfer_allowed("quarantine-read"):
+        metric._quarantine_reported = int(np.asarray(val))
+
+
+def quarantine_report() -> List[Dict[str, Any]]:
+    """Sanctioned readout of every registered counter, aggregated per owner.
+
+    Same shape discipline as ``sentinel_report``: one row per owner class
+    (counts summed, instances counted), flagged owners first, deterministic —
+    repeated exports of the same state are byte-identical.
+    """
+    by_owner: Dict[str, Dict[str, Any]] = {}
+    for metric in list(_REGISTRY.values()):
+        row = read_quarantine(metric)
+        slot = by_owner.setdefault(row["owner"], {"owner": row["owner"], "count": 0, "instances": 0})
+        slot["count"] += row["count"]
+        slot["instances"] += 1
+    rows = sorted(by_owner.values(), key=lambda r: (r["count"] == 0, r["owner"]))
+    return rows
+
+
+def reset_quarantine() -> None:
+    """Zero every registered counter and clear the registry
+    (``reset_engine_stats`` lockstep)."""
+    import jax.numpy as jnp
+
+    for metric in list(_REGISTRY.values()):
+        if getattr(metric, ATTR, None) is not None:
+            setattr(metric, ATTR, jnp.zeros((), jnp.int32))
+            metric._quarantine_reported = 0
+    _REGISTRY.clear()

@@ -233,7 +233,30 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric shouldn't be synced when performing ``forward``. HINT: Did you forget to call ``unsync``?"
             )
-        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+        from torchmetrics_tpu.engine import txn as _txn
+
+        # mutation guard for preemption-safe snapshots: a signal handler must
+        # not persist state mid-mutation (forward folds outside the update
+        # wrapper, hence the depth covers the whole call)
+        self._mutation_depth = getattr(self, "_mutation_depth", 0) + 1
+        try:
+            return self._forward_guarded(_txn, *args, **kwargs)
+        finally:
+            self._mutation_depth -= 1
+
+    def _forward_guarded(self, _txn: Any, *args: Any, **kwargs: Any) -> Any:
+        if (
+            self.full_state_update
+            or self.full_state_update is None
+            or self.dist_sync_on_step
+            or _txn.quarantine_enabled()
+        ):
+            # quarantine forces the full-state path: its global update gets the
+            # exact in-graph select and the throwaway batch state is restored
+            # wholesale, whereas the reduce path's count-weighted mean fold
+            # would dilute the global state by every quarantined batch (the
+            # host-side weights cannot see the device poison flag without a
+            # hot-loop transfer)
             self._forward_cache = self._forward_full_state_update(*args, **kwargs)
         else:
             self._forward_cache = self._forward_reduce_state_update(*args, **kwargs)
@@ -253,9 +276,14 @@ class Metric:
         self._should_unsync = False
         _temp_compute_on_cpu = self.compute_on_cpu
         self.compute_on_cpu = False
+        # the window's compute() runs on THROWAWAY single-batch state (reset
+        # zeroed counter and count alike) — epoch-level quarantine surfacing
+        # in the compute wrapper must not fire here
+        self._in_batch_value = True
         try:
             yield
         finally:
+            self._in_batch_value = False
             self._is_synced = False
             self._should_unsync = True
             self._to_sync = self.sync_on_compute
@@ -300,14 +328,32 @@ class Metric:
             attr: (list(v) if isinstance(v := getattr(self, attr), list) else v) for attr in self._defaults
         }
         refs["__none_folded__"] = frozenset(self._none_folded)
+        # the quarantine counter rides sync/forward snapshots like a state: a
+        # packed sync SUMS it cross-rank (parallel/packing.py), so unsync must
+        # restore the local count or the next sync would re-sum a sum
+        if "_quarantined_count" in self.__dict__:
+            refs["_quarantined_count"] = self.__dict__["_quarantined_count"]
+            refs["_quarantine_reported"] = self.__dict__.get("_quarantine_reported", 0)
         return refs
 
     def _restore_state_refs(self, cache: Dict[str, Any]) -> None:
+        # a reported-watermark change between snapshot and restore means a
+        # sanctioned quarantine read surfaced the WORLD total inside this sync
+        # window — see txn.mark_reported for why the restored local count must
+        # then be treated as already reported
+        read_in_window = (
+            "_quarantine_reported" in cache
+            and self.__dict__.get("_quarantine_reported", 0) != cache["_quarantine_reported"]
+        )
         for attr, val in cache.items():
             if attr == "__none_folded__":
                 self._none_folded = set(val)
             else:
                 setattr(self, attr, val)
+        if read_in_window:
+            from torchmetrics_tpu.engine import txn as _txn
+
+            _txn.mark_reported(self)
 
     def merge_state(self, incoming_state: Union["Metric", Dict[str, Any]], incoming_count: int = 1) -> None:
         """Fold another metric's state (or a raw state dict) into this one.
@@ -321,7 +367,12 @@ class Metric:
         if isinstance(incoming_state, Metric):
             incoming_count = incoming_state._update_count
             incoming_folded = frozenset(incoming_state._none_folded)
+            incoming_quarantined = incoming_state.__dict__.get("_quarantined_count")
+            incoming_q_reported = incoming_state.__dict__.get("_quarantine_reported", 0)
             incoming_state = {attr: getattr(incoming_state, attr) for attr in incoming_state._defaults}
+        else:
+            incoming_quarantined = incoming_state.get("_quarantined_count")
+            incoming_q_reported = incoming_state.get("_quarantine_reported", 0)
         self_count = self._update_count
         for attr in self._defaults:
             self_state = getattr(self, attr)
@@ -356,6 +407,15 @@ class Metric:
                 raise TypeError(f"Unsupported reduce_fn: {reduce_fn}")
             setattr(self, attr, reduced)
         self._update_count = self_count + incoming_count
+        if incoming_quarantined is not None:
+            from torchmetrics_tpu.engine import txn as _txn
+
+            # map-reduce folds are additive in the counter AND the reported
+            # watermark: each side's already-surfaced batches stay surfaced,
+            # each side's unreported delta stays pending exactly once
+            local_quarantined = _txn.ensure_count(self)
+            self._quarantined_count = local_quarantined + incoming_quarantined
+            self._quarantine_reported = self.__dict__.get("_quarantine_reported", 0) + incoming_q_reported
         self._computed = None
 
     def _fold_none_arrays(
@@ -426,6 +486,13 @@ class Metric:
             else:
                 raise TypeError(f"Unsupported reduce_fn: {reduce_fn}")
             setattr(self, attr, reduced)
+        # forward's reduce path reset the quarantine counter with the states;
+        # fold the snapshotted global count back in (the counter is additive)
+        global_quarantined = incoming_state.get("_quarantined_count")
+        local_quarantined = self.__dict__.get("_quarantined_count")
+        if global_quarantined is not None and local_quarantined is not None:
+            self._quarantined_count = global_quarantined + local_quarantined
+            self._quarantine_reported = incoming_state.get("_quarantine_reported", 0)
 
     # ------------------------------------------------------------------ sync
 
@@ -639,33 +706,58 @@ class Metric:
 
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
-            self._computed = None
-            self._update_count += 1
-            # host-side trace span: shows up in jax.profiler / Perfetto timelines so
-            # metric updates are attributable inside a profiled training step (SURVEY §5.1)
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-                if not self._engine_step(args, kwargs):
-                    # engine-disabled updates leave no engine counters behind; the
-                    # flight-recorder event keeps eager steps visible in the same
-                    # timeline as compiled dispatches (engine fallbacks additionally
-                    # carry their reason via EngineStats.fallback), timed so the
-                    # eager launch cost lands in the same latency histograms
-                    rec = _diag.active_recorder()
-                    measuring = rec is not None or _profile.active_profile() is not None
-                    if not measuring:
-                        update(*args, **kwargs)
-                    else:
-                        t0 = perf_counter()
-                        update(*args, **kwargs)
-                        dispatch_us = round((perf_counter() - t0) * 1e6, 3)
-                        _hist.observe(type(self).__name__, "eager", "dispatch_us", dispatch_us)
-                        if rec is not None:
-                            rec.record(
-                                "update.eager", type(self).__name__,
-                                dispatch_us=dispatch_us, dur_us=dispatch_us,
-                            )
-            if self.compute_on_cpu:
-                self._move_list_states_to_cpu()
+            from torchmetrics_tpu.engine import txn as _txn
+
+            quarantine_mode = _txn.quarantine_mode()
+            if quarantine_mode == _txn.MODE_ERROR:
+                # fail-loud admission: raises BEFORE any mutation, so the
+                # accumulator AND _update_count are untouched on both paths
+                # (unless the enclosing collection step already admitted this
+                # exact batch — one blocking sync per metric per step, not two)
+                if not self.__dict__.pop("_admission_prechecked", False):
+                    _txn.admission_check_or_raise(self, args, kwargs)
+            # a snapshot signal handler firing between these mutations would
+            # persist a torn shard (count bumped, states mid-write): the depth
+            # tells ContinuousSnapshotter to stand on the last completed flush
+            self._mutation_depth = getattr(self, "_mutation_depth", 0) + 1
+            try:
+                self._computed = None
+                self._update_count += 1
+                # host-side trace span: shows up in jax.profiler / Perfetto timelines so
+                # metric updates are attributable inside a profiled training step (SURVEY §5.1)
+                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                    if not self._engine_step(args, kwargs):
+                        # engine-disabled updates leave no engine counters behind; the
+                        # flight-recorder event keeps eager steps visible in the same
+                        # timeline as compiled dispatches (engine fallbacks additionally
+                        # carry their reason via EngineStats.fallback), timed so the
+                        # eager launch cost lands in the same latency histograms
+                        if quarantine_mode == _txn.MODE_QUARANTINE:
+                            # eager parity: the same admission + transactional skip
+                            # the compiled path lowers in-graph, so engine-on and
+                            # engine-off runs agree on quarantined streams
+                            def run() -> None:
+                                _txn.eager_update(self, lambda: update(*args, **kwargs), args, kwargs)
+                        else:
+                            def run() -> None:
+                                update(*args, **kwargs)
+                        rec = _diag.active_recorder()
+                        measuring = rec is not None or _profile.active_profile() is not None
+                        if not measuring:
+                            run()
+                        else:
+                            t0 = perf_counter()
+                            run()
+                            dispatch_us = round((perf_counter() - t0) * 1e6, 3)
+                            _hist.observe(type(self).__name__, "eager", "dispatch_us", dispatch_us)
+                            if rec is not None:
+                                rec.record(
+                                    "update.eager", type(self).__name__, dispatch_us=dispatch_us,
+                                )
+                if self.compute_on_cpu:
+                    self._move_list_states_to_cpu()
+            finally:
+                self._mutation_depth -= 1
 
         return wrapped_func
 
@@ -762,6 +854,22 @@ class Metric:
                     " method which may lead to errors, as metric states have not yet been updated.",
                     UserWarning,
                 )
+            elif not getattr(self, "_in_batch_value", False):
+                from torchmetrics_tpu.engine import txn as _txn
+
+                if _txn.quarantine_enabled() and getattr(self, _txn.ATTR, None) is not None:
+                    # compute IS the sanctioned epoch-end boundary: flush the
+                    # quarantine counter into EngineStats/events here, and warn
+                    # when every updated batch was quarantined — the states are
+                    # still at their defaults, which would otherwise read as a
+                    # silently-wrong epoch value
+                    if _txn.read_quarantine(self)["count"] >= self._update_count:
+                        rank_zero_warn(
+                            f"Every batch seen by metric {self.__class__.__name__} failed quarantine"
+                            " admission — ``compute`` is folding default (empty) state. Inspect"
+                            " the input pipeline or run with TORCHMETRICS_TPU_QUARANTINE=error.",
+                            UserWarning,
+                        )
             if self._computed is not None:
                 return self._computed
 
@@ -848,6 +956,11 @@ class Metric:
             # starts a fresh accumulation — flags from the previous epoch
             # must not bleed into the next one
             self._sentinel_flags = jnp.zeros((), jnp.int32)
+        if self.__dict__.get("_quarantined_count") is not None:
+            # same rule for the quarantine counter: growth already surfaced by
+            # a sanctioned read stays in EngineStats; the device count restarts
+            self._quarantined_count = jnp.zeros((), jnp.int32)
+            self._quarantine_reported = 0
 
     def state_footprint(self) -> Dict[str, Any]:
         """Live HBM bytes held by this metric's states (see ``diag/costs.py``)."""
@@ -861,7 +974,7 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Drop wrapped bound methods + compiled executables for pickling (reference ``metric.py:644-648``)."""
-        drop = ("update", "compute", "_update_signature", "_raw_update", "_raw_compute", "_engine", "_epoch")
+        drop = ("update", "compute", "_update_signature", "_raw_update", "_raw_compute", "_engine", "_epoch", "_txn_stats")
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

@@ -7,11 +7,15 @@ world sizes. See ``docs/pages/reliability.md``.
 """
 
 from torchmetrics_tpu.parallel.elastic import (
+    ContinuousSnapshotter,
     SnapshotIntegrityError,
+    SnapshotPolicy,
     SnapshotReshardError,
     SnapshotVersionError,
+    restore_latest,
     restore_resharded,
     save_state_shard,
+    state_fingerprint,
 )
 from torchmetrics_tpu.parallel.faults import (
     CollectiveTimeout,
@@ -43,6 +47,7 @@ from torchmetrics_tpu.parallel.sync import (
 __all__ = [
     "CollectiveTimeout",
     "CollectiveTimeoutError",
+    "ContinuousSnapshotter",
     "CorruptPayload",
     "DelayRank",
     "EvalMesh",
@@ -52,6 +57,7 @@ __all__ = [
     "RankDrop",
     "RankUnreachableError",
     "SnapshotIntegrityError",
+    "SnapshotPolicy",
     "SnapshotReshardError",
     "SnapshotVersionError",
     "SyncFaultError",
@@ -65,6 +71,8 @@ __all__ = [
     "jit_distributed_available",
     "resilience_context",
     "resilience_snapshot",
+    "restore_latest",
     "restore_resharded",
     "save_state_shard",
+    "state_fingerprint",
 ]

@@ -37,26 +37,50 @@ must restore into an M-rank world without corrupting the fold semantics every
   names a fallback shard set to restore instead (counted and recorded as a
   ``snapshot.fallback`` flight-recorder event) so a corrupted latest snapshot
   degrades to the previous one rather than to a crash loop.
+
+Preemption-safe **continuous** snapshots build on the same primitives:
+
+- :class:`SnapshotPolicy` — cadence (every N updates and/or every T seconds,
+  ``TORCHMETRICS_TPU_SNAPSHOT_EVERY``: ``"500"`` = updates, ``"30s"`` =
+  seconds).
+- :class:`ContinuousSnapshotter` — drives :func:`save_state_shard` on the
+  cadence into numbered sequences (``snap-000042.rank0-of-2.npz``), prunes
+  old sequences per rank, and installs SIGTERM/SIGINT handlers that flush a
+  FINAL shard before the process dies — a pod preemption between epoch-end
+  checkpoints loses at most the in-flight batch, not the epoch.
+- :func:`restore_latest` — walks the snapshot sequences newest-first and
+  restores the first COMPLETE, integrity-clean set (a preemption that caught
+  only some ranks mid-sequence degrades to the previous complete one — the
+  last-good chain, automated).
 """
 
 from __future__ import annotations
 
 import os
+import re
+import signal as _signal
+import time as _time
 import zlib
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 __all__ = [
+    "SNAPSHOT_EVERY_ENV_VAR",
     "SNAPSHOT_VERSION",
+    "ContinuousSnapshotter",
     "SnapshotIntegrityError",
+    "SnapshotPolicy",
     "SnapshotReshardError",
     "SnapshotVersionError",
+    "list_snapshots",
+    "restore_latest",
     "restore_resharded",
     "save_state_shard",
     "shard_path",
+    "state_fingerprint",
 ]
 
 #: bump when the snapshot layout changes; mismatched snapshots fail loud
@@ -94,11 +118,11 @@ def _payload_crc(flat: Dict[str, np.ndarray]) -> int:
     return crc & 0xFFFFFFFF
 
 
-def save_state_shard(metric: Any, path: str, rank: int = 0, world_size: int = 1) -> str:
-    """Atomically snapshot this rank's FULL state (persistence forced on).
+def _collect_flat(metric: Any) -> Dict[str, np.ndarray]:
+    """This rank's full state as a flat numpy dict (persistence forced on).
 
-    Writes ``path`` (``.npz`` appended when missing) via ``.tmp`` + rename:
-    the file either exists complete or not at all. Returns the final path.
+    The read rides the sanctioned ``snapshot-save`` boundary — persisting
+    state to disk is a DECLARED host transfer, like the sync collectives.
     """
     from torchmetrics_tpu.utilities.checkpoint import (
         _restore_persistence,
@@ -111,13 +135,31 @@ def save_state_shard(metric: Any, path: str, rank: int = 0, world_size: int = 1)
     saved_flags = _snapshot_persistence(metric)
     try:
         metric.persistent(True)
-        # persisting state to disk is a DECLARED host boundary (like the sync
-        # collectives): the strict transfer guard must not flag a checkpoint
         with transfer_allowed("snapshot-save"):
             flat = _to_saveable(metric.state_dict())
     finally:
         _restore_persistence(metric, saved_flags)
-    flat = {k: np.asarray(v) for k, v in flat.items()}
+    return {k: np.asarray(v) for k, v in flat.items()}
+
+
+def state_fingerprint(metric: Any) -> int:
+    """Order-independent CRC of the metric's full persisted state.
+
+    The same digest :func:`save_state_shard` stamps into a shard's payload —
+    two metrics with byte-identical persisted state (values AND update count)
+    fingerprint identically, so a snapshot→restore round-trip can be audited
+    without re-reading the shard.
+    """
+    return _payload_crc(_collect_flat(metric))
+
+
+def save_state_shard(metric: Any, path: str, rank: int = 0, world_size: int = 1) -> str:
+    """Atomically snapshot this rank's FULL state (persistence forced on).
+
+    Writes ``path`` (``.npz`` appended when missing) via ``.tmp`` + rename:
+    the file either exists complete or not at all. Returns the final path.
+    """
+    flat = _collect_flat(metric)
     flat["__elastic_version__"] = np.asarray(SNAPSHOT_VERSION)
     flat["__rank__"] = np.asarray(int(rank))
     flat["__world__"] = np.asarray(int(world_size))
@@ -388,3 +430,313 @@ def restore_resharded(
         saved_world=len(shard_flats), rank=int(rank), world=int(world_size),
     )
     return metric
+
+
+# ------------------------------------------------------------------ continuous snapshots
+
+#: cadence knob: ``"500"`` = snapshot every 500 updates, ``"30s"``/``"2.5s"`` =
+#: every 30 / 2.5 seconds; unset = no automatic cadence (flush/signals only)
+SNAPSHOT_EVERY_ENV_VAR = "TORCHMETRICS_TPU_SNAPSHOT_EVERY"
+
+_SNAP_RE = re.compile(r"snap-(\d+)\.rank(\d+)-of-(\d+)\.npz$")
+
+
+class SnapshotPolicy:
+    """Snapshot cadence: every N updates and/or every T seconds (OR-combined).
+
+    Cadence counts from the LAST snapshot: with ``every_updates=N`` the Nth
+    update since the previous flush is the one that snapshots (updates 1..N-1
+    do not) — the off-by-one convention the tests pin.
+    """
+
+    __slots__ = ("every_updates", "every_seconds")
+
+    def __init__(self, every_updates: Optional[int] = None, every_seconds: Optional[float] = None) -> None:
+        # None-checks, not truthiness: every_updates=0 must hit the validation
+        # below (a silently-disabled cadence loses data on the next preemption)
+        self.every_updates = int(every_updates) if every_updates is not None else None
+        self.every_seconds = float(every_seconds) if every_seconds is not None else None
+        if self.every_updates is not None and self.every_updates < 1:
+            raise ValueError(f"every_updates must be >= 1 (got {every_updates})")
+        if self.every_seconds is not None and self.every_seconds <= 0:
+            raise ValueError(f"every_seconds must be > 0 (got {every_seconds})")
+
+    @classmethod
+    def from_env(cls) -> Optional["SnapshotPolicy"]:
+        """Parse ``TORCHMETRICS_TPU_SNAPSHOT_EVERY``; None only when UNSET.
+
+        An invalid value fails loud: silently running with no cadence is the
+        exact data-loss mode the cadence exists to prevent — the operator who
+        set the knob must learn about the typo before the next preemption.
+        """
+        raw = os.environ.get(SNAPSHOT_EVERY_ENV_VAR, "").strip().lower()
+        if not raw:
+            return None
+        try:
+            if raw.endswith("s"):
+                return cls(every_seconds=float(raw[:-1]))
+            return cls(every_updates=int(raw))
+        except ValueError as exc:
+            raise TorchMetricsUserError(
+                f"invalid {SNAPSHOT_EVERY_ENV_VAR}={raw!r}: use an update count"
+                " ('500') or a seconds suffix ('30s'); refusing to run with the"
+                " snapshot cadence silently disabled."
+            ) from exc
+
+    def due(self, updates_since: int, seconds_since: float) -> bool:
+        """Whether a snapshot is due, given progress since the last one."""
+        if self.every_updates is not None and updates_since >= self.every_updates:
+            return True
+        if self.every_seconds is not None and seconds_since >= self.every_seconds:
+            return True
+        return False
+
+
+def _snapshot_base(directory: str, seq: int) -> str:
+    return os.path.join(directory, f"snap-{int(seq):06d}")
+
+
+def list_snapshots(directory: str) -> List[Tuple[int, List[str]]]:
+    """``[(seq, [shard paths])]`` for every snapshot sequence, oldest first.
+
+    Leftover ``.tmp`` files from crashed atomic writes never match the shard
+    pattern, so they are invisible here by construction.
+    """
+    by_seq: Dict[int, List[str]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        match = _SNAP_RE.fullmatch(name)
+        if match:
+            by_seq.setdefault(int(match.group(1)), []).append(os.path.join(directory, name))
+    return [(seq, sorted(by_seq[seq])) for seq in sorted(by_seq)]
+
+
+class ContinuousSnapshotter:
+    """Cadence-driven atomic snapshots + a preemption flush for ONE metric.
+
+    Each flush writes a new numbered sequence through :func:`save_state_shard`
+    (atomic, version-stamped, CRC'd), so the directory always holds a chain of
+    complete snapshots; :func:`restore_latest` walks it newest-first. ``keep``
+    bounds disk: this rank's shards of older sequences are pruned after every
+    successful flush (every retained sequence stays complete per rank).
+
+    :meth:`install_signal_handlers` arms SIGTERM/SIGINT: the handler flushes a
+    FINAL shard, then restores the previous handler and re-raises the signal —
+    the process still dies, but the last-good chain ends at the preemption
+    instant instead of the last epoch boundary. Handlers only install on the
+    main thread (Python's signal contract); install once per process per
+    snapshotter.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        directory: str,
+        rank: int = 0,
+        world_size: int = 1,
+        policy: Optional[SnapshotPolicy] = None,
+        keep: int = 2,
+        clock: Callable[[], float] = _time.monotonic,
+    ) -> None:
+        self.metric = metric
+        self.directory = os.fspath(directory)
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.policy = policy if policy is not None else SnapshotPolicy.from_env()
+        self.keep = max(1, int(keep))
+        self._clock = clock
+        os.makedirs(self.directory, exist_ok=True)
+        existing = list_snapshots(self.directory)
+        self._seq = existing[-1][0] if existing else 0
+        self._updates_since = 0
+        self._last_flush = self._clock()
+        self._prev_handlers: Dict[int, Any] = {}
+        self.flushes = 0
+
+    @property
+    def seq(self) -> int:
+        """Number of the last COMPLETED snapshot sequence (0 = none yet).
+
+        Lets callers pair each flush with out-of-band bookkeeping (e.g. a
+        fingerprint recorded per completed sequence): after a signal-handler
+        chain runs, ``seq`` advancing past the last value observed in the hot
+        loop proves the preemption flush wrote a shard rather than standing on
+        the previous snapshot (mid-update skip).
+        """
+        return self._seq
+
+    # ------------------------------------------------------------------ cadence
+
+    def note_update(self) -> Optional[str]:
+        """Record one metric update; snapshot when the cadence says so.
+
+        Returns the shard path when a snapshot was written, else None.
+        """
+        self._updates_since += 1
+        if self.policy is not None and self.policy.due(
+            self._updates_since, self._clock() - self._last_flush
+        ):
+            return self.flush(reason="cadence")
+        return None
+
+    def flush(self, reason: str = "manual") -> str:
+        """Write the next numbered snapshot sequence now (atomic per shard)."""
+        seq = self._seq + 1
+        path = save_state_shard(
+            self.metric,
+            shard_path(_snapshot_base(self.directory, seq), self.rank, self.world_size),
+            rank=self.rank,
+            world_size=self.world_size,
+        )
+        # only a written shard advances the completed-sequence watermark: a
+        # failed save (disk full) must leave ``seq`` standing on the last
+        # sequence that actually has a restorable shard
+        self._seq = seq
+        self._updates_since = 0
+        self._last_flush = self._clock()
+        self.flushes += 1
+        from torchmetrics_tpu.diag import trace as _diag
+
+        _diag.record(
+            "snapshot.flush", type(self.metric).__name__,
+            seq=self._seq, reason=reason, rank=self.rank, world=self.world_size,
+        )
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        """Drop THIS rank's shards beyond its newest ``keep``.
+
+        Retention is keyed on the sequences THIS RANK has shards in, not the
+        directory's global newest — ranks whose sequence counters skew (a
+        manual flush on one rank, seconds-cadence jitter) must never prune
+        their own newest shard just because another rank's counter ran ahead.
+        """
+        mine = []
+        for seq, paths in list_snapshots(self.directory):
+            shard = shard_path(_snapshot_base(self.directory, seq), self.rank, self.world_size)
+            if shard in paths:
+                mine.append((seq, shard))
+        mine.sort(reverse=True)
+        for _seq, stale in mine[self.keep:]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass  # already gone — pruning is best-effort
+
+    # ------------------------------------------------------------------ preemption
+
+    def install_signal_handlers(self, signals: Sequence[int] = (_signal.SIGTERM, _signal.SIGINT)) -> None:
+        """Arm the preemption flush: on signal, write a final shard, then die.
+
+        The previous handler is restored and the signal re-raised after the
+        flush, so default termination semantics (and any outer handler) are
+        preserved — this snapshotter only inserts the flush. If the re-raised
+        signal turns out survivable (a caught-and-continued KeyboardInterrupt),
+        the flush handler re-arms itself for the next delivery.
+        """
+        for signum in signals:
+            self._prev_handlers[signum] = _signal.getsignal(signum)
+            _signal.signal(signum, self._on_signal)
+
+    def uninstall_signal_handlers(self) -> None:
+        for signum, prev in self._prev_handlers.items():
+            _signal.signal(signum, prev)
+        self._prev_handlers.clear()
+
+    def _metric_mid_mutation(self) -> bool:
+        """Whether the watched metric (or any collection member) is mid-update.
+
+        Signal handlers run between bytecodes: a flush landing between the
+        update wrapper's count bump and its state writes would persist a TORN
+        shard that still passes its CRC (the digest covers whatever was read).
+        """
+        if getattr(self.metric, "_mutation_depth", 0):
+            return True
+        modules = getattr(self.metric, "_modules", None)
+        if modules:
+            return any(getattr(m, "_mutation_depth", 0) for m in modules.values())
+        return False
+
+    def preempt_flush(self, signum: int) -> Optional[str]:
+        """The signal-time flush: write a final shard, or — when the signal
+        landed mid-update — stand on the last completed snapshot instead of
+        persisting torn state. Returns the shard path, or None when skipped."""
+        from torchmetrics_tpu.diag import trace as _diag
+
+        if self._metric_mid_mutation():
+            _diag.record(
+                "snapshot.preempt", type(self.metric).__name__,
+                signum=int(signum), seq=self._seq, skipped="mid-update",
+            )
+            return None
+        path = self.flush(reason=f"signal:{signum}")
+        _diag.record(
+            "snapshot.preempt", type(self.metric).__name__, signum=int(signum), seq=self._seq,
+        )
+        return path
+
+    def _on_signal(self, signum: int, frame: Any) -> None:
+        try:
+            self.preempt_flush(signum)
+        finally:
+            prev = self._prev_handlers.get(signum, _signal.SIG_DFL)
+            _signal.signal(signum, prev if prev is not None else _signal.SIG_DFL)
+            try:
+                _signal.raise_signal(signum)
+            finally:
+                # a survivable delivery (a KeyboardInterrupt the training loop
+                # catches and continues from) must leave the preemption flush
+                # armed for the NEXT signal; a fatal one never reaches this
+                # line. Guard: uninstall may have run inside the re-raise.
+                if signum in self._prev_handlers:
+                    _signal.signal(signum, self._on_signal)
+
+    def __enter__(self) -> "ContinuousSnapshotter":
+        self.install_signal_handlers()
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.uninstall_signal_handlers()
+
+
+def restore_latest(
+    metric: Any,
+    directory: str,
+    rank: int = 0,
+    world_size: int = 1,
+) -> int:
+    """Restore the newest COMPLETE, integrity-clean snapshot sequence.
+
+    Walks the last-good chain newest-first: a sequence that is incomplete (a
+    preemption caught only some ranks mid-flush), corrupt, or
+    version-mismatched is skipped with a recorded ``snapshot.fallback`` event
+    and the previous one is tried — the automated form of
+    ``restore_resharded(..., last_good=...)``. Returns the restored sequence
+    number; raises :class:`SnapshotIntegrityError` when no sequence survives.
+    """
+    from torchmetrics_tpu.diag import trace as _diag
+
+    sequences = list_snapshots(directory)
+    last_err: Optional[Exception] = None
+    for seq, paths in reversed(sequences):
+        try:
+            restore_resharded(metric, paths, rank=rank, world_size=world_size)
+        except (SnapshotIntegrityError, SnapshotVersionError) as err:
+            _diag.record(
+                "snapshot.fallback", type(metric).__name__,
+                seq=seq, error=type(err).__name__, detail=str(err)[:200],
+            )
+            last_err = err
+            continue
+        _diag.record("snapshot.restore_latest", type(metric).__name__, seq=seq, rank=int(rank))
+        return seq
+    if last_err is not None:
+        raise SnapshotIntegrityError(
+            f"no restorable snapshot sequence under {directory!r}: every candidate"
+            " failed its integrity/version check"
+        ) from last_err
+    raise SnapshotIntegrityError(f"no snapshot sequences found under {directory!r}")

@@ -218,6 +218,11 @@ class PackedSyncPlan:
     def _build(self) -> None:
         import jax.numpy as jnp
 
+        # function-level import: packing sits below the engine package in the
+        # import graph (engine/epoch.py imports this module at top level), so a
+        # module-level engine import here would be a cycle
+        from torchmetrics_tpu.engine import txn as _txn
+
         for owner, metric in self._metrics:
             for attr, red in metric._reductions.items():
                 val = getattr(metric, attr)
@@ -272,6 +277,20 @@ class PackedSyncPlan:
                 spec.shape = tuple(int(d) for d in sentinel_val.shape)
                 spec.size = 1
                 spec.group = "gather:" + spec.dtype
+                self.specs.append(spec)
+            # quarantine counter (engine/txn.py): the per-rank batch-quarantine
+            # count rides the reduce buffer and SUMS across ranks — the same
+            # additive fold the aggregate ``_update_count`` gets at checkpoint
+            # restore. Membership is a function of the enablement knob alone
+            # (the sentinel's layout-symmetry rule): enable the same mode on
+            # every rank or the buffer layouts desynchronize.
+            quarantine_val = _txn.ensure_count(metric) if _txn.quarantine_enabled() else None
+            if _is_array(quarantine_val):
+                spec = _Spec(owner, _txn.ATTR, "sum", str(quarantine_val.dtype))
+                spec.shape = tuple(int(d) for d in quarantine_val.shape)
+                spec.size = 1
+                spec.needs_meta = False
+                spec.group = "reduce:" + spec.dtype
                 self.specs.append(spec)
 
     def _add_list_spec(self, owner: str, metric: Any, attr: str, red: Any, val: Any) -> None:
