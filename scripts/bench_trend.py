@@ -53,6 +53,15 @@ _TRACKED = (
     ("txn", "ladder_retries", None),
     ("txn", "quarantine_host_transfers", "max"),
     ("txn", "clean_quarantined_batches", "max"),
+    # numerics layer (engine/numerics.py, PR 8): compensated accumulation.
+    # the rel-err pair is the drift-vs-rescue evidence (display; the 1e-3/1e-6
+    # thresholds gate in check_counters); transfers/retraces/clean-flags gate.
+    ("numerics", "naive_rel_err", None),
+    ("numerics", "compensated_rel_err", None),
+    ("numerics", "drift_flags_planted", None),
+    ("numerics", "numerics_host_transfers", "max"),
+    ("numerics", "numerics_retraces_after_warmup", "max"),
+    ("numerics", "drift_flags_clean", "max"),
 )
 
 _TOL = 1e-6

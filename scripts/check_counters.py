@@ -59,6 +59,16 @@ and fails CI when any counter regresses past the committed baseline
   (``sync_degraded_folds`` == 0, ``sync_retries_clean`` == 0), and the whole
   chaos block does zero unsanctioned host transfers
   (``fault_host_transfers`` == 0)
+- numerical-resilience proofs (``engine/numerics.py``): the 18k-step
+  long stream drifts ≥1e-3 on the naive float32 path
+  (``drift_demonstrated``) while the compensated two-sum path stays within
+  1e-6 of the float64 reference (``compensated_ok``) — in the SAME donated
+  executable, zero host transfers, zero warm retraces; the sampled drift
+  audit is byte-inert on unsampled steps (``probe_parity_ok``), fires on the
+  planted run (``drift_flagged``, ``precision_loss_flagged``) and stays
+  silent on the clean one (``drift_flags_clean`` == 0,
+  ``clean_sentinel_flags`` == 0); the world-2 packed sync folds (value,
+  residual) pairs in ≤2 collectives with 1e-6 parity (``sync_parity_ok``)
 
 The baseline defaults to the NEWEST ``BENCH_r*.json`` in the repo root (pass
 ``--baseline`` to pin one) — a stale envelope can no longer be compared
@@ -139,6 +149,23 @@ _CHECKS = (
     ("txn", "ladder_parity_ok", "true", None),  # ...and the chunked step matches
     ("txn", "ladder_host_transfers", "abs", 0),
     ("txn", "sigterm_snapshot_ok", "true", None),  # restore_latest fingerprint parity
+    # numerical-resilience gates (engine/numerics.py, PR 8): the long stream
+    # must PROVE the drift (naive ≥1e-3 off the float64 reference) AND the
+    # rescue (compensated ≤1e-6), with the audit machinery firing only when
+    # planted — all under the STRICT transfer guard, zero warm retraces
+    ("numerics", "drift_demonstrated", "true", None),  # naive float32 ≥1e-3 adrift
+    ("numerics", "compensated_ok", "true", None),  # two-sum path ≤1e-6 of float64
+    ("numerics", "numerics_host_transfers", "abs", 0),  # strict guard held
+    ("numerics", "numerics_retraces_after_warmup", "abs", 0),  # two-sum lives in-graph
+    ("numerics", "numerics_retraces_uncaused", "abs", 0),
+    ("numerics", "probe_parity_ok", "true", None),  # unsampled steps byte-identical
+    ("numerics", "drift_flagged", "true", None),  # planted run DID flag drift
+    ("numerics", "precision_loss_flagged", "true", None),  # ...and the sentinel bit fired
+    ("numerics", "drift_host_transfers", "abs", 0),  # probe reads are sanctioned
+    ("numerics", "drift_flags_clean", "abs", 0),  # healthy stream flags nothing
+    ("numerics", "clean_sentinel_flags", "abs", 0),
+    ("numerics", "packed_collectives_per_sync", "max", 2),  # residual rides the same buffer
+    ("numerics", "sync_parity_ok", "true", None),  # world-2 two-sum fold ≤1e-6
 )
 
 
@@ -179,7 +206,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn"):
+    for scenario in ("engine", "epoch", "txn", "numerics"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

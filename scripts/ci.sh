@@ -9,7 +9,7 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-ARGS=(-q -p no:cacheprovider -rs --no-header)
+ARGS=(-q -p no:cacheprovider -rs --no-header -m "not slow")
 TARGET=(tests/)
 if [[ "${1:-}" == "fast" ]]; then
   TARGET=(tests/ --ignore=tests/differential)
@@ -79,8 +79,23 @@ elif ! grep -q '"quarantined_match": true' "$BENCH_OUT" \
   # SIGTERM'd run must leave a restore_latest()-able fingerprint-exact snapshot
   echo "bench smoke: FAILED (state-transaction quarantine/ladder/snapshot proofs missing or degraded)"
   status=1
+elif ! grep -q '"drift_demonstrated": true' "$BENCH_OUT" \
+  || ! grep -q '"compensated_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"numerics_host_transfers": 0' "$BENCH_OUT" \
+  || ! grep -q '"drift_flagged": true' "$BENCH_OUT" \
+  || ! grep -q '"precision_loss_flagged": true' "$BENCH_OUT" \
+  || ! grep -q '"drift_flags_clean": 0' "$BENCH_OUT" \
+  || ! grep -q '"sync_parity_ok": true' "$BENCH_OUT"; then
+  # numerical-resilience smoke (engine/numerics.py gate): the 18k-step long
+  # stream must drift >= 1e-3 on the naive float32 path while the compensated
+  # two-sum path holds 1e-6 parity with the float64 reference — in the same
+  # donated graph with zero host transfers; the drift audit + precision_loss
+  # sentinel must fire on the planted run and stay silent on the clean one;
+  # the world-2 packed sync must fold (value, residual) pairs with parity
+  echo "bench smoke: FAILED (compensated-accumulation drift/rescue proofs missing or degraded)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics counters present)"
 fi
 
 echo

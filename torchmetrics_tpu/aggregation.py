@@ -120,6 +120,11 @@ class SumMetric(BaseAggregator):
         6.0
     """
 
+    #: the update is additive in its sum-reduced state (``new = old + g(batch)``)
+    #: — the contract the compensated accumulation (engine/numerics.py) relies
+    #: on to recover the pure batch contribution from a zeroed state
+    _engine_state_additive = True
+
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
 
@@ -163,6 +168,9 @@ class MeanMetric(BaseAggregator):
     """
 
     weight: Array
+
+    #: additive in both sum-reduced states — compensation-eligible (numerics.py)
+    _engine_state_additive = True
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)

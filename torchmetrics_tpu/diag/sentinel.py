@@ -34,6 +34,12 @@ Bit layout (sticky — bits only ever set until :func:`reset_sentinels` or
                               was skipped in-graph (``engine/txn.py``) — the
                               INPUT was poisoned but the state stayed clean, as
                               opposed to the sticky state-corruption bits above
+``precision_loss``      0x40  an update's entire nonzero contribution landed
+                              below the accumulator's ulp (``fl(acc + inc) ==
+                              acc`` — ``engine/numerics.py``); a naive float32
+                              accumulator is silently dropping increments from
+                              here on (the compensated path preserves them in
+                              the residual)
 ======================  ====  ====================================================
 
 Enablement (first hit wins): :func:`sentinel_context` /
@@ -87,6 +93,7 @@ FLAG_NEG_INF = 0x04
 FLAG_OVERFLOW = 0x08
 FLAG_NEGATIVE_COUNT = 0x10
 FLAG_INPUT_POISONED = 0x20
+FLAG_PRECISION_LOSS = 0x40
 
 SENTINEL_BITS = {
     "nan": FLAG_NAN,
@@ -95,6 +102,7 @@ SENTINEL_BITS = {
     "overflow_suspect": FLAG_OVERFLOW,
     "negative_count": FLAG_NEGATIVE_COUNT,
     "input_poisoned": FLAG_INPUT_POISONED,
+    "precision_loss": FLAG_PRECISION_LOSS,
 }
 
 _enabled_override: Optional[bool] = None

@@ -60,6 +60,11 @@ from torchmetrics_tpu.engine.config import (
 )
 from torchmetrics_tpu.engine.epoch import CollectionEpoch, EpochEngine
 from torchmetrics_tpu.engine.fusion import FusedUpdate
+from torchmetrics_tpu.engine.numerics import (
+    compensated_context,
+    set_compensated,
+    set_drift_rtol,
+)
 from torchmetrics_tpu.engine.stats import EngineStats, engine_report, reset_engine_stats
 from torchmetrics_tpu.engine.txn import (
     QuarantinedBatchError,
@@ -75,12 +80,15 @@ __all__ = [
     "EpochEngine",
     "FusedUpdate",
     "QuarantinedBatchError",
+    "compensated_context",
     "engine_context",
     "engine_enabled",
     "engine_report",
     "quarantine_context",
     "quarantine_report",
     "reset_engine_stats",
+    "set_compensated",
+    "set_drift_rtol",
     "set_engine_enabled",
     "set_quarantine_mode",
 ]

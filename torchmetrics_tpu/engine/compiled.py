@@ -35,6 +35,7 @@ from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine import numerics as _numerics
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
 
@@ -93,13 +94,24 @@ def signature_fingerprint(
     :func:`torchmetrics_tpu.diag.trace.attribute_retrace` can diff a new
     signature against previously compiled ones and name the minimal change
     (``bucket-miss`` vs ``dtype-change`` vs ``treedef-change`` …).
-    ``state_sig`` entries are ``(name, shape, dtype)``; ``in_sig`` entries are
-    ``(shape, dtype)``.
+    ``state_sig`` entries are ``(name, shape, dtype)`` — or, for nested riders
+    like the compensation residual, ``(name, ((sub, shape, dtype), ...))`` —
+    and ``in_sig`` entries are ``(shape, dtype)``.
     """
+    names, dtypes, shapes = [], [], []
+    for entry in state_sig:
+        if len(entry) == 2:  # nested rider: (key, ((sub, shape, dtype), ...))
+            names.append((entry[0], tuple(n for n, _, _ in entry[1])))
+            dtypes.extend(d for _, _, d in entry[1])
+            shapes.extend(s for _, s, _ in entry[1])
+        else:
+            names.append(entry[0])
+            shapes.append(entry[1])
+            dtypes.append(entry[2])
     return {
-        "treedef": (treedef, tuple(k for k, _, _ in state_sig)),
-        "dtype": (tuple(d for _, _, d in state_sig), tuple(d for _, d in in_sig)),
-        "shape": (tuple(s for _, s, _ in state_sig), tuple(s for s, _ in in_sig)),
+        "treedef": (treedef, tuple(names)),
+        "dtype": (tuple(dtypes), tuple(d for _, d in in_sig)),
+        "shape": (tuple(shapes), tuple(s for s, _ in in_sig)),
         "bucket": bucket,
         "device": device,
     }
@@ -223,14 +235,16 @@ def shield_state(state: Dict[str, Any], metric: Any, stats: EngineStats) -> Dict
     import jax.numpy as jnp
 
     shared = protected_ids(metric)
-    out = {}
-    for k, v in state.items():
+
+    def shield(v: Any) -> Any:
+        if isinstance(v, dict):  # nested rider (the compensation residual dict)
+            return {n: shield(x) for n, x in v.items()}
         if id(v) in shared:
-            out[k] = jnp.array(v, copy=True)
             stats.donation_copies += 1
-        else:
-            out[k] = v
-    return out
+            return jnp.array(v, copy=True)
+        return v
+
+    return {k: shield(v) for k, v in state.items()}
 
 
 def state_invalidated(metric: Any) -> bool:
@@ -252,7 +266,7 @@ def state_invalidated(metric: Any) -> bool:
     return False
 
 
-def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None):
+def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
     """Compile ``run(state_pytree, flat_inputs) -> state_pytree`` into a jitted
     step with the state pytree donated (policy permitting).
 
@@ -262,11 +276,18 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None):
     (see ``engine/bucketing.py``); ``tree_map`` keeps it agnostic to whether the
     state pytree is one metric's dict or a fused dict-of-dicts.
 
+    ``comp`` is the optional compensated-accumulation recomposition
+    (``engine/numerics.py``), ``(old_state, result, flat) -> result``, applied
+    after the pad-subtract identity: compensated entries of ``result`` hold the
+    pure batch contribution (the run body zeroed those states), pad rows are
+    already subtracted from it, and the two-sum then folds contribution +
+    residual into the preserved old value.
+
     ``txn`` is the optional quarantine transaction (``engine/txn.py``),
-    ``(old_state, result, flat) -> result``, applied LAST — after the
-    pad-subtract identity — so a poisoned batch selects back to the exact
-    pre-update values (padding already removed from the rejected candidate,
-    never from the preserved old state).
+    ``(old_state, result, flat) -> result``, applied LAST — after pad-subtract
+    and compensation — so a poisoned batch selects back to the exact
+    pre-update values (value AND residual alike; padding already removed from
+    the rejected candidate, never from the preserved old state).
     """
     import jax
     import jax.numpy as jnp
@@ -285,27 +306,50 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None):
             unit = run(zeros, unit_flat)
 
             def subtract(path, o, u):
-                # the sentinel bitmask and the quarantine counter are not
-                # row-additive: pad rows cannot raise health flags or poison
-                # a batch (they are zeros), so both riders pass through the
+                # the sentinel bitmask, the quarantine counter, and the
+                # compensation residual are not row-additive: pad rows cannot
+                # raise health flags, poison a batch, or carry rounding error
+                # (they are zeros), so the riders pass through the
                 # pad-subtract identity untouched
                 if any(
-                    getattr(p, "key", None) in (_sentinel.STATE_KEY, _txn.STATE_KEY) for p in path
+                    getattr(p, "key", None)
+                    in (_sentinel.STATE_KEY, _txn.STATE_KEY, _numerics.STATE_KEY)
+                    for p in path
                 ):
                     return o
                 return o - u * n_pad.astype(o.dtype)
 
             result = jax.tree_util.tree_map_with_path(subtract, out, unit)
+            if comp is not None:
+                result = comp(state, result, flat)
             return txn(state, result, flat) if txn is not None else result
 
     else:
 
         def step(state, *flat):
             result = run(state, flat)
+            if comp is not None:
+                result = comp(state, result, flat)
             return txn(state, result, flat) if txn is not None else result
 
     donate = config.donation_enabled()
     return jax.jit(step, donate_argnums=(0,) if donate else ()), donate
+
+
+def state_signature(state: Dict[str, Any]) -> Tuple:
+    """Shape/dtype cache key over a state dict whose riders may nest one level.
+
+    The compensation residual (``numerics.STATE_KEY``) is a dict of arrays —
+    its signature entry nests the per-state (name, shape, dtype) triples so a
+    residual joining/leaving (or a compensated state reshaping) keys a fresh
+    compile exactly like any other state change.
+    """
+    return tuple(
+        (k, tuple(sorted((n, tuple(x.shape), x.dtype) for n, x in v.items())))
+        if isinstance(v, dict)
+        else (k, tuple(v.shape), v.dtype)
+        for k, v in state.items()
+    )
 
 
 def input_signature(inputs: Sequence[Any]) -> Optional[Tuple]:
@@ -413,8 +457,12 @@ class CompiledUpdate:
         # admission prelude + transactional select lower into the same graph
         if _txn.quarantine_enabled():
             state[_txn.STATE_KEY] = _txn.ensure_count(m)
+        # opt-in compensated accumulation: the residual dict joins the pytree
+        # so the two-sum recomposition lowers into the same donated graph
+        if _numerics.compensation_active(m):
+            state[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
 
-        state_sig = tuple((k, tuple(v.shape), v.dtype) for k, v in state.items())
+        state_sig = state_signature(state)
         key = (bucketed, len(args), kw_names, state_sig, in_sig, self._device_token(state))
 
         entry = self._cache.get(key)
@@ -523,8 +571,17 @@ class CompiledUpdate:
         quarantine_out = out.pop(_txn.STATE_KEY, None)
         if quarantine_out is not None:
             setattr(m, _txn.ATTR, quarantine_out)
+        residual_out = out.pop(_numerics.STATE_KEY, None)
+        if residual_out is not None:
+            setattr(m, _numerics.ATTR, residual_out)
+            st.compensated_steps += 1
         for k, v in out.items():
             setattr(m, k, v)
+        if profiling and not first:
+            # sampled precision-drift audit: every Nth warm dispatch reads the
+            # (value, residual) pair at the sanctioned boundary — unsampled
+            # steps stay byte-identical (the probe only reads)
+            _numerics.maybe_drift_probe(m, st)
         return True
 
     # ------------------------------------------------------------------ ladder
@@ -620,11 +677,25 @@ class CompiledUpdate:
         m = self._metric
         owner = self.stats.owner
         quarantined = _txn.quarantine_enabled()
+        comp_names = (
+            _numerics.comp_state_names(m) if _numerics.compensation_active(m) else ()
+        )
 
         def run(state, flat):
+            import jax.numpy as jnp
+
             state = dict(state)
             sentinel = state.pop(_sentinel.STATE_KEY, None)
             qcount = state.pop(_txn.STATE_KEY, None)
+            residuals = state.pop(_numerics.STATE_KEY, None)
+            if residuals is not None:
+                # compensated states enter the update body ZEROED: the body
+                # then leaves the pure batch contribution behind, and the
+                # two-sum recomposition in make_step folds it into the
+                # preserved old value with the exact error term
+                state = {
+                    k: jnp.zeros_like(v) if k in comp_names else v for k, v in state.items()
+                }
             call_args = tuple(flat[:n_args])
             call_kwargs = dict(zip(kw_names, flat[n_args:]))
             # named_scope is trace-time only: the HLO ops of this update body
@@ -635,22 +706,33 @@ class CompiledUpdate:
                 # with the quarantine transaction active the health checks fold
                 # over the SELECTED (post-transaction) states instead — a
                 # quarantined NaN input must not raise the nan bit on a state
-                # that stayed clean
+                # that stayed clean; under compensation the body only saw
+                # ZEROED copies, so the fold moves into the recomposition
+                # (build_compensation) where the real accumulators exist
                 out[_sentinel.STATE_KEY] = (
-                    sentinel if quarantined else _sentinel.update_flags(sentinel, out, m)
+                    sentinel
+                    if quarantined or residuals is not None
+                    else _sentinel.update_flags(sentinel, out, m)
                 )
             if qcount is not None:
                 out[_txn.STATE_KEY] = qcount
+            if residuals is not None:
+                out[_numerics.STATE_KEY] = residuals  # passthrough; folded in make_step
             return out
 
+        admission = _txn.build_admission(m, inputs) if quarantined else None
         step_txn = None
         if quarantined:
-            admission = _txn.build_admission(m, inputs)
 
             def step_txn(old_state, result, flat):
                 return _txn.transact(m, old_state, result, admission(flat))
 
-        fn, donate = make_step(run, bucketed, inputs, txn=step_txn)
+        step_comp = (
+            _numerics.build_compensation(m, comp_names, admission=admission)
+            if comp_names
+            else None
+        )
+        fn, donate = make_step(run, bucketed, inputs, txn=step_txn, comp=step_comp)
         # ahead-of-time compile: same single trace+compile as the lazy first
         # dispatch, but the Compiled handle feeds the diag cost/memory ledger
         example = (example_state, np.int32(n_pad), *inputs) if bucketed else (example_state, *inputs)

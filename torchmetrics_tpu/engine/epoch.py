@@ -328,8 +328,14 @@ def _exchange_once(
 
 
 def _write_synced(metric: Any, states: Dict[str, Any], plan: PackedSyncPlan, owner: str) -> None:
+    from torchmetrics_tpu.engine import numerics as _numerics
+
     for attr, val in states.items():
-        setattr(metric, attr, val)
+        if attr.startswith(_numerics.SYNC_RES_PREFIX):
+            # the two-sum fold's post-anchor residual for a compensated state
+            _numerics.set_residual(metric, attr[len(_numerics.SYNC_RES_PREFIX):], val)
+        else:
+            setattr(metric, attr, val)
     for attr in plan.none_folded_attrs(owner):
         metric._none_folded.add(attr)
 

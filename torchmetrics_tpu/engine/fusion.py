@@ -31,6 +31,7 @@ from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine import numerics as _numerics
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
@@ -41,6 +42,7 @@ from torchmetrics_tpu.engine.compiled import (
     input_signature,
     make_step,
     shield_state,
+    state_signature,
     traced_update,
 )
 from torchmetrics_tpu.engine.stats import EngineStats
@@ -67,17 +69,26 @@ class FusedUpdate:
 
         The fused treedef covers member names AND each member's state names —
         a member joining/leaving the fusable set reads as ``treedef-change``.
+        Nested rider entries (the compensation residual: ``(key, ((sub, shape,
+        dtype), ...))``) flatten into the same aspect tuples.
         """
+        names, dtypes, shapes = [], [], []
+        for name, sig in state_sig:
+            member_names = []
+            for entry in sig:
+                if len(entry) == 2:  # nested rider
+                    member_names.append((entry[0], tuple(n for n, _, _ in entry[1])))
+                    dtypes.extend(d for _, _, d in entry[1])
+                    shapes.extend(s for _, s, _ in entry[1])
+                else:
+                    member_names.append(entry[0])
+                    shapes.append(entry[1])
+                    dtypes.append(entry[2])
+            names.append((name, tuple(member_names)))
         return {
-            "treedef": tuple((name, tuple(k for k, _, _ in sig)) for name, sig in state_sig),
-            "dtype": (
-                tuple(d for _, sig in state_sig for _, _, d in sig),
-                tuple(d for _, d in in_sig),
-            ),
-            "shape": (
-                tuple(s for _, sig in state_sig for _, s, _ in sig),
-                tuple(s for s, _ in in_sig),
-            ),
+            "treedef": tuple(names),
+            "dtype": (tuple(dtypes), tuple(d for _, d in in_sig)),
+            "shape": (tuple(shapes), tuple(s for s, _ in in_sig)),
             "bucket": bucket,
         }
 
@@ -120,6 +131,8 @@ class FusedUpdate:
                     mstate[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
                 if _txn.quarantine_enabled():
                     mstate[_txn.STATE_KEY] = _txn.ensure_count(m)
+                if _numerics.compensation_active(m):
+                    mstate[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
                 members.append((name, m))
                 states[name] = mstate
         if len(members) < 2:
@@ -143,10 +156,7 @@ class FusedUpdate:
 
         # dtype OBJECTS, not str(dtype): numpy re-derives the name string on
         # every call (no caching) and the warm loop builds this key per step
-        state_sig = tuple(
-            (name, tuple((k, tuple(v.shape), v.dtype) for k, v in states[name].items()))
-            for name, _ in members
-        )
+        state_sig = tuple((name, state_signature(states[name])) for name, _ in members)
         key = (bucketed, state_sig, in_sig)
         entry = self._cache.get(key)
         if entry is _FALLBACK:
@@ -254,12 +264,21 @@ class FusedUpdate:
             quarantine_out = out[name].pop(_txn.STATE_KEY, None)
             if quarantine_out is not None:
                 setattr(m, _txn.ATTR, quarantine_out)
+            residual_out = out[name].pop(_numerics.STATE_KEY, None)
+            if residual_out is not None:
+                setattr(m, _numerics.ATTR, residual_out)
+                st.compensated_steps += 1
             for k, v in out[name].items():
                 setattr(m, k, v)
             # the wrapped-update bookkeeping the eager path would have done
             m._computed = None
             m._update_count += 1
             handled.add(name)
+            if profiling and not first and residual_out is not None:
+                # sampled drift audit per compensated member (sanctioned read);
+                # the member-qualified owner keeps each member on its own
+                # probe cadence despite the shared fused stats block
+                _numerics.maybe_drift_probe(m, st, owner=f"{st.owner}:{name}")
         return handled
 
     def _compile(
@@ -291,32 +310,55 @@ class FusedUpdate:
             return None
 
         quarantined = _txn.quarantine_enabled()
+        comp_names = {
+            name: _numerics.comp_state_names(m)
+            for name, m in fusable
+            if _numerics.compensation_active(m)
+        }
 
         def run_all(fused_states, flat):
+            import jax.numpy as jnp
+
             out = {}
             for name, m in fusable:
                 mstate = dict(fused_states[name])
                 sentinel = mstate.pop(_sentinel.STATE_KEY, None)
                 qcount = mstate.pop(_txn.STATE_KEY, None)
+                residuals = mstate.pop(_numerics.STATE_KEY, None)
+                if residuals is not None:
+                    # compensated states enter the body zeroed — the body
+                    # leaves the pure contribution, recomposed in make_step
+                    zero = comp_names.get(name, ())
+                    mstate = {
+                        k: jnp.zeros_like(v) if k in zero else v for k, v in mstate.items()
+                    }
                 # per-member named_scope: inside the ONE fused executable each
                 # member's ops still attribute to their own metric in profiles
                 with jax.named_scope(f"{name}:update"):
                     updated = traced_update(m, mstate, tuple(flat), {})
                 if sentinel is not None:
                     # under quarantine the health checks fold over the
-                    # per-member SELECTED states inside the transaction instead
+                    # per-member SELECTED states inside the transaction
+                    # instead; under compensation over the RECOMPOSED states
+                    # in build_compensation (the body saw zeroed copies)
                     updated[_sentinel.STATE_KEY] = (
-                        sentinel if quarantined else _sentinel.update_flags(sentinel, updated, m)
+                        sentinel
+                        if quarantined or residuals is not None
+                        else _sentinel.update_flags(sentinel, updated, m)
                     )
                 if qcount is not None:
                     updated[_txn.STATE_KEY] = qcount
+                if residuals is not None:
+                    updated[_numerics.STATE_KEY] = residuals
                 out[name] = updated
             return out
 
+        admissions = (
+            {name: _txn.build_admission(m, inputs) for name, m in fusable} if quarantined else {}
+        )
         step_txn = None
         if quarantined:
             # one admission plan per member: bounds (num_classes) are per-metric
-            admissions = {name: _txn.build_admission(m, inputs) for name, m in fusable}
 
             def step_txn(old_states, result, flat):
                 return {
@@ -324,17 +366,33 @@ class FusedUpdate:
                     for name, m in fusable
                 }
 
-        fn, donate = make_step(run_all, bucketed, inputs, txn=step_txn)
-        # AOT compile for the diag cost ledger (same single trace+compile)
+        step_comp = None
+        if comp_names:
+            comps = {
+                name: _numerics.build_compensation(m, comp_names[name], admission=admissions.get(name))
+                for name, m in fusable
+                if name in comp_names
+            }
+
+            def step_comp(old_states, result, flat):
+                return {
+                    name: comps[name](old_states[name], result[name], flat)
+                    if name in comps
+                    else result[name]
+                    for name in result
+                }
+
+        fn, donate = make_step(run_all, bucketed, inputs, txn=step_txn, comp=step_comp)
+        # AOT compile for the diag cost ledger (same single trace+compile).
+        # tree_leaves-based byte count: rider entries may nest (the residual dict)
         example_states = {name: states[name] for name, _ in fusable}
         example = (example_states, np.int32(0), *inputs) if bucketed else (example_states, *inputs)
-        donated = (
-            sum(v.nbytes for mstate in example_states.values() for v in mstate.values()) if donate else 0
+        state_bytes = sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(example_states)
         )
+        donated = state_bytes if donate else 0
         fn = _costs.aot_compile(fn, owner=self.stats.owner, kind="fused", args=example, donated_bytes=donated)
-        step_bytes = sum(
-            v.nbytes for mstate in example_states.values() for v in mstate.values()
-        ) + sum(getattr(a, "nbytes", 0) for a in inputs)
+        step_bytes = state_bytes + sum(getattr(a, "nbytes", 0) for a in inputs)
         return (
             fn,
             donate,

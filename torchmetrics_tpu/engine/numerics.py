@@ -1,0 +1,497 @@
+"""Long-horizon numerical resilience — compensated in-graph accumulation,
+overflow-safe count widening, and the sampled precision-drift audit.
+
+The fused engine compiles whole epochs into donated float32 graphs, so
+accumulation error is silent and unbounded: after ~10⁷ updates a float32 sum
+absorbs increments entirely (``|inc| < ulp(acc)`` makes the update a no-op),
+int32 counts overflow, and mean folds drift. This module defends the
+*correctness of a healthy accumulator over time* — the robustness gap between
+an epoch-scale engine and an unbounded serving stream — with four pieces:
+
+- **Compensated accumulation** (``TORCHMETRICS_TPU_COMPENSATED=1`` /
+  :func:`compensated_context`): eligible float states with
+  ``dist_reduce_fx="sum"|"mean"`` accumulate through a Kahan–Babuška–Neumaier
+  two-sum compiled INTO the donated update graph. The update body runs on a
+  ZEROED copy of each compensated state, so it returns the pure batch
+  contribution; the recomposition ``value, err = two_sum(value, contribution
+  + residual)`` then folds the running residual back into every increment
+  (the feedback form — the residual stays sub-ulp of the accumulator, so
+  error growth is O(ε) instead of O(N·ε); Knuth's branch-free two-sum keeps
+  the error term exact regardless of magnitudes). The residual rides the
+  state pytree under the reserved :data:`STATE_KEY` — pad-subtract-exempt
+  like ``__sentinel__``/``__quarantine__`` — and lives on the metric as the
+  :data:`ATTR` dict between steps. Zero host transfers, zero warm retraces:
+  the whole transform is a handful of fused adds in the same executable.
+- **Absorption detection**: when an update's entire nonzero contribution fails
+  to move the accumulator (``fl(acc + inc) == acc``), the new sticky
+  ``precision_loss`` sentinel bit (``diag/sentinel.py``, 0x40) is raised
+  in-graph and ORed cross-rank by the existing sentinel spec. Under
+  compensation the increment is *preserved* in the residual rather than lost
+  — the bit says "a naive accumulator would be silently wrong from here on".
+- **Sampled drift audit**: with profiling active (the PR-5 ``every_n`` probe
+  machinery), every Nth *warm* dispatch reads the (value, residual) pair at
+  the sanctioned ``drift-probe`` boundary and folds it into a float64
+  reference on the host — the relative drift of the naive float32 value from
+  that reference lands in the ``diag/hist.py`` registry (``drift_ppb``
+  series, parts-per-billion so the log buckets resolve 1e-9..1e-2) and a
+  drift past ``TORCHMETRICS_TPU_DRIFT_RTOL`` records a ``numerics.drift``
+  event + ``EngineStats.drift_flags``. Unsampled steps are byte-identical to
+  an unaudited run (the probe only reads).
+- **Periodic re-anchoring**: :func:`reanchor` folds (value, residual) into a
+  clean anchor — called at every ``compute()`` epoch boundary, inside the
+  packed-sync two-sum fold (``parallel/packing.py``), and on-the-fly by
+  ``state_dict`` so snapshots persist the anchored total (restore then
+  starts with a zero residual; see ``parallel/elastic.py``).
+
+Overflow-safe widening: :func:`count_dtype` resolves the dtype device-side
+counters accumulate in — int64 when the x64 flag is up (the promotion happens
+at creation, so retrace attribution never sees a mid-stream dtype flip; under
+x64 *warmup* the attribution reads dtype-change exactly once, as PR 3 pinned),
+int32 otherwise (where the ``overflow_suspect`` sentinel bit is the guard).
+Host-side counts (``Metric._update_count``) are Python ints — arbitrary
+precision — and :func:`py_count` coerces numpy scalars back to that before any
+additive fold so a ``np.int32`` count can never wrap silently.
+
+Enable the same compensation mode on EVERY rank of a world: the residual
+joins the packed sync's reduce buffers (a paired spec per compensated state,
+folded by two-sum — not naive add), so asymmetric enablement desynchronizes
+the buffer layout — the same rule the sentinel, audit, and quarantine knobs
+already document, enforced by the plan-signature/layout checks.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import profile as _profile
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.utilities.data import dim_zero_mean, dim_zero_sum
+
+__all__ = [
+    "ATTR",
+    "COMPENSATED_ENV_VAR",
+    "DRIFT_RTOL_ENV_VAR",
+    "STATE_KEY",
+    "SYNC_RES_PREFIX",
+    "anchored_value",
+    "build_compensation",
+    "comp_state_names",
+    "compensated_context",
+    "compensated_enabled",
+    "compensation_active",
+    "count_dtype",
+    "drift_rtol",
+    "eager_update",
+    "ensure_residuals",
+    "maybe_drift_probe",
+    "py_count",
+    "reanchor",
+    "set_compensated",
+    "set_drift_rtol",
+    "set_residual",
+    "two_sum",
+]
+
+COMPENSATED_ENV_VAR = "TORCHMETRICS_TPU_COMPENSATED"
+DRIFT_RTOL_ENV_VAR = "TORCHMETRICS_TPU_DRIFT_RTOL"
+
+#: reserved pytree key for the residual dict inside compiled step states
+STATE_KEY = "__compensation__"
+#: the attribute carrying the live residual dict ({state attr: residual array})
+ATTR = "_comp_residuals"
+#: packed-sync fold output keys carrying a state's post-fold residual
+SYNC_RES_PREFIX = "__comp_res__::"
+
+#: default relative-drift threshold for the sampled audit. Under healthy
+#: compensation the feedback form keeps the residual sub-ulp of the
+#: accumulator, so measured drift stays below ~2**-24 (≈6e-8): the default
+#: only fires on pathological states (merge-accumulated shard residue, a
+#: corrupt restore, operator-injected state) — tighten the knob to audit at
+#: the healthy sub-ulp scale
+DEFAULT_DRIFT_RTOL = 1e-5
+
+_enabled_override: Optional[bool] = None
+_rtol_override: Optional[float] = None
+
+
+# ------------------------------------------------------------------ policy
+
+
+def compensated_enabled() -> bool:
+    """Whether eligible updates accumulate through the compensated two-sum.
+
+    Unrecognized env values fail loud (the PR-7 ``TORCHMETRICS_TPU_QUARANTINE``
+    contract): a typo must not silently disable the protection it was set to
+    enable.
+    """
+    if _enabled_override is not None:
+        return _enabled_override
+    raw = os.environ.get(COMPENSATED_ENV_VAR, "").strip().lower()
+    if raw in ("", "0", "off"):
+        return False
+    if raw in ("1", "on"):
+        return True
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    raise TorchMetricsUserError(
+        f"{COMPENSATED_ENV_VAR} must be '0'/'off' or '1'/'on' (got {raw!r})"
+    )
+
+
+def set_compensated(value: Optional[bool]) -> None:
+    """Force compensation on/off process-wide; ``None`` restores env/default."""
+    global _enabled_override
+    _enabled_override = value
+
+
+@contextmanager
+def compensated_context(enabled: bool = True) -> Generator[None, None, None]:
+    """Scoped compensation enablement (tests, benches). Toggling mid-stream
+    retraces the affected signatures once (the residual rider is a
+    ``treedef-change``); enable on EVERY rank of a world or none."""
+    global _enabled_override
+    prev = _enabled_override
+    _enabled_override = enabled
+    try:
+        yield
+    finally:
+        _enabled_override = prev
+
+
+def drift_rtol() -> float:
+    """The relative-drift threshold past which the sampled audit flags.
+
+    An unparseable env value fails loud instead of silently reverting to the
+    default — the same contract as ``TORCHMETRICS_TPU_SNAPSHOT_EVERY``.
+    """
+    if _rtol_override is not None:
+        return _rtol_override
+    raw = os.environ.get(DRIFT_RTOL_ENV_VAR, "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+            raise TorchMetricsUserError(
+                f"{DRIFT_RTOL_ENV_VAR} must be a float (got {raw!r})"
+            ) from None
+    return DEFAULT_DRIFT_RTOL
+
+
+def set_drift_rtol(value: Optional[float]) -> None:
+    """Override the drift threshold; ``None`` restores env/default."""
+    global _rtol_override
+    _rtol_override = None if value is None else float(value)
+
+
+# ------------------------------------------------------------------ widening
+
+
+def count_dtype() -> Any:
+    """The dtype device-side counters accumulate in: int64 under x64, else int32.
+
+    Resolved at counter CREATION, so a process never flips a live counter's
+    dtype mid-stream (which would read as an unattributed retrace); under the
+    x64 flag the engine's retrace attribution sees the promotion exactly once,
+    at the first post-enable compile (``dtype-change``, the PR-3 contract).
+    Without x64 int64 does not exist on device — int32 stays, guarded by the
+    ``overflow_suspect`` sentinel bit at half-range.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def py_count(value: Any) -> int:
+    """Coerce a count to a Python int (arbitrary precision) before folding.
+
+    ``Metric._update_count`` is host-side state; wrappers and checkpoints
+    occasionally hand it back as a numpy scalar, and ``np.int32 + np.int32``
+    WRAPS silently near 2**31 — the exact overflow ``merge_state``'s additive
+    fold must survive. One ``int()`` at every fold boundary removes the class.
+    """
+    return int(value)
+
+
+# ------------------------------------------------------------------ two-sum
+
+
+def two_sum(a: Any, b: Any) -> Tuple[Any, Any]:
+    """Knuth's branch-free two-sum: ``s = fl(a + b)`` and the EXACT error term.
+
+    Unlike the fast (Dekker) variant this needs no magnitude branch, so it
+    lowers to six fused adds inside the update graph — valid for any (a, b).
+    """
+    s = a + b
+    bp = s - a
+    ap = s - bp
+    return s, (a - ap) + (b - bp)
+
+
+def anchored_value(value: Any, residual: Any) -> Any:
+    """The re-anchored accumulator: ``fl(value + residual)`` (read-only fold)."""
+    return two_sum(value, residual)[0]
+
+
+# ------------------------------------------------------------------ eligibility
+
+
+def comp_state_names(metric: Any) -> Tuple[str, ...]:
+    """The states of ``metric`` the compensated two-sum applies to.
+
+    Eligibility is a pure function of the metric DEFINITION (class flags,
+    registered defaults) — never of live values — so every rank of a world
+    resolves the same set and the packed buffer layout stays symmetric:
+
+    - the metric declares additivity-in-state (``_engine_state_additive`` on
+      the scalar aggregators, or the bucketing family's
+      ``_engine_row_additive``) — the zero-state trick that recovers the pure
+      batch contribution is only exact for ``new = old + g(batch)`` updates;
+    - the state's ``dist_reduce_fx`` is ``sum`` or ``mean``;
+    - the registered default is a float array (integer counts widen via
+      :func:`count_dtype` instead; there is no residual to track exactly).
+    """
+    if not (
+        getattr(metric, "_engine_state_additive", False)
+        or getattr(metric, "_engine_row_additive", False)
+    ):
+        return ()
+    import jax.numpy as jnp
+
+    names = []
+    for attr, red in getattr(metric, "_reductions", {}).items():
+        if red not in (dim_zero_sum, dim_zero_mean):
+            continue
+        default = metric._defaults[attr]
+        if isinstance(default, list):
+            continue
+        if jnp.issubdtype(default.dtype, jnp.floating):
+            names.append(attr)
+    return tuple(names)
+
+
+def compensation_active(metric: Any) -> bool:
+    """Whether this metric's updates ride the compensated path right now."""
+    return compensated_enabled() and bool(comp_state_names(metric))
+
+
+def ensure_residuals(metric: Any) -> Dict[str, Any]:
+    """The metric's residual dict, created (zeros) on first use."""
+    res = metric.__dict__.get(ATTR)
+    if res is None:
+        import jax.numpy as jnp
+
+        res = {k: jnp.zeros_like(getattr(metric, k)) for k in comp_state_names(metric)}
+        setattr(metric, ATTR, res)
+    return res
+
+
+def set_residual(metric: Any, attr: str, value: Any) -> None:
+    """Install one state's residual (packed-sync fold output path)."""
+    res = dict(metric.__dict__.get(ATTR) or {})
+    res[attr] = value
+    setattr(metric, ATTR, res)
+
+
+# ------------------------------------------------------------------ the in-graph transform
+
+
+def build_compensation(
+    metric: Any,
+    names: Sequence[str],
+    admission: Optional[Callable[[Sequence[Any]], Any]] = None,
+) -> Callable[[Dict[str, Any], Dict[str, Any], Sequence[Any]], Dict[str, Any]]:
+    """The jittable ``(old_state, result, flat) -> result`` recomposition.
+
+    ``result``'s compensated entries hold the pure batch CONTRIBUTION (the
+    update body ran on zeroed copies of those states; pad-subtract has already
+    removed pad rows from the contribution, never from the preserved old
+    value). The transform folds ``contribution + residual`` into the old value
+    via :func:`two_sum` and carries the exact error as the new residual.
+
+    Sentinel rider interplay: the run body SKIPPED its health fold (it only
+    saw zeroed copies of the compensated states), so — without quarantine —
+    the NaN/Inf/overflow checks fold here over the RECOMPOSED final states;
+    with the quarantine ``admission`` present the transaction folds them over
+    the SELECTED states instead (the PR-7 contract). The sticky
+    ``precision_loss`` bit is raised when any nonzero contribution failed to
+    move its accumulator, masked by ``admission`` so a poisoned batch's
+    absorbed garbage cannot stick a health bit the transaction is about to
+    roll back.
+    """
+    from torchmetrics_tpu.diag import sentinel as _sentinel
+    from torchmetrics_tpu.engine import txn as _txn
+
+    names = tuple(names)
+
+    def comp(old: Dict[str, Any], result: Dict[str, Any], flat: Sequence[Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        residual = old[STATE_KEY]
+        out = dict(result)
+        new_res = dict(residual)
+        absorbed = jnp.asarray(False)
+        for k in names:
+            a = old[k]
+            b = result[k]  # pure batch contribution
+            s, err = two_sum(a, b + residual[k])
+            out[k] = s
+            new_res[k] = err
+            absorbed = absorbed | ((b != 0) & (s == a)).any()
+        out[STATE_KEY] = new_res
+        if _sentinel.STATE_KEY in out:
+            flags = out[_sentinel.STATE_KEY]
+            if admission is not None:
+                absorbed = absorbed & ~admission(flat)
+            else:
+                final = {
+                    k: v
+                    for k, v in out.items()
+                    if k not in (STATE_KEY, _sentinel.STATE_KEY, _txn.STATE_KEY)
+                }
+                flags = _sentinel.update_flags(flags, final, metric)
+            out[_sentinel.STATE_KEY] = flags | jnp.where(
+                absorbed, jnp.int32(_sentinel.FLAG_PRECISION_LOSS), jnp.int32(0)
+            )
+        return out
+
+    return comp
+
+
+# ------------------------------------------------------------------ eager parity
+
+
+def eager_update(metric: Any, run_update: Callable[[], None]) -> None:
+    """Compensated eager update — the engine-off parity path.
+
+    Same zero-state trick as the compiled transform: the compensated states
+    enter the raw update body zeroed, the body leaves the pure contribution
+    behind, and the two-sum recomposition (residual fed back into the
+    increment) runs as a handful of eager jnp ops — no host transfer, no
+    double execution of the body.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.diag import sentinel as _sentinel
+
+    names = comp_state_names(metric)
+    residual = ensure_residuals(metric)
+    old = {k: getattr(metric, k) for k in names}
+    for k in names:
+        setattr(metric, k, jnp.zeros_like(old[k]))
+    try:
+        run_update()
+    except BaseException:
+        for k, v in old.items():  # a failed/raising update must not leave zeroed state
+            setattr(metric, k, v)
+        raise
+    new_res = dict(residual)
+    absorbed = jnp.asarray(False)
+    for k in names:
+        b = getattr(metric, k)  # pure batch contribution
+        s, err = two_sum(old[k], b + residual[k])
+        setattr(metric, k, s)
+        new_res[k] = err
+        absorbed = absorbed | ((b != 0) & (s == old[k])).any()
+    setattr(metric, ATTR, new_res)
+    if _sentinel.sentinel_enabled():
+        flags = _sentinel.ensure_flags(metric)
+        setattr(
+            metric,
+            _sentinel.ATTR,
+            flags
+            | jnp.where(absorbed, jnp.int32(_sentinel.FLAG_PRECISION_LOSS), jnp.int32(0)),
+        )
+    _stats_for(metric).compensated_steps += 1
+
+
+def _stats_for(metric: Any):
+    from torchmetrics_tpu.engine import txn as _txn
+
+    return _txn._stats_for(metric)
+
+
+# ------------------------------------------------------------------ re-anchoring
+
+
+def reanchor(metric: Any) -> bool:
+    """Fold (value, residual) into a clean anchor — the epoch-boundary fold.
+
+    Pure device ops (no host read): each compensated value absorbs its
+    residual through one two-sum, and the residual keeps only the sub-ulp
+    remainder, so error growth over an unbounded stream restarts from a clean
+    anchor at every epoch. Returns True when something was folded.
+    """
+    res = metric.__dict__.get(ATTR)
+    if not res:
+        return False
+    new_res = {}
+    for k, r in res.items():
+        v = getattr(metric, k, None)
+        if v is None or getattr(v, "shape", None) != getattr(r, "shape", None):
+            new_res[k] = r  # state moved under the residual (e.g. mid-restore)
+            continue
+        s, rem = two_sum(v, r)
+        setattr(metric, k, s)
+        new_res[k] = rem
+    setattr(metric, ATTR, new_res)
+    _stats_for(metric).reanchors += 1
+    _diag.record("numerics.reanchor", type(metric).__name__, states=len(new_res))
+    return True
+
+
+# ------------------------------------------------------------------ drift audit
+
+
+def maybe_drift_probe(metric: Any, stats: Any, owner: Optional[str] = None) -> Optional[float]:
+    """Sampled precision-drift audit — every Nth warm dispatch, sanctioned.
+
+    Reuses the PR-5 probe machinery (:func:`~torchmetrics_tpu.diag.profile.
+    probe_due` under an active profile scope) and its boundary rules: the
+    (value, residual) pair is read ONLY inside ``transfer_allowed("drift-
+    probe")``, folded into a float64 reference on the host, and the worst
+    relative drift of the naive value from that reference is recorded into the
+    ``drift_ppb`` histogram series (parts-per-billion keeps 1e-9..1e-2 drifts
+    inside the log-bucket range). Drift past :func:`drift_rtol` is a counted
+    ``numerics.drift`` event. Unsampled steps are untouched — byte-for-byte.
+    """
+    res = metric.__dict__.get(ATTR)
+    if not res:
+        return None
+    # ``owner`` distinguishes fused members sharing one stats block: each
+    # compensated member needs its OWN probe cadence, or the shared counter
+    # advances M times per step and the sample lands on the same member forever
+    owner = owner or stats.owner
+    if not _profile.probe_due(owner, "drift"):
+        return None
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    worst = 0.0
+    with transfer_allowed("drift-probe"):
+        for k, r in res.items():
+            value = np.asarray(getattr(metric, k), dtype=np.float64)
+            reference = value + np.asarray(r, dtype=np.float64)
+            denom = np.maximum(np.abs(reference), np.finfo(np.float64).tiny)
+            rel = float(np.max(np.abs(reference - value) / denom)) if value.size else 0.0
+            if np.isnan(rel):
+                # a NaN in (value, residual) is the corrupt-restore pathology
+                # this audit exists to catch — infinite drift, never "0.0"
+                # (max(0.0, nan) would silently keep the healthy reading)
+                rel = float("inf")
+            worst = max(worst, rel)
+    stats.drift_probes += 1
+    _hist.observe(owner, "update", "drift_ppb", worst * 1e9)
+    rtol = drift_rtol()
+    if worst > rtol:
+        stats.drift_flags += 1
+        _diag.record(
+            "numerics.drift", owner, rel=round(worst, 12), rtol=rtol,
+        )
+    return worst

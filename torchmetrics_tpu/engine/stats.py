@@ -33,6 +33,11 @@ _COUNTER_FIELDS = (
     # --- transactional layer (engine/txn.py): quarantine + fallback ladder ---
     "quarantined_batches",  # poisoned batches skipped in-graph (filled at the sanctioned read)
     "ladder_retries",  # dispatch failures that stepped down to a smaller bucket
+    # --- numerics layer (engine/numerics.py): compensated accumulation + drift audit ---
+    "compensated_steps",  # updates whose accumulate rode the in-graph two-sum
+    "reanchors",  # epoch-boundary (value, residual) folds into a clean anchor
+    "drift_probes",  # sampled drift-audit reads at the sanctioned boundary
+    "drift_flags",  # probes whose relative drift exceeded TORCHMETRICS_TPU_DRIFT_RTOL
     # --- epoch engine (engine/epoch.py): packed sync + cached compute ---
     "packed_syncs",  # packed epoch syncs completed (vs eager per-tensor syncs)
     "sync_collectives",  # buffer collectives issued across all packed syncs

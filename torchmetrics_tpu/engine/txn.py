@@ -239,12 +239,15 @@ def transact(metric: Any, old: Dict[str, Any], new: Dict[str, Any], poisoned: An
     """The in-graph state transaction (jittable, runs inside the compiled step).
 
     Every non-rider state leaf is selected against its pre-update value via
-    ``jnp.where(poisoned, old, new)``; the ``__quarantine__`` counter
-    increments by the flag; with the sentinel rider present, its health checks
-    fold over the SELECTED (final) states — a quarantined batch therefore
-    raises only the ``input_poisoned`` bit while ``nan``/``inf`` stay clear,
-    because the state genuinely stays clean.
+    ``jnp.where(poisoned, old, new)`` — including the compensation residual
+    dict (``engine/numerics.py``), whose entries roll back leaf-wise so a
+    quarantined batch leaves (value, residual) pairs bit-exact; the
+    ``__quarantine__`` counter increments by the flag; with the sentinel rider
+    present, its health checks fold over the SELECTED (final) states — a
+    quarantined batch therefore raises only the ``input_poisoned`` bit while
+    ``nan``/``inf`` stay clear, because the state genuinely stays clean.
     """
+    import jax
     import jax.numpy as jnp
 
     from torchmetrics_tpu.diag import sentinel as _sentinel
@@ -254,11 +257,11 @@ def transact(metric: Any, old: Dict[str, Any], new: Dict[str, Any], poisoned: An
     for k, v in new.items():
         if k in (STATE_KEY, _sentinel.STATE_KEY):
             continue
-        sel = jnp.where(poisoned, old[k], v)
+        sel = jax.tree_util.tree_map(lambda o, n: jnp.where(poisoned, o, n), old[k], v)
         out[k] = sel
         selected[k] = sel
     if STATE_KEY in new:
-        out[STATE_KEY] = old[STATE_KEY] + poisoned.astype(jnp.int32)
+        out[STATE_KEY] = old[STATE_KEY] + poisoned.astype(old[STATE_KEY].dtype)
     if _sentinel.STATE_KEY in new:
         flags = _sentinel.update_flags(new[_sentinel.STATE_KEY], selected, metric)
         out[_sentinel.STATE_KEY] = flags | jnp.where(
@@ -311,6 +314,7 @@ def eager_update(metric: Any, run_update: Callable[[], None], args: Sequence[Any
     import jax.numpy as jnp
 
     from torchmetrics_tpu.diag import sentinel as _sentinel
+    from torchmetrics_tpu.engine import numerics as _numerics
 
     inputs = _flat_inputs(args, kwargs)
     admission = build_admission(metric, inputs)
@@ -318,6 +322,11 @@ def eager_update(metric: Any, run_update: Callable[[], None], args: Sequence[Any
     for k in metric._defaults:
         v = getattr(metric, k)
         old[k] = list(v) if isinstance(v, list) else v
+    # the compensation residual rolls back with the states: a quarantined
+    # batch must leave (value, residual) pairs bit-exact. Absent-before reads
+    # as zeros — exactly the residual a pre-update metric carries.
+    had_res = _numerics.ATTR in metric.__dict__
+    old_res = dict(metric.__dict__.get(_numerics.ATTR) or {})
     poisoned = admission(inputs)
     run_update()
 
@@ -340,7 +349,17 @@ def eager_update(metric: Any, run_update: Callable[[], None], args: Sequence[Any
     if selectable:
         for k, o in old.items():
             setattr(metric, k, jnp.where(poisoned, o, getattr(metric, k)))
-        setattr(metric, ATTR, count + poisoned.astype(jnp.int32))
+        new_res = metric.__dict__.get(_numerics.ATTR)
+        if new_res is not None:
+            setattr(
+                metric,
+                _numerics.ATTR,
+                {
+                    k: jnp.where(poisoned, old_res.get(k, jnp.zeros_like(v)), v)
+                    for k, v in new_res.items()
+                },
+            )
+        setattr(metric, ATTR, count + poisoned.astype(count.dtype))
         if _sentinel.sentinel_enabled():
             flags = _sentinel.ensure_flags(metric)
             setattr(
@@ -356,7 +375,11 @@ def eager_update(metric: Any, run_update: Callable[[], None], args: Sequence[Any
     if bad:
         for k, o in old.items():
             setattr(metric, k, o)
-        setattr(metric, ATTR, count + jnp.int32(1))
+        if had_res:
+            setattr(metric, _numerics.ATTR, old_res)
+        elif _numerics.ATTR in metric.__dict__:
+            del metric.__dict__[_numerics.ATTR]
+        setattr(metric, ATTR, count + jnp.asarray(1, count.dtype))
         if _sentinel.sentinel_enabled():
             flags = _sentinel.ensure_flags(metric)
             setattr(metric, _sentinel.ATTR, flags | jnp.int32(_sentinel.FLAG_INPUT_POISONED))
@@ -440,12 +463,19 @@ def classify_dispatch_error(exc: BaseException) -> Optional[str]:
 
 
 def ensure_count(metric: Any) -> Any:
-    """The metric's device quarantine counter, created (zero) on first use."""
+    """The metric's device quarantine counter, created (zero) on first use.
+
+    Accumulates in :func:`~torchmetrics_tpu.engine.numerics.count_dtype` —
+    int64 under the x64 flag, int32 otherwise — resolved at creation so the
+    dtype never flips mid-stream (overflow-safe widening, ISSUE 8).
+    """
     val = getattr(metric, ATTR, None)
     if val is None:
         import jax.numpy as jnp
 
-        val = jnp.zeros((), jnp.int32)
+        from torchmetrics_tpu.engine import numerics as _numerics
+
+        val = jnp.zeros((), _numerics.count_dtype())
         setattr(metric, ATTR, val)
         metric._quarantine_reported = 0
     _REGISTRY[id(metric)] = metric
@@ -538,7 +568,8 @@ def reset_quarantine() -> None:
     import jax.numpy as jnp
 
     for metric in list(_REGISTRY.values()):
-        if getattr(metric, ATTR, None) is not None:
-            setattr(metric, ATTR, jnp.zeros((), jnp.int32))
+        val = getattr(metric, ATTR, None)
+        if val is not None:
+            setattr(metric, ATTR, jnp.zeros_like(val))  # dtype-preserving (x64 widening)
             metric._quarantine_reported = 0
     _REGISTRY.clear()

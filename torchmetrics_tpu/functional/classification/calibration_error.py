@@ -19,6 +19,7 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _multiclass_confusion_matrix_tensor_validation,
 )
 from torchmetrics_tpu.functional.classification.stat_scores import _is_floating
+from torchmetrics_tpu.utilities.compute import _safe_divide
 from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
 
 Array = jax.Array
@@ -36,7 +37,8 @@ def _binning_bucketize(
     acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies)
     conf_bin = jnp.nan_to_num(conf_bin / count_bin)
     acc_bin = jnp.nan_to_num(acc_bin / count_bin)
-    prop_bin = count_bin / count_bin.sum()
+    # zero observed samples: every bin proportion is the documented zero, not 0/0
+    prop_bin = _safe_divide(count_bin, count_bin.sum())
     return acc_bin, conf_bin, prop_bin
 
 

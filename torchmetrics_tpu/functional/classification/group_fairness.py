@@ -67,9 +67,15 @@ def _binary_groups_stat_scores(
 def _groups_reduce(
     group_stats: List[Tuple[Array, Array, Array, Array]]
 ) -> Dict[str, Array]:
-    """Rates per group (reference ``group_fairness.py:84-88``)."""
+    """Rates per group (reference ``group_fairness.py:84-88``).
+
+    A group with no observed samples has all-zero stats; its rates are the
+    documented zeros, not 0/0 NaN (which would poison every downstream
+    min/max-rate comparison).
+    """
     return {
-        f"group_{group}": jnp.stack(stats) / jnp.stack(stats).sum() for group, stats in enumerate(group_stats)
+        f"group_{group}": _safe_divide(jnp.stack(stats), jnp.stack(stats).sum())
+        for group, stats in enumerate(group_stats)
     }
 
 

@@ -20,6 +20,7 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _multilabel_confusion_matrix_tensor_validation,
 )
 from torchmetrics_tpu.functional.classification.stat_scores import _is_floating
+from torchmetrics_tpu.utilities.compute import _safe_divide
 
 Array = jax.Array
 
@@ -35,8 +36,13 @@ def _rank_data(x: Array) -> Array:
 
 
 def _ranking_reduce(score: Array, n_elements: Array) -> Array:
-    """Reference ``ranking.py:36-37``."""
-    return score / n_elements
+    """Reference ``ranking.py:36-37``.
+
+    ``n_elements`` is an accumulated sample count: a zero-count segment
+    (compute before any update reached this shard) yields the documented
+    zero, not 0/0 NaN.
+    """
+    return _safe_divide(score, n_elements)
 
 
 def _multilabel_ranking_tensor_validation(

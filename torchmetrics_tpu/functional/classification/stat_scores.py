@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_divide
 from torchmetrics_tpu.utilities.data import select_topk
 from torchmetrics_tpu.utilities.enums import ClassificationTask
 
@@ -352,9 +353,13 @@ def _multiclass_stat_scores_compute(
         return res.astype(jnp.float32).mean(axis=sum_axis)
     if average == "weighted":
         weight = (tp + fn).astype(jnp.float32)
+        # zero total support (every class empty — e.g. all targets ignore_index)
+        # must yield the documented zero score, not a NaN weight vector
         if multidim_average == "global":
-            return (res * (weight / weight.sum()).reshape(*weight.shape, 1)).sum(axis=sum_axis)
-        return (res * (weight / weight.sum(-1, keepdims=True)).reshape(*weight.shape, 1)).sum(axis=sum_axis)
+            return (res * _safe_divide(weight, weight.sum()).reshape(*weight.shape, 1)).sum(axis=sum_axis)
+        return (res * _safe_divide(weight, weight.sum(-1, keepdims=True)).reshape(*weight.shape, 1)).sum(
+            axis=sum_axis
+        )
     if average is None or average == "none":
         return res
     return None

@@ -334,6 +334,11 @@ class Metric:
         if "_quarantined_count" in self.__dict__:
             refs["_quarantined_count"] = self.__dict__["_quarantined_count"]
             refs["_quarantine_reported"] = self.__dict__.get("_quarantine_reported", 0)
+        # the compensation residual dict rides too: a packed sync two-sum-folds
+        # it cross-rank (parallel/packing.py), so unsync must restore the local
+        # (value, residual) pair or the next sync would re-fold a fold
+        if "_comp_residuals" in self.__dict__:
+            refs["_comp_residuals"] = dict(self.__dict__["_comp_residuals"])
         return refs
 
     def _restore_state_refs(self, cache: Dict[str, Any]) -> None:
@@ -363,26 +368,58 @@ class Metric:
         pipelines. Mean states are weighted by update counts (taken from the incoming
         metric, or ``incoming_count`` for raw dicts).
         """
+        from torchmetrics_tpu.engine import numerics as _numerics
+
         incoming_folded: Optional[frozenset] = None  # raw dicts: unknown -> ndim fallback
         if isinstance(incoming_state, Metric):
-            incoming_count = incoming_state._update_count
+            # host-side counts fold as Python ints (arbitrary precision): a
+            # numpy int32 count from a wrapper or checkpoint would WRAP
+            # silently near 2**31 under numpy arithmetic
+            incoming_count = _numerics.py_count(incoming_state._update_count)
             incoming_folded = frozenset(incoming_state._none_folded)
             incoming_quarantined = incoming_state.__dict__.get("_quarantined_count")
             incoming_q_reported = incoming_state.__dict__.get("_quarantine_reported", 0)
+            incoming_res = dict(incoming_state.__dict__.get("_comp_residuals") or {})
             incoming_state = {attr: getattr(incoming_state, attr) for attr in incoming_state._defaults}
         else:
+            incoming_count = _numerics.py_count(incoming_count)
             incoming_quarantined = incoming_state.get("_quarantined_count")
             incoming_q_reported = incoming_state.get("_quarantine_reported", 0)
-        self_count = self._update_count
+            incoming_res = dict(incoming_state.get("_comp_residuals") or {})
+        self_count = _numerics.py_count(self._update_count)
+        self_res = self.__dict__.get("_comp_residuals") or {}
+        merged_res: Dict[str, Any] = dict(self_res)
         for attr in self._defaults:
             self_state = getattr(self, attr)
             other_state = incoming_state[attr]
             reduce_fn = self._reductions[attr]
             if reduce_fn == dim_zero_sum:
-                reduced = self_state + other_state
+                if attr in self_res or attr in incoming_res:
+                    # compensated shards fold via two-sum: residuals add, the
+                    # values' exact fold error joins the merged residual
+                    reduced, err = _numerics.two_sum(self_state, other_state)
+                    merged_res[attr] = (
+                        self_res.get(attr, jnp.zeros_like(reduced))
+                        + incoming_res.get(attr, jnp.zeros_like(reduced))
+                        + err
+                    )
+                else:
+                    reduced = _safe_int_sum(self_state, other_state)
             elif reduce_fn == dim_zero_mean:
                 total = max(self_count + incoming_count, 1)
-                reduced = (self_count * self_state + incoming_count * other_state) / total
+                if attr in self_res or attr in incoming_res:
+                    # mean-reduced compensated state: residuals fold with the
+                    # SAME count weighting as the values — keeping the stale
+                    # local residual (or dropping the incoming one) would make
+                    # the next reanchor fold a correction belonging to a
+                    # different accumulator
+                    reduced = (self_count * self_state + incoming_count * other_state) / total
+                    merged_res[attr] = (
+                        self_count * self_res.get(attr, jnp.zeros_like(reduced))
+                        + incoming_count * incoming_res.get(attr, jnp.zeros_like(reduced))
+                    ) / total
+                else:
+                    reduced = (self_count * self_state + incoming_count * other_state) / total
             elif reduce_fn == dim_zero_max:
                 reduced = jnp.maximum(self_state, other_state)
             elif reduce_fn == dim_zero_min:
@@ -407,6 +444,8 @@ class Metric:
                 raise TypeError(f"Unsupported reduce_fn: {reduce_fn}")
             setattr(self, attr, reduced)
         self._update_count = self_count + incoming_count
+        if self_res or incoming_res:
+            self._comp_residuals = merged_res
         if incoming_quarantined is not None:
             from torchmetrics_tpu.engine import txn as _txn
 
@@ -454,15 +493,40 @@ class Metric:
 
     def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
         """Merge ``incoming_state`` (treated as global) with current (batch) state (reference ``metric.py:356-384``)."""
+        from torchmetrics_tpu.engine import numerics as _numerics
+
         global_folded = incoming_state.get("__none_folded__")  # _copy_state_refs snapshots carry this
+        global_res = incoming_state.get("_comp_residuals") or {}
+        local_res = self.__dict__.get("_comp_residuals") or {}
+        merged_res: Dict[str, Any] = dict(local_res)
         for attr in self._defaults:
             local_state = getattr(self, attr)
             global_state = incoming_state[attr]
             reduce_fn = self._reductions[attr]
             if reduce_fn == dim_zero_sum:
-                reduced = global_state + local_state
+                if attr in global_res or attr in local_res:
+                    # compensated forward fold: the global (value, residual)
+                    # absorbs the batch contribution through the same two-sum
+                    # the compiled step uses — residual fed back, error exact
+                    reduced, err = _numerics.two_sum(
+                        global_state,
+                        local_state
+                        + global_res.get(attr, jnp.zeros_like(global_state))
+                        + local_res.get(attr, jnp.zeros_like(global_state)),
+                    )
+                    merged_res[attr] = err
+                else:
+                    # same overflow-safe widening merge_state applies: forward's
+                    # reduce path folds the identical sum states
+                    reduced = _safe_int_sum(global_state, local_state)
             elif reduce_fn == dim_zero_mean:
                 reduced = ((self._update_count - 1) * global_state + local_state) / self._update_count
+                if attr in global_res or attr in local_res:
+                    # count-weighted residual fold, mirroring the value fold
+                    merged_res[attr] = (
+                        (self._update_count - 1) * global_res.get(attr, jnp.zeros_like(reduced))
+                        + local_res.get(attr, jnp.zeros_like(reduced))
+                    ) / self._update_count
             elif reduce_fn == dim_zero_max:
                 reduced = jnp.maximum(global_state, local_state)
             elif reduce_fn == dim_zero_min:
@@ -486,6 +550,8 @@ class Metric:
             else:
                 raise TypeError(f"Unsupported reduce_fn: {reduce_fn}")
             setattr(self, attr, reduced)
+        if global_res or local_res:
+            self._comp_residuals = merged_res
         # forward's reduce path reset the quarantine counter with the states;
         # fold the snapshotted global count back in (the counter is additive)
         global_quarantined = incoming_state.get("_quarantined_count")
@@ -732,15 +798,25 @@ class Metric:
                         # timeline as compiled dispatches (engine fallbacks additionally
                         # carry their reason via EngineStats.fallback), timed so the
                         # eager launch cost lands in the same latency histograms
+                        from torchmetrics_tpu.engine import numerics as _numerics
+
+                        if _numerics.compensation_active(self):
+                            # eager parity for the compensated two-sum: the raw
+                            # body runs on zeroed compensated states and the
+                            # recomposition matches the compiled transform
+                            def body() -> None:
+                                _numerics.eager_update(self, lambda: update(*args, **kwargs))
+                        else:
+                            def body() -> None:
+                                update(*args, **kwargs)
                         if quarantine_mode == _txn.MODE_QUARANTINE:
                             # eager parity: the same admission + transactional skip
                             # the compiled path lowers in-graph, so engine-on and
                             # engine-off runs agree on quarantined streams
                             def run() -> None:
-                                _txn.eager_update(self, lambda: update(*args, **kwargs), args, kwargs)
+                                _txn.eager_update(self, body, args, kwargs)
                         else:
-                            def run() -> None:
-                                update(*args, **kwargs)
+                            run = body
                         rec = _diag.active_recorder()
                         measuring = rec is not None or _profile.active_profile() is not None
                         if not measuring:
@@ -873,6 +949,15 @@ class Metric:
             if self._computed is not None:
                 return self._computed
 
+            if self.__dict__.get("_comp_residuals"):
+                # epoch-boundary re-anchoring (engine/numerics.py): fold each
+                # compensated (value, residual) pair into a clean anchor so
+                # compute() sees the corrected total and error growth restarts
+                # from zero — pure device ops, no host transfer
+                from torchmetrics_tpu.engine import numerics as _numerics
+
+                _numerics.reanchor(self)
+
             fused = None
             if not args and not kwargs:
                 # fused epoch chain: packed exchange + one executable doing
@@ -959,8 +1044,15 @@ class Metric:
         if self.__dict__.get("_quarantined_count") is not None:
             # same rule for the quarantine counter: growth already surfaced by
             # a sanctioned read stays in EngineStats; the device count restarts
-            self._quarantined_count = jnp.zeros((), jnp.int32)
+            self._quarantined_count = jnp.zeros_like(self._quarantined_count)
             self._quarantine_reported = 0
+        if self.__dict__.get("_comp_residuals"):
+            # a reset starts a fresh accumulation: the compensation residual
+            # restarts at zero with the states (fresh buffers — donation-safe
+            # against snapshots still holding the old arrays)
+            self._comp_residuals = {
+                k: jnp.zeros_like(v) for k, v in self._comp_residuals.items()
+            }
 
     def state_footprint(self) -> Dict[str, Any]:
         """Live HBM bytes held by this metric's states (see ``diag/costs.py``)."""
@@ -1081,10 +1173,18 @@ class Metric:
         """
         destination = {} if destination is None else destination
         wrote_any = False
+        residuals = self.__dict__.get("_comp_residuals") or {}
         for key in self._defaults:
             if not self._persistent[key]:
                 continue
             current_val = getattr(self, key)
+            if _is_array(current_val) and key in residuals:
+                # snapshots persist the ANCHORED total (value ⊕ residual folded
+                # on the fly, read-only): restore starts with a zero residual
+                # and loses only the sub-ulp remainder — see engine/numerics.py
+                from torchmetrics_tpu.engine import numerics as _numerics
+
+                current_val = _numerics.anchored_value(current_val, residuals[key])
             if _is_array(current_val):
                 destination[prefix + key] = np.asarray(current_val)
             elif isinstance(current_val, list):
@@ -1125,6 +1225,13 @@ class Metric:
         if restored_any:
             # state changed under the cache — a prior compute() value is stale now
             self._computed = None
+            if self.__dict__.get("_comp_residuals"):
+                # checkpoints carry anchored totals (state_dict folded the
+                # residual in): a stale residual surviving the restore would
+                # double-count the error it once compensated
+                self._comp_residuals = {
+                    k: jnp.zeros_like(v) for k, v in self._comp_residuals.items()
+                }
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Keep only kwargs that ``update`` accepts (reference ``metric.py:818-837``)."""
@@ -1263,6 +1370,25 @@ class Metric:
         return tuple(self.__getstate__().get("_defaults", ()))
 
     __iter__ = None
+
+
+def _safe_int_sum(a: Any, b: Any) -> Any:
+    """Additive state fold that cannot silently wrap an integer accumulator.
+
+    Under the x64 flag sub-64-bit integer states widen to int64/uint64 BEFORE
+    the add (overflow-safe at int64; the widened dtype is the accumulator's
+    from here on). Without x64 no wider device integer exists — the fold stays
+    in-dtype and the ``overflow_suspect`` sentinel bit is the guard.
+    """
+    if (
+        _is_array(a)
+        and jnp.issubdtype(a.dtype, jnp.integer)
+        and jax.config.jax_enable_x64
+        and jnp.dtype(a.dtype).itemsize < 8
+    ):
+        wide = jnp.uint64 if jnp.issubdtype(a.dtype, jnp.unsignedinteger) else jnp.int64
+        return jnp.asarray(a, wide) + jnp.asarray(b, wide)
+    return a + b
 
 
 def _neg(x: Array) -> Array:

@@ -273,17 +273,24 @@ def _fold_shards(metric: Any, shard_states: List[Dict[str, Any]]):
     import jax
     import jax.numpy as jnp
 
+    from torchmetrics_tpu.engine import numerics as _numerics
     from torchmetrics_tpu.parallel.packing import PackedSyncPlan
 
     n = len(shard_states)
     original = {k: getattr(metric, k) for k in metric._defaults}
     try:
-        plans, metas = [], []
-        for states in shard_states:
-            _set_states(metric, states)
-            plan = PackedSyncPlan([("", metric)], n, None)
-            plans.append(plan)
-            metas.append(plan.metadata_local())
+        # shard values are ANCHORED (state_dict folded their residuals in) and
+        # carry NO residuals of their own, so the restore-time plan is built
+        # with compensation OFF: plain sum/mean specs the reshard split
+        # algebra understands — the live world re-enables its (value,
+        # residual) pairing from a zero residual after the restore
+        with _numerics.compensated_context(False):
+            plans, metas = [], []
+            for states in shard_states:
+                _set_states(metric, states)
+                plan = PackedSyncPlan([("", metric)], n, None)
+                plans.append(plan)
+                metas.append(plan.metadata_local())
         shapes = {None if m is None else m.shape for m in metas}
         if len(shapes) != 1:
             raise SnapshotReshardError(
@@ -380,6 +387,14 @@ def _reshard_metric(
         setattr(metric, attr, value)
     metric._update_count = _split_count(sum(counts), rank, world_size)
     metric._computed = None
+    if metric.__dict__.get("_comp_residuals"):
+        import jax.numpy as jnp
+
+        # shards persist ANCHORED totals (state_dict folds the residual in):
+        # the restored world starts its compensation from a zero residual
+        metric._comp_residuals = {
+            k: jnp.zeros_like(getattr(metric, k)) for k in metric._comp_residuals
+        }
 
 
 def restore_resharded(

@@ -221,9 +221,20 @@ class PackedSyncPlan:
         # function-level import: packing sits below the engine package in the
         # import graph (engine/epoch.py imports this module at top level), so a
         # module-level engine import here would be a cycle
+        from torchmetrics_tpu.engine import numerics as _numerics
         from torchmetrics_tpu.engine import txn as _txn
 
         for owner, metric in self._metrics:
+            # compensated accumulation (engine/numerics.py): membership is a
+            # function of the ENABLEMENT KNOB + the metric definition alone —
+            # never of live values — so enablement must match on every rank or
+            # the buffer layouts desynchronize (the sentinel's documented rule,
+            # enforced by the plan signature / layout checks)
+            comp_names = (
+                _numerics.comp_state_names(metric) if _numerics.compensated_enabled() else ()
+            )
+            if comp_names:
+                _numerics.ensure_residuals(metric)
             for attr, red in metric._reductions.items():
                 val = getattr(metric, attr)
                 default = metric._defaults[attr]
@@ -262,6 +273,18 @@ class PackedSyncPlan:
                     # verification entry in the metadata exchange
                     spec.needs_meta = tuple(getattr(default, "shape", ())) != spec.shape
                 spec.group = ("reduce:" if kind in ("sum", "mean") else "gather:") + spec.dtype
+                if attr in comp_names and kind in ("sum", "mean"):
+                    # paired (value, residual) fold via two-sum — not naive
+                    # add: the value spec becomes comp-{sum,mean} and a
+                    # residual spec rides the SAME reduce buffer right after
+                    spec.kind = "comp-" + kind
+                    self.specs.append(spec)
+                    res_spec = _Spec(owner, attr, "comp-res", spec.dtype)
+                    res_spec.shape = spec.shape
+                    res_spec.size = spec.size
+                    res_spec.group = spec.group
+                    self.specs.append(res_spec)
+                    continue
                 self.specs.append(spec)
             # health sentinel (diag/sentinel.py): the int32 bitmask rides the
             # gather buffer and folds cross-rank by bitwise OR, so a flag
@@ -567,12 +590,17 @@ class PackedSyncPlan:
 
         if not self._finalized:
             raise RuntimeError("finalize() must run before pack()")
+        from torchmetrics_tpu.engine import numerics as _numerics
+
         segments: Dict[str, List[Any]] = {k: [] for k in self._group_sizes}
         by_owner = dict(self._metrics)
         for s in self.specs:
             if not s.group or s.size == 0:
                 continue
-            val = getattr(by_owner[s.owner], s.attr)
+            if s.kind == "comp-res":
+                val = _numerics.ensure_residuals(by_owner[s.owner])[s.attr]
+            else:
+                val = getattr(by_owner[s.owner], s.attr)
             if s.kind == "none-list":
                 flat = jnp.concatenate([jnp.ravel(e) for e in val]) if val else jnp.zeros((0,))
             elif s.kind == "cat":
@@ -614,16 +642,27 @@ class PackedSyncPlan:
         """
         if not self._finalized:
             raise RuntimeError("finalize() must run before make_fold()")
+        from torchmetrics_tpu.engine import numerics as _numerics
+
         specs = list(self.specs)
         members = list(self.members)
         empty = list(self.empty_lists)
+        # comp-{sum,mean} specs pair with the comp-res spec appended right
+        # after them at build time; resolve the pairing by position once
+        res_pair: Dict[int, _Spec] = {
+            i: specs[i + 1]
+            for i, s in enumerate(specs)
+            if s.kind in ("comp-sum", "comp-mean")
+        }
 
         def fold(gathered: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             import jax.numpy as jnp
 
             out: Dict[str, Dict[str, Any]] = {}
-            for s in specs:
+            for spec_i, s in enumerate(specs):
                 dest = out.setdefault(s.owner, {})
+                if s.kind == "comp-res":
+                    continue  # folded with its paired comp-{sum,mean} value spec
                 if s.kind == "cat" and (not s.group or (s.world_dim0 and max(s.world_dim0) == 0)):
                     # empty on every rank: lists stay [], arrays keep a 0-row shape
                     dest[s.attr] = (
@@ -636,7 +675,30 @@ class PackedSyncPlan:
                     continue
                 seg = gathered[s.group][:, s.offset : s.offset + s.size]
                 seg = seg[jnp.asarray(members)] if members != list(range(self.world_size)) else seg
-                if s.kind == "sentinel":
+                if s.kind in ("comp-sum", "comp-mean"):
+                    # (value, residual) pairs fold via two-sum — not naive add:
+                    # each rank's residual feeds back into its increment, the
+                    # exact fold error carries forward, and the synced pair is
+                    # re-anchored in the SAME graph (the epoch-boundary fold)
+                    rs = res_pair[spec_i]
+                    seg_r = gathered[rs.group][:, rs.offset : rs.offset + rs.size]
+                    seg_r = (
+                        seg_r[jnp.asarray(members)]
+                        if members != list(range(self.world_size))
+                        else seg_r
+                    )
+                    vstack = seg.reshape((len(members),) + s.shape)
+                    rstack = seg_r.reshape((len(members),) + s.shape)
+                    total, res = vstack[0], rstack[0]
+                    for r in range(1, len(members)):
+                        total, res = _numerics.two_sum(total, vstack[r] + rstack[r] + res)
+                    total, res = _numerics.two_sum(total, res)  # clean anchor
+                    if s.kind == "comp-mean":
+                        total = total / len(members)
+                        res = res / len(members)
+                    dest[s.attr] = total
+                    dest[_numerics.SYNC_RES_PREFIX + s.attr] = res
+                elif s.kind == "sentinel":
                     # per-bit max == bitwise OR: a health flag raised on ANY
                     # rank survives the cross-rank fold
                     stacked = seg.reshape((len(members),))
