@@ -23,6 +23,7 @@ DOMAINS = [
     ("torchmetrics_tpu.nominal", "Nominal"),
     ("torchmetrics_tpu.multimodal", "Multimodal"),
     ("torchmetrics_tpu.wrappers", "Wrappers"),
+    ("torchmetrics_tpu.serve", "Serving / streaming"),
     ("torchmetrics_tpu.ops", "TPU compute kernels"),
     ("torchmetrics_tpu.models", "Feature-extractor models"),
     ("torchmetrics_tpu.parallel", "Parallel / sync"),

@@ -62,6 +62,15 @@ _TRACKED = (
     ("numerics", "numerics_host_transfers", "max"),
     ("numerics", "numerics_retraces_after_warmup", "max"),
     ("numerics", "drift_flags_clean", "max"),
+    # serving layer (serve/, PR 9): streaming-loop timing is display (machine-
+    # dependent); transfers/retraces/executable-sharing and the HLL error gate.
+    ("serve", "windowed_us_per_step", None),
+    ("serve", "eager_rewindow_us_per_step", None),
+    ("serve", "hll_rel_err", None),
+    ("serve", "serve_host_transfers", "max"),
+    ("serve", "serve_retraces_after_warmup", "max"),
+    ("serve", "tenant_traces", "max"),
+    ("serve", "tenant_host_transfers", "max"),
 )
 
 _TOL = 1e-6

@@ -166,6 +166,26 @@ _CHECKS = (
     ("numerics", "clean_sentinel_flags", "abs", 0),
     ("numerics", "packed_collectives_per_sync", "max", 2),  # residual rides the same buffer
     ("numerics", "sync_parity_ok", "true", None),  # world-2 two-sum fold ≤1e-6
+    # serving gates (serve/, PR 9): the streaming hot loop holds the engine's
+    # invariants — 0 host transfers under the STRICT guard, 0 warm retraces —
+    # while 10⁴ tenant slices share one executable, the snapshot-compute is
+    # provably non-blocking, and the sketches hold their error/collective
+    # budgets with world-2 merge bit-parity
+    ("serve", "serve_host_transfers", "abs", 0),  # windowed loop under STRICT guard
+    ("serve", "serve_retraces_after_warmup", "abs", 0),  # one ring signature
+    ("serve", "windowed_fallbacks", "abs", 0),  # the ring compiles (no eager demotion)
+    ("serve", "windowed_parity_ok", "true", None),  # ring == recompute-from-scratch
+    ("serve", "tenant_traces", "max", 1),  # 10⁴ tenants, ONE executable signature
+    ("serve", "tenant_retraces_after_warmup", "abs", 0),  # tenant id is data
+    ("serve", "tenant_host_transfers", "abs", 0),
+    ("serve", "tenant_spot_check_ok", "true", None),  # per-slice + global exactness
+    ("serve", "snapshot_nonblocking_ok", "true", None),  # updates landed mid-scrape
+    ("serve", "snapshot_host_transfers", "abs", 0),
+    ("serve", "hll_within_bound", "true", None),  # ±3% at 10⁵ uniques
+    ("serve", "sketch_merge_parity_ok", "true", None),  # world-2 fold bit-exact
+    ("serve", "sketch_collectives_budget_ok", "true", None),  # ≤1 added collective
+    ("serve", "sidecar_content_type_ok", "true", None),  # text/plain; version=0.0.4
+    ("serve", "sidecar_scrape_ok", "true", None),  # tm_tpu_serve_* series served
 )
 
 
@@ -206,7 +226,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

@@ -94,8 +94,23 @@ elif ! grep -q '"drift_demonstrated": true' "$BENCH_OUT" \
   # the world-2 packed sync must fold (value, residual) pairs with parity
   echo "bench smoke: FAILED (compensated-accumulation drift/rescue proofs missing or degraded)"
   status=1
+elif ! grep -q '"serve_host_transfers": 0' "$BENCH_OUT" \
+  || ! grep -q '"serve_retraces_after_warmup": 0' "$BENCH_OUT" \
+  || ! grep -q '"tenant_traces": 1' "$BENCH_OUT" \
+  || ! grep -q '"snapshot_nonblocking_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"hll_within_bound": true' "$BENCH_OUT" \
+  || ! grep -q '"sketch_merge_parity_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"sidecar_content_type_ok": true' "$BENCH_OUT"; then
+  # serving smoke (serve/ gate): the windowed streaming loop must hold 0 host
+  # transfers + 0 warm retraces under the STRICT guard, 10^4 tenant slices
+  # must share ONE executable signature, snapshot-compute must demonstrably
+  # not block the hot loop, the HLL must hold its ±3% bound, the world-2
+  # sketch merge must be bit-exact, and the sidecar must answer with the
+  # 0.0.4 exposition content type
+  echo "bench smoke: FAILED (serving stream/tenancy/snapshot/sketch proofs missing or degraded)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve counters present)"
 fi
 
 echo

@@ -1,0 +1,556 @@
+"""Serving subsystem tests (torchmetrics_tpu/serve/): windowed/EMA streaming
+parity, sketch error bounds + world-2 merge parity, multi-tenant isolation and
+executable sharing, pause-free snapshot-compute under the STRICT transfer
+guard, the scrape sidecar, and the Running reset regression (satellite)."""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MeanMetric, MetricCollection, Running, SumMetric
+from torchmetrics_tpu.aggregation import MaxMetric
+from torchmetrics_tpu.diag import diag_context, transfer_guard
+from torchmetrics_tpu.engine import engine_context
+from torchmetrics_tpu.parallel.packing import PackedSyncPlan
+from torchmetrics_tpu.serve import (
+    CardinalitySketch,
+    DecayedMetric,
+    HeavyHitters,
+    MetricsSidecar,
+    TenantSlices,
+    WindowedMetric,
+    snapshot_compute,
+    take_snapshot,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+DISTRIBUTED = staticmethod(lambda: True)
+
+
+def _identical_rank_world(monkeypatch, world=2):
+    """Every rank holds this process's state: allgather = stack world copies."""
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+    )
+
+
+def _fold_world2(metrics):
+    """Fold two DISTINCT rank metrics through the packed plan, rank-0 view."""
+    plan_a = PackedSyncPlan([("m", metrics[0])], world_size=2)
+    plan_b = PackedSyncPlan([("m", metrics[1])], world_size=2)
+    assert plan_a.metadata_local() is None  # fixed shapes: rank-invariant
+    plan_a.finalize(None)
+    plan_b.finalize(None)
+    pa, pb = plan_a.pack(), plan_b.pack()
+    gathered = {k: jnp.stack([pa[k], pb[k]]) for k in pa}
+    return jax.jit(plan_a.make_fold())(gathered)["m"], plan_a
+
+
+# --------------------------------------------------------------------- window
+
+
+class TestWindowed:
+    def test_parity_vs_recompute_from_scratch(self):
+        """Ring fold == recompute over exactly the covered trailing updates."""
+        buckets, size = 4, 3
+        m = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=buckets, bucket_size=size)
+        values = [float(v) for v in np.random.RandomState(0).rand(40)]
+        for n, v in enumerate(values, start=1):
+            m.update(jnp.asarray(v))
+            first_bucket = max(0, (n - 1) // size - (buckets - 1))
+            covered = values[first_bucket * size : n]
+            assert float(m.compute()) == pytest.approx(sum(covered), rel=1e-6)
+
+    def test_max_base_and_eviction(self):
+        m = WindowedMetric(MaxMetric(), buckets=2, bucket_size=1)
+        for v in (9.0, 1.0, 2.0):
+            m.update(jnp.asarray(v))
+        # the 9.0 bucket was evicted: the window max is over {1, 2}
+        assert float(m.compute()) == 2.0
+
+    def test_compiled_matches_eager_with_clean_counters(self):
+        values = [float(v) for v in np.random.RandomState(1).rand(24)]
+        eager = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=3, bucket_size=2)
+        for v in values:
+            eager.update(jnp.asarray(v))
+        with engine_context(True, donate=True), diag_context(capacity=512) as rec, transfer_guard("strict"):
+            comp = WindowedMetric(
+                SumMetric(nan_strategy=0.0, compiled_update=True), buckets=3, bucket_size=2
+            )
+            for v in values:
+                comp.update(jnp.asarray(v))
+            st = comp._engine.stats
+            assert st.eager_fallbacks == 0
+            assert st.traces == 1  # advance/evict/fold is ONE signature
+            assert st.dispatches == len(values)
+            assert st.donated_dispatches == len(values)
+            assert rec.count("transfer.host", "transfer.blocked") == 0
+        assert float(comp.compute()) == pytest.approx(float(eager.compute()), rel=1e-6)
+
+    def test_decayed_closed_form(self):
+        decay = 0.75
+        d = DecayedMetric(SumMetric(nan_strategy=0.0), decay=decay)
+        values = [3.0, 1.0, 4.0, 1.0, 5.0]
+        for v in values:
+            d.update(jnp.asarray(v))
+        expected = 0.0
+        for v in values:
+            expected = expected * decay + v
+        assert float(d.compute()) == pytest.approx(expected, rel=1e-6)
+
+    def test_decayed_mean_is_ema(self):
+        d = DecayedMetric(MeanMetric(nan_strategy=0.0), half_life=8)
+        for _ in range(64):
+            d.update(jnp.asarray(2.5))
+        # numerator and denominator decay together: constant stream -> exact
+        assert float(d.compute()) == pytest.approx(2.5, rel=1e-6)
+
+    def test_window_world2_sync_doubles_sum(self, monkeypatch):
+        _identical_rank_world(monkeypatch)
+        with engine_context(True):
+            m = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=2, bucket_size=2)
+            m.distributed_available_fn = DISTRIBUTED.__func__
+            for v in (1.0, 2.0, 3.0):
+                m.update(jnp.asarray(v))
+            local = 6.0
+            assert float(m.compute()) == pytest.approx(2 * local)
+            st = m._epoch_engine().stats
+            assert st.packed_syncs >= 1
+            # fixed shapes, standard roles: no metadata gather, O(dtypes) buffers
+            assert st.sync_metadata_gathers == 0
+            assert st.sync_collectives / st.packed_syncs <= 2
+
+    def test_nested_exemption_is_attribute_scoped(self):
+        """A SECOND (undeclared) nested metric still disqualifies compilation —
+        the exemption names only the hygienic traced-body attribute."""
+        from torchmetrics_tpu.engine.compiled import holds_nested_metrics
+
+        clean = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=2)
+        assert not holds_nested_metrics(clean)
+        dirty = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=2)
+        dirty.sidekick = SumMetric(nan_strategy=0.0)  # live nested metric
+        assert holds_nested_metrics(dirty)
+
+    def test_rejects_unstreamable_bases(self):
+        from torchmetrics_tpu.aggregation import CatMetric
+
+        class MeanState(SumMetric):
+            def __init__(self):
+                super().__init__(nan_strategy=0.0)
+                self.add_state("avg", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+        with pytest.raises(TorchMetricsUserError, match="unsupported reduction"):
+            WindowedMetric(MeanState(), buckets=2)
+        with pytest.raises(TorchMetricsUserError, match="list state"):
+            DecayedMetric(CatMetric(nan_strategy=0.0), decay=0.5)
+
+        class ZeroDefaultMax(SumMetric):
+            def __init__(self):
+                super().__init__(nan_strategy=0.0)
+                self.add_state("peak", jnp.asarray(0.0), dist_reduce_fx="max")
+
+        # a 0-default float max state over an all-negative stream would
+        # silently report 0 from never-written slots — rejected at build
+        with pytest.raises(TorchMetricsUserError, match="fold identity"):
+            WindowedMetric(ZeroDefaultMax(), buckets=2)
+
+
+# --------------------------------------------------------------------- sketch
+
+
+class TestSketches:
+    def test_hll_error_bound_at_1e5_uniques(self):
+        sketch = CardinalitySketch(p=11)
+        ids = np.arange(100_000, dtype=np.int64)
+        for chunk in np.array_split(ids, 10):
+            sketch.update(jnp.asarray(chunk))
+        est = float(sketch.compute())
+        assert abs(est - 1e5) / 1e5 <= 0.03  # 1.04/sqrt(2048) ~ 2.3% std err
+
+    def test_hll_duplicates_do_not_count(self):
+        sketch = CardinalitySketch(p=11)
+        for _ in range(5):
+            sketch.update(jnp.arange(1000))
+        est = float(sketch.compute())
+        assert abs(est - 1000) / 1000 <= 0.05
+
+    def test_hll_world2_merge_bit_parity(self):
+        a, b, ref = CardinalitySketch(), CardinalitySketch(), CardinalitySketch()
+        a.update(jnp.arange(0, 5000))
+        b.update(jnp.arange(3000, 8000))  # overlapping streams
+        ref.update(jnp.arange(0, 5000))
+        ref.update(jnp.arange(3000, 8000))
+        folded, plan = _fold_world2([a, b])
+        # max-merge of rank registers == registers of the union stream, bitwise
+        assert bool((folded["registers"] == ref.registers).all())
+        # the whole sketch is ONE buffer collective (gather:int32), 0 metadata
+        assert len(plan.buffer_keys()) == 1
+
+    def test_hh_finds_heavy_hitters(self):
+        hh = HeavyHitters(k=8)
+        rng = np.random.RandomState(2)
+        stream = np.concatenate([np.full(600, 42), np.full(400, 7), rng.randint(1000, 5000, 300)])
+        rng.shuffle(stream)
+        for chunk in np.array_split(stream, 5):
+            hh.update(jnp.asarray(chunk))
+        ids, counts = (np.asarray(x) for x in hh.compute())
+        top2 = dict(zip(ids[:2].tolist(), counts[:2].tolist()))
+        assert set(top2) == {42, 7}
+        # CMS estimates are one-sided overestimates with bounded error
+        assert top2[42] >= 600 and top2[42] <= 640
+        assert top2[7] >= 400 and top2[7] <= 440
+
+    def test_hh_world2_merge_parity_and_collective_budget(self):
+        rank_a, rank_b, ref = HeavyHitters(k=8), HeavyHitters(k=8), HeavyHitters(k=8)
+        ids_a = np.concatenate([np.full(400, 7), np.arange(50)])
+        ids_b = np.concatenate([np.full(300, 13), np.arange(50, 100)])
+        rank_a.update(jnp.asarray(ids_a))
+        rank_b.update(jnp.asarray(ids_b))
+        ref.update(jnp.asarray(ids_a))
+        ref.update(jnp.asarray(ids_b))
+        folded, plan = _fold_world2([rank_a, rank_b])
+        # the count-min grid merge is exact: CMS(A)+CMS(B) == CMS(A ∪ B)
+        assert bool((folded["cms"] == ref.cms).all())
+        # joint hh fold == single-rank pass over the union stream, bit-exact
+        merged = sorted(
+            (int(i), int(c))
+            for i, c in zip(np.asarray(folded["hh_ids"]), np.asarray(folded["hh_counts"]))
+            if i >= 0
+        )
+        reference = sorted(
+            (int(i), int(c))
+            for i, c in zip(np.asarray(ref.hh_ids), np.asarray(ref.hh_counts))
+            if i >= 0
+        )
+        assert merged == reference
+        # reduce:int32 (grid) + gather:int32 (topk pair): ≤ 1 collective beyond
+        # what the grid alone would cost, and no metadata gather at all
+        assert len(plan.buffer_keys()) <= 2
+
+    def test_host_hash_mirrors_device_hash(self):
+        """The scrape-path probe uses pure-host hashing — it must be
+        bit-for-bit the device hash or host slot resolution diverges."""
+        from torchmetrics_tpu.serve.sketch import (
+            _SEED_INDEX, canon_u32, canon_u32_host, hash_u32, hash_u32_host,
+        )
+
+        for value in (0, 1, 7, 12345, 2**31 - 1, 2**33 + 5):
+            dev = int(np.asarray(hash_u32(canon_u32(jnp.asarray(value)), _SEED_INDEX)))
+            host = hash_u32_host(canon_u32_host(value), _SEED_INDEX)
+            assert dev == host, value
+
+    def test_hh_wide_ids_not_truncated(self):
+        """Under x64 a 64-bit id must survive intact in the top-k (it used to
+        wrap negative through an int32 cast and vanish while still inflating
+        the grid)."""
+        if not jax.config.jax_enable_x64:
+            pytest.skip("wide ids only exist under x64")
+        hh = HeavyHitters(k=4)
+        wide = 2**31  # doesn't fit int32
+        hh.update(jnp.asarray(np.full(100, wide, dtype=np.int64)))
+        ids, counts = (np.asarray(x) for x in hh.compute())
+        assert int(ids[0]) == wide
+        assert int(counts[0]) == 100
+
+    def test_canon_u32_dtype_parity(self):
+        """The same non-negative id must hash identically whether it arrives
+        as int32 or int64 — otherwise ranks with different input dtypes put
+        one tenant in disjoint registers and the merge models a disjoint
+        union (up to 2x cardinality overcount)."""
+        from torchmetrics_tpu.serve.sketch import canon_u32
+
+        ids = np.array([0, 1, 7, 2**31 - 1], dtype=np.int64)
+        a = np.asarray(canon_u32(jnp.asarray(ids, dtype=jnp.int32)))
+        b = np.asarray(canon_u32(jnp.asarray(ids, dtype=jnp.int64)))
+        assert a.tolist() == b.tolist()
+        # ...while ids past 2**32 still fold their high word (no wholesale
+        # collision with their low-word truncation)
+        big = jnp.asarray(np.array([5 + (1 << 33)], dtype=np.int64))
+        assert int(np.asarray(canon_u32(big))[0]) != int(a[2])
+
+    def test_hh_compiled_matches_eager(self):
+        ids = np.concatenate([np.full(64, 3), np.full(32, 11), np.arange(100, 120)])
+        eager = HeavyHitters(k=4)
+        eager.update(jnp.asarray(ids))
+        with engine_context(True, donate=True):
+            comp = HeavyHitters(k=4, compiled_update=True)
+            comp.update(jnp.asarray(ids))
+            assert comp._engine.stats.eager_fallbacks == 0
+        assert np.asarray(eager.hh_ids).tolist() == np.asarray(comp.hh_ids).tolist()
+        assert np.asarray(eager.hh_counts).tolist() == np.asarray(comp.hh_counts).tolist()
+
+
+# -------------------------------------------------------------------- tenancy
+
+
+class TestTenancy:
+    def test_isolation_and_executable_sharing(self):
+        n_tenants = 200
+        with engine_context(True, donate=True), diag_context(capacity=1024) as rec, transfer_guard("strict"):
+            slices = TenantSlices(SumMetric(nan_strategy=0.0), capacity=512, compiled_update=True)
+            for tid in range(n_tenants):
+                slices.update(jnp.asarray(tid), jnp.asarray(float(tid) + 1.0))
+            st = slices._engine.stats
+            # tenant id is DATA: every distinct tenant rides ONE executable
+            assert st.traces == 1
+            assert st.eager_fallbacks == 0
+            assert rec.count("transfer.host", "transfer.blocked") == 0
+        for tid in (0, 57, 199):
+            assert float(slices.tenant_value(tid)) == pytest.approx(tid + 1.0)
+        assert slices.tenant_value(100_000) is None
+        # scrape views must be callable INSIDE a strict-guard scope (a scrape
+        # landing mid-stream): every read rides a sanctioned boundary
+        with transfer_guard("strict"):
+            assert slices.tenant_count() == n_tenants
+            view = slices.tenant_value(57)
+        assert float(view) == pytest.approx(58.0)
+        assert slices.tenant_count() == n_tenants
+        assert slices.spilled_count() == 0
+        # the global aggregate spans every slice
+        assert float(slices.compute()) == pytest.approx(sum(range(1, n_tenants + 1)))
+
+    def test_spill_past_capacity(self):
+        slices = TenantSlices(SumMetric(nan_strategy=0.0), capacity=4, probes=4)
+        heavy_spiller = 999
+        for tid in range(12):
+            slices.update(jnp.asarray(tid), jnp.asarray(1.0))
+        for _ in range(20):
+            slices.update(jnp.asarray(heavy_spiller), jnp.asarray(1.0))
+        assert slices.tenant_count() <= 4
+        assert slices.spilled_count() > 0
+        report = slices.spill_report()
+        assert report["spilled_updates"] == slices.spilled_count()
+        # the dominant spilled tenant is identifiable from the sketch...
+        heavy = {h["tenant"]: h["estimate"] for h in report["heavy_hitters"]}
+        assert heavy.get(heavy_spiller, 0) >= 15
+        # ...and the GLOBAL aggregate stayed exact (dump row absorbs spills)
+        assert float(slices.compute()) == pytest.approx(32.0)
+
+    def test_mean_template_via_sum_count(self):
+        slices = TenantSlices(MeanMetric(nan_strategy=0.0), capacity=64)
+        slices.update(jnp.asarray(5), jnp.asarray(2.0))
+        slices.update(jnp.asarray(5), jnp.asarray(4.0))
+        slices.update(jnp.asarray(6), jnp.asarray(10.0))
+        assert float(slices.tenant_value(5)) == pytest.approx(3.0)
+        assert float(slices.tenant_value(6)) == pytest.approx(10.0)
+
+    def test_negative_tenant_id_spills_instead_of_contaminating(self):
+        slices = TenantSlices(SumMetric(nan_strategy=0.0), capacity=64)
+        slices.update(jnp.asarray(-1), jnp.asarray(100.0))
+        slices.update(jnp.asarray(-7), jnp.asarray(50.0))
+        # negative ids never claim a slot (they'd collide with the -1 empty
+        # sentinel and contaminate a later tenant's slice) — they spill
+        assert slices.tenant_count() == 0
+        assert slices.spilled_count() == 2
+        assert slices.tenant_value(-1) is None
+        slices.update(jnp.asarray(5), jnp.asarray(2.0))
+        assert float(slices.tenant_value(5)) == pytest.approx(2.0)  # uncontaminated
+        # ...and the dump row keeps the global aggregate exact regardless
+        assert float(slices.compute()) == pytest.approx(152.0)
+
+    def test_tenant_updates_accessor(self):
+        slices = TenantSlices(SumMetric(nan_strategy=0.0), capacity=64)
+        for _ in range(3):
+            slices.update(jnp.asarray(8), jnp.asarray(1.0))
+        slices.update(jnp.asarray(9), jnp.asarray(1.0))
+        assert slices.tenant_updates(8) == 3
+        assert slices.tenant_updates(9) == 1
+        assert slices.tenant_updates(12345) == 0
+
+    def test_env_knobs_fail_loud(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_SERVE_CAPACITY", "not-a-number")
+        with pytest.raises(TorchMetricsUserError, match="TORCHMETRICS_TPU_SERVE_CAPACITY"):
+            TenantSlices(SumMetric(nan_strategy=0.0))
+        monkeypatch.setenv("TORCHMETRICS_TPU_SERVE_CAPACITY", "100")  # not a power of two
+        with pytest.raises(TorchMetricsUserError, match="power of two"):
+            TenantSlices(SumMetric(nan_strategy=0.0))
+        monkeypatch.setenv("TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES", "zero")
+        from torchmetrics_tpu.serve.stats import snapshot_retries
+
+        with pytest.raises(TorchMetricsUserError, match="SNAPSHOT_RETRIES"):
+            snapshot_retries()
+
+
+# ------------------------------------------------------------------- snapshot
+
+
+class TestSnapshotCompute:
+    def test_interleaved_updates_under_strict_guard(self):
+        with engine_context(True, donate=True), diag_context(capacity=512) as rec, transfer_guard("strict"):
+            m = SumMetric(nan_strategy=0.0, compiled_update=True)
+            for v in range(10):
+                m.update(jnp.asarray(float(v)))
+            snap = take_snapshot(m)
+            # the hot loop keeps updating (and donating) AFTER the trigger
+            for v in range(10, 15):
+                m.update(jnp.asarray(float(v)))
+            frozen = snapshot_compute(m, snap)
+            events = [e for e in rec.snapshot() if e.kind == "serve.snapshot.read"]
+            assert events and events[-1].data["updates_between"] == 5
+            assert rec.count("transfer.host", "transfer.blocked") == 0
+        # the snapshot answers for its watermark; the live metric kept going
+        assert float(frozen) == pytest.approx(sum(range(10)))
+        assert float(m.compute()) == pytest.approx(sum(range(15)))
+
+    def test_live_caches_untouched(self):
+        m = SumMetric(nan_strategy=0.0)
+        m.update(jnp.asarray(2.0))
+        assert snapshot_compute(m) is not None
+        assert m._computed is None  # the scratch computed, not the live metric
+        assert m._is_synced is False
+
+    def test_windowed_metric_snapshot(self):
+        m = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=2, bucket_size=1)
+        for v in (1.0, 2.0, 3.0):
+            m.update(jnp.asarray(v))
+        assert float(snapshot_compute(m)) == pytest.approx(5.0)
+
+    def test_scratch_cache_evicts_with_dead_metric(self):
+        import gc
+
+        from torchmetrics_tpu.serve import snapshot as _snapshot
+
+        m = SumMetric(nan_strategy=0.0)
+        m.update(jnp.asarray(1.0))
+        key = id(m)
+        snapshot_compute(m)
+        assert key in _snapshot._SCRATCH
+        del m
+        gc.collect()
+        # the weakref's eviction callback dropped the scratch clone: long-lived
+        # serving processes must not accumulate clones of dead metrics
+        assert key not in _snapshot._SCRATCH
+
+    def test_collection_snapshot_compute(self):
+        mc = MetricCollection({"s": SumMetric(nan_strategy=0.0), "m": MeanMetric(nan_strategy=0.0)})
+        mc.update(jnp.asarray(4.0))
+        mc.update(jnp.asarray(6.0))
+        values = mc.snapshot_compute()
+        assert float(values["s"]) == pytest.approx(10.0)
+        assert float(values["m"]) == pytest.approx(5.0)
+
+
+# -------------------------------------------------------------------- sidecar
+
+
+class TestSidecar:
+    def _get(self, port, path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.getheader("Content-Type"), resp.read()
+        finally:
+            conn.close()
+
+    def test_scrape_endpoint(self):
+        m = SumMetric(nan_strategy=0.0)
+        m.update(jnp.asarray(1.0))
+        with MetricsSidecar(port=0) as sidecar:
+            status, ctype, _ = self._get(sidecar.port, "/metrics")
+            assert status == 200
+            assert ctype == "text/plain; version=0.0.4"
+            # second scrape: the first one's accounting is now visible
+            status, _, body = self._get(sidecar.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert "tm_tpu_serve_scrapes_total" in text
+            assert "tm_tpu_serve_scrape_seconds_total" in text
+            scrapes = [
+                line for line in text.splitlines()
+                if line.startswith("tm_tpu_serve_scrapes_total ")
+            ]
+            assert scrapes and float(scrapes[0].split()[-1]) >= 1
+
+            status, ctype, body = self._get(sidecar.port, "/telemetry")
+            assert status == 200 and ctype == "application/json"
+            snap = json.loads(body)
+            assert "serve" in snap and "counters" in snap
+
+            status, _, body = self._get(sidecar.port, "/healthz")
+            assert status == 200 and body == b"ok\n"
+            status, _, _ = self._get(sidecar.port, "/nope")
+            assert status == 404
+        assert sidecar.port is None  # stopped cleanly
+
+    def test_serve_gauges_in_exposition(self):
+        from torchmetrics_tpu.diag.telemetry import export_prometheus
+
+        slices = TenantSlices(SumMetric(nan_strategy=0.0), capacity=64)
+        slices.update(jnp.asarray(1), jnp.asarray(1.0))
+        sketch = CardinalitySketch()
+        sketch.update(jnp.arange(100))
+        text = export_prometheus()
+        assert "tm_tpu_serve_tenants" in text
+        assert "tm_tpu_serve_sketch_fill_ratio" in text
+
+    def test_same_class_instances_get_unique_owner_labels(self):
+        """Two live instances of one class must NOT emit duplicate label sets
+        — Prometheus rejects the whole scrape on a duplicate sample."""
+        from torchmetrics_tpu.diag.telemetry import export_prometheus
+
+        a, b = CardinalitySketch(), CardinalitySketch()
+        a.update(jnp.arange(10))
+        b.update(jnp.arange(10))
+        text = export_prometheus()
+        fills = [
+            line for line in text.splitlines()
+            if line.startswith("tm_tpu_serve_sketch_fill_ratio{")
+        ]
+        labels = [line.split("}")[0] for line in fills]
+        assert len(labels) == len(set(labels))
+        assert sum("CardinalitySketch" in lab for lab in labels) >= 2
+
+
+# ---------------------------------------------------- satellite: Running reset
+
+
+class TestRunningResetRegression:
+    """reset() must rewind the ring cursor: a stale ``_num_vals_seen`` would
+    resume mid-ring and fold fresh slots against evicted positions (pinned
+    here; cross-linked from the Running docstring)."""
+
+    def test_reset_rewinds_ring_cursor(self):
+        r = Running(SumMetric(nan_strategy=0.0), window=3)
+        for v in (10.0, 20.0, 30.0, 40.0):
+            r.update(jnp.asarray(v))
+        assert r._num_vals_seen == 4
+        r.reset()
+        assert r._num_vals_seen == 0
+
+    def test_reset_matches_fresh_update_path(self):
+        r = Running(SumMetric(nan_strategy=0.0), window=3)
+        for v in (10.0, 20.0, 30.0, 40.0):
+            r.update(jnp.asarray(v))
+        r.reset()
+        fresh = Running(SumMetric(nan_strategy=0.0), window=3)
+        for v in (1.0, 2.0):
+            r.update(jnp.asarray(v))
+            fresh.update(jnp.asarray(v))
+        assert float(r.compute()) == float(fresh.compute())
+
+    def test_reset_matches_fresh_forward_path(self):
+        r = Running(MeanMetric(nan_strategy=0.0), window=2)
+        for v in (1.0, 5.0, 9.0):
+            r(jnp.asarray(v))
+        r.reset()
+        assert r._num_vals_seen == 0 and r._update_count == 0
+        fresh = Running(MeanMetric(nan_strategy=0.0), window=2)
+        assert float(r(jnp.asarray(3.0))) == float(fresh(jnp.asarray(3.0)))
+        assert float(r.compute()) == float(fresh.compute())
+
+    def test_clone_then_reset(self):
+        r = Running(SumMetric(nan_strategy=0.0), window=2)
+        r.update(jnp.asarray(7.0))
+        c = r.clone()
+        c.reset()
+        assert c._num_vals_seen == 0
+        c.update(jnp.asarray(1.0))
+        assert float(c.compute()) == 1.0

@@ -93,8 +93,10 @@ class IntCount(Metric):
 
 #: unit suffixes the exposition conventions recognise for this exporter; any
 #: series introduced from the profiling layer onward MUST end in one of these
-#: (before a histogram's _bucket/_sum/_count or a counter's _total)
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_flops")
+#: (before a histogram's _bucket/_sum/_count or a counter's _total). `_ratio`
+#: is the conventional spelling for dimensionless 0..1 gauges (serve sketch
+#: saturation).
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_flops", "_ratio")
 
 #: families whose value is a pure EVENT/OBJECT COUNT or an enum bitmask — the
 #: exposition conventions require no unit suffix for those (`http_requests_total`
@@ -112,6 +114,10 @@ UNITLESS_COUNT_FAMILIES = {
     "tm_tpu_profile_probes", "tm_tpu_engines", "tm_tpu_retrace_causes",
     "tm_tpu_fallback_reasons", "tm_tpu_events", "tm_tpu_events_dropped",
     "tm_tpu_ledger_executables", "tm_tpu_sentinel_flags",
+    # serving layer (serve/, PR 9): scrape/snapshot event counts + live-object
+    # gauges; scrape latency itself is unit-suffixed (serve_scrape_latency_seconds)
+    "tm_tpu_serve_scrapes", "tm_tpu_serve_snapshots", "tm_tpu_serve_snapshot_retries",
+    "tm_tpu_serve_tenants", "tm_tpu_serve_spilled_updates",
 }
 
 

@@ -463,6 +463,22 @@ class MetricCollection:
 
         return state_footprint(self)
 
+    def snapshot_compute(self) -> Dict[str, Any]:
+        """Scrape-anytime per-member ``compute()`` on shielded state copies.
+
+        The collection-level analogue of :meth:`Metric.snapshot_compute`:
+        every member's value computes off a donation-proof snapshot (group
+        views materialized first, so view members hold real arrays), the hot
+        loop keeps updating, and no member syncs or caches. Rank-local.
+        """
+        self._materialize_group_views()
+        from torchmetrics_tpu.serve.snapshot import snapshot_compute
+
+        return {
+            name: snapshot_compute(metric)
+            for name, metric in self.items(copy_state=False)
+        }
+
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         """Restore from ``state_dict``."""
         for name, metric in self.items(keep_base=True, copy_state=False):

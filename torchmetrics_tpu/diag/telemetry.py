@@ -82,6 +82,7 @@ _HIST_SERIES = {
     "sync_us": ("sync_latency_seconds", 1e-6, "packed-sync exchange wall-time"),
     "compute_us": ("compute_latency_seconds", 1e-6, "cached/fused compute dispatch wall-time"),
     "sync_bytes": ("sync_size_bytes", 1.0, "bytes through packed-sync collectives per exchange"),
+    "scrape_us": ("serve_scrape_latency_seconds", 1e-6, "sidecar scrape handling wall-time"),
 }
 
 
@@ -119,6 +120,8 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
     from torchmetrics_tpu.engine.stats import engine_report
     from torchmetrics_tpu.parallel.resilience import resilience_snapshot
 
+    from torchmetrics_tpu.serve.stats import serve_state
+
     rec = recorder if recorder is not None else active_recorder()
     counters = engine_report()
     return {
@@ -130,6 +133,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
         "histograms": histograms_snapshot(),
         "profile": profile_snapshot(),
         "resilience": resilience_snapshot(),
+        "serve": serve_state(),
     }
 
 
@@ -200,6 +204,34 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
     emit(
         f"{_PREFIX}_sentinel_flags", "gauge", "health-sentinel bitmask per metric (0 = healthy)",
         [({"owner": s["owner"]}, s["flags"]) for s in snap.get("sentinels", [])],
+    )
+
+    # serving layer (serve/): scrape + snapshot counters and the live-object
+    # gauges (tenant slots in use, sketch saturation). Scrape latency exports
+    # as the serve_scrape_latency_seconds histogram family below.
+    serve = snap.get("serve", {})
+    emit(f"{_PREFIX}_serve_scrapes_total", "counter", "sidecar scrape requests answered",
+         [({}, serve.get("scrapes", 0))])
+    emit(f"{_PREFIX}_serve_scrape_seconds_total", "counter", "wall-time spent answering scrapes",
+         [({}, serve.get("scrape_seconds", 0.0))])
+    emit(f"{_PREFIX}_serve_snapshots_total", "counter", "pause-free state snapshots taken",
+         [({}, serve.get("snapshots", 0))])
+    emit(f"{_PREFIX}_serve_snapshot_retries_total", "counter",
+         "snapshot attempts retried for a consistent watermark",
+         [({}, serve.get("snapshot_retries", 0))])
+    emit(
+        f"{_PREFIX}_serve_tenants", "gauge", "live tenant slots in use per slice registry",
+        [({"owner": t["owner"]}, t["tenants"]) for t in serve.get("tenancies", [])],
+    )
+    emit(
+        f"{_PREFIX}_serve_spilled_updates_total", "counter",
+        "updates spilled past tenant capacity into the heavy-hitter sketch",
+        [({"owner": t["owner"]}, t["spilled"]) for t in serve.get("tenancies", [])],
+    )
+    emit(
+        f"{_PREFIX}_serve_sketch_fill_ratio", "gauge",
+        "fraction of touched sketch registers/cells (saturation)",
+        [({"owner": s["owner"]}, s["fill_ratio"]) for s in serve.get("sketches", [])],
     )
 
     # latency/size distributions as PROPER histogram exposition: cumulative

@@ -141,8 +141,21 @@ def holds_nested_metrics(metric: Any) -> bool:
     corruption the per-attribute side-effect check cannot see (the inner object
     identity never changes). Wrappers therefore always run eagerly; their inner
     metrics' own engines still compile the actual work.
+
+    Exemption: a wrapper that uses an inner metric ONLY as a traced body under
+    the :func:`traced_update` snapshot/restore hygiene (the inner object's
+    ``__dict__`` is restored wholesale before the trace ends, so no tracer can
+    leak onto its live state) names that attribute in
+    ``_engine_traced_bodies`` and stays engine-eligible — the ``serve/``
+    streaming wrappers are the current holders of that contract. The
+    exemption is PER ATTRIBUTE, never class-wide: any OTHER nested metric on
+    the same object still disqualifies it (the corruption class this scan
+    guards is unchanged for undeclared attributes).
     """
-    for v in metric.__dict__.values():
+    exempt = getattr(metric, "_engine_traced_bodies", ())
+    for k, v in metric.__dict__.items():
+        if k in exempt:
+            continue
         if _is_metric_like(v):
             return True
         if isinstance(v, (list, tuple)) and any(_is_metric_like(x) for x in v):

@@ -170,6 +170,7 @@ def reset_engine_stats() -> None:
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
     from torchmetrics_tpu.engine.txn import reset_quarantine
     from torchmetrics_tpu.parallel.resilience import reset_resilience
+    from torchmetrics_tpu.serve.stats import reset_serve_stats
 
     reset_engine_counters()
     _diag.clear_recorder()
@@ -179,3 +180,4 @@ def reset_engine_stats() -> None:
     reset_histograms()
     reset_profile()
     reset_resilience()
+    reset_serve_stats()

@@ -1060,6 +1060,19 @@ class Metric:
 
         return state_footprint(self)
 
+    def snapshot_compute(self) -> Any:
+        """Scrape-anytime ``compute()`` on a shielded state copy (``serve/``).
+
+        Pause-free: the live state keeps updating (and donating) while the
+        value computes on a donation-proof snapshot; caches, sync status and
+        counters on the live metric are untouched. Rank-local by design —
+        cross-rank totals belong to the epoch sync. See
+        :func:`torchmetrics_tpu.serve.snapshot.snapshot_compute`.
+        """
+        from torchmetrics_tpu.serve.snapshot import snapshot_compute
+
+        return snapshot_compute(self)
+
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference ``metric.py:640-642``)."""
         return deepcopy(self)

@@ -134,7 +134,7 @@ class _Spec:
     __slots__ = (
         "owner", "attr", "kind", "fold_fn", "dtype", "shape", "elem_shapes",
         "group", "offset", "size", "world_dim0", "pad_to", "needs_meta",
-        "was_list", "packed_value",
+        "was_list", "packed_value", "hh_meta",
     )
 
     def __init__(self, owner: str, attr: str, kind: str, dtype: str, fold_fn: Optional[Callable] = None):
@@ -142,6 +142,7 @@ class _Spec:
         self.attr = attr
         self.kind = kind  # sum | mean | max | min | none-array | custom | cat | none-list
         self.fold_fn = fold_fn  # custom callable folds only
+        self.hh_meta: Optional[Tuple] = None  # hh-ids only: (cms attr, k, depth, width)
         self.dtype = dtype
         self.shape: Tuple[int, ...] = ()
         self.elem_shapes: Tuple[Tuple[int, ...], ...] = ()  # none-list only
@@ -235,9 +236,47 @@ class PackedSyncPlan:
             )
             if comp_names:
                 _numerics.ensure_residuals(metric)
+            # heavy-hitter sketch (serve/sketch.py): the metric DEFINITION
+            # declares a (ids, counts) pair that must fold JOINTLY against the
+            # merged count-min grid — a dedicated packed role, not a per-state
+            # reduction. Membership is a function of the definition alone (the
+            # attrs always exist), so rank layouts cannot desynchronize.
+            hh_info = getattr(metric, "_hh_fold_info", None)
+            if hh_info is not None:
+                names = list(metric._reductions)
+                if (
+                    hh_info["cms"] not in names
+                    or hh_info["ids"] not in names
+                    or hh_info["counts"] not in names
+                    or names.index(hh_info["cms"]) > names.index(hh_info["ids"])
+                    or names.index(hh_info["counts"]) != names.index(hh_info["ids"]) + 1
+                ):
+                    raise PackingError(
+                        "heavy-hitter fold requires the count-min grid registered before"
+                        " the adjacent (ids, counts) top-k pair"
+                    )
             for attr, red in metric._reductions.items():
                 val = getattr(metric, attr)
                 default = metric._defaults[attr]
+                if hh_info is not None and attr in (hh_info["ids"], hh_info["counts"]):
+                    if not _is_array(val):
+                        raise PackingError(f"heavy-hitter state {attr!r} is not an array")
+                    spec = _Spec(
+                        owner, attr,
+                        "hh-ids" if attr == hh_info["ids"] else "hh-counts",
+                        str(val.dtype),
+                    )
+                    spec.shape = tuple(int(d) for d in val.shape)
+                    spec.size = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
+                    spec.needs_meta = tuple(getattr(default, "shape", ())) != spec.shape
+                    spec.group = "gather:" + spec.dtype
+                    if spec.kind == "hh-ids":
+                        spec.hh_meta = (
+                            hh_info["cms"], int(hh_info["k"]),
+                            int(hh_info["depth"]), int(hh_info["width"]),
+                        )
+                    self.specs.append(spec)
+                    continue
                 if isinstance(default, list):
                     if red is dim_zero_cat or red is None:
                         self._add_list_spec(owner, metric, attr, red, val)
@@ -627,6 +666,7 @@ class PackedSyncPlan:
                 (
                     s.owner, s.attr, s.kind, s.dtype, s.shape, s.elem_shapes,
                     s.group, s.offset, s.size, s.world_dim0, s.was_list, s.fold_fn,
+                    s.hh_meta,
                 )
                 for s in self.specs
             ),
@@ -654,6 +694,11 @@ class PackedSyncPlan:
             for i, s in enumerate(specs)
             if s.kind in ("comp-sum", "comp-mean")
         }
+        # hh-ids specs pair with the hh-counts spec registered right after
+        # them (layout enforced at build time, like the comp-res pairing)
+        hh_pair: Dict[int, _Spec] = {
+            i: specs[i + 1] for i, s in enumerate(specs) if s.kind == "hh-ids"
+        }
 
         def fold(gathered: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             import jax.numpy as jnp
@@ -661,8 +706,8 @@ class PackedSyncPlan:
             out: Dict[str, Dict[str, Any]] = {}
             for spec_i, s in enumerate(specs):
                 dest = out.setdefault(s.owner, {})
-                if s.kind == "comp-res":
-                    continue  # folded with its paired comp-{sum,mean} value spec
+                if s.kind in ("comp-res", "hh-counts"):
+                    continue  # folded with their paired value / hh-ids spec
                 if s.kind == "cat" and (not s.group or (s.world_dim0 and max(s.world_dim0) == 0)):
                     # empty on every rank: lists stay [], arrays keep a 0-row shape
                     dest[s.attr] = (
@@ -698,6 +743,23 @@ class PackedSyncPlan:
                         res = res / len(members)
                     dest[s.attr] = total
                     dest[_numerics.SYNC_RES_PREFIX + s.attr] = res
+                elif s.kind == "hh-ids":
+                    # joint heavy-hitter fold (serve/sketch.py): the union of
+                    # every rank's top-k candidates, re-estimated against the
+                    # MERGED count-min grid — which this same fold already
+                    # summed (the grid's spec precedes the pair by contract),
+                    # so the merge is exactly a single-rank pass over the
+                    # union stream whenever each heavy id made some local list
+                    from torchmetrics_tpu.serve.sketch import merge_topk
+
+                    cms_attr, hh_k, hh_depth, hh_width = s.hh_meta
+                    stacked = seg.reshape((len(members),) + s.shape)
+                    ids, counts = merge_topk(
+                        dest[cms_attr], stacked.reshape((-1,)), hh_k, hh_depth, hh_width
+                    )
+                    dest[s.attr] = ids.astype(s.dtype)
+                    cs = hh_pair[spec_i]
+                    dest[cs.attr] = counts.astype(cs.dtype)
                 elif s.kind == "sentinel":
                     # per-bit max == bitwise OR: a health flag raised on ANY
                     # rank survives the cross-rank fold

@@ -1,0 +1,43 @@
+"""Streaming / serving subsystem: continuous-traffic evaluation (ROADMAP item 2).
+
+The engine (PRs 1–2) makes per-epoch evaluation fast; this package makes it
+SERVABLE — unbounded streams, millions of user slices, scrape-anytime
+semantics, all without host transfers in the hot loop:
+
+- :mod:`~torchmetrics_tpu.serve.window` — :class:`WindowedMetric` (ring of
+  partial states, advance/evict/fold in one donated dispatch) and
+  :class:`DecayedMetric` (EMA states) over any sum/max/min-state base metric;
+- :mod:`~torchmetrics_tpu.serve.sketch` — :class:`CardinalitySketch`
+  (HLL-style distinct counting, max-merge) and :class:`HeavyHitters`
+  (count-min + in-graph top-k) as fixed-memory first-class metric states;
+- :mod:`~torchmetrics_tpu.serve.tenancy` — :class:`TenantSlices`: bounded
+  per-tenant slices sharing ONE executable (tenant id is data), spilling to
+  the heavy-hitter sketch past capacity;
+- :mod:`~torchmetrics_tpu.serve.snapshot` — :func:`snapshot_compute`:
+  ``compute()`` on a shielded state copy while updates continue;
+- :mod:`~torchmetrics_tpu.serve.sidecar` — :class:`MetricsSidecar`: the PR-4
+  Prometheus/JSONL exporters behind a threaded scrape endpoint.
+
+See ``docs/pages/serving.md`` for semantics, error bounds, and knobs.
+"""
+
+from torchmetrics_tpu.serve.sidecar import MetricsSidecar
+from torchmetrics_tpu.serve.sketch import CardinalitySketch, HeavyHitters
+from torchmetrics_tpu.serve.snapshot import StateSnapshot, snapshot_compute, take_snapshot
+from torchmetrics_tpu.serve.stats import reset_serve_stats, serve_state
+from torchmetrics_tpu.serve.tenancy import TenantSlices
+from torchmetrics_tpu.serve.window import DecayedMetric, WindowedMetric
+
+__all__ = [
+    "CardinalitySketch",
+    "DecayedMetric",
+    "HeavyHitters",
+    "MetricsSidecar",
+    "StateSnapshot",
+    "TenantSlices",
+    "WindowedMetric",
+    "reset_serve_stats",
+    "serve_state",
+    "snapshot_compute",
+    "take_snapshot",
+]

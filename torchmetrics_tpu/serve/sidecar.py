@@ -1,0 +1,134 @@
+"""Threaded scrape endpoint: the PR-4 Prometheus exporter as a real sidecar.
+
+``diag/telemetry.py`` renders exposition text; this module serves it. A
+:class:`MetricsSidecar` binds a ``ThreadingHTTPServer`` on a daemon thread —
+stdlib only, no new dependencies — and answers:
+
+- ``GET /metrics``   → ``export_prometheus()`` text,
+  ``Content-Type: text/plain; version=0.0.4`` (the exposition-format
+  version a Prometheus scraper negotiates);
+- ``GET /telemetry`` → one ``telemetry_snapshot()`` as a JSON line
+  (``application/json``), the JSONL tail-dashboard feed;
+- ``GET /healthz``   → liveness probe.
+
+Every scrape is timed into the ``serve_scrape_latency_seconds`` histogram
+family (``diag/hist.py``) and the ``tm_tpu_serve_scrapes_total`` counters;
+scrape handlers run on server threads, so the hot update loop never blocks
+on a scraper — pair with :func:`~torchmetrics_tpu.serve.snapshot.
+snapshot_compute` for value reads.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from time import perf_counter
+from typing import Any, Optional
+
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.serve import stats as _serve_stats
+
+__all__ = ["MetricsSidecar", "PROMETHEUS_CONTENT_TYPE"]
+
+#: text exposition format 0.0.4 — what a Prometheus server's Accept header
+#: negotiates for the classic text format
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+class _ScrapeHandler(BaseHTTPRequestHandler):
+    server_version = "tm-tpu-sidecar/1.0"
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        t0 = perf_counter()
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/metrics", "/"):
+                from torchmetrics_tpu.diag.telemetry import export_prometheus
+
+                body = export_prometheus().encode()
+                ctype = PROMETHEUS_CONTENT_TYPE
+            elif path == "/telemetry":
+                from torchmetrics_tpu.diag.telemetry import telemetry_snapshot
+
+                body = (json.dumps(telemetry_snapshot(), sort_keys=True, default=str) + "\n").encode()
+                ctype = "application/json"
+            elif path == "/healthz":
+                body, ctype = b"ok\n", "text/plain"
+            else:
+                self.send_error(404, "unknown scrape path")
+                return
+        except Exception as exc:  # noqa: BLE001 — a scrape failure must answer, not hang
+            self.send_error(500, f"{type(exc).__name__}: {exc}")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        elapsed = perf_counter() - t0
+        _serve_stats.note_scrape(elapsed)
+        _hist.observe("sidecar", "serve", "scrape_us", round(elapsed * 1e6, 3))
+        _diag.record("serve.scrape", "sidecar", path=path, bytes=len(body))
+
+    def log_message(self, *_: Any) -> None:
+        """Silence the default stderr access log (scrapes are periodic)."""
+
+
+class MetricsSidecar:
+    """Daemon-thread HTTP scrape endpoint over the telemetry exporters.
+
+    Usage::
+
+        with MetricsSidecar() as sidecar:      # port 0 = ephemeral
+            print(sidecar.url)                 # http://127.0.0.1:PORT/metrics
+            ... hot loop keeps updating ...
+
+    ``port`` defaults to ``TORCHMETRICS_TPU_SERVE_PORT`` (0 → OS-assigned,
+    read back from :attr:`port` after :meth:`start`).
+    """
+
+    def __init__(self, port: Optional[int] = None, host: str = "127.0.0.1") -> None:
+        self._requested_port = _serve_stats.default_port() if port is None else int(port)
+        self.host = host
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        if self.port is None:
+            raise RuntimeError("sidecar not started")
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def start(self) -> "MetricsSidecar":
+        if self._server is not None:
+            raise RuntimeError("sidecar already started")
+        server = ThreadingHTTPServer((self.host, self._requested_port), _ScrapeHandler)
+        server.daemon_threads = True
+        self._server = server
+        self.port = server.server_address[1]
+        self._thread = threading.Thread(
+            target=server.serve_forever, name="tm-tpu-sidecar", daemon=True
+        )
+        self._thread.start()
+        _diag.record("serve.sidecar.start", "sidecar", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+        self.port = None
+
+    def __enter__(self) -> "MetricsSidecar":
+        return self.start()
+
+    def __exit__(self, *_: Any) -> None:
+        self.stop()

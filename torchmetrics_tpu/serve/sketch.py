@@ -1,0 +1,309 @@
+"""Fixed-memory sketch states as first-class ``Metric`` states.
+
+Serving millions of user slices needs answers to "how many distinct X?" and
+"which X dominate?" in memory that does NOT grow with the stream. Two classic
+sketches become ordinary metric states here, so they ride the engine's donated
+compiled updates and the packed epoch sync like any accumulator:
+
+- :class:`CardinalitySketch` — HyperLogLog-style distinct counting. State is a
+  fixed vector of int32 registers; the cross-rank merge is an **elementwise
+  max**, which is exactly the existing ``dist_reduce_fx="max"`` packed-spec
+  role — no new sync machinery, and merging rank registers is bit-identical to
+  hashing the union stream on one rank (the hash is seed-deterministic).
+- :class:`HeavyHitters` — count-min sketch + an in-graph top-k candidate list.
+  The count-min grid folds cross-rank by **elementwise sum** (the existing
+  reduce role; CMS(A) + CMS(B) == CMS(A ∪ B) exactly), while the
+  ``(ids, counts)`` top-k pair needs a JOINT fold against the merged grid —
+  registered as the ``hh-ids``/``hh-counts`` packed-spec role in
+  ``parallel/packing.py`` (the metric declares ``_hh_fold_info``; membership
+  is a function of the metric definition alone, so rank layouts cannot
+  desynchronize).
+
+All hashing stays in uint32 space (murmur3 finalizer) so the sketches behave
+identically with and without the x64 flag; ids must be non-negative (−1 is the
+empty-slot sentinel in the top-k list).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+__all__ = ["CardinalitySketch", "HeavyHitters", "cms_query", "hash_u32", "canon_u32"]
+
+#: independent seed constants (odd, high-entropy) for the hash family
+_SEED_INDEX = 0x9E3779B9
+_SEED_RHO = 0x85EBCA6B
+_CMS_SEEDS = (0xC2B2AE35, 0x27D4EB2F, 0x165667B1, 0xD3A2646C, 0xFD7046C5, 0xB55A4F09)
+
+
+def hash_u32(x: Array, seed: int) -> Array:
+    """Murmur3 finalizer over uint32 lanes — a seeded, well-mixed 32-bit hash."""
+    x = x.astype(jnp.uint32) ^ jnp.uint32(seed)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_u32_host(value: int, seed: int) -> int:
+    """:func:`hash_u32` for one Python int, pure host arithmetic.
+
+    Scrape-path slot resolution (``TenantSlices._host_slot``) must not
+    dispatch a device op per lookup — and more importantly must not read a
+    device result back outside a sanctioned boundary, which would raise under
+    the strict transfer guard mid-stream. Bit-for-bit the device hash
+    (pinned by test).
+    """
+    x = (int(value) ^ seed) & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+def canon_u32_host(value: int) -> int:
+    """:func:`canon_u32` for one non-negative Python int (host mirror)."""
+    value = int(value)
+    lo = value & 0xFFFFFFFF
+    hi = (value >> 32) & 0xFFFFFFFF
+    return lo if hi == 0 else lo ^ hash_u32_host(hi, _SEED_INDEX)
+
+
+def canon_u32(ids: Any) -> Array:
+    """Canonicalize an id array to uint32 hash input, dtype-stably.
+
+    64-bit integer ids fold their high word in ONLY when it is nonzero (so
+    ids past 2**32 don't collide wholesale, while any non-negative id that
+    fits 32 bits hashes identically whether it arrives as int32 or int64 —
+    i.e. with or without the x64 flag; an unconditional fold would XOR
+    ``hash(0)`` into every 64-bit id and put the same tenant in different
+    registers per input dtype). Floats hash their float32 bit pattern.
+    """
+    ids = jnp.asarray(ids)
+    if jnp.issubdtype(ids.dtype, jnp.floating):
+        return jax.lax.bitcast_convert_type(ids.astype(jnp.float32), jnp.uint32)
+    if jnp.dtype(ids.dtype).itemsize == 8:
+        lo = (ids & 0xFFFFFFFF).astype(jnp.uint32)
+        hi = (ids >> 32).astype(jnp.uint32)
+        return jnp.where(hi == 0, lo, lo ^ hash_u32(hi, _SEED_INDEX))
+    return ids.astype(jnp.uint32)
+
+
+def cms_query(cms: Array, u32: Array, depth: int, width: int) -> Array:
+    """Point-estimate counts for hashed ids: min over the depth rows."""
+    est = None
+    for d in range(depth):
+        idx = hash_u32(u32, _CMS_SEEDS[d]) & jnp.uint32(width - 1)
+        row = cms[d, idx]
+        est = row if est is None else jnp.minimum(est, row)
+    return est
+
+
+def _rank_zero_fold(stacked: Array) -> Array:
+    """Eager-sync fallback fold for the top-k pair: keep the local rank's list.
+
+    The exact joint fold (union of candidates re-estimated against the merged
+    count-min grid) only exists on the packed plan, where the merged grid is
+    available in the same fold graph. The eager per-state path folds each
+    state independently, so it keeps rank 0's list — approximate by design,
+    documented in ``docs/pages/serving.md``.
+    """
+    return stacked[0]
+
+
+class CardinalitySketch(Metric):
+    """HyperLogLog-style distinct counter in ``2**p`` int32 registers.
+
+    ``update(ids)`` hashes every id and scatter-maxes the leading-zero rank
+    into its register; ``compute()`` returns the bias-corrected estimate with
+    the linear-counting small-range correction. Standard error is
+    ``1.04 / sqrt(2**p)`` (~2.3% at the default ``p=11`` — inside the ±3%
+    serving bound at 10⁵ uniques).
+
+    Cross-rank sync is the plain ``max`` reduce role: registers merged by
+    elementwise max equal the registers of the union stream bit-for-bit.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.serve import CardinalitySketch
+        >>> sketch = CardinalitySketch()
+        >>> sketch.update(jnp.arange(1000))
+        >>> bool(abs(float(sketch.compute()) - 1000) < 100)
+        True
+    """
+
+    full_state_update = True
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(self, p: int = 11, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, int) and 4 <= p <= 18):
+            raise ValueError(f"Expected argument `p` to be an int in [4, 18] but got {p}")
+        self.p = p
+        self.m = 1 << p
+        self.add_state("registers", default=jnp.zeros((self.m,), jnp.int32), dist_reduce_fx="max")
+        from torchmetrics_tpu.serve import stats as _serve_stats
+
+        _serve_stats.register_sketch(self)
+
+    def update(self, ids: Any) -> None:
+        """Fold a batch of (non-negative integer or float) ids into the registers."""
+        u = canon_u32(ids).ravel()
+        idx = hash_u32(u, _SEED_INDEX) & jnp.uint32(self.m - 1)
+        # rank of the first set bit of an independent hash: clz+1, so a zero
+        # word reads as 33 (the standard "all bits zero" register ceiling)
+        rho = (jax.lax.clz(hash_u32(u, _SEED_RHO)) + 1).astype(jnp.int32)
+        self.registers = self.registers.at[idx].max(rho)
+
+    def compute(self) -> Array:
+        """Bias-corrected harmonic-mean estimate with small-range correction."""
+        regs = self.registers.astype(jnp.float32)
+        m = float(self.m)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        raw = alpha * m * m / jnp.sum(jnp.exp2(-regs))
+        zeros = jnp.sum(self.registers == 0).astype(jnp.float32)
+        linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+        return jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+
+    def fill_ratio(self) -> float:
+        """Fraction of touched registers — the scrape-side saturation gauge."""
+        from torchmetrics_tpu.serve.snapshot import read_host
+
+        regs = read_host(self, ("registers",))["registers"]
+        return float((regs > 0).mean())
+
+
+class HeavyHitters(Metric):
+    """Count-min sketch + in-graph top-k heavy-hitter list, fixed memory.
+
+    ``update(ids, weights=None)`` scatter-adds every id into the
+    ``(depth, width)`` count-min grid, re-estimates the union of the current
+    top-k candidates and the batch ids against the updated grid, dedupes
+    in-graph (sort + run-boundary mask, all fixed shapes) and keeps the new
+    top-k — one compiled graph, no host round-trip, ids as DATA (a stream of
+    distinct ids reuses one executable).
+
+    ``compute()`` returns ``(ids, counts)``; empty slots are ``-1`` / ``0``.
+    Counts are CMS point estimates: one-sided overestimates with error
+    ``<= e * N / width`` at probability ``1 - e^-depth``.
+
+    Cross-rank sync: the grid sums (exact); the ``(ids, counts)`` pair folds
+    jointly through the ``hh-ids``/``hh-counts`` packed role declared via
+    ``_hh_fold_info`` (union of per-rank candidates re-estimated against the
+    merged grid — identical to a single-rank pass whenever each true heavy
+    hitter made some rank's local list).
+    """
+
+    full_state_update = True
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(self, k: int = 32, depth: int = 4, width: int = 2048, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError(f"Expected argument `k` to be a positive int but got {k}")
+        if not (isinstance(depth, int) and 1 <= depth <= len(_CMS_SEEDS)):
+            raise ValueError(f"Expected argument `depth` to be an int in [1, {len(_CMS_SEEDS)}] but got {depth}")
+        if not (isinstance(width, int) and width >= 2 and (width & (width - 1)) == 0):
+            raise ValueError(f"Expected argument `width` to be a power-of-two int >= 2 but got {width}")
+        self.k = k
+        self.depth = depth
+        self.width = width
+        # id/count dtype rides the PR-8 count contract (int64 under x64):
+        # 64-bit ids store natively instead of silently truncating to int32,
+        # and the grid cells cannot wrap negative (a wrapped cell would make
+        # cms_query return a negative estimate and the heaviest hitter would
+        # rank BELOW empty slots). Without x64 no wider device integer exists
+        # — and no 64-bit id can enter either. Ids and counts share one
+        # dtype, so the top-k pair still rides a single gather buffer.
+        from torchmetrics_tpu.engine.numerics import count_dtype
+
+        idt = count_dtype()
+        # registration ORDER is load-bearing: the packed fold estimates the
+        # top-k candidates against the merged grid, so the grid's spec must
+        # precede the hh pair in the plan (parallel/packing.py enforces it)
+        self.add_state("cms", default=jnp.zeros((depth, width), idt), dist_reduce_fx="sum")
+        self.add_state("hh_ids", default=jnp.full((k,), -1, idt), dist_reduce_fx=_rank_zero_fold)
+        self.add_state("hh_counts", default=jnp.zeros((k,), idt), dist_reduce_fx=_rank_zero_fold)
+        # joint-fold declaration for parallel/packing.py: membership is a
+        # function of the metric DEFINITION (not live values), so every rank
+        # builds the same plan layout unconditionally
+        self._hh_fold_info = {
+            "ids": "hh_ids", "counts": "hh_counts", "cms": "cms",
+            "k": k, "depth": depth, "width": width,
+        }
+        from torchmetrics_tpu.serve import stats as _serve_stats
+
+        _serve_stats.register_sketch(self)
+
+    def update(self, ids: Any, weights: Optional[Any] = None) -> None:
+        """Fold a batch of non-negative integer ids (optionally weighted) in.
+
+        The grid hashes the SAME canonicalization the top-k stores (the
+        id-state dtype — int64 under x64, so wide ids never truncate; without
+        x64 no 64-bit input can exist), keeping CMS cells and re-estimation
+        queries aligned.
+        """
+        id_dtype = self.hh_ids.dtype
+        ids_cast = jnp.asarray(ids).ravel().astype(id_dtype)
+        u = canon_u32(ids_cast)
+        w = (
+            jnp.ones(ids_cast.shape, self.cms.dtype)
+            if weights is None
+            else jnp.asarray(weights).ravel().astype(self.cms.dtype)
+        )
+        cms = self.cms
+        for d in range(self.depth):
+            idx = hash_u32(u, _CMS_SEEDS[d]) & jnp.uint32(self.width - 1)
+            cms = cms.at[d, idx].add(w)
+        self.cms = cms
+        self.hh_ids, self.hh_counts = merge_topk(
+            cms, jnp.concatenate([self.hh_ids, ids_cast]), self.k, self.depth, self.width
+        )
+
+    def compute(self) -> Tuple[Array, Array]:
+        """The current top-k as ``(ids, counts)`` (empty slots ``-1`` / ``0``)."""
+        return self.hh_ids, self.hh_counts
+
+    def fill_ratio(self) -> float:
+        """Fraction of touched count-min cells — the scrape-side saturation gauge."""
+        from torchmetrics_tpu.serve.snapshot import read_host
+
+        cms = read_host(self, ("cms",))["cms"]
+        return float((cms > 0).mean())
+
+
+def merge_topk(cms: Array, candidate_ids: Array, k: int, depth: int, width: int) -> Tuple[Array, Array]:
+    """Top-k over a candidate id set, counts re-estimated from ``cms``.
+
+    Fixed-shape and jittable: duplicates collapse by sorting and masking the
+    non-first element of every equal run (all copies of one id carry the SAME
+    grid estimate, so keeping the first is exact); ``-1`` empties rank last.
+    Shared by :class:`HeavyHitters.update`, the spill path in
+    ``serve/tenancy.py``, and the ``hh-ids`` packed fold.
+    """
+    est = cms_query(cms, canon_u32(candidate_ids), depth, width)
+    neg_one = jnp.asarray(-1, cms.dtype)
+    est = jnp.where(candidate_ids < 0, neg_one, est.astype(cms.dtype))
+    order = jnp.argsort(candidate_ids)
+    sid = candidate_ids[order]
+    sest = est[order]
+    dup = jnp.concatenate([jnp.zeros((1,), bool), sid[1:] == sid[:-1]])
+    sest = jnp.where(dup, neg_one, sest)
+    top_est, top_pos = jax.lax.top_k(sest, k)
+    ids = jnp.where(top_est >= 0, sid[top_pos], jnp.asarray(-1, sid.dtype))
+    counts = jnp.maximum(top_est, 0)
+    return ids, counts

@@ -1,0 +1,224 @@
+"""Pause-free snapshot-compute: scrape-anytime ``compute()`` off the hot loop.
+
+A Prometheus scrape that calls ``metric.compute()`` on the live object would
+sync, re-anchor, and potentially unsync mid-stream — pausing the hot loop and
+racing its donation. This module makes scrapes a SHIELDED read instead:
+
+1. :func:`take_snapshot` grabs the state refs at a consistent watermark
+   (retrying around in-flight mutations via the ``_mutation_depth`` guard the
+   PR-7 preemption snapshots introduced) and immediately re-materializes each
+   leaf as a fresh device buffer (``jnp.array(copy=True)``). The copy is an
+   ASYNC device dispatch — the update thread never blocks — and it is what
+   donation-proofs the snapshot: the hot loop's next donated step consumes
+   the OLD buffers, not the snapshot's.
+2. :func:`snapshot_compute` runs the metric's raw compute body on a cached
+   scratch clone holding the snapshot state — rank-local by design (a scrape
+   reads THIS host's view; cross-rank totals belong to the epoch sync), so
+   nothing synchronizes, nothing unsyncs, and the live metric's caches and
+   counters are untouched.
+
+The flight recorder narrates both halves (``serve.snapshot`` /
+``serve.snapshot.read`` events, the read carrying ``updates_between`` — the
+proof that updates kept landing while the snapshot computed).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from time import perf_counter
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.serve import stats as _serve_stats
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = ["StateSnapshot", "read_host", "snapshot_compute", "take_snapshot"]
+
+#: scratch clones per live metric — built once (deepcopy), reused per scrape.
+#: Entries are ``id(metric) -> (weakref(metric), scratch)``: the weakref's
+#: finalize callback evicts the entry when the source metric dies (so clones
+#: holding device arrays cannot accumulate for the life of the process), and
+#: the liveness check guards against id reuse in the window before the
+#: callback runs.
+_SCRATCH: Dict[int, Any] = {}
+_SCRATCH_LOCK = threading.Lock()
+
+
+@dataclass
+class StateSnapshot:
+    """A donation-proof copy of one metric's state at a known watermark."""
+
+    state: Dict[str, Any]
+    update_count: int
+    retries: int = 0
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+
+def _copy_leaf(value: Any) -> Any:
+    if isinstance(value, list):
+        return [jnp.array(v, copy=True) for v in value]
+    return jnp.array(value, copy=True)
+
+
+def take_snapshot(metric: Any) -> StateSnapshot:
+    """Consistent, donation-proof state copy without pausing updates.
+
+    Consistency protocol: grab refs only while no mutation is in flight
+    (``_mutation_depth == 0``) and re-check the update watermark afterwards;
+    a concurrent update (or a donated buffer consumed between grab and copy)
+    retries, up to ``TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES`` attempts. The
+    final attempt's copy failing is a real error — a scrape must never
+    surface a torn state as a value.
+    """
+    import time
+
+    budget = _serve_stats.snapshot_retries()
+    last_exc: Any = None
+    for attempt in range(budget):
+        if attempt:
+            # yield the GIL so a concurrent mid-mutation update can actually
+            # finish between attempts (a bare spin would burn the whole retry
+            # budget inside one GIL slice), escalating to a short real sleep
+            time.sleep(0 if attempt < 3 else 0.001 * attempt)
+        if getattr(metric, "_mutation_depth", 0):
+            continue  # an update is mid-write; retry after the yield above
+        watermark = metric._update_count
+        refs = {}
+        for key in metric._defaults:
+            value = getattr(metric, key)
+            refs[key] = list(value) if isinstance(value, list) else value
+        if metric._update_count != watermark or getattr(metric, "_mutation_depth", 0):
+            continue  # the watermark moved under us — refs may be torn
+        try:
+            copies = {key: _copy_leaf(value) for key, value in refs.items()}
+        except Exception as exc:  # noqa: BLE001 — a donated-away buffer between grab and copy
+            last_exc = exc
+            continue
+        extras = {}
+        quarantined = metric.__dict__.get("_quarantined_count")
+        if quarantined is not None:
+            extras["_quarantined_count"] = _copy_leaf(quarantined)
+        residuals = metric.__dict__.get("_comp_residuals")
+        if residuals:
+            extras["_comp_residuals"] = {k: _copy_leaf(v) for k, v in residuals.items()}
+        _diag.record(
+            "serve.snapshot", type(metric).__name__,
+            update_count=int(watermark), retries=attempt,
+        )
+        _serve_stats.note_snapshot(attempt)
+        return StateSnapshot(state=copies, update_count=int(watermark), retries=attempt, extras=extras)
+    raise TorchMetricsUserError(
+        f"Could not take a consistent snapshot of {type(metric).__name__} within"
+        f" {budget} attempts (TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES); the update"
+        f" loop never quiesced between dispatches." + (f" Last error: {last_exc}" if last_exc else "")
+    )
+
+
+def read_host(metric: Any, attrs: Any, index: Any = None) -> Dict[str, Any]:
+    """Scrape-path host read of named states with the snapshot retry discipline.
+
+    The serving views (tenant tables, sketch registers) read LIVE buffers that
+    a donated hot-loop dispatch may consume mid-read — the same race
+    :func:`take_snapshot` arbitrates. This shares its protocol (mutation-depth
+    gate, GIL yield between attempts, retry on a consumed buffer) for reads
+    that only need a few numpy arrays, not a full donation-proof copy; the
+    fetch itself rides the sanctioned ``serve-scrape`` boundary.
+
+    ``index`` (optional) selects ``state[index]`` device-side before the
+    transfer — a per-tenant view moves one row per state to host, not the
+    whole capacity-sized table.
+    """
+    import time
+
+    import numpy as np
+
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    attrs = tuple(attrs)
+    budget = _serve_stats.snapshot_retries()
+    last_exc: Any = None
+    for attempt in range(budget):
+        if attempt:
+            time.sleep(0 if attempt < 3 else 0.001 * attempt)
+        if getattr(metric, "_mutation_depth", 0):
+            continue
+        try:
+            with transfer_allowed("serve-scrape"):
+                if index is None:
+                    return {a: np.asarray(getattr(metric, a)) for a in attrs}
+                return {a: np.asarray(getattr(metric, a)[index]) for a in attrs}
+        except Exception as exc:  # noqa: BLE001 — a donated-away buffer mid-read
+            last_exc = exc
+            continue
+    raise TorchMetricsUserError(
+        f"Could not read {attrs} from {type(metric).__name__} within {budget}"
+        f" attempts (TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES)."
+        + (f" Last error: {last_exc}" if last_exc else "")
+    )
+
+
+def _scratch_for(metric: Any) -> Any:
+    """The cached compute-only clone for this metric instance (built once)."""
+    import weakref
+
+    key = id(metric)
+    with _SCRATCH_LOCK:
+        entry = _SCRATCH.get(key)
+        if entry is None or entry[0]() is not metric:
+            scratch = metric.clone()
+            # scrape computes are rank-local reads: never sync, never cache
+            scratch.sync_on_compute = False
+            scratch._to_sync = False
+            scratch.compute_with_cache = False
+
+            def _evict(_ref: Any, _key: int = key) -> None:
+                # lock-free on purpose: the callback can fire from GC at ANY
+                # allocation — including inside the locked clone above, where
+                # taking the (non-reentrant) lock again would deadlock.
+                # dict.pop is GIL-atomic, which is all the atomicity needed.
+                _SCRATCH.pop(_key, None)
+
+            # the per-entry lock serializes CONCURRENT scrapes of one metric:
+            # install/compute/restore on the shared scratch is a critical
+            # section (two unlocked scrapes would interleave their state
+            # installs and return each other's values)
+            _SCRATCH[key] = entry = (weakref.ref(metric, _evict), scratch, threading.Lock())
+    return entry
+
+
+def snapshot_compute(metric: Any, snapshot: StateSnapshot = None) -> Any:
+    """``compute()`` on a shielded copy while the live metric keeps updating.
+
+    Returns the computed value for the snapshot's watermark. The live
+    metric's state, caches (``_computed``), and sync status are untouched;
+    between :func:`take_snapshot` and the value read the hot loop keeps
+    dispatching — the ``serve.snapshot.read`` event records how many updates
+    landed in that window.
+    """
+    if snapshot is None:
+        snapshot = take_snapshot(metric)
+    _ref, scratch, lock = _scratch_for(metric)
+    t0 = perf_counter()
+    with lock:
+        prior = dict(scratch.__dict__)
+        try:
+            for key, value in snapshot.state.items():
+                object.__setattr__(scratch, key, value)
+            for key, value in snapshot.extras.items():
+                object.__setattr__(scratch, key, value)
+            object.__setattr__(scratch, "_update_count", max(snapshot.update_count, 1))
+            object.__setattr__(scratch, "_computed", None)
+            value = scratch._raw_compute()
+        finally:
+            scratch.__dict__.clear()
+            scratch.__dict__.update(prior)
+    _diag.record(
+        "serve.snapshot.read", type(metric).__name__,
+        update_count=snapshot.update_count,
+        updates_between=int(metric._update_count) - snapshot.update_count,
+        compute_us=round((perf_counter() - t0) * 1e6, 3),
+    )
+    return value

@@ -1,0 +1,288 @@
+"""Multi-tenant slice registry: per-cohort metric views in fixed memory.
+
+Serving evaluation for millions of users means per-segment metrics ("accuracy
+for cohort 48213") without a Python object — let alone a compiled executable —
+per segment. :class:`TenantSlices` holds ONE set of slotted state arrays
+(``capacity`` rows per base state) and routes every update by tenant id **as
+data**: the id enters the compiled graph as an array argument, the slot
+lookup is an in-graph open-addressing probe, and the scatter-accumulate lands
+in the same donated dispatch — so 10⁴ (or 10⁶) distinct tenants share ONE
+executable signature with zero warm retraces.
+
+Cardinality is bounded: when the table is full (or a probe chain is
+exhausted), the update spills to a built-in heavy-hitter sketch
+(``serve/sketch.py`` states, flat on this metric — no nested Metric), so the
+spilled traffic keeps its volume accounting and its dominant tenants remain
+identifiable in fixed memory. A dump row at index ``capacity`` absorbs
+spilled contributions, which keeps :meth:`compute`'s GLOBAL aggregate exact
+even past capacity.
+
+Cross-rank semantics: the slotted arrays carry standard sum/max/min
+reductions, so the packed sync folds them elementwise — exact whenever ranks
+assign tenants to the same slots (same arrival order, or a pre-warmed table);
+the spill sketch folds exactly via the ``hh-ids`` packed role. Per-tenant
+VIEWS are host-side scrape reads (:meth:`tenant_value`) riding a sanctioned
+transfer boundary — never part of the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.serve import stats as _serve_stats
+from torchmetrics_tpu.serve.sketch import (
+    _CMS_SEEDS,
+    _SEED_INDEX,
+    _rank_zero_fold,
+    canon_u32,
+    canon_u32_host,
+    hash_u32,
+    hash_u32_host,
+    merge_topk,
+)
+from torchmetrics_tpu.serve.snapshot import read_host
+from torchmetrics_tpu.serve.window import (
+    capture_np_defaults,
+    check_streamable,
+    extract_contribution,
+    run_base_compute,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+__all__ = ["TenantSlices"]
+
+
+class TenantSlices(Metric):
+    """Fixed-capacity per-tenant metric slices over one template metric.
+
+    Args:
+        template: the per-slice metric definition (sum/max/min states only —
+            the :func:`~torchmetrics_tpu.serve.window.check_streamable`
+            algebra; ``MeanMetric``'s sum/count formulation works).
+        capacity: tenant slots (power of two; default
+            ``TORCHMETRICS_TPU_SERVE_CAPACITY`` → 4096).
+        probes: linear-probe chain length per lookup (fixed, in-graph).
+        spill_k / spill_depth / spill_width: heavy-hitter sketch geometry for
+            the over-capacity spill.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> from torchmetrics_tpu.serve import TenantSlices
+        >>> slices = TenantSlices(SumMetric(nan_strategy=0.0), capacity=64)
+        >>> slices.update(jnp.asarray(7), jnp.asarray(2.0))
+        >>> slices.update(jnp.asarray(9), jnp.asarray(5.0))
+        >>> slices.update(jnp.asarray(7), jnp.asarray(1.0))
+        >>> float(slices.tenant_value(7)), float(slices.tenant_value(9))
+        (3.0, 5.0)
+    """
+
+    _engine_traced_bodies = frozenset({"template"})
+    full_state_update = True
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(
+        self,
+        template: Metric,
+        capacity: Optional[int] = None,
+        probes: int = 8,
+        spill_k: int = 32,
+        spill_depth: int = 4,
+        spill_width: int = 2048,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self._slot_folds = check_streamable(template, type(self).__name__)
+        if capacity is None:
+            capacity = _serve_stats.default_capacity()
+        if not (isinstance(capacity, int) and capacity >= 2 and (capacity & (capacity - 1)) == 0):
+            raise TorchMetricsUserError(
+                f"Expected argument `capacity` to be a power-of-two int >= 2 but got {capacity}"
+            )
+        if not (isinstance(probes, int) and probes >= 1):
+            raise ValueError(f"Expected argument `probes` to be a positive int but got {probes}")
+        self.template = template
+        self.capacity = capacity
+        self.probes = min(probes, capacity)
+        self._base_keys = tuple(template._defaults)
+        # slot table: -1 = empty; row `capacity` is the spill dump row, so an
+        # exhausted probe chain scatters there instead of wrapping to row -1
+        # ids and every counter ride the PR-8 count dtype (int64 under x64):
+        # wide tenant ids store without truncation, and long-lived counters /
+        # sketch cells cannot wrap at 2**31
+        from torchmetrics_tpu.engine.numerics import count_dtype
+
+        idt = count_dtype()
+        self.add_state("tenant_ids", default=jnp.full((capacity + 1,), -1, idt), dist_reduce_fx=_rank_zero_fold)
+        self.add_state("tenant_counts", default=jnp.zeros((capacity + 1,), idt), dist_reduce_fx="sum")
+        for key in self._base_keys:
+            default = template._defaults[key]
+            slotted = jnp.broadcast_to(default, (capacity + 1,) + tuple(default.shape))
+            self.add_state("seg_" + key, default=slotted, dist_reduce_fx=template._reductions[key])
+        # spill accounting: exact volume + heavy-hitter sketch (flat states —
+        # registration order matters: the grid precedes the hh pair, which the
+        # packed hh-ids fold requires)
+        self.add_state("spilled", default=jnp.zeros((), idt), dist_reduce_fx="sum")
+        self.add_state("spill_cms", default=jnp.zeros((spill_depth, spill_width), idt), dist_reduce_fx="sum")
+        self.add_state("spill_ids", default=jnp.full((spill_k,), -1, idt), dist_reduce_fx=_rank_zero_fold)
+        self.add_state("spill_counts", default=jnp.zeros((spill_k,), idt), dist_reduce_fx=_rank_zero_fold)
+        self._hh_fold_info = {
+            "ids": "spill_ids", "counts": "spill_counts", "cms": "spill_cms",
+            "k": spill_k, "depth": spill_depth, "width": spill_width,
+        }
+        self._spill_geom = (spill_k, spill_depth, spill_width)
+        self._np_defaults = capture_np_defaults(template, self._base_keys)
+        _serve_stats.register_tenancy(self)
+
+    # ------------------------------------------------------------------ update
+
+    def _lookup(self, table: Array, tid: Array) -> Array:
+        """In-graph probe: slot index for ``tid``, or ``capacity`` (spill)."""
+        h0 = hash_u32(canon_u32(tid), _SEED_INDEX)
+        offsets = jnp.arange(self.probes, dtype=jnp.uint32)
+        idx = ((h0 + offsets) & jnp.uint32(self.capacity - 1)).astype(jnp.int32)
+        vals = table[idx]
+        is_me = vals == tid
+        is_empty = vals < 0
+        found_slot = idx[jnp.argmax(is_me)]
+        empty_slot = idx[jnp.argmax(is_empty)]
+        return jnp.where(
+            jnp.any(is_me),
+            found_slot,
+            jnp.where(jnp.any(is_empty), empty_slot, jnp.int32(self.capacity)),
+        )
+
+    def update(self, tenant_id: Any, *args: Any, **kwargs: Any) -> None:
+        """Fold one tenant's batch into its slice — id is data, one graph.
+
+        ``tenant_id`` is a non-negative integer scalar (array or Python int).
+        A stream of distinct tenants reuses one compiled signature; spills
+        past capacity land in the dump row + heavy-hitter sketch.
+        """
+        tid = jnp.asarray(tenant_id).astype(self.tenant_ids.dtype).reshape(())
+        contrib = extract_contribution(
+            self.template, self._np_defaults, self._base_keys,
+            type(self).__name__, args, kwargs,
+        )
+        # negative ids collide with the -1 empty-slot sentinel (the probe
+        # would "find" an empty cell and contaminate whichever tenant later
+        # claims it) — route them straight to the spill/dump row instead
+        slot = jnp.where(
+            tid < 0, jnp.int32(self.capacity), self._lookup(self.tenant_ids, tid)
+        )
+        spilling = slot == self.capacity
+        # claiming is idempotent for a found slot and harmless for the dump
+        # row (its id cell is trash by definition)
+        self.tenant_ids = self.tenant_ids.at[slot].set(tid)
+        self.tenant_counts = self.tenant_counts.at[slot].add(1)
+        for key in self._base_keys:
+            seg = getattr(self, "seg_" + key)
+            kind = self._slot_folds[key][0]
+            ref = seg.at[slot]
+            seg = (ref.add if kind == "sum" else ref.max if kind == "max" else ref.min)(contrib[key])
+            setattr(self, "seg_" + key, seg)
+        # spill path: weight-0 scatter when not spilling keeps the graph
+        # branch-free (and the executable shared) for both cases
+        self.spilled = self.spilled + spilling.astype(self.spilled.dtype)
+        spill_k, spill_depth, spill_width = self._spill_geom
+        cms = self.spill_cms
+        w = spilling.astype(cms.dtype)
+        u = canon_u32(tid).reshape((1,))
+        for d in range(spill_depth):
+            cidx = hash_u32(u, _CMS_SEEDS[d]) & jnp.uint32(spill_width - 1)
+            cms = cms.at[d, cidx].add(w)
+        self.spill_cms = cms
+        candidate = jnp.where(spilling, tid, jnp.asarray(-1, tid.dtype)).reshape((1,))
+        self.spill_ids, self.spill_counts = merge_topk(
+            cms, jnp.concatenate([self.spill_ids, candidate]), spill_k, spill_depth, spill_width
+        )
+
+    # ------------------------------------------------------------------ compute
+
+    def compute(self) -> Any:
+        """GLOBAL aggregate across every tenant (dump row included — exact)."""
+        folded = {}
+        for key in self._base_keys:
+            seg = getattr(self, "seg_" + key)
+            kind = self._slot_folds[key][0]
+            folded[key] = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[kind](seg, axis=0)
+        return run_base_compute(self.template, folded)
+
+    # ------------------------------------------------------------------ views
+
+    def _host_slot(self, tenant_id: int, table: Optional[np.ndarray] = None) -> Optional[int]:
+        if int(tenant_id) < 0:
+            return None  # negative ids are spill-routed, never slotted
+        if table is None:
+            table = read_host(self, ("tenant_ids",))["tenant_ids"]
+        # pure host arithmetic (bit-for-bit the device hash, pinned by test):
+        # a device dispatch + readback here would trip the strict transfer
+        # guard when a scrape lands mid-stream
+        h0 = hash_u32_host(canon_u32_host(tenant_id), _SEED_INDEX)
+        for j in range(self.probes):
+            idx = (h0 + j) & (self.capacity - 1)
+            if table[idx] == int(tenant_id):
+                return idx
+            if table[idx] < 0:
+                return None
+        return None
+
+    def tenant_value(self, tenant_id: int) -> Optional[Any]:
+        """This tenant's computed metric value, or None when never tracked.
+
+        A scrape-path read: the table and slotted rows come to host through
+        :func:`~torchmetrics_tpu.serve.snapshot.read_host` — the sanctioned,
+        donation-race-retrying boundary — and the compute itself is the
+        template's raw body over the slot's state row.
+        """
+        slot = self._host_slot(tenant_id)
+        if slot is None:
+            return None
+        # one row per state crosses to host, not the capacity-sized tables
+        # (the device-side index happens inside the same retried boundary)
+        rows = read_host(self, tuple("seg_" + k for k in self._base_keys), index=slot)
+        states = {key: jnp.asarray(rows["seg_" + key]) for key in self._base_keys}
+        return run_base_compute(self.template, states)
+
+    def tenant_updates(self, tenant_id: int) -> int:
+        """Updates this tenant has received (0 when untracked/spilled).
+
+        The per-slot counter behind this read is what makes slice traffic
+        attributable at scrape time — `tenant_value` answers "what", this
+        answers "over how many updates".
+        """
+        if int(tenant_id) < 0:
+            return 0
+        host = read_host(self, ("tenant_ids", "tenant_counts"))
+        slot = self._host_slot(tenant_id, table=host["tenant_ids"])
+        return 0 if slot is None else int(host["tenant_counts"][slot])
+
+    def tenant_count(self) -> int:
+        """Live tracked tenants (scrape-path host read, race-retried)."""
+        table = read_host(self, ("tenant_ids",))["tenant_ids"]
+        return int((table[: self.capacity] >= 0).sum())
+
+    def spilled_count(self) -> int:
+        """Updates that spilled past capacity (scrape-path host read)."""
+        return int(read_host(self, ("spilled",))["spilled"])
+
+    def spill_report(self) -> Dict[str, Any]:
+        """Spilled volume + the dominant spilled tenants from the sketch."""
+        host = read_host(self, ("spill_ids", "spill_counts", "spilled"))
+        ids, counts, spilled = host["spill_ids"], host["spill_counts"], int(host["spilled"])
+        live = ids >= 0
+        return {
+            "spilled_updates": spilled,
+            "heavy_hitters": [
+                {"tenant": int(i), "estimate": int(c)}
+                for i, c in zip(ids[live].tolist(), counts[live].tolist())
+            ],
+        }

@@ -1,0 +1,354 @@
+"""In-graph streaming aggregation: windowed rings and exponential decay.
+
+``wrappers/running.py`` keeps a trailing window by snapshotting the FULL base
+state once per update on the host path — O(window) state copies, O(window)
+Python attribute traffic per step, and ``compute`` replays a host-side
+merge per slot. For a serving loop over an unbounded stream that is the wrong
+shape entirely. This module re-expresses the same semantics device-first:
+
+- :class:`WindowedMetric` — a fixed ring of ``buckets`` partial states, each
+  covering ``bucket_size`` updates. Advance (ring cursor), evict (reset the
+  re-entered slot to its default) and fold (batch contribution into the
+  cursor slot) all lower into ONE donated engine dispatch per step; memory is
+  ``buckets ×`` the base state, independent of stream length.
+- :class:`DecayedMetric` — exponential time-decay (EMA) states: additive base
+  states accumulate as ``state = decay * state + contribution``, so the
+  effective window is ``1 / (1 - decay)`` updates with O(1) state.
+
+Both wrappers hold their base metric purely as a TRACED BODY: the batch
+contribution comes from running the base's raw update on default states with
+the engine's own snapshot/restore hygiene (``traced_update``), never from the
+base's live host machinery — which is why they may declare
+the traced-body attribute in ``_engine_traced_bodies`` and compile despite
+owning an inner Metric.
+Ring/EMA states are ordinary registered states with standard reductions, so
+the packed epoch sync (``parallel/packing.py``) moves them with zero new
+collective roles and — all shapes being fixed — zero metadata gathers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.engine.compiled import _Ineligible, traced_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_max, dim_zero_min, dim_zero_sum
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+__all__ = ["DecayedMetric", "WindowedMetric"]
+
+#: reductions a streaming wrapper can fold per-slot / per-tick: each is an
+#: associative merge whose identity element is the registered default
+_FOLDS = {
+    dim_zero_sum: ("sum", jnp.add),
+    dim_zero_max: ("max", jnp.maximum),
+    dim_zero_min: ("min", jnp.minimum),
+}
+
+
+def check_streamable(base: Metric, wrapper: str) -> Dict[str, Tuple[str, Any]]:
+    """Validate a base metric for streaming wrappers; returns attr -> fold.
+
+    Eligible: fixed-shape array states whose reduction is sum/max/min and —
+    for sum — whose default is the additive identity (all-zero). Mean-reduced
+    states are rejected with a pointer at the sum/count formulation
+    (``MeanMetric`` already uses it); list/cat/None/custom states have no
+    slot-merge algebra.
+    """
+    import numpy as np
+
+    if not isinstance(base, Metric):
+        raise TorchMetricsUserError(
+            f"Expected the base metric to be a `torchmetrics_tpu.Metric` but got {base!r}"
+        )
+    folds: Dict[str, Tuple[str, Any]] = {}
+    for attr, red in base._reductions.items():
+        default = base._defaults[attr]
+        if isinstance(default, list):
+            raise TorchMetricsUserError(
+                f"{wrapper} cannot stream metric {type(base).__name__!r}: list state"
+                f" {attr!r} grows unboundedly — a fixed-memory window cannot hold it."
+            )
+        fold = _FOLDS.get(red)
+        if fold is None:
+            hint = (
+                " (mean-reduced states have no per-slot identity; use a sum/count"
+                " formulation like MeanMetric's instead)"
+                if red is not None and getattr(red, "__name__", "") == "dim_zero_mean"
+                else ""
+            )
+            raise TorchMetricsUserError(
+                f"{wrapper} cannot stream metric {type(base).__name__!r}: state {attr!r}"
+                f" has an unsupported reduction{hint}; only sum/max/min states fold"
+                " into ring slots."
+            )
+        from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+        # one-time construction read of the registered default (the sentinel's
+        # "sentinel-setup" precedent) — never on the update path
+        with transfer_allowed("serve-setup"):
+            nonzero_default = bool(np.asarray(default).any())
+        if fold[0] == "sum" and nonzero_default:
+            raise TorchMetricsUserError(
+                f"{wrapper} cannot stream metric {type(base).__name__!r}: sum-reduced"
+                f" state {attr!r} has a non-zero default, so the default is not the"
+                " fold identity an evicted slot resets to."
+            )
+        if fold[0] in ("max", "min") and np.issubdtype(np.asarray(default).dtype, np.floating):
+            # never-written / evicted slots hold the default, and the
+            # across-slot fold treats them as transparent ONLY if the default
+            # is the fold identity (−inf for max, +inf for min — what
+            # Max/MinMetric register). A 0-default max state over an
+            # all-negative stream would silently report 0. Integer extremum
+            # states are exempt: their identity is domain-dependent (e.g. 0
+            # is correct for non-negative rank registers) — documented.
+            identity = -np.inf if fold[0] == "max" else np.inf
+            with transfer_allowed("serve-setup"):
+                is_identity = bool((np.asarray(default) == identity).all())
+            if not is_identity:
+                raise TorchMetricsUserError(
+                    f"{wrapper} cannot stream metric {type(base).__name__!r}:"
+                    f" {fold[0]}-reduced float state {attr!r} has default"
+                    f" {np.asarray(default)!r}, not the fold identity"
+                    f" ({identity}) an evicted slot resets to."
+                )
+        folds[attr] = fold
+    return folds
+
+
+def capture_np_defaults(base: Metric, keys: Tuple[str, ...]) -> Dict[str, Any]:
+    """Numpy copies of the base defaults, captured ONCE under the sanctioned
+    boundary: referencing a live jax array inside a traced body embeds it as a
+    graph constant, and materializing that constant reads the device buffer —
+    which the strict transfer guard correctly flags. A numpy-backed constant
+    is host data and trips nothing. Shared by every traced-body wrapper
+    (windows, decay, tenancy) so the hygiene cannot drift between them.
+    """
+    import numpy as np
+
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    with transfer_allowed("serve-setup"):
+        return {k: np.asarray(base._defaults[k]) for k in keys}
+
+
+def extract_contribution(
+    base: Metric,
+    np_defaults: Dict[str, Any],
+    keys: Tuple[str, ...],
+    wrapper: str,
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+) -> Dict[str, Any]:
+    """The batch's pure contribution: base raw update on default states.
+
+    Runs under :func:`traced_update` snapshot/restore hygiene; eagerly
+    (outside an engine trace) a side-effectful base body is a hard semantic
+    error, not a fallback.
+    """
+    defaults = {k: jnp.asarray(np_defaults[k]) for k in keys}
+    try:
+        return traced_update(base, defaults, args, kwargs)
+    except _Ineligible as exc:
+        raise TorchMetricsUserError(
+            f"{wrapper} cannot stream {type(base).__name__!r}: {exc}"
+        ) from exc
+
+
+def run_base_compute(base: Metric, states: Dict[str, Any]) -> Any:
+    """Run the base's raw compute body on the given state values, hygienically.
+
+    The base's ``__dict__`` is snapshotted and restored wholesale (the
+    ``traced_update`` discipline), so neither a host call nor a trace can leak
+    values onto the live object. ``_update_count`` is pinned to 1: the window
+    has folded real updates into these states, and raw compute bodies only
+    ever read the count through mean weighting, which sum/count-style bases do
+    via their own states.
+    """
+    snapshot = dict(base.__dict__)
+    try:
+        for key, value in states.items():
+            object.__setattr__(base, key, value)
+        object.__setattr__(base, "_update_count", 1)
+        return base._raw_compute()
+    finally:
+        base.__dict__.clear()
+        base.__dict__.update(snapshot)
+
+
+class _StreamingWrapper(Metric):
+    """Shared base: contribution extraction + base-compute plumbing."""
+
+    #: engine/compiled.py eligibility exemption — ATTRIBUTE-scoped: only the
+    #: named inner metric is used as a traced body under snapshot/restore
+    #: hygiene; any other nested metric still disqualifies compilation
+    _engine_traced_bodies = frozenset({"base_metric"})
+    #: forward must use the safe two-update path: the reduce path's
+    #: reset+merge would misalign the ring cursor / decay tick
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._slot_folds = check_streamable(base_metric, type(self).__name__)
+        self.base_metric = base_metric
+        self._base_keys = tuple(base_metric._defaults)
+        self._np_defaults = capture_np_defaults(base_metric, self._base_keys)
+
+    def _default_of(self, key: str) -> Any:
+        """The base state's default as a trace-safe (numpy-backed) constant."""
+        return jnp.asarray(self._np_defaults[key])
+
+    def _contribution(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """The batch's pure contribution: base raw update on default states."""
+        return extract_contribution(
+            self.base_metric, self._np_defaults, self._base_keys,
+            type(self).__name__, args, kwargs,
+        )
+
+    def plot(
+        self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Optional[Any] = None
+    ) -> Any:
+        return self._plot(val, ax)
+
+
+class WindowedMetric(_StreamingWrapper):
+    """Trailing-window metric over a fixed ring of partial states.
+
+    The window covers the last ``buckets * bucket_size`` updates at
+    ``bucket_size``-update granularity: each ring slot accumulates
+    ``bucket_size`` consecutive updates, and re-entering a slot after a full
+    revolution evicts it (resets to the registered default) in the same
+    graph. ``compute()`` folds all slots with the base reduction — evicted
+    and never-written slots hold the fold identity, so no occupancy mask is
+    needed — and runs the base's compute body on the folded state.
+
+    Unlike :class:`~torchmetrics_tpu.wrappers.running.Running` (O(window)
+    host-side state snapshots per update, exact per-update granularity), the
+    ring is O(buckets) device memory with advance/evict/fold compiled into
+    one donated dispatch per step.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> from torchmetrics_tpu.serve import WindowedMetric
+        >>> metric = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=3, bucket_size=1)
+        >>> for v in (1.0, 2.0, 3.0, 4.0):
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())  # sum over the trailing window {2, 3, 4}
+        9.0
+    """
+
+    def __init__(self, base_metric: Metric, buckets: int = 8, bucket_size: int = 1, **kwargs: Any) -> None:
+        super().__init__(base_metric, **kwargs)
+        if not (isinstance(buckets, int) and buckets > 0):
+            raise ValueError(f"Expected argument `buckets` to be a positive int but got {buckets}")
+        if not (isinstance(bucket_size, int) and bucket_size > 0):
+            raise ValueError(f"Expected argument `bucket_size` to be a positive int but got {bucket_size}")
+        self.buckets = buckets
+        self.bucket_size = bucket_size
+        for key in self._base_keys:
+            default = base_metric._defaults[key]
+            ring_default = jnp.broadcast_to(default, (buckets,) + tuple(default.shape))
+            # slot-merge algebra == cross-rank algebra: per-slot partials fold
+            # elementwise across ranks with the base state's own reduction
+            self.add_state("win_" + key, default=ring_default, dist_reduce_fx=base_metric._reductions[key])
+        # lockstep tick counter; max-reduced so a cross-rank sync cannot
+        # double-count the shared clock. Dtype rides the PR-8 count contract
+        # (engine/numerics.count_dtype: int64 under x64, resolved at creation)
+        # — an unbounded serving stream must not wrap its clock at 2**31.
+        from torchmetrics_tpu.engine.numerics import count_dtype
+
+        self.add_state("clock", default=jnp.zeros((), count_dtype()), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """One stream tick: contribution + advance/evict/fold, one graph."""
+        contrib = self._contribution(args, kwargs)
+        clock = self.clock
+        cursor = (clock // self.bucket_size) % self.buckets
+        entering = (clock % self.bucket_size) == 0
+        for key in self._base_keys:
+            ring = getattr(self, "win_" + key)
+            # evict-on-entry: the slot re-entered after a full revolution
+            # restarts from the registered default (the fold identity)
+            slot = jnp.where(entering, self._default_of(key), ring[cursor])
+            merged = self._slot_folds[key][1](slot, contrib[key])
+            setattr(self, "win_" + key, ring.at[cursor].set(merged))
+        self.clock = clock + jnp.asarray(1, clock.dtype)
+
+    def compute(self) -> Any:
+        """Fold the ring across slots and run the base compute on the result."""
+        across = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}
+        folded = {
+            key: across[self._slot_folds[key][0]](getattr(self, "win_" + key), axis=0)
+            for key in self._base_keys
+        }
+        return run_base_compute(self.base_metric, folded)
+
+
+class DecayedMetric(_StreamingWrapper):
+    """Exponentially time-decayed metric states (EMA over the update stream).
+
+    Additive (sum-reduced) base states accumulate as
+    ``state = decay * state + contribution`` per update; max/min states fold
+    undecayed (a decayed extremum has no meaning). A sum/count base like
+    ``MeanMetric`` therefore yields a genuine EMA mean — numerator and
+    denominator decay together. The effective window is ``1 / (1 - decay)``
+    updates; pass ``half_life`` to derive ``decay = 0.5 ** (1 / half_life)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> from torchmetrics_tpu.serve import DecayedMetric
+        >>> metric = DecayedMetric(SumMetric(nan_strategy=0.0), decay=0.5)
+        >>> for v in (4.0, 2.0, 1.0):
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())  # 4*0.25 + 2*0.5 + 1
+        3.0
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        decay: Optional[float] = None,
+        half_life: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(base_metric, **kwargs)
+        if (decay is None) == (half_life is None):
+            raise ValueError("Provide exactly one of `decay` or `half_life`")
+        if half_life is not None:
+            if not (isinstance(half_life, int) and half_life > 0):
+                raise ValueError(f"Expected argument `half_life` to be a positive int but got {half_life}")
+            decay = 0.5 ** (1.0 / half_life)
+        if not (isinstance(decay, float) and 0.0 < decay < 1.0):
+            raise ValueError(f"Expected argument `decay` to be a float in (0, 1) but got {decay}")
+        self.decay = decay
+        for key in self._base_keys:
+            self.add_state(
+                "ema_" + key,
+                default=base_metric._defaults[key],
+                dist_reduce_fx=base_metric._reductions[key],
+            )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """One stream tick: decay additive states, fold the contribution in."""
+        contrib = self._contribution(args, kwargs)
+        for key in self._base_keys:
+            kind, fold = self._slot_folds[key]
+            state = getattr(self, "ema_" + key)
+            if kind == "sum":
+                state = state * jnp.asarray(self.decay, state.dtype) + contrib[key]
+            else:
+                state = fold(state, contrib[key])
+            setattr(self, "ema_" + key, state)
+
+    def compute(self) -> Any:
+        """Run the base compute on the decayed states."""
+        return run_base_compute(
+            self.base_metric, {key: getattr(self, "ema_" + key) for key in self._base_keys}
+        )

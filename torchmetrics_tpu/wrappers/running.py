@@ -20,7 +20,18 @@ class Running(Metric):
     """Compute a metric over a fixed running window of recent updates (reference ``running.py:26``).
 
     ``forward`` still returns the current-batch value; ``compute`` returns the windowed
-    value. Memory grows linearly with ``window`` (one state copy per slot).
+    value. Memory grows linearly with ``window`` (one state copy per slot), and every
+    ``update`` snapshots the FULL base state into its ring slot on the host path —
+    exact per-update granularity at O(window) state copies. For unbounded serving
+    streams prefer :class:`torchmetrics_tpu.serve.window.WindowedMetric`: a device-
+    resident ring of ``buckets`` partial states whose advance/evict/fold compiles
+    into one donated engine dispatch per step (bucketed granularity, O(buckets)
+    memory, no per-step host attribute traffic).
+
+    ``reset`` rewinds the ring cursor (``_num_vals_seen``) with the states — a reset
+    instance is indistinguishable from a fresh one (a stale cursor would silently
+    resume mid-ring and fold new slots against evicted positions); pinned by
+    ``tests/test_serve.py::TestRunningResetRegression``.
 
     Example:
         >>> import jax.numpy as jnp
