@@ -76,6 +76,12 @@ _SCENARIO_BYTES = {
 }
 
 
+# every scenario block scripts/check_counters.py gates on: a run (including
+# the TPU-less micro fallback) must prove each of these completed, or the
+# gate's scenario-completeness check fails — nothing gated can skip silently
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan")
+
+
 def _acquire_backend(max_tries=3, backoff_s=2.0, probe_timeout_s=120.0):
     """Bounded-retry backend acquisition that can neither raise nor hang.
 
@@ -1480,6 +1486,236 @@ def bench_serve():
     return out
 
 
+def bench_scan(micro=False):
+    """Multi-step scan dispatch scenario (ISSUE 10 acceptance evidence).
+
+    Measures the queued micro-batch drain (``engine/scan.py``) against the
+    SAME metric on the unqueued engine path — both through the public
+    ``metric.update`` hot loop, both warm — and proves the correctness
+    envelope the counter gate enforces:
+
+    - ``scan_amortization_k8`` / ``_k32``: unqueued µs/step over scan µs/step
+      at K∈{8,32} (best-of-repeats on both sides: amortization is a stable
+      dispatch-count property; wall-clock noise only ever dilutes it);
+    - byte-identical parity with step-at-a-time updates INCLUDING a
+      mid-queue quarantined (NaN) batch and compensated accumulation on —
+      the riders compose per scan step;
+    - 0 warm retraces across ragged queue tails (power-of-two K-buckets with
+      masked no-op padding reuse executables);
+    - 0 host transfers under the STRICT guard, with one ``update.scan`` event
+      per drain and every flush carrying its reason.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.engine import (
+        compensated_context,
+        engine_context,
+        quarantine_context,
+        scan_context,
+    )
+
+    # dispatch-bound shape on purpose: the scenario measures HOST dispatch
+    # amortization, so per-step device work must stay small relative to the
+    # ~300 µs/step launch cost the queue removes — at batch 64+ the drain's
+    # K-fold of real device work (serial on CPU) eats into the measured ratio
+    # (Amdahl), which on a TPU would overlap with dispatch asynchronously
+    batch, classes = 8, 10
+    steps = 128 if micro else 256
+    repeats = 7
+
+    key = jax.random.PRNGKey(42)
+    preds = jax.random.normal(key, (batch, classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, classes, dtype=jnp.int32)
+
+    def build(**kw):
+        return MulticlassAccuracy(classes, average="micro", validate_args=False, **kw)
+
+    def block(m):
+        jax.block_until_ready([getattr(m, s) for s in m._defaults])
+
+    def timed_loop(m, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            m.update(preds, target)
+        block(m)
+        return (time.perf_counter() - t0) / n * 1e6
+
+    out = {"batch": batch, "classes": classes, "steps": steps}
+
+    # -- paired amortization measurement --------------------------------------
+    # the three loops (unqueued, K=8, K=32) run back to back inside EACH
+    # repeat window, and the reported amortization is the MEDIAN of the
+    # per-window ratios: machine-load noise is common-mode within a window,
+    # so it cancels out of the ratio instead of flipping the >= 4x gate
+    with engine_context(True, donate=True):
+        base = build()
+        m8 = build(scan_steps=8)  # per-metric kwarg: queue without a context
+        m32 = build(scan_steps=32)
+        for _ in range(8):
+            base.update(preds, target)
+        for m, k in ((m8, 8), (m32, 32)):
+            for _ in range(2 * k):  # warm the K-bucket executable
+                m.update(preds, target)
+        block(base), block(m8), block(m32)
+        windows = []
+        for _ in range(repeats):
+            windows.append(
+                (timed_loop(base, steps), timed_loop(m8, steps), timed_loop(m32, steps))
+            )
+        st = m8._engine.stats
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    out["unqueued_us_per_step"] = round(median([w[0] for w in windows]), 2)
+    out["scan_us_per_step_k8"] = round(median([w[1] for w in windows]), 2)
+    out["scan_us_per_step_k32"] = round(median([w[2] for w in windows]), 2)
+    # wall-clock amortization: machine-dependent evidence (XLA CPU exec time
+    # for these micro executables jitters ±15% run to run even on an idle
+    # box, hence the paired-window median; typical CPU reading ~4.2x at K=8,
+    # gated only at a conservative sanity floor)
+    out["scan_amortization_k8"] = round(median([w[0] / max(w[1], 1e-9) for w in windows]), 2)
+    out["scan_amortization_k32"] = round(median([w[0] / max(w[2], 1e-9) for w in windows]), 2)
+    # DISPATCH amortization: the machine-independent counter ratio the gate
+    # enforces (the repo's counter-not-timing philosophy) — real steps folded
+    # per executed dispatch, exactly K on an aligned stream
+    out["scan_dispatch_amortization_k8"] = round(
+        st.scan_steps_folded / max(st.scan_dispatches, 1), 2
+    )
+    st32 = m32._engine.stats
+    out["scan_dispatch_amortization_k32"] = round(
+        st32.scan_steps_folded / max(st32.scan_dispatches, 1), 2
+    )
+    out["scan_dispatches"] = st.scan_dispatches
+    out["scan_steps_folded"] = st.scan_steps_folded
+    out["scan_pad_steps"] = st.scan_pad_steps
+    out["scan_flushes"] = st.scan_flushes
+    out["scan_flush_reasons"] = {r: st.scan_flush_reasons[r] for r in sorted(st.scan_flush_reasons)}
+
+    # -- parity: byte-identical to step-at-a-time, riders on ------------------
+    # a mid-queue NaN batch under quarantine + compensated accumulation: the
+    # scan path must match the unqueued path bit-for-bit, skip EXACTLY the
+    # poisoned step, and count it once
+    from torchmetrics_tpu.engine.txn import read_quarantine
+
+    rng = np.random.RandomState(7)
+    stream = [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch).astype(np.int32)),
+        )
+        for _ in range(24)
+    ]
+    poisoned_steps = {5, 13}
+    nan_preds = jnp.asarray(np.full((batch, classes), np.nan, np.float32))
+
+    def run_stream(scan_k):
+        with engine_context(True, donate=True), quarantine_context(True), compensated_context(True):
+            if scan_k:
+                ctx = scan_context(scan_k)
+            else:
+                from contextlib import nullcontext
+
+                ctx = nullcontext()
+            with ctx:
+                m = build()
+                for i, (p, t) in enumerate(stream):
+                    m.update(nan_preds if i in poisoned_steps else p, t)
+                value = np.asarray(m.compute())
+                states = {s: np.asarray(getattr(m, s)) for s in m._defaults}
+                quarantined = read_quarantine(m)["count"]
+        return value, states, quarantined
+
+    ref_value, ref_states, ref_q = run_stream(0)
+    scan_value, scan_states, scan_q = run_stream(8)
+    parity = bool(np.array_equal(ref_value, scan_value)) and all(
+        np.array_equal(ref_states[s], scan_states[s]) for s in ref_states
+    )
+
+    # compensated rider: accuracy's states are ints (no residual), so the
+    # two-sum parity is proved on a float accumulator — an absorption-prone
+    # stream with one NaN batch mid-queue, quarantine + compensation BOTH on
+    from torchmetrics_tpu import SumMetric
+
+    comp_stream = [1e8] + [0.1] * 10 + [float("nan")] + [0.1] * 12
+
+    def run_comp(scan_k):
+        with engine_context(True, donate=True), quarantine_context(True), compensated_context(True):
+            if scan_k:
+                ctx = scan_context(scan_k)
+            else:
+                from contextlib import nullcontext
+
+                ctx = nullcontext()
+            with ctx:
+                s = SumMetric(nan_strategy=0.0)
+                for v in comp_stream:
+                    s.update(jnp.asarray(v, jnp.float32))
+                value = np.asarray(s.compute())
+                quarantined = read_quarantine(s)["count"]
+        return value, quarantined
+
+    comp_ref, comp_ref_q = run_comp(0)
+    comp_scan, comp_scan_q = run_comp(8)
+    comp_parity = bool(np.array_equal(comp_ref, comp_scan)) and comp_scan_q == comp_ref_q == 1
+
+    out["scan_quarantine_planted"] = len(poisoned_steps) + 1
+    out["scan_quarantined_batches"] = int(scan_q) + int(comp_scan_q)
+    out["scan_parity_ok"] = bool(
+        parity and scan_q == ref_q == len(poisoned_steps) and comp_parity
+    )
+
+    # -- ragged tails: K-bucket executables must be reused warm ---------------
+    with engine_context(True, donate=True), scan_context(8):
+        m = build()
+        for tail in (8, 4, 2, 1):  # warm one executable per K-bucket
+            for _ in range(tail):
+                m.update(preds, target)
+            m._engine._scan.drain("bench-tail")
+        st = m._engine.stats
+        warm_traces = st.traces
+        for tail in (3, 5, 7, 1, 6, 2, 8):
+            for _ in range(tail):
+                m.update(preds, target)
+            m._engine._scan.drain("bench-tail")
+        out["scan_ragged_retraces_after_warmup"] = st.traces - warm_traces
+        out["scan_ragged_drains"] = 7
+        block(m)
+
+    # -- STRICT guard + flush-on-observation ----------------------------------
+    from torchmetrics_tpu.diag import diag_context, transfer_guard
+
+    with engine_context(True, donate=True), scan_context(8):
+        m = build()
+        for _ in range(16):  # warm outside the guard
+            m.update(preds, target)
+        block(m)
+        with diag_context(capacity=8192) as rec, transfer_guard("strict"):
+            for _ in range(40):
+                m.update(preds, target)
+            # 40 = 5 full drains; 3 more enqueue, then the observation drains
+            for _ in range(3):
+                m.update(preds, target)
+            value = m.compute()  # drains in-graph; the VALUE reads back below,
+            # outside the guard — the hot loop itself never touches the host
+        value = np.asarray(value)
+        out["scan_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+        scans = [e for e in rec.snapshot() if e.kind == "update.scan"]
+        retraces = [e for e in rec.snapshot() if e.kind.endswith(".retrace")]
+        out["scan_retraces_uncaused"] = sum(1 for e in retraces if not e.data.get("cause"))
+        flushes = [e for e in rec.snapshot() if e.kind == "scan.flush"]
+        out["scan_events_per_drain_ok"] = bool(len(scans) == 6)  # one X-slice per drain
+        out["scan_flush_on_observation_ok"] = bool(
+            any(e.data.get("reason") == "observation:compute" for e in flushes)
+            and scans[-1].data.get("steps") == 3
+            and value.shape == ()
+        )
+    return out
+
+
 def bench_micro_device(n_steps=200):
     """Bounded stand-in for the device scenarios when no TPU is present: a tiny
     jitted accuracy scan whose only job is to prove the measurement path runs
@@ -1993,6 +2229,12 @@ def main(argv=None):
         except Exception as err:  # noqa: BLE001
             statuses["serve"] = f"error:{type(err).__name__}: {str(err)[:200]}"
 
+        try:
+            extras["scan"] = bench_scan(micro=not on_tpu or args.smoke)
+            statuses["scan"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["scan"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
         if on_tpu and not args.smoke:
             try:
                 ours = bench_ours()  # all device timings complete before any host work
@@ -2008,6 +2250,18 @@ def main(argv=None):
                 statuses["device_scenarios"] = "tpu_unavailable_micro_fallback"
             except Exception as err:  # noqa: BLE001
                 statuses["device_scenarios"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+            # scenario-completeness keys: the micro fallback must record which
+            # GATED scenario blocks this run actually produced, so a TPU-less
+            # run can never silently skip a gated scenario (check_counters.py
+            # fails on a non-empty scenarios_missing)
+            extras["micro_fallback"] = {
+                "scenarios_present": sorted(
+                    k for k in _GATED_SCENARIOS if isinstance(extras.get(k), dict)
+                ),
+                "scenarios_missing": sorted(
+                    k for k in _GATED_SCENARIOS if not isinstance(extras.get(k), dict)
+                ),
+            }
             device_kind = backend.get("device_kind", backend.get("platform", ""))
     else:
         # a wedged plugin may have left a stuck init thread behind: do NO further
@@ -2017,6 +2271,7 @@ def main(argv=None):
         statuses["txn"] = "tpu_unavailable"
         statuses["numerics"] = "tpu_unavailable"
         statuses["serve"] = "tpu_unavailable"
+        statuses["scan"] = "tpu_unavailable"
         statuses["device_scenarios"] = "tpu_unavailable"
 
     if not args.smoke:

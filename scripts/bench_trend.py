@@ -62,6 +62,15 @@ _TRACKED = (
     ("numerics", "numerics_host_transfers", "max"),
     ("numerics", "numerics_retraces_after_warmup", "max"),
     ("numerics", "drift_flags_clean", "max"),
+    # multi-step scan dispatch (engine/scan.py, PR 10): amortization factors
+    # are display (machine-dependent ratios; the >= 4x floor gates in
+    # check_counters); steps-folded tracks adoption, transfers/retraces gate.
+    ("scan", "scan_dispatch_amortization_k8", None),
+    ("scan", "scan_amortization_k8", None),
+    ("scan", "scan_amortization_k32", None),
+    ("scan", "scan_steps_folded", None),
+    ("scan", "scan_host_transfers", "max"),
+    ("scan", "scan_ragged_retraces_after_warmup", "max"),
     # serving layer (serve/, PR 9): streaming-loop timing is display (machine-
     # dependent); transfers/retraces/executable-sharing and the HLL error gate.
     ("serve", "windowed_us_per_step", None),

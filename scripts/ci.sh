@@ -109,8 +109,20 @@ elif ! grep -q '"serve_host_transfers": 0' "$BENCH_OUT" \
   # 0.0.4 exposition content type
   echo "bench smoke: FAILED (serving stream/tenancy/snapshot/sketch proofs missing or degraded)"
   status=1
+elif ! grep -q '"scan_dispatch_amortization_k8": 8.0' "$BENCH_OUT" \
+  || ! grep -q '"scan_parity_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"scan_ragged_retraces_after_warmup": 0' "$BENCH_OUT" \
+  || ! grep -q '"scan_host_transfers": 0' "$BENCH_OUT" \
+  || ! grep -q '"scan_flush_on_observation_ok": true' "$BENCH_OUT"; then
+  # multi-step scan smoke (engine/scan.py gate): K=8 drains must fold exactly
+  # 8 real steps per dispatch (the counter-ratio amortization contract), stay
+  # byte-identical to step-at-a-time updates with a mid-queue quarantined
+  # batch + compensated accumulation on, reuse K-bucket executables across
+  # ragged queue tails, flush on observation, and hold the STRICT guard
+  echo "bench smoke: FAILED (multi-step scan fold/parity/flush proofs missing or degraded)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve + scan counters present)"
 fi
 
 echo

@@ -1,0 +1,736 @@
+"""Multi-step scan dispatch tests (engine/scan.py): K-folding drains, masked
+padding, flush-on-observation, rider composition (quarantine / compensation /
+sentinel riding the carry), fused-collection queues, and the fail-loud knobs."""
+
+import os
+import pickle
+import urllib.request
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection, SumMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassPrecision,
+)
+from torchmetrics_tpu.engine import engine_context, scan_context, set_scan_steps
+from torchmetrics_tpu.engine.scan import MAX_K, coerce_k, k_bucket, scan_k
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(n, NUM_CLASSES).astype(np.float32)),
+         jnp.asarray(rng.randint(0, NUM_CLASSES, n).astype(np.int32)))
+        for n in sizes
+    ]
+
+
+def _acc(**kw):
+    return MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False, **kw)
+
+
+# ---------------------------------------------------------------- knobs
+
+
+def test_env_var_fail_loud(monkeypatch):
+    """Invalid TORCHMETRICS_TPU_SCAN values raise instead of silently disabling."""
+    for bad in ("banana", "1", "-3", str(MAX_K + 1), "2.5"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_SCAN", bad)
+        with pytest.raises(TorchMetricsUserError):
+            scan_k()
+    for off in ("", "0", "off"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_SCAN", off)
+        assert scan_k() is None
+    monkeypatch.setenv("TORCHMETRICS_TPU_SCAN", "16")
+    assert scan_k() == 16
+
+
+def test_kwarg_and_override_resolution(monkeypatch):
+    monkeypatch.delenv("TORCHMETRICS_TPU_SCAN", raising=False)
+    assert scan_k() is None
+    with scan_context(4):
+        assert scan_k() == 4
+        # per-metric kwarg outranks the context: 0 forces off
+        m_off = _acc(scan_steps=0)
+        assert m_off._scan_depth() is None
+        m_k = _acc(scan_steps=8)
+        assert m_k._scan_depth() == 8
+    assert scan_k() is None
+    set_scan_steps(4)
+    try:
+        assert scan_k() == 4
+    finally:
+        set_scan_steps(None)
+    with pytest.raises(TorchMetricsUserError):
+        _acc(scan_steps=1)
+    with pytest.raises(TorchMetricsUserError):
+        _acc(scan_steps=True)
+    with pytest.raises(TorchMetricsUserError):
+        MetricCollection({"a": _acc(), "b": MulticlassPrecision(NUM_CLASSES, validate_args=False)}, scan_steps=-2)
+
+
+def test_k_bucket():
+    assert [k_bucket(n) for n in (1, 2, 3, 4, 5, 8, 9)] == [1, 2, 4, 4, 8, 8, 16]
+    assert coerce_k(None) is None
+    assert coerce_k(0) == 0
+    assert coerce_k(False) == 0
+    assert coerce_k(7) == 7
+
+
+# ---------------------------------------------------------------- parity + drains
+
+
+def test_scan_parity_and_k_reached():
+    """K queued steps fold into state through one dispatch, byte-identical to
+    the unqueued engine stream."""
+    batches = _batches([32] * 12)
+    with engine_context(True, donate=True):
+        ref = _acc()
+        for p, t in batches:
+            ref.update(p, t)
+        ref_val = np.asarray(ref.compute())
+    with engine_context(True, donate=True), scan_context(4):
+        m = _acc()
+        for p, t in batches:
+            m.update(p, t)
+        st = m._engine.stats
+        assert m._engine._scan.pending == 0  # 12 = 3 full drains
+        assert st.scan_dispatches == 3
+        assert st.scan_steps_folded == 12
+        assert st.scan_pad_steps == 0
+        assert st.scan_flush_reasons["k-reached"] == 3
+        assert st.eager_fallbacks == 0
+        val = np.asarray(m.compute())
+    np.testing.assert_array_equal(val, ref_val)
+
+
+def test_flush_on_compute_with_pad_steps():
+    """A ragged queue tail drains on compute() through the next K-bucket with
+    masked no-op padding — the padded steps leave no trace in state."""
+    batches = _batches([32] * 3)
+    with engine_context(True, donate=True):
+        ref = _acc()
+        for p, t in batches:
+            ref.update(p, t)
+        ref_val = np.asarray(ref.compute())
+    with engine_context(True, donate=True), scan_context(8):
+        m = _acc()
+        for p, t in batches:
+            m.update(p, t)
+        st = m._engine.stats
+        assert m._engine._scan.pending == 3
+        val = np.asarray(m.compute())
+        assert st.scan_dispatches == 1
+        assert st.scan_steps_folded == 3
+        assert st.scan_pad_steps == 1  # 3 -> k_bucket 4
+        assert st.scan_flush_reasons["observation:compute"] == 1
+    np.testing.assert_array_equal(val, ref_val)
+    assert m._update_count == 3
+
+
+def test_flush_on_sync_state_dict_merge_and_clone():
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True), scan_context(8):
+        m = SumMetric(nan_strategy=0.0)
+        m.persistent(True)
+        m.update(xs)
+        m.update(xs)
+        sd = m.state_dict()
+        assert float(np.asarray(sd["value"])) == 16.0
+        assert m._engine.stats.scan_flush_reasons["observation:state_dict"] == 1
+
+        other = SumMetric(nan_strategy=0.0)
+        other.update(xs)
+        m.merge_state(other)  # drains BOTH sides first
+        assert float(np.asarray(m.value)) == 24.0
+
+        m.update(xs)
+        clone = pickle.loads(pickle.dumps(m))  # __getstate__ drains first
+        assert float(np.asarray(clone.value)) == 32.0
+        assert m._engine.stats.scan_flush_reasons["observation:clone"] == 1
+
+
+def test_forward_drains_then_bypasses_queue():
+    """forward() is a value request: pending payloads fold first, and its own
+    updates apply immediately (never queued)."""
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True), scan_context(8):
+        m = SumMetric(nan_strategy=0.0)
+        m.update(xs)  # queued
+        batch_val = float(m.forward(2 * xs))
+        assert batch_val == 16.0
+        assert float(np.asarray(m.value)) == 24.0
+        assert m._engine.stats.scan_flush_reasons["observation:forward"] == 1
+        assert m._engine._scan.pending == 0
+
+
+def test_reset_discards_without_dispatch():
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True), scan_context(8):
+        m = SumMetric(nan_strategy=0.0)
+        m.update(xs)
+        m.update(xs)
+        st = m._engine.stats
+        d0 = st.scan_dispatches
+        m.reset()
+        assert st.scan_dispatches == d0  # no dispatch spent on doomed payloads
+        assert st.scan_flush_reasons["reset"] == 1
+        m.update(3 * xs)
+        assert float(m.compute()) == 24.0
+
+
+def test_signature_change_drains():
+    """A batch-shape change (different bucket) flushes the queue first."""
+    with engine_context(True, donate=True), scan_context(8):
+        m = _acc()
+        big = _batches([32] * 3, seed=3)
+        small = _batches([8] * 2, seed=4)
+        for p, t in big:
+            m.update(p, t)
+        for p, t in small:
+            m.update(p, t)
+        st = m._engine.stats
+        assert st.scan_flush_reasons["signature-change"] == 1
+        assert st.scan_steps_folded == 3  # the big-bucket payloads drained
+        assert m._engine._scan.pending == 2
+        m.compute()
+        assert st.scan_steps_folded == 5
+
+
+def test_scope_exit_flushes():
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True):
+        m = SumMetric(nan_strategy=0.0)
+        with scan_context(8):
+            m.update(xs)
+            assert m._engine._scan.pending == 1
+        assert m._engine._scan.pending == 0
+        assert m._engine.stats.scan_flush_reasons["scope-exit"] == 1
+        assert float(np.asarray(m.value)) == 8.0
+
+
+def test_ragged_tails_reuse_k_bucket_executables():
+    """After the K-bucket warmup, ragged queue tails cause ZERO new traces."""
+    batches = _batches([32] * 40, seed=5)
+    with engine_context(True, donate=True), scan_context(8):
+        m = _acc()
+        # warmup: one drain per K-bucket (1, 2, 4, 8) + the x64 state-dtype
+        # promotion retrace the engine convention allows
+        for tail in (8, 4, 2, 1, 8, 4, 2, 1):
+            for p, t in batches[:tail]:
+                m.update(p, t)
+            m._engine._scan.drain("test-tail")
+        st = m._engine.stats
+        warm_traces = st.traces
+        for tail in (3, 5, 7, 8, 1, 6, 2):
+            for p, t in batches[:tail]:
+                m.update(p, t)
+            m._engine._scan.drain("test-tail")
+        assert st.traces == warm_traces  # 0 warm retraces across ragged tails
+        assert st.scan_dispatches == 15
+    # every warm retrace carries an attributed cause (bucket-miss / dtype)
+    assert all(c in ("bucket-miss", "dtype-change") for c in st.retrace_causes)
+
+
+# ---------------------------------------------------------------- rider composition
+
+
+def test_quarantined_step_mid_queue_rolls_back_only_itself():
+    """A poisoned (NaN) payload mid-queue skips ONLY that scan step: the carry
+    flows through, the device counter increments by exactly 1, and the final
+    value is byte-identical to the step-at-a-time quarantine path."""
+    from torchmetrics_tpu.engine import quarantine_context
+    from torchmetrics_tpu.engine.txn import read_quarantine
+
+    xs = jnp.ones((16,), jnp.float32)
+    xs_nan = xs.at[3].set(jnp.nan)
+
+    with engine_context(True, donate=True), quarantine_context(True):
+        ref = SumMetric(nan_strategy=0.0)
+        for i in range(8):
+            ref.update(xs_nan if i == 3 else xs)
+        ref_val = np.asarray(ref.compute())
+        ref_q = read_quarantine(ref)["count"]
+
+    with engine_context(True, donate=True), quarantine_context(True), scan_context(8):
+        m = SumMetric(nan_strategy=0.0)
+        for i in range(8):
+            m.update(xs_nan if i == 3 else xs)
+        val = np.asarray(m.compute())
+        q = read_quarantine(m)["count"]
+        assert m._engine.stats.scan_dispatches == 1
+
+    np.testing.assert_array_equal(val, ref_val)
+    assert q == ref_q == 1
+
+
+def test_compensated_queue_matches_step_at_a_time_bit_exactly():
+    """Compensated two-sum accumulation over a drained queue is bit-exact with
+    the unqueued compensated path — the residual rides the scan carry."""
+    from torchmetrics_tpu.engine import compensated_context
+
+    values = [1e8] + [0.1] * 31 + [1e8] + [0.1] * 31
+
+    def run(scan):
+        with engine_context(True, donate=True), compensated_context(True):
+            if scan:
+                with scan_context(8):
+                    m = SumMetric(nan_strategy=0.0)
+                    for v in values:
+                        m.update(jnp.asarray(v, jnp.float32))
+                    out = np.asarray(m.compute())
+                    assert m._engine.stats.scan_dispatches == 8
+            else:
+                m = SumMetric(nan_strategy=0.0)
+                for v in values:
+                    m.update(jnp.asarray(v, jnp.float32))
+                out = np.asarray(m.compute())
+        return out
+
+    np.testing.assert_array_equal(run(scan=True), run(scan=False))
+
+
+class _FloatSum(Metric):
+    """Unimputing float sum: a NaN input genuinely lands in state."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+def test_sentinel_bits_or_across_queued_steps():
+    """Without quarantine, a NaN in one queued step raises the sticky nan bit
+    through the scan carry; the padding steps cannot raise anything."""
+    from torchmetrics_tpu.diag.sentinel import FLAG_NAN, read_sentinel, sentinel_context
+
+    xs = jnp.ones((8,), jnp.float32)
+    xs_nan = xs.at[1].set(jnp.nan)
+    with engine_context(True, donate=True), sentinel_context(True), scan_context(8):
+        clean = _FloatSum()
+        for _ in range(3):
+            clean.update(xs)
+        clean.compute()  # ragged drain with 1 pad step
+        assert read_sentinel(clean)["flags"] == 0
+
+        poisoned = _FloatSum()
+        poisoned.update(xs)
+        poisoned.update(xs_nan)
+        poisoned.update(xs)
+        poisoned.compute()
+        assert read_sentinel(poisoned)["flags"] & FLAG_NAN
+
+
+# ---------------------------------------------------------------- fused collections
+
+
+def _collection(**kw):
+    return MetricCollection(
+        {
+            "acc": _acc(),
+            "prec": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+            "cm": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+        },
+        **kw,
+    )
+
+
+def test_fused_scan_parity_and_view_reanchor():
+    batches = _batches([32] * 9, seed=7)
+    with engine_context(True, donate=True):
+        ref = _collection(compute_groups=True, fused_dispatch=True)
+        for p, t in batches:
+            ref.update(p, t)
+        ref_vals = {k: np.asarray(v) for k, v in ref.compute().items()}
+    with engine_context(True, donate=True), scan_context(4):
+        mc = _collection(compute_groups=True, fused_dispatch=True)
+        for p, t in batches:
+            mc.update(p, t)
+        fst = mc._fused_engine.stats
+        # step 1 is eager group discovery; 8 queued = 2 full drains
+        assert fst.scan_dispatches == 2
+        assert fst.scan_steps_folded == 8
+        vals = {k: np.asarray(v) for k, v in mc.compute().items()}
+        # group VIEW members re-anchored after the drain: direct member reads
+        # see live (non-donated) buffers
+        for m in mc._modules.values():
+            for s in m._defaults:
+                np.asarray(getattr(m, s))
+    for k in ref_vals:
+        np.testing.assert_array_equal(vals[k], ref_vals[k], err_msg=k)
+    for m in mc._modules.values():
+        assert m._update_count == len(batches)
+
+
+def test_fused_scan_collection_kwarg_forces_off():
+    batches = _batches([16] * 4, seed=8)
+    with engine_context(True, donate=True), scan_context(4):
+        mc = _collection(compute_groups=True, fused_dispatch=True, scan_steps=0)
+        for p, t in batches:
+            mc.update(p, t)
+        assert mc._fused_engine._scan is None  # never queued
+        # ... but the members' per-metric engines are not in play (fused
+        # handled them), so no per-metric queue either
+        assert mc._fused_engine.stats.scan_dispatches == 0
+
+
+# ---------------------------------------------------------------- serve integration
+
+
+def test_windowed_ring_clock_advances_by_true_steps():
+    """A windowed serve metric's ring clock advances by the REAL step count —
+    masked padding steps never tick the clock."""
+    from torchmetrics_tpu.serve import WindowedMetric
+
+    with engine_context(True, donate=True), scan_context(8):
+        w = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=3, bucket_size=1)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            w.update(jnp.asarray(v, jnp.float32))
+        assert w._engine._scan.pending == 5
+        val = float(w.compute())  # drains through k_bucket(5)=8 with 3 pads
+        st = w._engine.stats
+        assert st.scan_pad_steps == 3
+        assert int(np.asarray(w.clock)) == 5  # true count, not the padded K
+        assert val == 3.0 + 4.0 + 5.0  # trailing window of 3
+
+
+def test_take_snapshot_drains_first():
+    from torchmetrics_tpu.serve.snapshot import snapshot_compute, take_snapshot
+
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True), scan_context(8):
+        m = SumMetric(nan_strategy=0.0)
+        m.update(xs)
+        m.update(xs)
+        snap = take_snapshot(m)
+        assert m._engine.stats.scan_flush_reasons["observation:snapshot"] == 1
+        assert float(snapshot_compute(m, snap)) == 16.0
+
+
+def test_sidecar_scrape_drains_and_records_flush(monkeypatch):
+    from torchmetrics_tpu.diag.trace import active_recorder
+    from torchmetrics_tpu.serve import MetricsSidecar
+
+    # the scrape runs on a SERVER thread, which does not inherit a
+    # contextvar-scoped recorder — the env-var (process-global) recorder is
+    # the one that sees the drain's scan.flush event
+    monkeypatch.setenv("TORCHMETRICS_TPU_TRACE", "2048")
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True), scan_context(8):
+        m = SumMetric(nan_strategy=0.0)
+        m.update(xs)
+        m.update(xs)
+        assert m._engine._scan.pending == 2
+        with MetricsSidecar(port=0) as sidecar:
+            body = urllib.request.urlopen(sidecar.url, timeout=10).read().decode()
+        assert m._engine._scan.pending == 0  # the scrape drained the queue
+        assert m._engine.stats.scan_flush_reasons["observation:scrape"] == 1
+        rec = active_recorder()
+        flushes = [e for e in rec.snapshot() if e.kind == "scan.flush"]
+        assert any(e.data.get("reason") == "observation:scrape" for e in flushes)
+        assert "tm_tpu_scan_steps_folded_total" in body
+
+
+# ---------------------------------------------------------------- guard + diag
+
+
+def test_scan_loop_zero_host_transfers_under_strict_guard():
+    from torchmetrics_tpu.diag import diag_context, transfer_guard
+
+    batches = _batches([32] * 9, seed=9)
+    with engine_context(True, donate=True), scan_context(4):
+        m = _acc()
+        # warmup outside the guard (compiles may inspect constants)
+        for p, t in batches[:4]:
+            m.update(p, t)
+        with diag_context(capacity=4096) as rec, transfer_guard("strict"):
+            for p, t in batches[4:8]:
+                m.update(p, t)
+        assert rec.count("transfer.host", "transfer.blocked") == 0
+        events = [e for e in rec.snapshot() if e.kind == "update.scan"]
+        assert len(events) == 1  # ONE slice per drain, not K phantom slices
+        assert events[0].data["steps"] == 4
+        m.compute()
+
+
+def test_diag_report_scan_columns():
+    from torchmetrics_tpu.diag import diag_context
+    from torchmetrics_tpu.diag.report import diag_report
+    from torchmetrics_tpu.engine import reset_engine_stats
+
+    reset_engine_stats()  # counters are process-wide; isolate this stream
+    batches = _batches([32] * 8, seed=10)
+    with engine_context(True, donate=True), diag_context(capacity=4096), scan_context(4):
+        m = _acc()
+        for p, t in batches:
+            m.update(p, t)
+        report = diag_report()
+        row = report["per_metric"]["MulticlassAccuracy"]
+        assert row["scan_dispatches"] == 2
+        assert row["scan_steps_folded"] == 8
+        assert row["scan_amortization"] == 4.0
+        counters = report["counters"]
+        assert counters["scan_dispatches"] == 2
+        assert counters["scan_steps_folded"] == 8
+        assert counters["scan_flush_reasons"]["k-reached"] == 2
+
+
+def test_scan_disabled_mid_stream_drains_leftovers():
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True):
+        m = SumMetric(nan_strategy=0.0)
+        set_scan_steps(8)
+        try:
+            m.update(xs)
+            assert m._engine._scan.pending == 1
+        finally:
+            set_scan_steps(0)
+        m.update(xs)  # step-at-a-time path drains the leftover first
+        assert m._engine._scan.pending == 0
+        assert m._engine.stats.scan_flush_reasons["scan-disabled"] == 1
+        set_scan_steps(None)
+        assert float(np.asarray(m.value)) == 16.0
+
+
+def test_running_wrapper_slots_see_drained_state():
+    """Regression: Running's slot snapshot reads inner state DIRECTLY after
+    the inner update — under scan the inner payload must drain before the
+    read (and before the wrapper's reset could discard it)."""
+    from torchmetrics_tpu.wrappers import Running
+
+    with engine_context(True, donate=True), scan_context(8):
+        r = Running(SumMetric(nan_strategy=0.0), window=3)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            r.update(jnp.asarray(v, jnp.float32))
+        assert float(r.compute()) == 2.0 + 3.0 + 4.0
+
+
+def test_member_reset_drains_shared_fused_queue():
+    """Regression: resetting ONE collection member must not discard the
+    sibling members' payloads from the shared fused queue — the queue drains
+    instead, and only the resetting member's share is wiped."""
+    from torchmetrics_tpu import MeanMetric
+
+    with engine_context(True, donate=True), scan_context(8):
+        mc = MetricCollection(
+            {"s": SumMetric(nan_strategy=0.0), "m": MeanMetric(nan_strategy=0.0)},
+            compute_groups=True, fused_dispatch=True,
+        )
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+            mc.update(jnp.asarray(v, jnp.float32))
+        mc["s"].reset()
+        vals = mc.compute()
+        assert float(vals["m"]) == 3.5  # the sibling kept its queued steps
+        assert float(vals["s"]) == 0.0  # the reset member restarted
+
+
+def test_scan_context_restores_override_when_flush_raises(monkeypatch):
+    """Regression: a drain failure during the scope-exit flush must not leak
+    the forced queue depth process-wide."""
+    import torchmetrics_tpu.engine.scan as scan_mod
+
+    def boom(reason):
+        raise RuntimeError("drain exploded")
+
+    monkeypatch.setattr(scan_mod, "flush_all", boom)
+    with pytest.raises(RuntimeError):
+        with scan_context(4):
+            pass
+    assert scan_k() is None  # the override was restored despite the raise
+
+
+def test_out_of_band_drain_reanchors_views_for_per_metric_owner_queue():
+    """Regression: a group OWNER queueing through its own per-metric engine
+    (fused path bailed — kwargs) must re-anchor the collection's views when a
+    drain fires OUT OF BAND (scrape-style flush_all), or retained view
+    handles read donated (dead) buffers."""
+    from torchmetrics_tpu.classification import MulticlassRecall
+    from torchmetrics_tpu.engine.scan import flush_all
+
+    rng = np.random.RandomState(13)
+    with engine_context(True, donate=True), scan_context(4):
+        mc = MetricCollection(
+            {"p": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+             "r": MulticlassRecall(NUM_CLASSES, average="macro", validate_args=False)},
+            compute_groups=True, fused_dispatch=True,
+        )
+        view = mc["r"]  # retained handle (may be a compute-group view)
+        for _ in range(3):
+            p = jnp.asarray(rng.rand(16, NUM_CLASSES).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, NUM_CLASSES, 16).astype(np.int32))
+            # kwargs force the fused queue to bail; owners queue per-metric
+            mc.update(preds=p, target=t)
+        flush_all("observation:scrape")  # sidecar-style out-of-band drain
+        for s in view._defaults:  # the view must hold LIVE buffers
+            np.asarray(getattr(view, s))
+        float(np.asarray(view.compute()))
+
+
+def test_warm_drain_failure_replays_instead_of_losing_payloads():
+    """Regression: a dispatch failure on a CACHED scan executable must replay
+    the queued payloads step-at-a-time, never silently drop them."""
+    xs = jnp.ones((8,), jnp.float32)
+    with engine_context(True, donate=True), scan_context(4):
+        m = SumMetric(nan_strategy=0.0)
+        for _ in range(4):  # one clean drain warms the cache
+            m.update(xs)
+        sq = m._engine._scan
+
+        def boom(*a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: planted warm failure")
+
+        for key, entry in list(sq._cache.items()):
+            sq._cache[key] = (boom,) + tuple(entry[1:])
+        for _ in range(4):  # next drain hits the planted failure
+            m.update(xs)
+        assert float(np.asarray(m.value)) == 8 * 8.0  # all 8 steps applied
+        assert any(
+            r.startswith("scan-warm-dispatch-failed") for r in m._engine.stats.fallback_reasons
+        )
+
+
+def test_add_metrics_drains_fused_queue_before_dropping_engine():
+    """Regression: a membership change rebuilds the fused engine — the old
+    queue's payloads must fold into the existing members first, not orphan."""
+    from torchmetrics_tpu import MeanMetric
+
+    with engine_context(True, donate=True), scan_context(8):
+        mc = MetricCollection(
+            {"s": SumMetric(nan_strategy=0.0), "m": MeanMetric(nan_strategy=0.0)},
+            compute_groups=True, fused_dispatch=True,
+        )
+        for v in (1.0, 2.0, 3.0):
+            mc.update(jnp.asarray(v, jnp.float32))
+        mc.add_metrics({"s2": SumMetric(nan_strategy=0.0)})
+        vals = mc.compute()
+        assert float(vals["s"]) == 6.0  # nothing orphaned by the engine swap
+        assert float(vals["m"]) == 2.0
+
+
+def test_engine_disabled_mid_stream_drains_before_eager_step():
+    """Regression: disabling the ENGINE (not the scan knob) mid-stream must
+    drain queued payloads BEFORE the next eager step applies — later batches
+    cannot overtake earlier enqueued ones (order-dependent metrics)."""
+    from torchmetrics_tpu.serve import WindowedMetric
+
+    with scan_context(8):
+        with engine_context(True, donate=True):
+            w = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=2, bucket_size=1)
+            w.update(jnp.asarray(1.0, jnp.float32))
+            w.update(jnp.asarray(2.0, jnp.float32))
+            assert w._engine._scan.pending == 2
+        with engine_context(False):  # engine off: next update runs eagerly
+            w.update(jnp.asarray(3.0, jnp.float32))
+        # ring of 2: correct trailing window is {2, 3} — an order inversion
+        # (3 applied before 1, 2) would report a different fold
+        assert float(w.compute()) == 5.0
+        assert w._engine.stats.scan_flush_reasons["scan-disabled"] == 1
+
+
+def test_member_opt_out_keeps_view_reanchor_under_collection_scan():
+    """Regression: a member forced off the queue (scan_steps=0) inside a
+    scan-active collection still donates per step — retained view handles
+    must keep reading live buffers."""
+    rng = np.random.RandomState(17)
+    with engine_context(True, donate=True), scan_context(4):
+        mc = MetricCollection(
+            {"p": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False, scan_steps=0),
+             "a": _acc(scan_steps=0)},
+            compute_groups=True, fused_dispatch=False,  # owners step per-metric
+        )
+        view = mc["a"]
+        for _ in range(3):
+            p = jnp.asarray(rng.rand(16, NUM_CLASSES).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, NUM_CLASSES, 16).astype(np.int32))
+            mc.update(p, t)
+            for s in view._defaults:  # live after every donated eager step
+                np.asarray(getattr(view, s))
+        float(np.asarray(view.compute()))
+
+
+def test_view_member_observation_drains_owner_queue():
+    """Regression: a retained compute-group VIEW handle observes the OWNER's
+    state — its compute()/state_dict() must drain the owner's queue (the
+    `_scan_peer` stamp), never read K-1 steps stale."""
+    rng = np.random.RandomState(19)
+    with engine_context(True, donate=True):
+        # discover groups with scan off, then queue with it on
+        mc = MetricCollection(
+            {"a": _acc(), "p": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False)},
+            compute_groups=True, fused_dispatch=True,
+        )
+        batches = [
+            (jnp.asarray(rng.rand(16, NUM_CLASSES).astype(np.float32)),
+             jnp.asarray(rng.randint(0, NUM_CLASSES, 16).astype(np.int32)))
+            for _ in range(6)
+        ]
+        mc.update(*batches[0])  # discovery pass
+        handles = [mc[name] for name in ("a", "p")]  # one is a view
+        with scan_context(8):
+            for p, t in batches[1:]:
+                mc.update(p, t)  # 5 enqueued, none drained
+            for h in handles:
+                val = float(np.asarray(h.compute()))
+                assert 0.0 <= val <= 1.0
+                assert h._update_count == 6
+        # the drained values must match an unqueued reference
+        ref = MetricCollection(
+            {"a": _acc(), "p": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False)},
+            compute_groups=True, fused_dispatch=True,
+        )
+        for p, t in batches:
+            ref.update(p, t)
+        ref_vals = {k: float(np.asarray(v)) for k, v in ref.compute().items()}
+    assert float(np.asarray(mc["a"].compute())) == ref_vals["a"]
+    assert float(np.asarray(mc["p"].compute())) == ref_vals["p"]
+
+
+def test_engine_off_collection_never_reads_scan_env(monkeypatch):
+    """Regression: an invalid TORCHMETRICS_TPU_SCAN must not raise on
+    configurations whose engine is off (they never consulted the knob)."""
+    monkeypatch.setenv("TORCHMETRICS_TPU_SCAN", "banana")
+    rng = np.random.RandomState(23)
+    with engine_context(False):
+        mc = MetricCollection(
+            {"a": _acc(), "p": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False)},
+            compute_groups=True, fused_dispatch=False,
+        )
+        for _ in range(3):  # discovery + post-discovery steps
+            p = jnp.asarray(rng.rand(16, NUM_CLASSES).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, NUM_CLASSES, 16).astype(np.int32))
+            mc.update(p, t)
+        mc.compute()
+
+
+def test_donation_safety_after_drain():
+    """Post-drain, the stream continues and old handles were not corrupted."""
+    batches = _batches([32] * 8, seed=11)
+    with engine_context(True, donate=True), scan_context(4):
+        m = _acc()
+        for p, t in batches[:4]:
+            m.update(p, t)
+        mid = np.asarray(m.compute())  # drains + computes
+        for p, t in batches[4:]:
+            m.update(p, t)
+        final = np.asarray(m.compute())
+    ref = MulticlassAccuracy(NUM_CLASSES, average="macro")
+    for p, t in batches:
+        ref.update(p, t)
+    np.testing.assert_allclose(final, np.asarray(ref.compute()), atol=1e-7)
+    assert mid.shape == final.shape

@@ -110,6 +110,10 @@ UNITLESS_COUNT_FAMILIES = {
     "tm_tpu_sync_fold_traces", "tm_tpu_sync_divergence_flags", "tm_tpu_sync_straggler_flags",
     "tm_tpu_sync_retries", "tm_tpu_sync_degraded_folds",
     "tm_tpu_quarantined_batches", "tm_tpu_ladder_retries",
+    # multi-step scan dispatch (engine/scan.py, PR 10): drain/step/flush event
+    # counts — pure counts, no physical unit
+    "tm_tpu_scan_dispatches", "tm_tpu_scan_steps_folded", "tm_tpu_scan_pad_steps",
+    "tm_tpu_scan_flushes", "tm_tpu_scan_flush_reasons",
     "tm_tpu_compute_traces", "tm_tpu_compute_dispatches", "tm_tpu_compute_cache_hits",
     "tm_tpu_profile_probes", "tm_tpu_engines", "tm_tpu_retrace_causes",
     "tm_tpu_fallback_reasons", "tm_tpu_events", "tm_tpu_events_dropped",

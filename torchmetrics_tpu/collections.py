@@ -64,7 +64,10 @@ class _ComputeGroup:
         matters for list states, which are shallow-copied so a view appending
         host-side cannot grow the canonical list.
         """
+        import weakref
+
         owner = modules[self.owner]
+        owner_ref = weakref.ref(owner)
         for name in self.names[1:]:
             view = modules[name]
             for state in owner._defaults:
@@ -75,6 +78,11 @@ class _ComputeGroup:
             # fold markers travel with the states they describe, else a view
             # holding the owner's stacked None-reduced state would re-wrap it
             view._none_folded = set(owner._none_folded)
+            # a view OBSERVES the owner's state: its drain hooks must flush
+            # the OWNER's scan queue (engine/scan.py staleness contract) —
+            # the view itself never enqueues, so flush_metric(view) alone
+            # would match nothing and read up to K-1 steps stale
+            view._scan_peer = owner_ref
 
 
 def _state_fingerprint(metric: Metric) -> Optional[tuple]:
@@ -133,6 +141,11 @@ class MetricCollection:
             explicit list of name groups.
         fused_dispatch: None (follow the engine policy — on for accelerator
             backends), or force the one-dispatch fused collection step on/off.
+        scan_steps: None (follow the process-wide ``TORCHMETRICS_TPU_SCAN`` /
+            ``scan_context`` policy), ``0``/``False`` to force the multi-step
+            scan queue off for this collection, or an int K >= 2 to fold K
+            collection steps into one donated ``lax.scan`` dispatch
+            (``engine/scan.py``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -147,6 +160,8 @@ class MetricCollection:
     """
 
     _groups: Dict[int, _ComputeGroup]
+    #: class-level default so unpickled pre-scan instances still resolve policy
+    scan_steps: Optional[int] = None
 
     def __init__(
         self,
@@ -156,6 +171,7 @@ class MetricCollection:
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
         fused_dispatch: Optional[bool] = None,
+        scan_steps: Optional[int] = None,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
@@ -164,6 +180,11 @@ class MetricCollection:
         if fused_dispatch is not None and not isinstance(fused_dispatch, bool):
             raise ValueError(f"Expected `fused_dispatch` to be a bool or None but got {fused_dispatch}")
         self.fused_dispatch = fused_dispatch
+        self.scan_steps = scan_steps
+        if scan_steps is not None:
+            from torchmetrics_tpu.engine.scan import coerce_k
+
+            self.scan_steps = coerce_k(scan_steps)
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
         self._fused_engine = None  # engine/fusion.py executable cache; built lazily
@@ -178,6 +199,7 @@ class MetricCollection:
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-metric ``forward`` (batch values); kwargs filtered per signature."""
+        self._drain_scan("observation:forward")
         return self._compute_and_reduce("forward", *args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
@@ -201,7 +223,8 @@ class MetricCollection:
                 # check must run here — before any owner's state can change
                 for name, metric in owners:
                     _txn.admission_check_or_raise(metric, args, metric._filter_kwargs(**kwargs))
-            handled = self._fused_step(owners, args, kwargs)
+            handled, scan_active = self._fused_step(owners, args, kwargs)
+            eager_donation_possible = False
             for name, metric in owners:
                 if name not in handled:
                     if _txn.quarantine_error():
@@ -210,6 +233,26 @@ class MetricCollection:
                         # second blocking device sync for the same inputs
                         metric._admission_prechecked = True
                     metric.update(*args, **metric._filter_kwargs(**kwargs))
+                    # a group OWNER queueing through its own per-metric engine
+                    # must re-anchor this collection's views when its queue
+                    # drains — drains can fire out-of-band (scrapes, scope
+                    # exit), where only the hook knows a donation happened.
+                    # Wired on QUEUE presence, not the collection-level knob:
+                    # a member may queue via its own scan_steps kwarg
+                    eng = metric._engine
+                    sq = None if eng is None else eng._scan
+                    if sq is not None and sq.on_drain is None:
+                        sq.on_drain = self._anchor_views_after_scan
+                    # engine-off members never donate (harmless True); the
+                    # knob is only consulted when the member's engine is on —
+                    # the same gating Metric._engine_step applies, so an
+                    # invalid env value cannot start raising on engine-off
+                    # configurations that never consulted it before
+                    if not metric._epoch_enabled() or metric._scan_depth() is None:
+                        # this member's EFFECTIVE knob is off (e.g. the
+                        # per-metric opt-out under a collection-wide scope):
+                        # its step may have been a real donated dispatch
+                        eager_donation_possible = True
             if measuring:
                 step_us = round((_perf_counter() - t_step) * 1e6, 3)
                 _hist.observe(type(self).__name__, "collection", "dispatch_us", step_us)
@@ -218,8 +261,18 @@ class MetricCollection:
                         "collection.step", type(self).__name__,
                         dispatch_us=step_us, owners=len(owners), fused=len(handled),
                     )
-            donated = bool(handled) or any(
-                m._engine is not None and m._engine.stats.donated_dispatches for _, m in owners
+            # with a scan queue active, an update is a pure ENQUEUE: no owner
+            # buffer changes until a drain, and every drain re-anchors views
+            # itself through the on_drain/on_scan_drain hooks — re-deriving
+            # the views per queued step would re-pay exactly the per-step host
+            # cost the K-fold exists to amortize. Members whose EFFECTIVE knob
+            # is off (per-metric opt-out) may still have donated eagerly this
+            # step, so they keep the pre-scan re-anchor behavior
+            donated = ((not scan_active) and bool(handled)) or (
+                eager_donation_possible
+                and any(
+                    m._engine is not None and m._engine.stats.donated_dispatches for _, m in owners
+                )
             )
             if donated:
                 # re-anchor views NOW, not lazily at the next accessor: a donated
@@ -250,20 +303,73 @@ class MetricCollection:
                 self._materialize_group_views()
                 self._groups_checked = True
 
-    def _fused_step(self, owners: List[Tuple[str, Metric]], args: tuple, kwargs: dict) -> set:
-        """Try the one-dispatch fused collection step; returns handled names."""
+    def _fused_step(self, owners: List[Tuple[str, Metric]], args: tuple, kwargs: dict) -> Tuple[set, bool]:
+        """Try the one-dispatch fused collection step.
+
+        Returns ``(handled member names, scan_active)`` — the caller needs the
+        GATED scan state for its donated-view bookkeeping, and resolving it
+        here keeps the env knob unread on engine-off configurations (an
+        invalid ``TORCHMETRICS_TPU_SCAN`` must not start raising on setups
+        that never consulted it).
+        """
         enabled = self.fused_dispatch
         if enabled is None:
             from torchmetrics_tpu.engine.config import engine_enabled
 
             enabled = engine_enabled()
+        k = self._scan_depth() if enabled else None
+        fe = self._fused_engine
+        stale_engine = fe is not None and [n for n, _ in fe.metrics] != [n for n, _ in owners]
+        if fe is not None and (k is None or stale_engine):
+            sq = fe._scan
+            if sq is not None and sq.pending:
+                # leftover payloads — from a closed scan scope, the ENGINE
+                # being disabled mid-stream, or an owner-set change about to
+                # replace this engine — drain before anything else applies
+                # (ordering preserved, nothing orphaned)
+                sq.drain("scan-disabled" if not stale_engine else "signature-change")
         if not enabled or len(owners) < 2:
-            return set()
-        if self._fused_engine is None or [n for n, _ in self._fused_engine.metrics] != [n for n, _ in owners]:
+            return set(), k is not None
+        if fe is None or stale_engine:
             from torchmetrics_tpu.engine.fusion import FusedUpdate
 
-            self._fused_engine = FusedUpdate(owners)
-        return self._fused_engine.step(args, kwargs) or set()
+            fe = self._fused_engine = FusedUpdate(owners)
+            # scan drains can fire OUTSIDE collection.update (observation
+            # hooks, sidecar scrapes): re-anchor group views the moment a
+            # drain donates the owners' buffers, not at the next step
+            fe.on_scan_drain = self._anchor_views_after_scan
+        if k is not None:
+            handled = fe.scan_step(args, kwargs, k)
+            return (handled if handled is not None else set()), True
+        return fe.step(args, kwargs) or set(), False
+
+    def _scan_depth(self) -> Optional[int]:
+        """The active scan queue depth for this collection, or None (unqueued)."""
+        if self.scan_steps is not None:
+            return self.scan_steps or None  # 0 = forced off
+        from torchmetrics_tpu.engine.scan import scan_k
+
+        return scan_k()
+
+    def _anchor_views_after_scan(self) -> None:
+        if self._groups_checked:
+            self._state_is_copy = False
+            self._materialize_group_views()
+
+    def _drain_scan(self, reason: str) -> int:
+        """Flush every scan queue holding pending steps for ANY member.
+
+        Collection-level observations must drain the fused queue AND any
+        per-metric owner queues BEFORE member states are read — and re-anchor
+        group views afterwards (a drain donates the owners' buffers, so view
+        members would otherwise hold dead arrays).
+        """
+        from torchmetrics_tpu.engine.scan import flush_metrics
+
+        drained = flush_metrics(list(self._modules.values()), reason)
+        if drained:
+            self._anchor_views_after_scan()
+        return drained
 
     # ------------------------------------------------------------------ group discovery
 
@@ -316,6 +422,7 @@ class MetricCollection:
         computes on the synced canonical states (through its cached compute
         executable) and the owners unsync afterwards.
         """
+        self._drain_scan("observation:compute")
         restore = self._packed_epoch_sync()
         try:
             return self._compute_and_reduce("compute")
@@ -420,6 +527,11 @@ class MetricCollection:
 
     def reset(self) -> None:
         """Reset every metric; group views re-anchor to the (reset) owners."""
+        from torchmetrics_tpu.engine.scan import discard_metrics
+
+        # the fused queue's payloads die with the reset, undispatched —
+        # byte-identical to folding then wiping (member resets discard theirs)
+        discard_metrics(list(self._modules.values()), "reset")
         for metric in self.values(copy_state=False):
             metric.reset()
         if self._enable_compute_groups and self._groups_checked:
@@ -436,6 +548,9 @@ class MetricCollection:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Compiled fused executables are per-process — never pickled/copied."""
+        # the fused engine (and its scan queue) is dropped below: pending
+        # payloads must fold into the owners' states first, or the copy lags
+        self._drain_scan("observation:clone")
         state = self.__dict__.copy()
         state["_fused_engine"] = None
         state["_epoch_sync"] = None
@@ -448,6 +563,7 @@ class MetricCollection:
 
     def state_dict(self) -> Dict[str, Any]:
         """Flat state dict keyed by metric name."""
+        self._drain_scan("observation:state_dict")
         destination: Dict[str, Any] = {}
         for name, metric in self.items(keep_base=True, copy_state=False):
             metric.state_dict(destination, prefix=f"{name}.")
@@ -471,6 +587,7 @@ class MetricCollection:
         views materialized first, so view members hold real arrays), the hot
         loop keeps updating, and no member syncs or caches. Rank-local.
         """
+        self._drain_scan("observation:snapshot")
         self._materialize_group_views()
         from torchmetrics_tpu.serve.snapshot import snapshot_compute
 
@@ -490,6 +607,11 @@ class MetricCollection:
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
     ) -> None:
         """Register metrics from dict/sequence/instance."""
+        # membership change drops the fused engine below — its scan queue's
+        # enqueued payloads must fold into the existing members' states first
+        # (the __getstate__ precedent), or they are lost to GC while the
+        # members' update counts stay advanced
+        self._drain_scan("observation:membership-change")
         if isinstance(metrics, Metric):
             metrics = [metrics]
         if isinstance(metrics, Sequence):

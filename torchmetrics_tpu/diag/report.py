@@ -30,9 +30,11 @@ from torchmetrics_tpu.diag.trace import FlightRecorder, TraceEvent, active_recor
 
 __all__ = ["diag_report", "export_chrome_trace", "export_json"]
 
-# kinds whose events carry dispatch_us and render as duration slices
+# kinds whose events carry dispatch_us and render as duration slices.
+# update.scan is ONE drained scan (its args carry the steps folded): the
+# chrome trace renders one X-slice per drain, never K phantom per-step slices
 _SPAN_KINDS = frozenset(
-    {"update.dispatch", "fused.dispatch", "compute.dispatch", "collection.step", "sync.exchange"}
+    {"update.dispatch", "fused.dispatch", "compute.dispatch", "collection.step", "sync.exchange", "update.scan"}
 )
 
 
@@ -90,13 +92,21 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         lambda: {
             "dispatches": 0, "dispatch_us": 0.0, "device_us": 0.0, "probes": 0,
             "traces": 0, "retraces": 0, "fallbacks": 0,
+            "scan_dispatches": 0, "scan_steps_folded": 0,
         }
     )
     retraces: List[Dict[str, Any]] = []
     collective_bytes = 0
     for ev in events:
         slot = per_metric[ev.owner or "<process>"]
-        if ev.kind in _SPAN_KINDS:
+        if ev.kind == "update.scan":
+            # one drained scan = one dispatch folding `steps` updates; the
+            # per-owner amortization factor derives below
+            slot["dispatches"] += 1
+            slot["dispatch_us"] += float(ev.data.get("dispatch_us", 0.0))
+            slot["scan_dispatches"] += 1
+            slot["scan_steps_folded"] += int(ev.data.get("steps", 0))
+        elif ev.kind in _SPAN_KINDS:
             slot["dispatches"] += 1
             slot["dispatch_us"] += float(ev.data.get("dispatch_us", 0.0))
         elif ev.kind.endswith(".probe"):
@@ -115,6 +125,15 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
     from torchmetrics_tpu.diag.hist import histograms_snapshot
     from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
+
+    for slot in per_metric.values():
+        # dispatch-amortization factor: real steps folded per scan dispatch
+        # (1.0 would be the unqueued engine; the K-fold win reads directly)
+        slot["scan_amortization"] = (
+            round(slot["scan_steps_folded"] / slot["scan_dispatches"], 2)
+            if slot["scan_dispatches"]
+            else 0.0
+        )
 
     out: Dict[str, Any] = {
         "counters": engine_report(),

@@ -47,6 +47,10 @@ _COUNTER_HELP = {
     "bucketed_steps": "steps that rode a shape bucket",
     "bucket_pad_rows": "total pad rows added across bucketed steps",
     "bytes_moved": "input+state bytes entering compiled dispatches",
+    "scan_dispatches": "multi-step scan drains executed (one dispatch folding many steps)",
+    "scan_steps_folded": "real update steps folded across all scan drains",
+    "scan_pad_steps": "masked no-op padding steps added to fill scan K-buckets",
+    "scan_flushes": "scan-queue flushes (drains + discards)",
     "quarantined_batches": "poisoned batches skipped in-graph by the quarantine transaction",
     "ladder_retries": "dispatch failures that stepped down the fallback ladder to a smaller bucket",
     "packed_syncs": "packed epoch syncs completed",
@@ -168,6 +172,10 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
     emit(
         f"{_PREFIX}_fallback_reasons_total", "counter", "eager fallbacks by reason",
         [({"reason": r}, n) for r, n in sorted(counters.get("fallback_reasons", {}).items())],
+    )
+    emit(
+        f"{_PREFIX}_scan_flush_reasons_total", "counter", "multi-step scan-queue flushes by reason",
+        [({"reason": r}, n) for r, n in sorted(counters.get("scan_flush_reasons", {}).items())],
     )
     emit(
         f"{_PREFIX}_events_total", "counter", "flight-recorder events by kind",

@@ -65,6 +65,7 @@ from torchmetrics_tpu.engine.numerics import (
     set_compensated,
     set_drift_rtol,
 )
+from torchmetrics_tpu.engine.scan import scan_context, set_scan_steps
 from torchmetrics_tpu.engine.stats import EngineStats, engine_report, reset_engine_stats
 from torchmetrics_tpu.engine.txn import (
     QuarantinedBatchError,
@@ -87,8 +88,10 @@ __all__ = [
     "quarantine_context",
     "quarantine_report",
     "reset_engine_stats",
+    "scan_context",
     "set_compensated",
     "set_drift_rtol",
     "set_engine_enabled",
     "set_quarantine_mode",
+    "set_scan_steps",
 ]

@@ -279,15 +279,14 @@ def state_invalidated(metric: Any) -> bool:
     return False
 
 
-def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
-    """Compile ``run(state_pytree, flat_inputs) -> state_pytree`` into a jitted
-    step with the state pytree donated (policy permitting).
+def make_step_body(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
+    """The un-jitted per-step composition ``(state, n_pad, flat) -> state``.
 
-    Shared by the per-metric and the fused engines — the pad-subtract identity
-    and the donation flag live HERE, once. With ``bucketed`` the step takes a
-    traced ``n_pad`` scalar and subtracts the pad rows' contribution in-graph
-    (see ``engine/bucketing.py``); ``tree_map`` keeps it agnostic to whether the
-    state pytree is one metric's dict or a fused dict-of-dicts.
+    Shared by :func:`make_step` (one step per dispatch) and the multi-step
+    scan drain (``engine/scan.py``, which runs this body once per ``lax.scan``
+    step over the queued axis) — the pad-subtract identity and the rider
+    ordering (pad-subtract → compensation → quarantine transaction) live HERE,
+    once. ``n_pad`` is ignored when ``bucketed`` is False.
 
     ``comp`` is the optional compensated-accumulation recomposition
     (``engine/numerics.py``), ``(old_state, result, flat) -> result``, applied
@@ -305,13 +304,13 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
     import jax
     import jax.numpy as jnp
 
-    from torchmetrics_tpu.engine import bucketing, config
+    from torchmetrics_tpu.engine import bucketing
 
-    if bucketed:
-        pad_rows = bucketing.pad_row_constants(inputs)
+    pad_rows = bucketing.pad_row_constants(inputs) if bucketed else ()
 
-        def step(state, n_pad, *flat):
-            out = run(state, flat)
+    def body(state, n_pad, flat):
+        out = run(state, flat)
+        if bucketed:
             zeros = jax.tree_util.tree_map(jnp.zeros_like, state)
             # per-pad-row contribution: constant zero rows for batched inputs,
             # the live traced value for non-batched ones
@@ -333,20 +332,118 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
                 return o - u * n_pad.astype(o.dtype)
 
             result = jax.tree_util.tree_map_with_path(subtract, out, unit)
-            if comp is not None:
-                result = comp(state, result, flat)
-            return txn(state, result, flat) if txn is not None else result
+        else:
+            result = out
+        if comp is not None:
+            result = comp(state, result, flat)
+        return txn(state, result, flat) if txn is not None else result
+
+    return body
+
+
+def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
+    """Compile ``run(state_pytree, flat_inputs) -> state_pytree`` into a jitted
+    step with the state pytree donated (policy permitting).
+
+    Shared by the per-metric and the fused engines; the composition itself is
+    :func:`make_step_body` (also the scan drain's per-step body). ``tree_map``
+    keeps it agnostic to whether the state pytree is one metric's dict or a
+    fused dict-of-dicts.
+    """
+    import jax
+
+    from torchmetrics_tpu.engine import config
+
+    body = make_step_body(run, bucketed, inputs, txn=txn, comp=comp)
+
+    if bucketed:
+
+        def step(state, n_pad, *flat):
+            return body(state, n_pad, flat)
 
     else:
 
         def step(state, *flat):
-            result = run(state, flat)
-            if comp is not None:
-                result = comp(state, result, flat)
-            return txn(state, result, flat) if txn is not None else result
+            return body(state, None, flat)
 
     donate = config.donation_enabled()
     return jax.jit(step, donate_argnums=(0,) if donate else ()), donate
+
+
+def build_run(m: Any, owner: str, n_args: int, kw_names: Tuple[str, ...], quarantined: bool, comp_names: Tuple[str, ...]):
+    """The traced update body ``run(state, flat) -> state`` for one metric.
+
+    Factored out of :meth:`CompiledUpdate._compile` so the multi-step scan
+    drain (``engine/scan.py``) composes the IDENTICAL graph per queued step —
+    rider handling (sentinel fold placement, quarantine counter passthrough,
+    zeroed compensated states) included.
+    """
+    import jax
+
+    def run(state, flat):
+        import jax.numpy as jnp
+
+        state = dict(state)
+        sentinel = state.pop(_sentinel.STATE_KEY, None)
+        qcount = state.pop(_txn.STATE_KEY, None)
+        residuals = state.pop(_numerics.STATE_KEY, None)
+        if residuals is not None:
+            # compensated states enter the update body ZEROED: the body
+            # then leaves the pure batch contribution behind, and the
+            # two-sum recomposition in make_step folds it into the
+            # preserved old value with the exact error term
+            state = {
+                k: jnp.zeros_like(v) if k in comp_names else v for k, v in state.items()
+            }
+        call_args = tuple(flat[:n_args])
+        call_kwargs = dict(zip(kw_names, flat[n_args:]))
+        # named_scope is trace-time only: the HLO ops of this update body
+        # carry the owner's name, so device profiles attribute their slices
+        with jax.named_scope(f"{owner}:update"):
+            out = traced_update(m, state, call_args, call_kwargs)
+        if sentinel is not None:
+            # with the quarantine transaction active the health checks fold
+            # over the SELECTED (post-transaction) states instead — a
+            # quarantined NaN input must not raise the nan bit on a state
+            # that stayed clean; under compensation the body only saw
+            # ZEROED copies, so the fold moves into the recomposition
+            # (build_compensation) where the real accumulators exist
+            out[_sentinel.STATE_KEY] = (
+                sentinel
+                if quarantined or residuals is not None
+                else _sentinel.update_flags(sentinel, out, m)
+            )
+        if qcount is not None:
+            out[_txn.STATE_KEY] = qcount
+        if residuals is not None:
+            out[_numerics.STATE_KEY] = residuals  # passthrough; folded in make_step
+        return out
+
+    return run
+
+
+def build_riders(m: Any, inputs: Sequence[Any]):
+    """``(quarantined, comp_names, step_txn, step_comp)`` for the active rider config.
+
+    One planning site for the quarantine admission + transaction and the
+    compensated recomposition closures, shared by the one-step compile and the
+    scan drain so the composition can never drift between them.
+    """
+    quarantined = _txn.quarantine_enabled()
+    comp_names = _numerics.comp_state_names(m) if _numerics.compensation_active(m) else ()
+    admission = _txn.build_admission(m, inputs) if quarantined else None
+    step_txn = None
+    if quarantined:
+
+        def step_txn(old_state, result, flat):
+            return _txn.transact(m, old_state, result, admission(flat))
+
+    step_comp = (
+        _numerics.build_compensation(m, comp_names, admission=admission)
+        if comp_names
+        else None
+    )
+    return quarantined, comp_names, step_txn, step_comp
 
 
 def state_signature(state: Dict[str, Any]) -> Tuple:
@@ -406,6 +503,7 @@ class CompiledUpdate:
         self._transient_fails: Dict[Tuple, int] = {}  # key -> classified-failure count (ladder budget)
         self.stats = EngineStats(type(metric).__name__)
         self._bucket_ok: Optional[bool] = None
+        self._scan = None  # lazy multi-step queue (engine/scan.py)
         defaults = metric._defaults
         self._disabled_reason: Optional[str] = None
         if not defaults:
@@ -414,6 +512,25 @@ class CompiledUpdate:
             self._disabled_reason = "list-state"
         elif holds_nested_metrics(metric):
             self._disabled_reason = "nested-metric"
+
+    # ------------------------------------------------------------------ scan
+
+    def scan_step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> bool:
+        """Queue one update payload for the K-folding scan drain.
+
+        Returns True when the payload was queued (it folds into state at the
+        next drain — K reached, signature change, or any state observation);
+        False requests the eager fallback for THIS step, after draining any
+        pending payloads so ordering is preserved.
+        """
+        if self._disabled_reason is not None:
+            self.stats.fallback(self._disabled_reason)
+            return False
+        if self._scan is None:
+            from torchmetrics_tpu.engine.scan import MetricScan
+
+            self._scan = MetricScan(self)
+        return self._scan.push(args, kwargs, k)
 
     # ------------------------------------------------------------------ step
 
@@ -685,66 +802,10 @@ class CompiledUpdate:
         n_pad: int,
         key: Tuple,
     ):
-        import jax
-
         m = self._metric
         owner = self.stats.owner
-        quarantined = _txn.quarantine_enabled()
-        comp_names = (
-            _numerics.comp_state_names(m) if _numerics.compensation_active(m) else ()
-        )
-
-        def run(state, flat):
-            import jax.numpy as jnp
-
-            state = dict(state)
-            sentinel = state.pop(_sentinel.STATE_KEY, None)
-            qcount = state.pop(_txn.STATE_KEY, None)
-            residuals = state.pop(_numerics.STATE_KEY, None)
-            if residuals is not None:
-                # compensated states enter the update body ZEROED: the body
-                # then leaves the pure batch contribution behind, and the
-                # two-sum recomposition in make_step folds it into the
-                # preserved old value with the exact error term
-                state = {
-                    k: jnp.zeros_like(v) if k in comp_names else v for k, v in state.items()
-                }
-            call_args = tuple(flat[:n_args])
-            call_kwargs = dict(zip(kw_names, flat[n_args:]))
-            # named_scope is trace-time only: the HLO ops of this update body
-            # carry the owner's name, so device profiles attribute their slices
-            with jax.named_scope(f"{owner}:update"):
-                out = traced_update(m, state, call_args, call_kwargs)
-            if sentinel is not None:
-                # with the quarantine transaction active the health checks fold
-                # over the SELECTED (post-transaction) states instead — a
-                # quarantined NaN input must not raise the nan bit on a state
-                # that stayed clean; under compensation the body only saw
-                # ZEROED copies, so the fold moves into the recomposition
-                # (build_compensation) where the real accumulators exist
-                out[_sentinel.STATE_KEY] = (
-                    sentinel
-                    if quarantined or residuals is not None
-                    else _sentinel.update_flags(sentinel, out, m)
-                )
-            if qcount is not None:
-                out[_txn.STATE_KEY] = qcount
-            if residuals is not None:
-                out[_numerics.STATE_KEY] = residuals  # passthrough; folded in make_step
-            return out
-
-        admission = _txn.build_admission(m, inputs) if quarantined else None
-        step_txn = None
-        if quarantined:
-
-            def step_txn(old_state, result, flat):
-                return _txn.transact(m, old_state, result, admission(flat))
-
-        step_comp = (
-            _numerics.build_compensation(m, comp_names, admission=admission)
-            if comp_names
-            else None
-        )
+        quarantined, comp_names, step_txn, step_comp = build_riders(m, inputs)
+        run = build_run(m, owner, n_args, kw_names, quarantined, comp_names)
         fn, donate = make_step(run, bucketed, inputs, txn=step_txn, comp=step_comp)
         # ahead-of-time compile: same single trace+compile as the lazy first
         # dispatch, but the Compiled handle feeds the diag cost/memory ledger

@@ -48,6 +48,130 @@ from torchmetrics_tpu.engine.compiled import (
 from torchmetrics_tpu.engine.stats import EngineStats
 
 
+def probe_fusable(
+    members: Sequence[Tuple[str, Any]],
+    states: Dict[str, Dict[str, Any]],
+    inputs: Sequence[Any],
+    stats: EngineStats,
+) -> frozenset:
+    """The member names whose update bodies trace abstractly on these inputs.
+
+    The ``jax.eval_shape`` probe runs each member's update abstractly (no XLA
+    compile), so one metric with host-side validation or update side effects
+    is excluded — with its reason counted — instead of poisoning the whole
+    fused executable. Shared by the one-step compile and the scan queue's
+    enqueue-time membership resolution.
+    """
+    import jax
+
+    fusable = []
+    for name, m in members:
+        try:
+            jax.eval_shape(lambda s, *f, _m=m: traced_update(_m, s, f, {}), states[name], *inputs)
+            fusable.append(name)
+        except Exception as exc:  # noqa: BLE001 — probe failure excludes ONE member
+            stats.fallback_reasons[f"member:{name}:{type(exc).__name__}"] += 1
+            _diag.record("fused.exclude", stats.owner, member=name, reason=type(exc).__name__)
+    return frozenset(fusable)
+
+
+def build_run_all(
+    fusable: Sequence[Tuple[str, Any]],
+    comp_names: Dict[str, Tuple[str, ...]],
+    quarantined: bool,
+):
+    """The fused traced body ``run_all(fused_states, flat) -> fused_states``.
+
+    Factored out of :meth:`FusedUpdate._compile` so the scan drain
+    (``engine/scan.py``) composes the IDENTICAL dict-of-dicts graph per
+    queued step, rider handling included.
+    """
+    import jax
+
+    def run_all(fused_states, flat):
+        import jax.numpy as jnp
+
+        out = {}
+        for name, m in fusable:
+            mstate = dict(fused_states[name])
+            sentinel = mstate.pop(_sentinel.STATE_KEY, None)
+            qcount = mstate.pop(_txn.STATE_KEY, None)
+            residuals = mstate.pop(_numerics.STATE_KEY, None)
+            if residuals is not None:
+                # compensated states enter the body zeroed — the body
+                # leaves the pure contribution, recomposed in make_step
+                zero = comp_names.get(name, ())
+                mstate = {
+                    k: jnp.zeros_like(v) if k in zero else v for k, v in mstate.items()
+                }
+            # per-member named_scope: inside the ONE fused executable each
+            # member's ops still attribute to their own metric in profiles
+            with jax.named_scope(f"{name}:update"):
+                updated = traced_update(m, mstate, tuple(flat), {})
+            if sentinel is not None:
+                # under quarantine the health checks fold over the
+                # per-member SELECTED states inside the transaction
+                # instead; under compensation over the RECOMPOSED states
+                # in build_compensation (the body saw zeroed copies)
+                updated[_sentinel.STATE_KEY] = (
+                    sentinel
+                    if quarantined or residuals is not None
+                    else _sentinel.update_flags(sentinel, updated, m)
+                )
+            if qcount is not None:
+                updated[_txn.STATE_KEY] = qcount
+            if residuals is not None:
+                updated[_numerics.STATE_KEY] = residuals
+            out[name] = updated
+        return out
+
+    return run_all
+
+
+def build_fused_riders(fusable: Sequence[Tuple[str, Any]], inputs: Sequence[Any]):
+    """``(quarantined, comp_names, step_txn, step_comp)`` for the fused state.
+
+    The dict-of-dicts analogue of ``compiled.build_riders`` — one admission
+    plan per member (bounds like ``num_classes`` are per-metric), one
+    compensation recomposition per compensated member.
+    """
+    quarantined = _txn.quarantine_enabled()
+    comp_names = {
+        name: _numerics.comp_state_names(m)
+        for name, m in fusable
+        if _numerics.compensation_active(m)
+    }
+    admissions = (
+        {name: _txn.build_admission(m, inputs) for name, m in fusable} if quarantined else {}
+    )
+    step_txn = None
+    if quarantined:
+
+        def step_txn(old_states, result, flat):
+            return {
+                name: _txn.transact(m, old_states[name], result[name], admissions[name](flat))
+                for name, m in fusable
+            }
+
+    step_comp = None
+    if comp_names:
+        comps = {
+            name: _numerics.build_compensation(m, comp_names[name], admission=admissions.get(name))
+            for name, m in fusable
+            if name in comp_names
+        }
+
+        def step_comp(old_states, result, flat):
+            return {
+                name: comps[name](old_states[name], result[name], flat)
+                if name in comps
+                else result[name]
+                for name in result
+            }
+
+    return quarantined, comp_names, step_txn, step_comp
+
+
 class FusedUpdate:
     """One compiled executable updating several metrics' states per step."""
 
@@ -61,7 +185,48 @@ class FusedUpdate:
         # re-walking every member's __dict__ for nested metrics on EVERY step
         # was the dominant warm-path cost in the r09 regression bisect
         self._member_ok: Dict[str, bool] = {}
+        self._scan = None  # lazy multi-step queue (engine/scan.py)
+        #: set by the owning MetricCollection: re-anchor group views after a
+        #: scan drain donates the owners' buffers outside a collection step
+        self.on_scan_drain = None
         self.stats = EngineStats("fused:" + ",".join(type(m).__name__ for _, m in self.metrics))
+
+    def eligible_members(self, check_arrays: bool = True) -> List[Tuple[str, Any]]:
+        """The members structurally able to fuse right now (opt-outs honored).
+
+        ``check_arrays=False`` skips the per-state array walk — the scan queue
+        uses it on non-initial enqueues, where states cannot have changed
+        since the queue-start check (only drains write them).
+        """
+        members: List[Tuple[str, Any]] = []
+        for name, m in self.metrics:
+            if m.compiled_update is False:  # the per-metric opt-out outranks fusion
+                continue
+            ok = self._member_ok.get(name)
+            if ok is None:
+                ok = bool(m._defaults) and not any(
+                    isinstance(d, list) for d in m._defaults.values()
+                ) and not holds_nested_metrics(m)
+                self._member_ok[name] = ok
+            if not ok:
+                continue
+            if check_arrays and not all(_is_jax_array(getattr(m, k)) for k in m._defaults):
+                continue
+            members.append((name, m))
+        return members
+
+    def scan_step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> Optional[Set[str]]:
+        """Queue one fused payload for the K-folding scan drain.
+
+        Returns the handled member names (resolved by an abstract trace probe
+        at enqueue time), or ``None`` when this step cannot queue — the caller
+        runs members individually, and their own per-metric queues apply.
+        """
+        if self._scan is None:
+            from torchmetrics_tpu.engine.scan import FusedScan
+
+            self._scan = FusedScan(self)
+        return self._scan.push(args, kwargs, k)
 
     @staticmethod
     def _fingerprint(state_sig: Tuple, in_sig: Tuple, bucket: Optional[int]) -> Dict[str, Any]:
@@ -112,29 +277,17 @@ class FusedUpdate:
             st.fallback("non-array-input")
             return None
 
-        members: List[Tuple[str, Any]] = []
+        members = self.eligible_members()
         states: Dict[str, Dict[str, Any]] = {}
-        for name, m in self.metrics:
-            if m.compiled_update is False:  # the per-metric opt-out outranks fusion
-                continue
-            ok = self._member_ok.get(name)
-            if ok is None:
-                ok = bool(m._defaults) and not any(
-                    isinstance(d, list) for d in m._defaults.values()
-                ) and not holds_nested_metrics(m)
-                self._member_ok[name] = ok
-            if not ok:
-                continue
+        for name, m in members:
             mstate = {k: getattr(m, k) for k in m._defaults}
-            if all(_is_jax_array(v) for v in mstate.values()):
-                if _sentinel.sentinel_enabled():
-                    mstate[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
-                if _txn.quarantine_enabled():
-                    mstate[_txn.STATE_KEY] = _txn.ensure_count(m)
-                if _numerics.compensation_active(m):
-                    mstate[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
-                members.append((name, m))
-                states[name] = mstate
+            if _sentinel.sentinel_enabled():
+                mstate[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
+            if _txn.quarantine_enabled():
+                mstate[_txn.STATE_KEY] = _txn.ensure_count(m)
+            if _numerics.compensation_active(m):
+                mstate[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
+            states[name] = mstate
         if len(members) < 2:
             st.fallback("too-few-members")
             return None
@@ -298,90 +451,13 @@ class FusedUpdate:
         """
         import jax
 
-        fusable: List[Tuple[str, Any]] = []
-        for name, m in members:
-            try:
-                jax.eval_shape(lambda s, *f, _m=m: traced_update(_m, s, f, {}), states[name], *inputs)
-                fusable.append((name, m))
-            except Exception as exc:  # noqa: BLE001 — probe failure excludes ONE member
-                self.stats.fallback_reasons[f"member:{name}:{type(exc).__name__}"] += 1
-                _diag.record("fused.exclude", self.stats.owner, member=name, reason=type(exc).__name__)
+        fused_names = probe_fusable(members, states, inputs, self.stats)
+        fusable: List[Tuple[str, Any]] = [(n, m) for n, m in members if n in fused_names]
         if len(fusable) < 2:
             return None
 
-        quarantined = _txn.quarantine_enabled()
-        comp_names = {
-            name: _numerics.comp_state_names(m)
-            for name, m in fusable
-            if _numerics.compensation_active(m)
-        }
-
-        def run_all(fused_states, flat):
-            import jax.numpy as jnp
-
-            out = {}
-            for name, m in fusable:
-                mstate = dict(fused_states[name])
-                sentinel = mstate.pop(_sentinel.STATE_KEY, None)
-                qcount = mstate.pop(_txn.STATE_KEY, None)
-                residuals = mstate.pop(_numerics.STATE_KEY, None)
-                if residuals is not None:
-                    # compensated states enter the body zeroed — the body
-                    # leaves the pure contribution, recomposed in make_step
-                    zero = comp_names.get(name, ())
-                    mstate = {
-                        k: jnp.zeros_like(v) if k in zero else v for k, v in mstate.items()
-                    }
-                # per-member named_scope: inside the ONE fused executable each
-                # member's ops still attribute to their own metric in profiles
-                with jax.named_scope(f"{name}:update"):
-                    updated = traced_update(m, mstate, tuple(flat), {})
-                if sentinel is not None:
-                    # under quarantine the health checks fold over the
-                    # per-member SELECTED states inside the transaction
-                    # instead; under compensation over the RECOMPOSED states
-                    # in build_compensation (the body saw zeroed copies)
-                    updated[_sentinel.STATE_KEY] = (
-                        sentinel
-                        if quarantined or residuals is not None
-                        else _sentinel.update_flags(sentinel, updated, m)
-                    )
-                if qcount is not None:
-                    updated[_txn.STATE_KEY] = qcount
-                if residuals is not None:
-                    updated[_numerics.STATE_KEY] = residuals
-                out[name] = updated
-            return out
-
-        admissions = (
-            {name: _txn.build_admission(m, inputs) for name, m in fusable} if quarantined else {}
-        )
-        step_txn = None
-        if quarantined:
-            # one admission plan per member: bounds (num_classes) are per-metric
-
-            def step_txn(old_states, result, flat):
-                return {
-                    name: _txn.transact(m, old_states[name], result[name], admissions[name](flat))
-                    for name, m in fusable
-                }
-
-        step_comp = None
-        if comp_names:
-            comps = {
-                name: _numerics.build_compensation(m, comp_names[name], admission=admissions.get(name))
-                for name, m in fusable
-                if name in comp_names
-            }
-
-            def step_comp(old_states, result, flat):
-                return {
-                    name: comps[name](old_states[name], result[name], flat)
-                    if name in comps
-                    else result[name]
-                    for name in result
-                }
-
+        quarantined, comp_names, step_txn, step_comp = build_fused_riders(fusable, inputs)
+        run_all = build_run_all(fusable, comp_names, quarantined)
         fn, donate = make_step(run_all, bucketed, inputs, txn=step_txn, comp=step_comp)
         # AOT compile for the diag cost ledger (same single trace+compile).
         # tree_leaves-based byte count: rider entries may nest (the residual dict)

@@ -1,0 +1,942 @@
+"""Multi-step scan dispatch — fold K queued update steps into ONE donated
+``lax.scan`` executable.
+
+The per-step hot loop is host-dispatch-dominated: the XLA ledger shows tiny
+device work while an engine step costs hundreds of µs of Python + launch
+overhead on CPU (BENCH_r10–r13). This module amortizes the dispatch itself:
+a per-owner :class:`ScanQueue` buffers up to ``K`` update payloads that share
+one compile signature (treedef, bucketed shapes/dtypes), then drains them
+through a single cached executable whose body is ``lax.scan`` over the queued
+axis — each scan step re-runs the engine's OWN per-step composition
+(:func:`~torchmetrics_tpu.engine.compiled.make_step_body`: update body →
+pad-subtract → compensated two-sum → quarantine transaction) against the
+donated state carry, so K steps cost one dispatch instead of K.
+
+Design points:
+
+- **K-buckets + masked padding.** A drain of ``S ≤ K`` steps pads up to the
+  next power-of-two ``k_bucket(S)`` and masks the pad steps with a traced
+  ``valid`` flag (``jnp.where(valid, new, carry)`` per leaf), so ragged queue
+  tails reuse O(log K) executables instead of compiling one per tail length —
+  the same philosophy as ``engine/bucketing.py``'s pad-subtract. Pad steps
+  replay the LAST real step's input arrays (no allocation); the mask
+  guarantees their values, sentinel bits, quarantine verdicts, and residual
+  contributions never land in state.
+- **Rider composition per scan step.** The quarantine admission + rollback
+  select evaluates per step inside the scan body, so a poisoned step skips
+  only itself (the carry flows on); compensated two-sum accumulation runs per
+  step against the carried residual; sentinel bits OR across steps; the
+  ``__sentinel__``/``__quarantine__``/``__compensation__`` reserved keys ride
+  the carry like any other state leaf.
+- **Flush points.** The queue drains on: signature change, K reached, and ANY
+  state observation — ``compute()``, ``sync()``, ``forward()``,
+  ``state_dict()``, ``merge_state``, cloning/pickling, device moves,
+  ``snapshot_compute()``/``take_snapshot``, and sidecar scrapes via
+  ``serve/snapshot.read_host`` — each recorded as a ``scan.flush`` event with
+  its reason. A scrape can therefore never observe state that is K steps
+  stale. ``reset()`` DISCARDS the queue instead (applying updates that the
+  reset immediately wipes is byte-identical to skipping them).
+- **Donation-stable carry.** ``lax.scan`` needs a fixed carry signature, but
+  an update body may promote dtypes (the x64 first-update int32→int64
+  widening). The compile pre-resolves the body's output dtypes via
+  ``jax.eval_shape`` and casts the incoming state once, up front — exactly
+  the state the one-step engine would hold after its first update — and
+  requires a fixed point (a body that keeps reshaping its state cannot scan
+  and replays step-at-a-time, counted).
+
+Enablement (first hit wins; invalid values FAIL LOUD per the PR-7 env
+contract): per-metric ``Metric(scan_steps=K)`` /
+``MetricCollection(scan_steps=K)`` (``0``/``False`` forces off), an active
+:func:`scan_context` / :func:`set_scan_steps` override, then
+``TORCHMETRICS_TPU_SCAN=K``. The queue additionally requires the engine
+itself to be enabled — scan rides the compiled-step machinery.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from contextlib import contextmanager
+from time import perf_counter
+from typing import Any, Dict, FrozenSet, Generator, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.diag import costs as _costs
+from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import profile as _profile
+from torchmetrics_tpu.diag import sentinel as _sentinel
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.engine import bucketing, config
+from torchmetrics_tpu.engine import numerics as _numerics
+from torchmetrics_tpu.engine import txn as _txn
+from torchmetrics_tpu.engine.compiled import (
+    _FALLBACK,
+    _Ineligible,
+    _is_jax_array,
+    annotation_scope,
+    build_riders,
+    build_run,
+    completion_probe,
+    input_signature,
+    make_step_body,
+    shield_state,
+    signature_fingerprint,
+    state_invalidated,
+    state_signature,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = [
+    "MAX_K",
+    "SCAN_ENV_VAR",
+    "coerce_k",
+    "discard_metric",
+    "discard_metrics",
+    "flush_all",
+    "flush_metric",
+    "flush_metrics",
+    "k_bucket",
+    "scan_context",
+    "scan_k",
+    "set_scan_steps",
+]
+
+SCAN_ENV_VAR = "TORCHMETRICS_TPU_SCAN"
+
+#: upper bound on the queue depth — past ~1k steps the stacked inputs' device
+#: footprint (K x input bytes) dwarfs any remaining dispatch amortization
+MAX_K = 1024
+
+#: K-buckets up to this size compile FULLY UNROLLED (no lax.scan machinery);
+#: deeper queues ride a bounded-unroll lax.scan so compile time stays flat
+UNROLL_MAX = 32
+
+_UNSET = object()
+_k_override: Any = _UNSET
+
+
+# ------------------------------------------------------------------ policy
+
+
+def coerce_k(value: Any) -> Optional[int]:
+    """Validate a queue-depth knob: ``0``/``False`` = forced off, int in
+    [2, MAX_K] = depth; ``None`` passes through (defer to the policy)."""
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        if value:
+            raise TorchMetricsUserError(
+                "scan_steps=True is ambiguous — pass the queue depth K (an int >= 2),"
+                " or 0/False to disable the queue"
+            )
+        return 0
+    if isinstance(value, int):
+        if value == 0:
+            return 0
+        if 2 <= value <= MAX_K:
+            return value
+    raise TorchMetricsUserError(
+        f"scan queue depth must be 0 (off) or an integer in [2, {MAX_K}] (got {value!r});"
+        " K=1 is the unqueued engine — leave the knob unset instead"
+    )
+
+
+def scan_k() -> Optional[int]:
+    """The active queue depth K, or ``None`` when multi-step scan is off.
+
+    An unrecognized ``TORCHMETRICS_TPU_SCAN`` value fails loud (the PR-7 env
+    contract): a typo must not silently disable the amortization it was set
+    to enable — nor silently enable a nonsense depth.
+    """
+    if _k_override is not _UNSET:
+        return _k_override or None
+    raw = os.environ.get(SCAN_ENV_VAR, "").strip().lower()
+    if raw in ("", "0", "off"):
+        return None
+    try:
+        k = int(raw)
+    except ValueError:
+        raise TorchMetricsUserError(
+            f"{SCAN_ENV_VAR}={raw!r} is not a valid queue depth (expected unset/'0'/'off'"
+            f" or an integer K in [2, {MAX_K}])"
+        ) from None
+    if not (2 <= k <= MAX_K):
+        raise TorchMetricsUserError(
+            f"{SCAN_ENV_VAR}={k} is out of range: K must be in [2, {MAX_K}]"
+            " (K=1 is the unqueued engine — unset the variable instead)"
+        )
+    return k
+
+
+def set_scan_steps(value: Optional[Any]) -> None:
+    """Force the queue depth process-wide (``0``/``False`` = off); ``None``
+    restores env resolution."""
+    global _k_override
+    _k_override = _UNSET if value is None else coerce_k(value)
+
+
+@contextmanager
+def scan_context(k: int = 8) -> Generator[None, None, None]:
+    """Scoped multi-step scan enablement (benches, tests, serving loops).
+
+    Exiting the scope FLUSHES every queue with pending steps (reason
+    ``scope-exit``) — state outside the scope is never stale — and restores
+    the previous policy.
+    """
+    global _k_override
+    prev = _k_override
+    _k_override = coerce_k(k)
+    try:
+        yield
+    finally:
+        try:
+            flush_all("scope-exit")
+        finally:
+            # restore even when a drain raises: a flush failure must not leak
+            # the forced depth process-wide
+            _k_override = prev
+
+
+def k_bucket(n: int) -> int:
+    """Smallest power-of-two scan length holding ``n`` queued steps."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ------------------------------------------------------------------ registry
+
+_seq_counter = iter(range(1, 1 << 62))
+#: live queues, weakly held (a queue lives exactly as long as its engine)
+_QUEUES: "weakref.WeakValueDictionary[int, _ScanQueue]" = weakref.WeakValueDictionary()
+
+
+def flush_metric(metric: Any, reason: str) -> int:
+    """Drain every queue holding pending steps for ``metric``; returns steps drained."""
+    if not _QUEUES:
+        return 0
+    drained = 0
+    for q in list(_QUEUES.values()):
+        if q.pending and q.owns(metric):
+            drained += q.drain(reason)
+    return drained
+
+
+def flush_metrics(metrics: Sequence[Any], reason: str) -> int:
+    """Drain every queue holding pending steps for ANY of ``metrics``."""
+    if not _QUEUES:
+        return 0
+    drained = 0
+    for q in list(_QUEUES.values()):
+        if q.pending and any(q.owns(m) for m in metrics):
+            drained += q.drain(reason)
+    return drained
+
+
+def flush_all(reason: str) -> int:
+    """Drain every live queue (scope exit, sidecar scrape)."""
+    if not _QUEUES:
+        return 0
+    drained = 0
+    for q in list(_QUEUES.values()):
+        if q.pending:
+            drained += q.drain(reason)
+    return drained
+
+
+def discard_metric(metric: Any, reason: str) -> int:
+    """Drop ``metric``'s pending steps WITHOUT dispatching (the reset path).
+
+    Discard is only byte-identical for queues the resetting metric owns
+    EXCLUSIVELY (its per-metric queue): a shared fused queue also carries the
+    sibling members' enqueued steps, so it DRAINS instead — the siblings get
+    their updates, and the caller's reset then wipes its own folded share
+    (identical to having skipped it).
+    """
+    if not _QUEUES:
+        return 0
+    dropped = 0
+    for q in list(_QUEUES.values()):
+        if q.pending and q.owns(metric):
+            if q.exclusive_to((metric,)):
+                dropped += q.discard(reason)
+            else:
+                dropped += q.drain(reason)
+    return dropped
+
+
+def discard_metrics(metrics: Sequence[Any], reason: str) -> int:
+    """Collection-reset discard: queues owned entirely WITHIN ``metrics`` drop
+    their payloads; a queue sharing members outside the set drains instead."""
+    if not _QUEUES:
+        return 0
+    dropped = 0
+    for q in list(_QUEUES.values()):
+        if q.pending and any(q.owns(m) for m in metrics):
+            if q.exclusive_to(metrics):
+                dropped += q.discard(reason)
+            else:
+                dropped += q.drain(reason)
+    return dropped
+
+
+# ------------------------------------------------------------------ the scan executable
+
+
+def compile_scan(body, example_state, example_inputs: Sequence[Any], kb: int, owner: str, key: Tuple, stats):
+    """Jit + AOT-compile the K-folding scan over ``body`` (the per-step
+    composition from :func:`~torchmetrics_tpu.engine.compiled.make_step_body`).
+
+    The executable's signature is ``(state, valid[kb], n_pads[kb],
+    *flat_steps)`` with ``flat_steps`` holding ``kb`` step-major groups of the
+    per-step inputs; the inputs stack INSIDE the graph (no host-side device
+    ops, one dispatch per drain) and the state carry is donated.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_in = len(example_inputs)
+
+    def abstract_body(s, f):
+        return body(s, np.int32(0), tuple(f))
+
+    # carry-signature stabilization: resolve the body's output dtypes once and
+    # cast the incoming state up front (the x64 first-update promotion), then
+    # require a fixed point — lax.scan cannot carry a changing signature
+    out_shapes = jax.eval_shape(abstract_body, example_state, list(example_inputs))
+    out_tree = jax.tree_util.tree_structure(out_shapes)
+    if jax.tree_util.tree_structure(example_state) != out_tree:
+        raise _Ineligible("scan carry structure changes under the update body")
+    out_leaves = jax.tree_util.tree_leaves(out_shapes)
+    in_leaves = jax.tree_util.tree_leaves(example_state)
+    for a, b in zip(in_leaves, out_leaves):
+        if tuple(a.shape) != tuple(b.shape):
+            raise _Ineligible("scan carry shape changes under the update body")
+    carry_dtypes = jax.tree_util.tree_unflatten(out_tree, [leaf.dtype for leaf in out_leaves])
+    cast_example = jax.tree_util.tree_unflatten(
+        out_tree,
+        [jax.ShapeDtypeStruct(tuple(a.shape), b.dtype) for a, b in zip(in_leaves, out_leaves)],
+    )
+    fixed = jax.eval_shape(abstract_body, cast_example, list(example_inputs))
+    for a, b in zip(jax.tree_util.tree_leaves(fixed), out_leaves):
+        if a.dtype != b.dtype or tuple(a.shape) != tuple(b.shape):
+            raise _Ineligible("scan carry does not reach a dtype fixed point")
+
+    def masked_step(carry, valid_t, n_pad_t, flat_t):
+        new = body(carry, n_pad_t, flat_t)
+        # masked no-op padding: an invalid (pad) step selects the carry
+        # back leaf-wise — its values, sentinel bits, quarantine verdict,
+        # and residual contribution all evaporate
+        return jax.tree_util.tree_map(
+            lambda nv, ov: jnp.where(valid_t, nv, ov), new, carry
+        )
+
+    if kb <= UNROLL_MAX:
+        # small K-buckets trace FULLY UNROLLED: the step inputs feed the
+        # bodies directly (no stack, no per-step dynamic slice, no While-loop
+        # carry round-trip — all measurable against the tiny bodies on CPU)
+        # and XLA fuses across the steps
+
+        def scan_fn(state, valid, n_pads, *flat_steps):
+            carry = jax.tree_util.tree_map(
+                lambda v, d: v.astype(d) if v.dtype != d else v, state, carry_dtypes
+            )
+            for t in range(kb):
+                flat_t = flat_steps[t * n_in : (t + 1) * n_in]
+                carry = masked_step(carry, valid[t], n_pads[t], flat_t)
+            return carry
+
+    else:
+
+        def scan_fn(state, valid, n_pads, *flat_steps):
+            state = jax.tree_util.tree_map(
+                lambda v, d: v.astype(d) if v.dtype != d else v, state, carry_dtypes
+            )
+            cols = tuple(
+                jnp.stack([flat_steps[t * n_in + j] for t in range(kb)]) for j in range(n_in)
+            )
+
+            def scan_body(carry, xs):
+                return masked_step(carry, xs[0], xs[1], xs[2:]), None
+
+            # deep queues ride a real lax.scan with a bounded partial unroll:
+            # compile time stays O(UNROLL_MAX) bodies regardless of K
+            final, _ = lax.scan(
+                scan_body, state, (valid, n_pads) + cols, unroll=8
+            )
+            return final
+
+    donate = config.donation_enabled()
+    fn = jax.jit(scan_fn, donate_argnums=(0,) if donate else ())
+    example_valid = np.zeros((kb,), np.bool_)
+    example_valid[:1] = True
+    example_pads = np.zeros((kb,), np.int32)
+    example_flat: List[Any] = []
+    for _ in range(kb):
+        example_flat.extend(example_inputs)
+    state_bytes = sum(getattr(leaf, "nbytes", 0) for leaf in in_leaves)
+    fn = _costs.aot_compile(
+        fn,
+        owner=owner,
+        kind="scan",
+        args=(example_state, example_valid, example_pads, *example_flat),
+        donated_bytes=state_bytes if donate else 0,
+    )
+    step_in_bytes = sum(getattr(a, "nbytes", 0) for a in example_inputs)
+    return fn, donate, annotation_scope(owner, "scan", key), state_bytes, step_in_bytes
+
+
+def write_member_state(m: Any, out: Dict[str, Any], steps: int, stats) -> Optional[Dict[str, Any]]:
+    """One member's drain writeback: rider pops + state setattrs under the
+    PR-7 mutation guard (a SIGTERM snapshot landing mid-writeback must see a
+    mutation in flight, never persist a torn half-written state). Shared by
+    the per-metric and the fused queues so the rider handling cannot drift.
+    Returns the residual dict for the caller's drift-probe decision.
+    """
+    m._mutation_depth = getattr(m, "_mutation_depth", 0) + 1
+    try:
+        sentinel_out = out.pop(_sentinel.STATE_KEY, None)
+        if sentinel_out is not None:
+            setattr(m, _sentinel.ATTR, sentinel_out)
+        quarantine_out = out.pop(_txn.STATE_KEY, None)
+        if quarantine_out is not None:
+            setattr(m, _txn.ATTR, quarantine_out)
+        residual_out = out.pop(_numerics.STATE_KEY, None)
+        if residual_out is not None:
+            setattr(m, _numerics.ATTR, residual_out)
+            stats.compensated_steps += steps
+        for name, v in out.items():
+            setattr(m, name, v)
+    finally:
+        m._mutation_depth -= 1
+    return residual_out
+
+
+# ------------------------------------------------------------------ queues
+
+
+class _ScanQueue:
+    """Per-owner step queue + drain machinery (shared core).
+
+    Subclasses bind the queue to its engine: :class:`MetricScan` to one
+    metric's :class:`~torchmetrics_tpu.engine.compiled.CompiledUpdate`,
+    :class:`FusedScan` to a collection's
+    :class:`~torchmetrics_tpu.engine.fusion.FusedUpdate`.
+    """
+
+    def __init__(self, stats) -> None:
+        self.stats = stats
+        #: (orig_args, orig_kwargs, padded_inputs, n_pad) per queued step
+        self._pending: List[Tuple[Tuple, Dict, Tuple, int]] = []
+        self._qkey: Optional[Tuple] = None
+        self._k = 0
+        self._cache: Dict[Tuple, Any] = {}
+        self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}
+        self._transient_fails: Dict[Tuple, int] = {}
+        # drains can fire from a sidecar scrape thread while the hot loop
+        # enqueues: the reentrant lock serializes dequeue+dispatch+writeback
+        # so two flushes can never double-apply one payload
+        self._lock = threading.RLock()
+        #: optional post-drain hook (a collection re-anchoring its group views
+        #: after a drain donated an owner's buffers — wherever the drain fired)
+        self.on_drain = None
+        _QUEUES[next(_seq_counter)] = self
+
+    # -- interface subclasses provide -----------------------------------
+
+    def owns(self, metric: Any) -> bool:
+        raise NotImplementedError
+
+    def exclusive_to(self, metrics: Sequence[Any]) -> bool:
+        """Whether every metric this queue folds into is within ``metrics``
+        (discard safety: dropping the queue loses no other metric's steps)."""
+        raise NotImplementedError
+
+    def _gather_state(self):
+        """(state_pytree, state_sig, device_token) for the drain, or None."""
+        raise NotImplementedError
+
+    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple):
+        raise NotImplementedError
+
+    def _shield(self, state):
+        raise NotImplementedError
+
+    def _invalidated(self) -> bool:
+        raise NotImplementedError
+
+    def _writeback(self, out, steps: int, probing: bool) -> None:
+        raise NotImplementedError
+
+    def _replay(self, pending) -> None:
+        raise NotImplementedError
+
+    def _fingerprint(self, state_sig, kb: int, device: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _post_drain(self) -> None:
+        """Hook after a successful drain (view re-anchoring for collections)."""
+        cb = self.on_drain
+        if cb is not None:
+            cb()
+
+    # -- queue core ------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def discard(self, reason: str) -> int:
+        """Drop the queued payloads without dispatching (reset semantics)."""
+        with self._lock:
+            n = len(self._pending)
+            if not n:
+                return 0
+            self._pending = []
+        st = self.stats
+        st.scan_flushes += 1
+        st.scan_flush_reasons[reason] += 1
+        _diag.record("scan.flush", st.owner, reason=reason, steps=n, discarded=True)
+        return n
+
+    def drain(self, reason: str) -> int:
+        """Fold every queued step into state through one scan dispatch."""
+        with self._lock:
+            return self._drain_locked(reason)
+
+    def _drain_locked(self, reason: str) -> int:
+        pending = self._pending
+        n = len(pending)
+        if not n:
+            return 0
+        self._pending = []
+        st = self.stats
+        st.scan_flushes += 1
+        st.scan_flush_reasons[reason] += 1
+        rec = _diag.active_recorder()
+        if rec is not None:
+            rec.record("scan.flush", st.owner, reason=reason, steps=n)
+
+        gathered = self._gather_state()
+        if gathered is None:
+            st.fallback("scan-state-ineligible")
+            self._replay(pending)
+            # the replay's one-step dispatches donate too: views re-anchor
+            self._post_drain()
+            return n
+        state, state_sig, device = gathered
+        kb = k_bucket(n)
+        pad = kb - n
+        key = (self._qkey, state_sig, device, kb)
+        entry = self._cache.get(key)
+        if entry is _FALLBACK:
+            st.fallback("scan-uncompilable-signature")
+            self._replay(pending)
+            self._post_drain()
+            return n
+        first = entry is None
+
+        # step-major flat args; pad steps reuse the LAST real step's arrays
+        # (no allocation — the valid mask makes them no-ops)
+        flat_steps: List[Any] = []
+        n_pads = np.zeros((kb,), np.int32)
+        valid = np.zeros((kb,), np.bool_)
+        for t in range(kb):
+            src = pending[t] if t < n else pending[n - 1]
+            flat_steps.extend(src[2])
+            n_pads[t] = src[3]
+            valid[t] = t < n
+
+        profiling = _profile.active_profile() is not None
+        measuring = rec is not None or profiling
+        t_dispatch = perf_counter() if measuring else 0.0
+        try:
+            if first:
+                entry = self._compile_entry(state, pending[0][2], kb, key)
+            fn, donate, scope, state_bytes, step_in_bytes = entry
+            if donate:
+                state = self._shield(state)
+            if measuring:
+                t_dispatch = perf_counter()
+            import jax
+
+            with jax.profiler.TraceAnnotation(scope):
+                out = fn(state, valid, n_pads, *flat_steps)
+        except Exception as exc:  # noqa: BLE001 — a failed drain replays step-at-a-time
+            if self._invalidated():
+                raise  # donation consumed the state; nothing intact to replay
+            # first-compile AND warm-dispatch failures alike fall back to the
+            # step-at-a-time replay: the queued payloads are intact host-side
+            # and MUST apply (their update_counts already advanced at enqueue
+            # — raising here would silently lose up to K-1 steps of data).
+            # classify_and_demote keeps transient faults retryable under the
+            # PR-7 budget and demotes structural/persistent ones.
+            classified = _txn.classify_and_demote(
+                self._cache, _FALLBACK, self._transient_fails, key, exc
+            )
+            if isinstance(exc, _Ineligible):
+                st.fallback(f"scan-ineligible:{exc}")
+            elif not first:
+                st.fallback(f"scan-warm-dispatch-failed:{classified or type(exc).__name__}")
+            else:
+                st.fallback(
+                    f"scan-dispatch-{classified}" if classified else f"scan-trace-failed:{type(exc).__name__}"
+                )
+            self._replay(pending)
+            self._post_drain()
+            return n
+
+        if first:
+            st.traces += 1
+            self._cache[key] = entry
+            fp = self._fingerprint(state_sig, kb, device)
+            cause = _diag.attribute_retrace(fp, list(self._fingerprints.values()))
+            self._fingerprints[key] = fp
+            if cause != "initial":
+                st.retrace_causes[cause] += 1
+            if rec is not None:
+                rec.record(
+                    "update.scan.trace" if cause == "initial" else "update.scan.retrace",
+                    st.owner, cause=cause, k_bucket=kb, signatures=len(self._fingerprints),
+                )
+        else:
+            st.cache_hits += 1
+        st.dispatches += 1
+        st.scan_dispatches += 1
+        st.scan_steps_folded += n
+        st.scan_pad_steps += pad
+        if donate:
+            st.donated_dispatches += 1
+        else:
+            st.donation_fallbacks += 1
+        bytes_moved = state_bytes + step_in_bytes * kb
+        st.bytes_moved += bytes_moved
+        dispatch_us = round((perf_counter() - t_dispatch) * 1e6, 3) if measuring else 0.0
+        if measuring:
+            _hist.observe(st.owner, "scan", "dispatch_us", dispatch_us)
+        device_us = None
+        if profiling and not first:
+            device_us = completion_probe(out, st.owner, "scan", st, t_dispatch)
+        if rec is not None:
+            rec.record(
+                "update.scan", st.owner,
+                dispatch_us=dispatch_us, steps=n, k=self._k, k_bucket=kb,
+                pad_steps=pad, bytes=bytes_moved, donated=donate,
+                cached=not first, reason=reason,
+            )
+            if device_us is not None:
+                rec.record("update.scan.probe", st.owner, dispatch_us=dispatch_us, device_us=device_us)
+        self._writeback(out, n, profiling and not first)
+        self._post_drain()
+        return n
+
+
+class MetricScan(_ScanQueue):
+    """The scan queue of one metric's :class:`CompiledUpdate` engine."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+        #: (n_args, kw_names, raw_in_sig, bucketed, bucket, n_pad) of the last
+        #: slow-path push — the fixed-shape-stream enqueue fast path
+        self._fast: Optional[Tuple] = None
+        super().__init__(engine.stats)
+
+    def owns(self, metric: Any) -> bool:
+        return metric is self._engine._metric
+
+    def exclusive_to(self, metrics: Sequence[Any]) -> bool:
+        return any(self._engine._metric is m for m in metrics)
+
+    def push(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> bool:
+        """Queue one update payload; True = handled (folded now or later)."""
+        with self._lock:
+            return self._push_locked(args, kwargs, k)
+
+    def _push_locked(self, args, kwargs, k: int) -> bool:
+        eng = self._engine
+        st = self.stats
+        m = eng._metric
+        if kwargs:
+            kw_names = tuple(sorted(kwargs))
+            inputs = list(args) + [kwargs[kn] for kn in kw_names]
+        else:
+            kw_names = ()
+            inputs = list(args)
+        in_sig = input_signature(inputs)
+        if in_sig is None:
+            self._drain_locked("ineligible-step")
+            st.fallback("non-array-input")
+            return False
+        # fast path: a fixed-shape stream repeats one raw signature — skip the
+        # bucket resolution and qkey rebuild the slow path below re-derives
+        # (the enqueue side is the per-step cost the whole design amortizes)
+        fast = self._fast
+        if (
+            fast is not None
+            and self._pending
+            and k == self._k
+            and fast[0] == len(args)
+            and fast[1] == kw_names
+            and fast[2] == in_sig
+        ):
+            bucketed, bucket, n_pad = fast[3], fast[4], fast[5]
+            if bucketed:
+                st.bucketed_steps += 1
+                st.bucket_pad_rows += n_pad
+                if n_pad:
+                    inputs = list(bucketing.pad_args(inputs, bucket))
+            self._pending.append((args, kwargs, tuple(inputs), n_pad))
+            if len(self._pending) >= k:
+                self._drain_locked("k-reached")
+            return True
+        if not self._pending:
+            # state eligibility is a queue-start check: states cannot change
+            # while payloads are queued (only drains write them)
+            for name in m._defaults:
+                if not _is_jax_array(getattr(m, name)):
+                    st.fallback("non-array-state")
+                    return False
+        if eng._bucket_ok is None:
+            eng._bucket_ok = bucketing.bucket_eligible(m)
+        raw_sig = in_sig
+        n_pad = 0
+        bucket: Optional[int] = None
+        bucketed = False
+        if eng._bucket_ok and config.BUCKETING_ENABLED:
+            nrows = bucketing.batch_size(inputs)
+            if nrows is not None and nrows > 0:
+                bucket = bucketing.next_bucket(nrows)
+                n_pad = bucket - nrows
+                if n_pad:  # exact-fit batches keep their signature as-is
+                    inputs = list(bucketing.pad_args(inputs, bucket))
+                    in_sig = input_signature(inputs)
+                bucketed = True
+                st.bucketed_steps += 1
+                st.bucket_pad_rows += n_pad
+                st.bucket_sizes.add(bucket)
+        qkey = (bucketed, len(args), kw_names, in_sig, bucket)
+        if self._pending and (qkey != self._qkey or k != self._k):
+            self._drain_locked("signature-change")
+        self._qkey = qkey
+        self._k = k
+        self._fast = (len(args), kw_names, raw_sig, bucketed, bucket, n_pad)
+        self._pending.append((args, kwargs, tuple(inputs), n_pad))
+        if len(self._pending) >= k:
+            self._drain_locked("k-reached")
+        return True
+
+    def _gather_state(self):
+        m = self._engine._metric
+        state: Dict[str, Any] = {}
+        for name in m._defaults:
+            v = getattr(m, name)
+            if not _is_jax_array(v):
+                return None
+            state[name] = v
+        if _sentinel.sentinel_enabled():
+            state[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
+        if _txn.quarantine_enabled():
+            state[_txn.STATE_KEY] = _txn.ensure_count(m)
+        if _numerics.compensation_active(m):
+            state[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
+        return state, state_signature(state), type(self._engine)._device_token(state)
+
+    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple):
+        m = self._engine._metric
+        owner = self.stats.owner
+        bucketed, n_args, kw_names = self._qkey[0], self._qkey[1], self._qkey[2]
+        quarantined, comp_names, step_txn, step_comp = build_riders(m, example_inputs)
+        run = build_run(m, owner, n_args, kw_names, quarantined, comp_names)
+        body = make_step_body(run, bucketed, example_inputs, txn=step_txn, comp=step_comp)
+        return compile_scan(body, example_state, example_inputs, kb, owner, key, self.stats)
+
+    def _shield(self, state):
+        return shield_state(state, self._engine._metric, self.stats)
+
+    def _invalidated(self) -> bool:
+        return state_invalidated(self._engine._metric)
+
+    def _writeback(self, out, steps: int, probing: bool) -> None:
+        m = self._engine._metric
+        st = self.stats
+        st.metrics_updated += steps
+        write_member_state(m, out, steps, st)
+        if probing:
+            _numerics.maybe_drift_probe(m, st)
+
+    def _replay(self, pending) -> None:
+        """Step-at-a-time fallback: byte-identical order, counted, never lost."""
+        eng = self._engine
+        m = eng._metric
+        for args, kwargs, _, _ in pending:
+            if not eng.step(args, kwargs):
+                m._run_eager_update(args, kwargs)
+
+    def _fingerprint(self, state_sig, kb: int, device: str) -> Dict[str, Any]:
+        bucketed, n_args, kw_names, in_sig, bucket = self._qkey
+        # the K-bucket joins the bucket aspect so a ragged-tail recompile
+        # attributes as bucket-miss, never as an uncaused retrace
+        return signature_fingerprint((n_args, kw_names), state_sig, in_sig, (bucket, kb), device)
+
+
+class FusedScan(_ScanQueue):
+    """The scan queue of a collection's :class:`FusedUpdate` engine."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+        super().__init__(engine.stats)
+        self._probed: Dict[Tuple, FrozenSet[str]] = {}  # qkey -> fusable member names
+        self._names: FrozenSet[str] = frozenset()
+
+    def owns(self, metric: Any) -> bool:
+        return any(m is metric for _, m in self._engine.metrics)
+
+    def exclusive_to(self, metrics: Sequence[Any]) -> bool:
+        # the queued payloads fold into the PROBED member set; every one of
+        # those members must be covered for a discard to lose nothing
+        covered = [m for _, m in self._members()]
+        return all(any(m is c for c in metrics) for m in covered)
+
+    def push(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> Optional[Set[str]]:
+        """Queue one collection payload; returns handled member names, or
+        ``None`` when this step cannot queue (the caller runs members
+        individually — their own per-metric queues still apply)."""
+        with self._lock:
+            return self._push_locked(args, kwargs, k)
+
+    def _push_locked(self, args, kwargs, k: int) -> Optional[Set[str]]:
+        eng = self._engine
+        st = self.stats
+        if kwargs:
+            self._drain_locked("ineligible-step")
+            st.fallback("kwargs")
+            return None
+        inputs = list(args)
+        in_sig = input_signature(inputs)
+        if in_sig is None:
+            self._drain_locked("ineligible-step")
+            st.fallback("non-array-input")
+            return None
+        members = eng.eligible_members(check_arrays=not self._pending)
+        if len(members) < 2:
+            self._drain_locked("ineligible-step")
+            st.fallback("too-few-members")
+            return None
+        n_pad = 0
+        bucket: Optional[int] = None
+        bucketed = False
+        if config.BUCKETING_ENABLED and all(bucketing.bucket_eligible(m) for _, m in members):
+            nrows = bucketing.batch_size(inputs)
+            if nrows is not None and nrows > 0:
+                bucket = bucketing.next_bucket(nrows)
+                n_pad = bucket - nrows
+                inputs = list(bucketing.pad_args(inputs, bucket))
+                in_sig = input_signature(inputs)
+                bucketed = True
+                st.bucketed_steps += 1
+                st.bucket_pad_rows += n_pad
+                st.bucket_sizes.add(bucket)
+        qkey = (bucketed, in_sig, bucket, tuple(name for name, _ in members))
+        fused_names = self._probed.get(qkey)
+        if fused_names is None:
+            # one abstract trace probe per signature decides membership BEFORE
+            # anything queues — the handled set must be exact at enqueue time
+            from torchmetrics_tpu.engine.fusion import probe_fusable
+
+            states = {name: {sn: getattr(m, sn) for sn in m._defaults} for name, m in members}
+            fused_names = probe_fusable(members, states, inputs, st)
+            self._probed[qkey] = fused_names
+        if len(fused_names) < 2:
+            self._drain_locked("ineligible-step")
+            st.fallback("too-few-traceable-members")
+            return None
+        if self._pending and (qkey != self._qkey or k != self._k):
+            self._drain_locked("signature-change")
+        self._qkey = qkey
+        self._k = k
+        self._names = fused_names
+        self._pending.append((args, {}, tuple(inputs), n_pad))
+        # the host-side bookkeeping the one-step fused writeback would do,
+        # done at ENQUEUE: update_count is observation-independent (any state
+        # read drains first), and _computed must invalidate immediately
+        handled: Set[str] = set()
+        for name, m in members:
+            if name in fused_names:
+                m._computed = None
+                m._update_count += 1
+                handled.add(name)
+        if len(self._pending) >= k:
+            self._drain_locked("k-reached")
+        return handled
+
+    def _members(self) -> List[Tuple[str, Any]]:
+        return [(name, m) for name, m in self._engine.metrics if name in self._names]
+
+    def _gather_state(self):
+        states: Dict[str, Dict[str, Any]] = {}
+        sigs = []
+        device = ""
+        for name, m in self._members():
+            mstate = {sn: getattr(m, sn) for sn in m._defaults}
+            if not all(_is_jax_array(v) for v in mstate.values()):
+                return None
+            if _sentinel.sentinel_enabled():
+                mstate[_sentinel.STATE_KEY] = _sentinel.ensure_flags(m)
+            if _txn.quarantine_enabled():
+                mstate[_txn.STATE_KEY] = _txn.ensure_count(m)
+            if _numerics.compensation_active(m):
+                mstate[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
+            states[name] = mstate
+            sigs.append((name, state_signature(mstate)))
+            if not device:
+                from torchmetrics_tpu.engine.compiled import CompiledUpdate
+
+                device = CompiledUpdate._device_token(mstate)
+        return states, tuple(sigs), device
+
+    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple):
+        from torchmetrics_tpu.engine.fusion import build_fused_riders, build_run_all
+
+        bucketed = self._qkey[0]
+        fusable = self._members()
+        quarantined, comp_names, step_txn, step_comp = build_fused_riders(fusable, example_inputs)
+        run_all = build_run_all(fusable, comp_names, quarantined)
+        body = make_step_body(run_all, bucketed, example_inputs, txn=step_txn, comp=step_comp)
+        return compile_scan(body, example_state, example_inputs, kb, self.stats.owner, key, self.stats)
+
+    def _shield(self, states):
+        return {name: shield_state(states[name], m, self.stats) for name, m in self._members()}
+
+    def _invalidated(self) -> bool:
+        return any(state_invalidated(m) for _, m in self._members())
+
+    def _writeback(self, out, steps: int, probing: bool) -> None:
+        st = self.stats
+        for name, m in self._members():
+            st.metrics_updated += steps
+            residual_out = write_member_state(m, out[name], steps, st)
+            if probing and residual_out is not None:
+                _numerics.maybe_drift_probe(m, st, owner=f"{st.owner}:{name}")
+
+    def _replay(self, pending) -> None:
+        """Per-member eager replay (update_count already advanced at enqueue)."""
+        for args, _, _, _ in pending:
+            for _, m in self._members():
+                m._run_eager_update(args, {})
+
+    def _fingerprint(self, state_sig, kb: int, device: str) -> Dict[str, Any]:
+        bucketed, in_sig, bucket, _ = self._qkey
+        fp = type(self._engine)._fingerprint(state_sig, in_sig, (bucket, kb))
+        fp["device"] = device
+        return fp
+
+    def _post_drain(self) -> None:
+        cb = getattr(self._engine, "on_scan_drain", None)
+        if cb is not None:
+            # a drain donated the owners' buffers: the owning collection
+            # re-anchors its group views NOW, not at the next accessor
+            cb()

@@ -30,6 +30,11 @@ _COUNTER_FIELDS = (
     "bucketed_steps",  # steps that rode a shape bucket
     "bucket_pad_rows",  # total pad rows added across bucketed steps
     "bytes_moved",  # input + state bytes entering compiled dispatches
+    # --- multi-step scan dispatch (engine/scan.py): queued K-step drains ---
+    "scan_dispatches",  # scan drains executed (each = ONE dispatch folding many steps)
+    "scan_steps_folded",  # real update steps folded across all scan drains
+    "scan_pad_steps",  # masked no-op padding steps added to fill K-buckets
+    "scan_flushes",  # queue flushes (drains + discards), by reason in scan_flush_reasons
     # --- transactional layer (engine/txn.py): quarantine + fallback ladder ---
     "quarantined_batches",  # poisoned batches skipped in-graph (filled at the sanctioned read)
     "ladder_retries",  # dispatch failures that stepped down to a smaller bucket
@@ -59,13 +64,17 @@ _COUNTER_FIELDS = (
 class EngineStats:
     """Mutable counter block for one engine instance."""
 
-    __slots__ = ("owner", "fallback_reasons", "bucket_sizes", "retrace_causes", "__weakref__", *_COUNTER_FIELDS)
+    __slots__ = (
+        "owner", "fallback_reasons", "bucket_sizes", "retrace_causes",
+        "scan_flush_reasons", "__weakref__", *_COUNTER_FIELDS,
+    )
 
     def __init__(self, owner: str = "") -> None:
         self.owner = owner
         self.fallback_reasons: Counter = Counter()
         self.bucket_sizes: set = set()
         self.retrace_causes: Counter = Counter()  # attributed causes of post-initial compiles
+        self.scan_flush_reasons: Counter = Counter()  # scan-queue flushes by reason
         for f in _COUNTER_FIELDS:
             setattr(self, f, 0)
         _REGISTRY.add(self)
@@ -83,6 +92,7 @@ class EngineStats:
         self.fallback_reasons.clear()
         self.bucket_sizes.clear()
         self.retrace_causes.clear()
+        self.scan_flush_reasons.clear()
 
     def as_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {f: getattr(self, f) for f in _COUNTER_FIELDS}
@@ -93,6 +103,8 @@ class EngineStats:
             out["fallback_reasons"] = {k: self.fallback_reasons[k] for k in sorted(self.fallback_reasons)}
         if self.retrace_causes:
             out["retrace_causes"] = {k: self.retrace_causes[k] for k in sorted(self.retrace_causes)}
+        if self.scan_flush_reasons:
+            out["scan_flush_reasons"] = {k: self.scan_flush_reasons[k] for k in sorted(self.scan_flush_reasons)}
         return out
 
     def __repr__(self) -> str:
@@ -114,6 +126,7 @@ def engine_report(include_events: bool = False, reset: bool = False) -> Dict[str
     total: Dict[str, Any] = {f: 0 for f in _COUNTER_FIELDS}
     reasons: Counter = Counter()
     causes: Counter = Counter()
+    flushes: Counter = Counter()
     buckets: set = set()
     engines = 0
     for st in list(_REGISTRY):
@@ -122,6 +135,7 @@ def engine_report(include_events: bool = False, reset: bool = False) -> Dict[str
             total[f] += getattr(st, f)
         reasons.update(st.fallback_reasons)
         causes.update(st.retrace_causes)
+        flushes.update(st.scan_flush_reasons)
         buckets |= st.bucket_sizes
     total["engines"] = engines
     total["bucket_count"] = len(buckets)
@@ -130,6 +144,8 @@ def engine_report(include_events: bool = False, reset: bool = False) -> Dict[str
         total["fallback_reasons"] = {k: reasons[k] for k in sorted(reasons)}
     if causes:
         total["retrace_causes"] = {k: causes[k] for k in sorted(causes)}
+    if flushes:
+        total["scan_flush_reasons"] = {k: flushes[k] for k in sorted(flushes)}
     if include_events:
         rec = _diag.active_recorder()
         total["diag"] = (

@@ -132,6 +132,14 @@ class Metric:
             raise ValueError(
                 f"Expected keyword argument `compiled_update` to be a `bool` or `None` but got {self.compiled_update}"
             )
+        # multi-step scan dispatch (engine/scan.py): None = follow the
+        # process-wide policy (TORCHMETRICS_TPU_SCAN / scan_context), 0/False
+        # forces the queue off for this metric, an int K >= 2 forces depth K
+        self.scan_steps = kwargs.pop("scan_steps", None)
+        if self.scan_steps is not None:
+            from torchmetrics_tpu.engine.scan import coerce_k
+
+            self.scan_steps = coerce_k(self.scan_steps)
 
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
@@ -233,6 +241,9 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric shouldn't be synced when performing ``forward``. HINT: Did you forget to call ``unsync``?"
             )
+        # forward returns a value, so it is a state observation: pending scan
+        # payloads fold in first, and forward's own updates bypass the queue
+        self._drain_scan("observation:forward")
         from torchmetrics_tpu.engine import txn as _txn
 
         # mutation guard for preemption-safe snapshots: a signal handler must
@@ -370,6 +381,10 @@ class Metric:
         """
         from torchmetrics_tpu.engine import numerics as _numerics
 
+        # both sides of the fold are observed: drain pending scan payloads
+        self._drain_scan("observation:merge_state")
+        if isinstance(incoming_state, Metric):
+            incoming_state._drain_scan("observation:merge_state")
         incoming_folded: Optional[frozenset] = None  # raw dicts: unknown -> ndim fallback
         if isinstance(incoming_state, Metric):
             # host-side counts fold as Python ints (arbitrary precision): a
@@ -698,6 +713,8 @@ class Metric:
         """Manually trigger state sync across chips (reference ``metric.py:449-486``)."""
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
+        # the exchanged buffers must hold every enqueued step: drain first
+        self._drain_scan("observation:sync")
 
         if distributed_available is None and self.distributed_available_fn is not None:
             distributed_available = self.distributed_available_fn
@@ -769,6 +786,9 @@ class Metric:
 
     def _wrap_update(self, update: Callable) -> Callable:
         self._raw_update = update  # unwrapped body — what the engine traces
+        # hoisted: the annotation label is rebuilt per step otherwise, and the
+        # wrapper is on the hot path of every update (queued or not)
+        annotation = f"{type(self).__name__}.update"
 
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
@@ -791,39 +811,20 @@ class Metric:
                 self._update_count += 1
                 # host-side trace span: shows up in jax.profiler / Perfetto timelines so
                 # metric updates are attributable inside a profiled training step (SURVEY §5.1)
-                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                with jax.profiler.TraceAnnotation(annotation):
                     if not self._engine_step(args, kwargs):
                         # engine-disabled updates leave no engine counters behind; the
                         # flight-recorder event keeps eager steps visible in the same
                         # timeline as compiled dispatches (engine fallbacks additionally
                         # carry their reason via EngineStats.fallback), timed so the
                         # eager launch cost lands in the same latency histograms
-                        from torchmetrics_tpu.engine import numerics as _numerics
-
-                        if _numerics.compensation_active(self):
-                            # eager parity for the compensated two-sum: the raw
-                            # body runs on zeroed compensated states and the
-                            # recomposition matches the compiled transform
-                            def body() -> None:
-                                _numerics.eager_update(self, lambda: update(*args, **kwargs))
-                        else:
-                            def body() -> None:
-                                update(*args, **kwargs)
-                        if quarantine_mode == _txn.MODE_QUARANTINE:
-                            # eager parity: the same admission + transactional skip
-                            # the compiled path lowers in-graph, so engine-on and
-                            # engine-off runs agree on quarantined streams
-                            def run() -> None:
-                                _txn.eager_update(self, body, args, kwargs)
-                        else:
-                            run = body
                         rec = _diag.active_recorder()
                         measuring = rec is not None or _profile.active_profile() is not None
                         if not measuring:
-                            run()
+                            self._run_eager_update(args, kwargs)
                         else:
                             t0 = perf_counter()
-                            run()
+                            self._run_eager_update(args, kwargs)
                             dispatch_us = round((perf_counter() - t0) * 1e6, 3)
                             _hist.observe(type(self).__name__, "eager", "dispatch_us", dispatch_us)
                             if rec is not None:
@@ -837,15 +838,95 @@ class Metric:
 
         return wrapped_func
 
+    def _run_eager_update(self, args: tuple, kwargs: Dict[str, Any]) -> None:
+        """One eager update with full rider parity (compensation + quarantine).
+
+        The engine-off execution of a single batch: the raw body, wrapped in
+        the compensated two-sum recomposition and the quarantine
+        admission/transactional-skip exactly as the compiled path lowers them
+        — shared by the update wrapper's fallback branch and the scan queue's
+        step-at-a-time replay (``engine/scan.py``), so the parity logic can
+        never drift between the two. Does NOT touch ``_update_count`` or
+        ``_computed`` — that is the wrapper's (or the enqueue's) bookkeeping.
+        """
+        from torchmetrics_tpu.engine import numerics as _numerics
+        from torchmetrics_tpu.engine import txn as _txn
+
+        update = self._raw_update
+        if _numerics.compensation_active(self):
+            # eager parity for the compensated two-sum: the raw body runs on
+            # zeroed compensated states and the recomposition matches the
+            # compiled transform
+            def body() -> None:
+                _numerics.eager_update(self, lambda: update(*args, **kwargs))
+        else:
+            def body() -> None:
+                update(*args, **kwargs)
+        if _txn.quarantine_mode() == _txn.MODE_QUARANTINE:
+            # eager parity: the same admission + transactional skip the
+            # compiled path lowers in-graph, so engine-on and engine-off runs
+            # agree on quarantined streams
+            _txn.eager_update(self, body, args, kwargs)
+        else:
+            body()
+
     def _engine_step(self, args: tuple, kwargs: Dict[str, Any]) -> bool:
         """Route one update through the fused engine; False = run eagerly."""
-        if not self._epoch_enabled():
+        enabled = self._epoch_enabled()
+        k = self._scan_depth() if enabled else None
+        queueing = (
+            k is not None
+            and self._mutation_depth == 1
+            and not getattr(self, "_in_batch_value", False)
+        )
+        eng = self._engine
+        if not queueing and eng is not None:
+            sq = eng._scan
+            if sq is not None and sq.pending:
+                # a queue left over from a closed scan scope — OR from the
+                # ENGINE itself being disabled mid-stream — drains before this
+                # step applies, whatever path it takes (ordering preserved)
+                sq.drain("scan-disabled")
+        if not enabled:
             return False
-        if self._engine is None:
+        if eng is None:
             from torchmetrics_tpu.engine.compiled import CompiledUpdate
 
-            self._engine = CompiledUpdate(self)
-        return self._engine.step(args, kwargs)
+            eng = self._engine = CompiledUpdate(self)
+        if queueing:
+            # multi-step scan dispatch (engine/scan.py): queue this payload —
+            # K steps fold into one donated lax.scan executable. forward()'s
+            # inner updates (mutation depth > 1) bypass the queue: forward IS
+            # a value request, so its batch must apply immediately
+            return eng.scan_step(args, kwargs, k)
+        return eng.step(args, kwargs)
+
+    def _scan_depth(self) -> Optional[int]:
+        """The active scan queue depth for THIS metric, or None (unqueued)."""
+        if self.scan_steps is not None:
+            return self.scan_steps or None  # 0 = forced off for this metric
+        from torchmetrics_tpu.engine.scan import scan_k
+
+        return scan_k()
+
+    def _drain_scan(self, reason: str) -> int:
+        """Flush any scan queue holding this metric's pending steps.
+
+        Every state observation routes through here FIRST (the staleness
+        contract of ``engine/scan.py``): a reader can never see state that is
+        up to K steps behind the enqueued stream. A compute-group VIEW member
+        observes its OWNER's state, so the owner's queue (stamped as
+        ``_scan_peer`` at view materialization) drains too.
+        """
+        from torchmetrics_tpu.engine.scan import flush_metric
+
+        drained = flush_metric(self, reason)
+        peer_ref = self.__dict__.get("_scan_peer")
+        if peer_ref is not None:
+            peer = peer_ref()
+            if peer is not None:
+                drained += flush_metric(peer, reason)
+        return drained
 
     def _epoch_enabled(self) -> bool:
         """Shared engine-enablement resolution (per-metric kwarg > overrides > auto)."""
@@ -924,6 +1005,9 @@ class Metric:
 
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            # compute observes state: pending scan payloads fold in first (the
+            # engine/scan.py staleness contract)
+            self._drain_scan("observation:compute")
             if self._update_count == 0:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {self.__class__.__name__} was called before the ``update``"
@@ -1025,6 +1109,11 @@ class Metric:
 
     def reset(self) -> None:
         """Reset all states to their defaults (reference ``metric.py:623-638``)."""
+        from torchmetrics_tpu.engine.scan import discard_metric
+
+        # pending scan payloads are DISCARDED, not drained: applying updates
+        # the reset immediately wipes is byte-identical to skipping them
+        discard_metric(self, "reset")
         self._update_count = 0
         self._forward_cache = None
         self._computed = None
@@ -1079,7 +1168,15 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Drop wrapped bound methods + compiled executables for pickling (reference ``metric.py:644-648``)."""
-        drop = ("update", "compute", "_update_signature", "_raw_update", "_raw_compute", "_engine", "_epoch", "_txn_stats")
+        # a clone/pickle captures state: pending scan payloads fold in first,
+        # or the copy would silently lag the enqueued stream by up to K steps
+        self._drain_scan("observation:clone")
+        # _scan_peer is a weakref (unpicklable) into the ORIGINAL collection's
+        # owner — meaningless for a clone, which re-stamps at materialization
+        drop = (
+            "update", "compute", "_update_signature", "_raw_update", "_raw_compute",
+            "_engine", "_epoch", "_txn_stats", "_scan_peer",
+        )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -1087,6 +1184,7 @@ class Metric:
         self.__dict__.update(state)
         self.__dict__.setdefault("_none_folded", set())
         self.__dict__.setdefault("compiled_update", None)
+        self.__dict__.setdefault("scan_steps", None)
         self._engine = None  # executables are per-process/per-instance; rebuilt lazily
         self._epoch = None
         self._update_signature = inspect.signature(self.update)
@@ -1119,6 +1217,9 @@ class Metric:
 
     def to(self, device: Any) -> "Metric":
         """Place all states on ``device`` (the reference's ``_apply`` move, ``metric.py:714-761``)."""
+        # queued payloads were padded/bucketed against the OLD device's
+        # signature: fold them in before the states move
+        self._drain_scan("observation:device-move")
         self._device = device
 
         def _move(x: Any) -> Any:
@@ -1185,6 +1286,8 @@ class Metric:
         ``merge_state`` and running means depend on.
         """
         destination = {} if destination is None else destination
+        # a checkpoint must hold every enqueued step (engine/scan.py contract)
+        self._drain_scan("observation:state_dict")
         wrote_any = False
         residuals = self.__dict__.get("_comp_residuals") or {}
         for key in self._defaults:

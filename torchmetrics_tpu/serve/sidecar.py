@@ -46,12 +46,20 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
         try:
             if path in ("/metrics", "/"):
                 from torchmetrics_tpu.diag.telemetry import export_prometheus
+                from torchmetrics_tpu.engine.scan import flush_all
 
+                # drain-before-scrape (engine/scan.py): counters and gauges a
+                # scraper sees must reflect every enqueued step — the flush is
+                # recorded (scan.flush, reason=observation:scrape) so diag can
+                # prove no stale-read path exists
+                flush_all("observation:scrape")
                 body = export_prometheus().encode()
                 ctype = PROMETHEUS_CONTENT_TYPE
             elif path == "/telemetry":
                 from torchmetrics_tpu.diag.telemetry import telemetry_snapshot
+                from torchmetrics_tpu.engine.scan import flush_all
 
+                flush_all("observation:scrape")
                 body = (json.dumps(telemetry_snapshot(), sort_keys=True, default=str) + "\n").encode()
                 ctype = "application/json"
             elif path == "/healthz":

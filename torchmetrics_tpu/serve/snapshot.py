@@ -75,6 +75,11 @@ def take_snapshot(metric: Any) -> StateSnapshot:
     """
     import time
 
+    from torchmetrics_tpu.engine.scan import flush_metric
+
+    # flush-on-observation (engine/scan.py): a snapshot must hold every
+    # enqueued step — a scrape can never see state K steps stale
+    flush_metric(metric, "observation:snapshot")
     budget = _serve_stats.snapshot_retries()
     last_exc: Any = None
     for attempt in range(budget):
@@ -136,7 +141,11 @@ def read_host(metric: Any, attrs: Any, index: Any = None) -> Dict[str, Any]:
     import numpy as np
 
     from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+    from torchmetrics_tpu.engine.scan import flush_metric
 
+    # flush-on-observation (engine/scan.py): the scrape views (tenant tables,
+    # sketch registers, ring clocks) must reflect every enqueued step
+    flush_metric(metric, "observation:scrape")
     attrs = tuple(attrs)
     budget = _serve_stats.snapshot_retries()
     last_exc: Any = None

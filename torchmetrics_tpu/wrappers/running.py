@@ -69,6 +69,11 @@ class Running(Metric):
         """Update the underlying metric, then snapshot its state into the current ring slot."""
         val = self._num_vals_seen % self.window
         self.base_metric.update(*args, **kwargs)
+        # the raw getattr below is a state OBSERVATION the scan queue cannot
+        # see (engine/scan.py staleness contract): with multi-step scan on,
+        # the inner update may only be ENQUEUED — drain it first, or the slot
+        # snapshots default state and the reset() would discard the payload
+        self.base_metric._drain_scan("observation:running-slot")
         for key in self.base_metric._defaults:
             setattr(self, key + f"_{val}", getattr(self.base_metric, key))
         self.base_metric.reset()
