@@ -79,7 +79,7 @@ _SCENARIO_BYTES = {
 # every scenario block scripts/check_counters.py gates on: a run (including
 # the TPU-less micro fallback) must prove each of these completed, or the
 # gate's scenario-completeness check fails — nothing gated can skip silently
-_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan")
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan", "cse")
 
 
 def _acquire_backend(max_tries=3, backoff_s=2.0, probe_timeout_s=120.0):
@@ -1716,6 +1716,200 @@ def bench_scan(micro=False):
     return out
 
 
+def bench_cse(micro=False):
+    """Cross-metric common-subexpression fusion scenario (ISSUE 11 evidence).
+
+    A 10-metric stat-scores-family classification collection
+    (accuracy/precision/recall/F1/specificity/stat-scores across differing
+    ``average`` modes) declares ONE reduction signature
+    (``engine/statespec.py``), so ``MetricCollection`` merges the whole family
+    into a single compute group AT CONSTRUCTION: the shared TP/FP/TN/FN
+    reduction traces once, every step is one donated dispatch, and the family
+    holds ~1/N of the unfused state bytes. Counter-gated:
+
+    - 1 compute group, discovered BEFORE any update (no eager first-step
+      discovery pass, no sanctioned value-comparison host readback);
+    - exactly 1 shared-reduction trace, 1 dispatch/step, 0 eager fallbacks,
+      0 warm retraces;
+    - ``state_footprint()`` unique bytes <= ~2/N of the nominal sum, with the
+      canonical group state counted exactly once;
+    - byte-parity vs independently-computed metrics with the quarantine +
+      scan riders composed on the shared state (compensation enabled too —
+      provably inert on the family's integer counters but the rider planning
+      path runs);
+    - 0 host transfers under the STRICT guard, zero spec fallbacks (every
+      packed/bucketing/compensation role resolved from the registry).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+        MulticlassSpecificity,
+        MulticlassStatScores,
+    )
+    from torchmetrics_tpu.engine import (
+        compensated_context,
+        engine_context,
+        quarantine_context,
+        scan_context,
+    )
+    from torchmetrics_tpu.engine.statespec import spec_fallback_count
+    from torchmetrics_tpu.engine.stats import engine_report, reset_engine_stats
+
+    batch, classes = 32, 10
+    steps = 64 if micro else 200
+    repeats = 5
+
+    def family(**kw):
+        kw.setdefault("validate_args", False)
+        return {
+            "acc_macro": MulticlassAccuracy(classes, average="macro", **kw),
+            "acc_weighted": MulticlassAccuracy(classes, average="weighted", **kw),
+            "prec_macro": MulticlassPrecision(classes, average="macro", **kw),
+            "prec_none": MulticlassPrecision(classes, average="none", **kw),
+            "rec_macro": MulticlassRecall(classes, average="macro", **kw),
+            "rec_weighted": MulticlassRecall(classes, average="weighted", **kw),
+            "f1_macro": MulticlassF1Score(classes, average="macro", **kw),
+            "spec_macro": MulticlassSpecificity(classes, average="macro", **kw),
+            "spec_none": MulticlassSpecificity(classes, average="none", **kw),
+            "stat_macro": MulticlassStatScores(classes, average="macro", **kw),
+        }
+
+    n_members = len(family())
+    key = jax.random.PRNGKey(24)
+    preds = jax.random.normal(key, (batch, classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, classes, dtype=jnp.int32)
+
+    out = {"batch": batch, "classes": classes, "steps": steps, "members": n_members}
+
+    def block(mc):
+        owner_name = mc.compute_groups[0][0]
+        owner = mc._modules[owner_name]
+        jax.block_until_ready([getattr(owner, s) for s in owner._defaults])
+
+    # -- construction-time discovery + counter proof --------------------------
+    from torchmetrics_tpu.diag import diag_context, transfer_guard
+
+    with engine_context(True, donate=True):
+        reset_engine_stats()
+        mc = MetricCollection(family())
+        out["cse_groups"] = len(mc.compute_groups)
+        out["cse_discovered_at_construction"] = bool(mc._groups_checked)
+        # warm: the ONE shared-reduction trace happens on step 1 (no x64 in
+        # the bench process, so no dtype-promotion warmup retrace)
+        for _ in range(8):
+            mc.update(preds, target)
+        block(mc)
+        warm = engine_report()
+        out["cse_shared_reduction_traces"] = warm["traces"]
+        out["cse_eager_fallbacks"] = warm["eager_fallbacks"]
+        # guarded warm loop: dispatch-per-step, retraces, host transfers
+        with diag_context(capacity=16384) as rec, transfer_guard("strict"):
+            before = engine_report()
+            for _ in range(steps):
+                mc.update(preds, target)
+            after = engine_report()
+        block(mc)
+        out["cse_dispatches_per_step"] = round(
+            (after["dispatches"] - before["dispatches"]) / steps, 4
+        )
+        out["cse_retraces_after_warmup"] = after["traces"] - before["traces"]
+        out["cse_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+        retraces = [e for e in rec.snapshot() if e.kind.endswith(".retrace")]
+        out["cse_retraces_uncaused"] = sum(1 for e in retraces if not e.data.get("cause"))
+
+        # -- footprint: canonical family state counted once -------------------
+        foot = mc.state_footprint()
+        out["cse_unique_state_bytes"] = foot["unique_bytes"]
+        out["cse_nominal_state_bytes"] = foot["total_bytes"]
+        out["cse_footprint_fraction"] = round(
+            foot["unique_bytes"] / max(foot["total_bytes"], 1), 4
+        )
+        out["cse_group_canonical_bytes"] = foot["groups"][0]["canonical_bytes"] if foot.get("groups") else 0
+
+        # -- wall-clock evidence (display only; the contract is the counters):
+        # CSE'd collection vs the same 10 metrics updating per-metric compiled
+        unfused = MetricCollection(family(), compute_groups=False, fused_dispatch=False)
+        for _ in range(8):
+            unfused.update(preds, target)
+        windows = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                mc.update(preds, target)
+            block(mc)
+            t1 = time.perf_counter()
+            for _ in range(steps):
+                unfused.update(preds, target)
+            jax.block_until_ready([getattr(unfused._modules["acc_macro"], "tp")])
+            t2 = time.perf_counter()
+            windows.append(((t1 - t0) / steps * 1e6, (t2 - t1) / steps * 1e6))
+        # per-column medians: sorting the (cse, unfused) tuples jointly would
+        # report whatever unfused time happened to co-occur with the median
+        # CSE window, letting one noisy half skew the exported pair
+        med_cse = sorted(w[0] for w in windows)[len(windows) // 2]
+        med_unfused = sorted(w[1] for w in windows)[len(windows) // 2]
+        out["cse_us_per_step"] = round(med_cse, 2)
+        out["unfused_us_per_step"] = round(med_unfused, 2)
+        out["cse_speedup_vs_unfused"] = round(med_unfused / max(med_cse, 1e-9), 2)
+
+    # -- byte-parity vs independent metrics, riders composed ------------------
+    from torchmetrics_tpu.engine.txn import read_quarantine
+
+    rng = np.random.RandomState(31)
+    stream = [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch).astype(np.int32)),
+        )
+        for _ in range(24)
+    ]
+    poisoned_steps = {3, 17}
+    nan_preds = jnp.asarray(np.full((batch, classes), np.nan, np.float32))
+
+    def run_stream(fused):
+        with engine_context(True, donate=True), quarantine_context(True), \
+                compensated_context(True), scan_context(8):
+            if fused:
+                obj = MetricCollection(family())
+                members = obj._modules
+                for i, (p, t) in enumerate(stream):
+                    obj.update(nan_preds if i in poisoned_steps else p, t)
+                values = {k: np.asarray(v) for k, v in obj.compute().items()}
+                owner = members[obj.compute_groups[0][0]]
+                quarantined = read_quarantine(owner)["count"]
+            else:
+                members = family()
+                for i, (p, t) in enumerate(stream):
+                    for m in members.values():
+                        m.update(nan_preds if i in poisoned_steps else p, t)
+                values = {k: np.asarray(m.compute()) for k, m in members.items()}
+                quarantined = read_quarantine(next(iter(members.values())))["count"]
+            states = {
+                k: np.asarray(getattr(members["acc_macro"], k))
+                for k in members["acc_macro"]._defaults
+            }
+        return values, states, int(quarantined)
+
+    cse_vals, cse_states, cse_q = run_stream(True)
+    ref_vals, ref_states, ref_q = run_stream(False)
+    parity = all(np.array_equal(cse_vals[k], ref_vals[k]) for k in ref_vals) and all(
+        np.array_equal(cse_states[k], ref_states[k]) for k in ref_states
+    )
+    out["cse_quarantine_planted"] = len(poisoned_steps)
+    out["cse_quarantined_batches"] = cse_q
+    out["cse_parity_ok"] = bool(parity and cse_q == ref_q == len(poisoned_steps))
+
+    # -- deprecation telemetry: in-tree roles resolve from the registry -------
+    out["cse_spec_fallbacks"] = spec_fallback_count()
+    return out
+
+
 def bench_micro_device(n_steps=200):
     """Bounded stand-in for the device scenarios when no TPU is present: a tiny
     jitted accuracy scan whose only job is to prove the measurement path runs
@@ -2235,6 +2429,12 @@ def main(argv=None):
         except Exception as err:  # noqa: BLE001
             statuses["scan"] = f"error:{type(err).__name__}: {str(err)[:200]}"
 
+        try:
+            extras["cse"] = bench_cse(micro=not on_tpu or args.smoke)
+            statuses["cse"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["cse"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
         if on_tpu and not args.smoke:
             try:
                 ours = bench_ours()  # all device timings complete before any host work
@@ -2272,6 +2472,7 @@ def main(argv=None):
         statuses["numerics"] = "tpu_unavailable"
         statuses["serve"] = "tpu_unavailable"
         statuses["scan"] = "tpu_unavailable"
+        statuses["cse"] = "tpu_unavailable"
         statuses["device_scenarios"] = "tpu_unavailable"
 
     if not args.smoke:

@@ -80,6 +80,17 @@ _TRACKED = (
     ("serve", "serve_retraces_after_warmup", "max"),
     ("serve", "tenant_traces", "max"),
     ("serve", "tenant_host_transfers", "max"),
+    # cross-metric CSE (engine/statespec.py + collections.py, PR 11): the
+    # speedup and footprint fraction are trajectory evidence (check_counters
+    # gates the exact counter envelope); traces/dispatches/transfers and the
+    # deprecated-convention fallback count must never creep.
+    ("cse", "cse_speedup_vs_unfused", None),
+    ("cse", "cse_footprint_fraction", None),
+    ("cse", "cse_shared_reduction_traces", "max"),
+    ("cse", "cse_dispatches_per_step", "max"),
+    ("cse", "cse_host_transfers", "max"),
+    ("cse", "cse_retraces_after_warmup", "max"),
+    ("cse", "cse_spec_fallbacks", "max"),
 )
 
 _TOL = 1e-6

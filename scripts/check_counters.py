@@ -76,6 +76,18 @@ and fails CI when any counter regresses past the committed baseline
   (``scan_host_transfers`` == 0); on a TPU-less run the micro fallback must
   additionally prove NO gated scenario was skipped
   (``micro_fallback.scenarios_missing`` empty)
+- cross-metric CSE proofs (``engine/statespec.py`` + ``collections.py``): the
+  10-metric stat-scores-family collection resolves to ONE compute group at
+  CONSTRUCTION (``cse_groups`` == 1, ``cse_discovered_at_construction``),
+  traces the shared TP/FP/TN/FN reduction exactly once
+  (``cse_shared_reduction_traces`` == 1), runs one dispatch per step
+  (``cse_dispatches_per_step`` == 1) with zero fallbacks/warm retraces, holds
+  ~1/N unique state bytes (``cse_footprint_fraction`` <= 0.2), stays
+  byte-identical to independently-computed metrics with quarantine + scan
+  riders composed on the shared state (``cse_parity_ok``,
+  ``cse_quarantined_batches`` == planted), does zero host transfers under the
+  STRICT guard, and resolves every in-tree packed/bucketing/compensation role
+  from the StateSpec registry (``cse_spec_fallbacks`` == 0)
 - numerical-resilience proofs (``engine/numerics.py``): the 18k-step
   long stream drifts ≥1e-3 on the naive float32 path
   (``drift_demonstrated``) while the compensated two-sum path stays within
@@ -223,6 +235,27 @@ _CHECKS = (
     ("scan", "scan_retraces_uncaused", "abs", 0),  # every retrace attributed
     ("scan", "scan_events_per_drain_ok", "true", None),  # 1 update.scan per drain
     ("scan", "scan_flush_on_observation_ok", "true", None),  # compute() drained first
+    # cross-metric CSE gates (engine/statespec.py + collections.py, PR 11):
+    # a 10-metric stat-scores-family collection shares ONE state-producing
+    # reduction — discovered at CONSTRUCTION from declared reduction
+    # signatures (no eager first-step pass, no value-comparison readback),
+    # traced once, dispatched once per step, holding ~1/N unique state bytes,
+    # byte-identical to independently-computed metrics with the quarantine +
+    # scan riders composed on the shared state — and every in-tree role
+    # resolves from the StateSpec registry (zero deprecated-convention
+    # fallbacks)
+    ("cse", "cse_groups", "abs", 1),  # the whole family is ONE compute group
+    ("cse", "cse_discovered_at_construction", "true", None),  # no first-step pass
+    ("cse", "cse_shared_reduction_traces", "abs", 1),  # the reduction traced ONCE
+    ("cse", "cse_dispatches_per_step", "abs", 1.0),  # N metrics = 1 dispatch/step
+    ("cse", "cse_eager_fallbacks", "abs", 0),
+    ("cse", "cse_retraces_after_warmup", "abs", 0),
+    ("cse", "cse_host_transfers", "abs", 0),  # STRICT guard incl. discovery
+    ("cse", "cse_retraces_uncaused", "abs", 0),
+    ("cse", "cse_footprint_fraction", "abs", 0.2),  # unique bytes ~1/N of nominal
+    ("cse", "cse_parity_ok", "true", None),  # byte-identical, riders composed
+    ("cse", "cse_quarantined_batches", "eqfield", "cse_quarantine_planted"),
+    ("cse", "cse_spec_fallbacks", "abs", 0),  # every in-tree role is registry-resolved
 )
 
 
@@ -263,7 +296,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "cse"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

@@ -121,8 +121,22 @@ elif ! grep -q '"scan_dispatch_amortization_k8": 8.0' "$BENCH_OUT" \
   # ragged queue tails, flush on observation, and hold the STRICT guard
   echo "bench smoke: FAILED (multi-step scan fold/parity/flush proofs missing or degraded)"
   status=1
+elif ! grep -q '"cse_groups": 1' "$BENCH_OUT" \
+  || ! grep -q '"cse_discovered_at_construction": true' "$BENCH_OUT" \
+  || ! grep -q '"cse_shared_reduction_traces": 1' "$BENCH_OUT" \
+  || ! grep -q '"cse_dispatches_per_step": 1.0' "$BENCH_OUT" \
+  || ! grep -q '"cse_parity_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"cse_host_transfers": 0' "$BENCH_OUT" \
+  || ! grep -q '"cse_spec_fallbacks": 0' "$BENCH_OUT"; then
+  # cross-metric CSE smoke (engine/statespec.py + collections.py gate): the
+  # 10-metric stat-scores family must resolve to ONE construction-time
+  # compute group tracing the shared reduction once and dispatching once per
+  # step, byte-identical to independent metrics with riders composed, with
+  # zero host transfers and zero deprecated-convention spec fallbacks
+  echo "bench smoke: FAILED (cross-metric CSE shared-reduction proofs missing or degraded)"
+  status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve + scan counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve + scan + cse counters present)"
 fi
 
 echo

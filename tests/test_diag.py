@@ -180,8 +180,8 @@ def test_fused_step_emits_dispatch_and_trace_events():
         for _ in range(4):
             mc.update(*_batch(8))
     assert rec.counts["fused.trace"] == 1
-    assert rec.counts["fused.dispatch"] == 3  # step 1 is the eager discovery pass
-    assert rec.counts["collection.step"] == 3
+    assert rec.counts["fused.dispatch"] == 4  # every step fuses (CSE discovery at construction)
+    assert rec.counts["collection.step"] == 4
     dispatches = [e for e in rec.snapshot() if e.kind == "fused.dispatch"]
     assert all(e.data["dispatch_us"] > 0 and e.data["members"] >= 2 for e in dispatches)
 

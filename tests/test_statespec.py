@@ -1,0 +1,589 @@
+"""StateSpec registry + cross-metric CSE fusion tests (engine/statespec.py +
+collections.py): spec-vs-legacy role parity on every path, signature-based
+group discovery, rider composition on the shared state, lifecycle round-trips,
+and the deprecated-fallback telemetry."""
+
+import pickle
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassSpecificity,
+    MulticlassStatScores,
+)
+from torchmetrics_tpu.engine import engine_context, quarantine_context, scan_context
+from torchmetrics_tpu.engine import statespec
+from torchmetrics_tpu.engine.stats import engine_report, reset_engine_stats
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.parallel.packing import PackedSyncPlan
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+DISTRIBUTED = staticmethod(lambda: True)
+
+
+def _batches(sizes, seed=0, classes=NUM_CLASSES):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(n, classes)), jnp.asarray(rng.randint(0, classes, n)))
+        for n in sizes
+    ]
+
+
+def _family(n=10, classes=NUM_CLASSES, **kw):
+    """A 10-metric stat-scores-family classification collection (one reduction)."""
+    kw.setdefault("validate_args", False)
+    return {
+        "acc_macro": MulticlassAccuracy(classes, average="macro", **kw),
+        "acc_weighted": MulticlassAccuracy(classes, average="weighted", **kw),
+        "prec_macro": MulticlassPrecision(classes, average="macro", **kw),
+        "prec_none": MulticlassPrecision(classes, average="none", **kw),
+        "rec_macro": MulticlassRecall(classes, average="macro", **kw),
+        "rec_weighted": MulticlassRecall(classes, average="weighted", **kw),
+        "f1_macro": MulticlassF1Score(classes, average="macro", **kw),
+        "spec_macro": MulticlassSpecificity(classes, average="macro", **kw),
+        "spec_none": MulticlassSpecificity(classes, average="none", **kw),
+        "stat_macro": MulticlassStatScores(classes, average="macro", **kw),
+    }
+
+
+def _strip_registry(metric):
+    """Turn a registered metric into an 'out-of-tree legacy' one: no specs —
+    every consumer must re-derive roles from the attribute conventions."""
+    metric._state_specs.clear()
+    return metric
+
+
+class RichStates(Metric):
+    """Every fold kind the packed plan supports, via add_state."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(NUM_CLASSES), dist_reduce_fx="sum")
+        self.add_state("avg", jnp.asarray(0.0), dist_reduce_fx="mean")
+        self.add_state("peak", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.add_state("trough", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("raw", jnp.zeros((2,)), dist_reduce_fx=None)
+        self.add_state("rows", jnp.zeros((3, 2)), dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.total = self.total + x.sum(0)
+        self.avg = x.mean()
+        self.peak = jnp.maximum(self.peak, x.max())
+        self.trough = jnp.minimum(self.trough, x.min())
+        self.raw = self.raw + jnp.asarray([x.sum(), x.size], self.raw.dtype)
+        self.rows = x[:3, :2]
+
+    def compute(self):
+        return self.total.sum() + self.avg + self.peak + self.trough
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_add_state_registers_specs_zero_fallbacks():
+    reset_engine_stats()
+    m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+    specs = m.state_specs()
+    assert set(specs) == {"tp", "fp", "tn", "fn"}
+    for sp in specs.values():
+        assert sp.fold == "sum" and sp.role == "state"
+        assert sp.row_additive and not sp.state_additive
+        assert sp.shard_rule == "replicate"
+    s = SumMetric(nan_strategy=0.0)
+    assert s.state_specs()["value"].state_additive
+    assert statespec.spec_fallback_count() == 0
+
+
+def test_serve_roles_registered_first_class():
+    from torchmetrics_tpu.serve.sketch import HeavyHitters
+    from torchmetrics_tpu.serve.window import WindowedMetric
+
+    reset_engine_stats()
+    hh = HeavyHitters(k=4)
+    specs = hh.state_specs()
+    assert specs["cms"].role == "hh-grid"
+    assert specs["hh_ids"].role == "hh-ids"
+    assert specs["hh_ids"].hh == ("cms", 4, 4, 2048)
+    assert specs["hh_counts"].role == "hh-counts"
+    assert all(sp.dtype_policy == "count" for sp in specs.values())
+    w = WindowedMetric(SumMetric(nan_strategy=0.0), buckets=4, bucket_size=2)
+    assert w.state_specs()["clock"].role == "ring-clock"
+    assert w.state_specs()["clock"].dtype_policy == "count"
+    # the in-tree serve roles resolve from the registry, never the fallback
+    plan = PackedSyncPlan([("hh", hh)], 1, None)
+    assert [sp.kind for sp in plan.specs] == ["sum", "hh-ids", "hh-counts"]
+    assert statespec.spec_fallback_count() == 0
+
+
+def test_legacy_derivation_counts_fallback_once():
+    reset_engine_stats()
+    m = _strip_registry(MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False))
+    sp = statespec.spec_of(m, "tp", consumer="test")
+    assert sp.fold == "sum" and sp.row_additive
+    first = statespec.spec_fallback_count()
+    assert first == 1
+    # derivation caches back into the registry: telemetry fires once, not per step
+    statespec.spec_of(m, "tp", consumer="test")
+    assert statespec.spec_fallback_count() == first
+    assert engine_report()["spec_fallbacks"] == first
+
+
+def test_legacy_hh_derivation_matches_registered_plan():
+    from torchmetrics_tpu.serve.sketch import HeavyHitters
+
+    reset_engine_stats()
+    registered = HeavyHitters(k=4)
+    legacy = _strip_registry(HeavyHitters(k=4))
+    plan_r = PackedSyncPlan([("m", registered)], 1, None)
+    plan_l = PackedSyncPlan([("m", legacy)], 1, None)
+    assert [(s.attr, s.kind, s.hh_meta) for s in plan_r.specs] == [
+        (s.attr, s.kind, s.hh_meta) for s in plan_l.specs
+    ]
+    assert statespec.spec_fallback_count() > 0  # the legacy plan had to derive
+
+
+def test_plan_parity_spec_vs_legacy_all_roles():
+    reset_engine_stats()
+    registered = RichStates()
+    legacy = _strip_registry(RichStates())
+    x = jnp.asarray(np.random.RandomState(3).rand(4, NUM_CLASSES))
+    registered.update(x)
+    legacy.update(x)
+    plan_r = PackedSyncPlan([("m", registered)], 2, None)
+    plan_l = PackedSyncPlan([("m", legacy)], 2, None)
+    assert plan_r.signature() == plan_l.signature()
+    assert [s.kind for s in plan_r.specs] == ["sum", "mean", "max", "min", "none-array", "cat"]
+    assert statespec.spec_fallback_count() == len(legacy._reductions)
+
+
+def test_world2_packed_sync_parity_spec_vs_legacy(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * 2)
+    )
+    x = jnp.asarray(np.random.RandomState(5).rand(4, NUM_CLASSES))
+    results = {}
+    for label, strip in (("spec", False), ("legacy", True)):
+        with engine_context(True, donate=True):
+            m = RichStates(distributed_available_fn=lambda: True)
+            if strip:
+                _strip_registry(m)
+            m.update(x)
+            m.sync()
+            results[label] = {k: np.asarray(getattr(m, k)) for k in m._defaults}
+            m.unsync()
+    for k in results["spec"]:
+        np.testing.assert_array_equal(results["spec"][k], results["legacy"][k], err_msg=k)
+
+
+def test_compiled_and_fused_paths_spec_vs_legacy_parity():
+    """The engine hot paths (compiled per-metric step, fused collection step)
+    behave identically whether roles come from the registry or the counted
+    legacy derivation — bucketing eligibility included."""
+    steps = _batches([16, 7, 16], seed=8)  # ragged middle batch exercises buckets
+
+    def run_metric(strip):
+        with engine_context(True, donate=True):
+            m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+            if strip:
+                _strip_registry(m)
+            for p, t in steps:
+                m.update(p, t)
+            states = {k: np.asarray(getattr(m, k)) for k in m._defaults}
+            stats = m._engine.stats
+            return states, stats.bucketed_steps, stats.eager_fallbacks
+
+    spec_states, spec_bucketed, spec_fb = run_metric(False)
+    legacy_states, legacy_bucketed, legacy_fb = run_metric(True)
+    assert spec_bucketed == legacy_bucketed > 0
+    assert spec_fb == legacy_fb == 0
+    for k in spec_states:
+        np.testing.assert_array_equal(spec_states[k], legacy_states[k], err_msg=k)
+
+    def run_fused(strip):
+        with engine_context(True, donate=True):
+            mc = MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+                    "micro": MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False),
+                }
+            )
+            if strip:
+                for m in mc._modules.values():
+                    _strip_registry(m)
+            for p, t in steps:
+                mc.update(p, t)
+            return {k: np.asarray(v) for k, v in mc.compute().items()}
+
+    spec_vals = run_fused(False)
+    legacy_vals = run_fused(True)
+    for k in spec_vals:
+        np.testing.assert_array_equal(spec_vals[k], legacy_vals[k], err_msg=k)
+
+
+def test_bucketing_and_compensation_eligibility_legacy_parity():
+    from torchmetrics_tpu.engine.bucketing import bucket_eligible
+    from torchmetrics_tpu.engine.numerics import comp_state_names
+
+    reset_engine_stats()
+    for build in (
+        lambda: MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+        lambda: SumMetric(nan_strategy=0.0),
+        lambda: MeanMetric(nan_strategy=0.0),
+        RichStates,
+    ):
+        registered, legacy = build(), _strip_registry(build())
+        assert bucket_eligible(registered) == bucket_eligible(legacy)
+        assert comp_state_names(registered) == comp_state_names(legacy)
+
+
+def test_rider_keys_lockstep():
+    from torchmetrics_tpu.diag import sentinel as _sentinel
+    from torchmetrics_tpu.engine import numerics as _numerics
+    from torchmetrics_tpu.engine import txn as _txn
+
+    assert statespec.RIDER_KEYS == {
+        _sentinel.STATE_KEY, _txn.STATE_KEY, _numerics.STATE_KEY,
+    }
+    assert statespec.PAD_EXEMPT_KEYS == statespec.RIDER_KEYS
+
+
+def test_shard_rule_noop_default():
+    m = SumMetric(nan_strategy=0.0)
+    sp = m.state_specs()["value"]
+    assert sp.shard_rule == "replicate"
+    assert statespec.resolve_shard_rule(sp) is None  # documented no-op: replicated
+    import dataclasses
+
+    with pytest.raises(ValueError, match="unknown shard rule"):
+        statespec.resolve_shard_rule(dataclasses.replace(sp, shard_rule="nope"))
+
+
+def test_specs_pickle_with_the_metric():
+    m = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+    clone = pickle.loads(pickle.dumps(m))
+    assert set(clone._state_specs) == {"tp", "fp", "tn", "fn"}
+    assert clone._state_specs["tp"].fold == "sum"
+
+
+# ------------------------------------------------------------------ CSE discovery
+
+
+def test_cse_family_fused_at_construction():
+    mc = MetricCollection(_family())
+    # discovery is DONE before any update: one group, first step already fused
+    assert mc._groups_checked
+    assert len(mc.compute_groups) == 1
+    assert sorted(mc.compute_groups[0]) == sorted(_family().keys())
+
+
+def test_cse_average_differing_only_in_compute_fuses():
+    mc = MetricCollection(
+        {
+            "macro": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+            "weighted": MulticlassPrecision(NUM_CLASSES, average="weighted", validate_args=False),
+            "none": MulticlassRecall(NUM_CLASSES, average="none", validate_args=False),
+        }
+    )
+    assert len(mc.compute_groups) == 1
+    # normalize= differs only in compute for confusion matrices: same group
+    cm = MetricCollection(
+        {
+            "plain": MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+            "norm": MulticlassConfusionMatrix(NUM_CLASSES, normalize="true", validate_args=False),
+        }
+    )
+    assert len(cm.compute_groups) == 1
+
+
+def test_cse_knob_mismatch_no_fusion():
+    kw = dict(validate_args=False)
+    mc = MetricCollection(
+        {
+            "base": MulticlassAccuracy(NUM_CLASSES, average="macro", **kw),
+            "other_classes": MulticlassAccuracy(NUM_CLASSES + 1, average="macro", **kw),
+            "micro": MulticlassAccuracy(NUM_CLASSES, average="micro", **kw),
+            "topk": MulticlassAccuracy(NUM_CLASSES, average="macro", top_k=2, **kw),
+            "ignoring": MulticlassAccuracy(NUM_CLASSES, average="macro", ignore_index=0, **kw),
+        }
+    )
+    assert len(mc.compute_groups) == 5  # every knob difference splits the reduction
+
+
+def test_cse_ignore_index_value_coincidence_not_merged():
+    """The latent mis-merge of value-based discovery: differing ``ignore_index``
+    with no ignored label in batch 1 produces identical first-step states —
+    signatures keep the groups apart so batch 2 (which DOES contain the
+    ignored label) diverges correctly."""
+    kw = dict(validate_args=False)
+    rng = np.random.RandomState(11)
+    preds1 = jnp.asarray(rng.rand(8, 3))
+    target1 = jnp.asarray(rng.randint(0, 2, 8))  # no label 2 in batch 1
+    preds2 = jnp.asarray(rng.rand(8, 3))
+    target2 = jnp.asarray(np.full(8, 2, np.int64))  # all label 2 in batch 2
+    mc = MetricCollection(
+        {
+            "plain": MulticlassAccuracy(3, average="micro", **kw),
+            "ignoring": MulticlassAccuracy(3, average="micro", ignore_index=2, **kw),
+        }
+    )
+    assert len(mc.compute_groups) == 2  # merged groups would share one update
+    mc.update(preds1, target1)
+    mc.update(preds2, target2)
+    out = mc.compute()
+    ref_plain = MulticlassAccuracy(3, average="micro", **kw)
+    ref_ign = MulticlassAccuracy(3, average="micro", ignore_index=2, **kw)
+    for m in (ref_plain, ref_ign):
+        m.update(preds1, target1)
+        m.update(preds2, target2)
+    np.testing.assert_allclose(np.asarray(out["plain"]), np.asarray(ref_plain.compute()))
+    np.testing.assert_allclose(np.asarray(out["ignoring"]), np.asarray(ref_ign.compute()))
+
+
+def test_cse_disabled_falls_back_to_value_discovery():
+    with statespec.cse_context(False):
+        mc = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+                "prec": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+            }
+        )
+        assert not mc._groups_checked  # legacy: discovery waits for the first step
+        p, t = _batches([8], seed=2)[0]
+        mc.update(p, t)
+        assert mc._groups_checked
+        assert len(mc.compute_groups) == 1  # value equality still merges
+
+
+def test_cse_env_fail_loud(monkeypatch):
+    monkeypatch.setenv(statespec.CSE_ENV_VAR, "banana")
+    with pytest.raises(TorchMetricsUserError, match="TORCHMETRICS_TPU_CSE"):
+        MetricCollection(
+            {"acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)}
+        )
+
+
+class _UndeclaredHits(Metric):
+    """A signature-less metric: only value-equality discovery can merge it."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("hits", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        self.hits = self.hits + (preds.argmax(-1) == target).sum()
+
+    def compute(self):
+        return self.hits
+
+
+def test_cse_mixed_collection_keeps_value_discovery_for_undeclared():
+    mc = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+            "prec": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+            "hits_a": _UndeclaredHits(),
+            "hits_b": _UndeclaredHits(),
+        }
+    )
+    # the family pre-merged at construction; the undeclared metrics wait
+    assert not mc._groups_checked
+    assert sorted(map(sorted, mc.compute_groups.values())) == [
+        ["acc", "prec"], ["hits_a"], ["hits_b"],
+    ]
+    p, t = _batches([8], seed=4)[0]
+    mc.update(p, t)
+    assert mc._groups_checked
+    groups = sorted(map(sorted, mc.compute_groups.values()))
+    assert ["acc", "prec"] in groups
+    assert ["hits_a", "hits_b"] in groups  # value equality still merges those
+
+
+# ------------------------------------------------------------------ CSE counters + parity
+
+
+def test_cse_single_trace_single_dispatch_per_step():
+    steps = _batches([16] * 8, seed=7)
+    with engine_context(True, donate=True):
+        reset_engine_stats()
+        mc = MetricCollection(_family())
+        for p, t in steps:
+            mc.update(p, t)
+        rep = engine_report()
+    # ONE owner runs the shared reduction: 8 steps = 8 dispatches total
+    # (x64 promotes the int32 states after step 1, so warmup may trace twice)
+    assert rep["dispatches"] == len(steps)
+    budget = 2 if jax.config.jax_enable_x64 else 1
+    assert rep["traces"] <= budget
+    assert rep["eager_fallbacks"] == 0
+
+
+def test_cse_riders_byte_parity_quarantine_scan():
+    """The shared reduction composes with the PR-7 quarantine rider and the
+    PR-10 scan queue — byte-identical to independently-run metrics."""
+    classes = 4
+    rng = np.random.RandomState(9)
+    stream = [
+        (jnp.asarray(rng.rand(8, classes).astype(np.float32)), jnp.asarray(rng.randint(0, classes, 8)))
+        for _ in range(12)
+    ]
+    nan_preds = jnp.asarray(np.full((8, classes), np.nan, np.float32))
+    poisoned = {4, 9}
+
+    def family():
+        kw = dict(validate_args=False)
+        return {
+            "acc": MulticlassAccuracy(classes, average="macro", **kw),
+            "prec": MulticlassPrecision(classes, average="weighted", **kw),
+            "f1": MulticlassF1Score(classes, average="macro", **kw),
+        }
+
+    def run(fused):
+        with engine_context(True, donate=True), quarantine_context(True), scan_context(4):
+            if fused:
+                obj = MetricCollection(family())
+                for i, (p, t) in enumerate(stream):
+                    obj.update(nan_preds if i in poisoned else p, t)
+                values = {k: np.asarray(v) for k, v in obj.compute().items()}
+                states = {
+                    k: np.asarray(getattr(obj._modules["acc"], k))
+                    for k in obj._modules["acc"]._defaults
+                }
+            else:
+                metrics = family()
+                for i, (p, t) in enumerate(stream):
+                    for m in metrics.values():
+                        m.update(nan_preds if i in poisoned else p, t)
+                values = {k: np.asarray(m.compute()) for k, m in metrics.items()}
+                states = {k: np.asarray(getattr(metrics["acc"], k)) for k in metrics["acc"]._defaults}
+        return values, states
+
+    fused_vals, fused_states = run(True)
+    ref_vals, ref_states = run(False)
+    for k in ref_vals:
+        np.testing.assert_array_equal(fused_vals[k], ref_vals[k], err_msg=k)
+    for k in ref_states:
+        np.testing.assert_array_equal(fused_states[k], ref_states[k], err_msg=k)
+
+
+# ------------------------------------------------------------------ CSE lifecycle
+
+
+def test_cse_clone_pickle_state_dict_roundtrip():
+    mc = MetricCollection(_family())
+    for p, t in _batches([8, 8], seed=13):
+        mc.update(p, t)
+    want = {k: np.asarray(v) for k, v in mc.compute().items()}
+
+    clone = mc.clone()
+    assert clone._groups_checked and len(clone.compute_groups) == 1
+    got = {k: np.asarray(v) for k, v in clone.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+    wire = pickle.loads(pickle.dumps(mc))
+    got = {k: np.asarray(v) for k, v in wire.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+    mc.persistent(True)  # stat-scores states default to persistent=False
+    fresh = MetricCollection(_family())
+    fresh.load_state_dict(mc.state_dict())
+    got = {k: np.asarray(v) for k, v in fresh.compute().items()}
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+
+def test_cse_reshard_restores_canonical_state_once(tmp_path):
+    from torchmetrics_tpu.parallel.elastic import restore_resharded, save_state_shard, shard_path
+
+    base = str(tmp_path / "cse")
+    per_rank = []
+    for rank in range(2):
+        mc = MetricCollection(_family())
+        p, t = _batches([8], seed=20 + rank)[0]
+        mc.update(p, t)
+        save_state_shard(mc, shard_path(base, rank, 2), rank=rank, world_size=2)
+        per_rank.append(mc)
+    # world-2 -> world-1: the fold of both ranks, canonical state restored once
+    fresh = MetricCollection(_family())
+    restore_resharded(fresh, str(tmp_path), rank=0, world_size=1)
+    owner = fresh.compute_groups[0][0]
+    # every view member holds the OWNER's restored buffers (no per-view copies)
+    for name in fresh.compute_groups[0][1:]:
+        for attr in fresh._modules[owner]._defaults:
+            assert getattr(fresh._modules[name], attr) is getattr(fresh._modules[owner], attr)
+    got = {k: np.asarray(v) for k, v in fresh.compute().items()}
+    ref = {}
+    for k in per_rank[0].keys():
+        a = per_rank[0]._modules[k]
+        b = per_rank[1]._modules[k]
+        merged = a.clone()
+        merged.merge_state(b)
+        ref[k] = np.asarray(merged.compute())
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], atol=1e-6, err_msg=k)
+
+
+def test_cse_footprint_counts_canonical_once():
+    mc = MetricCollection(_family())
+    p, t = _batches([8], seed=23)[0]
+    mc.update(p, t)
+    foot = mc.state_footprint()
+    n = len(mc._modules)
+    # ~1/N unique state bytes for the fused family (one canonical tp/fp/tn/fn)
+    assert foot["unique_bytes"] * (n - 1) < foot["total_bytes"]
+    assert foot["groups"] and foot["groups"][0]["members"] == n
+    assert foot["groups"][0]["canonical_bytes"] == foot["unique_bytes"]
+    # entry-point independence: the diag function materializes views itself
+    from torchmetrics_tpu.diag.costs import state_footprint
+
+    mc2 = MetricCollection(_family())
+    direct = state_footprint(mc2)  # BEFORE any accessor materialized views
+    assert direct["unique_bytes"] * (n - 1) < direct["total_bytes"]
+
+
+# ------------------------------------------------------------------ telemetry
+
+
+def test_spec_fallback_prometheus_series():
+    from torchmetrics_tpu.diag.telemetry import export_prometheus
+
+    reset_engine_stats()
+    legacy = _strip_registry(MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False))
+    statespec.spec_of(legacy, "tp", consumer="test")
+    text = export_prometheus()
+    line = next(
+        (ln for ln in text.splitlines() if ln.startswith("tm_tpu_spec_fallbacks_total")), None
+    )
+    assert line is not None and float(line.split()[-1]) >= 1.0
+
+
+def test_spec_fallback_event_recorded():
+    from torchmetrics_tpu.diag import diag_context
+
+    reset_engine_stats()
+    with diag_context() as rec:
+        legacy = _strip_registry(
+            MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+        )
+        statespec.spec_of(legacy, "tp", consumer="unit-test")
+    events = [e for e in rec.snapshot() if e.kind == "spec.fallback"]
+    assert events and events[0].data["state"] == "tp"
+    assert events[0].data["consumer"] == "unit-test"

@@ -122,6 +122,9 @@ UNITLESS_COUNT_FAMILIES = {
     # gauges; scrape latency itself is unit-suffixed (serve_scrape_latency_seconds)
     "tm_tpu_serve_scrapes", "tm_tpu_serve_snapshots", "tm_tpu_serve_snapshot_retries",
     "tm_tpu_serve_tenants", "tm_tpu_serve_spilled_updates",
+    # state-spec registry (engine/statespec.py, PR 11): deprecated-convention
+    # role resolutions — a pure migration count, no physical unit
+    "tm_tpu_spec_fallbacks",
 }
 
 

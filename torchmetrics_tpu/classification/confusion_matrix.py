@@ -34,6 +34,17 @@ from torchmetrics_tpu.utilities.enums import ClassificationTask
 Array = jax.Array
 
 
+
+def _update_family(metric) -> tuple:
+    """Identity of the state-producing update body for the CSE signature
+    (the one shared keying rule — ``engine/statespec.update_family``): the
+    kappa/jaccard/matthews derivatives inherit the confusion-matrix update
+    verbatim and differ only in ``compute``, so they share the family."""
+    from torchmetrics_tpu.engine.statespec import update_family
+
+    return update_family(metric)
+
+
 class BinaryConfusionMatrix(Metric):
     """2x2 confusion matrix for binary tasks (reference ``confusion_matrix.py``)."""
 
@@ -68,6 +79,12 @@ class BinaryConfusionMatrix(Metric):
             _binary_confusion_matrix_tensor_validation(preds, target, self.ignore_index)
         preds, target = _binary_confusion_matrix_format(preds, target, self.threshold, self.ignore_index)
         self.confmat = self.confmat + _binary_confusion_matrix_update(preds, target)
+
+    def _cse_signature(self):
+        """Reduction signature (``engine/statespec.py``): ``normalize`` is
+        compute-only — matrices with matching threshold/ignore_index share one
+        canonical ``confmat`` reduction."""
+        return (*_update_family(self), float(self.threshold), self.ignore_index)
 
     def compute(self) -> Array:
         """Final (normalized) matrix."""
@@ -114,6 +131,12 @@ class MulticlassConfusionMatrix(Metric):
             _multiclass_confusion_matrix_tensor_validation(preds, target, self.num_classes, self.ignore_index)
         preds, target = _multiclass_confusion_matrix_format(preds, target, self.ignore_index)
         self.confmat = self.confmat + _multiclass_confusion_matrix_update(preds, target, self.num_classes)
+
+    def _cse_signature(self):
+        """Reduction signature (``engine/statespec.py``): ``normalize`` is
+        compute-only — matrices with matching num_classes/ignore_index share
+        one canonical ``confmat`` reduction."""
+        return (*_update_family(self), int(self.num_classes), self.ignore_index)
 
     def compute(self) -> Array:
         """Final (normalized) matrix."""
@@ -164,6 +187,12 @@ class MultilabelConfusionMatrix(Metric):
             preds, target, self.num_labels, self.threshold, self.ignore_index
         )
         self.confmat = self.confmat + _multilabel_confusion_matrix_update(preds, target, self.num_labels)
+
+    def _cse_signature(self):
+        """Reduction signature (``engine/statespec.py``): ``normalize`` is
+        compute-only — matrices with matching num_labels/threshold/
+        ignore_index share one canonical ``confmat`` reduction."""
+        return (*_update_family(self), int(self.num_labels), float(self.threshold), self.ignore_index)
 
     def compute(self) -> Array:
         """Final (normalized) matrices."""

@@ -83,6 +83,13 @@ class _AbstractStatScores(Metric):
         fn = dim_zero_cat(self.fn)
         return tp, fp, tn, fn
 
+    def _update_family(self) -> tuple:
+        """Identity of the state-producing update body for the CSE signature
+        (the one shared keying rule — ``engine/statespec.update_family``)."""
+        from torchmetrics_tpu.engine.statespec import update_family
+
+        return update_family(self)
+
 
 class BinaryStatScores(_AbstractStatScores):
     """tp/fp/tn/fn for binary tasks (reference ``classification/stat_scores.py:85-182``).
@@ -126,6 +133,14 @@ class BinaryStatScores(_AbstractStatScores):
         preds, target = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
         tp, fp, tn, fn = _binary_stat_scores_update(preds, target, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
+
+    def _cse_signature(self):
+        """Reduction signature (``engine/statespec.py``): the binary tp/fp/tn/fn
+        reduction is identical for every member whose threshold/ignore_index
+        match — the family's whole spread lives in ``compute``."""
+        if self.multidim_average != "global":
+            return None  # samplewise cat-list states don't CSE
+        return (*self._update_family(), float(self.threshold), self.ignore_index)
 
     def compute(self) -> Array:
         """Final [tp, fp, tn, fn, support]."""
@@ -174,6 +189,27 @@ class MulticlassStatScores(_AbstractStatScores):
         )
         self._update_state(tp, fp, tn, fn)
 
+    def _cse_signature(self):
+        """Reduction signature (``engine/statespec.py``).
+
+        ``average`` reaches the update ONLY as the micro-with-top-1 collapse
+        (scalar counters instead of per-class) — macro/weighted/none all
+        accumulate identical per-class tp/fp/tn/fn and differ purely in
+        ``compute``, so they normalize to one ``"per-class"`` token and FUSE;
+        ``num_classes``/``top_k``/``ignore_index`` genuinely shape the
+        reduction and split the signature.
+        """
+        if self.multidim_average != "global":
+            return None
+        micro = self.average == "micro" and self.top_k == 1
+        return (
+            *self._update_family(),
+            int(self.num_classes),
+            int(self.top_k),
+            "micro" if micro else "per-class",
+            self.ignore_index,
+        )
+
     def compute(self) -> Array:
         """Final stat scores with averaging applied."""
         tp, fp, tn, fn = self._final_state()
@@ -219,6 +255,20 @@ class MultilabelStatScores(_AbstractStatScores):
         )
         tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
+
+    def _cse_signature(self):
+        """Reduction signature (``engine/statespec.py``): the multilabel
+        reduction never sees ``average`` at all — per-label tp/fp/tn/fn for
+        every averaging mode, so the whole family fuses on matching
+        ``num_labels``/``threshold``/``ignore_index``."""
+        if self.multidim_average != "global":
+            return None
+        return (
+            *self._update_family(),
+            int(self.num_labels),
+            float(self.threshold),
+            self.ignore_index,
+        )
 
     def compute(self) -> Array:
         """Final stat scores with averaging applied."""

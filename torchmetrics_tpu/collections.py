@@ -380,7 +380,16 @@ class MetricCollection:
         bucket each group folds into the first representative whose state
         VALUES match, else becomes a new representative. Transitive merging
         falls out of representative chaining — no O(n²) rescans.
+
+        Groups that declared a reduction signature were already CSE-merged at
+        construction (:meth:`_merge_cse_groups`); here the signature acts as a
+        VETO — two groups whose declared reductions differ can never be merged
+        by a first-batch value coincidence (e.g. differing ``ignore_index``
+        with no ignored label in batch 1). Signature-less groups keep the
+        legacy value-equality semantics, including merging with a declared
+        group when the values prove equal.
         """
+        sigs = self.__dict__.get("_cse_signatures") or {}
         merged: List[_ComputeGroup] = []
         buckets: Dict[tuple, List[_ComputeGroup]] = {}
         for group in self._groups.values():
@@ -389,7 +398,11 @@ class MetricCollection:
             if fingerprint is None:  # stateless metrics never share a group
                 merged.append(group)
                 continue
+            sig = sigs.get(group.owner)
             for representative in buckets.setdefault(fingerprint, []):
+                rep_sig = sigs.get(representative.owner)
+                if sig is not None and rep_sig is not None and sig != rep_sig:
+                    continue  # declared reductions differ: value match is a coincidence
                 if _states_equal(self._modules[representative.owner], owner):
                     representative.absorb(group)
                     break
@@ -675,7 +688,8 @@ class MetricCollection:
             self._groups = {}
 
     def _init_compute_groups(self) -> None:
-        """Seed groups: user-specified lists, or one singleton per metric."""
+        """Seed groups: user-specified lists, or one singleton per metric
+        (then CSE-merged by declared reduction signature)."""
         if isinstance(self._enable_compute_groups, list):
             for names in self._enable_compute_groups:
                 for metric in names:
@@ -689,6 +703,80 @@ class MetricCollection:
             self._groups_checked = True
         else:
             self._groups = {i: _ComputeGroup([str(k)]) for i, k in enumerate(self._modules.keys())}
+            self._merge_cse_groups()
+
+    def _merge_cse_groups(self) -> None:
+        """Cross-metric common-subexpression fusion at CONSTRUCTION time.
+
+        Metrics declaring an equal :func:`~torchmetrics_tpu.engine.statespec.
+        reduction_signature` — the stat-scores family with matching
+        task/num_classes/top_k/ignore_index knobs, confusion matrices with
+        matching shape knobs — provably run one identical state-producing
+        reduction, so they merge into one compute group NOW: the shared
+        TP/FP/TN/FN (or confmat) reduction traces once into one canonical
+        donated state, and every member derives its compute from the shared
+        buffers.
+
+        When EVERY member carries a signature, discovery is complete here —
+        the first step is already fused (no N-way eager discovery pass, no
+        sanctioned host readback for value comparison). A mix of declared and
+        undeclared members keeps the legacy first-step value-equality pass for
+        the undeclared ones, with the signatures acting as a merge veto
+        (:meth:`_discover_groups`). ``TORCHMETRICS_TPU_CSE=0`` opts out
+        entirely.
+        """
+        from torchmetrics_tpu.engine.statespec import cse_enabled, reduction_signature
+
+        if not cse_enabled():
+            self._cse_signatures = {}
+            return
+        sigs = {name: reduction_signature(m) for name, m in self._modules.items()}
+        self._cse_signatures = sigs
+        # an equal signature proves IDENTICAL update bodies, not identical
+        # accumulated state: only metrics still at their registered defaults
+        # may merge declaratively (a late-added or pre-updated metric carries
+        # state the others never saw — it keeps the legacy value-equality
+        # path, which correctly refuses the merge)
+        fresh = {
+            name: self._metric_state_is_default(m) for name, m in self._modules.items()
+        }
+        merged: List[_ComputeGroup] = []
+        by_sig: Dict[tuple, _ComputeGroup] = {}
+        for group in self._groups.values():
+            sig = sigs.get(group.owner)
+            if sig is None or not fresh.get(group.owner, False):
+                merged.append(group)
+                continue
+            representative = by_sig.get(sig)
+            if representative is None:
+                by_sig[sig] = group
+                merged.append(group)
+            else:
+                representative.absorb(group)
+        self._groups = dict(enumerate(merged))
+        if self._groups and all(
+            sigs[name] is not None and fresh[name] for name in self._modules
+        ):
+            # every member declared its reduction and stands at defaults:
+            # discovery is DONE — the first step runs fused, and the one-time
+            # value-comparison host readback of the legacy pass never happens
+            self._groups_checked = True
+            self._materialize_group_views()
+
+    @staticmethod
+    def _metric_state_is_default(metric: Metric) -> bool:
+        """Pure host-side identity check: never updated, never synced, every
+        array state still IS its registered default (no device traffic)."""
+        if metric._update_count != 0 or metric._is_synced:
+            return False
+        for attr, default in metric._defaults.items():
+            value = getattr(metric, attr)
+            if isinstance(default, list) or isinstance(value, list):
+                if value:
+                    return False
+            elif value is not default:
+                return False
+        return True
 
     @property
     def compute_groups(self) -> Dict[int, List[str]]:

@@ -235,14 +235,47 @@ def _leaf_bytes(value: Any) -> Tuple[int, List[Tuple[int, int]]]:
     return total, buffers
 
 
+def _rider_values(metric: Any) -> list:
+    """Live rider buffers a metric holds beyond its registered states.
+
+    The sentinel bitmask, the quarantine counter, and the compensation
+    residual dict are real HBM the footprint must not under-report.
+    """
+    values = []
+    sentinel = getattr(metric, "_sentinel_flags", None)
+    if sentinel is not None:
+        values.append(sentinel)
+    quarantine = metric.__dict__.get("_quarantined_count")
+    if quarantine is not None:
+        values.append(quarantine)
+    residuals = metric.__dict__.get("_comp_residuals")
+    if residuals:
+        values.extend(residuals.values())
+    return values
+
+
 def state_footprint(obj: Any) -> Dict[str, Any]:
     """Live state-memory footprint of a Metric or MetricCollection.
 
     For a single metric: per-state and total bytes of the registered states
-    (list states sum their elements). For a collection: per-member nominal
-    bytes plus ``unique_bytes`` — the deduplicated total, counting each
-    underlying buffer once (compute-group view members SHARE their owner's
-    arrays, so nominal sums over-count what HBM actually holds).
+    (list states sum their elements) plus any live rider buffers (sentinel
+    bitmask, quarantine counter, compensation residuals). For a collection:
+    per-member nominal bytes plus ``unique_bytes`` — the deduplicated total,
+    counting each underlying buffer once (compute-group view members SHARE
+    their owner's arrays, so nominal sums over-count what HBM actually holds)
+    — and a ``groups`` section reporting each multi-member compute group's
+    canonical state EXACTLY ONCE (the CSE accounting: an N-member fused
+    family holds ~1/N of the unfused sum).
+
+    The walk is side-effect free: for a discovered compute group, view
+    members' REGISTERED states are read from the group OWNER (the canonical
+    buffers a view anchors to at its next materialization) instead of
+    mutating the collection by materializing views — a collection whose views
+    have not been re-anchored yet (construction-time CSE groups before the
+    first accessor, a donated drain that has not propagated) would otherwise
+    count every view's stale private buffers as unique. Rider buffers
+    (sentinel, quarantine counter, residuals) are genuinely per-member and
+    read from the member itself.
     """
     if hasattr(obj, "_defaults"):  # duck-typed Metric
         per_state = {}
@@ -251,22 +284,33 @@ def state_footprint(obj: Any) -> Dict[str, Any]:
             n, _ = _leaf_bytes(getattr(obj, attr))
             per_state[attr] = n
             total += n
-        sentinel = getattr(obj, "_sentinel_flags", None)
-        if sentinel is not None:
-            per_state["_sentinel_flags"] = int(getattr(sentinel, "nbytes", 0))
-            total += per_state["_sentinel_flags"]
+        for value in _rider_values(obj):
+            n, _ = _leaf_bytes(value)
+            # the sentinel key predates the rider split; keep its entry name
+            key = "_sentinel_flags" if value is getattr(obj, "_sentinel_flags", None) else "_riders"
+            per_state[key] = per_state.get(key, 0) + n
+            total += n
         return {"owner": type(obj).__name__, "total_bytes": total, "per_state": per_state}
     if hasattr(obj, "_modules"):  # duck-typed MetricCollection
+        owner_of: Dict[str, str] = {}
+        if getattr(obj, "_groups_checked", False):
+            for group in (getattr(obj, "_groups", None) or {}).values():
+                names = list(getattr(group, "names", ()))
+                for view_name in names[1:]:
+                    owner_of[view_name] = names[0]
         per_metric = {}
         seen: set = set()
         unique = 0
         nominal = 0
+        member_unique: Dict[str, int] = {}
         for name, metric in obj._modules.items():
             m_total = 0
-            values = [getattr(metric, attr) for attr in metric._defaults]
-            sentinel = getattr(metric, "_sentinel_flags", None)
-            if sentinel is not None:
-                values.append(sentinel)
+            m_unique = 0
+            # a view member's registered states are (or will anchor to) the
+            # owner's canonical buffers — read those, mutate nothing
+            source = obj._modules.get(owner_of.get(name, name), metric)
+            values = [getattr(source, attr) for attr in source._defaults]
+            values.extend(_rider_values(metric))
             for value in values:
                 total, buffers = _leaf_bytes(value)
                 m_total += total
@@ -275,13 +319,33 @@ def state_footprint(obj: Any) -> Dict[str, Any]:
                     if buf_id not in seen:
                         seen.add(buf_id)
                         unique += nbytes
+                        m_unique += nbytes
             per_metric[name] = m_total
+            member_unique[name] = m_unique
             nominal += m_total
-        return {
+        groups = []
+        for group in (getattr(obj, "_groups", None) or {}).values():
+            names = list(getattr(group, "names", ()))
+            if len(names) < 2:
+                continue
+            # the group's unique bytes across ALL members: the canonical
+            # state counted exactly once however many views share it (and
+            # whichever member happened to walk first and claim the buffers)
+            groups.append(
+                {
+                    "owner": group.owner,
+                    "members": len(names),
+                    "canonical_bytes": sum(member_unique.get(n, 0) for n in names),
+                }
+            )
+        out = {
             "owner": type(obj).__name__,
             "total_bytes": nominal,
             "unique_bytes": unique,
             "shared_bytes": nominal - unique,
             "per_metric": per_metric,
         }
+        if groups:
+            out["groups"] = groups
+        return out
     raise TypeError(f"state_footprint expects a Metric or MetricCollection, got {type(obj).__name__}")

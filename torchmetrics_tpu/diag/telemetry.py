@@ -66,6 +66,7 @@ _COUNTER_HELP = {
     "compute_dispatches": "cached compute dispatches",
     "compute_cache_hits": "compute dispatches served without a re-trace",
     "profile_probes": "warm dispatches followed by a sampled completion probe",
+    "spec_fallbacks": "state roles resolved via the deprecated string-prefix/attribute conventions",
 }
 
 # exposition-convention names for counters whose field name buries the unit:

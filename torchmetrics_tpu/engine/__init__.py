@@ -66,6 +66,12 @@ from torchmetrics_tpu.engine.numerics import (
     set_drift_rtol,
 )
 from torchmetrics_tpu.engine.scan import scan_context, set_scan_steps
+from torchmetrics_tpu.engine.statespec import (
+    StateSpec,
+    cse_context,
+    register_state_spec,
+    set_cse,
+)
 from torchmetrics_tpu.engine.stats import EngineStats, engine_report, reset_engine_stats
 from torchmetrics_tpu.engine.txn import (
     QuarantinedBatchError,
@@ -81,15 +87,19 @@ __all__ = [
     "EpochEngine",
     "FusedUpdate",
     "QuarantinedBatchError",
+    "StateSpec",
     "compensated_context",
+    "cse_context",
     "engine_context",
     "engine_enabled",
     "engine_report",
     "quarantine_context",
     "quarantine_report",
+    "register_state_spec",
     "reset_engine_stats",
     "scan_context",
     "set_compensated",
+    "set_cse",
     "set_drift_rtol",
     "set_engine_enabled",
     "set_quarantine_mode",

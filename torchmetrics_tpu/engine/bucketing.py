@@ -29,7 +29,6 @@ from typing import Any, Optional, Sequence, Tuple
 import numpy as np
 
 from torchmetrics_tpu.engine import config
-from torchmetrics_tpu.utilities.data import dim_zero_sum
 
 
 def next_bucket(n: int, min_bucket: Optional[int] = None) -> int:
@@ -47,11 +46,24 @@ def next_bucket(n: int, min_bucket: Optional[int] = None) -> int:
 
 
 def bucket_eligible(metric: Any) -> bool:
-    """Whether ``metric`` supports the pad-subtract identity."""
-    if not getattr(metric, "_engine_row_additive", False):
-        return False
+    """Whether ``metric`` supports the pad-subtract identity.
+
+    Resolved from the registered :class:`~torchmetrics_tpu.engine.statespec.
+    StateSpec`s: every state must declare ``row_additive`` (stamped from the
+    class's ``_engine_row_additive`` opt-in at registration) and a ``sum``
+    fold. Metrics without a registry (out-of-tree, hand-rolled ``_defaults``)
+    resolve through the counted deprecated-attribute fallback.
+    """
     reductions = getattr(metric, "_reductions", {})
-    return bool(reductions) and all(fn is dim_zero_sum for fn in reductions.values())
+    if not reductions:
+        return False
+    from torchmetrics_tpu.engine import statespec as _statespec
+
+    return all(
+        (sp := _statespec.spec_of(metric, attr, consumer="bucketing")).row_additive
+        and sp.fold == "sum"
+        for attr in reductions
+    )
 
 
 def batch_size(args: Sequence[Any]) -> Optional[int]:

@@ -36,6 +36,7 @@ from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine import numerics as _numerics
+from torchmetrics_tpu.engine import statespec as _statespec
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
 
@@ -318,15 +319,13 @@ def make_step_body(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=No
             unit = run(zeros, unit_flat)
 
             def subtract(path, o, u):
-                # the sentinel bitmask, the quarantine counter, and the
-                # compensation residual are not row-additive: pad rows cannot
-                # raise health flags, poison a batch, or carry rounding error
-                # (they are zeros), so the riders pass through the
-                # pad-subtract identity untouched
+                # the rider roles (sentinel bitmask, quarantine counter,
+                # compensation residual — statespec.PAD_EXEMPT_KEYS) are not
+                # row-additive: pad rows cannot raise health flags, poison a
+                # batch, or carry rounding error (they are zeros), so the
+                # riders pass through the pad-subtract identity untouched
                 if any(
-                    getattr(p, "key", None)
-                    in (_sentinel.STATE_KEY, _txn.STATE_KEY, _numerics.STATE_KEY)
-                    for p in path
+                    getattr(p, "key", None) in _statespec.PAD_EXEMPT_KEYS for p in path
                 ):
                     return o
                 return o - u * n_pad.astype(o.dtype)

@@ -70,7 +70,6 @@ import numpy as np
 from torchmetrics_tpu.diag import hist as _hist
 from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import trace as _diag
-from torchmetrics_tpu.utilities.data import dim_zero_mean, dim_zero_sum
 
 __all__ = [
     "ATTR",
@@ -246,28 +245,30 @@ def anchored_value(value: Any, residual: Any) -> Any:
 def comp_state_names(metric: Any) -> Tuple[str, ...]:
     """The states of ``metric`` the compensated two-sum applies to.
 
-    Eligibility is a pure function of the metric DEFINITION (class flags,
-    registered defaults) — never of live values — so every rank of a world
-    resolves the same set and the packed buffer layout stays symmetric:
+    Eligibility is a pure function of the metric DEFINITION (registered
+    :class:`~torchmetrics_tpu.engine.statespec.StateSpec` roles, registered
+    defaults) — never of live values — so every rank of a world resolves the
+    same set and the packed buffer layout stays symmetric:
 
-    - the metric declares additivity-in-state (``_engine_state_additive`` on
-      the scalar aggregators, or the bucketing family's
-      ``_engine_row_additive``) — the zero-state trick that recovers the pure
-      batch contribution is only exact for ``new = old + g(batch)`` updates;
-    - the state's ``dist_reduce_fx`` is ``sum`` or ``mean``;
+    - the state's spec declares additivity (``state_additive`` on the scalar
+      aggregators, or the bucketing family's ``row_additive``, both stamped
+      from the class declaration at ``add_state`` time) — the zero-state trick
+      that recovers the pure batch contribution is only exact for
+      ``new = old + g(batch)`` updates;
+    - the spec's fold is ``sum`` or ``mean``;
     - the registered default is a float array (integer counts widen via
       :func:`count_dtype` instead; there is no residual to track exactly).
     """
-    if not (
-        getattr(metric, "_engine_state_additive", False)
-        or getattr(metric, "_engine_row_additive", False)
-    ):
-        return ()
     import jax.numpy as jnp
 
+    from torchmetrics_tpu.engine import statespec as _statespec
+
     names = []
-    for attr, red in getattr(metric, "_reductions", {}).items():
-        if red not in (dim_zero_sum, dim_zero_mean):
+    for attr in getattr(metric, "_reductions", {}):
+        spec = _statespec.spec_of(metric, attr, consumer="compensation")
+        if spec.fold not in ("sum", "mean"):
+            continue
+        if not (spec.state_additive or spec.row_additive):
             continue
         default = metric._defaults[attr]
         if isinstance(default, list):

@@ -1,0 +1,439 @@
+"""First-class declarative state specifications — the single source of role truth.
+
+Role knowledge used to be smeared across string prefixes and attribute
+conventions: ``parallel/packing.py`` re-derived fold kinds from
+``dist_reduce_fx`` identities and read ``_hh_fold_info`` for the heavy-hitter
+pair, ``engine/numerics.py`` gated compensation on ``_engine_state_additive``/
+``_engine_row_additive`` class flags, ``engine/bucketing.py`` re-checked the
+same flags, the pad-subtract identity matched reserved pytree key strings, the
+divergence audit read ``_rank_invariant_states``, and ``serve/`` invented
+``hh-ids``/``hh-counts``/ring-clock roles no other layer could see. Five
+subsystems each re-parsing conventions is exactly the surface a sharding layer
+(ROADMAP item 1) cannot be built on.
+
+This module makes the role a first-class, declarative :class:`StateSpec`,
+registered at ``Metric.add_state`` time and consumed by every engine:
+
+- **fold semantics** (``sum``/``mean``/``max``/``min``/``cat``/``none``/
+  ``custom``) — what the packed sync, ``merge_state``, and the reshard split
+  algebra do with the state;
+- **role** — plain ``state``, or one of the structured roles: the
+  heavy-hitter ``hh-grid``/``hh-ids``/``hh-counts`` joint fold
+  (``serve/sketch.py``), the max-reduced ``ring-clock`` (``serve/window.py``),
+  and the reserved rider roles (``sentinel``/``quarantine``/
+  ``comp-residual``) that ride compiled-step pytrees under
+  :data:`RIDER_KEYS`;
+- **dtype policy** — ``"count"`` marks states under the PR-8
+  ``count_dtype()`` widening contract (int64 under x64, resolved at creation);
+- **additivity** — ``row_additive`` (the pad-subtract identity holds per
+  batch row; bucketing eligibility) and ``state_additive``
+  (``new = old + g(batch)``; compensation eligibility);
+- **pad exemption** — rider states the bucketing pad-subtract must pass
+  through untouched;
+- **rank invariance** — values must be identical on every rank (the packed
+  sync's divergence audit fingerprints these);
+- **shard rule** — the landing pad for the SPMD sharded-state engine
+  (ROADMAP item 1): a named rule resolving to a partition spec. The default
+  ``"replicate"`` is a documented no-op — every state is replicated per-rank
+  today, and :func:`resolve_shard_rule` returns ``None`` (no partitioning)
+  until the pjit layer lands. Registering the slot NOW means the sharding
+  layer consumes specs instead of inventing a sixth convention.
+
+Consumers resolve specs through :func:`spec_of`. Metrics that registered
+their states through ``add_state`` always hit the registry; anything else
+(out-of-tree metrics hand-rolling ``_defaults``/``_reductions``, pre-spec
+pickles) falls back to a DERIVED spec built from the deprecated attribute
+conventions — counted once per (metric, state) in
+``EngineStats.spec_fallbacks``, recorded as a ``spec.fallback`` flight-
+recorder event, and exported as ``tm_tpu_spec_fallbacks_total`` so migrating
+out-of-tree metrics are discoverable from a scrape. The in-tree suite runs at
+zero fallbacks.
+
+On top of the registry sits **cross-metric common-subexpression fusion**
+(CSE): metrics whose *state-producing reduction* is provably identical — the
+stat-scores family's TP/FP/TN/FN update with matching task/num_classes/
+``top_k``/``ignore_index`` knobs, confusion matrices with matching shape knobs
+— declare a :func:`reduction_signature`, and ``MetricCollection`` merges them
+into one compute group AT CONSTRUCTION TIME: the shared reduction traces
+once, N metrics derive their computes from one canonical donated state
+(``collections.py``). ``TORCHMETRICS_TPU_CSE=0`` opts out (falls back to the
+legacy first-step value-equality discovery); unrecognized values fail loud
+per the PR-7 env contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, Optional, Tuple
+
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.engine.stats import EngineStats
+from torchmetrics_tpu.utilities.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+
+__all__ = [
+    "CSE_ENV_VAR",
+    "COMPENSATION_KEY",
+    "PAD_EXEMPT_KEYS",
+    "QUARANTINE_KEY",
+    "RIDER_KEYS",
+    "SENTINEL_KEY",
+    "SHARD_RULES",
+    "StateSpec",
+    "cse_context",
+    "cse_enabled",
+    "fold_name",
+    "reduction_signature",
+    "register_state_spec",
+    "resolve_shard_rule",
+    "set_cse",
+    "spec_fallback_count",
+    "spec_of",
+    "specs_of",
+]
+
+CSE_ENV_VAR = "TORCHMETRICS_TPU_CSE"
+
+#: the reserved pytree keys rider roles ride under inside compiled steps.
+#: These are the canonical definitions; ``diag/sentinel.py``,
+#: ``engine/txn.py`` and ``engine/numerics.py`` keep their local ``STATE_KEY``
+#: aliases for their own machinery and a test pins the two in lockstep.
+SENTINEL_KEY = "__sentinel__"
+QUARANTINE_KEY = "__quarantine__"
+COMPENSATION_KEY = "__compensation__"
+
+#: every rider key — the transactional rollback and the scan carry treat these
+#: as non-state leaves with role-specific handling
+RIDER_KEYS = frozenset({SENTINEL_KEY, QUARANTINE_KEY, COMPENSATION_KEY})
+
+#: rider keys the bucketing pad-subtract identity must pass through untouched:
+#: pad rows cannot raise health flags, poison a batch, or carry rounding error
+PAD_EXEMPT_KEYS = RIDER_KEYS
+
+#: named shard rules — the SPMD landing pad (ROADMAP item 1). ``replicate`` is
+#: the documented no-op default: state lives whole on every rank and
+#: :func:`resolve_shard_rule` yields ``None`` (no partitioning). The sharding
+#: layer will register real rules ("class-axis", "row-chunk", …) here and
+#: resolve them to ``PartitionSpec``s; every spec already carries the slot.
+SHARD_RULES: Dict[str, Callable[["StateSpec", Any], Optional[Any]]] = {
+    "replicate": lambda spec, value=None: None,
+}
+
+_FOLD_BY_FN = {
+    dim_zero_sum: "sum",
+    dim_zero_mean: "mean",
+    dim_zero_max: "max",
+    dim_zero_min: "min",
+    dim_zero_cat: "cat",
+}
+
+#: the attribute the per-metric spec registry lives under
+REGISTRY_ATTR = "_state_specs"
+
+# module-level stats block: spec fallbacks are a process-wide migration
+# signal, not a per-engine property — one EngineStats joins the weak registry
+# so engine_report()/telemetry aggregate it like any other counter (the module
+# global keeps it alive; the registry only holds it weakly)
+_STATS = EngineStats("statespec")
+
+_cse_override: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class StateSpec:
+    """Declarative specification of one registered metric state.
+
+    Immutable and picklable (``fold_fn`` custom folds must be module-level
+    callables, which ``dist_reduce_fx`` already required for checkpointing).
+
+    Attributes:
+        name: the state attribute name.
+        fold: cross-rank / cross-shard fold semantic — ``"sum"``, ``"mean"``,
+            ``"max"``, ``"min"``, ``"cat"``, ``"none"`` (raw stack), or
+            ``"custom"`` (``fold_fn`` applies).
+        fold_fn: the callable for ``fold == "custom"``.
+        role: ``"state"`` for plain states, or a structured role:
+            ``"hh-grid"``/``"hh-ids"``/``"hh-counts"`` (the joint heavy-hitter
+            fold — ``hh`` carries ``(grid_attr, k, depth, width)`` on the ids
+            spec), ``"ring-clock"`` (max-reduced window cursor). The rider
+            roles (``"sentinel"``/``"quarantine"``/``"comp-residual"``) are
+            reserved for the pytree riders and never registered via
+            ``add_state``.
+        dtype_policy: ``"default"``, or ``"count"`` for states under the
+            ``count_dtype()`` widening contract (int64 under x64, resolved at
+            creation — PR 8).
+        row_additive: the pad-subtract identity holds per batch row
+            (``engine/bucketing.py`` eligibility; derived from the metric's
+            ``_engine_row_additive`` declaration at registration).
+        state_additive: ``new = old + g(batch)`` — the zero-state trick of the
+            compensated two-sum is exact (``engine/numerics.py`` eligibility).
+        pad_exempt: the bucketing pad-subtract passes this leaf through
+            untouched (rider semantics).
+        rank_invariant: values must be identical on every rank; the packed
+            sync's divergence audit fingerprints these.
+        hh: ``hh-ids`` only — ``(grid_attr, k, depth, width)`` tying the top-k
+            pair to its count-min grid for the joint packed fold.
+        shard_rule: named entry in :data:`SHARD_RULES`. ``"replicate"`` (the
+            default) is the documented no-op: no partitioning until the SPMD
+            layer (ROADMAP item 1) lands.
+    """
+
+    name: str
+    fold: str = "none"
+    fold_fn: Optional[Callable] = None
+    role: str = "state"
+    dtype_policy: str = "default"
+    row_additive: bool = False
+    state_additive: bool = False
+    pad_exempt: bool = False
+    rank_invariant: bool = False
+    hh: Optional[Tuple[str, int, int, int]] = None
+    shard_rule: str = "replicate"
+
+
+def fold_name(dist_reduce_fx: Any) -> Tuple[str, Optional[Callable]]:
+    """Canonical ``(fold, fold_fn)`` for a resolved ``dist_reduce_fx`` value."""
+    name = _FOLD_BY_FN.get(dist_reduce_fx)
+    if name is not None:
+        return name, None
+    if dist_reduce_fx is None:
+        return "none", None
+    if callable(dist_reduce_fx):
+        return "custom", dist_reduce_fx
+    raise ValueError(f"unresolvable dist_reduce_fx {dist_reduce_fx!r}")
+
+
+def resolve_shard_rule(spec: StateSpec, value: Any = None) -> Optional[Any]:
+    """Resolve a spec's shard rule to a partition spec (``None`` = replicate).
+
+    The no-op default: every in-tree rule currently resolves to ``None`` —
+    state is replicated per-rank, exactly today's semantics. The SPMD engine
+    (ROADMAP item 1) swaps real rules into :data:`SHARD_RULES` without
+    touching any consumer.
+    """
+    try:
+        rule = SHARD_RULES[spec.shard_rule]
+    except KeyError:
+        raise ValueError(
+            f"state {spec.name!r} names unknown shard rule {spec.shard_rule!r}"
+            f" (registered: {sorted(SHARD_RULES)})"
+        ) from None
+    return rule(spec, value)
+
+
+# ------------------------------------------------------------------ registry
+
+
+def build_spec(
+    metric: Any,
+    name: str,
+    dist_reduce_fx: Any,
+    overrides: Optional[Any] = None,
+) -> StateSpec:
+    """The spec ``add_state`` registers: derived defaults + explicit overrides.
+
+    Derivation reads the metric's class-level declarations ONCE, at
+    registration — ``_engine_row_additive``/``_engine_state_additive`` for the
+    additivity flags and ``_rank_invariant_states`` for audit membership — so
+    the registered spec is a pure function of the metric definition (the
+    packed-sync layout-symmetry rule). ``overrides`` is a ready
+    :class:`StateSpec` or a dict of field overrides (the ``serve/`` roles).
+    """
+    if isinstance(overrides, StateSpec):
+        return dataclasses.replace(overrides, name=name)
+    fold, fold_fn = fold_name(dist_reduce_fx)
+    fields: Dict[str, Any] = {
+        "name": name,
+        "fold": fold,
+        "fold_fn": fold_fn,
+        "row_additive": bool(getattr(metric, "_engine_row_additive", False)),
+        "state_additive": bool(getattr(metric, "_engine_state_additive", False)),
+        "rank_invariant": name in (getattr(metric, "_rank_invariant_states", ()) or ()),
+    }
+    if overrides:
+        unknown = set(overrides) - {f.name for f in dataclasses.fields(StateSpec)}
+        if unknown:
+            raise ValueError(f"unknown StateSpec field(s) for state {name!r}: {sorted(unknown)}")
+        if "name" in overrides and overrides["name"] != name:
+            # a renamed spec would register under the wrong key: spec_of would
+            # miss, silently drop the declared role, and count a spurious
+            # fallback — the spec's name IS the state's name, always
+            raise ValueError(
+                f"StateSpec override for state {name!r} must not rename it"
+                f" (got name={overrides['name']!r})"
+            )
+        fields.update(overrides)
+        fields["name"] = name
+    return StateSpec(**fields)
+
+
+def register_state_spec(metric: Any, spec: StateSpec) -> StateSpec:
+    """Install ``spec`` in the metric's registry (``add_state`` calls this)."""
+    registry = metric.__dict__.get(REGISTRY_ATTR)
+    if registry is None:
+        registry = {}
+        object.__setattr__(metric, REGISTRY_ATTR, registry)
+    registry[spec.name] = spec
+    return spec
+
+
+def _derive_legacy(metric: Any, name: str) -> StateSpec:
+    """Spec derivation from the deprecated attribute/prefix conventions.
+
+    The counted fallback path: out-of-tree metrics that hand-roll
+    ``_defaults``/``_reductions`` (or pre-spec pickles) resolve here until
+    they migrate to ``add_state``/``register_state_spec``. Mirrors exactly
+    what the consumers used to re-derive for themselves — including the
+    ``_hh_fold_info`` heavy-hitter declaration.
+    """
+    red = getattr(metric, "_reductions", {}).get(name)
+    spec = build_spec(metric, name, red)
+    hh_info = getattr(metric, "_hh_fold_info", None)
+    if hh_info is not None:
+        if name == hh_info.get("cms"):
+            spec = dataclasses.replace(spec, role="hh-grid")
+        elif name == hh_info.get("ids"):
+            spec = dataclasses.replace(
+                spec,
+                role="hh-ids",
+                hh=(
+                    hh_info["cms"], int(hh_info["k"]),
+                    int(hh_info["depth"]), int(hh_info["width"]),
+                ),
+            )
+        elif name == hh_info.get("counts"):
+            spec = dataclasses.replace(spec, role="hh-counts")
+    return spec
+
+
+def spec_of(metric: Any, name: str, consumer: str = "") -> StateSpec:
+    """The registered :class:`StateSpec` for ``metric.<name>``.
+
+    Registry miss = the deprecated fallback: the spec is derived from the
+    legacy attribute conventions, CACHED back into the registry (so the
+    derivation and its telemetry fire once per (metric, state), never per
+    step), counted in ``EngineStats.spec_fallbacks``, and recorded as a
+    ``spec.fallback`` event naming the consumer that had to fall back.
+    """
+    registry = metric.__dict__.get(REGISTRY_ATTR)
+    if registry is not None:
+        spec = registry.get(name)
+        if spec is not None:
+            return spec
+    spec = _derive_legacy(metric, name)
+    register_state_spec(metric, spec)
+    _STATS.spec_fallbacks += 1
+    _diag.record(
+        "spec.fallback", type(metric).__name__, state=name, consumer=consumer,
+        role=spec.role, fold=spec.fold,
+    )
+    return spec
+
+
+def specs_of(metric: Any, consumer: str = "") -> Dict[str, StateSpec]:
+    """Every registered state's spec, in ``_reductions`` registration order."""
+    return {
+        name: spec_of(metric, name, consumer)
+        for name in getattr(metric, "_reductions", {})
+    }
+
+
+def spec_fallback_count() -> int:
+    """Process-wide count of deprecated-convention spec derivations."""
+    return _STATS.spec_fallbacks
+
+
+# ------------------------------------------------------------------ CSE policy
+
+
+def cse_enabled() -> bool:
+    """Whether signature-based cross-metric fusion drives group discovery.
+
+    ``TORCHMETRICS_TPU_CSE=0|off`` reverts ``MetricCollection`` to the legacy
+    first-step value-equality discovery; unrecognized values fail loud (the
+    PR-7 env contract — a typo must not silently change fusion semantics).
+    """
+    if _cse_override is not None:
+        return _cse_override
+    raw = os.environ.get(CSE_ENV_VAR, "").strip().lower()
+    if raw in ("", "1", "on"):
+        return True
+    if raw in ("0", "off"):
+        return False
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    raise TorchMetricsUserError(
+        f"{CSE_ENV_VAR} must be '0'/'off' or '1'/'on' (got {raw!r})"
+    )
+
+
+def set_cse(value: Optional[bool]) -> None:
+    """Force CSE discovery on/off process-wide; ``None`` restores env/default."""
+    global _cse_override
+    _cse_override = value
+
+
+@contextmanager
+def cse_context(enabled: bool = True) -> Generator[None, None, None]:
+    """Scoped CSE enablement (tests, benches). Affects GROUP DISCOVERY, which
+    runs at collection construction / first step — toggling does not regroup
+    an already-discovered collection."""
+    global _cse_override
+    prev = _cse_override
+    _cse_override = enabled
+    try:
+        yield
+    finally:
+        _cse_override = prev
+
+
+def update_family(metric: Any) -> Tuple[str, str]:
+    """Identity of a metric's state-producing update body for CSE signatures.
+
+    Keyed on the CLASS'S actual ``update`` function (module + qualname): the
+    derivative metrics that inherit a task base's update verbatim — accuracy,
+    precision, recall, F-beta, specificity, hamming over stat-scores; kappa,
+    jaccard, matthews over confusion matrices — share a family, while any
+    subclass that overrides ``update`` breaks signature equality
+    automatically, with no declaration to forget. The ONE keying rule for
+    every declaring family (stat-scores and confusion-matrix bases both
+    delegate here).
+    """
+    fn = type(metric).update
+    return (fn.__module__, fn.__qualname__)
+
+
+def reduction_signature(metric: Any) -> Optional[Tuple]:
+    """The metric's state-producing-reduction signature, or ``None``.
+
+    Two metrics with EQUAL signatures are guaranteed (by the declaring class)
+    to run byte-identical ``update`` bodies onto identically-shaped,
+    identically-named states — the proof obligation the legacy discovery
+    established empirically by running one eager step per member and
+    value-comparing states on the host. A signature is a pure function of the
+    metric definition (class + constructor knobs that reach the update), so
+    discovery happens at collection CONSTRUCTION: the first step is already
+    fused, and two metrics whose knobs differ can never be merged by a
+    first-batch value coincidence (e.g. differing ``ignore_index`` with no
+    ignored labels in batch 1 — a latent mis-merge of the value-based path).
+
+    ``None`` (the base default) means "no declaration": the metric falls back
+    to the legacy value-equality discovery.
+    """
+    fn = getattr(metric, "_cse_signature", None)
+    if fn is None:
+        return None
+    sig = fn()
+    if sig is None:
+        return None
+    # the class vouches for update-body identity; the registered state layout
+    # (names in order) joins the key so a subclass that adds a state can never
+    # silently collide with its parent's signature
+    return (*sig, tuple(getattr(metric, "_reductions", {})))

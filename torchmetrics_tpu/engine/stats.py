@@ -58,6 +58,8 @@ _COUNTER_FIELDS = (
     "compute_cache_hits",  # compute dispatches served without a re-trace
     # --- profiling layer (diag/profile.py): sampled completion probes ---
     "profile_probes",  # warm dispatches followed by a sanctioned block_until_ready probe
+    # --- state-spec registry (engine/statespec.py): deprecation telemetry ---
+    "spec_fallbacks",  # roles resolved via the deprecated string-prefix/attribute conventions
 )
 
 

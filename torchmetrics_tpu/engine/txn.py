@@ -252,10 +252,18 @@ def transact(metric: Any, old: Dict[str, Any], new: Dict[str, Any], poisoned: An
 
     from torchmetrics_tpu.diag import sentinel as _sentinel
 
+    from torchmetrics_tpu.engine import statespec as _statespec
+
     out: Dict[str, Any] = {}
     selected: Dict[str, Any] = {}
+    # rollback selection over every STATE leaf: rider roles with their own
+    # fold-forward semantics (the quarantine counter increments, the sentinel
+    # folds over the selected states below) are exempt; the compensation
+    # residual is NOT — it rolls back leaf-wise with its value so a
+    # quarantined batch leaves (value, residual) pairs bit-exact
+    rollback_exempt = _statespec.RIDER_KEYS - {_statespec.COMPENSATION_KEY}
     for k, v in new.items():
-        if k in (STATE_KEY, _sentinel.STATE_KEY):
+        if k in rollback_exempt:
             continue
         sel = jax.tree_util.tree_map(lambda o, n: jnp.where(poisoned, o, n), old[k], v)
         out[k] = sel

@@ -149,6 +149,10 @@ class Metric:
         self._defaults: Dict[str, Union[List, Array]] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        # first-class role registry (engine/statespec.py): one StateSpec per
+        # registered state, installed by add_state — every engine consumes
+        # these instead of re-deriving roles from attribute conventions
+        self._state_specs: Dict[str, Any] = {}
 
         self._update_signature = inspect.signature(self.update)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -196,12 +200,22 @@ class Metric:
         default: Union[list, Array, float, int],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        spec: Optional[Any] = None,
     ) -> None:
         """Register a metric state variable (reference ``metric.py:181-247``).
 
         ``default`` must be an array (any shape) or an empty list (for "cat"-style
         unbounded states). ``dist_reduce_fx`` ∈ {"sum","mean","cat","max","min", None,
         callable} selects how the state folds across chips and across ``forward`` steps.
+
+        ``spec`` declares the state's first-class role
+        (:class:`~torchmetrics_tpu.engine.statespec.StateSpec`, or a dict of
+        field overrides — e.g. ``{"role": "hh-ids", ...}`` for the
+        heavy-hitter pair, ``{"dtype_policy": "count"}`` for counters under
+        the ``count_dtype()`` widening contract). Omitted, the spec derives
+        from ``dist_reduce_fx`` plus the metric's class-level declarations;
+        every engine resolves roles from the registered spec instead of
+        re-parsing attribute conventions.
         """
         if not isinstance(default, list) or default:
             if isinstance(default, (int, float)):
@@ -229,6 +243,19 @@ class Metric:
         self._defaults[name] = default  # arrays are immutable → no defensive copy needed
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        from torchmetrics_tpu.engine import statespec as _statespec
+
+        _statespec.register_state_spec(
+            self, _statespec.build_spec(self, name, dist_reduce_fx, spec)
+        )
+
+    def state_specs(self) -> Dict[str, Any]:
+        """Every registered state's :class:`~torchmetrics_tpu.engine.statespec.
+        StateSpec`, in registration order (missing entries derive from the
+        deprecated attribute conventions, counted as ``spec_fallbacks``)."""
+        from torchmetrics_tpu.engine import statespec as _statespec
+
+        return _statespec.specs_of(self, consumer="state_specs")
 
     # ------------------------------------------------------------------ forward
 
@@ -1185,6 +1212,8 @@ class Metric:
         self.__dict__.setdefault("_none_folded", set())
         self.__dict__.setdefault("compiled_update", None)
         self.__dict__.setdefault("scan_steps", None)
+        # pre-spec pickles: roles re-derive lazily (counted spec_fallbacks)
+        self.__dict__.setdefault("_state_specs", {})
         self._engine = None  # executables are per-process/per-instance; rebuilt lazily
         self._epoch = None
         self._update_signature = inspect.signature(self.update)

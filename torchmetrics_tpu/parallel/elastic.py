@@ -436,8 +436,28 @@ def restore_resharded(
 
     if _is_metric(metric):
         _reshard_metric(metric, shard_flats, rank, world_size)
+    elif getattr(metric, "_groups_checked", False) and getattr(metric, "_groups", None):
+        # compute-group'd collection (incl. construction-time CSE groups,
+        # engine/statespec.py): fold + split each CANONICAL owner exactly
+        # once, then re-anchor the view members onto the restored owners —
+        # restoring every view independently would re-run the fold N times
+        # per group and (for sum states) hand every view its own rank-0 copy
+        # until the next materialization overwrote it
+        grouped: set = set()
+        for group in metric._groups.values():
+            grouped.update(group.names)
+            _reshard_metric(
+                metric._modules[group.owner], shard_flats, rank, world_size,
+                prefix=f"{group.owner}.",
+            )
+        # an explicit compute_groups list may not cover every member
+        for name, member in metric._modules.items():
+            if name not in grouped:
+                _reshard_metric(member, shard_flats, rank, world_size, prefix=f"{name}.")
+        metric._state_is_copy = False
+        metric._materialize_group_views()
     else:
-        # MetricCollection: every member reshards independently under its prefix
+        # ungrouped collection: every member reshards independently under its prefix
         for name, member in metric.items(keep_base=True, copy_state=False):
             _reshard_metric(member, shard_flats, rank, world_size, prefix=f"{name}.")
     _diag.record(

@@ -46,23 +46,10 @@ from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import timeline as _timeline
 
-from torchmetrics_tpu.utilities.data import (
-    dim_zero_cat,
-    dim_zero_max,
-    dim_zero_mean,
-    dim_zero_min,
-    dim_zero_sum,
-)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 __all__ = ["PackedSyncPlan", "PackingError", "all_gather_backbone"]
-
-_KIND_BY_FN = {
-    dim_zero_sum: "sum",
-    dim_zero_mean: "mean",
-    dim_zero_max: "max",
-    dim_zero_min: "min",
-}
 
 # metadata entry tags (first int of nothing — entries are positional, tags are
 # implicit in the spec order; kept here as documentation of the 2-int layout)
@@ -134,7 +121,7 @@ class _Spec:
     __slots__ = (
         "owner", "attr", "kind", "fold_fn", "dtype", "shape", "elem_shapes",
         "group", "offset", "size", "world_dim0", "pad_to", "needs_meta",
-        "was_list", "packed_value", "hh_meta",
+        "was_list", "packed_value", "hh_meta", "rank_invariant",
     )
 
     def __init__(self, owner: str, attr: str, kind: str, dtype: str, fold_fn: Optional[Callable] = None):
@@ -143,6 +130,7 @@ class _Spec:
         self.kind = kind  # sum | mean | max | min | none-array | custom | cat | none-list
         self.fold_fn = fold_fn  # custom callable folds only
         self.hh_meta: Optional[Tuple] = None  # hh-ids only: (cms attr, k, depth, width)
+        self.rank_invariant = False  # audit: values must match on every rank
         self.dtype = dtype
         self.shape: Tuple[int, ...] = ()
         self.elem_shapes: Tuple[Tuple[int, ...], ...] = ()  # none-list only
@@ -223,9 +211,17 @@ class PackedSyncPlan:
         # import graph (engine/epoch.py imports this module at top level), so a
         # module-level engine import here would be a cycle
         from torchmetrics_tpu.engine import numerics as _numerics
+        from torchmetrics_tpu.engine import statespec as _statespec
         from torchmetrics_tpu.engine import txn as _txn
 
         for owner, metric in self._metrics:
+            # every packed-sync role resolves from the metric's registered
+            # StateSpecs (engine/statespec.py) — fold semantics, the
+            # heavy-hitter grid/ids/counts joint roles, rank invariance for
+            # the audit. Metrics without a registry entry resolve through the
+            # deprecated attribute-convention fallback, counted once per
+            # (metric, state) in EngineStats.spec_fallbacks.
+            sspecs = _statespec.specs_of(metric, consumer="packed-sync")
             # compensated accumulation (engine/numerics.py): membership is a
             # function of the ENABLEMENT KNOB + the metric definition alone —
             # never of live values — so enablement must match on every rank or
@@ -236,67 +232,82 @@ class PackedSyncPlan:
             )
             if comp_names:
                 _numerics.ensure_residuals(metric)
-            # heavy-hitter sketch (serve/sketch.py): the metric DEFINITION
+            # heavy-hitter roles (serve/sketch.py): the metric DEFINITION
             # declares a (ids, counts) pair that must fold JOINTLY against the
             # merged count-min grid — a dedicated packed role, not a per-state
             # reduction. Membership is a function of the definition alone (the
-            # attrs always exist), so rank layouts cannot desynchronize.
-            hh_info = getattr(metric, "_hh_fold_info", None)
-            if hh_info is not None:
-                names = list(metric._reductions)
+            # specs always exist), so rank layouts cannot desynchronize.
+            names = list(metric._reductions)
+            hh_ids_attr = next((n for n, sp in sspecs.items() if sp.role == "hh-ids"), None)
+            counts_attr = next((n for n, sp in sspecs.items() if sp.role == "hh-counts"), None)
+            if hh_ids_attr is not None:
+                hh = sspecs[hh_ids_attr].hh
+                grid_attr = hh[0] if hh else None
                 if (
-                    hh_info["cms"] not in names
-                    or hh_info["ids"] not in names
-                    or hh_info["counts"] not in names
-                    or names.index(hh_info["cms"]) > names.index(hh_info["ids"])
-                    or names.index(hh_info["counts"]) != names.index(hh_info["ids"]) + 1
+                    hh is None
+                    or grid_attr not in names
+                    or counts_attr is None
+                    or names.index(grid_attr) > names.index(hh_ids_attr)
+                    or names.index(counts_attr) != names.index(hh_ids_attr) + 1
                 ):
                     raise PackingError(
                         "heavy-hitter fold requires the count-min grid registered before"
                         " the adjacent (ids, counts) top-k pair"
                     )
+            elif counts_attr is not None:
+                # an orphan hh-counts spec would be SKIPPED by the fold (it is
+                # written with its paired ids) — silently keeping its local
+                # per-rank value would desynchronize ranks; fail loud instead
+                raise PackingError(
+                    f"state {counts_attr!r} declares role 'hh-counts' with no paired"
+                    " 'hh-ids' state — the heavy-hitter pair folds jointly"
+                )
+            elif getattr(metric, "_hh_fold_info", None) is not None:
+                # a declared joint fold whose top-k pair never registered:
+                # packing it as independent per-state folds would silently
+                # break the exact-merge contract — fail loud like the old path
+                raise PackingError(
+                    "heavy-hitter fold requires the count-min grid registered before"
+                    " the adjacent (ids, counts) top-k pair"
+                )
+            rank_inv_live = getattr(metric, "_rank_invariant_states", ()) or ()
             for attr, red in metric._reductions.items():
                 val = getattr(metric, attr)
                 default = metric._defaults[attr]
-                if hh_info is not None and attr in (hh_info["ids"], hh_info["counts"]):
+                sspec = sspecs[attr]
+                if sspec.role in ("hh-ids", "hh-counts"):
                     if not _is_array(val):
                         raise PackingError(f"heavy-hitter state {attr!r} is not an array")
-                    spec = _Spec(
-                        owner, attr,
-                        "hh-ids" if attr == hh_info["ids"] else "hh-counts",
-                        str(val.dtype),
-                    )
+                    spec = _Spec(owner, attr, sspec.role, str(val.dtype))
                     spec.shape = tuple(int(d) for d in val.shape)
                     spec.size = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
                     spec.needs_meta = tuple(getattr(default, "shape", ())) != spec.shape
                     spec.group = "gather:" + spec.dtype
-                    if spec.kind == "hh-ids":
-                        spec.hh_meta = (
-                            hh_info["cms"], int(hh_info["k"]),
-                            int(hh_info["depth"]), int(hh_info["width"]),
-                        )
+                    if sspec.role == "hh-ids":
+                        spec.hh_meta = tuple(sspec.hh)
                     self.specs.append(spec)
                     continue
                 if isinstance(default, list):
-                    if red is dim_zero_cat or red is None:
+                    if sspec.fold in ("cat", "none"):
                         self._add_list_spec(owner, metric, attr, red, val)
                     else:
                         raise PackingError(f"list state {attr!r} with non-cat reduction")
                     continue
                 if not _is_array(val):
                     raise PackingError(f"state {attr!r} is not an array")
-                kind = _KIND_BY_FN.get(red)
                 fold_fn = None
-                if kind is None:
-                    if red is dim_zero_cat:
-                        kind = "cat"
-                    elif red is None:
-                        kind = "none-array"
-                    elif callable(red):
-                        kind, fold_fn = "custom", red
-                    else:
-                        raise PackingError(f"unsupported reduction for state {attr!r}")
+                if sspec.fold in ("sum", "mean", "max", "min", "cat"):
+                    kind = sspec.fold
+                elif sspec.fold == "none":
+                    kind = "none-array"
+                elif sspec.fold == "custom":
+                    kind, fold_fn = "custom", sspec.fold_fn or red
+                else:
+                    raise PackingError(f"unsupported reduction for state {attr!r}")
                 spec = _Spec(owner, attr, kind, str(val.dtype), fold_fn)
+                # instance-level declarations made after add_state still join
+                # the audit: union of the registered spec and the live attr
+                spec.rank_invariant = sspec.rank_invariant or attr in rank_inv_live
                 spec.shape = tuple(int(d) for d in val.shape)
                 spec.size = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
                 if kind == "cat":
@@ -547,19 +558,17 @@ class PackedSyncPlan:
                 # sum/mean fingerprints are the opposite smell — every rank
                 # appears to have accumulated the same stream, so the fold
                 # will double-count — reported as "duplicate-suspect".
-                by_owner = dict(self._metrics)
                 self.audit_results = []
                 for spec_i, s in enumerate(self._audit_specs()):
                     fps = world_meta[:, idx]
                     sizes = world_meta[:, idx + 1]
                     idx += _META_INTS_PER_ENTRY
                     divergent = bool(fps.max() != fps.min() or sizes.max() != sizes.min())
-                    declared = getattr(by_owner[s.owner], "_rank_invariant_states", ()) or ()
                     # identical fingerprints imply every rank's buffer equals
                     # the local one, so the LOCAL any() check is world-valid:
                     # all-zero (still-at-default) states are not suspicious
                     local_nonzero = spec_i < len(self._audit_nonzero) and self._audit_nonzero[spec_i]
-                    if divergent and s.attr in declared:
+                    if divergent and s.rank_invariant:
                         flag = "rank-invariant-divergence"
                     elif (
                         not divergent

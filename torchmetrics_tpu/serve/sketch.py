@@ -235,12 +235,25 @@ class HeavyHitters(Metric):
         # registration ORDER is load-bearing: the packed fold estimates the
         # top-k candidates against the merged grid, so the grid's spec must
         # precede the hh pair in the plan (parallel/packing.py enforces it)
-        self.add_state("cms", default=jnp.zeros((depth, width), idt), dist_reduce_fx="sum")
-        self.add_state("hh_ids", default=jnp.full((k,), -1, idt), dist_reduce_fx=_rank_zero_fold)
-        self.add_state("hh_counts", default=jnp.zeros((k,), idt), dist_reduce_fx=_rank_zero_fold)
-        # joint-fold declaration for parallel/packing.py: membership is a
-        # function of the metric DEFINITION (not live values), so every rank
-        # builds the same plan layout unconditionally
+        # first-class roles (engine/statespec.py): the grid + (ids, counts)
+        # pair declare the joint heavy-hitter fold directly in their specs —
+        # membership is a function of the metric DEFINITION (not live values),
+        # so every rank builds the same plan layout unconditionally
+        self.add_state(
+            "cms", default=jnp.zeros((depth, width), idt), dist_reduce_fx="sum",
+            spec={"role": "hh-grid", "dtype_policy": "count"},
+        )
+        self.add_state(
+            "hh_ids", default=jnp.full((k,), -1, idt), dist_reduce_fx=_rank_zero_fold,
+            spec={"role": "hh-ids", "hh": ("cms", k, depth, width), "dtype_policy": "count"},
+        )
+        self.add_state(
+            "hh_counts", default=jnp.zeros((k,), idt), dist_reduce_fx=_rank_zero_fold,
+            spec={"role": "hh-counts", "dtype_policy": "count"},
+        )
+        # deprecated attribute-convention mirror of the specs above, kept one
+        # release for out-of-tree code that reads it; packing resolves from
+        # the specs and never consults this
         self._hh_fold_info = {
             "ids": "hh_ids", "counts": "hh_counts", "cms": "cms",
             "k": k, "depth": depth, "width": width,

@@ -121,19 +121,46 @@ class TenantSlices(Metric):
         from torchmetrics_tpu.engine.numerics import count_dtype
 
         idt = count_dtype()
-        self.add_state("tenant_ids", default=jnp.full((capacity + 1,), -1, idt), dist_reduce_fx=_rank_zero_fold)
-        self.add_state("tenant_counts", default=jnp.zeros((capacity + 1,), idt), dist_reduce_fx="sum")
+        self.add_state(
+            "tenant_ids", default=jnp.full((capacity + 1,), -1, idt),
+            dist_reduce_fx=_rank_zero_fold, spec={"dtype_policy": "count"},
+        )
+        self.add_state(
+            "tenant_counts", default=jnp.zeros((capacity + 1,), idt),
+            dist_reduce_fx="sum", spec={"dtype_policy": "count"},
+        )
         for key in self._base_keys:
             default = template._defaults[key]
             slotted = jnp.broadcast_to(default, (capacity + 1,) + tuple(default.shape))
             self.add_state("seg_" + key, default=slotted, dist_reduce_fx=template._reductions[key])
-        # spill accounting: exact volume + heavy-hitter sketch (flat states —
-        # registration order matters: the grid precedes the hh pair, which the
-        # packed hh-ids fold requires)
-        self.add_state("spilled", default=jnp.zeros((), idt), dist_reduce_fx="sum")
-        self.add_state("spill_cms", default=jnp.zeros((spill_depth, spill_width), idt), dist_reduce_fx="sum")
-        self.add_state("spill_ids", default=jnp.full((spill_k,), -1, idt), dist_reduce_fx=_rank_zero_fold)
-        self.add_state("spill_counts", default=jnp.zeros((spill_k,), idt), dist_reduce_fx=_rank_zero_fold)
+        # spill accounting: exact volume + heavy-hitter sketch, with the joint
+        # fold declared first-class in the specs (engine/statespec.py).
+        # Registration order stays load-bearing: the grid precedes the hh pair,
+        # which the packed hh-ids fold requires
+        self.add_state(
+            "spilled", default=jnp.zeros((), idt), dist_reduce_fx="sum",
+            spec={"dtype_policy": "count"},
+        )
+        self.add_state(
+            "spill_cms", default=jnp.zeros((spill_depth, spill_width), idt),
+            dist_reduce_fx="sum", spec={"role": "hh-grid", "dtype_policy": "count"},
+        )
+        self.add_state(
+            "spill_ids", default=jnp.full((spill_k,), -1, idt),
+            dist_reduce_fx=_rank_zero_fold,
+            spec={
+                "role": "hh-ids",
+                "hh": ("spill_cms", spill_k, spill_depth, spill_width),
+                "dtype_policy": "count",
+            },
+        )
+        self.add_state(
+            "spill_counts", default=jnp.zeros((spill_k,), idt),
+            dist_reduce_fx=_rank_zero_fold,
+            spec={"role": "hh-counts", "dtype_policy": "count"},
+        )
+        # deprecated attribute-convention mirror, kept one release for
+        # out-of-tree readers; packing resolves from the specs
         self._hh_fold_info = {
             "ids": "spill_ids", "counts": "spill_counts", "cms": "spill_cms",
             "k": spill_k, "depth": spill_depth, "width": spill_width,

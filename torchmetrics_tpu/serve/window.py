@@ -263,7 +263,10 @@ class WindowedMetric(_StreamingWrapper):
         # — an unbounded serving stream must not wrap its clock at 2**31.
         from torchmetrics_tpu.engine.numerics import count_dtype
 
-        self.add_state("clock", default=jnp.zeros((), count_dtype()), dist_reduce_fx="max")
+        self.add_state(
+            "clock", default=jnp.zeros((), count_dtype()), dist_reduce_fx="max",
+            spec={"role": "ring-clock", "dtype_policy": "count"},
+        )
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """One stream tick: contribution + advance/evict/fold, one graph."""
