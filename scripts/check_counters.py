@@ -88,6 +88,16 @@ and fails CI when any counter regresses past the committed baseline
   ``cse_quarantined_batches`` == planted), does zero host transfers under the
   STRICT guard, and resolves every in-tree packed/bucketing/compensation role
   from the StateSpec registry (``cse_spec_fallbacks`` == 0)
+- SPMD sharded-state proofs (``parallel/sharding.py``): class-axis states on
+  a >= 2-device mesh are born distributed (``shard_states``), compute
+  bit-identically to the replicated path (``sharding_parity_ok``), hold
+  ~1/mesh bytes per device (``sharding_footprint_fraction``), skip the packed
+  host gather in favour of in-graph psum (``gather_skipped``/``psum_syncs``,
+  ``sync_value_global_ok``), run the million-class hot loop as ONE SPMD
+  executable with zero host transfers and zero warm retraces
+  (``million_class_single_graph_ok``), and survive clone/pickle/state_dict/
+  reshard plus the K=8 scan drain (``lifecycle_roundtrip_ok``,
+  ``scan_compat_ok``)
 - numerical-resilience proofs (``engine/numerics.py``): the 18k-step
   long stream drifts ≥1e-3 on the naive float32 path
   (``drift_demonstrated``) while the compensated two-sum path stays within
@@ -256,6 +266,24 @@ _CHECKS = (
     ("cse", "cse_parity_ok", "true", None),  # byte-identical, riders composed
     ("cse", "cse_quarantined_batches", "eqfield", "cse_quarantine_planted"),
     ("cse", "cse_spec_fallbacks", "abs", 0),  # every in-tree role is registry-resolved
+    # SPMD sharded-state gates (parallel/sharding.py, PR 12): class-axis
+    # states born distributed over a >= 2-device mesh must compute
+    # bit-identically to the replicated path, hold ~1/mesh bytes per device,
+    # skip the packed host gather (in-graph psum takes its place), survive
+    # the full lifecycle, and run the million-class hot loop as ONE SPMD
+    # executable with zero host transfers under the STRICT guard
+    ("sharding", "sharding_parity_ok", "true", None),  # sharded == replicated, bit-exact
+    ("sharding", "shard_states", "true", None),  # states actually placed distributed
+    ("sharding", "gather_skipped", "true", None),  # packed gather skipped sharded states
+    ("sharding", "psum_syncs", "true", None),  # ...and additive folds rode in-graph psum
+    ("sharding", "sync_value_global_ok", "true", None),  # skipped state is already global
+    ("sharding", "million_class_sharded", "true", None),  # 1M-class counters born sharded
+    ("sharding", "million_class_single_graph_ok", "true", None),  # ONE update executable
+    ("sharding", "sharding_retraces_after_warmup", "abs", 0),
+    ("sharding", "sharding_host_transfers", "abs", 0),  # hot loop under STRICT guard
+    ("sharding", "sharding_footprint_fraction", "abs", 0.30),  # per-device ~1/mesh (mesh>=4)
+    ("sharding", "lifecycle_roundtrip_ok", "true", None),  # clone/pickle/state_dict/reshard
+    ("sharding", "scan_compat_ok", "true", None),  # PR-10 K=8 drain, byte-identical
 )
 
 
@@ -296,7 +324,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "cse"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "cse", "sharding"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

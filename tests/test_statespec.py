@@ -100,7 +100,10 @@ def test_add_state_registers_specs_zero_fallbacks():
     for sp in specs.values():
         assert sp.fold == "sum" and sp.role == "state"
         assert sp.row_additive and not sp.state_additive
-        assert sp.shard_rule == "replicate"
+        # the stat-scores family declares class-axis sharding (PR 12); with no
+        # active mesh the rule resolves to replication — today's placement
+        assert sp.shard_rule == "class_axis"
+        assert statespec.resolve_shard_rule(sp) is None
     s = SumMetric(nan_strategy=0.0)
     assert s.state_specs()["value"].state_additive
     assert statespec.spec_fallback_count() == 0
@@ -146,6 +149,13 @@ def test_legacy_hh_derivation_matches_registered_plan():
     reset_engine_stats()
     registered = HeavyHitters(k=4)
     legacy = _strip_registry(HeavyHitters(k=4))
+    # the in-tree `_hh_fold_info` mirror is GONE (PR 12 — the one-release
+    # deprecation window closed); the counted legacy-derivation path still
+    # serves out-of-tree metrics that declare the attribute themselves
+    legacy._hh_fold_info = {
+        "ids": "hh_ids", "counts": "hh_counts", "cms": "cms",
+        "k": 4, "depth": 4, "width": 2048,
+    }
     plan_r = PackedSyncPlan([("m", registered)], 1, None)
     plan_l = PackedSyncPlan([("m", legacy)], 1, None)
     assert [(s.attr, s.kind, s.hh_meta) for s in plan_r.specs] == [

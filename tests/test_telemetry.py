@@ -125,6 +125,9 @@ UNITLESS_COUNT_FAMILIES = {
     # state-spec registry (engine/statespec.py, PR 11): deprecated-convention
     # role resolutions — a pure migration count, no physical unit
     "tm_tpu_spec_fallbacks",
+    # SPMD sharded-state engine (parallel/sharding.py, PR 12): placement /
+    # in-graph-sync event counts — pure counts, no physical unit
+    "tm_tpu_shard_states", "tm_tpu_psum_syncs", "tm_tpu_gather_skipped",
 }
 
 

@@ -107,6 +107,11 @@ class MulticlassConfusionMatrix(Metric):
     # engine shape-bucketing opt-in: zero pad rows bincount into fixed cells
     # whose contribution the compiled step subtracts (engine/bucketing.py)
     _engine_row_additive = True
+    # SPMD placement (parallel/sharding.py): the matrix rows (true-class axis)
+    # partition over the state mesh — a num_classes x num_classes state holds
+    # ~1/N rows per device, the class-axis unlock for matrices no one device
+    # could hold. No active mesh (or indivisible num_classes) = replication.
+    _engine_shard_rules = {"confmat": "class_axis"}
 
     def __init__(
         self,
@@ -159,6 +164,9 @@ class MultilabelConfusionMatrix(Metric):
     # engine shape-bucketing opt-in: zero pad rows bincount into fixed cells
     # whose contribution the compiled step subtracts (engine/bucketing.py)
     _engine_row_additive = True
+    # SPMD placement: the per-label (L, 2, 2) stack partitions its label axis
+    # over the state mesh exactly like the per-class counters
+    _engine_shard_rules = {"confmat": "class_axis"}
 
     def __init__(
         self,

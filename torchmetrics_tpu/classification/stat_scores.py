@@ -50,6 +50,12 @@ class _AbstractStatScores(Metric):
     # additionally requires every state to be sum-reduced, which excludes the
     # samplewise cat-list layout automatically)
     _engine_row_additive = True
+    # SPMD placement (parallel/sharding.py): per-class counters partition
+    # their class axis over the state mesh, so vocab-scale (million-class)
+    # tp/fp/tn/fn hold ~1/N per device. Scalar micro counters and samplewise
+    # cat lists degrade to replication automatically (the rule inspects the
+    # registered default's shape); with no active mesh this is a no-op.
+    _engine_shard_rules = {"tp": "class_axis", "fp": "class_axis", "tn": "class_axis", "fn": "class_axis"}
 
     def _create_state(self, size: int, multidim_average: str = "global") -> None:
         """Register the 4 counter states; tensors+sum for global, lists+cat for samplewise."""

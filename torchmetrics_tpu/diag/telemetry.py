@@ -67,6 +67,9 @@ _COUNTER_HELP = {
     "compute_cache_hits": "compute dispatches served without a re-trace",
     "profile_probes": "warm dispatches followed by a sampled completion probe",
     "spec_fallbacks": "state roles resolved via the deprecated string-prefix/attribute conventions",
+    "shard_states": "states placed distributed via a resolved shard rule",
+    "psum_syncs": "additive sharded states whose sync lowered to in-graph psum",
+    "gather_skipped": "sharded states the packed host gather skipped",
 }
 
 # exposition-convention names for counters whose field name buries the unit:

@@ -340,7 +340,7 @@ def make_step_body(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=No
     return body
 
 
-def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
+def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None, out_shardings=None):
     """Compile ``run(state_pytree, flat_inputs) -> state_pytree`` into a jitted
     step with the state pytree donated (policy permitting).
 
@@ -348,6 +348,13 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
     :func:`make_step_body` (also the scan drain's per-step body). ``tree_map``
     keeps it agnostic to whether the state pytree is one metric's dict or a
     fused dict-of-dicts.
+
+    ``out_shardings`` (``parallel/sharding.state_out_shardings`` over the
+    example state, or ``None``) pins partitioned state leaves to their
+    ``NamedSharding`` so the executable lowers as an SPMD program — the
+    committed sharded inputs drive ``in_shardings`` by propagation, the
+    output constraint keeps the new state sharded in place, and GSPMD
+    inserts the in-graph ``psum``/``psum_scatter`` the partitioning needs.
     """
     import jax
 
@@ -366,7 +373,10 @@ def make_step(run, bucketed: bool, inputs: Sequence[Any], txn=None, comp=None):
             return body(state, None, flat)
 
     donate = config.donation_enabled()
-    return jax.jit(step, donate_argnums=(0,) if donate else ()), donate
+    jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,) if donate else ()}
+    if out_shardings is not None:
+        jit_kwargs["out_shardings"] = out_shardings
+    return jax.jit(step, **jit_kwargs), donate
 
 
 def build_run(m: Any, owner: str, n_args: int, kw_names: Tuple[str, ...], quarantined: bool, comp_names: Tuple[str, ...]):
@@ -805,7 +815,12 @@ class CompiledUpdate:
         owner = self.stats.owner
         quarantined, comp_names, step_txn, step_comp = build_riders(m, inputs)
         run = build_run(m, owner, n_args, kw_names, quarantined, comp_names)
-        fn, donate = make_step(run, bucketed, inputs, txn=step_txn, comp=step_comp)
+        from torchmetrics_tpu.parallel import sharding as _sharding
+
+        fn, donate = make_step(
+            run, bucketed, inputs, txn=step_txn, comp=step_comp,
+            out_shardings=_sharding.state_out_shardings(example_state),
+        )
         # ahead-of-time compile: same single trace+compile as the lazy first
         # dispatch, but the Compiled handle feeds the diag cost/memory ledger
         example = (example_state, np.int32(n_pad), *inputs) if bucketed else (example_state, *inputs)
@@ -816,10 +831,14 @@ class CompiledUpdate:
 
     @staticmethod
     def _device_token(state: Dict[str, Any]) -> str:
-        """Best-effort device component of the cache key — ``to(device)`` must recompile."""
-        for v in state.values():
-            try:
-                return str(next(iter(v.devices())))
-            except Exception:
-                break
-        return ""
+        """Placement component of the cache key — ``to(device)`` must recompile.
+
+        Sharding-aware (``parallel/sharding.placement_token``): partitioned
+        leaves fold their ``PartitionSpec`` + device set into the token, so a
+        re-placed state keys a fresh executable instead of dispatching one
+        AOT-pinned to the old placement; single-device pytrees yield the bare
+        device string the pre-sharding caches keyed on.
+        """
+        from torchmetrics_tpu.parallel.sharding import placement_token
+
+        return placement_token(state)

@@ -236,6 +236,37 @@ def _exchange(
                     # counted on COMPLETION, not on the replan decision — a
                     # degrade that itself fails must not read as a degraded fold
                     stats.sync_degraded_folds += 1
+                skipped = getattr(plan, "skipped_sharded", ())
+                if skipped:
+                    # live-sharded states never entered the host exchange:
+                    # their cross-device sync is the in-graph psum/psum_scatter
+                    # the SPMD executable already lowered (parallel/sharding.py)
+                    stats.gather_skipped += len(skipped)
+                    stats.psum_syncs += sum(
+                        1 for _, _, fold, _ in skipped if fold in ("sum", "mean")
+                    )
+                    _diag.record(
+                        "sync.shard_skip", stats.owner,
+                        states=len(skipped),
+                        attrs=tuple(f"{o}:{a}" if o else a for o, a, _, _ in skipped),
+                    )
+                    if plan.world_size > 1 and any(not spans for _, _, _, spans in skipped):
+                        # multi-host honesty: a process-LOCAL mesh only folded
+                        # this process's contributions — skipping the gather is
+                        # exact only when the mesh spans every process. Loud,
+                        # once (the warnings module dedups this call site):
+                        # partial totals must never be silent.
+                        from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+                        rank_zero_warn(
+                            "Sharded metric state on a process-local mesh skipped a"
+                            f" {plan.world_size}-process sync: the in-graph collectives"
+                            " folded only THIS process's contributions. Build the state"
+                            " mesh over the global device set (all processes) for"
+                            " multi-host sharding, or leave sharding off and ride the"
+                            " packed gather.",
+                            UserWarning,
+                        )
                 return gathered, plan
             except _resilience.SyncFaultError as exc:
                 # each pass excludes exactly one culprit; bounded by world size
@@ -454,7 +485,12 @@ class EpochEngine:
         gathered, plan = _exchange(plan, self.stats)
         sig = ("fused", plan.signature())
         entry = self._fused_cache.get(sig)
-        if entry is _FALLBACK or not self._compute_ok:
+        if entry is _FALLBACK or not self._compute_ok or getattr(plan, "skipped_sharded", ()):
+            # sharded states live OUTSIDE the exchange (their sync is
+            # in-graph), so the fused fold→compute graph — which only sees the
+            # packed buffers — cannot produce the full state set; the compute
+            # half runs on the live metric instead, where cached_compute
+            # consumes the sharded leaves directly as one SPMD executable
             return self._fold_then_no_value(plan, gathered)
         first = entry is None
         rec = _diag.active_recorder()
@@ -670,14 +706,11 @@ class EpochEngine:
 
     @staticmethod
     def _device_token(state: Dict[str, Any]) -> str:
-        import jax
+        # sharding-aware (parallel/sharding.py): a partitioned state keys a
+        # different compute executable than its replicated twin
+        from torchmetrics_tpu.parallel.sharding import placement_token
 
-        for v in jax.tree_util.tree_leaves(state):
-            try:
-                return str(next(iter(v.devices())))
-            except Exception:  # noqa: BLE001
-                break
-        return ""
+        return placement_token(state)
 
 
 class CollectionEpoch:

@@ -35,6 +35,7 @@ from torchmetrics_tpu.engine import numerics as _numerics
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
+    CompiledUpdate,
     _is_jax_array,
     annotation_scope,
     completion_probe,
@@ -310,7 +311,9 @@ class FusedUpdate:
         # dtype OBJECTS, not str(dtype): numpy re-derives the name string on
         # every call (no caching) and the warm loop builds this key per step
         state_sig = tuple((name, state_signature(states[name])) for name, _ in members)
-        key = (bucketed, state_sig, in_sig)
+        # placement joins the key (parallel/sharding.py): a member re-placed
+        # onto (or off) the state mesh must compile fresh, like a device move
+        key = (bucketed, state_sig, in_sig, CompiledUpdate._device_token(states))
         entry = self._cache.get(key)
         if entry is _FALLBACK:
             st.fallback("uncompilable-signature")
@@ -458,10 +461,15 @@ class FusedUpdate:
 
         quarantined, comp_names, step_txn, step_comp = build_fused_riders(fusable, inputs)
         run_all = build_run_all(fusable, comp_names, quarantined)
-        fn, donate = make_step(run_all, bucketed, inputs, txn=step_txn, comp=step_comp)
+        example_states = {name: states[name] for name, _ in fusable}
+        from torchmetrics_tpu.parallel import sharding as _sharding
+
+        fn, donate = make_step(
+            run_all, bucketed, inputs, txn=step_txn, comp=step_comp,
+            out_shardings=_sharding.state_out_shardings(example_states),
+        )
         # AOT compile for the diag cost ledger (same single trace+compile).
         # tree_leaves-based byte count: rider entries may nest (the residual dict)
-        example_states = {name: states[name] for name, _ in fusable}
         example = (example_states, np.int32(0), *inputs) if bucketed else (example_states, *inputs)
         state_bytes = sum(
             getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(example_states)

@@ -371,7 +371,16 @@ def compile_scan(body, example_state, example_inputs: Sequence[Any], kb: int, ow
             return final
 
     donate = config.donation_enabled()
-    fn = jax.jit(scan_fn, donate_argnums=(0,) if donate else ())
+    # SPMD carry (parallel/sharding.py): sharded state leaves pin their
+    # NamedSharding on the scan output so the whole K-fold drain lowers as
+    # one SPMD program and the donated carry stays partitioned in place
+    from torchmetrics_tpu.parallel import sharding as _sharding
+
+    out_sh = _sharding.state_out_shardings(example_state)
+    jit_kwargs = {"donate_argnums": (0,) if donate else ()}
+    if out_sh is not None:
+        jit_kwargs["out_shardings"] = out_sh
+    fn = jax.jit(scan_fn, **jit_kwargs)
     example_valid = np.zeros((kb,), np.bool_)
     example_valid[:1] = True
     example_pads = np.zeros((kb,), np.int32)

@@ -32,12 +32,13 @@ registered at ``Metric.add_state`` time and consumed by every engine:
   through untouched;
 - **rank invariance** — values must be identical on every rank (the packed
   sync's divergence audit fingerprints these);
-- **shard rule** — the landing pad for the SPMD sharded-state engine
-  (ROADMAP item 1): a named rule resolving to a partition spec. The default
-  ``"replicate"`` is a documented no-op — every state is replicated per-rank
-  today, and :func:`resolve_shard_rule` returns ``None`` (no partitioning)
-  until the pjit layer lands. Registering the slot NOW means the sharding
-  layer consumes specs instead of inventing a sixth convention.
+- **shard rule** — the SPMD sharded-state engine's placement input
+  (``parallel/sharding.py``): a named entry in :data:`SHARD_RULES` that
+  :func:`resolve_shard_rule` resolves to the live ``NamedSharding`` on the
+  active state mesh (``None`` = replicated). ``"class_axis"`` /
+  ``"row_sharded"`` partition the leading dim over the ``"state"`` axis so
+  million-class states are born distributed at ``add_state``; with no mesh
+  active every rule degrades to replication — today's semantics, free.
 
 Consumers resolve specs through :func:`spec_of`. Metrics that registered
 their states through ``add_state`` always hit the registry; anything else
@@ -117,13 +118,32 @@ RIDER_KEYS = frozenset({SENTINEL_KEY, QUARANTINE_KEY, COMPENSATION_KEY})
 #: pad rows cannot raise health flags, poison a batch, or carry rounding error
 PAD_EXEMPT_KEYS = RIDER_KEYS
 
-#: named shard rules — the SPMD landing pad (ROADMAP item 1). ``replicate`` is
-#: the documented no-op default: state lives whole on every rank and
-#: :func:`resolve_shard_rule` yields ``None`` (no partitioning). The sharding
-#: layer will register real rules ("class-axis", "row-chunk", …) here and
-#: resolve them to ``PartitionSpec``s; every spec already carries the slot.
+def _rule_replicate(spec: "StateSpec", value: Any = None) -> None:
+    """State lives whole on every device — no placement constraint."""
+    return None
+
+
+def _rule_dim0(spec: "StateSpec", value: Any = None) -> Optional[Any]:
+    """Partition the leading dim over the ``"state"`` mesh axis (or replicate)."""
+    from torchmetrics_tpu.parallel import sharding as _sharding
+
+    return _sharding.partition_dim0(spec, value)
+
+
+#: named shard rules, resolved by the SPMD sharded-state engine
+#: (``parallel/sharding.py``). ``replicate`` is the default: state lives whole
+#: on every device. ``class_axis`` partitions a per-class state's leading dim
+#: (per-class TP/FP/TN/FN counters, confusion-matrix rows, the multilabel
+#: ``(num_labels, 2, 2)`` stack) over the ``"state"`` mesh axis so a
+#: million-class state holds ~1/N per device; ``row_sharded`` is the same
+#: dim-0 partition for generic row-major matrix states (feature-covariance
+#: accumulators, embedding tables) where the rows carry no per-class
+#: semantics. Both degrade to replication — recorded, never silent — when no
+#: mesh is active or the leading dim is not divisible by the mesh axis.
 SHARD_RULES: Dict[str, Callable[["StateSpec", Any], Optional[Any]]] = {
-    "replicate": lambda spec, value=None: None,
+    "replicate": _rule_replicate,
+    "class_axis": _rule_dim0,
+    "row_sharded": _rule_dim0,
 }
 
 _FOLD_BY_FN = {
@@ -180,9 +200,11 @@ class StateSpec:
             sync's divergence audit fingerprints these.
         hh: ``hh-ids`` only — ``(grid_attr, k, depth, width)`` tying the top-k
             pair to its count-min grid for the joint packed fold.
-        shard_rule: named entry in :data:`SHARD_RULES`. ``"replicate"`` (the
-            default) is the documented no-op: no partitioning until the SPMD
-            layer (ROADMAP item 1) lands.
+        shard_rule: named entry in :data:`SHARD_RULES` — ``"replicate"`` (the
+            default), or ``"class_axis"``/``"row_sharded"`` to partition the
+            leading dim over the active state mesh (``parallel/sharding.py``);
+            derived from the metric's class-level ``_engine_shard_rules``
+            declaration at registration.
     """
 
     name: str
@@ -211,19 +233,24 @@ def fold_name(dist_reduce_fx: Any) -> Tuple[str, Optional[Callable]]:
 
 
 def resolve_shard_rule(spec: StateSpec, value: Any = None) -> Optional[Any]:
-    """Resolve a spec's shard rule to a partition spec (``None`` = replicate).
+    """Resolve a spec's shard rule to its live sharding (``None`` = replicate).
 
-    The no-op default: every in-tree rule currently resolves to ``None`` —
-    state is replicated per-rank, exactly today's semantics. The SPMD engine
-    (ROADMAP item 1) swaps real rules into :data:`SHARD_RULES` without
-    touching any consumer.
+    Returns the ``jax.sharding.NamedSharding`` the rule places ``value``
+    under on the active state mesh (``parallel/sharding.py``), or ``None``
+    when the state is replicated — because the rule is ``"replicate"``, no
+    mesh is active, or the rule degraded (indivisible leading dim, recorded
+    as a ``shard.fallback`` event). ``value`` carries the shape the
+    partitioning inspects; rules other than ``"replicate"`` resolve to
+    ``None`` without it. Unknown rule names raise, listing the registered
+    rules — a typo must not silently replicate a state the operator believes
+    is sharded.
     """
     try:
         rule = SHARD_RULES[spec.shard_rule]
     except KeyError:
         raise ValueError(
             f"state {spec.name!r} names unknown shard rule {spec.shard_rule!r}"
-            f" (registered: {sorted(SHARD_RULES)})"
+            f" (registered rules: {sorted(SHARD_RULES)})"
         ) from None
     return rule(spec, value)
 
@@ -256,6 +283,11 @@ def build_spec(
         "row_additive": bool(getattr(metric, "_engine_row_additive", False)),
         "state_additive": bool(getattr(metric, "_engine_state_additive", False)),
         "rank_invariant": name in (getattr(metric, "_rank_invariant_states", ()) or ()),
+        # SPMD placement (parallel/sharding.py): the class declares per-state
+        # rules once (``_engine_shard_rules = {"tp": "class_axis", ...}``);
+        # with no active mesh every rule resolves to replication, so the
+        # declaration is free until an operator turns the mesh on
+        "shard_rule": (getattr(metric, "_engine_shard_rules", None) or {}).get(name, "replicate"),
     }
     if overrides:
         unknown = set(overrides) - {f.name for f in dataclasses.fields(StateSpec)}
@@ -271,6 +303,14 @@ def build_spec(
             )
         fields.update(overrides)
         fields["name"] = name
+    if fields["shard_rule"] not in SHARD_RULES:
+        # validated at REGISTRATION, not first resolution: a typo'd rule on a
+        # state the mesh never touches would otherwise sit latent until the
+        # first sharded run of a completely different workload
+        raise ValueError(
+            f"state {name!r} names unknown shard rule {fields['shard_rule']!r}"
+            f" (registered rules: {sorted(SHARD_RULES)})"
+        )
     return StateSpec(**fields)
 
 

@@ -60,6 +60,10 @@ _COUNTER_FIELDS = (
     "profile_probes",  # warm dispatches followed by a sanctioned block_until_ready probe
     # --- state-spec registry (engine/statespec.py): deprecation telemetry ---
     "spec_fallbacks",  # roles resolved via the deprecated string-prefix/attribute conventions
+    # --- SPMD sharded-state engine (parallel/sharding.py): mesh placement ---
+    "shard_states",  # states placed distributed via a resolved shard rule (born or re-placed)
+    "psum_syncs",  # additive sharded states whose sync lowered to in-graph psum (gather skipped)
+    "gather_skipped",  # sharded states the packed host gather skipped entirely
 )
 
 

@@ -245,9 +245,37 @@ class Metric:
         self._reductions[name] = dist_reduce_fx
         from torchmetrics_tpu.engine import statespec as _statespec
 
-        _statespec.register_state_spec(
+        spec_obj = _statespec.register_state_spec(
             self, _statespec.build_spec(self, name, dist_reduce_fx, spec)
         )
+        if spec_obj.shard_rule != "replicate" and _is_array(default):
+            # born distributed (parallel/sharding.py): the registered default
+            # itself is placed onto the rule's resolved NamedSharding, so the
+            # state never materializes unsharded and reset() restores the
+            # sharded default by reference. No active mesh = no-op.
+            from torchmetrics_tpu.parallel import sharding as _sharding
+
+            placed = _sharding.place_state(self, name, default, spec_obj)
+            if placed is not default:
+                self._defaults[name] = placed
+                setattr(self, name, placed)
+
+    def _apply_shard_rules(self) -> None:
+        """Re-place rule-carrying states after a host round-trip.
+
+        ``load_state_dict``/unpickling hand back single-device arrays; when a
+        state mesh is active the registered shard rules re-apply so restored
+        state keeps the born-distributed placement. Cheap no-op for the
+        common case (no non-replicate rules registered, or no active mesh).
+        """
+        specs = self.__dict__.get("_state_specs") or {}
+        if not any(
+            getattr(sp, "shard_rule", "replicate") != "replicate" for sp in specs.values()
+        ):
+            return
+        from torchmetrics_tpu.parallel import sharding as _sharding
+
+        _sharding.reshard_states(self)
 
     def state_specs(self) -> Dict[str, Any]:
         """Every registered state's :class:`~torchmetrics_tpu.engine.statespec.
@@ -606,9 +634,33 @@ class Metric:
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
         """Gather every state from all chips/processes and apply its reduction (reference ``metric.py:386-416``)."""
-        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+        from torchmetrics_tpu.parallel.sharding import is_sharded, spans_processes
+
+        # live-sharded states are global by construction: the SPMD executable
+        # already folded every device's contribution through in-graph
+        # collectives, and gathering a partitioned buffer through the host
+        # would read shards this process may not even address — skip them,
+        # mirroring the packed plan's gather_skipped semantics
+        sharded_attrs = {attr for attr in self._reductions if is_sharded(getattr(self, attr))}
+        if sharded_attrs and jax.process_count() > 1 and any(
+            not spans_processes(getattr(self, attr)) for attr in sharded_attrs
+        ):
+            # same multi-host honesty warning as the packed path: a
+            # process-local mesh folded only this process's contributions
+            rank_zero_warn(
+                "Sharded metric state on a process-local mesh skipped a"
+                f" {jax.process_count()}-process sync: the in-graph collectives"
+                " folded only THIS process's contributions. Build the state mesh"
+                " over the global device set for multi-host sharding.",
+                UserWarning,
+            )
+        input_dict = {
+            attr: getattr(self, attr) for attr in self._reductions if attr not in sharded_attrs
+        }
 
         for attr, reduction_fn in self._reductions.items():
+            if attr in sharded_attrs:
+                continue
             # pre-concatenate list states to minimize collectives (ref ``metric.py:391-392``)
             if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
@@ -709,6 +761,8 @@ class Metric:
         )
 
         for attr, reduction_fn in self._reductions.items():
+            if attr in sharded_attrs:
+                continue  # globally consistent already; nothing was gathered
             if isinstance(output_dict[attr], list) and len(output_dict[attr]) == 0:
                 setattr(self, attr, [])
                 continue
@@ -1219,6 +1273,9 @@ class Metric:
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+        # pickles carry host-serialized (single-device) arrays: rule-carrying
+        # states re-place onto the active mesh (no-op when sharding is off)
+        self._apply_shard_rules()
 
     def __setattr__(self, name: str, value: Any) -> None:
         """Write-protect class-constant metadata (reference ``metric.py:657-668``)."""
@@ -1370,6 +1427,9 @@ class Metric:
         if restored_any:
             # state changed under the cache — a prior compute() value is stale now
             self._computed = None
+            # checkpoints hold host arrays: re-place rule-carrying states onto
+            # the active mesh so a restore keeps the sharded placement
+            self._apply_shard_rules()
             if self.__dict__.get("_comp_residuals"):
                 # checkpoints carry anchored totals (state_dict folded the
                 # residual in): a stale residual surviving the restore would

@@ -44,6 +44,34 @@ from torchmetrics_tpu.parallel.sync import (
     jit_distributed_available,
 )
 
+# SPMD sharded-state engine (parallel/sharding.py): exported LAZILY (PEP 562)
+# — sharding sits above the engine package in the import graph (it consumes
+# EngineStats + the statespec registry), while engine/epoch.py imports THIS
+# package's packing/resilience at module level; an eager import here would be
+# a cycle. `from torchmetrics_tpu.parallel import mesh_context` still works.
+_SHARDING_EXPORTS = (
+    "axis_size",
+    "build_mesh",
+    "is_sharded",
+    "mesh_context",
+    "metric_mesh",
+    "reshard_states",
+    "set_mesh",
+    "sharding_enabled",
+)
+
+
+def __getattr__(name: str):
+    if name in _SHARDING_EXPORTS or name == "sharding":
+        import importlib
+
+        # importlib, not `from ... import`: a from-import resolves through
+        # THIS __getattr__ while the submodule is still initializing — recursion
+        sharding = importlib.import_module("torchmetrics_tpu.parallel.sharding")
+        return sharding if name == "sharding" else getattr(sharding, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "CollectiveTimeout",
     "CollectiveTimeoutError",
@@ -65,8 +93,16 @@ __all__ = [
     "axis_max",
     "axis_mean",
     "axis_min",
+    "axis_size",
     "axis_sum",
+    "build_mesh",
     "fault_context",
+    "is_sharded",
+    "mesh_context",
+    "metric_mesh",
+    "reshard_states",
+    "set_mesh",
+    "sharding_enabled",
     "gather_all_tensors",
     "jit_distributed_available",
     "resilience_context",

@@ -387,6 +387,11 @@ def _reshard_metric(
         setattr(metric, attr, value)
     metric._update_count = _split_count(sum(counts), rank, world_size)
     metric._computed = None
+    if hasattr(metric, "_apply_shard_rules"):
+        # the reshard algebra ran on host/single-device arrays: rule-carrying
+        # states re-place onto the active state mesh so an N->M restore hands
+        # back born-distributed buffers (parallel/sharding.py)
+        metric._apply_shard_rules()
     if metric.__dict__.get("_comp_residuals"):
         import jax.numpy as jnp
 

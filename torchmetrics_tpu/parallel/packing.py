@@ -200,6 +200,14 @@ class PackedSyncPlan:
         # cached executable (or vice versa).
         self.degraded = False
         self.excluded_ranks: Tuple[int, ...] = ()
+        # live-sharded states the gather skips entirely (parallel/sharding.py):
+        # (owner, attr, fold, spans_processes) tuples the sync driver counts
+        # as gather_skipped / psum_syncs — their cross-device sync is the
+        # in-graph collective the SPMD executable already lowered. The
+        # spans_processes flag drives the multi-host honesty warning: a
+        # process-LOCAL mesh in a multi-process world folded only local
+        # contributions.
+        self.skipped_sharded: List[Tuple[str, str, str, bool]] = []
         self._build()
 
     # ------------------------------------------------------------------ build
@@ -213,6 +221,7 @@ class PackedSyncPlan:
         from torchmetrics_tpu.engine import numerics as _numerics
         from torchmetrics_tpu.engine import statespec as _statespec
         from torchmetrics_tpu.engine import txn as _txn
+        from torchmetrics_tpu.parallel import sharding as _sharding
 
         for owner, metric in self._metrics:
             # every packed-sync role resolves from the metric's registered
@@ -275,6 +284,18 @@ class PackedSyncPlan:
                 val = getattr(metric, attr)
                 default = metric._defaults[attr]
                 sspec = sspecs[attr]
+                if _is_array(val) and _sharding.is_sharded(val):
+                    # a partitioned state is global by construction — the SPMD
+                    # executable folded every device's contribution through
+                    # in-graph psum/psum_scatter; packing it would gather
+                    # buffers this host may not even address. Placement truth
+                    # is a pure function of (metric definition, mesh policy),
+                    # identical on every rank, so the layout-symmetry rule the
+                    # buffer collectives depend on is preserved.
+                    self.skipped_sharded.append(
+                        (owner, attr, sspec.fold, _sharding.spans_processes(val))
+                    )
+                    continue
                 if sspec.role in ("hh-ids", "hh-counts"):
                     if not _is_array(val):
                         raise PackingError(f"heavy-hitter state {attr!r} is not an array")

@@ -1,0 +1,442 @@
+"""SPMD sharded-state engine — the mesh/pjit layer that makes ``shard_rule`` real.
+
+Every state used to be replicated per rank: one whole copy per device, synced
+at epoch end by the packed host gather. That caps state size at one device's
+HBM — a million-class confusion matrix or vocab-level per-class counters are
+simply unrepresentable. This module turns the ``StateSpec.shard_rule`` slot
+(PR 11's landing pad, ``engine/statespec.py``) into actual placement:
+
+- **Mesh manager.** :func:`metric_mesh` resolves the process-wide
+  ``jax.sharding.Mesh`` the shard rules partition over — a 1-D mesh with the
+  named axis :data:`STATE_AXIS` (``"state"``), built over the local devices
+  (CPU multi-device via ``--xla_force_host_platform_device_count`` for
+  tests/bench, real chips in production). Activation is explicit:
+  :func:`mesh_context` / :func:`set_mesh` scoped overrides, or the
+  ``TORCHMETRICS_TPU_SHARD`` env var (``"1"``/``"all"`` = every local device,
+  an integer N = the first N; invalid values FAIL LOUD per the PR-7 env
+  contract). With no active mesh every rule resolves to ``None`` and nothing
+  changes — replicated state, today's semantics.
+
+- **Born distributed.** ``Metric.add_state`` resolves the registered spec's
+  rule through :func:`~torchmetrics_tpu.engine.statespec.resolve_shard_rule`
+  and ``device_put``s the default onto the resolved ``NamedSharding`` — the
+  state (and its registered default, so ``reset()`` keeps the placement) never
+  materializes unsharded. A rule that cannot partition the value (no active
+  mesh; a leading dim the mesh axis does not divide) degrades to replication,
+  recorded as a ``shard.fallback`` event when a mesh was active.
+
+- **SPMD executables.** The compiled-step engines (``engine/compiled.py``,
+  ``engine/scan.py``, ``engine/fusion.py``) pass
+  :func:`state_out_shardings` as ``jax.jit(..., out_shardings=...)`` and key
+  their caches on :func:`placement_token`, so the donated update/scan
+  executables lower as SPMD programs: the batch contribution is computed and
+  scattered shard-locally, GSPMD inserts the in-graph ``psum`` /
+  ``psum_scatter`` collectives the partitioning needs, and a re-placed state
+  compiles a fresh signature instead of colliding with the replicated one.
+
+- **Sync is in-graph.** A live-sharded state is *global by construction* —
+  the SPMD program already folded every device's contribution through XLA
+  collectives — so the packed host gather (``parallel/packing.py``) skips it
+  entirely (``gather_skipped``; additive folds counted as ``psum_syncs``).
+  Gathering it through the host would both defeat the point and, on a mesh
+  spanning processes, read buffers this host cannot address.
+
+- **Lifecycle.** Riders (``__sentinel__``/``__quarantine__`` scalars stay
+  replicated; the ``__compensation__`` residual inherits its value's sharding
+  via ``zeros_like``), scan carries, quarantine rollback selects, snapshot
+  copies and clones all preserve placement because JAX propagates shardings
+  through eager ops and ``deepcopy``. The paths that genuinely round-trip
+  through host numpy — ``state_dict``/``load_state_dict``, pickling,
+  ``restore_resharded`` — re-apply the registered rules via
+  :func:`reshard_states` on restore.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Generator, Optional, Sequence, Tuple
+
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.engine.stats import EngineStats
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = [
+    "SHARD_ENV_VAR",
+    "STATE_AXIS",
+    "axis_size",
+    "build_mesh",
+    "is_sharded",
+    "mesh_context",
+    "metric_mesh",
+    "partition_dim0",
+    "place_state",
+    "placement_token",
+    "reshard_states",
+    "set_mesh",
+    "sharding_enabled",
+    "state_out_shardings",
+]
+
+SHARD_ENV_VAR = "TORCHMETRICS_TPU_SHARD"
+
+#: the named mesh axis shard rules partition over — ``"class_axis"`` /
+#: ``"row_sharded"`` split a state's leading dim across it
+STATE_AXIS = "state"
+
+_UNSET = object()
+_mesh_override: Any = _UNSET
+
+# module-level stats block: mesh placement is a process-wide fact, not a
+# per-engine property — one EngineStats joins the weak registry so
+# engine_report()/telemetry aggregate it (the module global keeps it alive)
+_STATS = EngineStats("sharding")
+
+# set the first time any state is actually placed distributed; the per-step
+# placement-token walk short-circuits to the pre-sharding O(1) token until
+# then, so processes that never shard pay one bool check per dispatch
+_ever_placed = False
+
+
+# ------------------------------------------------------------------ mesh policy
+
+
+def build_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence[Any]] = None):
+    """A 1-D :class:`jax.sharding.Mesh` with the named axis ``"state"``.
+
+    ``devices`` wins when given; otherwise the first ``n_devices`` of the
+    GLOBAL device set (all of them when ``None``) — identical to the local
+    set in a single process, and the only placement whose in-graph
+    collectives actually span the world in a multi-process one (a
+    process-local mesh there folds only local contributions; the sync driver
+    warns when it sees that). Fewer than 2 devices is a loud error — a
+    1-device "mesh" would silently demote every rule to replication while
+    the operator believes sharding is on.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        world = jax.devices()
+        if n_devices is not None:
+            if not isinstance(n_devices, int) or isinstance(n_devices, bool) or n_devices < 2:
+                raise TorchMetricsUserError(
+                    f"a state mesh needs an integer device count >= 2 (got {n_devices!r})"
+                )
+            if n_devices > len(world):
+                raise TorchMetricsUserError(
+                    f"requested a {n_devices}-device state mesh but only"
+                    f" {len(world)} devices exist (CPU tests: raise"
+                    " --xla_force_host_platform_device_count)"
+                )
+            world = world[:n_devices]
+        devices = world
+    if len(devices) < 2:
+        raise TorchMetricsUserError(
+            f"a state mesh needs >= 2 devices (got {len(devices)}); with one"
+            " device every shard rule is a no-op — leave sharding off instead"
+        )
+    return Mesh(np.asarray(devices), (STATE_AXIS,))
+
+
+def _env_mesh():
+    """The mesh the ``TORCHMETRICS_TPU_SHARD`` env var names, or ``None``.
+
+    ``""``/``"0"``/``"off"`` = off; ``"1"``/``"on"``/``"all"`` = every local
+    device; an integer N >= 2 = the first N. Anything else fails loud (the
+    PR-7 env contract: a typo must not silently change placement semantics).
+    """
+    raw = os.environ.get(SHARD_ENV_VAR, "").strip().lower()
+    if raw in ("", "0", "off"):
+        return None
+    if raw in ("1", "on", "all"):
+        return build_mesh()
+    try:
+        n = int(raw)
+    except ValueError:
+        raise TorchMetricsUserError(
+            f"{SHARD_ENV_VAR}={raw!r} is not a valid state-mesh size (expected"
+            " unset/'0'/'off', '1'/'on'/'all', or an integer N >= 2)"
+        ) from None
+    return build_mesh(n)
+
+
+def metric_mesh():
+    """The active state mesh, or ``None`` (sharding off — replicated state)."""
+    if _mesh_override is not _UNSET:
+        return _mesh_override
+    return _env_mesh()
+
+
+def set_mesh(mesh: Any = None) -> None:
+    """Force the state mesh process-wide.
+
+    Accepts a ready :class:`jax.sharding.Mesh`, an integer device count,
+    ``True`` (all local devices), or ``False`` (force sharding OFF regardless
+    of the env var — the same spelling :func:`mesh_context` accepts); ``None``
+    restores env-var resolution.
+    """
+    global _mesh_override
+    if mesh is None:
+        _mesh_override = _UNSET
+    elif mesh is False:
+        # bool before int: isinstance(False, int) is True, and the build_mesh
+        # size check would raise a baffling "got False" instead of disabling
+        _mesh_override = None
+    elif mesh is True:
+        _mesh_override = build_mesh()
+    elif isinstance(mesh, int):
+        _mesh_override = build_mesh(mesh)
+    else:
+        _mesh_override = mesh
+
+
+@contextmanager
+def mesh_context(mesh: Any = True) -> Generator[Any, None, None]:
+    """Scoped state-mesh activation (tests, benches, serving loops).
+
+    ``mesh`` as in :func:`set_mesh` (``False`` forces sharding OFF inside the
+    scope regardless of the env var). Yields the active mesh (or ``None``).
+    Placement happens at ``add_state`` / :func:`reshard_states` time — states
+    born inside the scope stay sharded after it exits (arrays are committed);
+    only NEW placements see the restored policy.
+    """
+    global _mesh_override
+    prev = _mesh_override
+    set_mesh(mesh)
+    try:
+        yield metric_mesh()
+    finally:
+        _mesh_override = prev
+
+
+def sharding_enabled() -> bool:
+    """Whether an active mesh makes shard rules resolve to real placements."""
+    return metric_mesh() is not None
+
+
+def axis_size() -> int:
+    """Devices along the ``"state"`` axis of the active mesh (1 when off)."""
+    mesh = metric_mesh()
+    return 1 if mesh is None else int(mesh.shape[STATE_AXIS])
+
+
+# ------------------------------------------------------------------ predicates
+
+
+def is_sharded(value: Any) -> bool:
+    """True when ``value`` is a live array actually partitioned across devices.
+
+    Placement truth, not spec truth: a state whose rule degraded to
+    replication (no mesh at construction, indivisible leading dim) answers
+    False, so consumers (the packed gather's skip, the restore fold) follow
+    what the buffers really are. Mesh-replicated arrays (``PartitionSpec()``
+    over the mesh) are NOT sharded — every device holds the whole value and
+    the host can read it like any single-device array.
+    """
+    sharding = getattr(value, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return not sharding.is_fully_replicated and len(sharding.device_set) > 1
+    except Exception:  # noqa: BLE001 — exotic sharding types read as replicated
+        return False
+
+
+def spans_processes(value: Any) -> bool:
+    """Whether ``value``'s placement covers devices of more than one process.
+
+    The multi-host safety predicate: a sharded state whose mesh spans every
+    process IS globally synced by its in-graph collectives, so skipping the
+    host gather is exact; a sharded state on a process-LOCAL mesh in a
+    multi-process world only folded local contributions — the sync driver
+    warns loudly instead of silently serving partial totals.
+    """
+    sharding = getattr(value, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len({d.process_index for d in sharding.device_set}) > 1
+    except Exception:  # noqa: BLE001 — exotic device types read as local
+        return False
+
+
+def partition_dim0(spec: Any, value: Any = None):
+    """Resolve a dim-0 partition rule to a ``NamedSharding``, or ``None``.
+
+    ``None`` (replicate) when: no active mesh, no value to inspect, a scalar
+    value, or a leading dim the mesh axis does not divide evenly (JAX's
+    ``device_put`` requires divisibility; padding a *state* would corrupt fold
+    semantics, so the rule degrades instead — recorded as a ``shard.fallback``
+    event, since an active mesh failing to shard is an operator-visible fact).
+    """
+    mesh = metric_mesh()
+    if mesh is None or value is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shape = tuple(getattr(value, "shape", ()))
+    n = int(mesh.shape[STATE_AXIS])
+    if not shape or shape[0] % n != 0:
+        _diag.record(
+            "shard.fallback", "sharding",
+            state=getattr(spec, "name", ""), rule=getattr(spec, "shard_rule", ""),
+            reason="indivisible" if shape else "scalar", shape=shape, axis=n,
+        )
+        return None
+    return NamedSharding(mesh, PartitionSpec(STATE_AXIS))
+
+
+# ------------------------------------------------------------------ placement
+
+
+def place_state(metric: Any, name: str, value: Any, spec: Any) -> Any:
+    """``device_put`` one state onto its rule's resolved sharding (or no-op).
+
+    The born-distributed entry point ``add_state`` calls: the registered
+    default itself is placed, so the state never materializes unsharded and
+    ``reset()`` restores the sharded default by reference. Counted in
+    ``shard_states`` and recorded as a ``shard.place`` event.
+    """
+    from torchmetrics_tpu.engine import statespec as _statespec
+
+    sharding = _statespec.resolve_shard_rule(spec, value)
+    if sharding is None:
+        return value
+    import jax
+
+    placed = jax.device_put(value, sharding)
+    global _ever_placed
+    _ever_placed = True
+    _STATS.shard_states += 1
+    _diag.record(
+        "shard.place", type(metric).__name__,
+        state=name, rule=spec.shard_rule, axis=axis_size(),
+        shape=tuple(getattr(value, "shape", ())),
+    )
+    return placed
+
+
+def reshard_states(metric: Any) -> int:
+    """Re-apply the registered shard rules to a metric's live states.
+
+    The restore-side half of born-distributed: host round-trips
+    (``load_state_dict``, unpickling, ``restore_resharded``) hand back
+    single-device arrays, and this walks the spec registry and ``device_put``s
+    every rule-carrying state — live value, registered default, and any
+    compensation residual — back onto the resolved sharding. A no-op (returns
+    0) when no mesh is active or every rule resolves to replication.
+    """
+    specs = metric.__dict__.get("_state_specs") or {}
+    if not specs or metric_mesh() is None:
+        return 0
+    from torchmetrics_tpu.engine import statespec as _statespec
+
+    import jax
+
+    placed = 0
+    residuals = metric.__dict__.get("_comp_residuals") or {}
+    for name, spec in specs.items():
+        if getattr(spec, "shard_rule", "replicate") == "replicate":
+            continue
+        for holder, getter, setter in (
+            ("state", lambda: getattr(metric, name, None),
+             lambda v: setattr(metric, name, v)),
+            ("default", lambda: metric._defaults.get(name),
+             lambda v: metric._defaults.__setitem__(name, v)),
+            ("residual", lambda: residuals.get(name),
+             lambda v: residuals.__setitem__(name, v)),
+        ):
+            value = getter()
+            if value is None or isinstance(value, list) or not hasattr(value, "shape"):
+                continue
+            sharding = _statespec.resolve_shard_rule(spec, value)
+            if sharding is None or getattr(value, "sharding", None) == sharding:
+                continue
+            setter(jax.device_put(value, sharding))
+            placed += 1
+    if placed:
+        global _ever_placed
+        _ever_placed = True
+        _STATS.shard_states += placed
+        _diag.record("shard.reshard", type(metric).__name__, placed=placed, axis=axis_size())
+    return placed
+
+
+# ------------------------------------------------------------------ engine glue
+
+
+def state_out_shardings(example_state: Any) -> Optional[Any]:
+    """``out_shardings`` pytree for a compiled step over ``example_state``.
+
+    ``None`` when no leaf is partitioned (the common case — ``jax.jit`` keeps
+    its default placement behavior, byte-identical to pre-sharding builds).
+    Otherwise a matching pytree carrying each partitioned leaf's live
+    ``NamedSharding`` and ``None`` (unspecified) for everything else — riders
+    and scalar states come back mesh-replicated, sharded states come back
+    sharded, and the executable lowers as one SPMD program whose cross-shard
+    reductions are in-graph ``psum``/``psum_scatter``.
+    """
+    import jax
+
+    if not any(is_sharded(v) for v in jax.tree_util.tree_leaves(example_state)):
+        return None
+    return jax.tree_util.tree_map(
+        lambda v: v.sharding if is_sharded(v) else None, example_state
+    )
+
+
+def placement_token(state: Any) -> str:
+    """Cache-key component naming a state pytree's device placement.
+
+    Single-device pytrees yield the bare device string (the pre-sharding
+    token, so warm caches key identically to older builds). Partitioned
+    leaves append their ``PartitionSpec`` + sorted device ids: a state
+    re-placed onto a different mesh or spec — or gathered back to one device
+    — keys a fresh executable instead of dispatching a stale one compiled for
+    the old placement (AOT executables are pinned to their example shardings).
+
+    Hot-path cost: this runs inside the per-step dispatch key build, so until
+    the process has placed at least one state distributed it short-circuits
+    to the first leaf's device string — the exact pre-sharding token at the
+    exact pre-sharding O(1) cost. Once sharding is live (a one-way latch:
+    even a later gather-back-to-one-device must re-key), the full per-leaf
+    walk applies.
+    """
+    import jax
+
+    if not _ever_placed:
+        for leaf in jax.tree_util.tree_leaves(state):
+            try:
+                return str(next(iter(leaf.devices())))
+            except Exception:  # noqa: BLE001 — abstract/deleted leaves carry no device
+                break
+        return ""
+
+    first = ""
+    parts = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        if not first:
+            try:
+                first = str(next(iter(leaf.devices())))
+            except Exception:  # noqa: BLE001 — deleted/abstract leaves carry no device
+                continue
+        if is_sharded(leaf):
+            ids = ",".join(str(d.id) for d in sorted(sharding.device_set, key=lambda d: d.id))
+            parts.append(f"{sharding.spec}@{ids}")
+    return first if not parts else first + "|" + ";".join(parts)
+
+
+def shard_report() -> Dict[str, Any]:
+    """Process-wide sharding facts for telemetry/bench evidence."""
+    mesh = metric_mesh()
+    return {
+        "active": mesh is not None,
+        "axis_size": axis_size(),
+        "devices": [] if mesh is None else [int(d.id) for d in mesh.devices.flat],
+        "shard_states": _STATS.shard_states,
+    }

@@ -14,10 +14,12 @@ compiled updates and the packed epoch sync like any accumulator:
   The count-min grid folds cross-rank by **elementwise sum** (the existing
   reduce role; CMS(A) + CMS(B) == CMS(A ∪ B) exactly), while the
   ``(ids, counts)`` top-k pair needs a JOINT fold against the merged grid —
-  registered as the ``hh-ids``/``hh-counts`` packed-spec role in
-  ``parallel/packing.py`` (the metric declares ``_hh_fold_info``; membership
+  registered as the ``hh-ids``/``hh-counts`` :class:`StateSpec` roles
+  (``engine/statespec.py``) that ``parallel/packing.py`` resolves; membership
   is a function of the metric definition alone, so rank layouts cannot
-  desynchronize).
+  desynchronize. (The deprecated ``_hh_fold_info`` attribute mirror is gone —
+  out-of-tree metrics declare the pair through ``add_state(spec=...)``, or
+  keep setting the attribute and ride the counted legacy-derivation fallback.)
 
 All hashing stays in uint32 space (murmur3 finalizer) so the sketches behave
 identically with and without the x64 flag; ids must be non-negative (−1 is the
@@ -201,10 +203,10 @@ class HeavyHitters(Metric):
     ``<= e * N / width`` at probability ``1 - e^-depth``.
 
     Cross-rank sync: the grid sums (exact); the ``(ids, counts)`` pair folds
-    jointly through the ``hh-ids``/``hh-counts`` packed role declared via
-    ``_hh_fold_info`` (union of per-rank candidates re-estimated against the
-    merged grid — identical to a single-rank pass whenever each true heavy
-    hitter made some rank's local list).
+    jointly through the ``hh-ids``/``hh-counts`` roles its registered
+    :class:`StateSpec`s declare (union of per-rank candidates re-estimated
+    against the merged grid — identical to a single-rank pass whenever each
+    true heavy hitter made some rank's local list).
     """
 
     full_state_update = True
@@ -251,13 +253,6 @@ class HeavyHitters(Metric):
             "hh_counts", default=jnp.zeros((k,), idt), dist_reduce_fx=_rank_zero_fold,
             spec={"role": "hh-counts", "dtype_policy": "count"},
         )
-        # deprecated attribute-convention mirror of the specs above, kept one
-        # release for out-of-tree code that reads it; packing resolves from
-        # the specs and never consults this
-        self._hh_fold_info = {
-            "ids": "hh_ids", "counts": "hh_counts", "cms": "cms",
-            "k": k, "depth": depth, "width": width,
-        }
         from torchmetrics_tpu.serve import stats as _serve_stats
 
         _serve_stats.register_sketch(self)

@@ -159,12 +159,6 @@ class TenantSlices(Metric):
             dist_reduce_fx=_rank_zero_fold,
             spec={"role": "hh-counts", "dtype_policy": "count"},
         )
-        # deprecated attribute-convention mirror, kept one release for
-        # out-of-tree readers; packing resolves from the specs
-        self._hh_fold_info = {
-            "ids": "spill_ids", "counts": "spill_counts", "cms": "spill_cms",
-            "k": spill_k, "depth": spill_depth, "width": spill_width,
-        }
         self._spill_geom = (spill_k, spill_depth, spill_width)
         self._np_defaults = capture_np_defaults(template, self._base_keys)
         _serve_stats.register_tenancy(self)
