@@ -71,6 +71,15 @@ _TRACKED = (
     ("scan", "scan_steps_folded", None),
     ("scan", "scan_host_transfers", "max"),
     ("scan", "scan_ragged_retraces_after_warmup", "max"),
+    # async pipelined dispatch (engine/async_dispatch.py, PR 13): the gated
+    # enqueue-cost ratio and absolute latencies are display (check_counters
+    # owns the <= 1/4 bound); transfers/retraces/replays must never creep.
+    ("async", "async_enqueue_cost_ratio", None),
+    ("async", "async_enqueue_p50_us", None),
+    ("async", "async_overlap_us", None),
+    ("async", "async_host_transfers", "max"),
+    ("async", "async_retraces_after_warmup", "max"),
+    ("async", "async_replayed_steps", "max"),
     # serving layer (serve/, PR 9): streaming-loop timing is display (machine-
     # dependent); transfers/retraces/executable-sharing and the HLL error gate.
     ("serve", "windowed_us_per_step", None),
@@ -93,6 +102,24 @@ _TRACKED = (
     ("cse", "cse_spec_fallbacks", "max"),
 )
 
+#: the multi-chip evidence trajectory (MULTICHIP_r*.json, PR 12 onward): the
+#: sharding block lives at the file's top level ("sharding"), unlike the
+#: BENCH rounds' "extras" envelope. Counters a round predates print as "-"
+#: (pre-sharding rounds are raw runner transcripts with no counter block).
+#: Gates compare the FRESH run's sharding scenario against the newest
+#: committed multi-chip round — without this, the sharding trajectory was
+#: invisible to the trend gate entirely.
+_MULTICHIP_TRACKED = (
+    ("sharding", "shard_states", "max"),  # placements must not silently shrink... or grow unbounded
+    ("sharding", "psum_syncs", None),
+    ("sharding", "gather_skipped", None),
+    ("sharding", "sharding_footprint_fraction", "max"),  # per-device bytes ~1/mesh
+    ("sharding", "sharding_host_transfers", "max"),
+    ("sharding", "sharding_retraces_after_warmup", "max"),
+    ("sharding", "million_class_update_executables", "max"),  # ONE SPMD graph
+    ("sharding", "million_class_us_per_step", None),  # machine-dependent: display
+)
+
 _TOL = 1e-6
 
 
@@ -101,6 +128,16 @@ def rounds(repo: str = REPO):
     found = []
     for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
         match = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if match:
+            found.append((int(match.group(1)), path))
+    return sorted(found)
+
+
+def multichip_rounds(repo: str = REPO):
+    """[(round_number, path)] for every committed MULTICHIP_r*.json, in order."""
+    found = []
+    for path in glob.glob(os.path.join(repo, "MULTICHIP_r*.json")):
+        match = re.fullmatch(r"MULTICHIP_r(\d+)\.json", os.path.basename(path))
         if match:
             found.append((int(match.group(1)), path))
     return sorted(found)
@@ -129,6 +166,15 @@ def _fmt(value) -> str:
     return str(value)
 
 
+def _multichip_counter(payload: dict, scenario: str, counter: str):
+    # MULTICHIP rounds carry the scenario block at top level (no "extras"
+    # envelope); pre-sharding rounds are raw runner transcripts — tolerate both
+    block = payload.get(scenario)
+    if not isinstance(block, dict):
+        return None
+    return block.get(counter)
+
+
 def print_trajectory(history) -> None:
     names = [f"{s}.{c}" for s, c, _ in _TRACKED]
     name_w = max(len(n) for n in names)
@@ -140,7 +186,18 @@ def print_trajectory(history) -> None:
         print(f"  {name:<{name_w}}  " + "  ".join(f"{c:>{col_w}}" for c in cells))
 
 
-def gate(fresh: dict, baseline: dict, baseline_name: str) -> int:
+def print_multichip_trajectory(history) -> None:
+    names = [f"{s}.{c}" for s, c, _ in _MULTICHIP_TRACKED]
+    name_w = max(len(n) for n in names)
+    cols = [f"r{num:02d}" for num, _ in history]
+    col_w = max(10, max((len(c) for c in cols), default=3))
+    print(f"  {'counter':<{name_w}}  " + "  ".join(f"{c:>{col_w}}" for c in cols))
+    for (scenario, counter, _), name in zip(_MULTICHIP_TRACKED, names):
+        cells = [_fmt(_multichip_counter(p, scenario, counter)) for _, p in history]
+        print(f"  {name:<{name_w}}  " + "  ".join(f"{c:>{col_w}}" for c in cells))
+
+
+def gate(fresh: dict, baseline: dict, baseline_name: str, multichip=None) -> int:
     failures = []
     for scenario, counter, kind in _TRACKED:
         if kind is None:
@@ -155,6 +212,23 @@ def gate(fresh: dict, baseline: dict, baseline_name: str) -> int:
                 f"{scenario}.{counter}: {got} regressed past the {baseline_name}"
                 f" envelope ({'2x ' if kind == 'slack' else ''}{base})"
             )
+    if multichip is not None:
+        mc_name, mc_payload = multichip
+        for scenario, counter, kind in _MULTICHIP_TRACKED:
+            if kind is None:
+                continue
+            # the fresh run's sharding block rides the BENCH extras envelope;
+            # the committed multi-chip evidence holds it top-level
+            got = _counter(fresh, scenario, counter)
+            base = _multichip_counter(mc_payload, scenario, counter)
+            if got is None or base is None:
+                continue
+            limit = 2.0 * float(base) if kind == "slack" else float(base)
+            if float(got) > limit + _TOL:
+                failures.append(
+                    f"{scenario}.{counter}: {got} regressed past the {mc_name}"
+                    f" multichip envelope ({'2x ' if kind == 'slack' else ''}{base})"
+                )
     if failures:
         print("\nbench trend gate: FAILED")
         for failure in failures:
@@ -185,6 +259,17 @@ def main(argv=None) -> int:
     print(f"bench counter trajectory over {len(history)} committed rounds:")
     print_trajectory(history)
 
+    mc_history = []
+    for num, path in multichip_rounds():
+        try:
+            with open(path) as fh:
+                mc_history.append((num, json.load(fh)))
+        except (OSError, json.JSONDecodeError) as err:
+            print(f"bench trend: skipping unreadable {os.path.basename(path)}: {err}")
+    if mc_history:
+        print(f"\nmulti-chip counter trajectory over {len(mc_history)} committed rounds:")
+        print_multichip_trajectory(mc_history)
+
     if args.bench_json is None:
         return 0
     try:
@@ -194,7 +279,11 @@ def main(argv=None) -> int:
         print(f"bench trend: cannot read --bench-json: {err}")
         return 2
     newest_num, newest = history[-1]
-    return gate(fresh, newest, f"BENCH_r{newest_num:02d}")
+    multichip = None
+    if mc_history:
+        mc_num, mc_payload = mc_history[-1]
+        multichip = (f"MULTICHIP_r{mc_num:02d}", mc_payload)
+    return gate(fresh, newest, f"BENCH_r{newest_num:02d}", multichip=multichip)
 
 
 if __name__ == "__main__":

@@ -76,6 +76,21 @@ and fails CI when any counter regresses past the committed baseline
   (``scan_host_transfers`` == 0); on a TPU-less run the micro fallback must
   additionally prove NO gated scenario was skipped
   (``micro_fallback.scenarios_missing`` empty)
+- async pipelined dispatch proofs (``engine/async_dispatch.py``): with the
+  double-buffered background drain on, the caller-side p50 enqueue cost is
+  ≤ 1/4 of the synchronous K=8 scan per-step cost — gated on the PAIRED
+  per-window ratio (``async_enqueue_cost_ratio``; the absolute µs figures are
+  machine-dependent and export as slack tripwires) — drains genuinely execute
+  off the caller (``async_dispatches``) with the overlap attributed both as
+  ``async_overlap_us`` and as worker-track ``async.drain`` spans in the
+  PR-5 merged timeline (``async_overlap_in_timeline_ok``), state stays
+  byte-identical to the synchronous path with a mid-queue quarantined batch
+  and compensated sums composed (``async_parity_ok``), the clean run loses
+  no payload to a worker failure (``async_replayed_steps`` == 0), the async
+  tier adds NO new executables (``async_retraces_after_warmup`` == 0 — it
+  reuses the scan tier's cache), and the STRICT transfer guard, propagated
+  onto the worker thread via the submit context, records 0 transfers
+  (``async_host_transfers`` == 0)
 - cross-metric CSE proofs (``engine/statespec.py`` + ``collections.py``): the
   10-metric stat-scores-family collection resolves to ONE compute group at
   CONSTRUCTION (``cse_groups`` == 1, ``cse_discovered_at_construction``),
@@ -245,6 +260,30 @@ _CHECKS = (
     ("scan", "scan_retraces_uncaused", "abs", 0),  # every retrace attributed
     ("scan", "scan_events_per_drain_ok", "true", None),  # 1 update.scan per drain
     ("scan", "scan_flush_on_observation_ok", "true", None),  # compute() drained first
+    # async pipelined dispatch gates (engine/async_dispatch.py, PR 13): the
+    # double-buffered background drain must make update() a pure enqueue —
+    # caller-side p50 enqueue cost <= 1/4 of the synchronous K=8 scan
+    # per-step cost, gated on the PAIRED per-window ratio (machine-load noise
+    # is common-mode within a window; the absolute µs figures export as
+    # machine-dependent tripwires under slack) — while drains genuinely ride
+    # the worker (async_dispatches truthy, overlap_us attributed both as a
+    # counter and as worker-track spans in the merged timeline), parity stays
+    # byte-identical with mid-queue quarantine + compensation composed, no
+    # payload is ever lost to a worker failure on the clean run, and the
+    # STRICT guard — propagated across the thread hop — records 0 transfers
+    ("async", "async_enqueue_cost_ratio", "abs", 0.25),
+    ("async", "async_enqueue_p50_us", "slack", 60.0),  # wall tripwire, not the gate
+    ("async", "async_dispatches", "true", None),  # drains actually rode the worker
+    ("async", "async_joins", "true", None),  # observations actually joined
+    ("async", "async_overlap_ok", "true", None),  # overlap_us > 0: caller made progress
+    ("async", "async_overlap_in_timeline_ok", "true", None),  # attributed in the merge
+    ("async", "async_events_per_drain_ok", "true", None),  # one async.drain per drain
+    ("async", "async_parity_ok", "true", None),  # byte-identical, riders composed
+    ("async", "async_quarantined_batches", "eqfield", "async_quarantine_planted"),
+    ("async", "async_replayed_steps", "abs", 0),  # clean run: no worker failure
+    ("async", "async_retraces_after_warmup", "abs", 0),  # same cached executables
+    ("async", "async_retraces_uncaused", "abs", 0),
+    ("async", "async_host_transfers", "abs", 0),  # STRICT guard held across threads
     # cross-metric CSE gates (engine/statespec.py + collections.py, PR 11):
     # a 10-metric stat-scores-family collection shares ONE state-producing
     # reduction — discovered at CONSTRUCTION from declared reduction
@@ -324,7 +363,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "cse", "sharding"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

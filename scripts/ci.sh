@@ -121,6 +121,21 @@ elif ! grep -q '"scan_dispatch_amortization_k8": 8.0' "$BENCH_OUT" \
   # ragged queue tails, flush on observation, and hold the STRICT guard
   echo "bench smoke: FAILED (multi-step scan fold/parity/flush proofs missing or degraded)"
   status=1
+elif ! grep -q '"async_parity_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"async_overlap_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"async_overlap_in_timeline_ok": true' "$BENCH_OUT" \
+  || ! grep -q '"async_replayed_steps": 0' "$BENCH_OUT" \
+  || ! grep -q '"async_retraces_after_warmup": 0' "$BENCH_OUT" \
+  || ! grep -q '"async_host_transfers": 0' "$BENCH_OUT" \
+  || ! grep -q '"async_enqueue_cost_ratio"' "$BENCH_OUT"; then
+  # async dispatch smoke (engine/async_dispatch.py gate): background drains
+  # must stay byte-identical to the synchronous scan path (riders composed),
+  # attribute real overlap (counter + merged-timeline spans), lose no payload
+  # on the clean run, add no executables past the scan tier's cache, and hold
+  # the STRICT guard across the worker-thread hop; the <= 1/4 enqueue-cost
+  # ratio itself gates numerically in check_counters
+  echo "bench smoke: FAILED (async background-drain overlap/parity/replay proofs missing or degraded)"
+  status=1
 elif ! grep -q '"cse_groups": 1' "$BENCH_OUT" \
   || ! grep -q '"cse_discovered_at_construction": true' "$BENCH_OUT" \
   || ! grep -q '"cse_shared_reduction_traces": 1' "$BENCH_OUT" \
@@ -136,7 +151,7 @@ elif ! grep -q '"cse_groups": 1' "$BENCH_OUT" \
   echo "bench smoke: FAILED (cross-metric CSE shared-reduction proofs missing or degraded)"
   status=1
 else
-  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve + scan + cse counters present)"
+  echo "bench smoke: ok (rc=0, status markers + engine + epoch + telemetry + profiling + chaos + txn + numerics + serve + scan + async + cse counters present)"
 fi
 
 echo

@@ -1,0 +1,506 @@
+"""Async pipelined dispatch tests (engine/async_dispatch.py + engine/scan.py):
+double-buffered background drains, the join contract, backpressure, caller-side
+failure replay, prefetch staging, overlap attribution, the pause-free sidecar
+scrape, and the concurrent-observer stress proof."""
+
+import http.client
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection, SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.diag import diag_context, transfer_guard
+from torchmetrics_tpu.diag.hist import histograms_snapshot
+from torchmetrics_tpu.engine import (
+    async_context,
+    compensated_context,
+    engine_context,
+    quarantine_context,
+    scan_context,
+    set_async_dispatch,
+)
+from torchmetrics_tpu.engine.async_dispatch import (
+    DEFAULT_INFLIGHT,
+    MAX_INFLIGHT,
+    async_inflight,
+    coerce_inflight,
+    note_epoch_sync,
+    resolve_async,
+)
+from torchmetrics_tpu.engine import scan as scan_mod
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(n, NUM_CLASSES).astype(np.float32)),
+         jnp.asarray(rng.randint(0, NUM_CLASSES, n).astype(np.int32)))
+        for n in sizes
+    ]
+
+
+def _acc(**kw):
+    return MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False, **kw)
+
+
+def _states(m):
+    return {s: np.asarray(getattr(m, s)) for s in m._defaults}
+
+
+# ---------------------------------------------------------------- knobs
+
+
+def test_env_var_fail_loud(monkeypatch):
+    """Invalid TORCHMETRICS_TPU_ASYNC values raise instead of silently disabling."""
+    for bad in ("banana", "-1", str(MAX_INFLIGHT + 1), "1.5", "true"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_ASYNC", bad)
+        with pytest.raises(TorchMetricsUserError):
+            async_inflight()
+    for off in ("", "0", "off"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_ASYNC", off)
+        assert async_inflight() is None
+    monkeypatch.setenv("TORCHMETRICS_TPU_ASYNC", "1")
+    assert async_inflight() == DEFAULT_INFLIGHT
+    monkeypatch.setenv("TORCHMETRICS_TPU_ASYNC", "on")
+    assert async_inflight() == DEFAULT_INFLIGHT
+    monkeypatch.setenv("TORCHMETRICS_TPU_ASYNC", "4")
+    assert async_inflight() == 4
+
+
+def test_kwarg_and_override_resolution(monkeypatch):
+    monkeypatch.delenv("TORCHMETRICS_TPU_ASYNC", raising=False)
+    assert async_inflight() is None
+    assert coerce_inflight(True) == DEFAULT_INFLIGHT
+    assert coerce_inflight(False) == 0
+    assert coerce_inflight(3) == 3
+    with pytest.raises(TorchMetricsUserError):
+        coerce_inflight(MAX_INFLIGHT + 1)
+    with pytest.raises(TorchMetricsUserError):
+        coerce_inflight("2")
+    with async_context():
+        assert async_inflight() == DEFAULT_INFLIGHT
+        # per-metric kwarg outranks the context: 0/False forces off
+        m_off = _acc(async_dispatch=False)
+        assert resolve_async(m_off.async_dispatch) is None
+        m_on = _acc(async_dispatch=4)
+        assert resolve_async(m_on.async_dispatch) == 4
+    assert async_inflight() is None
+    set_async_dispatch(3)
+    try:
+        assert async_inflight() == 3
+    finally:
+        set_async_dispatch(None)
+    with pytest.raises(TorchMetricsUserError):
+        _acc(async_dispatch="yes")
+    with pytest.raises(TorchMetricsUserError):
+        MetricCollection(
+            {"a": _acc(), "b": MulticlassPrecision(NUM_CLASSES, validate_args=False)},
+            async_dispatch=99,
+        )
+
+
+def test_engine_off_metric_never_reads_async_env(monkeypatch):
+    """The env knob resolves only where a scan queue is active — a typo'd
+    TORCHMETRICS_TPU_ASYNC cannot raise on configurations that never read it."""
+    monkeypatch.setenv("TORCHMETRICS_TPU_ASYNC", "banana")
+    p, t = _batches([4])[0]
+    with engine_context(False):
+        m = _acc()
+        m.update(p, t)  # engine off: no scan queue, no async consult
+        m.compute()
+    with engine_context(True):
+        m = _acc(scan_steps=0)  # scan forced off per metric: still no consult
+        m.update(p, t)
+        m.compute()
+    with engine_context(True), scan_context(4):
+        m = _acc()
+        with pytest.raises(TorchMetricsUserError):
+            m.update(p, t)  # scan active -> the knob IS read -> fail loud
+
+
+# ---------------------------------------------------------------- core behavior
+
+
+def test_async_parity_with_sync_scan_and_step_at_a_time():
+    """Byte parity incl. a mid-queue quarantined batch + compensated sums."""
+    stream = _batches([8] * 24, seed=3)
+    nan_preds = jnp.asarray(np.full((8, NUM_CLASSES), np.nan, np.float32))
+    poisoned = {5, 13}
+
+    def run(scan_k, use_async):
+        with engine_context(True, donate=True), quarantine_context(True), compensated_context(True):
+            from contextlib import nullcontext
+
+            with (scan_context(scan_k) if scan_k else nullcontext()), (
+                async_context() if use_async else nullcontext()
+            ):
+                m = _acc()
+                for i, (p, t) in enumerate(stream):
+                    m.update(nan_preds if i in poisoned else p, t)
+                value = np.asarray(m.compute())
+                states = _states(m)
+        return value, states
+
+    ref_value, ref_states = run(0, False)
+    sync_value, sync_states = run(8, False)
+    async_value, async_states = run(8, True)
+    assert np.array_equal(ref_value, async_value)
+    assert np.array_equal(sync_value, async_value)
+    for s in ref_states:
+        assert np.array_equal(ref_states[s], async_states[s])
+        assert np.array_equal(sync_states[s], async_states[s])
+
+
+def test_background_drains_and_join_on_observation():
+    stream = _batches([8] * 20, seed=1)
+    with engine_context(True, donate=True), scan_context(4), async_context():
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+        st = m._engine.stats
+        # 5 buffers total; the first drain per (signature, K-bucket) compiles
+        # ON THE CALLER (incl. the x64 dtype-promotion re-key), the rest ride
+        # the worker as submits
+        assert st.async_submits >= 2
+        value = m.compute()  # the JOIN: folds the tail + waits the FIFO dry
+        assert st.scan_steps_folded == 20
+        assert st.async_dispatches >= 2  # warm drains genuinely rode the worker
+        assert st.scan_dispatches == 5  # 20 steps / K=4, caller-compiles included
+        assert st.async_replayed_steps == 0
+        assert m._update_count == 20
+        np.asarray(value)
+
+
+def test_backpressure_bounds_inflight_depth():
+    from torchmetrics_tpu.diag.hist import reset_histograms
+
+    reset_histograms()  # the depth histogram is process-wide; isolate from other tests
+    stream = _batches([8] * 64, seed=2)
+    with engine_context(True, donate=True), scan_context(4), async_context(1), diag_context():
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+        m.compute()
+        st = m._engine.stats
+    depth_rows = [
+        h for h in histograms_snapshot()
+        if h["kind"] == "async" and h["series"] == "depth" and h["owner"] == "MulticlassAccuracy"
+    ]
+    assert depth_rows and depth_rows[0]["max"] <= 1.0  # the bound held
+    assert st.async_backpressure_waits > 0  # ...and was actually exercised
+
+
+def test_worker_failure_replays_on_caller(monkeypatch):
+    """A drain failing on the worker hands its payloads back: the next join
+    replays step-at-a-time on the OBSERVER's thread — nothing is lost."""
+    stream = _batches([8] * 12, seed=4)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("planted scan-compile failure")
+
+    with engine_context(True, donate=True):
+        ref = _acc()
+        for p, t in stream:
+            ref.update(p, t)
+        ref_value = np.asarray(ref.compute())
+
+        monkeypatch.setattr(scan_mod, "compile_scan", boom)
+        with scan_context(4), async_context():
+            m = _acc()
+            for p, t in stream:
+                m.update(p, t)
+            value = np.asarray(m.compute())
+            st = m._engine.stats
+    assert np.array_equal(ref_value, value)
+    assert m._update_count == 12
+    assert st.async_replayed_steps > 0
+    assert st.async_dispatches == 0  # no background drain ever succeeded
+    assert any(r.startswith("scan-") for r in st.fallback_reasons)
+
+
+def test_reset_discards_in_flight_settled():
+    stream = _batches([8] * 7, seed=5)
+    with engine_context(True, donate=True), scan_context(4), async_context():
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+        m.reset()  # joins in-flight work, DISCARDS the tail without dispatch
+        assert m._update_count == 0
+        for s, default in m._defaults.items():
+            assert np.array_equal(np.asarray(getattr(m, s)), np.asarray(default))
+        p, t = stream[0]
+        m.update(p, t)
+        value = np.asarray(m.compute())
+        fresh = _acc(compiled_update=True)
+        fresh.update(p, t)
+    assert np.array_equal(value, np.asarray(fresh.compute()))
+
+
+def test_fused_collection_async_parity():
+    stream = _batches([8] * 16, seed=6)
+
+    def run(use_async):
+        from contextlib import nullcontext
+
+        with engine_context(True, donate=True), scan_context(4), (
+            async_context() if use_async else nullcontext()
+        ):
+            mc = MetricCollection(
+                {
+                    "acc": _acc(),
+                    "prec": MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+                }
+            )
+            for p, t in stream:
+                mc.update(p, t)
+            values = {k: np.asarray(v) for k, v in mc.compute().items()}
+        return values
+
+    sync_values = run(False)
+    async_values = run(True)
+    assert set(sync_values) == set(async_values)
+    for k in sync_values:
+        assert np.array_equal(sync_values[k], async_values[k]), k
+
+
+def test_scope_exit_joins_and_flushes():
+    stream = _batches([8] * 6, seed=7)
+    with engine_context(True, donate=True):
+        m = _acc()
+        with scan_context(4), async_context():
+            for p, t in stream:
+                m.update(p, t)
+        # outside the scopes: everything folded, nothing in flight
+        sq = m._engine._scan
+        assert sq.pending == 0
+        assert m._engine.stats.scan_steps_folded == 6
+        value = np.asarray(m.compute())
+        ref = _acc(compiled_update=True)
+        for p, t in stream:
+            ref.update(p, t)
+        assert np.array_equal(value, np.asarray(ref.compute()))
+
+
+def test_async_without_scan_is_inert():
+    p, t = _batches([8])[0]
+    with engine_context(True, donate=True), async_context():
+        m = _acc()
+        for _ in range(6):
+            m.update(p, t)
+        m.compute()
+        st = m._engine.stats
+    assert st.async_submits == 0  # no scan queue -> nothing to drain in background
+    assert st.scan_dispatches == 0
+
+
+def test_prefetch_stages_host_arrays():
+    rng = np.random.RandomState(8)
+    host_stream = [
+        (rng.rand(8, NUM_CLASSES).astype(np.float32), rng.randint(0, NUM_CLASSES, 8).astype(np.int32))
+        for _ in range(8)
+    ]
+    with engine_context(True, donate=True), scan_context(4), async_context():
+        m = _acc()
+        for p, t in host_stream:
+            m.update(p, t)
+        value = np.asarray(m.compute())
+        st = m._engine.stats
+        assert st.async_prefetches > 0  # numpy payloads were device_put-staged
+        ref = _acc(compiled_update=True)
+        for p, t in host_stream:
+            ref.update(jnp.asarray(p), jnp.asarray(t))
+    assert np.array_equal(value, np.asarray(ref.compute()))
+
+
+def test_overlap_attributed_and_timeline_spans():
+    from torchmetrics_tpu.diag.timeline import merge_timelines
+
+    stream = _batches([8] * 16, seed=9)
+    with engine_context(True, donate=True), scan_context(4), async_context(), diag_context() as rec:
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+            time.sleep(0.0005)  # caller forward progress the drain overlaps
+        m.compute()
+        st = m._engine.stats
+    assert st.async_overlap_us > 0
+    drains = [e for e in rec.snapshot() if e.kind == "async.drain"]
+    assert drains and all("overlap_us" in e.data for e in drains)
+    # (an `async.join` event only records when the observer actually WAITED —
+    # with the inter-update sleep the drains usually finish first, which is
+    # exactly the overlap this test proves)
+    trace = merge_timelines([{"rank": 0, "events": rec.snapshot()}])
+    span_names = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert "async.drain" in span_names  # the overlap is VISIBLE in the merged timeline
+
+
+def test_epoch_sync_overlap_note_credits_at_join():
+    stream = _batches([8] * 8, seed=10)
+    with engine_context(True, donate=True), scan_context(4), async_context(), diag_context() as rec:
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+        st = m._engine.stats
+        before = st.async_overlap_us
+        note_epoch_sync(st)  # what engine/epoch.py stamps after a packed sync
+        m._drain_scan("test-join")
+        assert st.async_overlap_us >= before
+        assert any(e.kind == "async.sync.overlap" for e in rec.snapshot())
+
+
+def test_strict_guard_zero_transfers_across_background_drains():
+    stream = _batches([8] * 44, seed=11)
+    with engine_context(True, donate=True), scan_context(8), async_context():
+        m = _acc()
+        for p, t in stream[:16]:  # warm outside the guard
+            m.update(p, t)
+        m._drain_scan("warmup")
+        with diag_context(capacity=8192) as rec, transfer_guard("strict"):
+            for p, t in stream[16:]:
+                m.update(p, t)
+            value = m.compute()  # joins + drains in-graph; value read below
+        value = np.asarray(value)
+        assert rec.count("transfer.host", "transfer.blocked") == 0
+        assert m._engine.stats.async_dispatches > 0
+    assert value.shape == ()
+
+
+# ---------------------------------------------------------------- serving
+
+
+def _http_get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_sidecar_scrape_rides_pause_free_path(monkeypatch):
+    """Satellite: a scrape under async mode joins background work on the
+    SCRAPE thread — the flush it observes rode the worker, and the event
+    stream proves both the watermark and the route."""
+    from torchmetrics_tpu.serve.sidecar import MetricsSidecar
+
+    monkeypatch.setenv("TORCHMETRICS_TPU_TRACE", "1")
+    stream = _batches([8] * 10, seed=12)
+    try:
+        with engine_context(True, donate=True), scan_context(4), async_context():
+            m = _acc()
+            for p, t in stream:
+                m.update(p, t)
+            st = m._engine.stats
+            with MetricsSidecar(port=0) as sidecar:
+                status, body = _http_get(sidecar.port, "/metrics")
+            assert status == 200
+            # the scrape observed the full watermark: every enqueued step folded
+            assert st.scan_steps_folded == 10
+            assert b"tm_tpu_async_dispatches_total" in body
+            from torchmetrics_tpu.diag.trace import active_recorder
+
+            rec = active_recorder()
+            kinds = rec.counts
+            assert kinds.get("serve.scrape.async", 0) >= 1  # the pause-free route, narrated
+            m.compute()
+    finally:
+        monkeypatch.delenv("TORCHMETRICS_TPU_TRACE", raising=False)
+
+
+def test_concurrent_scrape_snapshot_drain_stress(tmp_path):
+    """Satellite stress proof: one metric under STRICT guard with concurrent
+    sidecar scrapes + continuous snapshots (incl. a SIGTERM-style preemption
+    flush) + background drains — byte parity with the synchronous path and 0
+    host transfers recorded on the hot loop / worker."""
+    from torchmetrics_tpu.parallel.elastic import ContinuousSnapshotter, SnapshotPolicy
+    from torchmetrics_tpu.serve.sidecar import MetricsSidecar
+    from torchmetrics_tpu.serve.snapshot import snapshot_compute
+
+    steps = 160
+    stream = _batches([8] * steps, seed=13)
+
+    with engine_context(True, donate=True):
+        ref = _acc()
+        for p, t in stream:
+            ref.update(p, t)
+        ref_value = np.asarray(ref.compute())
+        ref_states = _states(ref)
+
+        with scan_context(8), async_context():
+            m = _acc()
+            # warm the executables outside the guard (compiles host-transfer free
+            # is not part of the contract)
+            for p, t in stream[:16]:
+                m.update(p, t)
+            m.reset()
+
+            snapper = ContinuousSnapshotter(
+                m, str(tmp_path), policy=SnapshotPolicy(every_updates=50), keep=2
+            )
+            stop = threading.Event()
+            errors = []
+
+            def scraper(port):
+                while not stop.is_set():
+                    try:
+                        status, _ = _http_get(port, "/metrics")
+                        assert status == 200
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(exc)
+                        return
+                    time.sleep(0.002)
+
+            def snapshotter():
+                while not stop.is_set():
+                    try:
+                        snapshot_compute(m)
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(exc)
+                        return
+                    time.sleep(0.003)
+
+            with MetricsSidecar(port=0) as sidecar:
+                threads = [
+                    threading.Thread(target=scraper, args=(sidecar.port,), daemon=True),
+                    threading.Thread(target=snapshotter, daemon=True),
+                ]
+                for th in threads:
+                    th.start()
+                with diag_context(capacity=16384) as rec, transfer_guard("strict"):
+                    for p, t in stream:
+                        m.update(p, t)
+                        snapper.note_update()  # cadence flushes ride the hot thread
+                    value = m.compute()
+                value = np.asarray(value)
+                # the SIGTERM-style preemption flush (the handler's core, without
+                # killing the test process): must write a final restorable shard
+                seq_before = snapper.seq
+                assert snapper.preempt_flush(signal.SIGTERM) is not None
+                assert snapper.seq == seq_before + 1
+                stop.set()
+                for th in threads:
+                    th.join(timeout=10)
+
+            assert not errors, errors[0]
+            st = m._engine.stats
+            # byte parity with the synchronous path, despite the observers
+            assert np.array_equal(ref_value, value)
+            states = _states(m)
+            for s in ref_states:
+                assert np.array_equal(ref_states[s], states[s]), s
+            assert m._update_count == steps
+            # 0 host transfers on the guarded context (hot loop + worker)
+            assert rec.count("transfer.host", "transfer.blocked") == 0
+            assert st.async_dispatches > 0  # drains genuinely rode the worker
+            assert st.async_replayed_steps == 0  # ...and none of them failed
+            assert snapper.flushes >= steps // 50 + 1

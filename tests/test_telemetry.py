@@ -128,6 +128,12 @@ UNITLESS_COUNT_FAMILIES = {
     # SPMD sharded-state engine (parallel/sharding.py, PR 12): placement /
     # in-graph-sync event counts — pure counts, no physical unit
     "tm_tpu_shard_states", "tm_tpu_psum_syncs", "tm_tpu_gather_skipped",
+    # async pipelined dispatch (engine/async_dispatch.py, PR 13): buffer /
+    # drain / join / replay event counts and the in-flight-depth histogram —
+    # pure counts; the time-valued async series export as *_seconds
+    "tm_tpu_async_submits", "tm_tpu_async_dispatches", "tm_tpu_async_joins",
+    "tm_tpu_async_backpressure_waits", "tm_tpu_async_replayed_steps",
+    "tm_tpu_async_prefetches", "tm_tpu_async_queue_depth",
 }
 
 

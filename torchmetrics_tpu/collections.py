@@ -146,6 +146,11 @@ class MetricCollection:
             scan queue off for this collection, or an int K >= 2 to fold K
             collection steps into one donated ``lax.scan`` dispatch
             (``engine/scan.py``).
+        async_dispatch: None (follow the process-wide
+            ``TORCHMETRICS_TPU_ASYNC`` / ``async_context`` policy),
+            ``False``/``0`` to force background drains off, ``True`` / an int
+            in-flight bound to drain this collection's scan buffers on the
+            background worker (``engine/async_dispatch.py``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -162,6 +167,8 @@ class MetricCollection:
     _groups: Dict[int, _ComputeGroup]
     #: class-level default so unpickled pre-scan instances still resolve policy
     scan_steps: Optional[int] = None
+    #: class-level default so unpickled pre-async instances still resolve policy
+    async_dispatch: Optional[int] = None
 
     def __init__(
         self,
@@ -172,6 +179,7 @@ class MetricCollection:
         compute_groups: Union[bool, List[List[str]]] = True,
         fused_dispatch: Optional[bool] = None,
         scan_steps: Optional[int] = None,
+        async_dispatch: Optional[Any] = None,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
@@ -185,6 +193,11 @@ class MetricCollection:
             from torchmetrics_tpu.engine.scan import coerce_k
 
             self.scan_steps = coerce_k(scan_steps)
+        self.async_dispatch = async_dispatch
+        if async_dispatch is not None:
+            from torchmetrics_tpu.engine.async_dispatch import coerce_inflight
+
+            self.async_dispatch = coerce_inflight(async_dispatch)
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
         self._fused_engine = None  # engine/fusion.py executable cache; built lazily
@@ -339,7 +352,12 @@ class MetricCollection:
             # drain donates the owners' buffers, not at the next step
             fe.on_scan_drain = self._anchor_views_after_scan
         if k is not None:
-            handled = fe.scan_step(args, kwargs, k)
+            # async tier resolution mirrors Metric._engine_step: only read
+            # where a scan queue is active, so an invalid TORCHMETRICS_TPU_ASYNC
+            # cannot raise on configurations that never consulted it
+            from torchmetrics_tpu.engine.async_dispatch import resolve_async
+
+            handled = fe.scan_step(args, kwargs, k, resolve_async(self.async_dispatch))
             return (handled if handled is not None else set()), True
         return fe.step(args, kwargs) or set(), False
 

@@ -51,6 +51,14 @@ _COUNTER_HELP = {
     "scan_steps_folded": "real update steps folded across all scan drains",
     "scan_pad_steps": "masked no-op padding steps added to fill scan K-buckets",
     "scan_flushes": "scan-queue flushes (drains + discards)",
+    "async_submits": "scan buffers swapped out and handed to the background drain worker",
+    "async_dispatches": "background drains executed off the caller's thread",
+    "async_joins": "observation joins that waited on in-flight background work",
+    "async_join_wait_us": "host time observers spent waiting at async joins",
+    "async_overlap_us": "drain/sync execution overlapped with caller forward progress",
+    "async_backpressure_waits": "buffer submits that blocked on the bounded in-flight window",
+    "async_replayed_steps": "steps replayed on the caller after a background drain failed",
+    "async_prefetches": "host arrays device_put-staged at enqueue ahead of their drain",
     "quarantined_batches": "poisoned batches skipped in-graph by the quarantine transaction",
     "ladder_retries": "dispatch failures that stepped down the fallback ladder to a smaller bucket",
     "packed_syncs": "packed epoch syncs completed",
@@ -80,6 +88,13 @@ _COUNTER_EXPORT_NAME = {
     "sync_bytes_moved": "sync_moved_bytes",
 }
 
+# µs-valued counters export in SECONDS under a unit-suffixed name (the
+# exposition base-unit rule); the in-repo EngineStats fields stay integral µs
+_COUNTER_EXPORT_SCALE = {
+    "async_join_wait_us": ("async_join_wait_seconds", 1e-6),
+    "async_overlap_us": ("async_overlap_seconds", 1e-6),
+}
+
 # histogram series (diag/hist.py, recorded in µs / bytes) -> exposition
 # family name + value scale. Latencies export in SECONDS, sizes in BYTES —
 # unit-suffixed per the exposition conventions (the test parser rejects
@@ -91,6 +106,11 @@ _HIST_SERIES = {
     "compute_us": ("compute_latency_seconds", 1e-6, "cached/fused compute dispatch wall-time"),
     "sync_bytes": ("sync_size_bytes", 1.0, "bytes through packed-sync collectives per exchange"),
     "scrape_us": ("serve_scrape_latency_seconds", 1e-6, "sidecar scrape handling wall-time"),
+    # async dispatch (engine/async_dispatch.py): per-enqueue caller cost and
+    # the in-flight buffer depth behind the background worker (a pure count —
+    # allowlisted unitless, like the scan step counters)
+    "enqueue_us": ("async_enqueue_latency_seconds", 1e-6, "caller-side cost of one async scan enqueue"),
+    "depth": ("async_queue_depth", 1.0, "in-flight buffers pending behind the background drain worker"),
 }
 
 
@@ -166,6 +186,12 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
 
     for field in sorted(_COUNTER_HELP):
         if field in counters:
+            scaled = _COUNTER_EXPORT_SCALE.get(field)
+            if scaled is not None:
+                name, scale = scaled
+                emit(f"{_PREFIX}_{name}_total", "counter", _COUNTER_HELP[field],
+                     [({}, counters[field] * scale)])
+                continue
             name = _COUNTER_EXPORT_NAME.get(field, field)
             emit(f"{_PREFIX}_{name}_total", "counter", _COUNTER_HELP[field], [({}, counters[field])])
     emit(f"{_PREFIX}_engines", "gauge", "live engine instances", [({}, counters.get("engines", 0))])

@@ -135,9 +135,15 @@ def resolve_arrivals(
 
 # ------------------------------------------------------------------ merge
 
-# event kinds rendered as duration slices when they carry a measured span
+# event kinds rendered as duration slices when they carry a measured span.
+# update.scan and async.drain spans make the overlap VISIBLE: a drain slice on
+# the worker's track running alongside the caller track's enqueue instants is
+# the attributed overlap_us, drawn
 _SPAN_KINDS = frozenset(
-    {"update.dispatch", "fused.dispatch", "compute.dispatch", "collection.step", "sync.exchange"}
+    {
+        "update.dispatch", "fused.dispatch", "compute.dispatch",
+        "collection.step", "sync.exchange", "update.scan", "async.drain",
+    }
 )
 
 

@@ -50,6 +50,15 @@ Event taxonomy (the ``kind`` field; full glossary in
 ``compute.dispatch``   one cached/fused compute execution (``dispatch_us``)
 ``compute.probe``      a sampled compute completion probe (``device_us``)
 ``collection.step``    one MetricCollection update step (``dispatch_us``, ``owners``, ``fused``)
+``async.enqueue``      one scan buffer handed to the background drain worker
+                       (``steps``, ``depth`` = in-flight buffers behind it)
+``async.drain``        one background drain executed off the caller's thread
+                       (``dispatch_us``, ``overlap_us`` = the slice during
+                       which no caller was blocked on it)
+``async.join``         an observation that waited on in-flight background
+                       work (``wait_us``, ``steps`` settled)
+``async.sync.overlap`` a packed epoch sync whose completion window overlapped
+                       the next epoch's enqueues (``overlap_us``)
 ``fallback``           every eager fallback, with its reason string
 ``transfer.host``      a device→host readback observed in ``log`` guard mode
 ``transfer.blocked``   a readback the ``strict`` guard refused

@@ -46,7 +46,7 @@ from typing import Any, Generator
 
 from torchmetrics_tpu.diag import trace
 
-__all__ = ["TransferGuardError", "transfer_allowed", "transfer_guard"]
+__all__ = ["TransferGuardError", "native_reentry", "transfer_allowed", "transfer_guard"]
 
 _MODES = ("strict", "log")
 
@@ -171,6 +171,27 @@ def transfer_guard(mode: str = "strict") -> Generator[None, None, None]:
     finally:
         _MODE_VAR.reset(token)
         _uninstall_hooks()
+
+
+@contextmanager
+def native_reentry() -> Generator[None, None, None]:
+    """Re-arm the native JAX D2H guard from the propagated contextvar mode.
+
+    The Python-level detector rides contextvars and crosses threads via
+    ``contextvars.copy_context`` (the async drain worker runs work items in
+    the submitting scope's context), but the native jax guard is
+    THREAD-local — a background drain must re-enter it explicitly or a
+    guarded section's proof would not cover the worker on real accelerators.
+    No-op when no guard scope is active.
+    """
+    mode = _MODE_VAR.get()
+    if mode == "off":
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow" if mode == "strict" else "log"):
+        yield
 
 
 @contextmanager

@@ -20,6 +20,11 @@ dispatch floor — not the kernels — dominates (BENCH_r04: 6.2 ms dispatch flo
   dispatch fusion: the update bodies of every compute-group leader in a
   ``MetricCollection`` trace into ONE executable, so an N-metric step costs one
   dispatch instead of N.
+- :mod:`~torchmetrics_tpu.engine.async_dispatch` — double-buffered background
+  drains over the scan queues: ``update()`` becomes a pure enqueue, a bounded
+  worker launches the same cached donated scan executable while the caller
+  fills the next buffer, and every state observation JOINS the in-flight work
+  before reading (``async_context`` / ``TORCHMETRICS_TPU_ASYNC``).
 - :mod:`~torchmetrics_tpu.engine.stats` — per-engine counters (traces, cache
   hits, fallbacks, donation copies, bytes moved, retrace causes) surfaced
   through :func:`engine_report` and exported by ``bench.py`` so the win is
@@ -52,6 +57,7 @@ is counted, never silent. Construct hot-loop metrics with
 ``validate_args=False`` to compile.
 """
 
+from torchmetrics_tpu.engine.async_dispatch import async_context, set_async_dispatch
 from torchmetrics_tpu.engine.compiled import CompiledUpdate
 from torchmetrics_tpu.engine.config import (
     engine_context,
@@ -88,6 +94,7 @@ __all__ = [
     "FusedUpdate",
     "QuarantinedBatchError",
     "StateSpec",
+    "async_context",
     "compensated_context",
     "cse_context",
     "engine_context",
@@ -98,6 +105,7 @@ __all__ = [
     "register_state_spec",
     "reset_engine_stats",
     "scan_context",
+    "set_async_dispatch",
     "set_compensated",
     "set_cse",
     "set_drift_rtol",

@@ -1,0 +1,300 @@
+"""Async pipelined dispatch — double-buffered background drains for the scan tier.
+
+PR 10's scan queues cut dispatch *count* K-fold, but every drain still runs
+synchronously on the caller's thread: at serving QPS the caller pays the full
+launch + staging latency on every Kth ``update()``. This module moves the
+drain off the caller entirely:
+
+- **Double buffering.** ``update()`` enqueues into the active scan buffer and
+  returns immediately; when the buffer reaches K (or a flush point fires) it
+  is SWAPPED out under the queue lock — a list pointer exchange, not a
+  dispatch — and handed to a bounded background executor that launches the
+  SAME cached donated scan executable (``engine/scan.py``) while the caller
+  fills the next buffer. Riders (quarantine / compensation / sentinel)
+  compose unchanged: the background drain runs the identical
+  ``_execute_work`` path the synchronous drain does.
+- **The join contract.** The PR-10 flush-on-observation contract becomes a
+  *join* contract: every state observation (``compute``/``sync``/
+  ``state_dict``/snapshot/scrape) first waits for the in-flight background
+  drains of the observed queue, replays any failed payloads on the OBSERVER's
+  thread (never the hot loop's), runs the deferred view re-anchors, and only
+  then reads state. A reader can still never see state that is K steps stale
+  — it just no longer pays the drain on the enqueueing thread.
+- **Backpressure, not unbounded memory.** At most ``inflight`` swapped
+  buffers may be pending behind the worker; a caller that outruns the drain
+  blocks on the OLDEST buffer's completion (counted in
+  ``async_backpressure_waits``) instead of growing the queue without bound.
+- **Failure = caller replay.** A drain that fails on the worker poisons its
+  queue: the failed buffer (and any buffers queued behind it) are handed back
+  in FIFO order and replayed step-at-a-time on the next caller-side join —
+  the PR-7 ladder semantics. Payloads are never lost and ordering is
+  preserved; the replays are counted (``async_replayed_steps``) and the
+  fallback reason recorded.
+- **Context propagation.** Work items capture ``contextvars.copy_context()``
+  at submit, so the worker's events land in the submitting scope's flight
+  recorder and the Python-level transfer guard (``diag/transfer_guard.py``)
+  stays armed across the thread hop; the native JAX device-to-host guard is
+  re-entered on the worker from the propagated mode (it is thread-local).
+- **Overlap attribution.** Each background drain records ``overlap_us`` — the
+  span of its execution during which NO caller was blocked waiting on it
+  (i.e. genuine caller forward progress) — as an ``async.drain`` event the
+  PR-5 merged timeline renders as a worker-track slice, plus the aggregate
+  ``EngineStats.async_overlap_us``. The packed epoch sync participates too:
+  when async mode is on, :func:`note_epoch_sync` stamps the sync's host-side
+  completion and the next join attributes the elapsed window (during which
+  the next epoch's enqueues proceeded while the sync's device work and
+  writeback futures completed) as an ``async.sync.overlap`` event.
+
+Enablement (first hit wins; invalid values FAIL LOUD per the PR-7 env
+contract): per-object ``Metric(async_dispatch=)`` /
+``MetricCollection(async_dispatch=)`` (``True`` = on with the default
+in-flight bound, ``False`` = forced off, int in [1, 16] = explicit bound), an
+active :func:`async_context` / :func:`set_async_dispatch` override, then
+``TORCHMETRICS_TPU_ASYNC`` (``"1"``/``"on"`` = default bound, ``"0"``/
+``"off"``/unset = off, int in [2, 16] = explicit bound). Async dispatch
+layers ON the scan tier: it engages only where a scan queue is active
+(``scan_steps``/``TORCHMETRICS_TPU_SCAN`` — K >= 2); with scan off there is
+no buffer to drain in the background and the knob is inert by design.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+from collections import deque
+from contextlib import contextmanager
+from time import perf_counter
+from typing import Any, Deque, Generator, List, Optional, Tuple
+
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = [
+    "ASYNC_ENV_VAR",
+    "DEFAULT_INFLIGHT",
+    "MAX_INFLIGHT",
+    "async_context",
+    "async_inflight",
+    "coerce_inflight",
+    "resolve_async",
+    "set_async_dispatch",
+]
+
+ASYNC_ENV_VAR = "TORCHMETRICS_TPU_ASYNC"
+
+#: default bound on swapped-out buffers pending behind the worker: one drain
+#: in flight + one queued behind it while the caller fills the third — the
+#: "double buffer" of the design, with one slot of slack for drain jitter
+DEFAULT_INFLIGHT = 2
+
+#: hard ceiling: each pending buffer pins K step payloads host-side, so a
+#: large bound trades the backpressure guarantee for memory — past ~16 the
+#: caller is simply outrunning the device and must be throttled
+MAX_INFLIGHT = 16
+
+_UNSET = object()
+_override: Any = _UNSET
+
+
+# ------------------------------------------------------------------ policy
+
+
+def coerce_inflight(value: Any) -> Optional[int]:
+    """Validate an async-dispatch knob: ``0``/``False`` = forced off,
+    ``True`` = on with :data:`DEFAULT_INFLIGHT`, int in [1, MAX_INFLIGHT] =
+    explicit in-flight bound; ``None`` passes through (defer to the policy)."""
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        return DEFAULT_INFLIGHT if value else 0
+    if isinstance(value, int):
+        if value == 0:
+            return 0
+        if 1 <= value <= MAX_INFLIGHT:
+            return value
+    raise TorchMetricsUserError(
+        f"async_dispatch must be a bool, 0 (off), or an integer in-flight bound"
+        f" in [1, {MAX_INFLIGHT}] (got {value!r})"
+    )
+
+
+def async_inflight() -> Optional[int]:
+    """The active in-flight bound, or ``None`` when async dispatch is off.
+
+    An unrecognized ``TORCHMETRICS_TPU_ASYNC`` value fails loud (the PR-7 env
+    contract): a typo must not silently disable the overlap it was set to
+    enable — nor silently enable a nonsense bound.
+    """
+    if _override is not _UNSET:
+        return _override or None
+    raw = os.environ.get(ASYNC_ENV_VAR, "").strip().lower()
+    if raw in ("", "0", "off"):
+        return None
+    if raw in ("1", "on"):
+        return DEFAULT_INFLIGHT
+    try:
+        bound = int(raw)
+    except ValueError:
+        raise TorchMetricsUserError(
+            f"{ASYNC_ENV_VAR}={raw!r} is not a valid async-dispatch setting"
+            f" (expected unset/'0'/'off', '1'/'on', or an in-flight bound in"
+            f" [2, {MAX_INFLIGHT}])"
+        ) from None
+    if not (2 <= bound <= MAX_INFLIGHT):
+        raise TorchMetricsUserError(
+            f"{ASYNC_ENV_VAR}={bound} is out of range: the in-flight bound must"
+            f" be in [2, {MAX_INFLIGHT}] ('1' enables the default bound of"
+            f" {DEFAULT_INFLIGHT})"
+        )
+    return bound
+
+
+def set_async_dispatch(value: Optional[Any]) -> None:
+    """Force async dispatch process-wide (``0``/``False`` = off); ``None``
+    restores env resolution."""
+    global _override
+    _override = _UNSET if value is None else coerce_inflight(value)
+
+
+@contextmanager
+def async_context(inflight: Any = True) -> Generator[None, None, None]:
+    """Scoped async-dispatch enablement (benches, tests, serving loops).
+
+    Composes with :func:`~torchmetrics_tpu.engine.scan.scan_context` — async
+    dispatch drains scan buffers, so a scan depth must be active for it to
+    engage. Exiting the scope flushes AND JOINS every queue with pending or
+    in-flight work (reason ``async-scope-exit``) — state outside the scope is
+    never stale and no drain outlives its enablement — then restores the
+    previous policy.
+    """
+    global _override
+    prev = _override
+    _override = coerce_inflight(inflight)
+    try:
+        yield
+    finally:
+        try:
+            from torchmetrics_tpu.engine.scan import flush_all
+
+            # drain() joins in-flight work before (and instead of) a
+            # caller-side dispatch while async mode is still on
+            flush_all("async-scope-exit")
+        finally:
+            _override = prev
+
+
+def resolve_async(kwarg: Optional[Any]) -> Optional[int]:
+    """Per-object resolution: the coerced ``async_dispatch`` kwarg wins
+    (``0`` = forced off), else the process policy. Mirrors
+    ``Metric._scan_depth``'s kwarg-over-context-over-env order."""
+    if kwarg is not None:
+        return kwarg or None  # already coerced at construction; 0 = off
+    return async_inflight()
+
+
+# ------------------------------------------------------------------ executor
+
+
+class _AsyncExecutor:
+    """One daemon worker draining swapped-out scan buffers in global FIFO.
+
+    A single worker is the ordering guarantee: buffers of one queue can never
+    reorder, and cross-queue work shares the device serially exactly like the
+    synchronous path. The executor holds no queue locks — work items carry
+    everything the drain needs (see ``engine/scan.py:_DrainWork``) and state
+    writeback serializes on the per-queue drain mutex.
+    """
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._items: Deque[Any] = deque()
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, work: Any) -> None:
+        with self._cv:
+            # lazily (re)started: survives fork-per-test process models where
+            # a child inherits the module state but not the running thread
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="tm-tpu-async-drain", daemon=True
+                )
+                self._thread.start()
+            self._items.append(work)
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._items:
+                    self._cv.wait()
+                work = self._items.popleft()
+            try:
+                # the copied context carries the submitting scope's flight
+                # recorder and transfer-guard mode across the thread hop
+                work.ctx.run(work.queue._worker_execute, work)
+            finally:
+                work.done.set()
+
+
+_EXECUTOR = _AsyncExecutor()
+
+#: latched on the first submit: lets env-silent call sites (the epoch sync
+#: stamp) know async ever engaged WITHOUT consulting the env knob — an invalid
+#: TORCHMETRICS_TPU_ASYNC must only raise where the policy is actually read
+#: (the enqueue path), never on a sync that predates any async use
+_engaged = False
+
+
+def submit(work: Any) -> None:
+    """Hand one swapped-out buffer to the background worker (FIFO)."""
+    global _engaged
+    _engaged = True
+    work.ctx = contextvars.copy_context()
+    _EXECUTOR.submit(work)
+
+
+# ------------------------------------------------------------- sync overlap
+
+#: pending epoch-sync overlap stamps: (EngineStats, host-completion ts). The
+#: next join consumes them; bounded so an observation-free loop cannot grow it
+_SYNC_NOTES: List[Tuple[Any, float]] = []
+_SYNC_NOTES_LOCK = threading.Lock()
+_SYNC_NOTES_CAP = 64
+
+
+def note_epoch_sync(stats: Any) -> None:
+    """Stamp a packed epoch sync's host-side completion for overlap credit.
+
+    Called by ``engine/epoch.py`` after the packed exchange + fold dispatch
+    return (the written states are still device FUTURES at this point). When
+    async mode is on, the elapsed window until the next join — during which
+    the caller's next-epoch enqueues proceeded while the sync's device work
+    completed — is attributed as ``async.sync.overlap``. Env-silent: gated on
+    the engaged latch ONLY, never the knob — a kwarg-engaged process with a
+    typo'd TORCHMETRICS_TPU_ASYNC must not crash its epoch syncs (the env
+    fails loud where it is resolved: the enqueue path). A stamp recorded
+    after async dispatch was later disabled credits a window the caller did
+    spend making forward progress — generous but bounded (the notes cap) and
+    consumed at the next join either way.
+    """
+    if not _engaged:
+        return
+    with _SYNC_NOTES_LOCK:
+        if len(_SYNC_NOTES) >= _SYNC_NOTES_CAP:
+            _SYNC_NOTES.pop(0)
+        _SYNC_NOTES.append((stats, perf_counter()))
+
+
+def consume_sync_notes() -> None:
+    """Credit every pending sync stamp's overlap window at a join point."""
+    with _SYNC_NOTES_LOCK:
+        if not _SYNC_NOTES:
+            return
+        notes, _SYNC_NOTES[:] = list(_SYNC_NOTES), []
+    from torchmetrics_tpu.diag import trace as _diag
+
+    now = perf_counter()
+    for stats, t0 in notes:
+        overlap_us = int((now - t0) * 1e6)
+        stats.async_overlap_us += overlap_us
+        _diag.record("async.sync.overlap", stats.owner, overlap_us=overlap_us)

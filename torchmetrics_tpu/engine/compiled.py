@@ -122,11 +122,28 @@ class _Ineligible(Exception):
     """Raised inside a trace to abort compilation with a recorded reason."""
 
 
-def _is_jax_array(x: Any) -> bool:
-    import jax
-    import jax.numpy as jnp
+#: resolved once — `jax.Array`/`jax.core.Tracer` attribute walks go through
+#: jax's lazy-module `__getattr__` machinery, which costs ~µs per access and
+#: sits on the per-step enqueue fast path (input_signature is rebuilt every
+#: warm step; the scan/async enqueue cost IS the product)
+_ARRAY_TYPES: Optional[tuple] = None
+_TRACER_CLS: Any = None
 
-    return isinstance(x, (jax.Array, jnp.ndarray)) and not isinstance(x, (list, tuple))
+
+def _array_types() -> tuple:
+    global _ARRAY_TYPES, _TRACER_CLS
+    if _ARRAY_TYPES is None:
+        import jax
+        import jax.numpy as jnp
+
+        _ARRAY_TYPES = (jax.Array, jnp.ndarray)
+        _TRACER_CLS = jax.core.Tracer
+    return _ARRAY_TYPES
+
+
+def _is_jax_array(x: Any) -> bool:
+    types = _ARRAY_TYPES if _ARRAY_TYPES is not None else _array_types()
+    return isinstance(x, types) and not isinstance(x, (list, tuple))
 
 
 def _is_metric_like(x: Any) -> bool:
@@ -478,11 +495,12 @@ def input_signature(inputs: Sequence[Any]) -> Optional[Tuple]:
     trace (a user-jitted step) must keep the pre-engine eager semantics — the
     engine only owns dispatches it issues from host level.
     """
-    import jax
-
+    if _ARRAY_TYPES is None:
+        _array_types()
+    tracer = _TRACER_CLS
     sig = []
     for a in inputs:
-        if isinstance(a, jax.core.Tracer):
+        if isinstance(a, tracer):
             return None
         if _is_jax_array(a) or isinstance(a, np.ndarray):
             # dtype OBJECT, not str(dtype): numpy re-derives the name string on
@@ -524,13 +542,17 @@ class CompiledUpdate:
 
     # ------------------------------------------------------------------ scan
 
-    def scan_step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> bool:
+    def scan_step(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int, async_inflight: Optional[int] = None
+    ) -> bool:
         """Queue one update payload for the K-folding scan drain.
 
         Returns True when the payload was queued (it folds into state at the
         next drain — K reached, signature change, or any state observation);
         False requests the eager fallback for THIS step, after draining any
-        pending payloads so ordering is preserved.
+        pending payloads so ordering is preserved. ``async_inflight`` routes
+        full buffers to the background worker (``engine/async_dispatch.py``)
+        with the given in-flight bound.
         """
         if self._disabled_reason is not None:
             self.stats.fallback(self._disabled_reason)
@@ -539,7 +561,7 @@ class CompiledUpdate:
             from torchmetrics_tpu.engine.scan import MetricScan
 
             self._scan = MetricScan(self)
-        return self._scan.push(args, kwargs, k)
+        return self._scan.push(args, kwargs, k, async_inflight)
 
     # ------------------------------------------------------------------ step
 

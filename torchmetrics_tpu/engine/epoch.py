@@ -65,6 +65,20 @@ from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError, all_
 NO_VALUE = object()
 
 
+def _note_async_sync(stats: EngineStats) -> None:
+    """Stamp a completed packed sync for async-overlap attribution.
+
+    The synced states written back above are device FUTURES — with async
+    dispatch on, the caller's next-epoch enqueues proceed while the sync's
+    fold work completes; the window until the next join is credited as
+    ``async.sync.overlap`` (see ``engine/async_dispatch.note_epoch_sync``).
+    No-op when async mode is off.
+    """
+    from torchmetrics_tpu.engine.async_dispatch import note_epoch_sync
+
+    note_epoch_sync(stats)
+
+
 def traced_compute(metric: Any, state: Dict[str, Any]) -> Any:
     """Run ``metric``'s original compute body as ``state -> value`` (trace-safe).
 
@@ -467,6 +481,7 @@ class EpochEngine:
             return False
         _write_synced(self._metric, folded.get("", {}), plan, "")
         self.stats.packed_syncs += 1
+        _note_async_sync(self.stats)
         return True
 
     def sync_and_compute(self, process_group: Optional[Sequence[int]] = None):
@@ -576,6 +591,7 @@ class EpochEngine:
             if device_us is not None:
                 rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)
         _write_synced(m, states, plan, "")
+        _note_async_sync(self.stats)
         return (value,)
 
     def _fold_then_no_value(self, plan: PackedSyncPlan, gathered: Dict[str, Any]):
@@ -585,6 +601,7 @@ class EpochEngine:
             return None
         _write_synced(self._metric, folded.get("", {}), plan, "")
         self.stats.packed_syncs += 1
+        _note_async_sync(self.stats)
         return (NO_VALUE,)
 
     # ------------------------------------------------------------------ compute
@@ -740,4 +757,5 @@ class CollectionEpoch:
         for name, metric in owners:
             _write_synced(metric, folded.get(name, {}), plan, name)
         self.stats.packed_syncs += 1
+        _note_async_sync(self.stats)
         return True

@@ -216,7 +216,9 @@ class FusedUpdate:
             members.append((name, m))
         return members
 
-    def scan_step(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> Optional[Set[str]]:
+    def scan_step(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int, async_inflight: Optional[int] = None
+    ) -> Optional[Set[str]]:
         """Queue one fused payload for the K-folding scan drain.
 
         Returns the handled member names (resolved by an abstract trace probe
@@ -227,7 +229,7 @@ class FusedUpdate:
             from torchmetrics_tpu.engine.scan import FusedScan
 
             self._scan = FusedScan(self)
-        return self._scan.push(args, kwargs, k)
+        return self._scan.push(args, kwargs, k, async_inflight)
 
     @staticmethod
     def _fingerprint(state_sig: Tuple, in_sig: Tuple, bucket: Optional[int]) -> Dict[str, Any]:

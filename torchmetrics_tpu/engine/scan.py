@@ -36,6 +36,14 @@ Design points:
   its reason. A scrape can therefore never observe state that is K steps
   stale. ``reset()`` DISCARDS the queue instead (applying updates that the
   reset immediately wipes is byte-identical to skipping them).
+- **Async background drains.** With ``engine/async_dispatch.py`` enabled, a
+  full (or signature-changed) buffer is SWAPPED out under the queue lock and
+  drained on a bounded background worker while the caller fills the next
+  buffer — ``update()`` becomes a pure enqueue. Every flush point above turns
+  into a JOIN: the observer waits out the in-flight drains (and replays any
+  payloads a failed worker drain handed back) before the state read; the hot
+  loop never pays a drain, a join, or a replay. Sync-mode behavior is
+  byte-identical and untouched.
 - **Donation-stable carry.** ``lax.scan`` needs a fixed carry signature, but
   an update body may promote dtypes (the x64 first-update int32→int64
   widening). The compile pre-resolves the body's output dtypes via
@@ -57,9 +65,10 @@ from __future__ import annotations
 import os
 import threading
 import weakref
+from collections import deque
 from contextlib import contextmanager
 from time import perf_counter
-from typing import Any, Dict, FrozenSet, Generator, List, Optional, Sequence, Set, Tuple
+from typing import Any, Deque, Dict, FrozenSet, Generator, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -428,6 +437,36 @@ def write_member_state(m: Any, out: Dict[str, Any], steps: int, stats) -> Option
 # ------------------------------------------------------------------ queues
 
 
+class _DrainWork:
+    """One swapped-out buffer: everything a drain needs, caller-independent.
+
+    The queue's live ``_qkey``/``_k``/member names may move on under the
+    enqueueing thread while this buffer waits behind the background worker —
+    the work item freezes the values the drain must compile and write back
+    against. ``first_wait_t`` is the overlap boundary: the instant the first
+    caller blocked on this item, the caller's forward progress (the thing
+    ``overlap_us`` attributes) ended.
+    """
+
+    __slots__ = (
+        "queue", "pending", "qkey", "k", "names", "reason",
+        "done", "ctx", "replay", "error", "first_wait_t",
+    )
+
+    def __init__(self, queue: "_ScanQueue", pending, qkey, k: int, names, reason: str) -> None:
+        self.queue = queue
+        self.pending = pending
+        self.qkey = qkey
+        self.k = k
+        self.names = names
+        self.reason = reason
+        self.done = threading.Event()
+        self.ctx = None  # contextvars snapshot, stamped at submit
+        self.replay = False  # worker handed the payload back for caller replay
+        self.error = None  # exception to re-raise at the join (state consumed)
+        self.first_wait_t: Optional[float] = None
+
+
 class _ScanQueue:
     """Per-owner step queue + drain machinery (shared core).
 
@@ -453,6 +492,26 @@ class _ScanQueue:
         #: optional post-drain hook (a collection re-anchoring its group views
         #: after a drain donated an owner's buffers — wherever the drain fired)
         self.on_drain = None
+        # --- async tier (engine/async_dispatch.py) -----------------------
+        #: in-flight bound resolved at push time (None/0 = synchronous drains)
+        self._async_limit: Optional[int] = None
+        #: buffers swapped out inside _push_locked, submitted OUTSIDE the lock
+        self._staged_work: List[_DrainWork] = []
+        self._needs_join = False
+        #: FIFO of submitted-but-unjoined work (pruned lazily as items finish)
+        self._inflight: Deque[_DrainWork] = deque()
+        #: payloads a failed worker drain handed back for caller-side replay
+        self._failed: Deque[_DrainWork] = deque()
+        #: a worker failure stops dispatching until a join replays the FIFO —
+        #: otherwise later buffers would apply ahead of the failed one
+        self._poisoned = False
+        #: a successful background drain defers the view re-anchor to the join
+        self._post_pending = False
+        # worker execution vs a caller-side synchronous drain of the SAME
+        # queue: one mutex serializes gather/dispatch/writeback. Callers that
+        # hold self._lock may acquire it; the worker takes it WITHOUT
+        # self._lock, so the ordering is one-directional and deadlock-free
+        self._drain_mutex = threading.Lock()
         _QUEUES[next(_seq_counter)] = self
 
     # -- interface subclasses provide -----------------------------------
@@ -465,27 +524,31 @@ class _ScanQueue:
         (discard safety: dropping the queue loses no other metric's steps)."""
         raise NotImplementedError
 
-    def _gather_state(self):
+    def _gather_state(self, names):
         """(state_pytree, state_sig, device_token) for the drain, or None."""
         raise NotImplementedError
 
-    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple):
+    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple, work: _DrainWork):
         raise NotImplementedError
 
-    def _shield(self, state):
+    def _shield(self, state, names):
         raise NotImplementedError
 
-    def _invalidated(self) -> bool:
+    def _invalidated(self, names) -> bool:
         raise NotImplementedError
 
-    def _writeback(self, out, steps: int, probing: bool) -> None:
+    def _writeback(self, out, steps: int, probing: bool, names) -> None:
         raise NotImplementedError
 
-    def _replay(self, pending) -> None:
+    def _replay(self, pending, names) -> None:
         raise NotImplementedError
 
-    def _fingerprint(self, state_sig, kb: int, device: str) -> Dict[str, Any]:
+    def _fingerprint(self, state_sig, kb: int, device: str, qkey) -> Dict[str, Any]:
         raise NotImplementedError
+
+    def _names_snapshot(self):
+        """Member-name freeze for a work item (fused queues override)."""
+        return None
 
     def _post_drain(self) -> None:
         """Hook after a successful drain (view re-anchoring for collections)."""
@@ -497,12 +560,71 @@ class _ScanQueue:
 
     @property
     def pending(self) -> int:
-        return len(self._pending)
+        # in-flight and handed-back buffers count: an observation must JOIN
+        # them even when the active buffer is empty
+        with self._lock:
+            return (
+                len(self._pending)
+                + sum(len(w.pending) for w in self._inflight)
+                + sum(len(w.pending) for w in self._failed)
+            )
+
+    def push(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int, async_inflight: Optional[int] = None):
+        """Queue one payload (see the subclass ``_push_locked`` for the
+        semantics of the return value). Async staging, submits, and joins all
+        happen OUTSIDE the queue lock, so the worker — which takes the drain
+        mutex but never this lock from its own stack — cannot deadlock
+        against an enqueue."""
+        if not async_inflight and (self._inflight or self._failed):
+            # async was just disabled mid-stream (scope exit, kwarg change):
+            # the leftover background work must land before this step's path
+            # — synchronous or eager — applies, or batches would reorder
+            self.join_async("async-disabled")
+        measuring = async_inflight and (
+            _diag.active_recorder() is not None or _profile.active_profile() is not None
+        )
+        t0 = perf_counter() if measuring else 0.0
+        with self._lock:
+            self._async_limit = async_inflight or None
+            result = self._push_locked(args, kwargs, k)
+            staged, self._staged_work = self._staged_work, []
+            needs_join, self._needs_join = self._needs_join, False
+        try:
+            for idx, work in enumerate(staged):
+                self._submit_work(work)
+        except BaseException:
+            # a failed submit (a stored drain error re-raised at a join, a
+            # wedged executor) must not leave later staged buffers tracked
+            # but never-completing — observers would wait on them forever.
+            # Hand them to the failed FIFO: the next join replays them.
+            for w in staged[idx:]:
+                self._abandon(w)
+            raise
+        if needs_join:
+            # an OBSERVING flush point fired inside the enqueue (ineligible
+            # step about to run eagerly): ordering requires the staged buffer
+            # to fully land before the caller proceeds
+            self.join_async("enqueue-ineligible")
+        if measuring:
+            # the full caller-side cost of this enqueue, submits and
+            # backpressure waits included — the p50 of this distribution IS
+            # the "update() ≈ a dict append" claim, measured
+            _hist.observe(self.stats.owner, "async", "enqueue_us", round((perf_counter() - t0) * 1e6, 3))
+        return result
 
     def discard(self, reason: str) -> int:
-        """Drop the queued payloads without dispatching (reset semantics)."""
+        """Drop the queued payloads without dispatching (reset semantics).
+
+        Async tier: background drains already in flight complete first (the
+        caller's reset wipes their folded effect — byte-identical to having
+        skipped them); failed hand-backs are DROPPED like the pending buffer
+        (replaying then wiping equals skipping).
+        """
+        self.join_async(reason, collect=False)
         with self._lock:
-            n = len(self._pending)
+            n = len(self._pending) + sum(len(w.pending) for w in self._failed)
+            self._failed.clear()
+            self._poisoned = False
             if not n:
                 return 0
             self._pending = []
@@ -513,15 +635,71 @@ class _ScanQueue:
         return n
 
     def drain(self, reason: str) -> int:
-        """Fold every queued step into state through one scan dispatch."""
+        """Fold every queued step into state through one scan dispatch.
+
+        This is the async tier's JOIN point: with async dispatch active the
+        current buffer rides the background worker too — the OBSERVER waits
+        for it, while the hot loop only ever contends on the buffer swap.
+        """
+        drained = self.join_async(reason)
         with self._lock:
-            return self._drain_locked(reason)
+            if not self._async_limit:
+                return drained + self._drain_locked(reason)
+            work = self._swap_locked(reason)
+            if work is not None:
+                self._inflight.append(work)  # joinable from the swap instant
+        if work is None:
+            return drained
+        try:
+            self._submit_work(work)
+        except BaseException:
+            self._abandon(work)
+            raise
+        self.join_async(reason)
+        return drained + len(work.pending)
 
     def _drain_locked(self, reason: str) -> int:
+        """Synchronous drain (queue lock held): swap + execute on this thread."""
+        work = self._swap_locked(reason)
+        if work is None:
+            return 0
+        with self._drain_mutex:
+            ok = self._execute_work(work)
+        if not ok:
+            self._replay(work.pending, work.names)
+        # the replay's one-step dispatches donate too: views re-anchor
+        self._post_drain()
+        return len(work.pending)
+
+    def _flush_point_locked(self, reason: str, asyncable: bool) -> None:
+        """A drain trigger inside the enqueue path (queue lock held).
+
+        Async mode swaps the buffer for the background worker — ``k-reached``
+        and ``signature-change`` are pure ordering points, nothing observes
+        state at them. A trigger followed by an eager step in the same push
+        (``asyncable=False``) additionally forces a join before ``push``
+        returns, so the eager step cannot overtake the swapped buffer.
+        """
+        if self._async_limit:
+            work = self._swap_locked(reason)
+            if work is not None:
+                # tracked from the SWAP (still under the queue lock): the
+                # buffer is visible to `pending` and joinable by a concurrent
+                # observer from the first instant it leaves the active list —
+                # there is no window where its steps are invisible
+                self._inflight.append(work)
+                self._staged_work.append(work)
+            if not asyncable:
+                self._needs_join = True
+        else:
+            self._drain_locked(reason)
+
+    def _swap_locked(self, reason: str) -> Optional[_DrainWork]:
+        """Detach the active buffer as a work item (the double-buffer swap)."""
         pending = self._pending
         n = len(pending)
         if not n:
-            return 0
+            return None
         self._pending = []
         st = self.stats
         st.scan_flushes += 1
@@ -529,25 +707,42 @@ class _ScanQueue:
         rec = _diag.active_recorder()
         if rec is not None:
             rec.record("scan.flush", st.owner, reason=reason, steps=n)
+        return _DrainWork(self, pending, self._qkey, self._k, self._names_snapshot(), reason)
 
-        gathered = self._gather_state()
+    def _execute_work(self, work: _DrainWork, allow_compile: bool = True) -> bool:
+        """Gather → (compile) → ONE dispatch → counters → writeback.
+
+        Runs on the caller (sync path) or the background worker (async path),
+        always under the drain mutex. Returns False when the payload must
+        replay step-at-a-time; raises when donation already consumed the
+        state (nothing intact to replay). ``allow_compile=False`` (the worker)
+        refuses a first compile outright — tracing diffs the metric __dict__
+        against the caller's live enqueue bookkeeping, so compiles belong to
+        the caller's thread; a refused buffer replays there instead.
+        """
+        pending = work.pending
+        n = len(pending)
+        st = self.stats
+        rec = _diag.active_recorder()
+        gathered = self._gather_state(work.names)
         if gathered is None:
             st.fallback("scan-state-ineligible")
-            self._replay(pending)
-            # the replay's one-step dispatches donate too: views re-anchor
-            self._post_drain()
-            return n
+            return False
         state, state_sig, device = gathered
         kb = k_bucket(n)
         pad = kb - n
-        key = (self._qkey, state_sig, device, kb)
+        key = (work.qkey, state_sig, device, kb)
         entry = self._cache.get(key)
         if entry is _FALLBACK:
             st.fallback("scan-uncompilable-signature")
-            self._replay(pending)
-            self._post_drain()
-            return n
+            return False
         first = entry is None
+        if first and not allow_compile:
+            # the submit-side key prediction raced an in-flight writeback
+            # (e.g. the x64 widening moved the signature under it): hand the
+            # payload back rather than trace on the worker
+            st.fallback("scan-async-warm-miss")
+            return False
 
         # step-major flat args; pad steps reuse the LAST real step's arrays
         # (no allocation — the valid mask makes them no-ops)
@@ -565,10 +760,10 @@ class _ScanQueue:
         t_dispatch = perf_counter() if measuring else 0.0
         try:
             if first:
-                entry = self._compile_entry(state, pending[0][2], kb, key)
+                entry = self._compile_entry(state, pending[0][2], kb, key, work)
             fn, donate, scope, state_bytes, step_in_bytes = entry
             if donate:
-                state = self._shield(state)
+                state = self._shield(state, work.names)
             if measuring:
                 t_dispatch = perf_counter()
             import jax
@@ -576,7 +771,7 @@ class _ScanQueue:
             with jax.profiler.TraceAnnotation(scope):
                 out = fn(state, valid, n_pads, *flat_steps)
         except Exception as exc:  # noqa: BLE001 — a failed drain replays step-at-a-time
-            if self._invalidated():
+            if self._invalidated(work.names):
                 raise  # donation consumed the state; nothing intact to replay
             # first-compile AND warm-dispatch failures alike fall back to the
             # step-at-a-time replay: the queued payloads are intact host-side
@@ -595,14 +790,12 @@ class _ScanQueue:
                 st.fallback(
                     f"scan-dispatch-{classified}" if classified else f"scan-trace-failed:{type(exc).__name__}"
                 )
-            self._replay(pending)
-            self._post_drain()
-            return n
+            return False
 
         if first:
             st.traces += 1
             self._cache[key] = entry
-            fp = self._fingerprint(state_sig, kb, device)
+            fp = self._fingerprint(state_sig, kb, device, work.qkey)
             cause = _diag.attribute_retrace(fp, list(self._fingerprints.values()))
             self._fingerprints[key] = fp
             if cause != "initial":
@@ -633,15 +826,276 @@ class _ScanQueue:
         if rec is not None:
             rec.record(
                 "update.scan", st.owner,
-                dispatch_us=dispatch_us, steps=n, k=self._k, k_bucket=kb,
+                dispatch_us=dispatch_us, steps=n, k=work.k, k_bucket=kb,
                 pad_steps=pad, bytes=bytes_moved, donated=donate,
-                cached=not first, reason=reason,
+                cached=not first, reason=work.reason,
             )
             if device_us is not None:
                 rec.record("update.scan.probe", st.owner, dispatch_us=dispatch_us, device_us=device_us)
-        self._writeback(out, n, profiling and not first)
-        self._post_drain()
-        return n
+        self._writeback(out, n, profiling and not first, work.names)
+        return True
+
+    # -- async tier (engine/async_dispatch.py) ---------------------------
+
+    def _submit_work(self, work: _DrainWork) -> None:
+        """Hand a swapped buffer to the background worker, under backpressure.
+
+        At most ``_async_limit`` buffers may be pending behind the worker; a
+        caller that outruns the drain blocks on the OLDEST buffer instead of
+        growing host memory without bound. A poisoned queue (a prior drain
+        failed) short-circuits to the caller-side FIFO replay.
+        """
+        from torchmetrics_tpu.engine import async_dispatch as _async
+
+        st = self.stats
+        limit = self._async_limit or 1
+        # first drain of a (signature, K-bucket) pair COMPILES, and the trace
+        # diffs the metric's __dict__ — which the caller's next enqueues
+        # mutate concurrently (_update_count/_computed bookkeeping). Compiles
+        # therefore run HERE on the caller, race-free; only warm dispatches of
+        # the cached executable ride the worker. The prediction below can race
+        # an in-flight drain's writeback (the x64 first-update widening moves
+        # the state signature) — a mispredicted warm submit is still safe:
+        # the worker refuses to compile (allow_compile=False) and hands the
+        # buffer back for a caller-side replay instead.
+        gathered = self._gather_state(work.names)
+        key = None
+        if gathered is not None:
+            key = (work.qkey, gathered[1], gathered[2], k_bucket(len(work.pending)))
+        if key is None or key not in self._cache:
+            # the work item already rides the in-flight FIFO (appended at the
+            # swap), so wait out the OLDER items only — waiting on ourselves
+            # would deadlock — then settle any handed-back payloads first
+            self._join_until(work)
+            try:
+                with self._drain_mutex:
+                    ok = self._execute_work(work)
+                if not ok:
+                    self._replay(work.pending, work.names)
+                    work.replay = True  # joiners must not count it again
+                self._post_drain()
+            finally:
+                work.done.set()
+            return
+        while True:
+            with self._lock:
+                while (
+                    self._inflight
+                    and self._inflight[0].done.is_set()
+                    and self._inflight[0] is not work
+                ):
+                    self._inflight.popleft()
+                # the bound counts OUR buffer too (it joined the FIFO at swap):
+                # more than `limit` tracked buffers = wait on the oldest, which
+                # is never ours (ours is the newest)
+                oldest = self._inflight[0] if len(self._inflight) > limit else None
+                poisoned = self._poisoned
+            if poisoned:
+                # worker is handing payloads back: settle everything in FIFO
+                # order on THIS thread, this buffer included
+                with self._lock:
+                    try:
+                        self._inflight.remove(work)
+                    except ValueError:
+                        pass
+                self.join_async("async-poisoned")
+                self._replay(work.pending, work.names)
+                st.async_replayed_steps += len(work.pending)
+                self._post_drain()
+                work.replay = True
+                work.done.set()
+                return
+            if oldest is None or oldest is work:
+                break
+            st.async_backpressure_waits += 1
+            if oldest.first_wait_t is None:
+                oldest.first_wait_t = perf_counter()
+            oldest.done.wait()
+        with self._lock:
+            depth = len(self._inflight)
+        st.async_submits += 1
+        rec = _diag.active_recorder()
+        if rec is not None or _profile.active_profile() is not None:
+            # queue-depth distribution: how far the caller runs ahead of the
+            # drain (1 = pure double buffering, `limit` = backpressure ceiling)
+            _hist.observe(st.owner, "async", "depth", float(depth))
+            if rec is not None:
+                rec.record(
+                    "async.enqueue", st.owner,
+                    steps=len(work.pending), depth=depth, reason=work.reason,
+                )
+        _async.submit(work)
+
+    def _join_until(self, work: _DrainWork) -> None:
+        """Wait out (and settle) everything swapped BEFORE ``work``."""
+        while True:
+            with self._lock:
+                while (
+                    self._inflight
+                    and self._inflight[0].done.is_set()
+                    and self._inflight[0] is not work
+                ):
+                    self._inflight.popleft()
+                head = self._inflight[0] if self._inflight else None
+            if head is None or head is work:
+                break
+            if head.first_wait_t is None:
+                head.first_wait_t = perf_counter()
+            head.done.wait()
+        self._collect_failed()
+
+    def _abandon(self, work: _DrainWork) -> None:
+        """A buffer that can no longer reach the worker: route it to the
+        failed FIFO (the next join replays it) and release its waiters."""
+        if work.done.is_set():
+            return
+        with self._lock:
+            work.replay = True
+            self._failed.append(work)
+        work.done.set()
+
+    def _worker_execute(self, work: _DrainWork) -> None:
+        """The background half of a drain (executor thread, submit context).
+
+        Failure semantics differ from the sync path on purpose: the payload
+        is handed BACK for the next caller-side join to replay — the hot loop
+        never pays a replay, and the poisoned flag stops later buffers from
+        dispatching ahead of the failed one. Success defers the view
+        re-anchor to the join (the observer's thread), matching the contract
+        that only observers read state.
+        """
+        st = self.stats
+        if self._poisoned:
+            work.replay = True  # passthrough: joiners count it ONCE, at replay
+            with self._lock:
+                self._failed.append(work)
+            return
+        from torchmetrics_tpu.diag.transfer_guard import native_reentry
+
+        t0 = perf_counter()
+        try:
+            with self._drain_mutex, native_reentry():
+                ok = self._execute_work(work, allow_compile=False)
+        except Exception as exc:  # noqa: BLE001 — donation consumed the state: raise at the join
+            work.error = exc
+            with self._lock:
+                self._failed.append(work)
+                self._poisoned = True
+            return
+        end = perf_counter()
+        if not ok:
+            work.replay = True
+            with self._lock:
+                self._failed.append(work)
+                self._poisoned = True
+            return
+        exec_us = round((end - t0) * 1e6, 3)
+        # overlap credit: the slice of this drain during which NO caller was
+        # blocked on it — genuine caller forward progress behind the worker
+        fw = work.first_wait_t
+        overlap_us = round(max(0.0, ((min(fw, end) if fw is not None else end) - t0) * 1e6), 3)
+        st.async_dispatches += 1
+        st.async_overlap_us += int(overlap_us)
+        self._post_pending = True
+        rec = _diag.active_recorder()
+        if rec is not None:
+            rec.record(
+                "async.drain", st.owner,
+                dispatch_us=exec_us, overlap_us=overlap_us,
+                steps=len(work.pending), reason=work.reason,
+            )
+
+    def join_async(self, reason: str, collect: bool = True) -> int:
+        """Wait out this queue's in-flight background drains (the JOIN).
+
+        Runs on the OBSERVER's thread: waits the FIFO dry, replays any
+        payloads a failed drain handed back (unless ``collect=False`` — the
+        discard path drops them instead), fires the deferred view re-anchor,
+        and credits pending epoch-sync overlap windows. Returns the number of
+        steps settled (completed + replayed).
+        """
+        settled = 0
+        waited = False
+        t0 = 0.0
+        while True:
+            with self._lock:
+                while self._inflight and self._inflight[0].done.is_set():
+                    self._inflight.popleft()
+                work = self._inflight[0] if self._inflight else None
+            if work is None:
+                break
+            if not waited:
+                waited = True
+                t0 = perf_counter()
+            if work.first_wait_t is None:
+                work.first_wait_t = perf_counter()
+            work.done.wait()
+            if not work.replay and work.error is None:
+                # failed buffers count ONCE — at their replay in
+                # _collect_failed below, not here
+                settled += len(work.pending)
+        st = self.stats
+        if waited:
+            wait_us = round((perf_counter() - t0) * 1e6, 3)
+            st.async_joins += 1
+            st.async_join_wait_us += int(wait_us)
+            rec = _diag.active_recorder()
+            if rec is not None:
+                rec.record("async.join", st.owner, reason=reason, steps=settled, wait_us=wait_us)
+        if collect:
+            settled += self._collect_failed()
+        if self._post_pending:
+            self._post_pending = False
+            self._post_drain()
+        from torchmetrics_tpu.engine import async_dispatch as _async
+
+        _async.consume_sync_notes()
+        return settled
+
+    def _collect_failed(self) -> int:
+        """Replay handed-back payloads in FIFO order on THIS thread."""
+        replayed = 0
+        error = None
+        while True:
+            with self._lock:
+                if not self._failed:
+                    self._poisoned = False
+                    break
+                work = self._failed.popleft()
+            if work.error is not None:
+                # donation consumed the state mid-drain: data is genuinely
+                # lost and the observer must know — the sync path raises the
+                # same way
+                error = work.error
+                continue
+            self._replay(work.pending, work.names)
+            self.stats.async_replayed_steps += len(work.pending)
+            replayed += len(work.pending)
+        if replayed:
+            self._post_drain()
+        if error is not None:
+            raise error
+        return replayed
+
+    def _prefetch(self, inputs):
+        """``jax.device_put`` host arrays at ENQUEUE time (async mode only).
+
+        The H2D staging is an asynchronous dispatch: it proceeds in the
+        background while the caller keeps enqueueing, so the drain finds its
+        payload already on device instead of staging it inside the step.
+        """
+        import jax
+
+        out = list(inputs)
+        staged = 0
+        for i, x in enumerate(out):
+            if isinstance(x, np.ndarray):
+                out[i] = jax.device_put(x)
+                staged += 1
+        if staged:
+            self.stats.async_prefetches += staged
+            return out
+        return inputs
 
 
 class MetricScan(_ScanQueue):
@@ -660,11 +1114,6 @@ class MetricScan(_ScanQueue):
     def exclusive_to(self, metrics: Sequence[Any]) -> bool:
         return any(self._engine._metric is m for m in metrics)
 
-    def push(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> bool:
-        """Queue one update payload; True = handled (folded now or later)."""
-        with self._lock:
-            return self._push_locked(args, kwargs, k)
-
     def _push_locked(self, args, kwargs, k: int) -> bool:
         eng = self._engine
         st = self.stats
@@ -677,7 +1126,7 @@ class MetricScan(_ScanQueue):
             inputs = list(args)
         in_sig = input_signature(inputs)
         if in_sig is None:
-            self._drain_locked("ineligible-step")
+            self._flush_point_locked("ineligible-step", asyncable=False)
             st.fallback("non-array-input")
             return False
         # fast path: a fixed-shape stream repeats one raw signature — skip the
@@ -698,9 +1147,11 @@ class MetricScan(_ScanQueue):
                 st.bucket_pad_rows += n_pad
                 if n_pad:
                     inputs = list(bucketing.pad_args(inputs, bucket))
+            if self._async_limit:
+                inputs = self._prefetch(inputs)
             self._pending.append((args, kwargs, tuple(inputs), n_pad))
             if len(self._pending) >= k:
-                self._drain_locked("k-reached")
+                self._flush_point_locked("k-reached", asyncable=True)
             return True
         if not self._pending:
             # state eligibility is a queue-start check: states cannot change
@@ -729,16 +1180,18 @@ class MetricScan(_ScanQueue):
                 st.bucket_sizes.add(bucket)
         qkey = (bucketed, len(args), kw_names, in_sig, bucket)
         if self._pending and (qkey != self._qkey or k != self._k):
-            self._drain_locked("signature-change")
+            self._flush_point_locked("signature-change", asyncable=True)
         self._qkey = qkey
         self._k = k
         self._fast = (len(args), kw_names, raw_sig, bucketed, bucket, n_pad)
+        if self._async_limit:
+            inputs = self._prefetch(inputs)
         self._pending.append((args, kwargs, tuple(inputs), n_pad))
         if len(self._pending) >= k:
-            self._drain_locked("k-reached")
+            self._flush_point_locked("k-reached", asyncable=True)
         return True
 
-    def _gather_state(self):
+    def _gather_state(self, names):
         m = self._engine._metric
         state: Dict[str, Any] = {}
         for name in m._defaults:
@@ -754,22 +1207,22 @@ class MetricScan(_ScanQueue):
             state[_numerics.STATE_KEY] = _numerics.ensure_residuals(m)
         return state, state_signature(state), type(self._engine)._device_token(state)
 
-    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple):
+    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple, work: _DrainWork):
         m = self._engine._metric
         owner = self.stats.owner
-        bucketed, n_args, kw_names = self._qkey[0], self._qkey[1], self._qkey[2]
+        bucketed, n_args, kw_names = work.qkey[0], work.qkey[1], work.qkey[2]
         quarantined, comp_names, step_txn, step_comp = build_riders(m, example_inputs)
         run = build_run(m, owner, n_args, kw_names, quarantined, comp_names)
         body = make_step_body(run, bucketed, example_inputs, txn=step_txn, comp=step_comp)
         return compile_scan(body, example_state, example_inputs, kb, owner, key, self.stats)
 
-    def _shield(self, state):
+    def _shield(self, state, names):
         return shield_state(state, self._engine._metric, self.stats)
 
-    def _invalidated(self) -> bool:
+    def _invalidated(self, names) -> bool:
         return state_invalidated(self._engine._metric)
 
-    def _writeback(self, out, steps: int, probing: bool) -> None:
+    def _writeback(self, out, steps: int, probing: bool, names) -> None:
         m = self._engine._metric
         st = self.stats
         st.metrics_updated += steps
@@ -777,7 +1230,7 @@ class MetricScan(_ScanQueue):
         if probing:
             _numerics.maybe_drift_probe(m, st)
 
-    def _replay(self, pending) -> None:
+    def _replay(self, pending, names) -> None:
         """Step-at-a-time fallback: byte-identical order, counted, never lost."""
         eng = self._engine
         m = eng._metric
@@ -785,8 +1238,8 @@ class MetricScan(_ScanQueue):
             if not eng.step(args, kwargs):
                 m._run_eager_update(args, kwargs)
 
-    def _fingerprint(self, state_sig, kb: int, device: str) -> Dict[str, Any]:
-        bucketed, n_args, kw_names, in_sig, bucket = self._qkey
+    def _fingerprint(self, state_sig, kb: int, device: str, qkey) -> Dict[str, Any]:
+        bucketed, n_args, kw_names, in_sig, bucket = qkey
         # the K-bucket joins the bucket aspect so a ragged-tail recompile
         # attributes as bucket-miss, never as an uncaused retrace
         return signature_fingerprint((n_args, kw_names), state_sig, in_sig, (bucket, kb), device)
@@ -807,32 +1260,25 @@ class FusedScan(_ScanQueue):
     def exclusive_to(self, metrics: Sequence[Any]) -> bool:
         # the queued payloads fold into the PROBED member set; every one of
         # those members must be covered for a discard to lose nothing
-        covered = [m for _, m in self._members()]
+        covered = [m for _, m in self._members(self._names)]
         return all(any(m is c for c in metrics) for m in covered)
-
-    def push(self, args: Tuple[Any, ...], kwargs: Dict[str, Any], k: int) -> Optional[Set[str]]:
-        """Queue one collection payload; returns handled member names, or
-        ``None`` when this step cannot queue (the caller runs members
-        individually — their own per-metric queues still apply)."""
-        with self._lock:
-            return self._push_locked(args, kwargs, k)
 
     def _push_locked(self, args, kwargs, k: int) -> Optional[Set[str]]:
         eng = self._engine
         st = self.stats
         if kwargs:
-            self._drain_locked("ineligible-step")
+            self._flush_point_locked("ineligible-step", asyncable=False)
             st.fallback("kwargs")
             return None
         inputs = list(args)
         in_sig = input_signature(inputs)
         if in_sig is None:
-            self._drain_locked("ineligible-step")
+            self._flush_point_locked("ineligible-step", asyncable=False)
             st.fallback("non-array-input")
             return None
         members = eng.eligible_members(check_arrays=not self._pending)
         if len(members) < 2:
-            self._drain_locked("ineligible-step")
+            self._flush_point_locked("ineligible-step", asyncable=False)
             st.fallback("too-few-members")
             return None
         n_pad = 0
@@ -860,14 +1306,16 @@ class FusedScan(_ScanQueue):
             fused_names = probe_fusable(members, states, inputs, st)
             self._probed[qkey] = fused_names
         if len(fused_names) < 2:
-            self._drain_locked("ineligible-step")
+            self._flush_point_locked("ineligible-step", asyncable=False)
             st.fallback("too-few-traceable-members")
             return None
         if self._pending and (qkey != self._qkey or k != self._k):
-            self._drain_locked("signature-change")
+            self._flush_point_locked("signature-change", asyncable=True)
         self._qkey = qkey
         self._k = k
         self._names = fused_names
+        if self._async_limit:
+            inputs = self._prefetch(inputs)
         self._pending.append((args, {}, tuple(inputs), n_pad))
         # the host-side bookkeeping the one-step fused writeback would do,
         # done at ENQUEUE: update_count is observation-independent (any state
@@ -879,17 +1327,20 @@ class FusedScan(_ScanQueue):
                 m._update_count += 1
                 handled.add(name)
         if len(self._pending) >= k:
-            self._drain_locked("k-reached")
+            self._flush_point_locked("k-reached", asyncable=True)
         return handled
 
-    def _members(self) -> List[Tuple[str, Any]]:
-        return [(name, m) for name, m in self._engine.metrics if name in self._names]
+    def _members(self, names) -> List[Tuple[str, Any]]:
+        return [(name, m) for name, m in self._engine.metrics if name in names]
 
-    def _gather_state(self):
+    def _names_snapshot(self):
+        return self._names
+
+    def _gather_state(self, names):
         states: Dict[str, Dict[str, Any]] = {}
         sigs = []
         device = ""
-        for name, m in self._members():
+        for name, m in self._members(names):
             mstate = {sn: getattr(m, sn) for sn in m._defaults}
             if not all(_is_jax_array(v) for v in mstate.values()):
                 return None
@@ -907,38 +1358,38 @@ class FusedScan(_ScanQueue):
                 device = CompiledUpdate._device_token(mstate)
         return states, tuple(sigs), device
 
-    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple):
+    def _compile_entry(self, example_state, example_inputs, kb: int, key: Tuple, work: _DrainWork):
         from torchmetrics_tpu.engine.fusion import build_fused_riders, build_run_all
 
-        bucketed = self._qkey[0]
-        fusable = self._members()
+        bucketed = work.qkey[0]
+        fusable = self._members(work.names)
         quarantined, comp_names, step_txn, step_comp = build_fused_riders(fusable, example_inputs)
         run_all = build_run_all(fusable, comp_names, quarantined)
         body = make_step_body(run_all, bucketed, example_inputs, txn=step_txn, comp=step_comp)
         return compile_scan(body, example_state, example_inputs, kb, self.stats.owner, key, self.stats)
 
-    def _shield(self, states):
-        return {name: shield_state(states[name], m, self.stats) for name, m in self._members()}
+    def _shield(self, states, names):
+        return {name: shield_state(states[name], m, self.stats) for name, m in self._members(names)}
 
-    def _invalidated(self) -> bool:
-        return any(state_invalidated(m) for _, m in self._members())
+    def _invalidated(self, names) -> bool:
+        return any(state_invalidated(m) for _, m in self._members(names))
 
-    def _writeback(self, out, steps: int, probing: bool) -> None:
+    def _writeback(self, out, steps: int, probing: bool, names) -> None:
         st = self.stats
-        for name, m in self._members():
+        for name, m in self._members(names):
             st.metrics_updated += steps
             residual_out = write_member_state(m, out[name], steps, st)
             if probing and residual_out is not None:
                 _numerics.maybe_drift_probe(m, st, owner=f"{st.owner}:{name}")
 
-    def _replay(self, pending) -> None:
+    def _replay(self, pending, names) -> None:
         """Per-member eager replay (update_count already advanced at enqueue)."""
         for args, _, _, _ in pending:
-            for _, m in self._members():
+            for _, m in self._members(names):
                 m._run_eager_update(args, {})
 
-    def _fingerprint(self, state_sig, kb: int, device: str) -> Dict[str, Any]:
-        bucketed, in_sig, bucket, _ = self._qkey
+    def _fingerprint(self, state_sig, kb: int, device: str, qkey) -> Dict[str, Any]:
+        bucketed, in_sig, bucket, _ = qkey
         fp = type(self._engine)._fingerprint(state_sig, in_sig, (bucket, kb))
         fp["device"] = device
         return fp

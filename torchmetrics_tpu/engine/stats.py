@@ -35,6 +35,15 @@ _COUNTER_FIELDS = (
     "scan_steps_folded",  # real update steps folded across all scan drains
     "scan_pad_steps",  # masked no-op padding steps added to fill K-buckets
     "scan_flushes",  # queue flushes (drains + discards), by reason in scan_flush_reasons
+    # --- async pipelined dispatch (engine/async_dispatch.py): background drains ---
+    "async_submits",  # buffers swapped out and handed to the background worker
+    "async_dispatches",  # background drains the worker executed (overlapping the caller)
+    "async_joins",  # observation joins that actually waited on in-flight work
+    "async_join_wait_us",  # host µs observers spent waiting at joins (exported in seconds)
+    "async_overlap_us",  # drain/sync µs overlapped with caller forward progress
+    "async_backpressure_waits",  # submits that blocked on the bounded in-flight window
+    "async_replayed_steps",  # steps replayed on the caller after a worker drain failed
+    "async_prefetches",  # host arrays device_put-staged at enqueue, ahead of their drain
     # --- transactional layer (engine/txn.py): quarantine + fallback ladder ---
     "quarantined_batches",  # poisoned batches skipped in-graph (filled at the sanctioned read)
     "ladder_retries",  # dispatch failures that stepped down to a smaller bucket

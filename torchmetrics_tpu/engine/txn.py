@@ -99,6 +99,8 @@ MODE_QUARANTINE = "1"
 MODE_ERROR = "error"
 
 _mode_override: Optional[str] = None
+# (raw env value, parsed mode) — see quarantine_mode()
+_env_mode_cache: tuple = ("", MODE_OFF)
 
 # metrics currently carrying a quarantine counter, for process-wide reporting.
 # WeakValueDictionary keyed by id(): Metric.__hash__ covers current state-array
@@ -125,19 +127,29 @@ def quarantine_mode() -> str:
     protection the knob was set to enable (same contract as
     ``SnapshotPolicy.from_env``).
     """
+    global _env_mode_cache
     if _mode_override is not None:
         return _mode_override
-    raw = os.environ.get(QUARANTINE_ENV_VAR, "").strip().lower()
-    if raw in ("", "0", "off"):
-        return MODE_OFF
-    if raw in ("1", "on", "quarantine"):
-        return MODE_QUARANTINE
-    if raw == "error":
-        return MODE_ERROR
-    raise TorchMetricsUserError(
-        f"{QUARANTINE_ENV_VAR}={raw!r} is not a recognized quarantine mode "
-        "(expected unset/'0'/'off', '1'/'on'/'quarantine', or 'error')"
-    )
+    # cached parse keyed on the raw value: this sits on the per-update hot
+    # path (the wrapper consults the mode every step), so a steady env var
+    # costs one os.environ read + string compare, not a re-parse
+    raw = os.environ.get(QUARANTINE_ENV_VAR, "")
+    if raw == _env_mode_cache[0]:
+        return _env_mode_cache[1]
+    val = raw.strip().lower()
+    if val in ("", "0", "off"):
+        mode = MODE_OFF
+    elif val in ("1", "on", "quarantine"):
+        mode = MODE_QUARANTINE
+    elif val == "error":
+        mode = MODE_ERROR
+    else:
+        raise TorchMetricsUserError(
+            f"{QUARANTINE_ENV_VAR}={val!r} is not a recognized quarantine mode "
+            "(expected unset/'0'/'off', '1'/'on'/'quarantine', or 'error')"
+        )
+    _env_mode_cache = (raw, mode)
+    return mode
 
 
 def quarantine_enabled() -> bool:

@@ -140,6 +140,15 @@ class Metric:
             from torchmetrics_tpu.engine.scan import coerce_k
 
             self.scan_steps = coerce_k(self.scan_steps)
+        # async pipelined dispatch (engine/async_dispatch.py): None = follow
+        # the process-wide policy (TORCHMETRICS_TPU_ASYNC / async_context),
+        # False/0 forces background drains off, True/int forces them on with
+        # the default/explicit in-flight bound. Layers on the scan queue.
+        self.async_dispatch = kwargs.pop("async_dispatch", None)
+        if self.async_dispatch is not None:
+            from torchmetrics_tpu.engine.async_dispatch import coerce_inflight
+
+            self.async_dispatch = coerce_inflight(self.async_dispatch)
 
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
@@ -978,8 +987,13 @@ class Metric:
             # multi-step scan dispatch (engine/scan.py): queue this payload —
             # K steps fold into one donated lax.scan executable. forward()'s
             # inner updates (mutation depth > 1) bypass the queue: forward IS
-            # a value request, so its batch must apply immediately
-            return eng.scan_step(args, kwargs, k)
+            # a value request, so its batch must apply immediately. The async
+            # tier (engine/async_dispatch.py) resolves HERE — only where a
+            # scan queue is active — so an invalid TORCHMETRICS_TPU_ASYNC can
+            # never raise on configurations that never read it
+            from torchmetrics_tpu.engine.async_dispatch import resolve_async
+
+            return eng.scan_step(args, kwargs, k, resolve_async(self.async_dispatch))
         return eng.step(args, kwargs)
 
     def _scan_depth(self) -> Optional[int]:
@@ -1266,6 +1280,7 @@ class Metric:
         self.__dict__.setdefault("_none_folded", set())
         self.__dict__.setdefault("compiled_update", None)
         self.__dict__.setdefault("scan_steps", None)
+        self.__dict__.setdefault("async_dispatch", None)
         # pre-spec pickles: roles re-derive lazily (counted spec_fallbacks)
         self.__dict__.setdefault("_state_specs", {})
         self._engine = None  # executables are per-process/per-instance; rebuilt lazily

@@ -37,6 +37,28 @@ __all__ = ["MetricsSidecar", "PROMETHEUS_CONTENT_TYPE"]
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 
+def _scrape_flush() -> None:
+    """Drain every scan queue before the scrape reads counters/gauges.
+
+    The pause-free contract (the snapshot-compute discipline applied to
+    scrapes): with async dispatch on, ``drain()`` routes the buffers through
+    the BACKGROUND worker and only this scrape thread waits on the join — the
+    training thread contends solely on the brief buffer swap, so a Prometheus
+    scrape can never stall a training step. The scrape still observes the
+    flush-on-observation watermark: every step enqueued before the scrape is
+    folded into what it exports. Synchronous mode keeps the pre-async
+    behavior (the drain runs here, on the scrape thread — not the hot loop's).
+    """
+    from torchmetrics_tpu.engine.async_dispatch import _engaged
+    from torchmetrics_tpu.engine.scan import flush_all
+
+    drained = flush_all("observation:scrape")
+    if _engaged:
+        # narrate the pause-free route: the steps this scrape waited out rode
+        # the background worker, not this thread's dispatch
+        _diag.record("serve.scrape.async", "sidecar", drained=drained)
+
+
 class _ScrapeHandler(BaseHTTPRequestHandler):
     server_version = "tm-tpu-sidecar/1.0"
 
@@ -46,20 +68,18 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
         try:
             if path in ("/metrics", "/"):
                 from torchmetrics_tpu.diag.telemetry import export_prometheus
-                from torchmetrics_tpu.engine.scan import flush_all
 
                 # drain-before-scrape (engine/scan.py): counters and gauges a
                 # scraper sees must reflect every enqueued step — the flush is
                 # recorded (scan.flush, reason=observation:scrape) so diag can
                 # prove no stale-read path exists
-                flush_all("observation:scrape")
+                _scrape_flush()
                 body = export_prometheus().encode()
                 ctype = PROMETHEUS_CONTENT_TYPE
             elif path == "/telemetry":
                 from torchmetrics_tpu.diag.telemetry import telemetry_snapshot
-                from torchmetrics_tpu.engine.scan import flush_all
 
-                flush_all("observation:scrape")
+                _scrape_flush()
                 body = (json.dumps(telemetry_snapshot(), sort_keys=True, default=str) + "\n").encode()
                 ctype = "application/json"
             elif path == "/healthz":
