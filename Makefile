@@ -1,9 +1,15 @@
 # One-command entry points (reference Makefile:22-26 analogue).
 
-.PHONY: test test-fast bench multichip
+.PHONY: test test-fast bench multichip lint lint-json
 
 test:            ## full gate: CPU-mesh suite + doctests + differential + distributed worlds
 	bash scripts/ci.sh
+
+lint:            ## static invariant analysis (tools/tmlint): transfer purity, knob/counter/event lockstep, lock discipline
+	python -m tools.tmlint torchmetrics_tpu/
+
+lint-json:       ## same, machine-readable (per-rule finding counts for trend tooling)
+	python -m tools.tmlint torchmetrics_tpu/ --json
 
 test-fast:       ## same gate minus the execute-the-reference differential sweep
 	bash scripts/ci.sh fast

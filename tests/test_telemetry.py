@@ -91,50 +91,11 @@ class IntCount(Metric):
 # ------------------------------------------------------------------ prometheus
 
 
-#: unit suffixes the exposition conventions recognise for this exporter; any
-#: series introduced from the profiling layer onward MUST end in one of these
-#: (before a histogram's _bucket/_sum/_count or a counter's _total). `_ratio`
-#: is the conventional spelling for dimensionless 0..1 gauges (serve sketch
-#: saturation).
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_flops", "_ratio")
-
-#: families whose value is a pure EVENT/OBJECT COUNT or an enum bitmask — the
-#: exposition conventions require no unit suffix for those (`http_requests_total`
-#: style). Any series measuring a physical quantity (time, size, rate) must
-#: NOT be added here; give it a `_seconds`/`_bytes`/`_flops` spelling instead.
-UNITLESS_COUNT_FAMILIES = {
-    "tm_tpu_traces", "tm_tpu_cache_hits", "tm_tpu_dispatches", "tm_tpu_metrics_updated",
-    "tm_tpu_eager_fallbacks", "tm_tpu_donated_dispatches", "tm_tpu_donation_copies",
-    "tm_tpu_donation_fallbacks", "tm_tpu_bucketed_steps", "tm_tpu_bucket_pad_rows",
-    "tm_tpu_packed_syncs", "tm_tpu_sync_collectives", "tm_tpu_sync_metadata_gathers",
-    "tm_tpu_sync_fold_traces", "tm_tpu_sync_divergence_flags", "tm_tpu_sync_straggler_flags",
-    "tm_tpu_sync_retries", "tm_tpu_sync_degraded_folds",
-    "tm_tpu_quarantined_batches", "tm_tpu_ladder_retries",
-    # multi-step scan dispatch (engine/scan.py, PR 10): drain/step/flush event
-    # counts — pure counts, no physical unit
-    "tm_tpu_scan_dispatches", "tm_tpu_scan_steps_folded", "tm_tpu_scan_pad_steps",
-    "tm_tpu_scan_flushes", "tm_tpu_scan_flush_reasons",
-    "tm_tpu_compute_traces", "tm_tpu_compute_dispatches", "tm_tpu_compute_cache_hits",
-    "tm_tpu_profile_probes", "tm_tpu_engines", "tm_tpu_retrace_causes",
-    "tm_tpu_fallback_reasons", "tm_tpu_events", "tm_tpu_events_dropped",
-    "tm_tpu_ledger_executables", "tm_tpu_sentinel_flags",
-    # serving layer (serve/, PR 9): scrape/snapshot event counts + live-object
-    # gauges; scrape latency itself is unit-suffixed (serve_scrape_latency_seconds)
-    "tm_tpu_serve_scrapes", "tm_tpu_serve_snapshots", "tm_tpu_serve_snapshot_retries",
-    "tm_tpu_serve_tenants", "tm_tpu_serve_spilled_updates",
-    # state-spec registry (engine/statespec.py, PR 11): deprecated-convention
-    # role resolutions — a pure migration count, no physical unit
-    "tm_tpu_spec_fallbacks",
-    # SPMD sharded-state engine (parallel/sharding.py, PR 12): placement /
-    # in-graph-sync event counts — pure counts, no physical unit
-    "tm_tpu_shard_states", "tm_tpu_psum_syncs", "tm_tpu_gather_skipped",
-    # async pipelined dispatch (engine/async_dispatch.py, PR 13): buffer /
-    # drain / join / replay event counts and the in-flight-depth histogram —
-    # pure counts; the time-valued async series export as *_seconds
-    "tm_tpu_async_submits", "tm_tpu_async_dispatches", "tm_tpu_async_joins",
-    "tm_tpu_async_backpressure_waits", "tm_tpu_async_replayed_steps",
-    "tm_tpu_async_prefetches", "tm_tpu_async_queue_depth",
-}
+#: the unit-suffix rule and the pure-count allowlist are now CANONICAL in
+#: diag/telemetry.py (the static analyzer reads them there too — tmlint rule
+#: TM403 gates the same convention from the source text); the parser below
+#: keeps enforcing them at scrape time
+from torchmetrics_tpu.diag.telemetry import UNIT_SUFFIXES, UNITLESS_COUNT_FAMILIES  # noqa: E402
 
 
 def _family_of(name):

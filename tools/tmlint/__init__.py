@@ -1,0 +1,46 @@
+"""tmlint — the AST-based invariant analyzer for torchmetrics_tpu.
+
+Thirteen PRs accreted cross-cutting invariants that runtime guards and CI
+greps enforced piecemeal; tmlint checks them from the source text, before a
+TPU — or even a test run — is needed. Rule families (catalog with IDs in
+``docs/pages/static-analysis.md``):
+
+=======  ==============================================================
+TM1xx    transfer purity — host readbacks only at registered boundaries
+TM2xx    env-knob contract — fail-loud parsers + doc lockstep
+TM301    rider-key lockstep — one spelling site for reserved pytree keys
+TM4xx    counter lockstep — EngineStats ↔ telemetry ↔ unit conventions
+TM5xx    event taxonomy — declared, documented, recorded
+TM6xx    lock discipline — guarded-by annotations on cross-thread state
+=======  ==============================================================
+
+Run ``python -m tools.tmlint torchmetrics_tpu/`` from the repo root (see
+``scripts/ci.sh``), or ``--json`` for machine-readable finding counts.
+"""
+
+from tools.tmlint.core import Finding, Project, SourceFile, run_lint
+
+#: rule catalog: id -> one-line description (the docs page mirrors this)
+RULES = {
+    "TM101": "unsanctioned host readback in engine/parallel/serve",
+    "TM102": "float()/int() over a jnp-derived value (implicit readback)",
+    "TM103": "transfer_allowed label / boundary() not registered",
+    "TM201": "TORCHMETRICS_TPU_* env read outside its registered parser",
+    "TM202": "dynamic environ read outside the registered generic parsers",
+    "TM203": "registered env knob undocumented in docs/api/root.md",
+    "TM204": "documented env knob missing from KNOB_REGISTRY",
+    "TM301": "reserved rider-key literal outside engine/statespec.py",
+    "TM401": "EngineStats counter missing from the telemetry export table",
+    "TM402": "telemetry export row for a nonexistent counter",
+    "TM403": "exported series name violates the unit-suffix convention",
+    "TM404": "EngineStats.__init__/reset no longer iterate _COUNTER_FIELDS",
+    "TM501": "record() kind not declared in EVENT_KINDS",
+    "TM502": "dynamic event kind outside an event-forwarder",
+    "TM503": "declared event kind undocumented in observability.md",
+    "TM504": "declared event kind never recorded (dead taxonomy)",
+    "TM601": "guarded-by attribute accessed outside its lock",
+    "TM602": "lock created with no guarded-by declarations",
+    "TM603": "guarded-by/holds names a lock that does not exist",
+}
+
+__all__ = ["Finding", "Project", "RULES", "SourceFile", "run_lint"]

@@ -1,0 +1,91 @@
+"""tmlint CLI — ``python -m tools.tmlint [paths...]``.
+
+Exit status 0 when every finding is covered by the committed baseline
+(``tools/tmlint/baseline.json`` by default), 1 otherwise. ``--json`` emits a
+machine-readable report (per-rule counts included) for trend tooling like
+``scripts/bench_trend.py``; ``--write-baseline`` grandfathers the current
+findings (the committed baseline ships EMPTY for the transfer/knob/rider
+families — keep it that way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from tools.tmlint import RULES, run_lint
+from tools.tmlint.core import save_baseline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tmlint",
+        description="AST-based invariant analyzer for torchmetrics_tpu",
+    )
+    parser.add_argument("paths", nargs="*", default=["torchmetrics_tpu"], help="files/dirs to analyze")
+    parser.add_argument("--project-root", default=".", help="repo root (registries + docs live here)")
+    parser.add_argument("--baseline", default=None, help="baseline file (default: tools/tmlint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true", help="write current findings to the baseline")
+    parser.add_argument("--rules", default=None, help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--json", action="store_true", dest="as_json", help="machine-readable output")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    root = Path(args.project_root).resolve()
+    baseline = None
+    if not args.no_baseline:
+        baseline = Path(args.baseline) if args.baseline else root / "tools" / "tmlint" / "baseline.json"
+    rules = {r.strip() for r in args.rules.split(",")} if args.rules else None
+    paths = [Path(p) for p in args.paths]
+
+    result = run_lint(paths, root=root, rules=rules, baseline_path=baseline)
+
+    if args.write_baseline:
+        target = baseline or root / "tools" / "tmlint" / "baseline.json"
+        save_baseline(target, result["findings"])
+        print(f"tmlint: wrote {len(result['findings'])} finding(s) to {target}")
+        return 0
+
+    counts = Counter(f.rule for f in result["new"])
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {"rule": f.rule, "path": f.path, "line": f.line, "message": f.message}
+                        for f in result["new"]
+                    ],
+                    "counts": {k: counts[k] for k in sorted(counts)},
+                    "baselined": len(result["baselined"]),
+                    "stale_baseline": result["stale"],
+                    "ok": not result["new"],
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in result["new"]:
+            print(f.render())
+        if result["baselined"]:
+            print(f"tmlint: {len(result['baselined'])} grandfathered finding(s) suppressed by the baseline")
+        for fp in result["stale"]:
+            print(f"tmlint: stale baseline entry (fixed? regenerate): {fp}")
+        if result["new"]:
+            print(f"tmlint: {len(result['new'])} finding(s) [" + ", ".join(f"{k}={counts[k]}" for k in sorted(counts)) + "]")
+        else:
+            print("tmlint: clean")
+    return 1 if result["new"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

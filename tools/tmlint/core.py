@@ -1,0 +1,386 @@
+"""tmlint core — source model, annotations, suppressions, baseline, runner.
+
+The analyzer is stdlib-only (``ast`` + ``tokenize``) and runs from source
+text: no imports of the analyzed package, no accelerator, no test run. Every
+rule reads the same :class:`SourceFile` model:
+
+- **Suppressions** — ``# tmlint: disable=TM101`` (comma-separated rule ids)
+  on the finding's line or the line directly above silences exactly those
+  rules for that line.
+- **Function annotations** — a comment on the ``def`` line or up to two lines
+  above it:
+
+  - ``# tmlint: holds(<lock>)`` — every caller guarantees ``<lock>`` is held
+    for the duration (the ``*_locked`` convention, checked at the call sites'
+    discipline, declared here);
+  - ``# tmlint: single-owner(<role>)`` — the function runs on exactly one
+    thread (``caller`` / ``worker``); guarded attributes may be touched
+    without the lock;
+  - ``# tmlint: boundary(<label>)`` — the function only runs inside the named
+    sanctioned transfer boundary (label must be registered in
+    ``diag/transfer_guard.py``);
+  - ``# tmlint: host-only`` — the function operates on host (numpy/python)
+    data exclusively; no device buffer can reach its readback calls;
+  - ``# tmlint: event-forwarder`` — the function forwards a caller-supplied
+    event kind (exempt from the dynamic-kind rule).
+
+- **Attribute guards** — ``# guarded-by: <lock>`` trailing (or directly
+  above) an attribute's declaring assignment marks it as lock-protected
+  shared state; rule TM601 then requires every access to sit inside a
+  ``with <lock>`` block, a ``holds(<lock>)`` function, or a single-owner
+  function.
+
+- **Scope markers** — ``# tmlint: scope=transfer|locks|knobs`` anywhere in a
+  file opts it into the scoped rule families (used by test fixtures; in-tree
+  scoping is path-based).
+
+Findings carry a content-addressed ``fingerprint`` (rule + relative path +
+normalized line text + occurrence index) so the committed baseline survives
+unrelated line-number drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(r"tmlint:\s*disable=([A-Z0-9, ]+)")
+_ANNOT_RE = re.compile(
+    r"tmlint:\s*(holds|single-owner|boundary)\(([^)]*)\)|tmlint:\s*(host-only|event-forwarder)"
+)
+_SCOPE_RE = re.compile(r"tmlint:\s*scope=([a-z,]+)")
+_GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # project-root-relative, posix separators
+    line: int
+    message: str
+    fingerprint: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class FunctionInfo:
+    node: ast.AST
+    qualname: str
+    holds: Set[str] = field(default_factory=set)
+    single_owner: Optional[str] = None
+    boundary: Optional[str] = None
+    host_only: bool = False
+    event_forwarder: bool = False
+
+
+class SourceFile:
+    """Parsed source + comment-derived metadata for one file."""
+
+    def __init__(self, path: Path, root: Path) -> None:
+        self.path = path
+        self.relpath = path.resolve().relative_to(root.resolve()).as_posix() if _is_under(path, root) else path.as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.comments: Dict[int, str] = self._collect_comments()
+        self.scopes: Set[str] = self._collect_scopes()
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.functions: Dict[ast.AST, FunctionInfo] = self._collect_functions()
+        #: instance attributes, keyed by bare attr name (file-wide: subclasses
+        #: inherit the base class's discipline) -> lock name
+        self.guarded_attrs: Dict[str, str] = {}
+        #: module-level globals -> lock name
+        self.guarded_globals: Dict[str, str] = {}
+        self.guard_decl_lines: Set[int] = set()
+        self._collect_guards()
+
+    # -- comments ------------------------------------------------------
+
+    def _collect_comments(self) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def _collect_scopes(self) -> Set[str]:
+        scopes: Set[str] = set()
+        for text in self.comments.values():
+            m = _SCOPE_RE.search(text)
+            if m:
+                scopes.update(s for s in m.group(1).split(",") if s)
+        return scopes
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """Same-line suppression, or anywhere in the contiguous comment block
+        directly above (multi-line justifications are encouraged)."""
+        candidates = [lineno]
+        ln = lineno - 1
+        while ln in self.comments:
+            candidates.append(ln)
+            ln -= 1
+        for ln in candidates:
+            m = _DISABLE_RE.search(self.comments.get(ln, ""))
+            if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                return True
+        return False
+
+    # -- structure -----------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing(self, node: ast.AST, kinds: Tuple[type, ...]) -> Optional[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
+        fn = self.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        return self.functions.get(fn) if fn is not None else None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        found = self.enclosing(node, (ast.ClassDef,))
+        return found if isinstance(found, ast.ClassDef) else None
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def _collect_functions(self) -> Dict[ast.AST, FunctionInfo]:
+        out: Dict[ast.AST, FunctionInfo] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info = FunctionInfo(node=node, qualname=self.qualname(node))
+            # the def line, any decorator lines, and the whole contiguous
+            # comment block directly above them
+            first = min([node.lineno] + [d.lineno for d in node.decorator_list])
+            parts = [self.comments.get(node.lineno, "")]
+            ln = first - 1
+            while ln in self.comments:
+                parts.append(self.comments[ln])
+                ln -= 1
+            text = " ".join(parts)
+            for m in _ANNOT_RE.finditer(text):
+                if m.group(1) == "holds":
+                    info.holds.add(m.group(2).strip())
+                elif m.group(1) == "single-owner":
+                    info.single_owner = m.group(2).strip() or "unspecified"
+                elif m.group(1) == "boundary":
+                    info.boundary = m.group(2).strip()
+                elif m.group(3) == "host-only":
+                    info.host_only = True
+                elif m.group(3) == "event-forwarder":
+                    info.event_forwarder = True
+            out[node] = info
+        return out
+
+    # -- guarded attributes --------------------------------------------
+
+    def _collect_guards(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            # same-line comment wins over the line above (adjacent declarations
+            # each carry their own trailing annotation)
+            m = _GUARDED_RE.search(self.comments.get(node.lineno, ""))
+            if not m:
+                m = _GUARDED_RE.search(self.comments.get(node.lineno - 1, ""))
+            if not m:
+                continue
+            lock = m.group(1)
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                    if self.enclosing_class(node) is not None:
+                        self.guarded_attrs[tgt.attr] = lock
+                        self.guard_decl_lines.add(node.lineno)
+                elif isinstance(tgt, ast.Name):
+                    cls = self.enclosing_class(node)
+                    fn = self.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    if cls is None and fn is None:  # module-level global
+                        self.guarded_globals[tgt.id] = lock
+                        self.guard_decl_lines.add(node.lineno)
+
+    # -- with-block lock spans -----------------------------------------
+
+    def with_lock_spans(self) -> List[Tuple[str, int, int]]:
+        """``(lock_name, first_line, last_line)`` for every ``with`` item that
+        looks like a lock acquisition (``with self._lock:`` / ``with LOCK:``)."""
+        spans: List[Tuple[str, int, int]] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                name: Optional[str] = None
+                if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) and expr.value.id in ("self", "cls"):
+                    name = expr.attr
+                elif isinstance(expr, ast.Name):
+                    name = expr.id
+                if name is not None:
+                    spans.append((name, node.lineno, node.end_lineno or node.lineno))
+        return spans
+
+
+def _is_under(path: Path, root: Path) -> bool:
+    try:
+        path.resolve().relative_to(root.resolve())
+        return True
+    except ValueError:
+        return False
+
+
+class Project:
+    """The analysis context: root dir, file set, lazily extracted registries."""
+
+    def __init__(self, root: Path, paths: Sequence[Path]) -> None:
+        self.root = Path(root).resolve()
+        self.files: List[Path] = []
+        pkg = (self.root / "torchmetrics_tpu").resolve()
+        #: whether the analyzed set covers the whole package — whole-tree
+        #: checks (e.g. the TM504 orphan scan) only make sense then
+        self.full_package = False
+        for p in paths:
+            p = Path(p)
+            if p.is_dir():
+                self.files.extend(sorted(q for q in p.rglob("*.py") if "__pycache__" not in q.parts))
+                res = p.resolve()
+                if res == pkg or _is_under(pkg, res):
+                    self.full_package = True
+            elif p.suffix == ".py":
+                self.files.append(p)
+        self._registry_cache: Dict[str, Any] = {}
+        #: literal event kinds observed at record() sites (filled by the
+        #: events rule during the file pass; read by its project pass)
+        self.recorded_kinds: Set[str] = set()
+
+    def package_file(self, rel: str) -> Optional[Path]:
+        p = self.root / rel
+        return p if p.is_file() else None
+
+    def module_name(self, path: Path) -> str:
+        """Dotted module path of a file relative to the project root."""
+        try:
+            rel = path.resolve().relative_to(self.root)
+        except ValueError:
+            return path.stem
+        parts = list(rel.parts)
+        parts[-1] = parts[-1][:-3]  # drop .py
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def registry(self, key: str, loader) -> Any:
+        if key not in self._registry_cache:
+            self._registry_cache[key] = loader(self)
+        return self._registry_cache[key]
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def finding_fingerprints(findings: Iterable[Finding], lines_by_path: Dict[str, List[str]]) -> List[Finding]:
+    """Attach content-addressed fingerprints (stable across line drift)."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Finding] = []
+    for f in findings:
+        lines = lines_by_path.get(f.path, [])
+        content = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        key = (f.rule, f.path, content)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        fp = f"{f.rule}|{f.path}|{content}|{idx}"
+        out.append(Finding(f.rule, f.path, f.line, f.message, fingerprint=fp))
+    return out
+
+
+def load_baseline(path: Path) -> Set[str]:
+    if not path.is_file():
+        return set()
+    data = json.loads(path.read_text())
+    return {entry["fingerprint"] for entry in data.get("findings", [])}
+
+
+def save_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    data = {
+        "version": 1,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "fingerprint": f.fingerprint}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+
+
+# ------------------------------------------------------------------ runner
+
+
+def run_lint(
+    paths: Sequence[Path],
+    root: Optional[Path] = None,
+    rules: Optional[Set[str]] = None,
+    baseline_path: Optional[Path] = None,
+) -> Dict[str, Any]:
+    """Run every rule family; returns findings, baselined + stale splits."""
+    from tools.tmlint import rules_counters, rules_events, rules_knobs, rules_locks, rules_riders, rules_transfer
+
+    root = Path(root).resolve() if root is not None else Path.cwd()
+    project = Project(root, paths)
+    families = (rules_transfer, rules_knobs, rules_riders, rules_counters, rules_events, rules_locks)
+
+    findings: List[Finding] = []
+    lines_by_path: Dict[str, List[str]] = {}
+    for path in project.files:
+        try:
+            sf = SourceFile(path, root)
+        except SyntaxError as err:
+            findings.append(Finding("TM000", str(path), err.lineno or 1, f"syntax error: {err.msg}"))
+            continue
+        lines_by_path[sf.relpath] = sf.lines
+        for fam in families:
+            check = getattr(fam, "check_file", None)
+            if check is not None:
+                findings.extend(check(project, sf))
+    for fam in families:
+        check = getattr(fam, "check_project", None)
+        if check is not None:
+            for f in check(project):
+                findings.append(f)
+                if f.path not in lines_by_path:
+                    p = root / f.path
+                    lines_by_path[f.path] = p.read_text().splitlines() if p.is_file() else []
+
+    if rules:
+        findings = [f for f in findings if f.rule in rules]
+    findings = finding_fingerprints(findings, lines_by_path)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    known = load_baseline(baseline_path) if baseline_path else set()
+    new = [f for f in findings if f.fingerprint not in known]
+    baselined = [f for f in findings if f.fingerprint in known]
+    stale = sorted(known - {f.fingerprint for f in findings})
+    return {"findings": findings, "new": new, "baselined": baselined, "stale": stale}

@@ -1,0 +1,168 @@
+"""tmlint registry extraction — read the package's declared invariants from
+source text (never by importing the package).
+
+Each accessor parses the module that CANONICALLY declares a registry:
+
+===========================  =================================================
+``EVENT_KINDS``              ``torchmetrics_tpu/diag/trace.py``
+``TRANSFER_LABELS`` (+ prefixes)  ``torchmetrics_tpu/diag/transfer_guard.py``
+``KNOB_REGISTRY`` (+ generic parsers)  ``torchmetrics_tpu/engine/config.py``
+``RIDER_KEYS``               ``torchmetrics_tpu/engine/statespec.py``
+``_COUNTER_FIELDS``          ``torchmetrics_tpu/engine/stats.py``
+counter/histogram export tables + unit rule  ``torchmetrics_tpu/diag/telemetry.py``
+===========================  =================================================
+
+The mini-evaluator below resolves module-level assignments whose value is a
+constant expression over literals, earlier module constants, and the builtin
+container constructors (``frozenset``/``set``/``tuple``/``list``/``dict``) —
+enough for every registry above without executing package code.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from tools.tmlint.core import Project
+
+_CONSTRUCTORS = {"frozenset": frozenset, "set": set, "tuple": tuple, "list": list, "dict": dict}
+
+
+def _resolve(node: ast.AST, env: Dict[str, Any]) -> Any:
+    """Evaluate a constant expression over literals + known module constants."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise ValueError(f"unresolvable name {node.id!r}")
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_resolve(e, env) for e in node.elts]
+        return tuple(vals) if isinstance(node, ast.Tuple) else vals
+    if isinstance(node, ast.Set):
+        return {_resolve(e, env) for e in node.elts}
+    if isinstance(node, ast.Dict):
+        return {
+            _resolve(k, env): _resolve(v, env)
+            for k, v in zip(node.keys, node.values)
+            if k is not None
+        }
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id in _CONSTRUCTORS:
+        ctor = _CONSTRUCTORS[node.func.id]
+        if not node.args:
+            return ctor()
+        return ctor(_resolve(node.args[0], env))
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _resolve(node.left, env) + _resolve(node.right, env)
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                parts.append(str(_resolve(v.value, env)))
+            else:
+                raise ValueError("unresolvable f-string part")
+        return "".join(parts)
+    raise ValueError(f"unresolvable node {type(node).__name__}")
+
+
+def module_constants(path: Path) -> Dict[str, Any]:
+    """Every module-level NAME whose assigned value resolves constantly."""
+    tree = ast.parse(path.read_text())
+    env: Dict[str, Any] = {}
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                try:
+                    env[tgt.id] = _resolve(value, env)
+                except ValueError:
+                    pass
+    return env
+
+
+def _constants_of(project: Project, rel: str) -> Dict[str, Any]:
+    path = project.package_file(rel)
+    return module_constants(path) if path is not None else {}
+
+
+def event_kinds(project: Project) -> frozenset:
+    def load(p: Project):
+        return frozenset(_constants_of(p, "torchmetrics_tpu/diag/trace.py").get("EVENT_KINDS", ()))
+
+    return project.registry("event_kinds", load)
+
+
+def transfer_labels(project: Project):
+    def load(p: Project):
+        env = _constants_of(p, "torchmetrics_tpu/diag/transfer_guard.py")
+        return (
+            frozenset(env.get("TRANSFER_LABELS", ())),
+            tuple(env.get("TRANSFER_LABEL_PREFIXES", ())),
+        )
+
+    return project.registry("transfer_labels", load)
+
+
+def knob_registry(project: Project):
+    def load(p: Project):
+        env = _constants_of(p, "torchmetrics_tpu/engine/config.py")
+        return (
+            dict(env.get("KNOB_REGISTRY", {})),
+            tuple(env.get("GENERIC_KNOB_PARSERS", ())),
+        )
+
+    return project.registry("knob_registry", load)
+
+
+def rider_keys(project: Project) -> frozenset:
+    def load(p: Project):
+        env = _constants_of(p, "torchmetrics_tpu/engine/statespec.py")
+        keys = env.get("RIDER_KEYS")
+        if keys:
+            return frozenset(keys)
+        # self-hosting fallback: the reserved keys are part of the rule's
+        # contract even if the registry module is missing from the target tree
+        return frozenset({"__sentinel__", "__quarantine__", "__compensation__"})
+
+    return project.registry("rider_keys", load)
+
+
+def counter_fields(project: Project) -> tuple:
+    def load(p: Project):
+        return tuple(_constants_of(p, "torchmetrics_tpu/engine/stats.py").get("_COUNTER_FIELDS", ()))
+
+    return project.registry("counter_fields", load)
+
+
+def telemetry_tables(project: Project) -> Dict[str, Any]:
+    def load(p: Project):
+        env = _constants_of(p, "torchmetrics_tpu/diag/telemetry.py")
+        return {
+            "prefix": env.get("_PREFIX", "tm_tpu"),
+            "counter_help": dict(env.get("_COUNTER_HELP", {})),
+            "export_name": dict(env.get("_COUNTER_EXPORT_NAME", {})),
+            "export_scale": dict(env.get("_COUNTER_EXPORT_SCALE", {})),
+            "hist_series": dict(env.get("_HIST_SERIES", {})),
+            "unit_suffixes": tuple(env.get("UNIT_SUFFIXES", ())),
+            "unitless": frozenset(env.get("UNITLESS_COUNT_FAMILIES", ())),
+        }
+
+    return project.registry("telemetry_tables", load)
+
+
+def docs_text(project: Project, rel: str) -> Optional[str]:
+    key = f"docs::{rel}"
+
+    def load(p: Project):
+        path = p.root / rel
+        return path.read_text() if path.is_file() else None
+
+    return project.registry(key, load)

@@ -1,0 +1,186 @@
+"""TM4xx — counter lockstep: every ``EngineStats`` field is reset, exported,
+and exposition-conformant — checked from the source text.
+
+``tests/test_telemetry.py`` proves this at runtime for the counters a test
+run happens to touch; these rules prove it for EVERY field, before any run:
+
+- **TM401 counter-not-exported** — a ``_COUNTER_FIELDS`` entry with no
+  ``_COUNTER_HELP`` row in ``diag/telemetry.py`` (it would silently vanish
+  from ``export_prometheus``).
+- **TM402 counter-table-orphan** — a ``_COUNTER_HELP`` /
+  ``_COUNTER_EXPORT_NAME`` / ``_COUNTER_EXPORT_SCALE`` key that is not a
+  ``_COUNTER_FIELDS`` entry (a stale export row for a removed counter).
+- **TM403 series-unit-violation** — an exported family name (counter,
+  histogram, or explicitly emitted literal) that neither carries a unit
+  suffix (``UNIT_SUFFIXES``) nor sits in the pure-count allowlist
+  (``UNITLESS_COUNT_FAMILIES``).
+- **TM404 counter-reset-drift** — ``EngineStats.__init__`` / ``reset`` no
+  longer iterate ``_COUNTER_FIELDS`` (a hand-maintained field list is exactly
+  the lockstep this registry exists to prevent).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Set
+
+from tools.tmlint.core import Finding, Project
+from tools.tmlint.registries import counter_fields, module_constants, telemetry_tables
+
+_STATS_REL = "torchmetrics_tpu/engine/stats.py"
+_TELEMETRY_REL = "torchmetrics_tpu/diag/telemetry.py"
+_FAMILY_STRIP = ("_bucket", "_sum", "_count")
+
+
+def _base_family(name: str) -> str:
+    for suffix in _FAMILY_STRIP:
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+            break
+    if name.endswith("_total"):
+        name = name[: -len("_total")]
+    return name
+
+
+def _unit_ok(family: str, tables: Dict[str, Any]) -> bool:
+    base = _base_family(family)
+    return base.endswith(tuple(tables["unit_suffixes"])) or base in tables["unitless"]
+
+
+def _literal_families(project: Project) -> Dict[str, int]:
+    """Family names emitted as literals/f-strings in export_prometheus."""
+    path = project.package_file(_TELEMETRY_REL)
+    if path is None:
+        return {}
+    consts = module_constants(path)
+    tree = ast.parse(path.read_text())
+    out: Dict[str, int] = {}
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for node in ast.walk(tree):
+        value: Optional[str] = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            value = node.value
+        elif isinstance(node, ast.JoinedStr):
+            parts = []
+            ok = True
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                elif isinstance(v, ast.FormattedValue) and isinstance(v.value, ast.Name):
+                    ref = consts.get(v.value.id)
+                    if isinstance(ref, str):
+                        parts.append(ref)
+                    else:
+                        ok = False
+                        break
+                else:
+                    ok = False
+                    break
+            if ok:
+                value = "".join(parts)
+        if value and value.startswith(consts.get("_PREFIX", "tm_tpu") + "_") and name_re.match(value):
+            out.setdefault(value, node.lineno)
+    return out
+
+
+def check_project(project: Project) -> List[Finding]:
+    fields = counter_fields(project)
+    tables = telemetry_tables(project)
+    if not fields or not tables["counter_help"]:
+        return []
+    findings: List[Finding] = []
+    field_set: Set[str] = set(fields)
+    help_set = set(tables["counter_help"])
+    prefix = tables["prefix"]
+
+    for f in sorted(field_set - help_set):
+        findings.append(
+            Finding(
+                "TM401", _STATS_REL, 1,
+                f"EngineStats counter {f!r} has no _COUNTER_HELP row in"
+                " diag/telemetry.py — it will not export to Prometheus",
+            )
+        )
+    for table_name in ("counter_help", "export_name", "export_scale"):
+        for f in sorted(set(tables[table_name]) - field_set):
+            findings.append(
+                Finding(
+                    "TM402", _TELEMETRY_REL, 1,
+                    f"telemetry table {table_name} entry {f!r} is not an"
+                    " EngineStats _COUNTER_FIELDS member (stale export row)",
+                )
+            )
+
+    # unit conformance: counters (after export-name/scale mapping) ...
+    for f in sorted(field_set & help_set):
+        scaled = tables["export_scale"].get(f)
+        name = scaled[0] if scaled else tables["export_name"].get(f, f)
+        family = f"{prefix}_{name}_total"
+        if not _unit_ok(family, tables):
+            findings.append(
+                Finding(
+                    "TM403", _TELEMETRY_REL, 1,
+                    f"counter family {family!r} lacks a unit suffix"
+                    f" ({tables['unit_suffixes']}) and is not allowlisted in"
+                    " UNITLESS_COUNT_FAMILIES",
+                )
+            )
+    # ... histogram families ...
+    for series, spec in sorted(tables["hist_series"].items()):
+        family = f"{prefix}_{spec[0]}"
+        if not _unit_ok(family, tables):
+            findings.append(
+                Finding(
+                    "TM403", _TELEMETRY_REL, 1,
+                    f"histogram family {family!r} (series {series!r}) lacks a unit"
+                    " suffix and is not allowlisted in UNITLESS_COUNT_FAMILIES",
+                )
+            )
+    # ... and explicitly emitted literal families (serve/ledger/event rows)
+    for family, lineno in sorted(_literal_families(project).items()):
+        if not _unit_ok(family, tables):
+            findings.append(
+                Finding(
+                    "TM403", _TELEMETRY_REL, lineno,
+                    f"emitted family {family!r} lacks a unit suffix and is not"
+                    " allowlisted in UNITLESS_COUNT_FAMILIES",
+                )
+            )
+
+    findings.extend(_check_reset_lockstep(project))
+    return findings
+
+
+def _check_reset_lockstep(project: Project) -> List[Finding]:
+    path = project.package_file(_STATS_REL)
+    if path is None:
+        return []
+    tree = ast.parse(path.read_text())
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "EngineStats":
+            for required in ("__init__", "reset"):
+                fn = next(
+                    (n for n in node.body if isinstance(n, ast.FunctionDef) and n.name == required),
+                    None,
+                )
+                if fn is None or not _iterates_fields(fn):
+                    findings.append(
+                        Finding(
+                            "TM404", _STATS_REL, (fn or node).lineno,
+                            f"EngineStats.{required} must iterate _COUNTER_FIELDS"
+                            " (setattr loop) so new counters reset/initialize in"
+                            " lockstep with the registry",
+                        )
+                    )
+    return findings
+
+
+def _iterates_fields(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For) and isinstance(node.iter, ast.Name) and node.iter.id == "_COUNTER_FIELDS":
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) and isinstance(inner.func, ast.Name) and inner.func.id == "setattr":
+                    return True
+    return False

@@ -1,0 +1,196 @@
+"""TM5xx — event taxonomy: every recorded kind is declared and documented.
+
+The flight-recorder ``kind`` strings are the join key for every downstream
+consumer — per-kind counts, the chrome-trace renderer, the
+``tm_tpu_events_total`` labels, the counter gates' event assertions. A typo'd
+kind silently forks the taxonomy. Rules:
+
+- **TM501 unknown-event-kind** — a literal kind at a ``record(...)`` site
+  (including ``A if cond else B`` literal pairs) that is not declared in
+  ``diag/trace.py``'s ``EVENT_KINDS``.
+- **TM502 dynamic-event-kind** — a non-literal kind expression at a record
+  site, outside functions annotated ``# tmlint: event-forwarder`` (the
+  declared pass-through helpers).
+- **TM503 event-kind-undocumented** — an ``EVENT_KINDS`` member missing from
+  the taxonomy table in ``docs/pages/observability.md``.
+- **TM504 event-kind-orphan** — an ``EVENT_KINDS`` member no call site in the
+  analyzed tree records (dead taxonomy: the declaration outlived the code).
+
+Record sites are recognized by receiver: an alias of ``diag.trace``
+(``_diag.record`` / ``trace.record``), a bare ``record`` imported from it, or
+a local bound from ``active_recorder()`` / ``diag_context(...) as rec``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence, Set
+
+from tools.tmlint.core import Finding, Project, SourceFile
+from tools.tmlint.registries import docs_text, event_kinds
+
+_TRACE_REL = "torchmetrics_tpu/diag/trace.py"
+_DOCS_REL = "docs/pages/observability.md"
+
+
+def _trace_aliases(sf: SourceFile) -> Set[str]:
+    """Names in this module that refer to the diag.trace module or its record."""
+    aliases: Set[str] = set()
+    bare_record = False
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if a.name == "trace" and mod.endswith("diag"):
+                    aliases.add(a.asname or a.name)
+                if a.name == "record" and mod.endswith("trace"):
+                    bare_record = True
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("diag.trace"):
+                    aliases.add((a.asname or a.name).split(".")[0])
+    if bare_record:
+        aliases.add("<bare>")
+    return aliases
+
+
+def _recorder_locals(sf: SourceFile) -> Set[str]:
+    """Names bound from active_recorder() / diag_context(...) as X."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (fn.id if isinstance(fn, ast.Name) else None)
+            if name == "active_recorder":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    fn = expr.func
+                    name = fn.attr if isinstance(fn, ast.Attribute) else (fn.id if isinstance(fn, ast.Name) else None)
+                    if name == "diag_context" and isinstance(item.optional_vars, ast.Name):
+                        out.add(item.optional_vars.id)
+    return out
+
+
+def _kind_literals(expr: ast.expr) -> Optional[Sequence[str]]:
+    """The literal kind(s) this expression can evaluate to, or None."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return (expr.value,)
+    if isinstance(expr, ast.IfExp):
+        a = _kind_literals(expr.body)
+        b = _kind_literals(expr.orelse)
+        if a is not None and b is not None:
+            return tuple(a) + tuple(b)
+    return None
+
+
+def check_file(project: Project, sf: SourceFile) -> List[Finding]:
+    rel = sf.relpath
+    if rel == _TRACE_REL:  # the definitional module (record() itself)
+        return []
+    in_package = rel.startswith("torchmetrics_tpu/")
+    if not in_package and "events" not in sf.scopes:
+        return []
+    kinds = event_kinds(project)
+    if not kinds:
+        return []
+    aliases = _trace_aliases(sf)
+    rec_names = _recorder_locals(sf)
+    findings: List[Finding] = []
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        is_site = False
+        if isinstance(fn, ast.Attribute) and fn.attr == "record" and isinstance(fn.value, ast.Name):
+            is_site = fn.value.id in aliases or fn.value.id in rec_names
+        elif isinstance(fn, ast.Name) and fn.id == "record" and "<bare>" in aliases:
+            is_site = True
+        if not is_site:
+            continue
+        info = sf.enclosing_function(node)
+        literals = _kind_literals(node.args[0])
+        if literals is None:
+            if (info is not None and info.event_forwarder) or sf.suppressed("TM502", node.lineno):
+                continue
+            findings.append(
+                Finding(
+                    "TM502", rel, node.lineno,
+                    "non-literal event kind at a record() site — record literal kinds"
+                    " from EVENT_KINDS, or annotate the declared pass-through helper"
+                    " with # tmlint: event-forwarder",
+                )
+            )
+            continue
+        for kind in literals:
+            if in_package:
+                project.recorded_kinds.add(kind)
+            if kind not in kinds and not sf.suppressed("TM501", node.lineno):
+                findings.append(
+                    Finding(
+                        "TM501", rel, node.lineno,
+                        f"event kind {kind!r} is not declared in diag/trace.py"
+                        " EVENT_KINDS — declare it there and document it in"
+                        f" {_DOCS_REL}",
+                    )
+                )
+    return findings
+
+
+def _documented_kinds(text: str) -> Set[str]:
+    """Exact kind tokens the docs mention, with the table's
+    ``a.trace/retrace`` shorthand rows expanded.
+
+    Exact-token matching on purpose: a raw substring test would count
+    ``update.scan`` as documented merely because ``update.scan.trace`` is —
+    deleting a kind's own row must fail the lockstep.
+    """
+    out: Set[str] = set()
+    # dotted tokens (optionally slash-expanded) anywhere in the text
+    for m in re.finditer(r"[a-z_]+(?:\.[a-z_]+)+(?:/[a-z_.]+)*", text):
+        token = m.group(0)
+        parts = token.split("/")
+        out.add(parts[0])
+        prefix = parts[0].rsplit(".", 1)[0]
+        for alt in parts[1:]:
+            out.add(alt if "." in alt and alt.split(".")[0] == prefix.split(".")[0] else f"{prefix}.{alt}")
+            out.add(f"{prefix}.{alt}")
+    # single-word kinds (`collective`, `fallback`) appear as backticked tokens
+    for m in re.finditer(r"`([a-z_]+)`", text):
+        out.add(m.group(1))
+    return out
+
+
+def check_project(project: Project) -> List[Finding]:
+    kinds = event_kinds(project)
+    if not kinds:
+        return []
+    findings: List[Finding] = []
+    text = docs_text(project, _DOCS_REL)
+    if text is not None:
+        documented = _documented_kinds(text)
+        for kind in sorted(kinds):
+            if kind not in documented:
+                findings.append(
+                    Finding(
+                        "TM503", _TRACE_REL, 1,
+                        f"event kind {kind!r} is declared but undocumented — add it to"
+                        f" the taxonomy table in {_DOCS_REL}",
+                    )
+                )
+    if project.full_package and project.recorded_kinds:
+        for kind in sorted(kinds - project.recorded_kinds):
+            findings.append(
+                Finding(
+                    "TM504", _TRACE_REL, 1,
+                    f"event kind {kind!r} is declared in EVENT_KINDS but no analyzed"
+                    " call site records it — drop the dead taxonomy entry",
+                )
+            )
+    return findings

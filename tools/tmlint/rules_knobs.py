@@ -1,0 +1,144 @@
+"""TM2xx — the env-knob contract: every ``TORCHMETRICS_TPU_*`` read routes
+through its ONE registered fail-loud parser and stays in lockstep with the
+knob documentation.
+
+- **TM201 raw-env-read** — an ``os.environ.get`` / ``os.getenv`` /
+  ``os.environ[...]`` read whose key resolves to a ``TORCHMETRICS_TPU_*``
+  name, either (a) not registered in ``engine/config.py``'s
+  ``KNOB_REGISTRY`` at all, or (b) read outside the registered parser
+  function. The PR-7 env contract (unrecognized values fail loud) is only
+  enforceable while every read goes through the one parser that implements it.
+- **TM202 dynamic-env-read** — an environ read whose key is not statically
+  resolvable, outside the registered generic parsers
+  (``GENERIC_KNOB_PARSERS`` — the shared ``name``-parameter validators).
+- **TM203 knob-undocumented** — a registered knob that never appears in
+  ``docs/api/root.md`` (implemented but undocumented).
+- **TM204 knob-unimplemented** — a ``TORCHMETRICS_TPU_*`` token in
+  ``docs/api/root.md`` with no registry entry (documented but gone — or
+  implemented without registration).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from tools.tmlint.core import Finding, Project, SourceFile
+from tools.tmlint.registries import docs_text, knob_registry, module_constants
+
+_KNOB_RE = re.compile(r"TORCHMETRICS_TPU_[A-Z0-9_]+")
+_DOCS_REL = "docs/api/root.md"
+
+
+_ENV_CALLS = ("os.environ.get", "os.getenv", "environ.get", "getenv")
+_ENV_MAPPINGS = ("os.environ", "environ")
+
+
+def _env_read_key(node: ast.AST) -> Optional[Tuple[ast.AST, ast.expr]]:
+    """(site, key-expression) when ``node`` reads the process environment.
+
+    Matches the aliased spellings too (``from os import environ, getenv``) —
+    a knob read must not escape the contract by import style.
+    """
+    if isinstance(node, ast.Call):
+        target = ast.unparse(node.func)
+        if target in _ENV_CALLS and node.args:
+            return node, node.args[0]
+    if isinstance(node, ast.Subscript):
+        if isinstance(node.value, (ast.Attribute, ast.Name)) and ast.unparse(node.value) in _ENV_MAPPINGS:
+            return node, node.slice
+    return None
+
+
+def _resolve_key(expr: ast.expr, consts: Dict[str, Any]) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        val = consts.get(expr.id)
+        return val if isinstance(val, str) else None
+    return None
+
+
+def check_file(project: Project, sf: SourceFile) -> List[Finding]:
+    rel = sf.relpath
+    in_package = rel.startswith("torchmetrics_tpu/")
+    if not in_package and "knobs" not in sf.scopes:
+        return []
+    registry, generic = knob_registry(project)
+    consts = module_constants(sf.path)
+    module = project.module_name(sf.path) if in_package else sf.path.stem
+    findings: List[Finding] = []
+
+    for node in ast.walk(sf.tree):
+        hit = _env_read_key(node)
+        if hit is None:
+            continue
+        site, key_expr = hit
+        info = sf.enclosing_function(site)
+        qual = f"{module}:{info.qualname}" if info is not None else f"{module}:<module>"
+        key = _resolve_key(key_expr, consts)
+        if key is None:
+            if qual in generic or sf.suppressed("TM202", site.lineno):
+                continue
+            findings.append(
+                Finding(
+                    "TM202", rel, site.lineno,
+                    f"dynamic environment read in {qual} — only the registered generic"
+                    f" parsers {list(generic)} may read a non-literal key",
+                )
+            )
+            continue
+        if not _KNOB_RE.fullmatch(key):
+            continue  # not a package knob (LOCAL_RANK, debug vars, ...)
+        if sf.suppressed("TM201", site.lineno):
+            continue
+        parser = registry.get(key)
+        if parser is None:
+            findings.append(
+                Finding(
+                    "TM201", rel, site.lineno,
+                    f"env knob {key} is read here but not registered in"
+                    " engine/config.py KNOB_REGISTRY — register its fail-loud parser"
+                    " and document it in docs/api/root.md",
+                )
+            )
+        elif qual != parser:
+            findings.append(
+                Finding(
+                    "TM201", rel, site.lineno,
+                    f"env knob {key} read outside its registered parser"
+                    f" ({qual} != {parser}) — route the read through the parser so"
+                    " the fail-loud contract stays single-sourced",
+                )
+            )
+    return findings
+
+
+def check_project(project: Project) -> List[Finding]:
+    registry, _ = knob_registry(project)
+    if not registry:
+        return []
+    text = docs_text(project, _DOCS_REL)
+    if text is None:
+        return []
+    documented = set(_KNOB_RE.findall(text))
+    config_rel = "torchmetrics_tpu/engine/config.py"
+    findings: List[Finding] = []
+    for knob in sorted(set(registry) - documented):
+        findings.append(
+            Finding(
+                "TM203", config_rel, 1,
+                f"env knob {knob} is registered (parser {registry[knob]}) but"
+                f" undocumented — add it to {_DOCS_REL}",
+            )
+        )
+    for knob in sorted(documented - set(registry)):
+        findings.append(
+            Finding(
+                "TM204", _DOCS_REL, 1,
+                f"env knob {knob} is documented but has no KNOB_REGISTRY entry —"
+                " register its parser in engine/config.py or drop the stale doc",
+            )
+        )
+    return findings

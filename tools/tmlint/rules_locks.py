@@ -1,0 +1,155 @@
+"""TM6xx — lock discipline for the cross-thread tiers (scan/async/serve).
+
+The PR-13 review passes fixed, by hand, a class of race where shared
+swap/FIFO/writeback state was touched off-lock. This rule family makes the
+locking contract *declared and checked*:
+
+- an attribute's declaring assignment carries ``# guarded-by: <lock>``;
+- **TM601 unguarded-access** — any other read/write of that attribute that is
+  not (a) lexically inside a ``with <lock>``/``with self.<lock>`` block,
+  (b) inside a function annotated ``# tmlint: holds(<lock>)`` (the
+  ``*_locked`` convention: every caller holds the lock), or (c) inside a
+  function annotated ``# tmlint: single-owner(<role>)`` (provably one
+  thread). Benign racy peeks must be explicit: ``# tmlint: disable=TM601``
+  with a justification.
+- **TM602 undeclared-lock** — a ``threading.Lock/RLock/Condition`` created in
+  a cross-thread module with no ``guarded-by`` declaration naming it: a lock
+  that protects nothing *declared* protects nothing *checked*.
+- **TM603 unknown-lock** — a ``guarded-by``/``holds`` annotation naming a
+  lock that is never created in the file (typo catcher).
+
+Scope: ``engine/scan.py``, ``engine/async_dispatch.py``, ``serve/*`` (the
+modules where a worker/scrape thread runs against the hot loop), plus any
+file carrying ``# tmlint: scope=locks`` (test fixtures).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tmlint.core import Finding, Project, SourceFile
+
+_SCOPE_SUFFIXES = ("engine/scan.py", "engine/async_dispatch.py")
+_SCOPE_DIRS = ("/serve/",)
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    if "locks" in sf.scopes:
+        return True
+    rel = "/" + sf.relpath
+    return rel.endswith(_SCOPE_SUFFIXES) or any(d in rel for d in _SCOPE_DIRS)
+
+
+def _lock_assignments(sf: SourceFile) -> Dict[str, int]:
+    """Lock-object names created in this file -> first creation line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        fn = node.value.func
+        ctor = fn.attr if isinstance(fn, ast.Attribute) else (fn.id if isinstance(fn, ast.Name) else None)
+        if ctor not in _LOCK_CTORS:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                out.setdefault(tgt.attr, node.lineno)
+            elif isinstance(tgt, ast.Name):
+                out.setdefault(tgt.id, node.lineno)
+    return out
+
+
+def check_file(project: Project, sf: SourceFile) -> List[Finding]:
+    if not _in_scope(sf):
+        return []
+    findings: List[Finding] = []
+    locks = _lock_assignments(sf)
+    guarded_locks = set(sf.guarded_attrs.values()) | set(sf.guarded_globals.values())
+    spans = sf.with_lock_spans()
+
+    # TM602: every created lock must guard something declared
+    for name, lineno in sorted(locks.items()):
+        if name not in guarded_locks and not sf.suppressed("TM602", lineno):
+            findings.append(
+                Finding(
+                    "TM602", sf.relpath, lineno,
+                    f"lock {name!r} is created here but no attribute declares"
+                    " '# guarded-by: {0}' — declare the state it protects so the"
+                    " discipline is checkable".format(name),
+                )
+            )
+    # TM603: every referenced lock must exist
+    for attr, lock in sorted({**sf.guarded_attrs, **sf.guarded_globals}.items()):
+        if lock not in locks and not sf.suppressed("TM603", 1):
+            findings.append(
+                Finding(
+                    "TM603", sf.relpath, 1,
+                    f"attribute {attr!r} declares guarded-by: {lock} but no such lock"
+                    " is created in this file",
+                )
+            )
+    for info in sf.functions.values():
+        for lock in sorted(info.holds):
+            if lock not in locks:
+                findings.append(
+                    Finding(
+                        "TM603", sf.relpath, info.node.lineno,
+                        f"holds({lock}) names a lock never created in this file",
+                    )
+                )
+
+    def inside(lock: str, lineno: int) -> bool:
+        return any(name == lock and a <= lineno <= b for name, a, b in spans)
+
+    # TM601: instance-attribute + module-global accesses. single-owner
+    # exemptions are collected per attribute so that the SAME attribute
+    # exempted under two DIFFERENT roles (caller vs worker = two threads)
+    # still fails — that is precisely the cross-thread race class.
+    owner_roles: Dict[str, Dict[str, int]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) and node.value.id == "self":
+            lock = sf.guarded_attrs.get(node.attr)
+            if lock is not None and sf.enclosing_class(node) is not None:
+                findings.extend(_check_access(sf, node, node.attr, lock, inside, owner_roles))
+        elif isinstance(node, ast.Name) and node.id in sf.guarded_globals:
+            findings.extend(
+                _check_access(sf, node, node.id, sf.guarded_globals[node.id], inside, owner_roles)
+            )
+    for attr, roles in sorted(owner_roles.items()):
+        if len(roles) > 1:
+            findings.append(
+                Finding(
+                    "TM601", sf.relpath, min(roles.values()),
+                    f"attribute {attr!r} is accessed off-lock in single-owner functions"
+                    f" of DIFFERENT roles ({', '.join(sorted(roles))}) — two owners are"
+                    " two threads; take the lock in one of them",
+                )
+            )
+    return findings
+
+
+def _check_access(
+    sf: SourceFile, node: ast.AST, attr: str, lock: str, inside, owner_roles: Dict[str, Dict[str, int]]
+) -> List[Finding]:
+    lineno = node.lineno
+    if lineno in sf.guard_decl_lines:
+        return []
+    if inside(lock, lineno):
+        return []
+    info = sf.enclosing_function(node)
+    if info is not None and lock in info.holds:
+        return []
+    if info is not None and info.single_owner is not None:
+        owner_roles.setdefault(attr, {}).setdefault(info.single_owner, lineno)
+        return []
+    if sf.suppressed("TM601", lineno):
+        return []
+    return [
+        Finding(
+            "TM601", sf.relpath, lineno,
+            f"access to {attr!r} (guarded-by: {lock}) outside a 'with {lock}' block —"
+            f" take the lock, annotate the function (# tmlint: holds({lock}) /"
+            " single-owner(<role>)), or justify a benign peek with a disable comment",
+        )
+    ]

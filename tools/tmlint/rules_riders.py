@@ -1,0 +1,56 @@
+"""TM301 — rider-key lockstep: the reserved pytree keys have ONE spelling site.
+
+``__sentinel__`` / ``__quarantine__`` / ``__compensation__`` are structural:
+the bucketing pad-subtract, the transactional rollback, the packed-sync
+layout, and the scan carry all special-case them. A re-spelled literal in a
+new consumer silently drifts out of that contract the day the canonical set
+changes. Rule: the literals may appear only in ``engine/statespec.py`` (the
+canonical ``RIDER_KEYS`` declaration) — everywhere else import
+``RIDER_KEYS`` / ``PAD_EXEMPT_KEYS`` / the ``*_KEY`` aliases. Docstrings are
+exempt (prose, not pytree keys).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.tmlint.core import Finding, Project, SourceFile
+from tools.tmlint.registries import rider_keys
+
+_CANONICAL_SUFFIX = "engine/statespec.py"
+
+
+def _docstring_nodes(tree: ast.AST) -> Set[ast.AST]:
+    """The Constant nodes that are module/class/function docstrings."""
+    out: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and isinstance(body[0].value, ast.Constant):
+                out.add(body[0].value)
+    return out
+
+
+def check_file(project: Project, sf: SourceFile) -> List[Finding]:
+    if ("/" + sf.relpath).endswith("/" + _CANONICAL_SUFFIX):
+        return []
+    keys = rider_keys(project)
+    docstrings = _docstring_nodes(sf.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Constant) or not isinstance(node.value, str):
+            continue
+        if node.value not in keys or node in docstrings:
+            continue
+        if sf.suppressed("TM301", node.lineno):
+            continue
+        findings.append(
+            Finding(
+                "TM301", sf.relpath, node.lineno,
+                f"reserved rider key {node.value!r} spelled as a literal outside"
+                " engine/statespec.py — import RIDER_KEYS/PAD_EXEMPT_KEYS (or the"
+                " *_KEY aliases) instead",
+            )
+        )
+    return findings

@@ -1,0 +1,216 @@
+"""TM1xx — transfer purity: host readbacks only at registered boundaries.
+
+The north-star invariant ("zero host transfers in the hot loop",
+``BASELINE.json``) is a *static* property of the source: a readback call that
+is not lexically inside a sanctioned ``transfer_allowed(...)`` scope will
+eventually execute outside one. Rules:
+
+- **TM101 unsanctioned-host-readback** — a call to ``np.asarray`` /
+  ``np.array`` / ``jax.device_get`` / ``.item()`` / ``.tolist()`` inside the
+  hot-loop packages (``engine/``, ``parallel/``, ``serve/``) that is not
+  enclosed in a ``with transfer_allowed(...)`` block, not inside a function
+  annotated ``# tmlint: boundary(<label>)`` (asserting it only runs inside
+  that registered boundary) or ``# tmlint: host-only`` (asserting no device
+  buffer reaches it), and not suppressed.
+- **TM102 device-scalar-coercion** — ``float(x)`` / ``int(x)`` where ``x`` is
+  a ``jnp.*`` call result (directly or through a same-function local): the
+  implicit ``__float__``/``__int__`` is a device→host readback.
+- **TM103 unregistered-transfer-label** — a ``transfer_allowed("<label>")``
+  call or ``boundary(<label>)`` annotation whose label is not declared in
+  ``diag/transfer_guard.py``'s ``TRANSFER_LABELS`` (or covered by a
+  registered prefix): sanctioned boundaries are a closed, reviewed set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tmlint.core import Finding, Project, SourceFile
+from tools.tmlint.registries import transfer_labels
+
+_READBACK_METHODS = {"item", "tolist"}
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_SCOPE_DIRS = ("/engine/", "/parallel/", "/serve/")
+#: the guard machinery itself and its direct test double are out of scope
+_EXEMPT_SUFFIXES = ("diag/transfer_guard.py",)
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    if "transfer" in sf.scopes:
+        return True
+    rel = "/" + sf.relpath
+    if rel.endswith(_EXEMPT_SUFFIXES):
+        return False
+    return any(d in rel for d in _SCOPE_DIRS)
+
+
+def _is_transfer_allowed_call(node: ast.Call) -> bool:
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (fn.id if isinstance(fn, ast.Name) else None)
+    return name == "transfer_allowed"
+
+
+def _label_of(node: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(literal label, literal prefix) — prefix for ``"collective:" + x``."""
+    if not node.args:
+        return "", None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, None
+    if (
+        isinstance(arg, ast.BinOp)
+        and isinstance(arg.op, ast.Add)
+        and isinstance(arg.left, ast.Constant)
+        and isinstance(arg.left.value, str)
+    ):
+        return None, arg.left.value
+    return None, None
+
+
+def _sanction_spans(sf: SourceFile) -> List[Tuple[int, int, ast.Call]]:
+    spans = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call) and _is_transfer_allowed_call(expr):
+                    spans.append((node.lineno, node.end_lineno or node.lineno, expr))
+    return spans
+
+
+def _readback_name(node: ast.Call) -> Optional[str]:
+    """The flaggable readback this call is, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        base_name = base.id if isinstance(base, ast.Name) else None
+        if fn.attr in ("asarray", "array") and base_name in _NUMPY_NAMES:
+            return f"{base_name}.{fn.attr}"
+        if fn.attr == "device_get" and base_name == "jax":
+            return "jax.device_get"
+        if fn.attr in _READBACK_METHODS and not node.args and not node.keywords:
+            return f".{fn.attr}()"
+    return None
+
+
+def _jnp_locals(fn_node: ast.AST) -> Set[str]:
+    """Names assigned from a ``jnp.*`` call anywhere in this function."""
+    out: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and _is_jnp_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _is_jnp_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    while isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name) and fn.value.id == "jnp":
+            return True
+        fn = fn.value
+    return False
+
+
+def check_file(project: Project, sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    labels, prefixes = transfer_labels(project)
+
+    def label_ok(label: Optional[str], prefix: Optional[str]) -> bool:
+        if label is not None:
+            return label in labels or any(label.startswith(p) for p in prefixes)
+        if prefix is not None:
+            return any(prefix.startswith(p) or p.startswith(prefix) for p in prefixes)
+        return False
+
+    # TM103 on every transfer_allowed site + boundary annotation (any file
+    # inside the analyzed tree that uses the guard machinery)
+    spans = _sanction_spans(sf)
+    if not ("/" + sf.relpath).endswith(_EXEMPT_SUFFIXES):
+        for _, _, call in spans:
+            label, prefix = _label_of(call)
+            if label == "":
+                # a bare transfer_allowed() would sanction readbacks while
+                # naming no reviewed boundary — exactly the drive-by the
+                # registry exists to prevent
+                if not sf.suppressed("TM103", call.lineno):
+                    findings.append(
+                        Finding(
+                            "TM103", sf.relpath, call.lineno,
+                            "transfer_allowed() without a label sanctions readbacks"
+                            " anonymously — pass a label registered in"
+                            " diag/transfer_guard.py TRANSFER_LABELS",
+                        )
+                    )
+                continue
+            if not label_ok(label, prefix) and not sf.suppressed("TM103", call.lineno):
+                findings.append(
+                    Finding(
+                        "TM103", sf.relpath, call.lineno,
+                        f"transfer_allowed label {label or prefix!r} is not registered in"
+                        " diag/transfer_guard.py TRANSFER_LABELS",
+                    )
+                )
+        for info in sf.functions.values():
+            if info.boundary is not None and info.boundary not in labels:
+                if not sf.suppressed("TM103", info.node.lineno):
+                    findings.append(
+                        Finding(
+                            "TM103", sf.relpath, info.node.lineno,
+                            f"boundary({info.boundary}) names a label not registered in"
+                            " diag/transfer_guard.py TRANSFER_LABELS",
+                        )
+                    )
+
+    if not _in_scope(sf):
+        return findings
+
+    def sanctioned(lineno: int) -> bool:
+        return any(a <= lineno <= b for a, b, _ in spans)
+
+    jnp_cache: Dict[ast.AST, Set[str]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        info = sf.enclosing_function(node)
+        exempt = info is not None and (info.boundary is not None or info.host_only)
+
+        name = _readback_name(node)
+        if name is not None:
+            if sanctioned(node.lineno) or exempt or sf.suppressed("TM101", node.lineno):
+                continue
+            findings.append(
+                Finding(
+                    "TM101", sf.relpath, node.lineno,
+                    f"host readback {name} outside any sanctioned transfer_allowed(...)"
+                    " scope — wrap it in a registered boundary, annotate the enclosing"
+                    " function (# tmlint: boundary(<label>) / host-only), or move the"
+                    " read to the epoch boundary",
+                )
+            )
+            continue
+
+        # TM102: float()/int() over a jnp-derived value
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in ("float", "int") and len(node.args) == 1:
+            arg = node.args[0]
+            derived = _is_jnp_call(arg)
+            if not derived and isinstance(arg, ast.Name):
+                owner = sf.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                if owner is not None:
+                    if owner not in jnp_cache:
+                        jnp_cache[owner] = _jnp_locals(owner)
+                    derived = arg.id in jnp_cache[owner]
+            if derived and not sanctioned(node.lineno) and not exempt and not sf.suppressed("TM102", node.lineno):
+                findings.append(
+                    Finding(
+                        "TM102", sf.relpath, node.lineno,
+                        f"{fn.id}() over a jnp-derived value is an implicit device→host"
+                        " readback — sanction it or keep the value on device",
+                    )
+                )
+    return findings

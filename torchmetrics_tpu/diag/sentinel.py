@@ -82,8 +82,10 @@ __all__ = [
 SENTINEL_ENV_VAR = "TORCHMETRICS_TPU_SENTINEL"
 AUDIT_ENV_VAR = "TORCHMETRICS_TPU_AUDIT"
 
-#: reserved pytree key for the sentinel scalar inside compiled step states
-STATE_KEY = "__sentinel__"
+#: reserved pytree key for the sentinel scalar inside compiled step states —
+#: aliased from the canonical declaration (engine/statespec.py RIDER_KEYS);
+#: tmlint rule TM301 forbids respelling the literal outside that module
+from torchmetrics_tpu.engine.statespec import SENTINEL_KEY as STATE_KEY  # noqa: E402
 #: the attribute carrying the live bitmask on a metric instance
 ATTR = "_sentinel_flags"
 

@@ -29,9 +29,69 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.diag.trace import FlightRecorder, active_recorder
 
-__all__ = ["export_jsonl", "export_prometheus", "telemetry_snapshot"]
+__all__ = [
+    "UNIT_SUFFIXES",
+    "UNITLESS_COUNT_FAMILIES",
+    "export_jsonl",
+    "export_prometheus",
+    "telemetry_snapshot",
+]
 
 _PREFIX = "tm_tpu"
+
+#: the exposition naming convention (https://prometheus.io/docs/practices/naming/):
+#: a series measuring a physical quantity must spell its base unit as the name
+#: suffix. This is the CANONICAL declaration — the test-suite exposition parser
+#: and the static analyzer (``tools/tmlint`` rule TM403) both read it.
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_flops", "_ratio")
+
+#: families whose value is a pure EVENT/OBJECT COUNT or an enum bitmask — the
+#: exposition conventions require no unit suffix for those
+#: (`http_requests_total` style). Any series measuring a physical quantity
+#: (time, size, rate) must NOT be added here; give it a
+#: `_seconds`/`_bytes`/`_flops` spelling instead. Keyed WITHOUT the `_total`
+#: suffix. New counter fields must either carry a unit suffix or be
+#: allowlisted here — tmlint gates the lockstep statically, the telemetry
+#: round-trip test at scrape time.
+UNITLESS_COUNT_FAMILIES = frozenset({
+    "tm_tpu_traces", "tm_tpu_cache_hits", "tm_tpu_dispatches", "tm_tpu_metrics_updated",
+    "tm_tpu_eager_fallbacks", "tm_tpu_donated_dispatches", "tm_tpu_donation_copies",
+    "tm_tpu_donation_fallbacks", "tm_tpu_bucketed_steps", "tm_tpu_bucket_pad_rows",
+    "tm_tpu_packed_syncs", "tm_tpu_sync_collectives", "tm_tpu_sync_metadata_gathers",
+    "tm_tpu_sync_fold_traces", "tm_tpu_sync_divergence_flags", "tm_tpu_sync_straggler_flags",
+    "tm_tpu_sync_retries", "tm_tpu_sync_degraded_folds",
+    "tm_tpu_quarantined_batches", "tm_tpu_ladder_retries",
+    # numerics layer (engine/numerics.py, PR 8): two-sum step / reanchor /
+    # drift-audit event counts — pure counts, no physical unit. These four
+    # existed as EngineStats fields without export rows until tmlint rule
+    # TM401 flagged the drift.
+    "tm_tpu_compensated_steps", "tm_tpu_reanchors", "tm_tpu_drift_probes",
+    "tm_tpu_drift_flags",
+    # multi-step scan dispatch (engine/scan.py, PR 10): drain/step/flush event
+    # counts — pure counts, no physical unit
+    "tm_tpu_scan_dispatches", "tm_tpu_scan_steps_folded", "tm_tpu_scan_pad_steps",
+    "tm_tpu_scan_flushes", "tm_tpu_scan_flush_reasons",
+    "tm_tpu_compute_traces", "tm_tpu_compute_dispatches", "tm_tpu_compute_cache_hits",
+    "tm_tpu_profile_probes", "tm_tpu_engines", "tm_tpu_retrace_causes",
+    "tm_tpu_fallback_reasons", "tm_tpu_events", "tm_tpu_events_dropped",
+    "tm_tpu_ledger_executables", "tm_tpu_sentinel_flags",
+    # serving layer (serve/, PR 9): scrape/snapshot event counts + live-object
+    # gauges; scrape latency itself is unit-suffixed (serve_scrape_latency_seconds)
+    "tm_tpu_serve_scrapes", "tm_tpu_serve_snapshots", "tm_tpu_serve_snapshot_retries",
+    "tm_tpu_serve_tenants", "tm_tpu_serve_spilled_updates",
+    # state-spec registry (engine/statespec.py, PR 11): deprecated-convention
+    # role resolutions — a pure migration count, no physical unit
+    "tm_tpu_spec_fallbacks",
+    # SPMD sharded-state engine (parallel/sharding.py, PR 12): placement /
+    # in-graph-sync event counts — pure counts, no physical unit
+    "tm_tpu_shard_states", "tm_tpu_psum_syncs", "tm_tpu_gather_skipped",
+    # async pipelined dispatch (engine/async_dispatch.py, PR 13): buffer /
+    # drain / join / replay event counts and the in-flight-depth histogram —
+    # pure counts; the time-valued async series export as *_seconds
+    "tm_tpu_async_submits", "tm_tpu_async_dispatches", "tm_tpu_async_joins",
+    "tm_tpu_async_backpressure_waits", "tm_tpu_async_replayed_steps",
+    "tm_tpu_async_prefetches", "tm_tpu_async_queue_depth",
+})
 
 # EngineStats fields exported as monotonic counters (everything countable);
 # HELP strings double as the field glossary for scrape-side dashboards.
@@ -61,6 +121,10 @@ _COUNTER_HELP = {
     "async_prefetches": "host arrays device_put-staged at enqueue ahead of their drain",
     "quarantined_batches": "poisoned batches skipped in-graph by the quarantine transaction",
     "ladder_retries": "dispatch failures that stepped down the fallback ladder to a smaller bucket",
+    "compensated_steps": "updates whose accumulate rode the in-graph two-sum",
+    "reanchors": "epoch-boundary (value, residual) folds into a clean anchor",
+    "drift_probes": "sampled drift-audit reads at the sanctioned boundary",
+    "drift_flags": "drift probes exceeding TORCHMETRICS_TPU_DRIFT_RTOL",
     "packed_syncs": "packed epoch syncs completed",
     "sync_collectives": "buffer collectives issued across packed syncs",
     "sync_metadata_gathers": "metadata exchanges issued",

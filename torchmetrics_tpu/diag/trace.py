@@ -85,6 +85,7 @@ from time import perf_counter
 from typing import Any, Dict, Generator, List, NamedTuple, Optional, Sequence
 
 __all__ = [
+    "EVENT_KINDS",
     "FlightRecorder",
     "TraceEvent",
     "active_recorder",
@@ -95,6 +96,46 @@ __all__ = [
 ]
 
 DEFAULT_CAPACITY = 2048
+
+#: The closed event taxonomy — every ``kind`` any call site may record. This
+#: is the single declaration the static analyzer (``tools/tmlint`` rule TM501)
+#: checks every ``record(...)`` literal against, and every member must be
+#: documented in ``docs/pages/observability.md`` (TM503). Adding an event kind
+#: means adding it HERE and to the docs table in the same change — an
+#: undeclared kind fails CI from the source text, before any run records it.
+EVENT_KINDS = frozenset({
+    # compiled update engine (engine/compiled.py)
+    "update.trace", "update.retrace", "update.dispatch", "update.probe", "update.eager",
+    "update.quarantine", "update.ladder",
+    # multi-step scan dispatch (engine/scan.py)
+    "update.scan", "update.scan.trace", "update.scan.retrace", "update.scan.probe",
+    "scan.flush",
+    # async pipelined dispatch (engine/scan.py + engine/async_dispatch.py)
+    "async.enqueue", "async.drain", "async.join", "async.sync.overlap",
+    # collection fusion (engine/fusion.py, collections.py)
+    "fused.trace", "fused.retrace", "fused.dispatch", "fused.probe", "fused.exclude",
+    "collection.step",
+    # epoch engine / packed sync (engine/epoch.py, parallel/packing.py)
+    "sync.exchange", "sync.fold_trace", "sync.fold_retrace", "sync.eager",
+    "sync.audit", "sync.straggler", "sync.retry", "sync.fault", "sync.degraded",
+    "sync.shard_skip", "collective",
+    # cached compute (engine/epoch.py)
+    "compute.trace", "compute.retrace", "compute.dispatch", "compute.probe",
+    # numerics layer (engine/numerics.py)
+    "numerics.drift", "numerics.reanchor",
+    # elastic checkpoints (parallel/elastic.py)
+    "snapshot.save", "snapshot.restore", "snapshot.fallback", "snapshot.flush",
+    "snapshot.preempt", "snapshot.restore_latest",
+    # SPMD sharded-state engine (parallel/sharding.py)
+    "shard.place", "shard.fallback", "shard.reshard",
+    # state-spec registry (engine/statespec.py)
+    "spec.fallback",
+    # serving layer (serve/)
+    "serve.scrape", "serve.scrape.async", "serve.scrape.error", "serve.sidecar.start",
+    "serve.snapshot", "serve.snapshot.read",
+    # engine-wide fallbacks + transfer guard (engine/stats.py, diag/transfer_guard.py)
+    "fallback", "transfer.host", "transfer.blocked",
+})
 
 #: env knob: "1" = on (default capacity), int > 1 = capacity, "0"/unset = off
 TRACE_ENV_VAR = "TORCHMETRICS_TPU_TRACE"

@@ -46,9 +46,51 @@ from typing import Any, Generator
 
 from torchmetrics_tpu.diag import trace
 
-__all__ = ["TransferGuardError", "native_reentry", "transfer_allowed", "transfer_guard"]
+__all__ = [
+    "TRANSFER_LABELS",
+    "TRANSFER_LABEL_PREFIXES",
+    "TransferGuardError",
+    "native_reentry",
+    "transfer_allowed",
+    "transfer_guard",
+]
 
 _MODES = ("strict", "log")
+
+#: The registry of SANCTIONED host-transfer boundary labels. Every
+#: ``transfer_allowed("<label>")`` call site in the package — and every
+#: ``# tmlint: boundary(<label>)`` function annotation asserting "this helper
+#: only runs inside that boundary" — must name a label declared here; the
+#: static analyzer (``tools/tmlint`` rule TM103) rejects unregistered labels,
+#: so a new host-readback boundary is a REVIEWED, named decision, not a
+#: drive-by ``transfer_allowed()``. The label glossary lives in
+#: ``docs/pages/static-analysis.md``.
+TRANSFER_LABELS = frozenset({
+    # packed-sync backbone (parallel/packing.py, engine/epoch.py)
+    "sync-metadata",   # the one metadata gather covering every dynamic state
+    "sync-audit",      # divergence-audit fingerprint reads on the metadata path
+    "sync-fault",      # classified-fault payload inspection (parallel/resilience.py)
+    # engine evidence boundaries (engine/, diag/)
+    "profile-probe",   # sampled block_until_ready completion probes (PR 5)
+    "drift-probe",     # sampled compensated-drift audit reads (PR 8)
+    "quarantine-check",  # =error admission precheck before any mutation (PR 7)
+    "quarantine-read",   # sanctioned epoch-end quarantine-counter flush (PR 7)
+    "sentinel-setup",  # one-time Inf-default detection at sentinel install (PR 4)
+    "sentinel-read",   # sanctioned sentinel bitmask read (PR 4)
+    "group-discovery",  # one-time compute-group value comparison (collections.py)
+    # checkpoint/restore boundaries (parallel/elastic.py)
+    "snapshot-save",   # state materialization into an atomic .npz shard
+    "snapshot-load",   # shard payload reads on the restore/reshard path
+    # fault injection (parallel/faults.py) — corrupts an already-gathered row
+    "fault-inject",
+    # serving boundaries (serve/)
+    "serve-setup",     # one-time np capture of nested-metric defaults (PR 9)
+    "serve-scrape",    # scrape-path host reads with the snapshot retry protocol
+})
+
+#: label PREFIXES sanctioned with a dynamic suffix: the collective backbone
+#: labels every buffer exchange ``collective:<role>:<dtype>`` at runtime
+TRANSFER_LABEL_PREFIXES = ("collective:",)
 
 
 class TransferGuardError(RuntimeError):

@@ -207,8 +207,8 @@ class _AsyncExecutor:
 
     def __init__(self) -> None:
         self._cv = threading.Condition()
-        self._items: Deque[Any] = deque()
-        self._thread: Optional[threading.Thread] = None
+        self._items: Deque[Any] = deque()  # guarded-by: _cv
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _cv
 
     def submit(self, work: Any) -> None:
         with self._cv:
@@ -257,7 +257,7 @@ def submit(work: Any) -> None:
 
 #: pending epoch-sync overlap stamps: (EngineStats, host-completion ts). The
 #: next join consumes them; bounded so an observation-free loop cannot grow it
-_SYNC_NOTES: List[Tuple[Any, float]] = []
+_SYNC_NOTES: List[Tuple[Any, float]] = []  # guarded-by: _SYNC_NOTES_LOCK
 _SYNC_NOTES_LOCK = threading.Lock()
 _SYNC_NOTES_CAP = 64
 

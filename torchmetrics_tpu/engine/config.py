@@ -30,6 +30,49 @@ _ACCELERATORS = ("tpu", "gpu", "cuda", "rocm", "axon")
 _enabled_override: Optional[bool] = None
 _donate_override: Optional[bool] = None
 
+#: The env-knob registry — every ``TORCHMETRICS_TPU_*`` variable the package
+#: reads, mapped to its ONE recognized fail-loud parser (``module:qualname``).
+#: The static analyzer (``tools/tmlint`` rule TM201) rejects any
+#: ``os.environ``/``os.getenv`` read of a registered key outside its parser,
+#: flags reads of UNregistered ``TORCHMETRICS_TPU_*`` keys, and cross-checks
+#: this table against the knob documentation in ``docs/api/root.md`` (TM203/
+#: TM204) — so "implemented but undocumented" and "documented but gone" both
+#: fail CI from the source text. Adding a knob means: write the fail-loud
+#: parser (the PR-7 env contract), register it here, document it in
+#: ``docs/api/root.md``.
+KNOB_REGISTRY = {
+    "TORCHMETRICS_TPU_ENGINE": "torchmetrics_tpu.engine.config:engine_enabled",
+    "TORCHMETRICS_TPU_CSE": "torchmetrics_tpu.engine.statespec:cse_enabled",
+    "TORCHMETRICS_TPU_SCAN": "torchmetrics_tpu.engine.scan:scan_k",
+    "TORCHMETRICS_TPU_ASYNC": "torchmetrics_tpu.engine.async_dispatch:async_inflight",
+    "TORCHMETRICS_TPU_QUARANTINE": "torchmetrics_tpu.engine.txn:quarantine_mode",
+    "TORCHMETRICS_TPU_COMPENSATED": "torchmetrics_tpu.engine.numerics:compensated_enabled",
+    "TORCHMETRICS_TPU_DRIFT_RTOL": "torchmetrics_tpu.engine.numerics:drift_rtol",
+    "TORCHMETRICS_TPU_SHARD": "torchmetrics_tpu.parallel.sharding:_env_mesh",
+    "TORCHMETRICS_TPU_SYNC_DEADLINE_MS": "torchmetrics_tpu.parallel.resilience:_env_float",
+    "TORCHMETRICS_TPU_SYNC_RETRIES": "torchmetrics_tpu.parallel.resilience:_env_float",
+    "TORCHMETRICS_TPU_SYNC_BACKOFF_MS": "torchmetrics_tpu.parallel.resilience:_env_float",
+    "TORCHMETRICS_TPU_DEGRADED": "torchmetrics_tpu.parallel.resilience:current_policy",
+    "TORCHMETRICS_TPU_SNAPSHOT_EVERY": "torchmetrics_tpu.parallel.elastic:SnapshotPolicy.from_env",
+    "TORCHMETRICS_TPU_COSTS": "torchmetrics_tpu.diag.costs:costs_enabled",
+    "TORCHMETRICS_TPU_TRACE": "torchmetrics_tpu.diag.trace:_env_recorder",
+    "TORCHMETRICS_TPU_SENTINEL": "torchmetrics_tpu.diag.sentinel:sentinel_enabled",
+    "TORCHMETRICS_TPU_AUDIT": "torchmetrics_tpu.diag.sentinel:audit_enabled",
+    "TORCHMETRICS_TPU_PROFILE": "torchmetrics_tpu.diag.profile:active_profile",
+    "TORCHMETRICS_TPU_STRAGGLER_US": "torchmetrics_tpu.diag.profile:straggler_threshold_us",
+    "TORCHMETRICS_TPU_SERVE_CAPACITY": "torchmetrics_tpu.serve.stats:_env_int",
+    "TORCHMETRICS_TPU_SERVE_PORT": "torchmetrics_tpu.serve.stats:_env_int",
+    "TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES": "torchmetrics_tpu.serve.stats:_env_int",
+}
+
+#: parsers that read the env key through a ``name`` PARAMETER (shared
+#: validation helpers) — the only functions where a dynamic (non-literal)
+#: environ key read is sanctioned (tmlint rule TM202)
+GENERIC_KNOB_PARSERS = (
+    "torchmetrics_tpu.parallel.resilience:_env_float",
+    "torchmetrics_tpu.serve.stats:_env_int",
+)
+
 # bucketing policy (see engine/bucketing.py)
 BUCKETING_ENABLED = True
 MIN_BUCKET = 8

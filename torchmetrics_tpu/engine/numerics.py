@@ -99,8 +99,10 @@ __all__ = [
 COMPENSATED_ENV_VAR = "TORCHMETRICS_TPU_COMPENSATED"
 DRIFT_RTOL_ENV_VAR = "TORCHMETRICS_TPU_DRIFT_RTOL"
 
-#: reserved pytree key for the residual dict inside compiled step states
-STATE_KEY = "__compensation__"
+#: reserved pytree key for the residual dict inside compiled step states —
+#: aliased from the canonical declaration (engine/statespec.py RIDER_KEYS);
+#: tmlint rule TM301 forbids respelling the literal outside that module
+from torchmetrics_tpu.engine.statespec import COMPENSATION_KEY as STATE_KEY  # noqa: E402
 #: the attribute carrying the live residual dict ({state attr: residual array})
 ATTR = "_comp_residuals"
 #: packed-sync fold output keys carrying a state's post-fold residual

@@ -479,12 +479,12 @@ class _ScanQueue:
     def __init__(self, stats) -> None:
         self.stats = stats
         #: (orig_args, orig_kwargs, padded_inputs, n_pad) per queued step
-        self._pending: List[Tuple[Tuple, Dict, Tuple, int]] = []
-        self._qkey: Optional[Tuple] = None
-        self._k = 0
-        self._cache: Dict[Tuple, Any] = {}
-        self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}
-        self._transient_fails: Dict[Tuple, int] = {}
+        self._pending: List[Tuple[Tuple, Dict, Tuple, int]] = []  # guarded-by: _lock
+        self._qkey: Optional[Tuple] = None  # guarded-by: _lock
+        self._k = 0  # guarded-by: _lock
+        self._cache: Dict[Tuple, Any] = {}  # guarded-by: _drain_mutex
+        self._fingerprints: Dict[Tuple, Dict[str, Any]] = {}  # guarded-by: _drain_mutex
+        self._transient_fails: Dict[Tuple, int] = {}  # guarded-by: _drain_mutex
         # drains can fire from a sidecar scrape thread while the hot loop
         # enqueues: the reentrant lock serializes dequeue+dispatch+writeback
         # so two flushes can never double-apply one payload
@@ -494,19 +494,19 @@ class _ScanQueue:
         self.on_drain = None
         # --- async tier (engine/async_dispatch.py) -----------------------
         #: in-flight bound resolved at push time (None/0 = synchronous drains)
-        self._async_limit: Optional[int] = None
+        self._async_limit: Optional[int] = None  # guarded-by: _lock
         #: buffers swapped out inside _push_locked, submitted OUTSIDE the lock
-        self._staged_work: List[_DrainWork] = []
-        self._needs_join = False
+        self._staged_work: List[_DrainWork] = []  # guarded-by: _lock
+        self._needs_join = False  # guarded-by: _lock
         #: FIFO of submitted-but-unjoined work (pruned lazily as items finish)
-        self._inflight: Deque[_DrainWork] = deque()
+        self._inflight: Deque[_DrainWork] = deque()  # guarded-by: _lock
         #: payloads a failed worker drain handed back for caller-side replay
-        self._failed: Deque[_DrainWork] = deque()
+        self._failed: Deque[_DrainWork] = deque()  # guarded-by: _lock
         #: a worker failure stops dispatching until a join replays the FIFO —
         #: otherwise later buffers would apply ahead of the failed one
-        self._poisoned = False
+        self._poisoned = False  # guarded-by: _lock
         #: a successful background drain defers the view re-anchor to the join
-        self._post_pending = False
+        self._post_pending = False  # guarded-by: _lock
         # worker execution vs a caller-side synchronous drain of the SAME
         # queue: one mutex serializes gather/dispatch/writeback. Callers that
         # hold self._lock may acquire it; the worker takes it WITHOUT
@@ -575,6 +575,8 @@ class _ScanQueue:
         happen OUTSIDE the queue lock, so the worker — which takes the drain
         mutex but never this lock from its own stack — cannot deadlock
         against an enqueue."""
+        # tmlint: disable=TM601 — emptiness peek; a stale read only skips the
+        # early join, and join_async re-checks the FIFOs under the lock
         if not async_inflight and (self._inflight or self._failed):
             # async was just disabled mid-stream (scope exit, kwarg change):
             # the leftover background work must land before this step's path
@@ -658,6 +660,7 @@ class _ScanQueue:
         self.join_async(reason)
         return drained + len(work.pending)
 
+    # tmlint: holds(_lock)
     def _drain_locked(self, reason: str) -> int:
         """Synchronous drain (queue lock held): swap + execute on this thread."""
         work = self._swap_locked(reason)
@@ -671,6 +674,7 @@ class _ScanQueue:
         self._post_drain()
         return len(work.pending)
 
+    # tmlint: holds(_lock)
     def _flush_point_locked(self, reason: str, asyncable: bool) -> None:
         """A drain trigger inside the enqueue path (queue lock held).
 
@@ -694,6 +698,7 @@ class _ScanQueue:
         else:
             self._drain_locked(reason)
 
+    # tmlint: holds(_lock)
     def _swap_locked(self, reason: str) -> Optional[_DrainWork]:
         """Detach the active buffer as a work item (the double-buffer swap)."""
         pending = self._pending
@@ -709,6 +714,7 @@ class _ScanQueue:
             rec.record("scan.flush", st.owner, reason=reason, steps=n)
         return _DrainWork(self, pending, self._qkey, self._k, self._names_snapshot(), reason)
 
+    # tmlint: holds(_drain_mutex)
     def _execute_work(self, work: _DrainWork, allow_compile: bool = True) -> bool:
         """Gather → (compile) → ONE dispatch → counters → writeback.
 
@@ -848,7 +854,8 @@ class _ScanQueue:
         from torchmetrics_tpu.engine import async_dispatch as _async
 
         st = self.stats
-        limit = self._async_limit or 1
+        with self._lock:
+            limit = self._async_limit or 1
         # first drain of a (signature, K-bucket) pair COMPILES, and the trace
         # diffs the metric's __dict__ — which the caller's next enqueues
         # mutate concurrently (_update_count/_computed bookkeeping). Compiles
@@ -862,6 +869,9 @@ class _ScanQueue:
         key = None
         if gathered is not None:
             key = (work.qkey, gathered[1], gathered[2], k_bucket(len(work.pending)))
+        # tmlint: disable=TM601 — documented racy prediction: a concurrent
+        # worker may demote this key under the drain mutex, but a misprediction
+        # is safe either way (the worker refuses to compile and hands back)
         if key is None or key not in self._cache:
             # the work item already rides the in-flight FIFO (appended at the
             # swap), so wait out the OLDER items only — waiting on ourselves
@@ -965,11 +975,15 @@ class _ScanQueue:
         that only observers read state.
         """
         st = self.stats
-        if self._poisoned:
-            work.replay = True  # passthrough: joiners count it ONCE, at replay
-            with self._lock:
+        with self._lock:
+            if self._poisoned:
+                # passthrough: joiners count it ONCE, at replay — checked and
+                # appended in ONE critical section so a concurrent join cannot
+                # clear the flag between the read and the hand-back (the
+                # executor's finally sets work.done after we return)
+                work.replay = True
                 self._failed.append(work)
-            return
+                return
         from torchmetrics_tpu.diag.transfer_guard import native_reentry
 
         t0 = perf_counter()
@@ -996,7 +1010,8 @@ class _ScanQueue:
         overlap_us = round(max(0.0, ((min(fw, end) if fw is not None else end) - t0) * 1e6), 3)
         st.async_dispatches += 1
         st.async_overlap_us += int(overlap_us)
-        self._post_pending = True
+        with self._lock:
+            self._post_pending = True
         rec = _diag.active_recorder()
         if rec is not None:
             rec.record(
@@ -1044,8 +1059,9 @@ class _ScanQueue:
                 rec.record("async.join", st.owner, reason=reason, steps=settled, wait_us=wait_us)
         if collect:
             settled += self._collect_failed()
-        if self._post_pending:
-            self._post_pending = False
+        with self._lock:
+            post_pending, self._post_pending = self._post_pending, False
+        if post_pending:
             self._post_drain()
         from torchmetrics_tpu.engine import async_dispatch as _async
 
@@ -1114,6 +1130,7 @@ class MetricScan(_ScanQueue):
     def exclusive_to(self, metrics: Sequence[Any]) -> bool:
         return any(self._engine._metric is m for m in metrics)
 
+    # tmlint: holds(_lock)
     def _push_locked(self, args, kwargs, k: int) -> bool:
         eng = self._engine
         st = self.stats
@@ -1263,6 +1280,7 @@ class FusedScan(_ScanQueue):
         covered = [m for _, m in self._members(self._names)]
         return all(any(m is c for c in metrics) for m in covered)
 
+    # tmlint: holds(_lock)
     def _push_locked(self, args, kwargs, k: int) -> Optional[Set[str]]:
         eng = self._engine
         st = self.stats

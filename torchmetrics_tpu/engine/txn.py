@@ -89,8 +89,10 @@ __all__ = [
 
 QUARANTINE_ENV_VAR = "TORCHMETRICS_TPU_QUARANTINE"
 
-#: reserved pytree key for the quarantine counter inside compiled step states
-STATE_KEY = "__quarantine__"
+#: reserved pytree key for the quarantine counter inside compiled step states —
+#: aliased from the canonical declaration (engine/statespec.py RIDER_KEYS);
+#: tmlint rule TM301 forbids respelling the literal outside that module
+from torchmetrics_tpu.engine.statespec import QUARANTINE_KEY as STATE_KEY  # noqa: E402
 #: the attribute carrying the live device counter on a metric instance
 ATTR = "_quarantined_count"
 

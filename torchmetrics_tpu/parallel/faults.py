@@ -222,6 +222,8 @@ def apply_before(
                 )
 
 
+# tmlint: boundary(fault-inject) — deliberately materializes the gathered
+# payload to corrupt one rank's row; fault injection IS a declared host read
 def apply_after(label: str, members: Optional[Sequence[int]], gathered: Any) -> Any:
     """Fire post-collective faults (payload corruption) on the gathered rows."""
     out = gathered

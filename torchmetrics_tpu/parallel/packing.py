@@ -63,6 +63,7 @@ def _is_array(x: Any) -> bool:
     return isinstance(x, (jax.Array, jnp.ndarray)) and not isinstance(x, (list, tuple))
 
 
+# tmlint: host-only — digests python int sequences, never device buffers
 def _fingerprint(dims: Sequence[int]) -> int:
     """Process-stable digest of a dim sequence (crc32, masked to positive int32)."""
     return zlib.crc32(np.asarray(list(dims), dtype=np.int64).tobytes()) & 0x7FFFFFFF
@@ -500,8 +501,12 @@ class PackedSyncPlan:
             entries += _timeline.timeline_entries()
         if not entries:
             return None
+        # tmlint: disable=TM101 — `entries` is a host list of python ints (the
+        # audit digests above already rode the sanctioned sync-audit boundary)
         return np.asarray(entries, dtype=np.int32)
 
+    # tmlint: host-only — validates the GATHERED metadata (host numpy, arrived
+    # through the sanctioned sync-metadata exchange); touches no device buffer
     def finalize(self, world_meta: Optional[np.ndarray]) -> None:
         """Validate the exchanged metadata and freeze buffer offsets.
 

@@ -261,6 +261,8 @@ def reset_resilience() -> None:
 # ------------------------------------------------------------------ the wrapper
 
 
+# tmlint: boundary(sync-fault) — CRC echo verification materializes the local
+# payload row; opt-in (verify_payload) and part of the declared fault machinery
 def _payload_crc(payload: Any) -> Optional[int]:
     """crc32 over the payload's raw bytes; None when it has no buffer view."""
     try:
@@ -352,6 +354,8 @@ def bounded_collective(
             out = _faults.apply_after(label, members, out)
             if local_crc is not None:
                 rank = _local_rank()
+                # tmlint: disable=TM101 — `out` is the gathered host result
+                # (the collective already crossed at its sanctioned boundary)
                 got = np.asarray(out)
                 if rank < got.shape[0]:
                     echo_crc = zlib.crc32(np.ascontiguousarray(got[rank]).tobytes()) & 0xFFFFFFFF

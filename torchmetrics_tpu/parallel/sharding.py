@@ -137,6 +137,7 @@ def build_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence[Any]]
             f"a state mesh needs >= 2 devices (got {len(devices)}); with one"
             " device every shard rule is a no-op — leave sharding off instead"
         )
+    # tmlint: disable=TM101 — `devices` is a host list of Device objects
     return Mesh(np.asarray(devices), (STATE_AXIS,))
 
 

@@ -43,7 +43,7 @@ __all__ = ["StateSnapshot", "read_host", "snapshot_compute", "take_snapshot"]
 #: holding device arrays cannot accumulate for the life of the process), and
 #: the liveness check guards against id reuse in the window before the
 #: callback runs.
-_SCRATCH: Dict[int, Any] = {}
+_SCRATCH: Dict[int, Any] = {}  # guarded-by: _SCRATCH_LOCK
 _SCRATCH_LOCK = threading.Lock()
 
 

@@ -40,7 +40,7 @@ _LOCK = threading.Lock()
 
 #: process-wide monotonic counters (scrapes come from the sidecar thread, so
 #: every bump takes the lock; the hot update loop never touches these)
-_COUNTERS: Dict[str, float] = {
+_COUNTERS: Dict[str, float] = {  # guarded-by: _LOCK
     "scrapes": 0,
     "scrape_seconds": 0.0,
     "snapshots": 0,

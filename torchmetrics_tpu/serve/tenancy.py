@@ -295,6 +295,8 @@ class TenantSlices(Metric):
         """Updates that spilled past capacity (scrape-path host read)."""
         return int(read_host(self, ("spilled",))["spilled"])
 
+    # tmlint: host-only — operates on the host dict read_host already fetched
+    # through the sanctioned serve-scrape boundary
     def spill_report(self) -> Dict[str, Any]:
         """Spilled volume + the dominant spilled tenants from the sketch."""
         host = read_host(self, ("spill_ids", "spill_counts", "spilled"))

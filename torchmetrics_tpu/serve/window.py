@@ -51,6 +51,8 @@ _FOLDS = {
 }
 
 
+# tmlint: boundary(serve-setup) — one-time construction-path validation; the
+# default-value reads below ride the serve-setup boundary (never the hot loop)
 def check_streamable(base: Metric, wrapper: str) -> Dict[str, Tuple[str, Any]]:
     """Validate a base metric for streaming wrappers; returns attr -> fold.
 
