@@ -43,6 +43,7 @@ import subprocess
 import sys
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -79,7 +80,7 @@ _SCENARIO_BYTES = {
 # every scenario block scripts/check_counters.py gates on: a run (including
 # the TPU-less micro fallback) must prove each of these completed, or the
 # gate's scenario-completeness check fails — nothing gated can skip silently
-_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding")
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "heavy")
 
 # the sharding scenario partitions state over a >= 4-device mesh; on a host
 # platform that needs forced virtual devices, set BEFORE jax initializes (the
@@ -2404,6 +2405,306 @@ def bench_sharding(micro=False):
     return out
 
 
+def bench_heavy(micro=False):
+    """Heavy-metric in-graph kernels scenario (ISSUE 15 evidence).
+
+    The reference's expensive workloads — image FID, detection mAP, text
+    BERTScore — run engine-native, and every claim is a recorded counter:
+
+    - **FID**: the branchless row-additive update streams under the STRICT
+      guard with 0 host transfers / 0 warm retraces and ONE ledger-verified
+      update executable; ``compute`` (``jnp.linalg.eigvalsh``) is one cached
+      graph dispatched inside the same guard; the retained host-eigh knob path
+      matches in value and is COUNTED (``fid_host_eighs``); the ``(d, d)``
+      covariance states born ``row_sharded`` on a 4-device mesh hold ~1/mesh
+      bytes per device with value parity; the K=8 scan drain is byte-identical.
+    - **mAP (packed route)**: ``PackedMeanAveragePrecision`` folds greedy
+      matching + PR-histogram accumulation into one donated executable —
+      ragged detection widths share one power-of-two bucket signature, 0 host
+      transfers, headline parity vs the retained host evaluator (itself
+      counted as ``map_host_evals`` with its fetch on the sanctioned
+      ``map-host-matcher`` boundary).
+    - **BERTScore**: the bucketed score path holds 0 warm retraces across a
+      ragged (pair-count × width) stream under the STRICT guard, and matches
+      the exact-shape staging bit-for-tolerance (idf table gather included).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision, PackedMeanAveragePrecision
+    from torchmetrics_tpu.detection.ingraph import pack_detections
+    from torchmetrics_tpu.diag import diag_context, transfer_guard
+    from torchmetrics_tpu.diag.costs import ledger_snapshot
+    from torchmetrics_tpu.engine import engine_context, scan_context
+    from torchmetrics_tpu.engine.stats import engine_report, reset_engine_stats
+    from torchmetrics_tpu.functional.text.bert import bert_score, bert_scoring_cache_size
+    from torchmetrics_tpu.image.fid import FrechetInceptionDistance
+    from torchmetrics_tpu.parallel import sharding as shd
+
+    feat_dim = 128 if micro else 512
+    fid_batch = 16 if micro else 64
+    fid_steps = 8 if micro else 24
+    map_classes = 8 if micro else 16
+    map_bins = 512 if micro else 1024
+    map_steps = 6 if micro else 16
+    out = {
+        "feat_dim": feat_dim, "fid_batch": fid_batch, "fid_steps": fid_steps,
+        "map_classes": map_classes, "map_bins": map_bins,
+    }
+    rng = np.random.RandomState(17)
+
+    def extractor(imgs):
+        # row-independent, NON-saturating features (the /dim keeps tanh in its
+        # linear range — a saturated extractor collapses every covariance to 0)
+        x = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+        w = jnp.linspace(0.25, 1.75, x.shape[1] * feat_dim).reshape(x.shape[1], feat_dim)
+        return jnp.tanh(x @ w / x.shape[1])
+
+    fid_real = [jnp.asarray(rng.rand(fid_batch, 2, 8, 8).astype(np.float32)) for _ in range(4)]
+    # the fake stream is a genuinely different distribution (scaled + shifted)
+    fid_fake = [img * 0.8 + 0.15 for img in fid_real]
+
+    def fid_stream(metric, steps):
+        for i in range(steps):
+            if i % 2 == 0:
+                metric.update(fid_real[(i // 2) % len(fid_real)], jnp.asarray(True))
+            else:
+                metric.update(fid_fake[(i // 2) % len(fid_fake)], jnp.asarray(False))
+
+    def _ledger_execs(owner, kind):
+        # cached computes ledger under the epoch engine's qualified owner name
+        want = f"epoch:{owner}" if kind == "compute" else owner
+        return [
+            e for e in ledger_snapshot().get("executables", [])
+            if e["owner"] == want and e["kind"] == kind
+        ]
+
+    # -- FID: in-graph vs retained host-eigh parity (+ the counted fallback) --
+    reset_engine_stats()
+    fid_ref = FrechetInceptionDistance(feature=extractor, num_features=feat_dim)
+    fid_stream(fid_ref, 4)
+    v_ingraph = float(np.asarray(fid_ref.compute()))
+    os.environ["TORCHMETRICS_TPU_FID_HOST_EIGH"] = "1"
+    try:
+        fid_host = FrechetInceptionDistance(feature=extractor, num_features=feat_dim)
+        fid_stream(fid_host, 4)
+        v_host = float(np.asarray(fid_host.compute()))
+    finally:
+        os.environ.pop("TORCHMETRICS_TPU_FID_HOST_EIGH", None)
+    out["fid_value_ingraph"] = v_ingraph
+    out["fid_value_host"] = v_host
+    out["fid_parity_ok"] = bool(abs(v_ingraph - v_host) <= 1e-3 * (1.0 + abs(v_host)))
+    out["fid_host_eigh_counted"] = engine_report()["fid_host_eighs"] == 1
+
+    # -- FID: engine hot loop + compute under the STRICT guard, one graph -----
+    reset_engine_stats()
+    with engine_context(True, donate=True):
+        fid = FrechetInceptionDistance(feature=extractor, num_features=feat_dim)
+        fid_stream(fid, 2)  # warm: the single fixed-shape signature compiles here
+        jax.block_until_ready([fid.real_features_cov_sum])
+        with diag_context(capacity=16384) as rec, transfer_guard("strict"):
+            before = engine_report()
+            t0 = time.perf_counter()
+            fid_stream(fid, fid_steps)
+            jax.block_until_ready([fid.real_features_cov_sum])
+            elapsed = time.perf_counter() - t0
+            fid_value = fid.compute()  # cached in-graph Fréchet: no host read
+            jax.block_until_ready(fid_value)
+            after = engine_report()
+        out["fid_us_per_step"] = round(elapsed / fid_steps * 1e6, 2)
+        out["fid_retraces_after_warmup"] = after["traces"] - before["traces"]
+        out["fid_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+        retraces = [e for e in rec.snapshot() if e.kind.endswith(".retrace")]
+        out["heavy_retraces_uncaused"] = sum(1 for e in retraces if not e.data.get("cause"))
+        out["fid_single_graph_ok"] = bool(
+            len(_ledger_execs("FrechetInceptionDistance", "update")) == 1
+            and len(_ledger_execs("FrechetInceptionDistance", "compute")) == 1
+            and out["fid_retraces_after_warmup"] == 0
+        )
+        out["fid_host_eighs_clean"] = engine_report()["fid_host_eighs"]
+        v_unqueued = np.asarray(fid_value)
+
+    # -- FID: K=8 scan drain byte-parity --------------------------------------
+    with engine_context(True, donate=True), scan_context(8):
+        fid_q = FrechetInceptionDistance(feature=extractor, num_features=feat_dim)
+        fid_stream(fid_q, fid_steps + 2)
+        v_queued = np.asarray(fid_q.compute())
+    with engine_context(True, donate=True):
+        fid_b = FrechetInceptionDistance(feature=extractor, num_features=feat_dim)
+        fid_stream(fid_b, fid_steps + 2)
+        v_base = np.asarray(fid_b.compute())
+    out["fid_scan_parity_ok"] = bool(np.array_equal(v_queued, v_base))
+
+    # -- FID: row-sharded covariance on a 4-device state mesh ------------------
+    n_dev = min(4, jax.local_device_count())
+    if n_dev >= 2 and feat_dim % n_dev == 0:
+        reset_engine_stats()
+        with engine_context(True, donate=True), shd.mesh_context(n_dev):
+            fid_s = FrechetInceptionDistance(feature=extractor, num_features=feat_dim)
+            born = shd.is_sharded(fid_s.real_features_cov_sum) and shd.is_sharded(
+                fid_s.fake_features_cov_sum
+            )
+            foot = fid_s.state_footprint()
+            out["fid_sharded_footprint_fraction"] = round(
+                foot["per_device_bytes"] / max(foot["total_bytes"], 1), 4
+            )
+            # the exact update sequence of the guarded leg (warm + hot loop),
+            # so the value comparison sees identical samples
+            fid_stream(fid_s, 2)
+            fid_stream(fid_s, fid_steps)
+            v_sharded = float(np.asarray(fid_s.compute()))
+        out["fid_sharded_parity_ok"] = bool(
+            born and abs(v_sharded - float(v_unqueued)) <= 1e-3 * (1.0 + abs(float(v_unqueued)))
+        )
+        out["fid_shard_states"] = engine_report()["shard_states"]
+    else:  # pragma: no cover — the bench forces an 8-virtual-device CPU world
+        out["fid_sharded_parity_ok"] = False
+        out["fid_sharded_footprint_fraction"] = 1.0
+
+    # -- mAP: packed in-graph route vs the retained (counted) host evaluator --
+    # every detection gets a GLOBALLY DISTINCT score level k/map_bins: the
+    # levels are f32-exact (dyadic), distinct scores land in distinct
+    # histogram bins (binned PR curve == exact PR curve), and no score ties
+    # exist anywhere (tie order at equal scores is sort-implementation-defined
+    # in BOTH reference paths — the one legitimate divergence source)
+    score_rng = np.random.RandomState(99)
+    score_levels = iter(score_rng.permutation(map_bins))
+
+    def map_batch(b, g, seed):
+        # box coords quantized to a 1/8 grid: every area/intersection is exact
+        # in BOTH f32 (in-graph without x64) and f64 (host evaluator), so the
+        # two paths' IoUs can only disagree at the division-rounding level —
+        # far below any realistic distance to an IoU threshold
+        r = np.random.RandomState(seed)
+        tb = np.zeros((b, g, 4), np.float32)
+        tb[..., :2] = np.round(r.rand(b, g, 2) * 60 * 8) / 8
+        tb[..., 2:] = tb[..., :2] + np.round((r.rand(b, g, 2) * 50 + 5) * 8) / 8
+        tl = r.randint(0, map_classes, (b, g))
+        tc = r.randint(1, g + 1, b)
+        pb = np.clip(tb + np.round(r.randn(b, g, 4).astype(np.float32) * 4 * 8) / 8, 0, None)
+        pb[..., 2:] = np.maximum(pb[..., 2:], pb[..., :2] + 1)
+        ps = (
+            np.fromiter((next(score_levels) for _ in range(b * g)), dtype=np.float64, count=b * g)
+            .reshape(b, g) / map_bins
+        ).astype(np.float32)
+        pl = tl.copy()
+        flip = r.rand(b, g) < 0.2
+        pl[flip] = r.randint(0, map_classes, flip.sum())
+        pc = r.randint(1, g + 1, b)
+        return (
+            {"boxes": pb, "scores": ps, "labels": pl, "num_boxes": pc},
+            {"boxes": tb, "labels": tl, "num_boxes": tc},
+        )
+
+    # ragged widths that share one power-of-two slot bucket (9..16 -> 16);
+    # total detections stay under map_bins so every score level is unique
+    widths = [9, 12, 16, 10, 14, 11, 13, 15]
+    assert map_steps * 4 * max(widths) <= map_bins, "score levels must stay unique"
+    batches = [map_batch(4, widths[i % len(widths)], 100 + i) for i in range(map_steps)]
+
+    reset_engine_stats()
+    host_map = MeanAveragePrecision()
+    for preds, target in batches:
+        host_map.update(preds, target)
+    hv = {k: np.asarray(v) for k, v in host_map.compute().items()}
+    out["map_host_fallback_counted"] = engine_report()["map_host_evals"] >= 1
+
+    reset_engine_stats()
+    with engine_context(True, donate=True):
+        pm = PackedMeanAveragePrecision(num_classes=map_classes, score_bins=map_bins)
+        packed = [pack_detections(p, t) for p, t in batches]
+        for arrs in packed[:2]:
+            pm.update(*arrs)
+        jax.block_until_ready([pm.map_tp_hist])
+        with diag_context(capacity=16384) as rec, transfer_guard("strict"):
+            before = engine_report()
+            t0 = time.perf_counter()
+            for arrs in packed[2:]:
+                pm.update(*arrs)
+            jax.block_until_ready([pm.map_tp_hist])
+            elapsed = time.perf_counter() - t0
+            pv_dev = pm.compute()
+            jax.block_until_ready(pv_dev)
+            after = engine_report()
+        out["map_us_per_step"] = round(elapsed / max(len(packed) - 2, 1) * 1e6, 2)
+        out["map_retraces_after_warmup"] = after["traces"] - before["traces"]
+        out["map_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+        retraces = [e for e in rec.snapshot() if e.kind.endswith(".retrace")]
+        out["heavy_retraces_uncaused"] += sum(1 for e in retraces if not e.data.get("cause"))
+        out["map_single_graph_ok"] = bool(
+            len(_ledger_execs("PackedMeanAveragePrecision", "update")) == 1
+            and len(_ledger_execs("PackedMeanAveragePrecision", "compute")) == 1
+            and out["map_retraces_after_warmup"] == 0
+        )
+    pv = {k: np.asarray(v) for k, v in pv_dev.items()}
+    headline = (
+        "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+        "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+    )
+    deltas = {k: abs(float(hv[k]) - float(pv[k])) for k in headline}
+    out["map_value"] = float(pv["map"])
+    out["map_max_headline_delta"] = max(deltas.values())
+    # the bench runs without x64, so the in-graph path accumulates in f32 vs
+    # the host evaluator's f64 — 5e-4 bounds that rounding envelope; the
+    # BIT-level parity claim is pinned under x64 by tests/test_heavy.py
+    out["map_parity_ok"] = bool(out["map_max_headline_delta"] <= 5e-4)
+
+    # -- BERTScore: bucketed ragged stream, 0 warm retraces, STRICT-clean -----
+    def tok(sents):
+        width = max(len(s.split()) for s in sents)
+        ids = np.zeros((len(sents), width), np.int32)
+        for i, s in enumerate(sents):
+            for j, w in enumerate(s.split()):
+                # crc32, not hash(): PYTHONHASHSEED randomizes hash() per
+                # process, which would make the recorded evidence irreproducible
+                ids[i, j] = (zlib.crc32(w.encode()) % 211) + 1
+        return {
+            "input_ids": jnp.asarray(ids),
+            "attention_mask": jnp.asarray((ids > 0).astype(np.int32)),
+        }
+
+    def model(ids, mask):
+        d = 32
+        return jax.nn.one_hot(ids % d, d) + 0.1 * jax.nn.one_hot((ids // d) % d, d)
+
+    words = [f"tok{i}" for i in range(64)]
+
+    def pair_stream(n, width, seed):
+        r = np.random.RandomState(seed)
+        preds = [" ".join(r.choice(words, size=r.randint(2, width)).tolist()) for _ in range(n)]
+        target = [" ".join(r.choice(words, size=r.randint(2, width)).tolist()) for _ in range(n)]
+        return preds, target
+
+    preds0, target0 = pair_stream(6, 7, 0)
+    bucketed = bert_score(preds0, target0, model=model, user_tokenizer=tok, idf=True)
+    os.environ["TORCHMETRICS_TPU_BERT_BUCKETS"] = "0"
+    try:
+        exact = bert_score(preds0, target0, model=model, user_tokenizer=tok, idf=True)
+    finally:
+        os.environ.pop("TORCHMETRICS_TPU_BERT_BUCKETS", None)
+    out["bert_parity_ok"] = bool(
+        all(
+            np.allclose(np.asarray(bucketed[k]), np.asarray(exact[k]), atol=1e-6)
+            for k in ("precision", "recall", "f1")
+        )
+    )
+
+    # warm the (8, 8) bucket, then a ragged stream inside it must not retrace
+    bert_score(*pair_stream(5, 7, 1), model=model, user_tokenizer=tok, idf=False)
+    warm_graphs = bert_scoring_cache_size()
+    with diag_context(capacity=4096) as rec, transfer_guard("strict"):
+        t0 = time.perf_counter()
+        ragged = [pair_stream(2 + (i % 6), 3 + (i % 5), 10 + i) for i in range(8)]
+        for preds_i, target_i in ragged:
+            bert_score(preds_i, target_i, model=model, user_tokenizer=tok, idf=False)
+        elapsed = time.perf_counter() - t0
+    out["bert_us_per_batch"] = round(elapsed / len(ragged) * 1e6, 2)
+    out["bert_warm_retraces"] = bert_scoring_cache_size() - warm_graphs
+    out["bert_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+    out["bert_score_graphs"] = bert_scoring_cache_size()
+    return out
+
+
 def multichip_evidence(sharding_block):
     """MULTICHIP_r06-style evidence dict from a completed sharding scenario."""
     import jax
@@ -2988,10 +3289,25 @@ def main(argv=None):
                 statuses["device_scenarios"] = "tpu_unavailable_micro_fallback"
             except Exception as err:  # noqa: BLE001
                 statuses["device_scenarios"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+            device_kind = backend.get("device_kind", backend.get("platform", ""))
+
+        # heavy runs LAST among gated scenarios, AFTER every device timing leg:
+        # its in-graph FID compute puts an eig kernel on the accelerator
+        # stream, and on the tunneled TPU one device eigh degrades every
+        # subsequent dispatch (~0.03 ms -> ~104 ms) — running it earlier would
+        # silently poison bench_ours' and the other scenarios' timing evidence
+        try:
+            extras["heavy"] = bench_heavy(micro=not on_tpu or args.smoke)
+            statuses["heavy"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["heavy"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        if statuses.get("device_scenarios") == "tpu_unavailable_micro_fallback":
             # scenario-completeness keys: the micro fallback must record which
             # GATED scenario blocks this run actually produced, so a TPU-less
             # run can never silently skip a gated scenario (check_counters.py
-            # fails on a non-empty scenarios_missing)
+            # fails on a non-empty scenarios_missing) — computed after EVERY
+            # gated scenario (heavy included) has had its chance to run
             extras["micro_fallback"] = {
                 "scenarios_present": sorted(
                     k for k in _GATED_SCENARIOS if isinstance(extras.get(k), dict)
@@ -3000,7 +3316,6 @@ def main(argv=None):
                     k for k in _GATED_SCENARIOS if not isinstance(extras.get(k), dict)
                 ),
             }
-            device_kind = backend.get("device_kind", backend.get("platform", ""))
     else:
         # a wedged plugin may have left a stuck init thread behind: do NO further
         # jax work of any kind in this process
@@ -3013,6 +3328,7 @@ def main(argv=None):
         statuses["async"] = "tpu_unavailable"
         statuses["cse"] = "tpu_unavailable"
         statuses["sharding"] = "tpu_unavailable"
+        statuses["heavy"] = "tpu_unavailable"
         statuses["device_scenarios"] = "tpu_unavailable"
 
     if not args.smoke:

@@ -100,6 +100,19 @@ _TRACKED = (
     ("cse", "cse_host_transfers", "max"),
     ("cse", "cse_retraces_after_warmup", "max"),
     ("cse", "cse_spec_fallbacks", "max"),
+    # heavy-metric in-graph kernels (PR 15): per-step timings and the sharded
+    # footprint are trajectory evidence (machine-dependent; check_counters
+    # owns the parity/single-graph gates); transfers/retraces and the clean-
+    # run host-fallback count must never creep.
+    ("heavy", "fid_us_per_step", None),
+    ("heavy", "map_us_per_step", None),
+    ("heavy", "fid_sharded_footprint_fraction", None),
+    ("heavy", "fid_host_transfers", "max"),
+    ("heavy", "fid_retraces_after_warmup", "max"),
+    ("heavy", "map_host_transfers", "max"),
+    ("heavy", "map_retraces_after_warmup", "max"),
+    ("heavy", "bert_warm_retraces", "max"),
+    ("heavy", "fid_host_eighs_clean", "max"),
 )
 
 #: the multi-chip evidence trajectory (MULTICHIP_r*.json, PR 12 onward): the

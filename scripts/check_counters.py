@@ -323,6 +323,32 @@ _CHECKS = (
     ("sharding", "sharding_footprint_fraction", "abs", 0.30),  # per-device ~1/mesh (mesh>=4)
     ("sharding", "lifecycle_roundtrip_ok", "true", None),  # clone/pickle/state_dict/reshard
     ("sharding", "scan_compat_ok", "true", None),  # PR-10 K=8 drain, byte-identical
+    # heavy-metric in-graph kernel gates (image/fid.py, detection/ingraph.py,
+    # functional/text/bert.py, PR 15): the reference's expensive workloads run
+    # engine-native — FID update+compute and the packed-route mAP hold 0
+    # hot-loop host transfers under the STRICT guard with ledger-verified
+    # single-graph lowering, the ragged BERTScore stream holds 0 warm
+    # retraces, the sharded-FID covariance sits at ~1/mesh bytes per device,
+    # and every in-graph path is parity-pinned against its host reference
+    # (the retained host paths are themselves COUNTED fallbacks)
+    ("heavy", "fid_parity_ok", "true", None),  # in-graph eigvalsh == host eigh
+    ("heavy", "fid_host_transfers", "abs", 0),  # update stream + compute under STRICT
+    ("heavy", "fid_retraces_after_warmup", "abs", 0),
+    ("heavy", "fid_single_graph_ok", "true", None),  # 1 update + 1 compute executable
+    ("heavy", "fid_host_eighs_clean", "abs", 0),  # knob off -> no host fallback
+    ("heavy", "fid_host_eigh_counted", "true", None),  # knob on -> counted exactly once
+    ("heavy", "fid_scan_parity_ok", "true", None),  # K=8 drain byte-identical
+    ("heavy", "fid_sharded_parity_ok", "true", None),  # row_sharded covariance, same value
+    ("heavy", "fid_sharded_footprint_fraction", "abs", 0.30),  # ~1/mesh (mesh >= 4)
+    ("heavy", "map_parity_ok", "true", None),  # packed in-graph == host evaluator
+    ("heavy", "map_host_transfers", "abs", 0),  # matcher + PR accumulation on device
+    ("heavy", "map_retraces_after_warmup", "abs", 0),  # ragged widths share one bucket
+    ("heavy", "map_single_graph_ok", "true", None),  # 1 update + 1 compute executable
+    ("heavy", "map_host_fallback_counted", "true", None),  # host evaluator IS counted
+    ("heavy", "bert_parity_ok", "true", None),  # bucketed == exact-shape staging
+    ("heavy", "bert_warm_retraces", "abs", 0),  # ragged stream inside warm buckets
+    ("heavy", "bert_host_transfers", "abs", 0),  # score path under STRICT
+    ("heavy", "heavy_retraces_uncaused", "abs", 0),
 )
 
 
@@ -363,7 +389,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "heavy"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

@@ -1,0 +1,486 @@
+"""Heavy-metric in-graph kernels (ISSUE 15): FID / packed mAP / BERTScore.
+
+Parity suites pin the engine-native paths bit-or-tolerance-exact against the
+retained host reference paths, including the world-2 packed sync over FID's
+covariance states and a 4-device sharded FID run; retrace-count assertions pin
+the bucketing contracts for ragged mAP widths and ragged BERTScore batches.
+"""
+
+import os
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import multihost_utils
+
+from torchmetrics_tpu.detection import MeanAveragePrecision, PackedMeanAveragePrecision
+from torchmetrics_tpu.detection.ingraph import pack_detections
+from torchmetrics_tpu.diag import diag_context, transfer_guard
+from torchmetrics_tpu.engine import engine_context, scan_context
+from torchmetrics_tpu.engine.stats import engine_report, reset_engine_stats
+from torchmetrics_tpu.functional.text.bert import (
+    _compute_idf,
+    _idf_table,
+    _idf_weights,
+    bert_score,
+    bert_scoring_cache_size,
+)
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance
+from torchmetrics_tpu.parallel import sharding
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+# ------------------------------------------------------------------ fixtures
+
+FEAT_DIM = 8
+
+
+def toy_extractor(imgs):
+    """Row-independent (N, 8) features — the row-additive contract holder.
+
+    The /dim keeps tanh in its linear range (a saturated extractor collapses
+    every covariance to zero and the parity checks go vacuous).
+    """
+    x = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+    w = jnp.linspace(0.25, 1.75, x.shape[1] * FEAT_DIM).reshape(x.shape[1], FEAT_DIM)
+    return jnp.tanh(x @ w / x.shape[1])
+
+
+def f32_extractor(imgs):
+    """f32 output != the f64 accumulation dtype: a lost ``orig_dtype`` is
+    visible as a dtype flip (``toy_extractor`` promotes to f64 under x64).
+    Module-level so pickling a metric that references it round-trips."""
+    return toy_extractor(imgs).astype(jnp.float32)
+
+
+def fid_stream(n_batches=4, batch=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(batch, 2, 4, 4).astype(np.float32)), jnp.asarray(i % 2 == 0))
+        for i, _ in enumerate(range(n_batches))
+    ]
+
+
+def run_fid(metric, stream):
+    for imgs, real in stream:
+        metric.update(imgs, real)
+    return np.asarray(metric.compute())
+
+
+N_CLS = 4
+
+
+def map_batches(n_batches=3, b=4, g=5, seed=7, bins=1024):
+    """Jittered-GT detection batches; scores quantized to bin centers so the
+    histogram PR accumulation is EXACT vs the host reference."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        tb = np.zeros((b, g, 4), np.float32)
+        tb[..., :2] = rng.rand(b, g, 2) * 60
+        tb[..., 2:] = tb[..., :2] + rng.rand(b, g, 2) * 50 + 5
+        tl = rng.randint(0, N_CLS, (b, g))
+        tc = rng.randint(1, g + 1, b)
+        pb = np.clip(tb + rng.randn(b, g, 4).astype(np.float32) * 4, 0, None)
+        pb[..., 2:] = np.maximum(pb[..., 2:], pb[..., :2] + 1)
+        ps = np.round(rng.rand(b, g).astype(np.float32) * (bins // 2)) / bins
+        pl = tl.copy()
+        flip = rng.rand(b, g) < 0.2
+        pl[flip] = rng.randint(0, N_CLS, flip.sum())
+        pc = rng.randint(1, g + 1, b)
+        out.append(
+            (
+                {"boxes": pb, "scores": ps, "labels": pl, "num_boxes": pc},
+                {"boxes": tb, "labels": tl, "num_boxes": tc},
+            )
+        )
+    return out
+
+
+HEADLINE = (
+    "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+    "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+)
+
+
+def bert_tok(sents):
+    width = max(len(s.split()) for s in sents)
+    ids = np.zeros((len(sents), width), np.int32)
+    for i, s in enumerate(sents):
+        for j, w in enumerate(s.split()):
+            ids[i, j] = (abs(hash(w)) % 97) + 1
+    return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray((ids > 0).astype(np.int32))}
+
+
+def bert_model(ids, mask):
+    d = 16
+    return jax.nn.one_hot(ids % d, d) + 0.1 * jax.nn.one_hot((ids // d) % d, d)
+
+
+# ------------------------------------------------------------------ FID
+
+
+class TestFidInGraph:
+    def test_ingraph_matches_host_eigh(self, monkeypatch):
+        stream = fid_stream()
+        fid_dev = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+        v_dev = run_fid(fid_dev, stream)
+        monkeypatch.setenv("TORCHMETRICS_TPU_FID_HOST_EIGH", "1")
+        fid_host = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+        v_host = run_fid(fid_host, stream)
+        assert abs(float(v_dev) - float(v_host)) < 1e-8
+
+    def test_host_eigh_knob_fail_loud(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_FID_HOST_EIGH", "sometimes")
+        fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+        for imgs, real in fid_stream(2):
+            fid.update(imgs, real)
+        with pytest.raises(TorchMetricsUserError, match="FID_HOST_EIGH"):
+            fid.compute()
+
+    def test_host_path_counted_and_recorded(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_FID_HOST_EIGH", "on")
+        reset_engine_stats()
+        with diag_context() as rec:
+            fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            run_fid(fid, fid_stream(2))
+        assert engine_report()["fid_host_eighs"] == 1
+        assert rec.count("heavy.fallback") == 1
+        evt = [e for e in rec.snapshot() if e.kind == "heavy.fallback"][0]
+        assert evt.data["label"] == "fid-host-eigh"
+
+    def test_bool_flag_matches_device_flag(self):
+        rng = np.random.RandomState(3)
+        imgs = jnp.asarray(rng.rand(10, 2, 4, 4).astype(np.float32))
+        a = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+        b = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+        for i in range(4):
+            a.update(imgs + 0.01 * i, real=(i % 2 == 0))
+            b.update(imgs + 0.01 * i, real=jnp.asarray(i % 2 == 0))
+        assert np.allclose(np.asarray(a.compute()), np.asarray(b.compute()), rtol=0, atol=0)
+
+    def test_engine_hot_loop_strict_and_bucketed(self):
+        stream = fid_stream(6, batch=12) + [
+            (jnp.asarray(np.random.RandomState(9).rand(7, 2, 4, 4).astype(np.float32)), jnp.asarray(True))
+        ]
+        with engine_context(True, donate=True):
+            eager_ref = FrechetInceptionDistance(
+                feature=toy_extractor, num_features=FEAT_DIM, compiled_update=False
+            )
+            v_ref = run_fid(eager_ref, stream)
+
+            fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            for imgs, real in stream[:2]:
+                fid.update(imgs, real)
+            jax.block_until_ready([fid.real_features_cov_sum])
+            reset_engine_stats()
+            with diag_context() as rec, transfer_guard("strict"):
+                before = engine_report()
+                for imgs, real in stream[2:]:
+                    fid.update(imgs, real)
+                jax.block_until_ready([fid.real_features_cov_sum])
+                after = engine_report()
+                value = fid.compute()  # cached in-graph compute: no host read
+            assert after["traces"] - before["traces"] <= 1  # the ragged 7-row bucket
+            assert after["eager_fallbacks"] == 0
+            assert rec.count("transfer.host", "transfer.blocked") == 0
+            assert after["bucketed_steps"] > 0
+        assert np.allclose(np.asarray(value), v_ref, rtol=1e-6, atol=1e-6)
+
+    def test_world2_packed_sync_covariance_parity(self, monkeypatch):
+        world = 2
+        monkeypatch.setattr(jax, "process_count", lambda: world)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+        )
+        stream = fid_stream(4)
+        with engine_context(True, donate=True):
+            eager = FrechetInceptionDistance(
+                feature=toy_extractor, num_features=FEAT_DIM,
+                compiled_update=False, distributed_available_fn=lambda: True,
+            )
+            v_eager = run_fid(eager, stream)
+            packed = FrechetInceptionDistance(
+                feature=toy_extractor, num_features=FEAT_DIM,
+                distributed_available_fn=lambda: True,
+            )
+            v_packed = run_fid(packed, stream)
+        assert np.allclose(v_eager, v_packed, rtol=1e-9, atol=1e-9)
+        assert engine_report()["packed_syncs"] >= 1
+
+    def test_sharded_fid_footprint_and_parity(self):
+        if jax.local_device_count() < 4:
+            pytest.skip("needs the conftest 8-virtual-device CPU world")
+        stream = fid_stream(4, batch=8, seed=5)
+        with engine_context(True, donate=True):
+            ref = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            v_ref = run_fid(ref, stream)
+        reset_engine_stats()
+        with engine_context(True, donate=True), sharding.mesh_context(4):
+            fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            assert sharding.is_sharded(fid.real_features_cov_sum)
+            assert sharding.is_sharded(fid.fake_features_cov_sum)
+            foot = fid.state_footprint()
+            # the (d, d) pair dominates: per-device bytes ~= 1/mesh + the
+            # replicated vectors/scalars
+            assert foot["per_device_bytes"] / foot["total_bytes"] < 0.5
+            v = run_fid(fid, stream)
+            assert engine_report()["shard_states"] >= 2
+        assert np.allclose(v, v_ref, rtol=1e-5, atol=1e-5)
+
+    def test_scan_queue_parity(self):
+        stream = fid_stream(8, batch=8, seed=11)
+        with engine_context(True, donate=True):
+            base = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            v_base = run_fid(base, stream)
+        with engine_context(True, donate=True), scan_context(8):
+            queued = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            v_queued = run_fid(queued, stream)
+        assert np.array_equal(v_base, v_queued)
+
+    def test_sample_guard_covers_world2_fused_path(self, monkeypatch):
+        """The distributed compute path must ALSO raise on <2 samples (the
+        fused sync→compute chain is declined so the guard sees synced counts)."""
+        world = 2
+        monkeypatch.setattr(jax, "process_count", lambda: world)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather", lambda x, tiled=False: np.stack([np.asarray(x)] * world)
+        )
+        with engine_context(True, donate=True):
+            fid = FrechetInceptionDistance(
+                feature=toy_extractor, num_features=FEAT_DIM,
+                distributed_available_fn=lambda: True,
+            )
+            imgs = jnp.asarray(np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32))
+            fid.update(imgs, jnp.asarray(True))  # 1 real sample, 0 fake — globally 2/0
+            with pytest.raises(RuntimeError, match="More than one sample"):
+                fid.compute()
+
+    def test_nonfinite_batch_cannot_poison_the_other_stream(self):
+        """One overflowing fake batch must leave the real stream's statistics
+        finite (where-selects, not 0*inf arithmetic masking)."""
+        blow_up = {"on": False}
+
+        def flaky_extractor(imgs):
+            feats = toy_extractor(imgs)
+            return feats + jnp.inf if blow_up["on"] else feats
+
+        rng = np.random.RandomState(4)
+        imgs = jnp.asarray(rng.rand(8, 2, 4, 4).astype(np.float32))
+        fid = FrechetInceptionDistance(feature=flaky_extractor, num_features=FEAT_DIM)
+        fid.update(imgs, real=jnp.asarray(True))
+        blow_up["on"] = True
+        fid.update(imgs, real=jnp.asarray(False))  # poisoned FAKE batch
+        assert np.isfinite(np.asarray(fid.real_features_sum)).all()
+        assert np.isfinite(np.asarray(fid.real_features_cov_sum)).all()
+        assert not np.isfinite(np.asarray(fid.fake_features_cov_sum)).all()
+
+    def test_sample_guard_covers_cached_compute_after_reset(self):
+        """A reset metric must RAISE on compute, not dispatch the cached graph
+        into 0/0 NaN — the guard lives in the host-side pre-dispatch hook."""
+        stream = fid_stream(4)
+        with engine_context(True, donate=True):
+            fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            run_fid(fid, stream)  # compiles + caches the compute executable
+            fid.reset()
+            with pytest.raises(RuntimeError, match="More than one sample"):
+                fid.compute()
+
+    def test_host_eigh_knob_flip_beats_cached_compute(self, monkeypatch):
+        """Flipping the knob ON mid-process (the documented tunneled-TPU
+        remediation) must route the NEXT compute to the counted host path,
+        not the already-cached in-graph executable."""
+        stream = fid_stream(4)
+        reset_engine_stats()
+        with engine_context(True, donate=True):
+            fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            v_cached = float(np.asarray(run_fid(fid, stream)))  # caches the graph
+            imgs, real = stream[-1]
+            fid.update(imgs, real)  # invalidates the computed-VALUE cache
+            monkeypatch.setenv("TORCHMETRICS_TPU_FID_HOST_EIGH", "1")
+            v_host = float(np.asarray(fid.compute()))
+        assert engine_report()["fid_host_eighs"] == 1
+        assert np.isfinite(v_host) and np.isfinite(v_cached)
+
+    def test_engine_only_dtype_survives_clone(self):
+        """The engine-observed extractor dtype mirrors onto the clone/pickle-
+        visible attribute at the first compute, so round-trips keep it."""
+        stream = fid_stream(4)
+        with engine_context(True, donate=True):
+            fid = FrechetInceptionDistance(feature=toy_extractor, num_features=FEAT_DIM)
+            run_fid(fid, stream)
+            assert fid.orig_dtype is not None  # mirrored in the compute hook
+            clone = fid.clone()
+            assert np.asarray(clone.compute()).dtype == np.asarray(fid.compute()).dtype
+
+    def test_engine_only_dtype_survives_precompute_pickle(self):
+        """A pickle/clone taken AFTER updates but BEFORE any compute must still
+        carry the extractor dtype: the traced update cannot write the attribute
+        and the id-keyed registry does not follow the copy — __getstate__
+        mirrors it into the serialized state."""
+        import pickle
+
+        stream = fid_stream(4)
+        with engine_context(True, donate=True):
+            fid = FrechetInceptionDistance(feature=f32_extractor, num_features=FEAT_DIM)
+            for imgs, real in stream:
+                fid.update(imgs, real)
+            # no compute yet: the attribute mirror has not run
+            restored = pickle.loads(pickle.dumps(fid))
+            clone = fid.clone()
+            v_orig = np.asarray(fid.compute())
+            assert v_orig.dtype == np.float32
+            assert np.asarray(restored.compute()).dtype == v_orig.dtype
+            assert np.asarray(clone.compute()).dtype == v_orig.dtype
+
+
+# ------------------------------------------------------------------ mAP
+
+
+class TestPackedMap:
+    def test_parity_vs_host_reference(self):
+        batches = map_batches()
+        host = MeanAveragePrecision(class_metrics=True)
+        packed = PackedMeanAveragePrecision(num_classes=N_CLS, score_bins=1024, class_metrics=True)
+        for preds, target in batches:
+            host.update(preds, target)
+            packed.update_batch(preds, target)
+        hv = {k: np.asarray(v) for k, v in host.compute().items()}
+        pv = {k: np.asarray(v) for k, v in packed.compute().items()}
+        for key in HEADLINE:
+            assert abs(float(hv[key]) - float(pv[key])) < 1e-6, key
+        # all classes present in this stream -> per-class arrays align 1:1
+        assert list(np.asarray(hv["classes"]).reshape(-1)) == list(range(N_CLS))
+        assert np.allclose(hv["map_per_class"], pv["map_per_class"], atol=1e-6)
+
+    def test_ragged_widths_reuse_executables_strict(self):
+        rng_batches = [map_batches(1, b=4, g=g, seed=20 + g)[0] for g in (5, 7, 6, 8, 5, 7)]
+        with engine_context(True, donate=True):
+            m = PackedMeanAveragePrecision(num_classes=N_CLS, score_bins=256)
+            packed = [pack_detections(p, t) for p, t in rng_batches]
+            for arrs in packed[:2]:
+                m.update(*arrs)
+            jax.block_until_ready([m.map_tp_hist])
+            reset_engine_stats()
+            with diag_context() as rec, transfer_guard("strict"):
+                before = engine_report()
+                for arrs in packed[2:]:
+                    m.update(*arrs)
+                jax.block_until_ready([m.map_tp_hist])
+                after = engine_report()
+                value = m.compute()
+            assert after["traces"] - before["traces"] == 0  # widths 5..8 share one bucket
+            assert after["eager_fallbacks"] == 0
+            assert rec.count("transfer.host", "transfer.blocked") == 0
+        assert np.isfinite(float(np.asarray(value["map"])))
+
+    def test_scan_queue_parity(self):
+        batches = map_batches(8, seed=31)
+        with engine_context(True, donate=True):
+            base = PackedMeanAveragePrecision(num_classes=N_CLS, score_bins=256)
+            for p, t in batches:
+                base.update_batch(p, t)
+            v_base = {k: np.asarray(v) for k, v in base.compute().items()}
+        with engine_context(True, donate=True), scan_context(4):
+            queued = PackedMeanAveragePrecision(num_classes=N_CLS, score_bins=256)
+            for p, t in batches:
+                queued.update_batch(p, t)
+            v_queued = {k: np.asarray(v) for k, v in queued.compute().items()}
+        for key in HEADLINE:
+            assert np.array_equal(v_base[key], v_queued[key]), key
+
+    def test_host_route_counted_and_boundary_sanctioned(self):
+        batches = map_batches(1)
+        reset_engine_stats()
+        host = MeanAveragePrecision()
+        for preds, target in batches:
+            host.update(preds, target)
+        with diag_context() as rec, transfer_guard("strict"):
+            host.compute()  # the epoch-end fetch rides map-host-matcher
+        assert engine_report()["map_host_evals"] == 1
+        assert rec.count("heavy.fallback") == 1
+        assert rec.count("transfer.blocked") == 0
+
+    def test_pack_rejects_out_of_range_scores(self):
+        preds, target = map_batches(1)[0]
+        bad = dict(preds, scores=np.asarray(preds["scores"]) + 5.0)  # raw logits
+        with pytest.raises(ValueError, match=r"scores must lie in \[0, 1\]"):
+            pack_detections(bad, target)
+
+    def test_pack_rejects_out_of_range_counts(self):
+        preds, target = map_batches(1)[0]
+        over = np.asarray(preds["num_boxes"]).copy()
+        over[0] = preds["labels"].shape[-1] + 1  # claims boxes past the slots
+        with pytest.raises(ValueError, match="num_boxes out of range"):
+            pack_detections(dict(preds, num_boxes=over), target)
+        neg = np.asarray(target["num_boxes"]).copy()
+        neg[0] = -1
+        with pytest.raises(ValueError, match="num_boxes out of range"):
+            pack_detections(preds, dict(target, num_boxes=neg))
+
+    def test_pack_validation(self):
+        with pytest.raises(ValueError, match="missing keys"):
+            pack_detections({"boxes": np.zeros((1, 2, 4))}, {"boxes": np.zeros((1, 2, 4))})
+        with pytest.raises(ValueError, match="share the batch"):
+            pack_detections(
+                {"boxes": np.zeros((2, 2, 4)), "scores": np.zeros((2, 2)),
+                 "labels": np.zeros((2, 2)), "num_boxes": np.ones(2, int)},
+                {"boxes": np.zeros((1, 2, 4)), "labels": np.zeros((1, 2)), "num_boxes": np.ones(1, int)},
+            )
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="num_classes"):
+            PackedMeanAveragePrecision(num_classes=0)
+        with pytest.raises(ValueError, match="score_bins"):
+            PackedMeanAveragePrecision(num_classes=2, score_bins=1)
+
+
+# ------------------------------------------------------------------ BERTScore
+
+
+class TestBertBuckets:
+    def test_idf_table_matches_dict_lookup(self):
+        tok = bert_tok(["a b c a", "b d e", "f"])
+        idf = _compute_idf([tok["input_ids"]], [tok["attention_mask"]])
+        table = _idf_table(idf)
+        ids = np.asarray(tok["input_ids"])
+        got = np.asarray(_idf_weights(tok["input_ids"], tok["attention_mask"], table))
+        want = np.vectorize(lambda t: idf.get(int(t), 0.0))(ids).astype(np.float32) * np.asarray(
+            tok["attention_mask"]
+        )
+        assert np.allclose(got, want, atol=1e-7)
+
+    def test_bucketed_matches_unbucketed(self, monkeypatch):
+        preds = ["hello world out there", "a b c", "one two"]
+        target = ["hello there world", "a b", "one two three four"]
+        kwargs = dict(model=bert_model, user_tokenizer=bert_tok, idf=True)
+        bucketed = bert_score(preds, target, **kwargs)
+        monkeypatch.setenv("TORCHMETRICS_TPU_BERT_BUCKETS", "0")
+        exact = bert_score(preds, target, **kwargs)
+        for key in ("precision", "recall", "f1"):
+            assert np.allclose(np.asarray(bucketed[key]), np.asarray(exact[key]), atol=1e-6), key
+        assert np.asarray(bucketed["f1"]).shape == (3,)
+
+    def test_ragged_stream_retrace_bound(self):
+        words = ["w%d" % i for i in range(12)]
+        before = bert_scoring_cache_size()
+        # pair counts 2..7 and widths 2..7 all land in the (8, 8) bucket
+        for n in (2, 3, 5, 7):
+            preds = [" ".join(words[: 2 + (n % 5)]) for _ in range(n)]
+            target = [" ".join(words[1: 3 + (n % 5)]) for _ in range(n)]
+            bert_score(preds, target, model=bert_model, user_tokenizer=bert_tok, idf=False)
+        assert bert_scoring_cache_size() - before <= 1
+
+    def test_buckets_knob_fail_loud(self, monkeypatch):
+        monkeypatch.setenv("TORCHMETRICS_TPU_BERT_BUCKETS", "maybe")
+        with pytest.raises(TorchMetricsUserError, match="BERT_BUCKETS"):
+            bert_score(["a"], ["a"], model=bert_model, user_tokenizer=bert_tok)
+
+    def test_idf_weights_stay_on_device_in_score_path(self):
+        tok = bert_tok(["a b c", "d e f g"])
+        idf = _compute_idf([tok["input_ids"]], [tok["attention_mask"]])
+        table = _idf_table(idf)
+        with transfer_guard("strict"):
+            w = _idf_weights(tok["input_ids"], tok["attention_mask"], table)
+        assert w.shape == tok["input_ids"].shape

@@ -3,6 +3,7 @@
 from torchmetrics_tpu.detection.ciou import CompleteIntersectionOverUnion
 from torchmetrics_tpu.detection.diou import DistanceIntersectionOverUnion
 from torchmetrics_tpu.detection.giou import GeneralizedIntersectionOverUnion
+from torchmetrics_tpu.detection.ingraph import PackedMeanAveragePrecision
 from torchmetrics_tpu.detection.iou import IntersectionOverUnion
 from torchmetrics_tpu.detection.mean_ap import MeanAveragePrecision
 from torchmetrics_tpu.detection.panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
@@ -14,5 +15,6 @@ __all__ = [
     "IntersectionOverUnion",
     "MeanAveragePrecision",
     "ModifiedPanopticQuality",
+    "PackedMeanAveragePrecision",
     "PanopticQuality",
 ]

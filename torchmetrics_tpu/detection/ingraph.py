@@ -1,0 +1,503 @@
+"""In-graph packed-route mean-average-precision — the mAP hot path on device.
+
+:class:`~torchmetrics_tpu.detection.mean_ap.MeanAveragePrecision` evaluates with
+COCOeval semantics but runs its greedy best-GT matching and PR accumulation on
+host numpy over ragged per-image lists — exactly the expensive part of a
+detection eval epoch. This module lowers the *packed-array* update route (the
+padded ``(B, M, ...)`` layout a batched NMS produces on device) to a single XLA
+graph per step:
+
+- **Padded per-image IoU**: one broadcasted ``(D, G)`` pairwise IoU per image,
+  vmapped over the batch, label-masked so every class evaluates in the same
+  pass.
+- **Greedy assignment in-graph**: detections walk in score order under
+  ``lax.fori_loop``; each step picks the best still-unmatched, non-ignored GT
+  by masked argmax, vectorized over every IoU threshold × area range at once.
+  Matching semantics are pinned to the host reference
+  (``native/rle_mask.py::coco_match``): strict ``IoU > thr``, non-ignored GTs
+  only, first-index tie-breaks.
+- **Score-sorted PR accumulation as device histogram states**: instead of
+  buffering per-image arrays for an epoch-end host sort, every detection folds
+  its TP/FP verdict into fixed-shape per-``(class, threshold, area, maxdet)``
+  score histograms (``score_bins`` bins over [0, 1]). ``compute()`` rebuilds
+  the PR curves from the reversed-cumsum histograms fully in-graph — exact
+  whenever distinct scores land in distinct bins, tolerance-bounded otherwise.
+
+The states are plain sum-folded fixed-shape arrays, so the metric rides the
+whole engine stack like a counter metric: donated compiled steps, power-of-two
+batch buckets (``_engine_row_additive`` — a zero-count pad image contributes
+nothing), the K-step scan queue, async drains, and ``class_axis`` sharding of
+the leading class dim. The list/RLE route stays on
+:class:`MeanAveragePrecision` (the retained host matcher, counted and
+boundary-sanctioned); parity between the two is pinned by
+``tests/test_heavy.py``.
+
+Known deltas vs the host route, by construction: ``classes`` reports the full
+configured ``[0, num_classes)`` range (presence is a data-dependent shape, and
+absent classes are ``-1``-masked out of every mean exactly like the host
+path), and per-class arrays are length ``num_classes``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.engine import bucketing
+from torchmetrics_tpu.functional.detection.helpers import _box_iou
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+# f64 under x64 (matches the host evaluator's float64 ingestion); f32 on TPU
+_F64 = jnp.result_type(jnp.float32, jnp.float64)
+
+#: host-reference epsilon in the precision denominator (``mean_ap.py:667``)
+_PR_EPS = float(np.finfo(np.float64).eps)
+
+
+class _MapParams(NamedTuple):
+    """Static evaluation grid — hashable, closed over by the traced update."""
+
+    num_classes: int
+    iou_thresholds: Tuple[float, ...]
+    rec_thresholds: Tuple[float, ...]
+    max_dets: Tuple[int, ...]
+    area_ranges: Tuple[Tuple[float, float], ...]
+    score_bins: int
+
+
+def _image_eval(p: Array, n_p: Array, t: Array, n_t: Array, params: _MapParams):
+    """Match ONE padded image; return per-det verdicts + per-class GT counts.
+
+    Mirrors ``coco_match``'s numpy fallback exactly: detections in stable
+    score-descending order, masked argmax over valid same-class GTs that are
+    neither matched nor area-ignored, strict ``IoU > thr``.
+    """
+    C = params.num_classes
+    thr = jnp.asarray(params.iou_thresholds, dtype=_F64)          # (T,)
+    areas = np.asarray(params.area_ranges, dtype=np.float64)      # (A, 2) static
+    lo = jnp.asarray(areas[:, 0], dtype=_F64)
+    hi = jnp.asarray(areas[:, 1], dtype=_F64)
+    maxdets = np.asarray(params.max_dets)                         # (Md,) static
+    T, A, Md = thr.shape[0], areas.shape[0], maxdets.shape[0]
+    M, G = p.shape[0], t.shape[0]
+
+    boxes_d = p[:, :4].astype(_F64)
+    scores = p[:, 4]
+    labels_d = p[:, 5].astype(jnp.int32)
+    boxes_g = t[:, :4].astype(_F64)
+    labels_g = t[:, 4].astype(jnp.int32)
+
+    vd = (jnp.arange(M) < n_p) & (labels_d >= 0) & (labels_d < C)
+    vg = (jnp.arange(G) < n_t) & (labels_g >= 0) & (labels_g < C)
+
+    area_d = (boxes_d[:, 2] - boxes_d[:, 0]) * (boxes_d[:, 3] - boxes_d[:, 1])
+    area_g = (boxes_g[:, 2] - boxes_g[:, 0]) * (boxes_g[:, 3] - boxes_g[:, 1])
+    gt_ignore = (area_g[None, :] < lo[:, None]) | (area_g[None, :] > hi[:, None])  # (A, G)
+    det_oor = (area_d[None, :] < lo[:, None]) | (area_d[None, :] > hi[:, None])    # (A, M)
+
+    # per-class score rank (stable desc, original row order breaking ties) —
+    # the per-(image, class) top-max_det truncation of the host route
+    better = (scores[None, :] > scores[:, None]) | (
+        (scores[None, :] == scores[:, None]) & (jnp.arange(M)[None, :] < jnp.arange(M)[:, None])
+    )
+    same_cls = labels_d[None, :] == labels_d[:, None]
+    rank = jnp.sum(better & same_cls & vd[None, :], axis=1)
+    participate = vd & (rank < int(maxdets[-1]))
+
+    if G == 0 or M == 0:
+        det_match = jnp.zeros((M, T, A), bool)
+    else:
+        # the SHARED jnp pairwise-IoU kernel (zero-union pairs define IoU as 0
+        # — the same rule the host fallback's _safe_iou pins)
+        iou = _box_iou(boxes_d, boxes_g)
+        pair_ok = vd[:, None] & vg[None, :] & (labels_d[:, None] == labels_g[None, :])
+        iou = jnp.where(pair_ok, iou, 0.0)
+        order = jnp.argsort(-scores)  # stable: equal scores keep row order
+
+        def body(k, carry):
+            matched, det_match = carry
+            d = order[k]
+            allowed = (~matched) & (~gt_ignore[None, :, :]) & vg[None, None, :]  # (T, A, G)
+            masked = jnp.where(allowed, iou[d][None, None, :], 0.0)
+            g_best = jnp.argmax(masked, axis=-1)                                 # (T, A)
+            v_best = jnp.take_along_axis(masked, g_best[..., None], axis=-1)[..., 0]
+            hit = participate[d] & (v_best > thr[:, None])                       # (T, A)
+            onehot = jax.nn.one_hot(g_best, G, dtype=bool)                       # (T, A, G)
+            matched = matched | (onehot & hit[..., None])
+            det_match = det_match.at[d].set(hit)
+            return matched, det_match
+
+        _, det_match = jax.lax.fori_loop(
+            0, M, body, (jnp.zeros((T, A, G), bool), jnp.zeros((M, T, A), bool))
+        )
+
+    det_ign = (~det_match) & jnp.transpose(det_oor)[:, None, :]  # (M, T, A)
+
+    incl = participate[:, None] & (rank[:, None] < jnp.asarray(maxdets)[None, :])  # (M, Md)
+    tp = det_match & ~det_ign          # matched dets are never ignored — kept for clarity
+    fp = (~det_match) & ~det_ign
+    nb = params.score_bins
+    bins = jnp.clip((scores * nb).astype(jnp.int32), 0, nb - 1)
+
+    onehot_g = jax.nn.one_hot(labels_g, C, dtype=_F64) * vg[:, None].astype(_F64)  # (G, C)
+    n_pos = ((~gt_ignore).astype(_F64) @ onehot_g).T                               # (C, A)
+    return tp, fp, incl, bins, labels_d, n_pos
+
+
+def packed_contributions(
+    packed_preds: Array,
+    pred_counts: Array,
+    packed_targets: Array,
+    target_counts: Array,
+    params: _MapParams,
+) -> Tuple[Array, Array, Array]:
+    """Fold one padded batch into ``(tp_hist, fp_hist, n_pos)`` deltas.
+
+    Pure and additive over the batch dim (each image contributes
+    independently), so the engine's pad-subtract bucketing identity holds:
+    a zero-count pad image contributes exactly zero to every state.
+    """
+    C, nb = params.num_classes, params.score_bins
+    T = len(params.iou_thresholds)
+    A = len(params.area_ranges)
+    Md = len(params.max_dets)
+
+    tp, fp, incl, bins, cls, n_pos = jax.vmap(
+        lambda p, np_, t, nt: _image_eval(p, np_, t, nt, params)
+    )(packed_preds, pred_counts, packed_targets, target_counts)
+
+    # flatten every (image, det, threshold, area, maxdet) verdict into one
+    # scatter-add over the flat histogram — invalid dets carry value 0
+    val_tp = (tp[:, :, :, :, None] & incl[:, :, None, None, :]).astype(jnp.float32)  # (B,M,T,A,Md)
+    val_fp = (fp[:, :, :, :, None] & incl[:, :, None, None, :]).astype(jnp.float32)
+    c = jnp.clip(cls, 0, C - 1)[:, :, None, None, None]
+    ti = jnp.arange(T)[None, None, :, None, None]
+    ai = jnp.arange(A)[None, None, None, :, None]
+    mi = jnp.arange(Md)[None, None, None, None, :]
+    b = bins[:, :, None, None, None]
+    idx = (((c * T + ti) * A + ai) * Md + mi) * nb + b
+    flat = C * T * A * Md * nb
+    tp_hist = jnp.zeros(flat, jnp.float32).at[idx.reshape(-1)].add(val_tp.reshape(-1))
+    fp_hist = jnp.zeros(flat, jnp.float32).at[idx.reshape(-1)].add(val_fp.reshape(-1))
+    shape = (C, T, A, Md, nb)
+    return (
+        tp_hist.reshape(shape),
+        fp_hist.reshape(shape),
+        n_pos.sum(axis=0).astype(jnp.float32),
+    )
+
+
+def _masked_mean(x: Array) -> Array:
+    """Mean over cells > -1, or -1 when none are (the host ``_summarize`` rule)."""
+    valid = x > -1
+    count = valid.sum()
+    total = jnp.where(valid, x, 0.0).sum()
+    return jnp.where(count > 0, total / jnp.maximum(count, 1), -1.0).astype(jnp.float32)
+
+
+def compute_from_hists(
+    tp_hist: Array, fp_hist: Array, n_pos: Array, params: _MapParams
+) -> Dict[str, Array]:
+    """COCO headline dict from the device histogram states — one traceable graph.
+
+    The reversed-bin cumsum IS the score-descending TP/FP accumulation of the
+    host ``_accumulate``; the monotone envelope and the recall-threshold
+    interpolation follow the same pinned rules (``searchsorted`` left,
+    precision 0 past the achieved recall, cells -1 where ``n_pos`` is 0).
+    """
+    C, nb = params.num_classes, params.score_bins
+    Md = len(params.max_dets)
+    rec_t = jnp.asarray(params.rec_thresholds, dtype=_F64)
+
+    tp_cum = jnp.cumsum(tp_hist[..., ::-1].astype(_F64), axis=-1)   # (C,T,A,Md,NB)
+    fp_cum = jnp.cumsum(fp_hist[..., ::-1].astype(_F64), axis=-1)
+    npig = n_pos.astype(_F64)[:, None, :, None]                     # (C,1,A,1)
+    cell_ok = npig > 0
+    rc = tp_cum / jnp.maximum(npig[..., None], 1.0)
+    pr = tp_cum / (tp_cum + fp_cum + _PR_EPS)
+    # monotone envelope (suffix running max — the host path's
+    # ``np.maximum.accumulate(pr[::-1])[::-1]``)
+    pr_env = jax.lax.cummax(pr, axis=pr.ndim - 1, reverse=True)
+
+    # per-cell searchsorted (left) at the recall thresholds — vmapped over the
+    # flattened cells so no (cells × R × NB) comparison tensor materializes
+    idx = jax.vmap(lambda r: jnp.searchsorted(r, rec_t, side="left"))(
+        rc.reshape(-1, nb)
+    ).reshape(rc.shape[:-1] + (rec_t.shape[0],))                    # (C,T,A,Md,R)
+    prec_at = jnp.where(
+        idx < nb,
+        jnp.take_along_axis(pr_env, jnp.clip(idx, 0, nb - 1), axis=-1),
+        0.0,
+    )
+    precision = jnp.where(cell_ok[..., None], prec_at, -1.0)        # (C,T,A,Md,R)
+    recall = jnp.where(cell_ok, tp_cum[..., -1] / jnp.maximum(npig, 1.0), -1.0)  # (C,T,A,Md)
+
+    last = Md - 1
+    iou_list = list(params.iou_thresholds)
+    out: Dict[str, Array] = {
+        "map": _masked_mean(precision[:, :, 0, last, :]),
+        "map_small": _masked_mean(precision[:, :, 1, last, :]),
+        "map_medium": _masked_mean(precision[:, :, 2, last, :]),
+        "map_large": _masked_mean(precision[:, :, 3, last, :]),
+    }
+    for key, value in (("map_50", 0.5), ("map_75", 0.75)):
+        out[key] = (
+            _masked_mean(precision[:, iou_list.index(value), 0, last, :])
+            if value in iou_list
+            else jnp.asarray(-1.0, jnp.float32)
+        )
+    for mi, max_det in enumerate(params.max_dets):
+        out[f"mar_{max_det}"] = _masked_mean(recall[:, :, 0, mi])
+    out["mar_small"] = _masked_mean(recall[:, :, 1, last])
+    out["mar_medium"] = _masked_mean(recall[:, :, 2, last])
+    out["mar_large"] = _masked_mean(recall[:, :, 3, last])
+    out["map_per_class"] = jax.vmap(_masked_mean)(precision[:, :, 0, last, :])
+    out[f"mar_{params.max_dets[-1]}_per_class"] = jax.vmap(_masked_mean)(recall[:, :, 0, last])
+    out["classes"] = jnp.arange(C, dtype=jnp.int32)
+    return out
+
+
+class PackedMeanAveragePrecision(Metric):
+    """mAP/mAR over padded detection batches, evaluated entirely in-graph.
+
+    The engine-native sibling of :class:`~torchmetrics_tpu.detection.mean_ap.
+    MeanAveragePrecision` for the packed-array route: ``update`` takes the
+    padded device layout directly and folds greedy matching + PR accumulation
+    into fixed-shape histogram states in ONE compiled donated dispatch;
+    ``compute`` rebuilds the COCO headline numbers from the histograms in one
+    cached graph. Requires ``num_classes`` up front (fixed state shapes) and
+    scores in ``[0, 1]``.
+
+    Args:
+        num_classes: class-id range ``[0, num_classes)``; out-of-range labels
+            are treated as padding.
+        box_format: input box convention (converted in-graph when not xyxy).
+        iou_thresholds / rec_thresholds / max_detection_thresholds /
+        class_metrics: as in :class:`MeanAveragePrecision`.
+        score_bins: PR histogram resolution over [0, 1]; the curve is exact
+            when distinct scores land in distinct bins.
+
+    Use :meth:`update_batch` with the dict schema of the host packed route to
+    get power-of-two padding of the detection-slot dims (stable compile
+    signatures across ragged batches); the batch dim rides the engine's
+    standard shape buckets.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    # additive over batch images with all-sum states: bucketing + scan + async
+    # compose like any counter metric (a count-0 pad image contributes zero)
+    _engine_row_additive: bool = True
+    # the class dim leads every state: a large-vocabulary detector's PR
+    # histograms shard over the state mesh like any per-class counter
+    _engine_shard_rules = {
+        "map_tp_hist": "class_axis",
+        "map_fp_hist": "class_axis",
+        "map_n_pos": "class_axis",
+    }
+
+    def __init__(
+        self,
+        num_classes: int,
+        box_format: str = "xyxy",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        score_bins: int = 1024,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError(f"Expected `num_classes` to be a positive int, got {num_classes!r}")
+        if box_format not in ("xyxy", "xywh", "cxcywh"):
+            raise ValueError(f"Expected `box_format` to be one of ('xyxy', 'xywh', 'cxcywh'), got {box_format}")
+        if not isinstance(score_bins, int) or score_bins < 2:
+            raise ValueError(f"Expected `score_bins` to be an int >= 2, got {score_bins!r}")
+        self.box_format = box_format
+        self.class_metrics = bool(class_metrics)
+        iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+        rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
+        max_dets = sorted(max_detection_thresholds or [1, 10, 100])
+        # the host route's bbox_area_ranges, in the same order
+        area_ranges = (
+            (float(0**2), float(1e5**2)),
+            (float(0**2), float(32**2)),
+            (float(32**2), float(96**2)),
+            (float(96**2), float(1e5**2)),
+        )
+        self._params = _MapParams(
+            num_classes=num_classes,
+            iou_thresholds=tuple(float(x) for x in iou_thresholds),
+            rec_thresholds=tuple(float(x) for x in rec_thresholds),
+            max_dets=tuple(int(x) for x in max_dets),
+            area_ranges=area_ranges,
+            score_bins=score_bins,
+        )
+        C, T, A, Md = num_classes, len(iou_thresholds), len(area_ranges), len(max_dets)
+        hist = (C, T, A, Md, score_bins)
+        self.add_state("map_tp_hist", jnp.zeros(hist, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("map_fp_hist", jnp.zeros(hist, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("map_n_pos", jnp.zeros((C, A), jnp.float32), dist_reduce_fx="sum")
+
+    # ------------------------------------------------------------------ update
+
+    def update(
+        self,
+        packed_preds: Array,
+        pred_counts: Array,
+        packed_targets: Array,
+        target_counts: Array,
+    ) -> None:
+        """Fold one padded batch: ``(B, M, 6)`` preds / ``(B, G, 5)`` targets.
+
+        Channel layout matches the host packed route: preds are
+        ``[x1, y1, x2, y2, score, label]``, targets ``[x1, y1, x2, y2, label]``,
+        with ``counts`` marking the valid prefix of each image's slots.
+        Everything here is traceable jnp — the engine compiles it into one
+        donated executable per (bucketed) shape signature.
+        """
+        pp = jnp.asarray(packed_preds, jnp.float32)
+        tt = jnp.asarray(packed_targets, jnp.float32)
+        if self.box_format != "xyxy":
+            from torchmetrics_tpu.functional.detection.helpers import _box_convert
+
+            b, m = pp.shape[:2]
+            boxes_p = _box_convert(pp[..., :4].reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy")
+            pp = jnp.concatenate([boxes_p.reshape(b, m, 4), pp[..., 4:]], axis=-1)
+            bt, g = tt.shape[:2]
+            boxes_t = _box_convert(tt[..., :4].reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy")
+            tt = jnp.concatenate([boxes_t.reshape(bt, g, 4), tt[..., 4:]], axis=-1)
+        tp, fp, n_pos = packed_contributions(
+            pp,
+            jnp.asarray(pred_counts, jnp.int32),
+            tt,
+            jnp.asarray(target_counts, jnp.int32),
+            self._params,
+        )
+        self.map_tp_hist = self.map_tp_hist + tp
+        self.map_fp_hist = self.map_fp_hist + fp
+        self.map_n_pos = self.map_n_pos + n_pos
+
+    def update_batch(self, preds: Dict[str, Any], target: Dict[str, Any]) -> None:
+        """Dict-schema convenience: pack, width-bucket, then ``update``.
+
+        Accepts the host packed route's schema (``boxes``/``scores``/``labels``/
+        ``num_boxes``) and pads the detection-slot dims up to the next
+        power-of-two bucket so ragged per-batch widths reuse O(log M) compile
+        signatures instead of one per distinct width.
+        """
+        pp, pc, tt, tc = pack_detections(preds, target)
+        self.update(pp, pc, tt, tc)
+
+    # ------------------------------------------------------------------ compute
+
+    def compute(self) -> Dict[str, Array]:
+        """COCO headline dict from the histogram states (one cached graph)."""
+        out = compute_from_hists(
+            self.map_tp_hist, self.map_fp_hist, self.map_n_pos, self._params
+        )
+        if not self.class_metrics:
+            out["map_per_class"] = jnp.asarray(-1.0, jnp.float32)
+            out[f"mar_{self._params.max_dets[-1]}_per_class"] = jnp.asarray(-1.0, jnp.float32)
+        return out
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        return self._plot(val, ax)
+
+
+def pack_detections(
+    preds: Dict[str, Any], target: Dict[str, Any], min_bucket: int = 8
+) -> Tuple[Array, Array, Array, Array]:
+    """Pack the dict schema into padded arrays with power-of-two slot widths.
+
+    Validation mirrors the host route for host-side inputs (the f32 label
+    exactness bound); added pad slots carry label ``-1`` so they can never
+    alias class 0, and counts never cover them.
+    """
+    from torchmetrics_tpu.detection.mean_ap import _check_packed_label_bound
+
+    for name, d, keys in (
+        ("preds", preds, ("boxes", "scores", "labels", "num_boxes")),
+        ("target", target, ("boxes", "labels", "num_boxes")),
+    ):
+        missing = [k for k in keys if k not in d]
+        if missing:
+            raise ValueError(f"Packed `{name}` dict is missing keys {missing}")
+        lbl, cnt = d["labels"], d["num_boxes"]
+        if isinstance(lbl, (np.ndarray, list, tuple)) and isinstance(cnt, (np.ndarray, list, tuple, int)):
+            lbl_np = np.asarray(lbl)
+            if lbl_np.ndim >= 2:
+                # count range FIRST (same ordering as _validate_packed_batch): an
+                # out-of-range count would make the label bound check — and the
+                # valid-slot masks downstream — misread padding as real boxes
+                cnt_np = np.asarray(cnt)
+                if (cnt_np < 0).any() or (cnt_np > lbl_np.shape[-1]).any():
+                    raise ValueError(
+                        f"Packed `{name}` num_boxes out of range: counts must lie in"
+                        f" [0, slot width] ({lbl_np.shape[-1]}) — a count past the"
+                        " padding would silently count pad slots as real boxes"
+                    )
+                _check_packed_label_bound(name, lbl_np, cnt_np)
+
+    # the PR histograms bin scores over [0, 1]: raw logits would silently
+    # collapse into the extreme bins and degenerate the curve — host-side
+    # inputs are checked here, device arrays carry the documented contract
+    scores = preds["scores"]
+    if isinstance(scores, (np.ndarray, list, tuple)) and isinstance(
+        preds["num_boxes"], (np.ndarray, list, tuple, int)
+    ):
+        s = np.asarray(scores, dtype=np.float64)
+        if s.ndim == 2:
+            # slots past each image's count are padding and never read back
+            valid = np.arange(s.shape[-1]) < np.asarray(preds["num_boxes"]).reshape(-1, 1)
+            checked = s[valid]
+            if checked.size and (float(checked.min()) < 0.0 or float(checked.max()) > 1.0):
+                raise ValueError(
+                    f"Packed scores must lie in [0, 1] (got"
+                    f" [{float(checked.min())}, {float(checked.max())}]): the PR"
+                    " histograms bin over the unit interval — apply a sigmoid/"
+                    "normalization before packing"
+                )
+
+    p_boxes = jnp.asarray(preds["boxes"], jnp.float32)
+    t_boxes = jnp.asarray(target["boxes"], jnp.float32)
+    if p_boxes.ndim != 3 or p_boxes.shape[-1] != 4 or t_boxes.ndim != 3 or t_boxes.shape[-1] != 4:
+        raise ValueError(f"Packed boxes must be (B, M, 4), got {p_boxes.shape} and {t_boxes.shape}")
+    if p_boxes.shape[0] != t_boxes.shape[0]:
+        raise ValueError("Packed preds and target must share the batch dimension")
+    pp = jnp.concatenate(
+        [
+            p_boxes,
+            jnp.asarray(preds["scores"], jnp.float32)[..., None],
+            jnp.asarray(preds["labels"], jnp.float32)[..., None],
+        ],
+        axis=-1,
+    )
+    tt = jnp.concatenate([t_boxes, jnp.asarray(target["labels"], jnp.float32)[..., None]], axis=-1)
+
+    def widen(arr: Array) -> Array:
+        m = arr.shape[1]
+        b = bucketing.next_bucket(max(m, 1), min_bucket)
+        if b == m:
+            return arr
+        pad = jnp.full((arr.shape[0], b - m, arr.shape[2]), 0.0, arr.dtype)
+        # pad slots get label -1 (never a valid class) in the last channel
+        pad = pad.at[..., -1].set(-1.0)
+        return jnp.concatenate([arr, pad], axis=1)
+
+    return (
+        widen(pp),
+        jnp.asarray(preds["num_boxes"], jnp.int32),
+        widen(tt),
+        jnp.asarray(target["num_boxes"], jnp.int32),
+    )

@@ -82,6 +82,9 @@ UNITLESS_COUNT_FAMILIES = frozenset({
     # state-spec registry (engine/statespec.py, PR 11): deprecated-convention
     # role resolutions — a pure migration count, no physical unit
     "tm_tpu_spec_fallbacks",
+    # heavy-workload kernels (image/fid.py, detection/mean_ap.py, PR 15):
+    # retained host-path engagements — pure counts, no physical unit
+    "tm_tpu_fid_host_eighs", "tm_tpu_map_host_evals",
     # SPMD sharded-state engine (parallel/sharding.py, PR 12): placement /
     # in-graph-sync event counts — pure counts, no physical unit
     "tm_tpu_shard_states", "tm_tpu_psum_syncs", "tm_tpu_gather_skipped",
@@ -139,6 +142,8 @@ _COUNTER_HELP = {
     "compute_cache_hits": "compute dispatches served without a re-trace",
     "profile_probes": "warm dispatches followed by a sampled completion probe",
     "spec_fallbacks": "state roles resolved via the deprecated string-prefix/attribute conventions",
+    "fid_host_eighs": "FID Frechet computes routed to the retained host-eigh fallback",
+    "map_host_evals": "mAP computes evaluated by the retained host matcher",
     "shard_states": "states placed distributed via a resolved shard rule",
     "psum_syncs": "additive sharded states whose sync lowered to in-graph psum",
     "gather_skipped": "sharded states the packed host gather skipped",

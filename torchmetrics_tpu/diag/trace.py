@@ -130,6 +130,9 @@ EVENT_KINDS = frozenset({
     "shard.place", "shard.fallback", "shard.reshard",
     # state-spec registry (engine/statespec.py)
     "spec.fallback",
+    # heavy-workload kernels (image/fid.py, detection/mean_ap.py): a retained
+    # host path engaged — the knob-selected FID host eigh or the host matcher
+    "heavy.fallback",
     # serving layer (serve/)
     "serve.scrape", "serve.scrape.async", "serve.scrape.error", "serve.sidecar.start",
     "serve.snapshot", "serve.snapshot.read",

@@ -86,6 +86,10 @@ TRANSFER_LABELS = frozenset({
     # serving boundaries (serve/)
     "serve-setup",     # one-time np capture of nested-metric defaults (PR 9)
     "serve-scrape",    # scrape-path host reads with the snapshot retry protocol
+    # heavy-workload retained host paths (PR 15) — counted fallbacks, declared
+    "fid-host-eigh",   # FID Fréchet on host LAPACK (TORCHMETRICS_TPU_FID_HOST_EIGH)
+    "fid-sample-guard",  # FID's epoch-boundary <2-sample check (two scalar reads)
+    "map-host-matcher",  # mAP list/RLE host evaluator's one batched epoch-end fetch
 })
 
 #: label PREFIXES sanctioned with a dynamic suffix: the collective backbone

@@ -63,6 +63,9 @@ KNOB_REGISTRY = {
     "TORCHMETRICS_TPU_SERVE_CAPACITY": "torchmetrics_tpu.serve.stats:_env_int",
     "TORCHMETRICS_TPU_SERVE_PORT": "torchmetrics_tpu.serve.stats:_env_int",
     "TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES": "torchmetrics_tpu.serve.stats:_env_int",
+    # heavy-workload kernels (PR 15): FID host-eigh fallback + BERTScore buckets
+    "TORCHMETRICS_TPU_FID_HOST_EIGH": "torchmetrics_tpu.image.fid:fid_host_eigh",
+    "TORCHMETRICS_TPU_BERT_BUCKETS": "torchmetrics_tpu.functional.text.bert:bert_buckets_enabled",
 }
 
 #: parsers that read the env key through a ``name`` PARAMETER (shared

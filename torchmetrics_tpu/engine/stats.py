@@ -69,6 +69,9 @@ _COUNTER_FIELDS = (
     "profile_probes",  # warm dispatches followed by a sanctioned block_until_ready probe
     # --- state-spec registry (engine/statespec.py): deprecation telemetry ---
     "spec_fallbacks",  # roles resolved via the deprecated string-prefix/attribute conventions
+    # --- heavy-workload kernels (image/fid.py, detection/mean_ap.py): retained host paths ---
+    "fid_host_eighs",  # FID Fréchet computes routed to host LAPACK via TORCHMETRICS_TPU_FID_HOST_EIGH
+    "map_host_evals",  # mAP computes evaluated by the retained host matcher (list/RLE route)
     # --- SPMD sharded-state engine (parallel/sharding.py): mesh placement ---
     "shard_states",  # states placed distributed via a resolved shard rule (born or re-placed)
     "psum_syncs",  # additive sharded states whose sync lowered to in-graph psum (gather skipped)

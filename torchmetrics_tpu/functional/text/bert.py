@@ -12,6 +12,7 @@ not cached — reference ``text/bert.py:192-195``), or inject ``user_tokenizer``
 
 from __future__ import annotations
 
+import os
 from collections import Counter
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -19,6 +20,31 @@ import jax
 import jax.numpy as jnp
 
 Array = jax.Array
+
+_BERT_BUCKETS_ENV = "TORCHMETRICS_TPU_BERT_BUCKETS"
+
+
+def bert_buckets_enabled() -> bool:
+    """Whether BERTScore stages ragged batches through power-of-two shape buckets.
+
+    On (the default), every tokenized batch pads its pair count and token
+    widths up to the engine's power-of-two buckets BEFORE the model forward and
+    the jitted greedy-cosine core, so a ragged eval stream compiles O(log N ·
+    log L) score graphs instead of one per distinct shape — and the IDF
+    weighting stays a device-side table gather (zero host touches in the score
+    path). ``TORCHMETRICS_TPU_BERT_BUCKETS=0|off`` restores exact-shape
+    staging; unrecognized values fail loud (the PR-7 env contract).
+    """
+    raw = os.environ.get(_BERT_BUCKETS_ENV, "").strip().lower()
+    if raw in ("", "1", "on"):
+        return True
+    if raw in ("0", "off"):
+        return False
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    raise TorchMetricsUserError(
+        f"{_BERT_BUCKETS_ENV} must be unset/'1'/'on' or '0'/'off' (got {raw!r})"
+    )
 
 
 def _validate_model_inputs(model: Any, user_tokenizer: Any) -> None:
@@ -51,16 +77,44 @@ def _compute_idf(token_batches: List[Array], mask_batches: List[Array]) -> Dict[
     return {tok: math.log((num_docs + 1) / (cnt + 1)) for tok, cnt in doc_counts.items()}
 
 
-def _idf_weights(ids: Array, mask: Array, idf: Optional[Dict[int, float]]) -> Array:
-    """Per-token weights: idf lookup or uniform."""
+def _idf_table(idf: Dict[int, float]) -> Tuple[Array, Array]:
+    """``(sorted_token_ids, weights)`` device arrays for the vectorized gather.
+
+    Built once per corpus dict; the per-token lookup then lowers to one
+    ``searchsorted`` + gather on device — the host-Python ``np.vectorize``
+    walk this replaces cost O(tokens) Python calls per batch, scaling with
+    corpus size.
+    """
     import numpy as np
 
-    if idf is None:
-        return jnp.asarray(np.asarray(mask), dtype=jnp.float32)
-    ids_np = np.asarray(ids)
-    default = 0.0
-    w = np.vectorize(lambda t: idf.get(int(t), default))(ids_np).astype(np.float32)
-    return jnp.asarray(w) * jnp.asarray(np.asarray(mask), dtype=jnp.float32)
+    keys = np.fromiter(sorted(idf), dtype=np.int64, count=len(idf))
+    vals = np.asarray([idf[int(k)] for k in keys], dtype=np.float32)
+    if keys.size == 0:  # empty corpus: a 1-slot miss table keeps shapes static
+        keys = np.asarray([-1], dtype=np.int64)
+        vals = np.zeros(1, dtype=np.float32)
+    return jnp.asarray(keys), jnp.asarray(vals)
+
+
+def _idf_weights(
+    ids: Array, mask: Array, table: Optional[Union[Dict[int, float], Tuple[Array, Array]]]
+) -> Array:
+    """Per-token weights: device-side idf table gather, or uniform (the mask).
+
+    Tokens absent from the corpus table weight 0.0 — the same default the old
+    host-side ``dict.get`` lookup applied, now as a binary-search gather that
+    never leaves the device. Accepts either the prebuilt ``_idf_table`` pair
+    (build it once per corpus) or the raw idf dict (legacy callers — infolm).
+    """
+    mask_f = jnp.asarray(mask, dtype=jnp.float32)
+    if table is None:
+        return mask_f
+    if isinstance(table, dict):
+        table = _idf_table(table)
+    keys, vals = table
+    ids_j = jnp.asarray(ids, dtype=keys.dtype)
+    pos = jnp.clip(jnp.searchsorted(keys, ids_j), 0, keys.shape[0] - 1)
+    w = jnp.where(keys[pos] == ids_j, vals[pos], 0.0)
+    return w * mask_f
 
 
 def _greedy_cosine_scores(
@@ -92,6 +146,30 @@ def _greedy_cosine_scores(
         return precision, recall, f1
 
     return jax.vmap(_one)(pred_n, pred_mask, tgt_n, tgt_mask, pred_w, tgt_w)
+
+
+#: the compiled score core — with bucketed staging its compile-signature count
+#: is bounded by O(log N · log L) for an arbitrarily ragged eval stream
+_scores_jit = jax.jit(_greedy_cosine_scores)
+
+
+def bert_scoring_cache_size() -> int:
+    """Compiled score-graph signatures held by the jitted greedy-cosine core.
+
+    The retrace evidence surface for the bucketing contract: a ragged stream
+    staged through the shape buckets holds this at O(log N · log L) — bench and
+    tests assert it stops growing once the bucket set is warm.
+    """
+    return int(_scores_jit._cache_size())
+
+
+def _pad_2d(arr: Array, rows: int, width: int) -> Array:
+    """Zero-pad a (N, L) batch up to the bucketed shape (mask-neutral)."""
+    arr = jnp.asarray(arr)
+    pad_r, pad_w = rows - arr.shape[0], width - arr.shape[1]
+    if pad_r or pad_w:
+        arr = jnp.pad(arr, ((0, pad_r), (0, pad_w)))
+    return arr
 
 
 def _resolve_model_and_tokenizer(
@@ -136,24 +214,51 @@ def _score_from_tokens(
 ) -> Tuple[Array, Array, Array]:
     """(precision, recall, f1) per pair from tokenized batches — the post-tokenize
     half of the pipeline, shared by the functional API and the modular metric's
-    tokenized-tensor states."""
-    pred_emb = forward(pred_tok["input_ids"], pred_tok["attention_mask"])
-    tgt_emb = forward(tgt_tok["input_ids"], tgt_tok["attention_mask"])
+    tokenized-tensor states.
 
-    idf_map = (
-        _compute_idf([tgt_tok["input_ids"]], [tgt_tok["attention_mask"]]) if idf else None
+    With bucketing on (the default), the pair count and token widths pad up to
+    the engine's power-of-two buckets BEFORE the model forward and the jitted
+    score core: a ragged eval stream reuses O(log N · log L) compiled graphs,
+    and zero-mask pad rows/columns are score-neutral (sliced off the result).
+    """
+    # corpus idf over the RAW, UNPADDED target arrays: bucket-pad rows would
+    # inflate the document count, and counting happens BEFORE any device
+    # conversion so a numpy-returning tokenizer stays host-pure (no round-trip)
+    table = (
+        _idf_table(_compute_idf([tgt_tok["input_ids"]], [tgt_tok["attention_mask"]]))
+        if idf
+        else None
     )
-    pred_w = _idf_weights(pred_tok["input_ids"], pred_tok["attention_mask"], idf_map)
-    tgt_w = _idf_weights(tgt_tok["input_ids"], tgt_tok["attention_mask"], idf_map)
 
-    return _greedy_cosine_scores(
+    pred_ids = jnp.asarray(pred_tok["input_ids"])
+    pred_mask = jnp.asarray(pred_tok["attention_mask"])
+    tgt_ids = jnp.asarray(tgt_tok["input_ids"])
+    tgt_mask = jnp.asarray(tgt_tok["attention_mask"])
+    n = pred_ids.shape[0]
+
+    if bert_buckets_enabled():
+        from torchmetrics_tpu.engine import bucketing
+
+        rows = bucketing.next_bucket(max(n, 1))
+        lp = bucketing.next_bucket(max(pred_ids.shape[1], 1))
+        lt = bucketing.next_bucket(max(tgt_ids.shape[1], 1))
+        pred_ids, pred_mask = _pad_2d(pred_ids, rows, lp), _pad_2d(pred_mask, rows, lp)
+        tgt_ids, tgt_mask = _pad_2d(tgt_ids, rows, lt), _pad_2d(tgt_mask, rows, lt)
+
+    pred_emb = forward(pred_ids, pred_mask)
+    tgt_emb = forward(tgt_ids, tgt_mask)
+    pred_w = _idf_weights(pred_ids, pred_mask, table)
+    tgt_w = _idf_weights(tgt_ids, tgt_mask, table)
+
+    precision, recall, f1 = _scores_jit(
         pred_emb,
-        jnp.asarray(pred_tok["attention_mask"], dtype=jnp.float32),
+        jnp.asarray(pred_mask, dtype=jnp.float32),
         tgt_emb,
-        jnp.asarray(tgt_tok["attention_mask"], dtype=jnp.float32),
+        jnp.asarray(tgt_mask, dtype=jnp.float32),
         pred_w,
         tgt_w,
     )
+    return precision[:n], recall[:n], f1[:n]
 
 
 def bert_score(

@@ -3,22 +3,40 @@
 TPU-first design:
 - Streaming sum / Σxxᵀ / count states (fixed shapes, one psum each at sync) — same
   layout as the reference (``fid.py:315-321``).
+- The update is **row-additive and branchless**: the real/fake flag rides as a 0-d
+  input (``jnp.where`` select, no Python branch), so the engine compiles ONE donated
+  executable covering both streams and the ragged tail rides the power-of-two shape
+  buckets like any counter metric (``_engine_row_additive``). The extractor must be
+  row-independent (per-image features, no cross-batch normalisation) — that is what
+  the row-additive declaration asserts.
 - ``trace(sqrtm(Σ₁Σ₂))`` via symmetric eigendecomposition: for PSD Σ₁, Σ₂ the
   eigvals of Σ₁Σ₂ equal those of the *symmetric* Σ₁^½ Σ₂ Σ₁^½, so two ``eigh`` calls
-  replace the reference's general-matrix ``torch.linalg.eigvals`` (``fid.py:160-179``)
-  — ``eigh`` lowers to XLA on TPU, general ``eigvals`` does not.
+  replace the reference's general-matrix ``torch.linalg.eigvals`` (``fid.py:160-179``).
+  The Fréchet compute runs **in-graph** by default (``jnp.linalg.eigvalsh`` — one XLA
+  graph, no host readback, STRICT-guard clean); the legacy host-numpy path is retained
+  behind ``TORCHMETRICS_TPU_FID_HOST_EIGH`` as a counted, boundary-sanctioned fallback
+  for deployments where a device eig kernel degrades the accelerator stream (the
+  tunneled-TPU pathology: one eigh dropped every later dispatch ~0.03 ms → ~104 ms).
+- The ``(d, d)`` covariance-sum states declare ``row_sharded``: on an active state
+  mesh (``parallel/sharding.py``) a 2048-dim (or larger) feature covariance is born
+  partitioned over the mesh rows — ``state_footprint()`` proves ~1/mesh bytes per
+  device — and the SPMD update scatters each batch's Σxxᵀ contribution shard-locally.
 - Accumulation in f64 like the reference; on TPU (no native f64) XLA emulates — the
   compute runs once per epoch so this is off the hot path.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Union
+import os
+import weakref
+from typing import Any, Callable, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.engine.stats import EngineStats
 from torchmetrics_tpu.image._extractor import resolve_feature_extractor
 from torchmetrics_tpu.metric import Metric
 
@@ -28,6 +46,53 @@ Array = jax.Array
 # native f64 is absent — resolved via result_type so no dtype-truncation warnings fire.
 _F64 = jnp.result_type(jnp.float32, jnp.float64)
 
+_HOST_EIGH_ENV = "TORCHMETRICS_TPU_FID_HOST_EIGH"
+
+# module-level stats block: heavy-workload host fallbacks are a process-wide
+# fact, not a per-engine property — one EngineStats joins the weak registry so
+# engine_report()/telemetry aggregate `fid_host_eighs` like any other counter
+_STATS = EngineStats("fid")
+
+# extractor output dtypes observed per live metric instance. The traced update
+# cannot write `self.orig_dtype` (any non-state attribute write aborts
+# compilation), but a tracer's dtype is STATIC metadata — recording it here is
+# a trace-safe, idempotent side effect, so engine-only streams still report the
+# extractor's dtype from compute(). id-keyed with a finalizer (Metric.__hash__
+# is state-dependent, so WeakKeyDictionary is off the table).
+_ORIG_DTYPES: Dict[int, Any] = {}
+
+
+def _note_orig_dtype(metric: "FrechetInceptionDistance", dtype: Any) -> None:
+    key = id(metric)
+    if key not in _ORIG_DTYPES:
+        _ORIG_DTYPES[key] = dtype
+        weakref.finalize(metric, _ORIG_DTYPES.pop, key, None)
+    else:
+        _ORIG_DTYPES[key] = dtype
+
+
+def fid_host_eigh() -> bool:
+    """Whether the Fréchet compute takes the retained host-eigh fallback.
+
+    ``TORCHMETRICS_TPU_FID_HOST_EIGH=1|on`` routes the epoch-end eigendecompositions
+    to host LAPACK (the pre-r17 behavior — keeps eig kernels OFF the accelerator
+    stream where a tunneled-TPU dispatch pathology makes them toxic); unset/``0``/
+    ``off`` keeps the compute in-graph. Unrecognized values fail loud (the PR-7 env
+    contract). Each host compute is counted (``fid_host_eighs``) and recorded as a
+    ``heavy.fallback`` event, and its readbacks ride the sanctioned
+    ``fid-host-eigh`` transfer boundary.
+    """
+    raw = os.environ.get(_HOST_EIGH_ENV, "").strip().lower()
+    if raw in ("", "0", "off"):
+        return False
+    if raw in ("1", "on"):
+        return True
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    raise TorchMetricsUserError(
+        f"{_HOST_EIGH_ENV} must be unset/'0'/'off' or '1'/'on' (got {raw!r})"
+    )
+
 
 def _sqrtm_psd(mat):
     """Matrix square root of a symmetric PSD matrix via host eigh (numpy)."""
@@ -36,16 +101,27 @@ def _sqrtm_psd(mat):
     return (v * np.sqrt(w)) @ v.T
 
 
-def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
-    """d² = ‖μ₁−μ₂‖² + Tr(Σ₁+Σ₂−2√(Σ₁Σ₂)) (reference ``fid.py:160-179``).
+def _compute_fid_host(mu1, sigma1, mu2, sigma2) -> Array:
+    """The retained host-numpy Fréchet path (``TORCHMETRICS_TPU_FID_HOST_EIGH``).
 
-    Runs on host numpy: the eigendecompositions are one-shot (d,d) LAPACK calls at
-    epoch end, and device eig kernels must be kept OFF the accelerator stream — on
-    the tunneled TPU a single eigh permanently degrades every subsequent dispatch
-    (~0.03 ms → ~104 ms), poisoning the training hot loop that follows ``compute``.
+    One-shot (d, d) LAPACK calls at epoch end, kept for deployments where device
+    eig kernels must stay off the accelerator stream. Counted + sanctioned: the
+    readbacks ride the registered ``fid-host-eigh`` boundary so a STRICT guard
+    stays clean by declaration rather than suppression.
     """
-    mu1, mu2 = np.asarray(mu1), np.asarray(mu2)
-    sigma1, sigma2 = np.asarray(sigma1), np.asarray(sigma2)
+    from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+    if jax.core.trace_state_clean():
+        # an epoch-engine trace attempt reaches here with tracers and aborts at
+        # the first readback — only the eager evaluation that runs counts
+        _STATS.fid_host_eighs += 1
+        _diag.record(
+            "heavy.fallback", "FrechetInceptionDistance",
+            label="fid-host-eigh", reason="knob",
+        )
+    with transfer_allowed("fid-host-eigh"):
+        mu1, mu2 = np.asarray(mu1), np.asarray(mu2)
+        sigma1, sigma2 = np.asarray(sigma1), np.asarray(sigma2)
     a = ((mu1 - mu2) ** 2).sum(axis=-1)
     b = np.trace(sigma1) + np.trace(sigma2)
     s1_half = _sqrtm_psd(sigma1)
@@ -53,6 +129,25 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
     eig = np.linalg.eigvalsh(m)
     c = np.sqrt(np.clip(eig, 0.0, None)).sum(axis=-1)
     return jnp.asarray(a + b - 2 * c)
+
+
+def _compute_fid_jnp(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """d² = ‖μ₁−μ₂‖² + Tr(Σ₁+Σ₂−2√(Σ₁Σ₂)) in one XLA graph (reference ``fid.py:160-179``)."""
+    a = ((mu1 - mu2) ** 2).sum(axis=-1)
+    b = jnp.trace(sigma1) + jnp.trace(sigma2)
+    w1, v1 = jnp.linalg.eigh(sigma1)
+    s1_half = (v1 * jnp.sqrt(jnp.clip(w1, 0.0, None))) @ v1.T
+    m = s1_half @ sigma2 @ s1_half
+    eig = jnp.linalg.eigvalsh(m)
+    c = jnp.sqrt(jnp.clip(eig, 0.0, None)).sum(axis=-1)
+    return a + b - 2 * c
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """Fréchet distance — in-graph by default, host-eigh behind the knob."""
+    if fid_host_eigh():
+        return _compute_fid_host(mu1, sigma1, mu2, sigma2)
+    return _compute_fid_jnp(mu1, sigma1, mu2, sigma2)
 
 
 class FrechetInceptionDistance(Metric):
@@ -64,12 +159,29 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: whether ``reset`` clears the real-distribution states.
         normalize: if True, float [0,1] inputs are scaled to [0,255] uint8 first.
         num_features: feature dim; probed from a dummy forward when ``None``.
+
+    Engine notes: pass ``real`` as a 0-d jax array (``jnp.asarray(True)``) to ride
+    the compiled/bucketed/scan hot path — a Python bool is a non-array input and
+    runs the exact same branchless body eagerly. The covariance-sum states declare
+    ``row_sharded``: with an active state mesh they are born partitioned (~1/mesh
+    bytes per device, in-graph psum sync).
     """
 
     is_differentiable: bool = False
     higher_is_better: bool = False
     full_state_update: bool = False
     plot_lower_bound: float = 0.0
+
+    # the update is additive over batch rows (Σ over per-image features) and every
+    # state folds with "sum" — the bucketing pad-subtract identity holds, PROVIDED
+    # the extractor maps each image independently (documented requirement)
+    _engine_row_additive: bool = True
+    # SPMD placement (parallel/sharding.py): the (d, d) covariance sums partition
+    # their leading dim over the state mesh; no active mesh = replicated, free
+    _engine_shard_rules = {
+        "real_features_cov_sum": "row_sharded",
+        "fake_features_cov_sum": "row_sharded",
+    }
 
     def __init__(
         self,
@@ -100,36 +212,116 @@ class FrechetInceptionDistance(Metric):
         self.add_state("fake_features_cov_sum", jnp.zeros(mx, dtype=_F64), dist_reduce_fx="sum")
         self.add_state("fake_features_num_samples", jnp.asarray(0), dist_reduce_fx="sum")
 
-    def update(self, imgs: Array, real: bool) -> None:
-        """Extract features and fold them into the streaming moments (reference ``fid.py:323-339``)."""
+    def update(self, imgs: Array, real: Union[bool, Array]) -> None:
+        """Extract features and fold them into the streaming moments (reference ``fid.py:323-339``).
+
+        Branchless: both real and fake states update every step, masked by the
+        ``real`` flag — so a 0-d array flag traces into ONE compiled executable
+        serving both streams (a Python bool runs the identical arithmetic eagerly).
+        """
         imgs = (imgs * 255).astype(jnp.uint8) if self.normalize else imgs
         features = self.inception(imgs)
-        self.orig_dtype = features.dtype
+        # the dtype is static even on a tracer; the external registry makes it
+        # observable from compute() on engine-only streams, while the pickle-
+        # visible attribute mirror is written on the eager path only (a traced
+        # non-state attribute write would abort compilation)
+        _note_orig_dtype(self, features.dtype)
+        if not isinstance(features, jax.core.Tracer) and getattr(self, "orig_dtype", None) != features.dtype:
+            self.orig_dtype = features.dtype
         features = features.astype(_F64)
         if features.ndim == 1:
             features = features[None, :]
-        if real:
-            self.real_features_sum = self.real_features_sum + features.sum(axis=0)
-            self.real_features_cov_sum = self.real_features_cov_sum + features.T @ features
-            self.real_features_num_samples = self.real_features_num_samples + imgs.shape[0]
-        else:
-            self.fake_features_sum = self.fake_features_sum + features.sum(axis=0)
-            self.fake_features_cov_sum = self.fake_features_cov_sum + features.T @ features
-            self.fake_features_num_samples = self.fake_features_num_samples + imgs.shape[0]
+        n = features.shape[0]
+        fsum = features.sum(axis=0)
+        fcov = features.T @ features
+        r = jnp.asarray(real)
+        cnt_dtype = self.real_features_num_samples.dtype
+        # where-SELECTS, not arithmetic masking: `0 * inf = NaN` would let one
+        # non-finite batch poison the OTHER stream's states — the unselected
+        # branch of a select cannot contaminate the selected lanes, so the two
+        # streams stay isolated exactly like the old if/else. The pad-subtract
+        # identity still holds per branch (the unit run selects the same side).
+        self.real_features_sum = jnp.where(r, self.real_features_sum + fsum, self.real_features_sum)
+        self.real_features_cov_sum = jnp.where(r, self.real_features_cov_sum + fcov, self.real_features_cov_sum)
+        self.real_features_num_samples = self.real_features_num_samples + jnp.where(r, n, 0).astype(cnt_dtype)
+        self.fake_features_sum = jnp.where(r, self.fake_features_sum, self.fake_features_sum + fsum)
+        self.fake_features_cov_sum = jnp.where(r, self.fake_features_cov_sum, self.fake_features_cov_sum + fcov)
+        self.fake_features_num_samples = self.fake_features_num_samples + jnp.where(r, 0, n).astype(cnt_dtype)
+
+    def _epoch_sync_for_compute(self):
+        """Decline the fused sync→compute chain — it returns a value without
+        re-entering ``_engine_compute``, which would skip the <2-sample guard
+        on multi-process runs. The packed sync still rides ``sync_context``;
+        the guard then reads the SYNCED counts and the cached compute
+        executable serves the value (two epoch-end dispatches instead of one —
+        noise next to the Fréchet eigendecompositions)."""
+        return None
+
+    def _engine_compute(self, compute, args, kwargs):
+        """Host-side pre-dispatch hook covering cached AND eager compute.
+
+        The cached-compute executable never re-enters the Python body, so the
+        reference's <2-sample guard must run here — one sanctioned scalar read
+        per compute call, at the epoch boundary, cached-path included (a reset
+        metric raises exactly like the pre-engine path instead of dispatching
+        a graph that folds 0/0 into NaN). The same host moment mirrors the
+        engine-observed extractor dtype onto the pickle/clone-visible
+        ``orig_dtype`` attribute (the traced update cannot write it).
+        """
+        dtype = _ORIG_DTYPES.get(id(self))
+        if dtype is not None and self.__dict__.get("orig_dtype") is None:
+            self.orig_dtype = dtype
+        from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
+
+        with transfer_allowed("fid-sample-guard"):
+            n_real = int(self.real_features_num_samples)
+            n_fake = int(self.fake_features_num_samples)
+        if n_real < 2 or n_fake < 2:
+            raise RuntimeError(
+                "More than one sample is required for both the real and fake distributed to compute FID"
+            )
+        if fid_host_eigh():
+            # the retained host path must bypass the CACHED in-graph executable:
+            # the knob can flip mid-process (the documented tunneled-TPU
+            # remediation), and a cached graph would silently ignore it
+            return compute(*args, **kwargs)
+        return super()._engine_compute(compute, args, kwargs)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Mirror the engine-observed extractor dtype into the pickled state.
+
+        On an engine-only stream the traced update cannot write ``orig_dtype``
+        and the id-keyed registry does not follow a pickle/clone — without this,
+        a copy taken after updates but before the first compute would return the
+        accumulation dtype instead of the extractor's.
+        """
+        state = super().__getstate__()
+        if state.get("orig_dtype") is None:
+            dtype = _ORIG_DTYPES.get(id(self))
+            if dtype is not None:
+                state["orig_dtype"] = dtype
+        return state
 
     def compute(self) -> Array:
-        """FID between the two accumulated gaussians (reference ``fid.py:341-352``)."""
-        if int(self.real_features_num_samples) < 2 or int(self.fake_features_num_samples) < 2:
-            raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
-        mean_real = (self.real_features_sum / self.real_features_num_samples)[None, :]
-        mean_fake = (self.fake_features_sum / self.fake_features_num_samples)[None, :]
+        """FID between the two accumulated gaussians (reference ``fid.py:341-352``).
 
-        cov_real_num = self.real_features_cov_sum - self.real_features_num_samples * (mean_real.T @ mean_real)
-        cov_real = cov_real_num / (self.real_features_num_samples - 1)
-        cov_fake_num = self.fake_features_cov_sum - self.fake_features_num_samples * (mean_fake.T @ mean_fake)
-        cov_fake = cov_fake_num / (self.fake_features_num_samples - 1)
+        Fully traceable when the host-eigh knob is off: the epoch engine caches
+        it as ONE ledger-verified executable and the STRICT transfer guard
+        holds (the <2-sample guard runs in the host-side ``_engine_compute``
+        hook, never in this body).
+        """
+        n_real = self.real_features_num_samples
+        n_fake = self.fake_features_num_samples
+        mean_real = (self.real_features_sum / n_real)[None, :]
+        mean_fake = (self.fake_features_sum / n_fake)[None, :]
+
+        cov_real_num = self.real_features_cov_sum - n_real * (mean_real.T @ mean_real)
+        cov_real = cov_real_num / (n_real - 1)
+        cov_fake_num = self.fake_features_cov_sum - n_fake * (mean_fake.T @ mean_fake)
+        cov_fake = cov_fake_num / (n_fake - 1)
         out = _compute_fid(mean_real.squeeze(0), cov_real, mean_fake.squeeze(0), cov_fake)
-        return out.astype(getattr(self, "orig_dtype", out.dtype))
+        orig = getattr(self, "orig_dtype", None) or _ORIG_DTYPES.get(id(self))
+        return out.astype(orig if orig is not None else out.dtype)
 
     def reset(self) -> None:
         """Reset, optionally keeping the real-distribution statistics (reference ``fid.py:354-365``)."""

@@ -7,6 +7,13 @@ so they ride the cross-process array gather and a multi-host eval computes over 
 full corpus (including corpus-wide idf). Only with no tokenizer at all does the
 metric fall back to raw sentence-list states, which are host data and aggregate
 per-host only.
+
+Scoring rides the bucketed staging of ``functional/text/bert.py``: the
+epoch-end corpus (whatever its pair count) pads up to the engine's
+power-of-two buckets before the model forward and the jitted greedy-cosine
+core, and IDF weighting is a device-side table gather — ragged eval corpora
+stop retracing and stop touching host in the score path
+(``TORCHMETRICS_TPU_BERT_BUCKETS`` opts out).
 """
 
 from __future__ import annotations
