@@ -80,7 +80,7 @@ _SCENARIO_BYTES = {
 # every scenario block scripts/check_counters.py gates on: a run (including
 # the TPU-less micro fallback) must prove each of these completed, or the
 # gate's scenario-completeness check fails — nothing gated can skip silently
-_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "heavy")
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "multichip_2d", "heavy")
 
 # the sharding scenario partitions state over a >= 4-device mesh; on a host
 # platform that needs forced virtual devices, set BEFORE jax initializes (the
@@ -2405,6 +2405,192 @@ def bench_sharding(micro=False):
     return out
 
 
+def bench_multichip_2d(micro=False):
+    """2-D (data, state) mesh scenario (ISSUE 16 evidence).
+
+    An emulated world-2 epoch sync rides a live ``(data=2, state=2)`` mesh
+    fully in-graph, and every claim is a recorded counter:
+
+    - **zero host collectives**: with a live data axis the packed exchange
+      assembles data-sharded world views instead of host gathers —
+      ``sync_collectives`` == 0 AND ``sync_metadata_gathers`` == 0 across the
+      whole epoch path, while ``ingraph_syncs``/``psum_syncs`` count the
+      in-graph exchanges that replaced them;
+    - **parity**: the in-graph fold is byte-identical to the world-2 HOST
+      packed-sync reference for additive and cat states
+      (``ingraph_parity_ok``);
+    - **noop plans**: a fully class-axis-sharded metric skips the packed
+      exchange wholesale — no buffers, no metadata, counted as
+      ``sync_noop_plans`` — and still computes the already-global value
+      (``noop_value_ok``);
+    - **warm stability**: a second epoch re-dispatches the cached sync→fold
+      executables under the STRICT transfer guard with 0 retraces and 0
+      unsanctioned host transfers;
+    - **2-D placement**: class-axis states born on the mesh partition over
+      ``"state"`` only (replicated over ``"data"``) — per-device bytes ==
+      total / state-axis (``placement_2d_ok``) — and the PR-10 K=8 scan drain
+      stays byte-identical over 2-D carries (``scan2d_compat_ok``).
+    """
+    from contextlib import ExitStack
+    from unittest import mock
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.aggregation import CatMetric
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix, MulticlassStatScores
+    from torchmetrics_tpu.diag import diag_context, transfer_guard
+    from torchmetrics_tpu.engine import engine_context, scan_context
+    from torchmetrics_tpu.engine.stats import engine_report, reset_engine_stats
+    from torchmetrics_tpu.parallel import sharding as shd
+
+    if jax.local_device_count() < 4:
+        raise RuntimeError(
+            f"multichip_2d scenario needs >= 4 local devices (have {jax.local_device_count()};"
+            " CPU runs force 8 via --xla_force_host_platform_device_count)"
+        )
+    data_ax, state_ax = 2, 2
+    world = 2
+    classes, batch = (64, 256) if micro else (256, 1024)
+    n_batches = 6
+    rng = np.random.RandomState(16)
+    batches = [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch).astype(np.int32)),
+        )
+        for _ in range(n_batches)
+    ]
+
+    out = {
+        "mesh": f"{data_ax}x{state_ax}",
+        "mesh_devices": data_ax * state_ax,
+        "data_axis": data_ax,
+        "state_axis": state_ax,
+        "world": world,
+        "classes": classes,
+        "batch": batch,
+    }
+
+    def emulated_world(stack):
+        stack.enter_context(mock.patch.object(jax, "process_count", lambda: world))
+        stack.enter_context(
+            mock.patch.object(
+                multihost_utils,
+                "process_allgather",
+                lambda x, tiled=False: np.stack([np.asarray(x)] * world),
+            )
+        )
+
+    def run_stream(metric, stream, synced=True):
+        metric.distributed_available_fn = (lambda: True) if synced else (lambda: False)
+        for p, t in stream:
+            metric.update(p, t)
+        return np.asarray(metric.compute())
+
+    def build_pair():
+        ss = MulticlassStatScores(classes, average="micro", validate_args=False)
+        # float nan_strategy = the branch-free device impute path — the eager
+        # NaN readback would (correctly) trip the STRICT guard in epoch 2
+        cat = CatMetric(nan_strategy=0.0)
+        return ss, cat
+
+    # -- world-2 HOST packed-sync reference (no mesh): the parity baseline ----
+    reset_engine_stats()
+    with ExitStack() as es:
+        es.enter_context(engine_context(True, donate=True))
+        emulated_world(es)
+        ss_ref, cat_ref = build_pair()
+        ss_host = run_stream(ss_ref, batches)
+        cat_ref.distributed_available_fn = lambda: True
+        for p, _ in batches[:3]:
+            cat_ref.update(p.mean(axis=1))
+        cat_host = np.asarray(cat_ref.compute())
+    host_rep = engine_report()
+    out["host_sync_collectives"] = host_rep["sync_collectives"]  # proves the baseline gathered
+
+    # -- in-graph epoch sync on the live (data, state) mesh -------------------
+    reset_engine_stats()
+    with ExitStack() as es:
+        es.enter_context(engine_context(True, donate=True))
+        es.enter_context(shd.mesh_context(data=data_ax, state=state_ax))
+        emulated_world(es)
+        ss_m, cat_m = build_pair()
+        ss_val = run_stream(ss_m, batches)  # epoch 1: traces + fold compiles
+        cat_m.distributed_available_fn = lambda: True
+        for p, _ in batches[:3]:
+            cat_m.update(p.mean(axis=1))
+        cat_val = np.asarray(cat_m.compute())
+        # epoch 2: the warm re-dispatch, STRICT-guarded end to end
+        ss_m.reset()
+        cat_m.reset()
+        before = engine_report()
+        with diag_context(capacity=8192) as rec, transfer_guard("strict"):
+            ss_m.distributed_available_fn = lambda: True
+            for p, t in batches:
+                ss_m.update(p, t)
+            ss_warm_dev = ss_m.compute()
+            for p, _ in batches[:3]:
+                cat_m.update(p.mean(axis=1))
+            cat_warm_dev = cat_m.compute()
+        ss_warm = np.asarray(ss_warm_dev)
+        cat_warm = np.asarray(cat_warm_dev)
+        after = engine_report()
+    out["ingraph_retraces_warm"] = after["traces"] - before["traces"]
+    out["ingraph_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+    out["sync_collectives"] = after["sync_collectives"]
+    out["sync_metadata_gathers"] = after["sync_metadata_gathers"]
+    out["ingraph_syncs"] = after["ingraph_syncs"]
+    out["psum_syncs"] = after["psum_syncs"]
+    out["packed_syncs"] = after["packed_syncs"]
+    out["ingraph_parity_ok"] = bool(
+        np.array_equal(ss_val, ss_host)
+        and np.array_equal(cat_val, cat_host)
+        and np.array_equal(ss_warm, ss_host)
+        and np.array_equal(cat_warm, cat_host)
+    )
+
+    # -- noop plans + 2-D placement: every state live-sharded -----------------
+    with engine_context(True, donate=True):
+        cm_local = run_stream(
+            MulticlassConfusionMatrix(classes, validate_args=False), batches, synced=False
+        )
+    with ExitStack() as es:
+        es.enter_context(engine_context(True, donate=True))
+        es.enter_context(shd.mesh_context(data=data_ax, state=state_ax))
+        emulated_world(es)
+        cm = MulticlassConfusionMatrix(classes, validate_args=False)
+        foot = cm.state_footprint()
+        out["placement_2d_ok"] = bool(
+            shd.is_sharded(cm.confmat)
+            and foot["per_device_bytes"] * state_ax == foot["total_bytes"]
+        )
+        cm_synced = run_stream(cm, batches)
+    noop_rep = engine_report()
+    out["sync_noop_plans"] = noop_rep["sync_noop_plans"]
+    out["noop_value_ok"] = bool(np.array_equal(cm_synced, cm_local))
+    out["sync_collectives_total"] = noop_rep["sync_collectives"]  # both legs, still zero
+
+    # -- scan-queue compat over 2-D carries -----------------------------------
+    with engine_context(True, donate=True):
+        macro_ref = run_stream(
+            MulticlassStatScores(classes, average="macro", validate_args=False),
+            batches,
+            synced=False,
+        )
+    with engine_context(True, donate=True), scan_context(8), shd.mesh_context(
+        data=data_ax, state=state_ax
+    ):
+        scanned = run_stream(
+            MulticlassStatScores(classes, average="macro", validate_args=False),
+            batches,
+            synced=False,
+        )
+    out["scan2d_compat_ok"] = bool(np.array_equal(scanned, macro_ref))
+    return out
+
+
 def bench_heavy(micro=False):
     """Heavy-metric in-graph kernels scenario (ISSUE 15 evidence).
 
@@ -2705,8 +2891,15 @@ def bench_heavy(micro=False):
     return out
 
 
-def multichip_evidence(sharding_block):
-    """MULTICHIP_r06-style evidence dict from a completed sharding scenario."""
+def multichip_evidence(sharding_block, mesh2d_block=None):
+    """MULTICHIP_r07-style evidence dict from the completed sharding scenarios.
+
+    ``sharding_block`` is the 1-D state-mesh scenario (ISSUE 12); the optional
+    ``mesh2d_block`` is the 2-D (data, state) scenario (ISSUE 16) — when
+    present, its gates join the overall verdict: the in-graph epoch sync must
+    have run with ZERO host collectives, byte-parity against the world-2
+    packed-sync reference, 0 warm retraces, and a counted no-op plan.
+    """
     import jax
 
     ok = bool(
@@ -2717,7 +2910,21 @@ def multichip_evidence(sharding_block):
         and sharding_block.get("gather_skipped", 0) > 0
         and sharding_block.get("sharding_host_transfers", 1) == 0
     )
-    return {
+    if mesh2d_block is not None:
+        ok = ok and bool(
+            mesh2d_block.get("ingraph_parity_ok")
+            and mesh2d_block.get("noop_value_ok")
+            and mesh2d_block.get("placement_2d_ok")
+            and mesh2d_block.get("scan2d_compat_ok")
+            and mesh2d_block.get("sync_collectives", 1) == 0
+            and mesh2d_block.get("sync_metadata_gathers", 1) == 0
+            and mesh2d_block.get("ingraph_syncs", 0) > 0
+            and mesh2d_block.get("psum_syncs", 0) > 0
+            and mesh2d_block.get("sync_noop_plans", 0) > 0
+            and mesh2d_block.get("ingraph_retraces_warm", 1) == 0
+            and mesh2d_block.get("ingraph_host_transfers", 1) == 0
+        )
+    evidence = {
         "n_devices": int(jax.local_device_count()),
         "mesh_devices": sharding_block.get("mesh_devices"),
         "rc": 0 if ok else 1,
@@ -2726,6 +2933,9 @@ def multichip_evidence(sharding_block):
         "tail": "",
         "sharding": sharding_block,
     }
+    if mesh2d_block is not None:
+        evidence["multichip_2d"] = mesh2d_block
+    return evidence
 
 
 def bench_micro_device(n_steps=200):
@@ -3267,12 +3477,22 @@ def main(argv=None):
         try:
             extras["sharding"] = bench_sharding(micro=not on_tpu or args.smoke)
             statuses["sharding"] = "ok"
-            if args.multichip_out:
-                with open(args.multichip_out, "w") as fh:
-                    json.dump(multichip_evidence(extras["sharding"]), fh, indent=2, sort_keys=True)
-                    fh.write("\n")
         except Exception as err:  # noqa: BLE001
             statuses["sharding"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        try:
+            extras["multichip_2d"] = bench_multichip_2d(micro=not on_tpu or args.smoke)
+            statuses["multichip_2d"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["multichip_2d"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        if args.multichip_out and isinstance(extras.get("sharding"), dict):
+            with open(args.multichip_out, "w") as fh:
+                json.dump(
+                    multichip_evidence(extras["sharding"], extras.get("multichip_2d")),
+                    fh, indent=2, sort_keys=True,
+                )
+                fh.write("\n")
 
         if on_tpu and not args.smoke:
             try:
@@ -3328,6 +3548,7 @@ def main(argv=None):
         statuses["async"] = "tpu_unavailable"
         statuses["cse"] = "tpu_unavailable"
         statuses["sharding"] = "tpu_unavailable"
+        statuses["multichip_2d"] = "tpu_unavailable"
         statuses["heavy"] = "tpu_unavailable"
         statuses["device_scenarios"] = "tpu_unavailable"
 

@@ -131,6 +131,17 @@ _MULTICHIP_TRACKED = (
     ("sharding", "sharding_retraces_after_warmup", "max"),
     ("sharding", "million_class_update_executables", "max"),  # ONE SPMD graph
     ("sharding", "million_class_us_per_step", None),  # machine-dependent: display
+    # 2-D (data, state) mesh trajectory (PR 16, MULTICHIP_r07 onward): the
+    # in-graph epoch sync must STAY at zero host collectives / zero warm
+    # retraces; the informational rows show how much exchange traffic rides
+    # in-graph per round
+    ("multichip_2d", "sync_collectives", "max"),  # zero host collectives, forever
+    ("multichip_2d", "sync_metadata_gathers", "max"),
+    ("multichip_2d", "ingraph_syncs", None),
+    ("multichip_2d", "psum_syncs", None),
+    ("multichip_2d", "sync_noop_plans", None),
+    ("multichip_2d", "ingraph_retraces_warm", "max"),
+    ("multichip_2d", "ingraph_host_transfers", "max"),
 )
 
 _TOL = 1e-6

@@ -323,6 +323,26 @@ _CHECKS = (
     ("sharding", "sharding_footprint_fraction", "abs", 0.30),  # per-device ~1/mesh (mesh>=4)
     ("sharding", "lifecycle_roundtrip_ok", "true", None),  # clone/pickle/state_dict/reshard
     ("sharding", "scan_compat_ok", "true", None),  # PR-10 K=8 drain, byte-identical
+    # 2-D (data, state) mesh gates (parallel/sharding.py + engine/epoch.py,
+    # PR 16): with a live data axis the epoch sync of replicated + sharded
+    # states compiles into ONE in-graph GSPMD program — ZERO host collectives
+    # and zero metadata gathers on the whole epoch path, psum counted in
+    # their place, byte-parity against the world-2 host packed-sync
+    # reference, all-sharded plans skipped wholesale as counted no-ops, and
+    # the warm re-dispatch STRICT-guard clean with 0 retraces
+    ("multichip_2d", "sync_collectives", "abs", 0),  # ZERO host collectives, live data axis
+    ("multichip_2d", "sync_metadata_gathers", "abs", 0),  # metadata tiled locally, not gathered
+    ("multichip_2d", "ingraph_syncs", "min", 1),  # the exchanges actually rode in-graph
+    ("multichip_2d", "psum_syncs", "min", 1),  # ...with additive folds lowered to psum
+    ("multichip_2d", "host_sync_collectives", "true", None),  # the HOST baseline DID gather
+    ("multichip_2d", "ingraph_parity_ok", "true", None),  # byte-parity vs packed-sync reference
+    ("multichip_2d", "sync_noop_plans", "min", 1),  # all-sharded plan skipped wholesale
+    ("multichip_2d", "noop_value_ok", "true", None),  # ...and still computed the global value
+    ("multichip_2d", "sync_collectives_total", "abs", 0),  # both legs: still zero host ops
+    ("multichip_2d", "ingraph_retraces_warm", "abs", 0),  # epoch 2 reused the cached fold
+    ("multichip_2d", "ingraph_host_transfers", "abs", 0),  # STRICT guard held end to end
+    ("multichip_2d", "placement_2d_ok", "true", None),  # class axis over "state" only
+    ("multichip_2d", "scan2d_compat_ok", "true", None),  # PR-10 K=8 drain over 2-D carries
     # heavy-metric in-graph kernel gates (image/fid.py, detection/ingraph.py,
     # functional/text/bert.py, PR 15): the reference's expensive workloads run
     # engine-native — FID update+compute and the packed-route mAP hold 0
@@ -389,7 +409,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "heavy"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "multichip_2d", "heavy"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

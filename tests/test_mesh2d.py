@@ -1,0 +1,469 @@
+"""2-D (data, state) mesh tier (``parallel/sharding.py`` + ``engine/epoch.py``) — ISSUE 16.
+
+Runs on the conftest's forced 8-virtual-device CPU world. A 2×2 named
+``("data", "state")`` mesh drives the new tier for real: in-graph packed
+epoch sync over the data axis (zero host collectives, ``psum`` lowered into
+the fold executable), per-state-name partition-rule tables, the no-op-plan
+short-circuit, the degrade counter export, multi-host knob parsing, and the
+full lifecycle suite (clone / pickle / state_dict / ``restore_resharded``
+N→M / scan K ∈ {1, 8} / async drain) parity-pinned bit-identical against the
+1-D mesh and the replicated packed-sync paths.
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassStatScores,
+)
+from torchmetrics_tpu.engine import engine_context, scan_context
+from torchmetrics_tpu.engine import statespec
+from torchmetrics_tpu.engine.stats import engine_report, reset_engine_stats
+from torchmetrics_tpu.parallel import sharding
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+DATA = 2
+STATE = 2
+CLASSES = 32
+BATCH = 64
+N_BATCHES = 6
+
+
+@pytest.fixture()
+def stream():
+    rng = np.random.RandomState(13)
+    return [
+        (
+            jnp.asarray(rng.rand(BATCH, CLASSES).astype(np.float32)),
+            jnp.asarray(rng.randint(0, CLASSES, BATCH).astype(np.int32)),
+        )
+        for _ in range(N_BATCHES)
+    ]
+
+
+@pytest.fixture()
+def world2(monkeypatch):
+    """Emulate a 2-rank world: every rank holds byte-identical state."""
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x, tiled=False: np.stack([np.asarray(x)] * 2),
+    )
+    return 2
+
+
+def _run(metric, stream):
+    for preds, target in stream:
+        metric.update(preds, target)
+    return np.asarray(metric.compute())
+
+
+# ------------------------------------------------------------------ mesh policy
+
+
+def test_mesh2d_context_shapes():
+    with sharding.mesh_context(data=DATA, state=STATE) as mesh:
+        assert tuple(mesh.axis_names) == (sharding.DATA_AXIS, sharding.STATE_AXIS)
+        assert sharding.data_axis_size() == DATA
+        assert sharding.axis_size() == STATE
+        assert sharding.sharding_enabled()
+    assert sharding.metric_mesh() is None
+    # 1-D forms stay valid and carry no data axis
+    with sharding.mesh_context(4):
+        assert sharding.data_axis_size() == 1
+        assert sharding.axis_size() == 4
+
+
+def test_mesh2d_env_spec(monkeypatch):
+    monkeypatch.setenv(sharding.SHARD_ENV_VAR, "2x4")
+    mesh = sharding.metric_mesh()
+    assert dict(mesh.shape) == {sharding.DATA_AXIS: 2, sharding.STATE_AXIS: 4}
+    # "1xS" is exactly the 1-D S-device mesh
+    monkeypatch.setenv(sharding.SHARD_ENV_VAR, "1x4")
+    mesh = sharding.metric_mesh()
+    assert tuple(mesh.axis_names) == (sharding.STATE_AXIS,)
+    for bad in ("0x4", "2x0", "1x1", "axb", "2x"):
+        monkeypatch.setenv(sharding.SHARD_ENV_VAR, bad)
+        with pytest.raises(TorchMetricsUserError):
+            sharding.metric_mesh()
+
+
+def test_mesh2d_rejects_mixed_and_oversized():
+    with pytest.raises(TorchMetricsUserError, match="not both"):
+        sharding.set_mesh(4, data=2)
+    with pytest.raises(TorchMetricsUserError, match="devices exist"):
+        sharding.build_mesh(8, data=2)  # 16 > the 8-device world
+
+
+def test_shard_batch_rides_data_axis():
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    with sharding.mesh_context(data=DATA, state=STATE):
+        placed = sharding.shard_batch(x)
+        spec = placed.sharding.spec
+        assert spec[0] == sharding.DATA_AXIS
+        assert np.array_equal(np.asarray(placed), np.asarray(x))
+        # indivisible leading dim: silent exact no-op (inputs are transient)
+        odd = jnp.zeros((7, 4))
+        assert sharding.shard_batch(odd) is odd
+    assert sharding.shard_batch(x) is x  # no mesh: no-op
+
+
+# ------------------------------------------------------------------ partition rules
+
+
+def test_partition_rule_table_overrides_shard_rule():
+    value = jnp.zeros((CLASSES, CLASSES), jnp.int32)
+    spec = statespec.StateSpec(name="confmat", fold="sum", shard_rule="replicate")
+    with sharding.mesh_context(data=DATA, state=STATE):
+        # replicate rule + no table: stays replicated
+        assert statespec.resolve_shard_rule(spec, value) is None
+        with sharding.partition_rules_context([(r"confmat$", P("state"))]):
+            resolved = statespec.resolve_shard_rule(spec, value)
+            assert resolved is not None
+            assert tuple(resolved.spec) == (sharding.STATE_AXIS,)
+        # an explicit None rule overrides a real shard_rule back to replication
+        cls = statespec.StateSpec(name="confmat", fold="sum", shard_rule="class_axis")
+        with sharding.partition_rules_context([(r"confmat$", None)]):
+            assert statespec.resolve_shard_rule(cls, value) is None
+        # owner-qualified patterns match "Owner/state"
+        with sharding.partition_rules_context([(r"^MyMetric/confmat$", P("state"))]):
+            assert statespec.resolve_shard_rule(spec, value, owner="MyMetric") is not None
+            assert statespec.resolve_shard_rule(spec, value, owner="Other") is None
+
+
+def test_partition_rule_2d_block_and_degrade():
+    reset_engine_stats()
+    spec = statespec.StateSpec(name="embeddings", fold="sum", shard_rule="replicate")
+    with sharding.mesh_context(data=DATA, state=STATE):
+        with sharding.partition_rules_context([(r"embeddings$", P("data", "state"))]):
+            value = jnp.zeros((4, 6), jnp.float32)
+            resolved = statespec.resolve_shard_rule(spec, value)
+            assert tuple(resolved.spec) == (sharding.DATA_AXIS, sharding.STATE_AXIS)
+            # per-dimension degrade: dim 1 indivisible by the state axis
+            ragged = jnp.zeros((4, 7), jnp.float32)
+            partial = statespec.resolve_shard_rule(spec, ragged)
+            assert tuple(partial.spec) == (sharding.DATA_AXIS,)
+            # every dim degrading resolves to replication, counted not raised
+            scalar = jnp.zeros((), jnp.float32)
+            assert statespec.resolve_shard_rule(spec, scalar) is None
+    rep = engine_report()
+    assert rep["shard_degrades"] >= 2
+
+
+def test_partition_rules_validate_eagerly():
+    with pytest.raises(TorchMetricsUserError, match="axis"):
+        sharding.set_partition_rules([(r"x$", P("banana"))])
+    with pytest.raises(TorchMetricsUserError, match="regex"):
+        sharding.set_partition_rules([("(", P("state"))])
+    sharding.set_partition_rules(None)  # cleanup is a supported spelling
+    assert not sharding.partition_rules_active()
+
+
+def test_partition_rule_places_states_at_add_state(stream):
+    """A rule-matched state is BORN distributed even with shard_rule='replicate'."""
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        with sharding.partition_rules_context([(r"confmat$", P("state"))]):
+            m = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+            assert sharding.is_sharded(m.confmat)
+            sharded = _run(m, stream)
+    with engine_context(True, donate=True):
+        ref = _run(MulticlassConfusionMatrix(CLASSES, validate_args=False), stream)
+    assert np.array_equal(sharded, ref)
+
+
+def test_shard_degrades_counter_exported():
+    reset_engine_stats()
+    spec = statespec.StateSpec(name="tp", fold="sum", shard_rule="class_axis")
+    with sharding.mesh_context(data=DATA, state=STATE):
+        assert statespec.resolve_shard_rule(spec, jnp.zeros((CLASSES + 1,))) is None
+    assert engine_report()["shard_degrades"] >= 1
+    from torchmetrics_tpu.diag.telemetry import export_prometheus
+
+    text = export_prometheus()
+    for series in (
+        "tm_tpu_shard_degrades_total",
+        "tm_tpu_ingraph_syncs_total",
+        "tm_tpu_sync_noop_plans_total",
+    ):
+        assert series in text
+
+
+# ------------------------------------------------------------------ multi-host knob
+
+
+def test_multihost_spec_parser(monkeypatch):
+    monkeypatch.delenv(sharding.MULTIHOST_ENV_VAR, raising=False)
+    assert sharding.multihost_spec() is None
+    for raw in ("0", "off"):
+        monkeypatch.setenv(sharding.MULTIHOST_ENV_VAR, raw)
+        assert sharding.multihost_spec() is None
+    for raw in ("1", "on", "auto"):
+        monkeypatch.setenv(sharding.MULTIHOST_ENV_VAR, raw)
+        assert sharding.multihost_spec() == {}
+    monkeypatch.setenv(sharding.MULTIHOST_ENV_VAR, "10.0.0.1:8476:4:2")
+    assert sharding.multihost_spec() == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    for bad in ("banana", "host:port:2:0", "1:2:3"):
+        monkeypatch.setenv(sharding.MULTIHOST_ENV_VAR, bad)
+        with pytest.raises(TorchMetricsUserError, match="multi-host spec"):
+            sharding.multihost_spec()
+
+
+def test_ensure_multihost_initializes_once(monkeypatch):
+    calls = []
+    monkeypatch.setattr(sharding, "_multihost_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False, raising=False)
+    monkeypatch.delenv(sharding.MULTIHOST_ENV_VAR, raising=False)
+    assert sharding.ensure_multihost() is False  # knob off: never initializes
+    assert calls == []
+    monkeypatch.setenv(sharding.MULTIHOST_ENV_VAR, "127.0.0.1:9999:1:0")
+    assert sharding.ensure_multihost() is True
+    assert calls == [
+        {"coordinator_address": "127.0.0.1:9999", "num_processes": 1, "process_id": 0}
+    ]
+    assert sharding.ensure_multihost() is True  # latched: once per process
+    assert len(calls) == 1
+    # an already-formed pod is detected and reused, never re-initialized
+    monkeypatch.setattr(sharding, "_multihost_initialized", False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True, raising=False)
+    assert sharding.ensure_multihost() is True
+    assert len(calls) == 1
+
+
+# ------------------------------------------------------------------ in-graph epoch sync
+
+
+def test_ingraph_sync_zero_host_collectives(world2, stream):
+    """Replicated states epoch-sync with ZERO host collectives on a live data
+    axis: buffers become data-sharded world views, the fold's reduction lowers
+    to in-graph psum, and the result is byte-identical to the host packed path."""
+    def run_sum(metric):
+        metric.distributed_available_fn = lambda: True
+        for p, _ in stream:
+            metric.update(p.sum())
+        return np.asarray(metric.compute())
+
+    with engine_context(True, donate=True):
+        host_value = run_sum(SumMetric())
+    reset_engine_stats()
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        ingraph_value = run_sum(SumMetric())
+    rep = engine_report()
+    assert rep["sync_collectives"] == 0
+    assert rep["sync_metadata_gathers"] == 0
+    assert rep["ingraph_syncs"] >= 1
+    assert rep["psum_syncs"] >= 1
+    assert rep["packed_syncs"] >= 1
+    assert np.array_equal(ingraph_value, host_value)
+
+
+def test_ingraph_sync_parity_1d_and_replicated(world2, stream):
+    """Satellite pin: the in-graph 2-D sync, the 1-D-mesh host sync, and the
+    plain replicated host sync produce bit-identical values for metrics whose
+    states stay replicated (scalars degrade every shard rule)."""
+    def run(mesh_kwargs):
+        from contextlib import ExitStack
+
+        with ExitStack() as es:
+            es.enter_context(engine_context(True, donate=True))
+            if mesh_kwargs:
+                es.enter_context(sharding.mesh_context(**mesh_kwargs))
+            out = {}
+            for cls, name in ((SumMetric, "sum"), (MeanMetric, "mean"),
+                              (MulticlassAccuracy, "acc")):
+                m = cls(num_classes=CLASSES, average="micro", validate_args=False) \
+                    if cls is MulticlassAccuracy else cls()
+                m.distributed_available_fn = lambda: True
+                if cls is MulticlassAccuracy:
+                    for p, t in stream:
+                        m.update(p, t)
+                else:
+                    for p, _ in stream:
+                        m.update(p.mean())
+                out[name] = np.asarray(m.compute())
+            cat = CatMetric()
+            cat.distributed_available_fn = lambda: True
+            for p, _ in stream[:3]:
+                cat.update(p.mean(axis=1))
+            out["cat"] = np.asarray(cat.compute())
+            return out
+
+    replicated = run(None)
+    mesh_1d = run({"mesh": 4})
+    mesh_2d = run({"data": DATA, "state": STATE})
+    for key in replicated:
+        assert np.array_equal(replicated[key], mesh_2d[key]), key
+        assert np.array_equal(mesh_1d[key], mesh_2d[key]), key
+
+
+def test_ingraph_cat_gather(world2, stream):
+    """Cat (ragged) states ride the in-graph all_gather view: metadata is
+    tiled locally (zero gathers) and the folded rows match the host path."""
+    with engine_context(True, donate=True):
+        base = CatMetric()
+        base.distributed_available_fn = lambda: True
+        for p, _ in stream[:3]:
+            base.update(p.mean(axis=1))
+        host_rows = np.asarray(base.compute())
+    reset_engine_stats()
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        m = CatMetric()
+        m.distributed_available_fn = lambda: True
+        for p, _ in stream[:3]:
+            m.update(p.mean(axis=1))
+        rows = np.asarray(m.compute())
+    rep = engine_report()
+    assert rep["sync_collectives"] == 0
+    assert rep["sync_metadata_gathers"] == 0
+    assert rep["ingraph_syncs"] >= 1
+    assert np.array_equal(rows, host_rows)
+
+
+def test_sync_noop_plan_skips_packing(world2, stream):
+    """Every state live-sharded => the packed exchange is skipped wholesale."""
+    reset_engine_stats()
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        m = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        assert sharding.is_sharded(m.confmat)
+        m.distributed_available_fn = lambda: True
+        synced = _run(m, stream)
+    rep = engine_report()
+    assert rep["sync_noop_plans"] >= 1
+    assert rep["sync_collectives"] == 0
+    assert rep["sync_metadata_gathers"] == 0
+    assert rep["gather_skipped"] >= 1
+    with engine_context(True, donate=True):
+        base = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        base.distributed_available_fn = lambda: False
+        local = _run(base, stream)
+    # the sharded state is global by construction: no emulated x2 fold
+    assert np.array_equal(synced, local)
+
+
+def test_ingraph_mode_resolution(world2):
+    """The mode classifier: data axis must be live AND match the world size."""
+    from torchmetrics_tpu.parallel import packing
+
+    with engine_context(True, donate=True):
+        m = SumMetric()
+        m.update(jnp.asarray(1.0))
+        plan = packing.PackedSyncPlan([("", m)], 2, None)
+        assert packing.ingraph_sync_mode(plan, None, 1) is None  # no mesh
+        with sharding.mesh_context(4):  # 1-D: no data axis
+            assert packing.ingraph_sync_mode(
+                plan, sharding.metric_mesh(), sharding.data_axis_size()) is None
+        with sharding.mesh_context(data=4, state=2):  # data != world
+            assert packing.ingraph_sync_mode(
+                plan, sharding.metric_mesh(), sharding.data_axis_size()) is None
+        with sharding.mesh_context(data=DATA, state=STATE):
+            mesh = sharding.metric_mesh()
+            assert packing.ingraph_sync_mode(plan, mesh, 2) == "emulated"
+            degraded = packing.PackedSyncPlan([("", m)], 2, (0,))
+            assert packing.ingraph_sync_mode(degraded, mesh, 2) is None
+
+
+# ------------------------------------------------------------------ lifecycle on 2x2
+
+
+def test_mesh2d_states_born_sharded_and_parity(stream):
+    with engine_context(True, donate=True):
+        ref = _run(MulticlassConfusionMatrix(CLASSES, validate_args=False), stream)
+    with engine_context(True, donate=True), sharding.mesh_context(4):
+        m1 = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        v1 = _run(m1, stream)
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        m2 = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        assert sharding.is_sharded(m2.confmat)
+        # 2-D placement: partitioned over "state", replicated over "data"
+        foot = m2.state_footprint()
+        assert foot["per_device_bytes"] * STATE == foot["total_bytes"]
+        v2 = _run(m2, stream)
+    assert np.array_equal(ref, v2)
+    assert np.array_equal(v1, v2)
+
+
+def test_mesh2d_clone_pickle_statedict_roundtrips(stream):
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        src = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        _run(src, stream)
+        reference = np.asarray(src.compute())
+
+        clone = src.clone()
+        assert sharding.is_sharded(clone.confmat)
+        assert np.array_equal(np.asarray(clone.compute()), reference)
+
+        restored = pickle.loads(pickle.dumps(src))
+        assert sharding.is_sharded(restored.confmat)
+        assert np.array_equal(np.asarray(restored.compute()), reference)
+
+        src.persistent(True)
+        fresh = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        fresh.persistent(True)
+        fresh.load_state_dict(src.state_dict())
+        assert sharding.is_sharded(fresh.confmat)
+        assert np.array_equal(np.asarray(fresh.compute()), reference)
+
+
+def test_mesh2d_restore_resharded_n_to_m(tmp_path, stream):
+    from torchmetrics_tpu.parallel.elastic import restore_resharded, save_state_shard, shard_path
+
+    with engine_context(True, donate=True), sharding.mesh_context(data=DATA, state=STATE):
+        src = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        _run(src, stream)
+        base = os.path.join(str(tmp_path), "ck")
+        for rank in range(2):
+            save_state_shard(src, shard_path(base, rank, 2), rank=rank, world_size=2)
+        target = MulticlassConfusionMatrix(CLASSES, validate_args=False)
+        restore_resharded(target, str(tmp_path), rank=0, world_size=1)
+        assert sharding.is_sharded(target.confmat)
+        assert np.array_equal(np.asarray(target.confmat), 2 * np.asarray(src.confmat))
+
+
+@pytest.mark.parametrize("k", [1, 8])
+def test_mesh2d_scan_queue_compat(k, stream):
+    def run(mesh):
+        from contextlib import ExitStack
+
+        with ExitStack() as es:
+            es.enter_context(engine_context(True, donate=True))
+            if k > 1:
+                es.enter_context(scan_context(k))
+            if mesh:
+                es.enter_context(sharding.mesh_context(data=DATA, state=STATE))
+            m = MulticlassStatScores(CLASSES, average="macro", validate_args=False)
+            return _run(m, stream)
+
+    assert np.array_equal(run(mesh=False), run(mesh=True))
+
+
+def test_mesh2d_async_drain_compat(stream):
+    from torchmetrics_tpu.engine import async_context
+
+    def run(mesh):
+        from contextlib import ExitStack
+
+        with ExitStack() as es:
+            es.enter_context(engine_context(True, donate=True))
+            es.enter_context(scan_context(4))
+            es.enter_context(async_context(True))
+            if mesh:
+                es.enter_context(sharding.mesh_context(data=DATA, state=STATE))
+            m = MulticlassStatScores(CLASSES, average="macro", validate_args=False)
+            return _run(m, stream)
+
+    assert np.array_equal(run(mesh=False), run(mesh=True))

@@ -88,6 +88,9 @@ UNITLESS_COUNT_FAMILIES = frozenset({
     # SPMD sharded-state engine (parallel/sharding.py, PR 12): placement /
     # in-graph-sync event counts — pure counts, no physical unit
     "tm_tpu_shard_states", "tm_tpu_psum_syncs", "tm_tpu_gather_skipped",
+    # 2-D data×state mesh (parallel/sharding.py + engine/epoch.py, PR 16):
+    # degrade-to-replication, in-graph exchange, and no-op-plan counts
+    "tm_tpu_shard_degrades", "tm_tpu_ingraph_syncs", "tm_tpu_sync_noop_plans",
     # async pipelined dispatch (engine/async_dispatch.py, PR 13): buffer /
     # drain / join / replay event counts and the in-flight-depth histogram —
     # pure counts; the time-valued async series export as *_seconds
@@ -147,6 +150,9 @@ _COUNTER_HELP = {
     "shard_states": "states placed distributed via a resolved shard rule",
     "psum_syncs": "additive sharded states whose sync lowered to in-graph psum",
     "gather_skipped": "sharded states the packed host gather skipped",
+    "shard_degrades": "shard-rule resolutions degraded to replication",
+    "ingraph_syncs": "packed exchanges that rode the data axis in-graph",
+    "sync_noop_plans": "packed syncs skipped wholesale (every state live-sharded)",
 }
 
 # exposition-convention names for counters whose field name buries the unit:

@@ -118,7 +118,7 @@ EVENT_KINDS = frozenset({
     # epoch engine / packed sync (engine/epoch.py, parallel/packing.py)
     "sync.exchange", "sync.fold_trace", "sync.fold_retrace", "sync.eager",
     "sync.audit", "sync.straggler", "sync.retry", "sync.fault", "sync.degraded",
-    "sync.shard_skip", "collective",
+    "sync.shard_skip", "sync.ingraph", "sync.noop", "collective",
     # cached compute (engine/epoch.py)
     "compute.trace", "compute.retrace", "compute.dispatch", "compute.probe",
     # numerics layer (engine/numerics.py)
@@ -127,7 +127,7 @@ EVENT_KINDS = frozenset({
     "snapshot.save", "snapshot.restore", "snapshot.fallback", "snapshot.flush",
     "snapshot.preempt", "snapshot.restore_latest",
     # SPMD sharded-state engine (parallel/sharding.py)
-    "shard.place", "shard.fallback", "shard.reshard",
+    "shard.place", "shard.fallback", "shard.reshard", "multihost.init",
     # state-spec registry (engine/statespec.py)
     "spec.fallback",
     # heavy-workload kernels (image/fid.py, detection/mean_ap.py): a retained

@@ -49,6 +49,7 @@ KNOB_REGISTRY = {
     "TORCHMETRICS_TPU_COMPENSATED": "torchmetrics_tpu.engine.numerics:compensated_enabled",
     "TORCHMETRICS_TPU_DRIFT_RTOL": "torchmetrics_tpu.engine.numerics:drift_rtol",
     "TORCHMETRICS_TPU_SHARD": "torchmetrics_tpu.parallel.sharding:_env_mesh",
+    "TORCHMETRICS_TPU_MULTIHOST": "torchmetrics_tpu.parallel.sharding:multihost_spec",
     "TORCHMETRICS_TPU_SYNC_DEADLINE_MS": "torchmetrics_tpu.parallel.resilience:_env_float",
     "TORCHMETRICS_TPU_SYNC_RETRIES": "torchmetrics_tpu.parallel.resilience:_env_float",
     "TORCHMETRICS_TPU_SYNC_BACKOFF_MS": "torchmetrics_tpu.parallel.resilience:_env_float",

@@ -56,6 +56,7 @@ from torchmetrics_tpu.engine.compiled import (
 )
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
+from torchmetrics_tpu.parallel import packing as _packing
 from torchmetrics_tpu.parallel import resilience as _resilience
 from torchmetrics_tpu.parallel.packing import PackedSyncPlan, PackingError, all_gather_backbone
 
@@ -150,19 +151,22 @@ def _collect_state(metric: Any) -> Optional[Dict[str, Any]]:
     return state
 
 
-def _plan_fingerprint(plan: PackedSyncPlan) -> Dict[str, Any]:
+def _plan_fingerprint(plan: PackedSyncPlan, mode: str = "host") -> Dict[str, Any]:
     """Signature digest of a packed plan for retrace-cause attribution.
 
     A fold/fused executable recompiling after warmup is attributed to the
     nearest-changed aspect: the spec layout (``treedef-change``), a state dtype
     (``dtype-change``), per-rank shapes/raggedness (``shape-change``), or the
-    world geometry / buffer layout (``plan-change``).
+    world geometry / buffer layout / exchange mode (``plan-change`` — the
+    in-graph data-axis view and the host-gathered view carry different input
+    shardings, so a mode flip IS a plan-level recompile, attributed, never
+    "unknown").
     """
     return {
         "treedef": tuple((s.owner, s.attr, s.kind, s.was_list) for s in plan.specs),
         "dtype": tuple(s.dtype for s in plan.specs),
         "shape": tuple((s.shape, s.elem_shapes, s.world_dim0) for s in plan.specs),
-        "plan": (plan.world_size, plan.members, tuple(sorted(plan._group_sizes.items()))),
+        "plan": (mode, plan.world_size, plan.members, tuple(sorted(plan._group_sizes.items()))),
     }
 
 
@@ -231,8 +235,8 @@ def _degraded_replan(
 
 def _exchange(
     plan: PackedSyncPlan, stats: EngineStats
-) -> Tuple[Dict[str, Any], PackedSyncPlan]:
-    """Run the (fault-bounded) exchange; returns ``(gathered, live plan)``.
+) -> Tuple[Dict[str, Any], PackedSyncPlan, str]:
+    """Run the (fault-bounded) exchange; returns ``(gathered, live plan, mode)``.
 
     The live plan is the one the caller must fold/cache against: a classified
     collective fault (timeout past the deadline, unreachable rank — typed
@@ -240,12 +244,18 @@ def _exchange(
     the sync onto a re-planned surviving membership when policy allows, so the
     returned plan may exclude the culprit rank. Retries spent inside the
     bounded collectives are folded into ``stats.sync_retries``.
+
+    ``mode`` names how the buffers were exchanged — ``"local"`` (world 1),
+    ``"host"`` (packed host gather), ``"emulated"``/``"spmd"`` (the in-graph
+    data-axis view, :func:`~torchmetrics_tpu.parallel.packing.mesh_world_view`)
+    or ``"noop"`` (nothing to exchange) — and keys the fold caches, since the
+    gathered views carry mode-specific input shardings.
     """
     retries_before = _resilience.total_retries()
     try:
         while True:
             try:
-                gathered = _exchange_once(plan, stats)
+                gathered, mode = _exchange_once(plan, stats)
                 if plan.degraded:
                     # counted on COMPLETION, not on the replan decision — a
                     # degrade that itself fails must not read as a degraded fold
@@ -264,7 +274,11 @@ def _exchange(
                         states=len(skipped),
                         attrs=tuple(f"{o}:{a}" if o else a for o, a, _, _ in skipped),
                     )
-                    if plan.world_size > 1 and any(not spans for _, _, _, spans in skipped):
+                    if (
+                        plan.world_size > 1
+                        and mode not in ("emulated", "spmd")
+                        and any(not spans for _, _, _, spans in skipped)
+                    ):
                         # multi-host honesty: a process-LOCAL mesh only folded
                         # this process's contributions — skipping the gather is
                         # exact only when the mesh spans every process. Loud,
@@ -281,7 +295,7 @@ def _exchange(
                             " packed gather.",
                             UserWarning,
                         )
-                return gathered, plan
+                return gathered, plan, mode
             except _resilience.SyncFaultError as exc:
                 # each pass excludes exactly one culprit; bounded by world size
                 plan = _degraded_replan(plan, stats, exc)
@@ -291,23 +305,60 @@ def _exchange(
 
 def _exchange_once(
     plan: PackedSyncPlan, stats: EngineStats
-) -> Dict[str, Any]:
+) -> Tuple[Dict[str, Any], str]:
     """Run the metadata exchange + buffer collectives for ``plan``.
 
-    One-process worlds skip the collectives entirely (the gathered view is the
-    local buffer with a world axis of 1) — packed sync then costs ZERO host
-    transfers, which is exactly the single-chip epoch cost the north star asks
-    for. Metadata validation errors propagate (fail loud on every rank).
+    Returns ``(gathered, mode)``. One-process worlds skip the collectives
+    entirely (the gathered view is the local buffer with a world axis of 1) —
+    packed sync then costs ZERO host transfers, which is exactly the
+    single-chip epoch cost the north star asks for.
+
+    With a live 2-D mesh whose data axis matches the world size
+    (:func:`~torchmetrics_tpu.parallel.packing.ingraph_sync_mode`), the packed
+    buffers are exchanged as data-axis-sharded world VIEWS instead of host
+    gathers: the fold's stacked reduction over dim 0 then lowers to an
+    in-graph psum/pmax/pmin (all_gather for cat states) inside the same
+    compiled executable. The host ``bounded_collective`` remains only for the
+    metadata control probe on real multi-host pods (``"spmd"``) and for the
+    eager ``"host"`` fallback. Metadata validation errors propagate (fail loud
+    on every rank).
     """
+    from torchmetrics_tpu.parallel import sharding as _sharding
+
     rec = _diag.active_recorder()
     measuring = rec is not None or _profile.active_profile() is not None
     t0 = perf_counter() if measuring else 0.0
+    if plan.world_size == 1:
+        mode = "local"
+    else:
+        mode = (
+            _packing.ingraph_sync_mode(plan, _sharding.metric_mesh(), _sharding.data_axis_size())
+            or "host"
+        )
+    if not plan.specs and not plan.timeline:
+        # every state is live-sharded (its sync is already in-graph) or the
+        # plan is genuinely empty: the packed buffers would be zero-row and
+        # the metadata gather pure control noise — skip the exchange wholesale
+        plan.finalize(None)
+        stats.sync_noop_plans += 1
+        _diag.record(
+            "sync.noop", stats.owner,
+            world=plan.world_size, mode=mode,
+            sharded=len(getattr(plan, "skipped_sharded", ())),
+        )
+        return {}, mode
     meta = plan.metadata_local()
     had_meta = False
+    ingraph = mode in ("emulated", "spmd")
     if meta is None:
         plan.finalize(None)
     elif plan.world_size == 1:
         plan.finalize(meta[None, :])
+    elif mode == "emulated":
+        # one real process emulating the world: every rank computes
+        # byte-identical metadata, so tiling locally IS the gathered view —
+        # zero host collectives, same rows the host gather would return
+        plan.finalize(np.repeat(meta[None, :], plan.world_size, axis=0))
     else:
         had_meta = True
         # sanctioned boundary: the metadata probe is host data by design — every
@@ -319,15 +370,34 @@ def _exchange_once(
     local = plan.pack()
     gathered: Dict[str, Any] = {}
     bytes_moved = 0
+    ingraph_bufs = 0
     for key in sorted(local):  # deterministic collective order on every rank
         buf = local[key]
         if plan.world_size == 1:
             gathered[key] = buf[None]
             continue
+        if ingraph:
+            # data-axis world view: no host collective, no transfer — the
+            # cross-rank reduction compiles into the consuming fold/fused
+            # executable (psum for reduce buffers, all_gather for gathers)
+            gathered[key] = _packing.mesh_world_view(
+                buf, plan.world_size, _sharding.metric_mesh(),
+                multiprocess=(mode == "spmd"), label=key,
+            )
+            ingraph_bufs += 1
+            if key.startswith("reduce:"):
+                stats.psum_syncs += 1
+            continue
         gathered[key] = all_gather_backbone(buf, label=key, members=plan.members)
         stats.sync_collectives += 1
         bytes_moved += int(getattr(buf, "nbytes", 0)) * plan.world_size
     stats.sync_bytes_moved += bytes_moved
+    if ingraph_bufs:
+        stats.ingraph_syncs += 1
+        _diag.record(
+            "sync.ingraph", stats.owner,
+            world=plan.world_size, buffers=ingraph_bufs, mode=mode,
+        )
     # divergence audit (opt-in): the metadata exchange carried per-state value
     # fingerprints; surface what the cross-rank comparison found
     for finding in getattr(plan, "audit_results", ()):
@@ -368,8 +438,9 @@ def _exchange_once(
             "sync.exchange", stats.owner,
             dispatch_us=sync_us,
             world=plan.world_size, buffers=len(local), metadata=had_meta, bytes=bytes_moved,
+            mode=mode,
         )
-    return gathered
+    return gathered, mode
 
 
 def _write_synced(metric: Any, states: Dict[str, Any], plan: PackedSyncPlan, owner: str) -> None:
@@ -391,6 +462,7 @@ def _run_fold(
     cache: Dict[Tuple, Any],
     stats: EngineStats,
     fingerprints: List[Dict[str, Any]],
+    mode: str = "host",
 ) -> Optional[Dict[str, Dict[str, Any]]]:
     """Dispatch the plan's fold through the signature-keyed executable cache.
 
@@ -400,9 +472,16 @@ def _run_fold(
     collection engines so the fallback/counter semantics cannot drift apart.
     ``fingerprints`` is the caller-owned list of previously compiled plan
     fingerprints — a fold compile past the first is attributed and recorded as
-    a ``sync.fold_retrace`` with its cause.
+    a ``sync.fold_retrace`` with its cause. ``mode`` is the exchange mode from
+    :func:`_exchange` and keys the cache: the in-graph data-axis views and the
+    host-gathered replicated views carry different input shardings, so an AOT
+    executable compiled for one must never be dispatched on the other.
     """
-    sig = plan.signature()
+    if not plan.specs:
+        # no-op plan (every state live-sharded): nothing to unpack or fold —
+        # compiling a trivial executable for an empty pytree is pure waste
+        return {}
+    sig = (mode, plan.signature())
     entry = cache.get(sig)
     first = entry is None
     try:
@@ -426,7 +505,7 @@ def _run_fold(
     if first:
         cache[sig] = entry
         stats.sync_fold_traces += 1
-        fp = _plan_fingerprint(plan)
+        fp = _plan_fingerprint(plan, mode)
         cause = _diag.attribute_retrace(fp, fingerprints)
         fingerprints.append(fp)
         if cause != "initial":
@@ -475,8 +554,8 @@ class EpochEngine:
         plan = self._plan(process_group)
         if plan is None:
             return False
-        gathered, plan = _exchange(plan, self.stats)
-        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
+        gathered, plan, mode = _exchange(plan, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps, mode)
         if folded is None:
             return False
         _write_synced(self._metric, folded.get("", {}), plan, "")
@@ -497,16 +576,20 @@ class EpochEngine:
         plan = self._plan(process_group)
         if plan is None:
             return None
-        gathered, plan = _exchange(plan, self.stats)
-        sig = ("fused", plan.signature())
+        gathered, plan, mode = _exchange(plan, self.stats)
+        # sharded states live OUTSIDE the exchange (their cross-device sync is
+        # in-graph): they join the fused graph as a SECOND argument, so the
+        # packed-buffer fold, the sharded leaves' SPMD reduction, and the
+        # compute body all lower into ONE executable — the old sharded-skip
+        # special case collapses into the same GSPMD program
+        skipped = tuple(getattr(plan, "skipped_sharded", ()))
+        live = {attr: getattr(m, attr) for owner, attr, _, _ in skipped if not owner}
+        live_sig = _state_signature(live) if live else ()
+        live_token = self._device_token(live) if live else ""
+        sig = ("fused", mode, plan.signature(), live_sig, live_token)
         entry = self._fused_cache.get(sig)
-        if entry is _FALLBACK or not self._compute_ok or getattr(plan, "skipped_sharded", ()):
-            # sharded states live OUTSIDE the exchange (their sync is
-            # in-graph), so the fused fold→compute graph — which only sees the
-            # packed buffers — cannot produce the full state set; the compute
-            # half runs on the live metric instead, where cached_compute
-            # consumes the sharded leaves directly as one SPMD executable
-            return self._fold_then_no_value(plan, gathered)
+        if entry is _FALLBACK or not self._compute_ok or (live and live_sig is None):
+            return self._fold_then_no_value(plan, gathered, mode)
         first = entry is None
         rec = _diag.active_recorder()
         profiling = _profile.active_profile() is not None
@@ -519,10 +602,11 @@ class EpochEngine:
                 fold = plan.make_fold()
                 owner = self.stats.owner
 
-                def fused(bufs):
+                def fused(bufs, live_states):
                     states = fold(bufs).get("", {})
+                    full = {**live_states, **states}
                     with jax.named_scope(f"{owner}:compute"):
-                        value = traced_compute(m, states)
+                        value = traced_compute(m, full)
                     if _sentinel.ATTR in states:
                         # the final value's health folds into the same graph:
                         # a NaN/Inf compute output raises the (already
@@ -533,7 +617,7 @@ class EpochEngine:
 
                 entry = (
                     _costs.aot_compile(
-                        jax.jit(fused), owner=owner, kind="sync-compute", args=(gathered,)
+                        jax.jit(fused), owner=owner, kind="sync-compute", args=(gathered, live)
                     ),
                     annotation_scope(owner, "sync-compute", sig),
                 )
@@ -541,7 +625,7 @@ class EpochEngine:
             if measuring:
                 t_dispatch = perf_counter()
             with jax.profiler.TraceAnnotation(scope):
-                states, value = fn(gathered)
+                states, value = fn(gathered, live)
         except Exception as exc:  # noqa: BLE001 — untraceable compute: sync still packed
             if not first:
                 raise
@@ -555,12 +639,19 @@ class EpochEngine:
             else:
                 reason = f"fused-trace-failed:{type(exc).__name__}"
             self.stats.fallback(reason)
-            return self._fold_then_no_value(plan, gathered)
+            return self._fold_then_no_value(plan, gathered, mode)
         if first:
             self._fused_cache[sig] = entry
             self.stats.compute_traces += 1
             self.stats.sync_fold_traces += 1
-            fp = _plan_fingerprint(plan)
+            fp = _plan_fingerprint(plan, mode)
+            if live:
+                # the live sharded leaves are fused-graph inputs too: their
+                # layout/placement changing is an attributable retrace cause
+                fp["treedef"] = (fp["treedef"], tuple(e[0] for e in live_sig))
+                fp["shape"] = (fp["shape"], tuple(e[1] for e in live_sig))
+                fp["dtype"] = (fp["dtype"], tuple(e[2] for e in live_sig))
+                fp["plan"] = (fp["plan"], live_token)
             cause = _diag.attribute_retrace(fp, self._fused_fps)
             self._fused_fps.append(fp)
             if cause != "initial":
@@ -594,9 +685,9 @@ class EpochEngine:
         _note_async_sync(self.stats)
         return (value,)
 
-    def _fold_then_no_value(self, plan: PackedSyncPlan, gathered: Dict[str, Any]):
+    def _fold_then_no_value(self, plan: PackedSyncPlan, gathered: Dict[str, Any], mode: str = "host"):
         """Fold-only completion for an exchange whose compute half can't fuse."""
-        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps, mode)
         if folded is None:
             return None
         _write_synced(self._metric, folded.get("", {}), plan, "")
@@ -750,8 +841,8 @@ class CollectionEpoch:
         except PackingError as exc:
             self.stats.fallback(f"sync:{exc}")
             return False
-        gathered, plan = _exchange(plan, self.stats)
-        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps)
+        gathered, plan, mode = _exchange(plan, self.stats)
+        folded = _run_fold(plan, gathered, self._fold_cache, self.stats, self._fold_fps, mode)
         if folded is None:
             return False
         for name, metric in owners:

@@ -232,18 +232,25 @@ def fold_name(dist_reduce_fx: Any) -> Tuple[str, Optional[Callable]]:
     raise ValueError(f"unresolvable dist_reduce_fx {dist_reduce_fx!r}")
 
 
-def resolve_shard_rule(spec: StateSpec, value: Any = None) -> Optional[Any]:
+def resolve_shard_rule(spec: StateSpec, value: Any = None, owner: str = "") -> Optional[Any]:
     """Resolve a spec's shard rule to its live sharding (``None`` = replicate).
 
     Returns the ``jax.sharding.NamedSharding`` the rule places ``value``
     under on the active state mesh (``parallel/sharding.py``), or ``None``
     when the state is replicated — because the rule is ``"replicate"``, no
     mesh is active, or the rule degraded (indivisible leading dim, recorded
-    as a ``shard.fallback`` event). ``value`` carries the shape the
-    partitioning inspects; rules other than ``"replicate"`` resolve to
-    ``None`` without it. Unknown rule names raise, listing the registered
-    rules — a typo must not silently replicate a state the operator believes
-    is sharded.
+    as a ``shard.fallback`` event and counted in ``shard_degrades``).
+    ``value`` carries the shape the partitioning inspects; rules other than
+    ``"replicate"`` resolve to ``None`` without it. Unknown rule names raise,
+    listing the registered rules — a typo must not silently replicate a state
+    the operator believes is sharded.
+
+    The per-state-name partition-rule table
+    (:func:`~torchmetrics_tpu.parallel.sharding.set_partition_rules`) is
+    consulted FIRST: an entry matching ``owner/name`` (``owner`` is the
+    metric class name when the caller knows it) overrides the named rule with
+    its explicit per-dim ``PartitionSpec`` — the operator-side channel for
+    sharding states whose class declarations can't be edited.
     """
     try:
         rule = SHARD_RULES[spec.shard_rule]
@@ -252,6 +259,11 @@ def resolve_shard_rule(spec: StateSpec, value: Any = None) -> Optional[Any]:
             f"state {spec.name!r} names unknown shard rule {spec.shard_rule!r}"
             f" (registered rules: {sorted(SHARD_RULES)})"
         ) from None
+    from torchmetrics_tpu.parallel import sharding as _sharding
+
+    match = _sharding.match_partition_rule(spec.name, owner)
+    if match is not None:
+        return _sharding.apply_partition_rule(spec, value, match[1])
     return rule(spec, value)
 
 

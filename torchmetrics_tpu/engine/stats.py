@@ -76,6 +76,10 @@ _COUNTER_FIELDS = (
     "shard_states",  # states placed distributed via a resolved shard rule (born or re-placed)
     "psum_syncs",  # additive sharded states whose sync lowered to in-graph psum (gather skipped)
     "gather_skipped",  # sharded states the packed host gather skipped entirely
+    # --- 2-D data×state mesh (parallel/sharding.py + engine/epoch.py) ---
+    "shard_degrades",  # shard-rule resolutions degraded to replication (no mesh / indivisible dim)
+    "ingraph_syncs",  # packed exchanges that rode the data axis in-graph (zero host collectives)
+    "sync_noop_plans",  # packed syncs skipped wholesale: every state live-sharded, nothing to pack
 )
 
 

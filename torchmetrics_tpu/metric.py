@@ -257,13 +257,22 @@ class Metric:
         spec_obj = _statespec.register_state_spec(
             self, _statespec.build_spec(self, name, dist_reduce_fx, spec)
         )
-        if spec_obj.shard_rule != "replicate" and _is_array(default):
+        if _is_array(default):
+            from torchmetrics_tpu.parallel import sharding as _sharding
+
+            needs_place = spec_obj.shard_rule != "replicate" or (
+                # the per-state-name partition-rule table (2-D mesh tier) can
+                # shard a state whose declared rule is replicate
+                _sharding.partition_rules_active()
+                and _sharding.match_partition_rule(name, type(self).__name__) is not None
+            )
+        else:
+            needs_place = False
+        if needs_place:
             # born distributed (parallel/sharding.py): the registered default
             # itself is placed onto the rule's resolved NamedSharding, so the
             # state never materializes unsharded and reset() restores the
             # sharded default by reference. No active mesh = no-op.
-            from torchmetrics_tpu.parallel import sharding as _sharding
-
             placed = _sharding.place_state(self, name, default, spec_obj)
             if placed is not default:
                 self._defaults[name] = placed
@@ -278,12 +287,12 @@ class Metric:
         common case (no non-replicate rules registered, or no active mesh).
         """
         specs = self.__dict__.get("_state_specs") or {}
-        if not any(
-            getattr(sp, "shard_rule", "replicate") != "replicate" for sp in specs.values()
-        ):
-            return
         from torchmetrics_tpu.parallel import sharding as _sharding
 
+        if not any(
+            getattr(sp, "shard_rule", "replicate") != "replicate" for sp in specs.values()
+        ) and not _sharding.partition_rules_active():
+            return
         _sharding.reshard_states(self)
 
     def state_specs(self) -> Dict[str, Any]:

@@ -52,11 +52,16 @@ from torchmetrics_tpu.parallel.sync import (
 _SHARDING_EXPORTS = (
     "axis_size",
     "build_mesh",
+    "data_axis_size",
+    "ensure_multihost",
     "is_sharded",
     "mesh_context",
     "metric_mesh",
+    "partition_rules_context",
     "reshard_states",
     "set_mesh",
+    "set_partition_rules",
+    "shard_batch",
     "sharding_enabled",
 )
 
@@ -96,12 +101,17 @@ __all__ = [
     "axis_size",
     "axis_sum",
     "build_mesh",
+    "data_axis_size",
+    "ensure_multihost",
     "fault_context",
     "is_sharded",
     "mesh_context",
     "metric_mesh",
+    "partition_rules_context",
     "reshard_states",
     "set_mesh",
+    "set_partition_rules",
+    "shard_batch",
     "sharding_enabled",
     "gather_all_tensors",
     "jit_distributed_available",

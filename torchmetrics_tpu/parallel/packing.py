@@ -49,7 +49,13 @@ from torchmetrics_tpu.diag import timeline as _timeline
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
-__all__ = ["PackedSyncPlan", "PackingError", "all_gather_backbone"]
+__all__ = [
+    "PackedSyncPlan",
+    "PackingError",
+    "all_gather_backbone",
+    "ingraph_sync_mode",
+    "mesh_world_view",
+]
 
 # metadata entry tags (first int of nothing — entries are positional, tags are
 # implicit in the spec order; kept here as documentation of the 2-int layout)
@@ -110,6 +116,92 @@ def all_gather_backbone(x: Any, label: str = "", members: Optional[Sequence[int]
                 members=members,
             )
         )
+
+
+def ingraph_sync_mode(plan: "PackedSyncPlan", mesh: Any, data_size: int) -> Optional[str]:
+    """Can this plan's buffer exchange ride the mesh's ``"data"`` axis?
+
+    Returns ``"emulated"`` (one real process emulating ``world_size`` ranks —
+    tests/bench worlds patched over ``jax.process_count``), ``"spmd"`` (a real
+    multi-process world whose mesh gives each process exactly its own data
+    row), or ``None`` (ride the host packed gather).
+
+    The gate is strict by design — every condition below guards a correctness
+    edge, and a counted host fallback always remains available:
+
+    - the data axis must equal the plan's world size (each rank = one row, so
+      the fold's ``stacked.<op>(axis=0)`` over the row-sharded dim IS the
+      cross-rank fold);
+    - degraded/sub-world plans stay on the host path (the fold's member
+      sub-select indexes the world axis — exact on a host-gathered buffer,
+      but a data-sharded view would still carry the excluded rank's row);
+    - in a real multi-process world, row ``i`` of the mesh must hold process
+      ``i``'s devices and nothing else — a process-local mesh there would
+      tile LOCAL buffers over the data axis and silently double-count.
+    """
+    if mesh is None or plan.world_size < 2 or data_size != plan.world_size:
+        return None
+    if plan.degraded or plan.members != tuple(range(plan.world_size)):
+        return None
+    import jax
+
+    try:
+        real_procs = {d.process_index for d in jax.devices()}
+    except Exception:  # noqa: BLE001 — un-initialized backend: host path
+        return None
+    if len(real_procs) == 1:
+        return "emulated"
+    rows = mesh.devices.reshape(plan.world_size, -1)
+    for i in range(plan.world_size):
+        if {d.process_index for d in rows[i].flat} != {i}:
+            return None
+    return "spmd"
+
+
+def mesh_world_view(
+    buf: Any, world_size: int, mesh: Any, multiprocess: bool = False, label: str = ""
+) -> Any:
+    """Device-resident ``(world, n)`` gathered view sharded over ``"data"``.
+
+    The in-graph replacement for :func:`all_gather_backbone`: instead of a
+    host ``process_allgather``, the world view of a packed buffer is
+    assembled as a device array whose leading (world) dim is partitioned over
+    the mesh's ``"data"`` axis. When the fold executable consumes it, GSPMD
+    lowers ``stacked.sum(axis=0)`` to a local partial + in-graph ``psum``
+    over ``"data"`` (``pmax``/``pmin``/``all_gather`` for the other kinds) —
+    the cross-rank collective compiles into the same program as the unpack
+    and fold, and zero bytes cross the host boundary.
+
+    Emulated worlds (``multiprocess=False``: one real process standing in for
+    ``world_size`` ranks): every rank's buffer IS this buffer, so the view is
+    a broadcast stack resharded over ``"data"`` — value-identical to the host
+    gather's stacked result, with the same per-row contents the patched
+    ``process_allgather`` of the test worlds produces. Tests monkeypatch THIS
+    function to emulate distinct per-rank buffers.
+
+    Real multi-host (``multiprocess=True``): each process contributes its
+    local buffer as its own data row via
+    ``jax.make_array_from_single_device_arrays`` — no host collective; the
+    exchange happens in-graph when the fold runs.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from torchmetrics_tpu.parallel import sharding as _sharding
+
+    sh = NamedSharding(mesh, PartitionSpec(_sharding.DATA_AXIS))
+    buf = jnp.asarray(buf)
+    if not multiprocess:
+        stacked = jnp.broadcast_to(buf[None], (world_size,) + tuple(buf.shape))
+        return jax.device_put(stacked, sh)
+    row = buf[None]
+    # every addressable device of the sharding belongs to this process's data
+    # row; each holds the full (1, n) row shard (replicated over "state")
+    arrays = [jax.device_put(row, d) for d in sh.addressable_devices]
+    return jax.make_array_from_single_device_arrays(
+        (world_size,) + tuple(buf.shape), sh, arrays
+    )
 
 
 class PackingError(Exception):

@@ -62,27 +62,45 @@ from torchmetrics_tpu.engine.stats import EngineStats
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 __all__ = [
+    "DATA_AXIS",
+    "MULTIHOST_ENV_VAR",
     "SHARD_ENV_VAR",
     "STATE_AXIS",
+    "apply_partition_rule",
     "axis_size",
     "build_mesh",
+    "data_axis_size",
+    "ensure_multihost",
     "is_sharded",
+    "match_partition_rule",
     "mesh_context",
     "metric_mesh",
+    "multihost_spec",
     "partition_dim0",
+    "partition_rules_context",
     "place_state",
     "placement_token",
     "reshard_states",
     "set_mesh",
+    "set_partition_rules",
+    "shard_batch",
     "sharding_enabled",
     "state_out_shardings",
 ]
 
 SHARD_ENV_VAR = "TORCHMETRICS_TPU_SHARD"
+MULTIHOST_ENV_VAR = "TORCHMETRICS_TPU_MULTIHOST"
 
 #: the named mesh axis shard rules partition over — ``"class_axis"`` /
 #: ``"row_sharded"`` split a state's leading dim across it
 STATE_AXIS = "state"
+
+#: the named batch axis of the 2-D ``(data, state)`` mesh: update inputs shard
+#: over it (:func:`shard_batch`) and, when it is live, the epoch engine lowers
+#: the cross-rank fold of replicated states onto it as in-graph
+#: ``psum``/``pmax``/``pmin``/``all_gather`` (engine/epoch.py) instead of the
+#: host packed gather
+DATA_AXIS = "data"
 
 _UNSET = object()
 _mesh_override: Any = _UNSET
@@ -101,42 +119,83 @@ _ever_placed = False
 # ------------------------------------------------------------------ mesh policy
 
 
-def build_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence[Any]] = None):
-    """A 1-D :class:`jax.sharding.Mesh` with the named axis ``"state"``.
+def build_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[Any]] = None,
+    data: Optional[int] = None,
+):
+    """A :class:`jax.sharding.Mesh` for metric state — 1-D or 2-D.
 
-    ``devices`` wins when given; otherwise the first ``n_devices`` of the
-    GLOBAL device set (all of them when ``None``) — identical to the local
-    set in a single process, and the only placement whose in-graph
-    collectives actually span the world in a multi-process one (a
-    process-local mesh there folds only local contributions; the sync driver
-    warns when it sees that). Fewer than 2 devices is a loud error — a
-    1-device "mesh" would silently demote every rule to replication while
-    the operator believes sharding is on.
+    With ``data`` unset (or 1) this is the PR-12 1-D mesh with the single
+    named axis ``"state"`` — byte-identical policy, shapes, and errors, so
+    every existing cache key and test pin survives. With ``data >= 2`` the
+    device list reshapes to ``(data, state)`` under the named axes
+    ``("data", "state")``: states partition over ``"state"``, update inputs
+    and the epoch engine's in-graph cross-rank fold ride ``"data"``.
+
+    ``devices`` wins when given; otherwise the first ``data * n_devices`` of
+    the GLOBAL device set (all of them when ``n_devices`` is ``None``) —
+    identical to the local set in a single process, and the only placement
+    whose in-graph collectives actually span the world in a multi-process one
+    (a process-local mesh there folds only local contributions; the sync
+    driver warns when it sees that). Fewer than 2 devices total is a loud
+    error — a 1-device "mesh" would silently demote every rule to replication
+    while the operator believes sharding is on.
     """
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
+    dsize = 1 if data is None else data
+    if not isinstance(dsize, int) or isinstance(dsize, bool) or dsize < 1:
+        raise TorchMetricsUserError(
+            f"the 'data' mesh axis needs an integer size >= 1 (got {data!r})"
+        )
+    ensure_multihost()
     if devices is None:
         world = jax.devices()
         if n_devices is not None:
-            if not isinstance(n_devices, int) or isinstance(n_devices, bool) or n_devices < 2:
+            min_state = 2 if dsize == 1 else 1
+            if not isinstance(n_devices, int) or isinstance(n_devices, bool) or n_devices < min_state:
                 raise TorchMetricsUserError(
                     f"a state mesh needs an integer device count >= 2 (got {n_devices!r})"
+                    if dsize == 1
+                    else f"the 'state' axis of a (data, state) mesh needs an"
+                    f" integer size >= 1 (got {n_devices!r})"
                 )
-            if n_devices > len(world):
+            if dsize * n_devices > len(world):
                 raise TorchMetricsUserError(
-                    f"requested a {n_devices}-device state mesh but only"
+                    f"requested a {dsize}x{n_devices} (data, state) mesh but only"
+                    f" {len(world)} devices exist (CPU tests: raise"
+                    " --xla_force_host_platform_device_count)"
+                    if dsize > 1
+                    else f"requested a {n_devices}-device state mesh but only"
                     f" {len(world)} devices exist (CPU tests: raise"
                     " --xla_force_host_platform_device_count)"
                 )
-            world = world[:n_devices]
+            world = world[: dsize * n_devices]
+        elif dsize > 1:
+            if len(world) % dsize != 0:
+                raise TorchMetricsUserError(
+                    f"a data axis of {dsize} does not divide the {len(world)}-device"
+                    " world evenly; pass an explicit state size"
+                    " (e.g. mesh_context(data=2, state=2))"
+                )
+            world = world[: len(world)]
         devices = world
     if len(devices) < 2:
         raise TorchMetricsUserError(
             f"a state mesh needs >= 2 devices (got {len(devices)}); with one"
             " device every shard rule is a no-op — leave sharding off instead"
         )
+    if dsize > 1:
+        if len(devices) % dsize != 0:
+            raise TorchMetricsUserError(
+                f"a data axis of {dsize} does not divide the {len(devices)}-device"
+                " list evenly — a (data, state) mesh must be rectangular"
+            )
+        # tmlint: disable=TM101 — `devices` is a host list of Device objects
+        return Mesh(np.asarray(devices).reshape(dsize, -1), (DATA_AXIS, STATE_AXIS))
     # tmlint: disable=TM101 — `devices` is a host list of Device objects
     return Mesh(np.asarray(devices), (STATE_AXIS,))
 
@@ -153,12 +212,31 @@ def _env_mesh():
         return None
     if raw in ("1", "on", "all"):
         return build_mesh()
+    if "x" in raw:
+        # 2-D "DxS" spec: data x state (e.g. "2x4" = 2-row data axis over a
+        # 4-device state axis). "1xS" is exactly the 1-D S-device mesh.
+        head, _, tail = raw.partition("x")
+        try:
+            dn, sn = int(head), int(tail)
+        except ValueError:
+            raise TorchMetricsUserError(
+                f"{SHARD_ENV_VAR}={raw!r} is not a valid mesh spec (expected"
+                " unset/'0'/'off', '1'/'on'/'all', an integer N >= 2, or a 2-D"
+                " 'DxS' data-by-state spec such as '2x4')"
+            ) from None
+        if dn < 1 or sn < 1 or dn * sn < 2:
+            raise TorchMetricsUserError(
+                f"{SHARD_ENV_VAR}={raw!r} names a {dn}x{sn} mesh — both axes"
+                " must be >= 1 and the mesh must span >= 2 devices"
+            )
+        return build_mesh(sn, data=dn) if dn > 1 else build_mesh(sn)
     try:
         n = int(raw)
     except ValueError:
         raise TorchMetricsUserError(
             f"{SHARD_ENV_VAR}={raw!r} is not a valid state-mesh size (expected"
-            " unset/'0'/'off', '1'/'on'/'all', or an integer N >= 2)"
+            " unset/'0'/'off', '1'/'on'/'all', an integer N >= 2, or a 2-D"
+            " 'DxS' data-by-state spec such as '2x4')"
         ) from None
     return build_mesh(n)
 
@@ -170,15 +248,24 @@ def metric_mesh():
     return _env_mesh()
 
 
-def set_mesh(mesh: Any = None) -> None:
+def set_mesh(mesh: Any = None, *, data: Optional[int] = None, state: Optional[int] = None) -> None:
     """Force the state mesh process-wide.
 
     Accepts a ready :class:`jax.sharding.Mesh`, an integer device count,
     ``True`` (all local devices), or ``False`` (force sharding OFF regardless
     of the env var — the same spelling :func:`mesh_context` accepts); ``None``
-    restores env-var resolution.
+    restores env-var resolution. ``data=``/``state=`` build a 2-D
+    ``(data, state)`` mesh instead (``state=None`` spreads the remaining
+    devices); they are mutually exclusive with a positional ``mesh``.
     """
     global _mesh_override
+    if data is not None or state is not None:
+        if mesh is not None and mesh is not True:
+            raise TorchMetricsUserError(
+                "pass either a mesh/device-count or data=/state= axis sizes, not both"
+            )
+        _mesh_override = build_mesh(state, data=data)
+        return
     if mesh is None:
         _mesh_override = _UNSET
     elif mesh is False:
@@ -194,18 +281,25 @@ def set_mesh(mesh: Any = None) -> None:
 
 
 @contextmanager
-def mesh_context(mesh: Any = True) -> Generator[Any, None, None]:
+def mesh_context(
+    mesh: Any = True, *, data: Optional[int] = None, state: Optional[int] = None
+) -> Generator[Any, None, None]:
     """Scoped state-mesh activation (tests, benches, serving loops).
 
     ``mesh`` as in :func:`set_mesh` (``False`` forces sharding OFF inside the
-    scope regardless of the env var). Yields the active mesh (or ``None``).
-    Placement happens at ``add_state`` / :func:`reshard_states` time — states
-    born inside the scope stay sharded after it exits (arrays are committed);
-    only NEW placements see the restored policy.
+    scope regardless of the env var); ``mesh_context(data=N, state=M)``
+    activates a 2-D ``(data, state)`` mesh instead. Yields the active mesh
+    (or ``None``). Placement happens at ``add_state`` /
+    :func:`reshard_states` time — states born inside the scope stay sharded
+    after it exits (arrays are committed); only NEW placements see the
+    restored policy.
     """
     global _mesh_override
     prev = _mesh_override
-    set_mesh(mesh)
+    if data is not None or state is not None:
+        set_mesh(None if mesh is True else mesh, data=data, state=state)
+    else:
+        set_mesh(mesh)
     try:
         yield metric_mesh()
     finally:
@@ -217,10 +311,118 @@ def sharding_enabled() -> bool:
     return metric_mesh() is not None
 
 
+# ------------------------------------------------------------------ multi-host
+
+# one-way latch: jax.distributed.initialize is once-per-process by contract
+_multihost_initialized = False
+
+
+def multihost_spec() -> Optional[Dict[str, Any]]:
+    """Parse ``TORCHMETRICS_TPU_MULTIHOST`` — the pod-slice formation knob.
+
+    ``""``/``"0"``/``"off"`` = off (``None``); ``"1"``/``"on"``/``"auto"`` =
+    auto-detected coordinator (``jax.distributed.initialize()`` with no
+    arguments — the TPU-pod default, where the runtime publishes the
+    coordinator); an explicit ``"host:port:num_processes:process_id"`` spec
+    pins all three for CPU/GPU clusters and subprocess tests. Anything else
+    fails loud (the PR-7 env contract: a typo must not silently leave a pod
+    un-formed while the operator believes multi-host sync is on).
+    """
+    raw = os.environ.get(MULTIHOST_ENV_VAR, "").strip()
+    low = raw.lower()
+    if low in ("", "0", "off"):
+        return None
+    if low in ("1", "on", "auto"):
+        return {}
+    parts = raw.split(":")
+    if len(parts) == 4:
+        try:
+            return {
+                "coordinator_address": f"{parts[0]}:{int(parts[1])}",
+                "num_processes": int(parts[2]),
+                "process_id": int(parts[3]),
+            }
+        except ValueError:
+            pass
+    raise TorchMetricsUserError(
+        f"{MULTIHOST_ENV_VAR}={raw!r} is not a valid multi-host spec (expected"
+        " unset/'0'/'off', '1'/'on'/'auto', or 'host:port:num_processes:process_id')"
+    )
+
+
+def ensure_multihost() -> bool:
+    """Form the real pod slice the knob names (idempotent; False = knob off).
+
+    Called by :func:`build_mesh` before it reads ``jax.devices()``, so a mesh
+    built under ``TORCHMETRICS_TPU_MULTIHOST`` spans the GLOBAL device set of
+    a genuinely-initialized multi-process world — the emulated-world tests
+    gain a real pod-slice execution mode by flipping one env var. Failures
+    from ``jax.distributed.initialize`` propagate (a half-formed world must
+    not silently degrade to single-process semantics).
+    """
+    global _multihost_initialized
+    spec = multihost_spec()
+    if spec is None:
+        return False
+    if _multihost_initialized:
+        return True
+    import jax
+
+    already = False
+    try:
+        already = bool(jax.distributed.is_initialized())
+    except AttributeError:  # older jax: probe the client on the global state
+        state = getattr(jax.distributed, "global_state", None)
+        already = getattr(state, "client", None) is not None
+    if not already:
+        jax.distributed.initialize(**spec)
+    _multihost_initialized = True
+    _diag.record(
+        "multihost.init", "sharding",
+        processes=int(jax.process_count()), process=int(jax.process_index()),
+        explicit=bool(spec),
+    )
+    return True
+
+
 def axis_size() -> int:
     """Devices along the ``"state"`` axis of the active mesh (1 when off)."""
     mesh = metric_mesh()
-    return 1 if mesh is None else int(mesh.shape[STATE_AXIS])
+    return 1 if mesh is None else int(dict(mesh.shape).get(STATE_AXIS, 1))
+
+
+def data_axis_size() -> int:
+    """Devices along the ``"data"`` axis of the active mesh (1 when off/1-D).
+
+    A live data axis (>= 2) is the epoch engine's trigger to lower the
+    cross-rank fold of replicated states onto the mesh as in-graph
+    collectives (``engine/epoch.py``) instead of the host packed gather.
+    """
+    mesh = metric_mesh()
+    return 1 if mesh is None else int(dict(mesh.shape).get(DATA_AXIS, 1))
+
+
+def shard_batch(x: Any) -> Any:
+    """``device_put`` dim 0 of an update input over the ``"data"`` mesh axis.
+
+    The input-side half of the 2-D story: states partition over ``"state"``,
+    per-batch update inputs shard over ``"data"`` so the SPMD update
+    executable computes each data row's contribution shard-locally. A no-op
+    (the value is returned untouched) when no data axis is live or the
+    leading dim is not divisible by it — inputs are transient, so degrading
+    silently here is exact, unlike state placement which records.
+    """
+    mesh = metric_mesh()
+    n = data_axis_size()
+    if mesh is None or n < 2:
+        return x
+    shape = tuple(getattr(x, "shape", ()))
+    if not shape or shape[0] % n != 0:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(x, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
 
 
 # ------------------------------------------------------------------ predicates
@@ -263,14 +465,34 @@ def spans_processes(value: Any) -> bool:
         return False
 
 
+def _record_degrade(spec: Any, reason: str, shape: Tuple[int, ...], axis: int) -> None:
+    """One degrade-to-replication: counted (``shard_degrades``) AND recorded.
+
+    An active mesh failing to shard is an operator-visible fact — the event
+    narrates it, the counter exports it (``tm_tpu_shard_degrades_total``), so
+    a fleet where "sharding is on" but rules quietly replicate is discoverable
+    from a scrape, not only from a flight-recorder dump.
+    """
+    _STATS.shard_degrades += 1
+    _diag.record(
+        "shard.fallback", "sharding",
+        state=getattr(spec, "name", ""), rule=getattr(spec, "shard_rule", ""),
+        reason=reason, shape=shape, axis=axis,
+    )
+
+
 def partition_dim0(spec: Any, value: Any = None):
     """Resolve a dim-0 partition rule to a ``NamedSharding``, or ``None``.
 
     ``None`` (replicate) when: no active mesh, no value to inspect, a scalar
-    value, or a leading dim the mesh axis does not divide evenly (JAX's
-    ``device_put`` requires divisibility; padding a *state* would corrupt fold
-    semantics, so the rule degrades instead — recorded as a ``shard.fallback``
-    event, since an active mesh failing to shard is an operator-visible fact).
+    value, a mesh with no live ``"state"`` axis (a data-only 2-D mesh), or a
+    leading dim the state axis does not divide evenly (JAX's ``device_put``
+    requires divisibility; padding a *state* would corrupt fold semantics, so
+    the rule degrades instead — recorded as a ``shard.fallback`` event and
+    counted in ``shard_degrades``, since an active mesh failing to shard is
+    an operator-visible fact). On a 2-D mesh the resolved sharding partitions
+    dim 0 over ``"state"`` and replicates over ``"data"`` — exactly the
+    placement the in-graph epoch fold expects.
     """
     mesh = metric_mesh()
     if mesh is None or value is None:
@@ -278,15 +500,162 @@ def partition_dim0(spec: Any, value: Any = None):
     from jax.sharding import NamedSharding, PartitionSpec
 
     shape = tuple(getattr(value, "shape", ()))
-    n = int(mesh.shape[STATE_AXIS])
-    if not shape or shape[0] % n != 0:
-        _diag.record(
-            "shard.fallback", "sharding",
-            state=getattr(spec, "name", ""), rule=getattr(spec, "shard_rule", ""),
-            reason="indivisible" if shape else "scalar", shape=shape, axis=n,
-        )
+    n = int(dict(mesh.shape).get(STATE_AXIS, 1))
+    if not shape or n < 2 or shape[0] % n != 0:
+        reason = "scalar" if not shape else ("no-state-axis" if n < 2 else "indivisible")
+        _record_degrade(spec, reason, shape, n)
         return None
     return NamedSharding(mesh, PartitionSpec(STATE_AXIS))
+
+
+# ------------------------------------------------------------------ rule table
+
+# per-state-name partition rules (regex -> PartitionSpec axes), consulted by
+# ``statespec.resolve_shard_rule`` BEFORE the named SHARD_RULES entry — the
+# operator-side override channel: shard an out-of-tree metric's states without
+# touching its class declarations, or pin one state of a declared family to a
+# different layout. Empty by default (zero cost until set).
+_partition_rules: Tuple[Tuple[Any, Tuple[Optional[str], ...]], ...] = ()
+
+
+def _compile_rules(rules: Optional[Sequence[Tuple[str, Any]]]):
+    import re
+
+    from jax.sharding import PartitionSpec
+
+    compiled = []
+    for entry in rules or ():
+        try:
+            pattern, spec = entry
+        except (TypeError, ValueError):
+            raise TorchMetricsUserError(
+                f"partition rules are (regex, spec) pairs (got {entry!r})"
+            ) from None
+        try:
+            rx = re.compile(pattern)
+        except re.error as exc:
+            raise TorchMetricsUserError(
+                f"invalid partition-rule regex {pattern!r}: {exc}"
+            ) from None
+        if spec is None:
+            axes: Tuple[Optional[str], ...] = ()
+        elif isinstance(spec, str):
+            axes = (spec,)
+        elif isinstance(spec, PartitionSpec):
+            axes = tuple(spec)
+        elif isinstance(spec, (tuple, list)):
+            axes = tuple(spec)
+        else:
+            raise TorchMetricsUserError(
+                f"partition rule {pattern!r} names an unsupported spec {spec!r}"
+                " (expected None, an axis name, a tuple of axis names/None, or"
+                " a jax.sharding.PartitionSpec)"
+            )
+        for ax in axes:
+            if ax is not None and ax not in (DATA_AXIS, STATE_AXIS):
+                raise TorchMetricsUserError(
+                    f"partition rule {pattern!r} names unknown mesh axis {ax!r}"
+                    f" (known axes: {DATA_AXIS!r}, {STATE_AXIS!r})"
+                )
+        compiled.append((rx, axes))
+    return tuple(compiled)
+
+
+def set_partition_rules(rules: Optional[Sequence[Tuple[str, Any]]]) -> None:
+    """Install the process-wide per-state-name partition-rule table.
+
+    ``rules`` is an ordered sequence of ``(regex, spec)`` pairs; the first
+    regex that matches a state's qualified name (``"<MetricClass>/<state>"``
+    when the owner is known, the bare state name otherwise — matching is
+    ``re.search``, so an unanchored bare-name pattern matches both forms)
+    wins. ``spec`` names the per-dim mesh axes: an axis name string (dim 0),
+    a tuple like ``("state", None)`` / ``("data",)``, a ready
+    ``jax.sharding.PartitionSpec``, or ``None`` to force replication.
+    Validation is eager and loud (the PR-7 env contract's spirit): a bad
+    regex or an unknown axis raises at install, never at first placement.
+    ``None``/``()`` clears the table.
+    """
+    global _partition_rules
+    _partition_rules = _compile_rules(rules)
+
+
+@contextmanager
+def partition_rules_context(
+    rules: Optional[Sequence[Tuple[str, Any]]],
+) -> Generator[None, None, None]:
+    """Scoped partition-rule table (tests, benches) — see :func:`set_partition_rules`."""
+    global _partition_rules
+    prev = _partition_rules
+    _partition_rules = _compile_rules(rules)
+    try:
+        yield
+    finally:
+        _partition_rules = prev
+
+
+def partition_rules_active() -> bool:
+    """Whether any per-state-name partition rule is installed (cheap gate)."""
+    return bool(_partition_rules)
+
+
+def match_partition_rule(name: str, owner: str = ""):
+    """First table entry matching ``owner/name`` — ``(pattern, axes)`` or ``None``."""
+    if not _partition_rules:
+        return None
+    qualified = f"{owner}/{name}" if owner else name
+    for rx, axes in _partition_rules:
+        if rx.search(qualified):
+            return (rx.pattern, axes)
+    return None
+
+
+def apply_partition_rule(spec: Any, value: Any, axes: Sequence[Optional[str]]):
+    """Resolve a table entry's per-dim axes to a ``NamedSharding`` (or ``None``).
+
+    Per-dim divisibility-checked: a dim whose named mesh axis is absent
+    (< 2 devices), out of the value's rank, or does not divide evenly
+    degrades to ``None`` (replicated along that dim) — recorded once per
+    resolution via ``shard.fallback`` + ``shard_degrades``, like the named
+    rules. A fully-degraded (or explicitly replicating) entry returns
+    ``None``.
+    """
+    mesh = metric_mesh()
+    if mesh is None or value is None:
+        return None
+    if not any(a is not None for a in axes):
+        return None  # explicit replicate entry — intent, not degradation
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shape = tuple(getattr(value, "shape", ()))
+    if not shape:
+        _record_degrade(spec, "scalar", shape, 0)
+        return None
+    sizes = dict(mesh.shape)
+    resolved = []
+    degraded_reason = ""
+    for i, ax in enumerate(axes):
+        if ax is None:
+            resolved.append(None)
+            continue
+        n = int(sizes.get(ax, 1))
+        if n < 2:
+            degraded_reason = degraded_reason or "axis-missing"
+            resolved.append(None)
+        elif i >= len(shape):
+            degraded_reason = degraded_reason or "rank-mismatch"
+            resolved.append(None)
+        elif shape[i] % n != 0:
+            degraded_reason = degraded_reason or "indivisible"
+            resolved.append(None)
+        else:
+            resolved.append(ax)
+    if degraded_reason:
+        _record_degrade(spec, degraded_reason, shape, int(sizes.get(STATE_AXIS, 1)))
+    while resolved and resolved[-1] is None:
+        resolved.pop()
+    if not any(resolved):
+        return None
+    return NamedSharding(mesh, PartitionSpec(*resolved))
 
 
 # ------------------------------------------------------------------ placement
@@ -302,7 +671,7 @@ def place_state(metric: Any, name: str, value: Any, spec: Any) -> Any:
     """
     from torchmetrics_tpu.engine import statespec as _statespec
 
-    sharding = _statespec.resolve_shard_rule(spec, value)
+    sharding = _statespec.resolve_shard_rule(spec, value, owner=type(metric).__name__)
     if sharding is None:
         return value
     import jax
@@ -337,9 +706,13 @@ def reshard_states(metric: Any) -> int:
     import jax
 
     placed = 0
+    owner = type(metric).__name__
     residuals = metric.__dict__.get("_comp_residuals") or {}
     for name, spec in specs.items():
-        if getattr(spec, "shard_rule", "replicate") == "replicate":
+        if (
+            getattr(spec, "shard_rule", "replicate") == "replicate"
+            and match_partition_rule(name, owner) is None
+        ):
             continue
         for holder, getter, setter in (
             ("state", lambda: getattr(metric, name, None),
@@ -352,7 +725,7 @@ def reshard_states(metric: Any) -> int:
             value = getter()
             if value is None or isinstance(value, list) or not hasattr(value, "shape"):
                 continue
-            sharding = _statespec.resolve_shard_rule(spec, value)
+            sharding = _statespec.resolve_shard_rule(spec, value, owner=owner)
             if sharding is None or getattr(value, "sharding", None) == sharding:
                 continue
             setter(jax.device_put(value, sharding))
@@ -438,6 +811,9 @@ def shard_report() -> Dict[str, Any]:
     return {
         "active": mesh is not None,
         "axis_size": axis_size(),
+        "data_axis_size": data_axis_size(),
         "devices": [] if mesh is None else [int(d.id) for d in mesh.devices.flat],
         "shard_states": _STATS.shard_states,
+        "shard_degrades": _STATS.shard_degrades,
+        "partition_rules": len(_partition_rules),
     }
