@@ -113,6 +113,16 @@ _TRACKED = (
     ("heavy", "map_retraces_after_warmup", "max"),
     ("heavy", "bert_warm_retraces", "max"),
     ("heavy", "fid_host_eighs_clean", "max"),
+    # zero-cold-start serving (PR 17): the warm-over-cold TTFD fraction and
+    # absolute deserialize cost are trajectory evidence (machine-dependent;
+    # check_counters owns the <= 10% gate); envelope rejects and host
+    # transfers on the load path must never creep above zero.
+    ("coldstart", "coldstart_warm_ttfd_frac", None),
+    ("coldstart", "coldstart_warm_prewarm_ms", None),
+    ("coldstart", "warm_deserialize_ms", None),
+    ("coldstart", "persist_hits", None),
+    ("coldstart", "coldstart_envelope_rejects", "max"),
+    ("coldstart", "coldstart_host_transfers", "max"),
 )
 
 #: the multi-chip evidence trajectory (MULTICHIP_r*.json, PR 12 onward): the

@@ -369,6 +369,17 @@ _CHECKS = (
     ("heavy", "bert_warm_retraces", "abs", 0),  # ragged stream inside warm buckets
     ("heavy", "bert_host_transfers", "abs", 0),  # score path under STRICT
     ("heavy", "heavy_retraces_uncaused", "abs", 0),
+    # zero-cold-start serving (PR 17): two child processes share a persist
+    # dir — the warm replica must first-dispatch out of the cache, with the
+    # prewarm replay proven (replays > 0), every artifact accepted (no
+    # envelope rejects on a same-topology reload), and a readback-free
+    # deserialize/prewarm path under the STRICT guard
+    ("coldstart", "coldstart_warm_ttfd_frac", "abs", 0.10),  # warm TTFD <= 10% of uncached
+    ("coldstart", "persist_hits", "true", None),  # warm leg loaded from the cache
+    ("coldstart", "prewarm_replays", "true", None),  # manifest replay actually dispatched
+    ("coldstart", "coldstart_envelope_rejects", "abs", 0),  # same topology -> zero rejects
+    ("coldstart", "coldstart_host_transfers", "abs", 0),  # both legs under STRICT
+    ("coldstart", "values_match", "true", None),  # prewarm replay is value-inert
 )
 
 
@@ -409,7 +420,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "multichip_2d", "heavy"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

@@ -34,6 +34,38 @@ if ! python -m tools.tmlint torchmetrics_tpu/; then
 fi
 
 echo
+echo "=== persist wiring (zero-cold-start serving) ==="
+# The numeric cold-start proof gates in check_counters (coldstart scenario)
+# and the durability contract gates in tmlint (TM701/TM702); this block pins
+# the WIRING neither sees from one file alone: every engine compile funnel
+# must record a prewarm-manifest row, and the sidecar must run the warm
+# handoff — losing either silently turns prewarm into a no-op.
+persist_ok=1
+for f in compiled fusion epoch scan; do
+  if ! grep -q '_persist\.record_compile' "torchmetrics_tpu/engine/$f.py"; then
+    echo "persist: engine/$f.py lost its record_compile manifest site"
+    persist_ok=0
+  fi
+done
+if ! grep -q 'warm_start' torchmetrics_tpu/serve/sidecar.py; then
+  echo "persist: serve/sidecar.py lost the warm_start handoff"
+  persist_ok=0
+fi
+if ! grep -q 'TORCHMETRICS_TPU_PERSIST' torchmetrics_tpu/engine/config.py; then
+  echo "persist: TORCHMETRICS_TPU_PERSIST missing from KNOB_REGISTRY"
+  persist_ok=0
+fi
+if ! grep -q 'try_load_executable' torchmetrics_tpu/diag/costs.py; then
+  echo "persist: diag/costs.py aot funnel lost its cache-load path"
+  persist_ok=0
+fi
+if [[ $persist_ok -eq 1 ]]; then
+  echo "persist wiring: ok"
+else
+  status=1
+fi
+
+echo
 echo "=== bench smoke (CPU) ==="
 # The r05 regression class: bench.py must degrade to partial JSON with explicit
 # status markers and rc=0 when no TPU exists — never die with a traceback.

@@ -1,0 +1,350 @@
+"""Persistent executable cache + prewarm manifest tests (engine/persist.py):
+the zero-cold-start serving tier. Covers the env-knob fail-loud contract,
+store/load round-trips with hit/miss accounting, the compatibility-envelope
+rejection path (a stale artifact is a counted miss, never a wrong load),
+corrupt-artifact skip with last-good recompile, manifest journal round-trips,
+value-inert prewarm replay, the warm-replica handoff (prewarm +
+``restore_latest`` parity), and STRICT-guard cleanliness of the whole load
+path."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.diag import diag_context, transfer_guard
+from torchmetrics_tpu.engine import engine_context
+from torchmetrics_tpu.engine import persist as persist_mod
+from torchmetrics_tpu.engine.persist import (
+    PERSIST_ENV_VAR,
+    PersistEnvelopeError,
+    load_executable,
+    load_manifest,
+    persist_context,
+    persist_dir,
+    persist_state,
+    prewarm,
+    record_compile,
+    store_executable,
+    try_load_executable,
+    warm_start,
+)
+from torchmetrics_tpu.parallel.elastic import (
+    save_state_shard,
+    shard_path,
+    state_fingerprint,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+
+
+def _acc(**kw):
+    kw.setdefault("validate_args", False)
+    return MulticlassAccuracy(NUM_CLASSES, average="macro", **kw)
+
+
+def _batch(n=32, seed=3):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(n, NUM_CLASSES).astype(np.float32)),
+        jnp.asarray(rng.randint(0, NUM_CLASSES, n).astype(np.int32)),
+    )
+
+
+def _compiled_probe(scale=2.0):
+    def fn(x):
+        return (x * scale + 1.0).sum()
+
+    x = jnp.ones((16, 4))
+    return jax.jit(fn).lower(x).compile(), x
+
+
+# ----------------------------------------------------------- env contract
+
+
+def test_env_contract_fail_loud(monkeypatch):
+    monkeypatch.delenv(PERSIST_ENV_VAR, raising=False)
+    assert persist_dir() is None
+    for off in ("0", "off", "OFF"):
+        monkeypatch.setenv(PERSIST_ENV_VAR, off)
+        assert persist_dir() is None
+    monkeypatch.setenv(PERSIST_ENV_VAR, "/some/cache/dir")
+    assert persist_dir() == "/some/cache/dir"
+    # the PR-7 contract: an empty value is a misconfiguration, never a
+    # silent disable
+    monkeypatch.setenv(PERSIST_ENV_VAR, "")
+    with pytest.raises(TorchMetricsUserError):
+        persist_dir()
+    monkeypatch.setenv(PERSIST_ENV_VAR, "   ")
+    with pytest.raises(TorchMetricsUserError):
+        persist_dir()
+
+
+def test_persist_context_overrides_and_restores(monkeypatch, tmp_path):
+    monkeypatch.delenv(PERSIST_ENV_VAR, raising=False)
+    with persist_context(str(tmp_path)):
+        assert persist_dir() == str(tmp_path)
+        with persist_context(None):
+            assert persist_dir() is None
+        assert persist_dir() == str(tmp_path)
+    assert persist_dir() is None
+
+
+# ------------------------------------------------- store/load round-trip
+
+
+def test_store_load_roundtrip_counts_hits_and_misses(tmp_path):
+    compiled, x = _compiled_probe()
+    want = float(np.asarray(compiled(x)))
+    with persist_context(str(tmp_path)):
+        before = persist_state()
+        assert try_load_executable("Probe", "update", "sig-a") is None  # cold miss
+        assert store_executable("Probe", "update", "sig-a", compiled)
+        loaded = try_load_executable("Probe", "update", "sig-a")
+        assert loaded is not None
+        assert float(np.asarray(loaded(x))) == pytest.approx(want)
+        after = persist_state()
+    assert after["misses"] - before["misses"] == 1
+    assert after["stores"] - before["stores"] == 1
+    assert after["hits"] - before["hits"] == 1
+    assert after["stored_bytes"] > before["stored_bytes"]
+    assert after["deserialize_ms"] > before["deserialize_ms"]
+
+
+def test_envelope_mismatch_is_counted_miss_never_a_load(tmp_path):
+    compiled, _ = _compiled_probe()
+    with persist_context(str(tmp_path)):
+        assert store_executable("Probe", "update", "sig-env", compiled)
+        path = persist_mod._artifact_path(str(tmp_path), "Probe", "update", "sig-env")
+        with open(path, "rb") as fh:
+            record = pickle.load(fh)
+        # a hand-moved artifact from another deployment: same filename, but
+        # the INNER envelope (re-verified at load) no longer matches
+        record["envelope"] = dict(record["envelope"], jax="0.0.1")
+        with open(path, "wb") as fh:
+            pickle.dump(record, fh)
+        with pytest.raises(PersistEnvelopeError) as err:
+            load_executable("Probe", "update", "sig-env")
+        assert "jax" in str(err.value)  # names the stale key, loud
+        before = persist_state()
+        with diag_context(capacity=64) as rec:
+            assert try_load_executable("Probe", "update", "sig-env") is None
+        after = persist_state()
+        assert after["envelope_rejects"] - before["envelope_rejects"] == 1
+        assert after["misses"] - before["misses"] == 1
+        assert rec.count("persist.fallback") == 1
+
+
+def test_cross_topology_filename_miss(tmp_path):
+    # the envelope digest is folded into the artifact FILENAME: a different
+    # topology looks up a different path and misses naturally, so no file of
+    # another topology can even be opened
+    compiled, _ = _compiled_probe()
+    with persist_context(str(tmp_path)):
+        assert store_executable("Probe", "update", "sig-t", compiled)
+        path = persist_mod._artifact_path(str(tmp_path), "Probe", "update", "sig-t")
+    env = persist_mod.compat_envelope()
+    other = dict(env, device_count=env["device_count"] + 1)
+    a = persist_mod._envelope_digest(env)
+    b = persist_mod._envelope_digest(other)
+    assert a != b
+    assert os.path.basename(path) not in (b,)
+
+
+def test_corrupt_artifact_skipped_loud_with_last_good_recompile(tmp_path):
+    compiled, x = _compiled_probe()
+    want = float(np.asarray(compiled(x)))
+    with persist_context(str(tmp_path)):
+        assert store_executable("Probe", "update", "sig-c", compiled)
+        path = persist_mod._artifact_path(str(tmp_path), "Probe", "update", "sig-c")
+        with open(path, "wb") as fh:
+            fh.write(b"\x00garbage, not a pickle")
+        before = persist_state()
+        with diag_context(capacity=64) as rec:
+            assert try_load_executable("Probe", "update", "sig-c") is None
+        after = persist_state()
+        assert after["corrupt_skips"] - before["corrupt_skips"] == 1
+        assert rec.count("persist.fallback") == 1
+        # last-good behavior: the caller recompiles and re-stores; the next
+        # replica loads clean
+        assert store_executable("Probe", "update", "sig-c", compiled)
+        loaded = try_load_executable("Probe", "update", "sig-c")
+        assert loaded is not None
+        assert float(np.asarray(loaded(x))) == pytest.approx(want)
+
+
+# ------------------------------------------------------- manifest journal
+
+
+def test_manifest_roundtrip_and_dedup(tmp_path):
+    p, t = _batch()
+    with persist_context(str(tmp_path)):
+        record_compile("MulticlassAccuracy", "update", args=[p, t], bucket=32)
+        record_compile("epoch:MulticlassAccuracy", "compute")
+        # identical row: deduped by signature, not re-appended
+        record_compile("MulticlassAccuracy", "update", args=[p, t], bucket=32)
+        rows = load_manifest()
+    assert len(rows) == 2
+    upd = next(r for r in rows if r["kind"] == "update")
+    assert upd["owner"] == "MulticlassAccuracy"
+    assert upd["bucket"] == 32
+    assert upd["args"] == [[[32, NUM_CLASSES], "float32"], [[32], "int32"]]
+    assert upd["sig"]
+    comp = next(r for r in rows if r["kind"] == "compute")
+    assert comp["owner"] == "epoch:MulticlassAccuracy"
+    assert comp["args"] is None
+
+
+def test_manifest_corrupt_line_skipped_loud(tmp_path):
+    p, t = _batch()
+    with persist_context(str(tmp_path)):
+        record_compile("MulticlassAccuracy", "update", args=[p, t], bucket=32)
+        manifest = os.path.join(str(tmp_path), "manifest.jsonl")
+        with open(manifest, "a") as fh:
+            fh.write("{not json\n")
+        record_compile("epoch:MulticlassAccuracy", "compute")
+        before = persist_state()
+        with diag_context(capacity=64) as rec:
+            rows = load_manifest()
+        after = persist_state()
+    assert len(rows) == 2  # both good rows survive the bad line
+    assert after["corrupt_skips"] - before["corrupt_skips"] == 1
+    assert rec.count("persist.fallback") == 1
+
+
+def test_record_compile_noop_when_disabled(tmp_path, monkeypatch):
+    monkeypatch.delenv(PERSIST_ENV_VAR, raising=False)
+    p, t = _batch()
+    record_compile("MulticlassAccuracy", "update", args=[p, t], bucket=32)
+    assert not os.path.exists(os.path.join(str(tmp_path), "manifest.jsonl"))
+
+
+# ----------------------------------------------- engine funnel + prewarm
+
+
+def test_engine_compile_populates_cache_and_fresh_replica_hits(tmp_path):
+    p, t = _batch()
+    with persist_context(str(tmp_path)):
+        with engine_context(True):
+            cold = _acc()
+            before = persist_state()
+            cold.update(p, t)
+            cold_value = float(np.asarray(cold.compute()))
+            mid = persist_state()
+            assert mid["stores"] - before["stores"] >= 2  # update + compute
+            assert mid["misses"] - before["misses"] >= 2
+            assert cold._engine.stats.persist_misses >= 1
+            # a fresh instance = a fresh engine cache = this process's stand-in
+            # for a replacement replica: every compile loads instead
+            warm = _acc()
+            warm.update(p, t)
+            warm_value = float(np.asarray(warm.compute()))
+            after = persist_state()
+            assert after["hits"] - mid["hits"] >= 2
+            assert after["stores"] == mid["stores"]
+            assert warm._engine.stats.persist_hits >= 1
+    assert warm_value == pytest.approx(cold_value)
+    assert len(load_manifest(str(tmp_path))) >= 2
+
+
+def test_prewarm_fresh_replica_loads_from_cache(tmp_path):
+    p, t = _batch()
+    with persist_context(str(tmp_path)), engine_context(True):
+        seed = _acc()
+        seed.update(p, t)
+        seed.compute()
+
+        replica = _acc()  # fresh engine cache: every replay must LOAD
+        with diag_context(capacity=256) as rec:
+            report = prewarm(replica)
+        assert report["entries"] >= 2
+        assert report["replayed"] >= 2
+        assert report["failed"] == 0
+        assert report["hits"] >= 2
+        assert report["misses"] == 0
+        assert rec.count("persist.prewarm") == 1
+        assert persist_state()["prewarm_replays"] >= report["replayed"]
+
+
+def test_prewarm_is_value_inert_on_live_state(tmp_path):
+    p, t = _batch()
+    with persist_context(str(tmp_path)), engine_context(True):
+        live = _acc()
+        live.update(p, t)
+        fp_before = state_fingerprint(live)
+        value_before = float(np.asarray(live.compute()))
+        report = prewarm(live)  # executables already hot: replays re-dispatch
+        assert report["replayed"] >= 2
+        assert report["failed"] == 0
+        # zeros are NOT an identity for metric updates: state must be
+        # snapshotted/restored around the replay, bit-for-bit
+        assert state_fingerprint(live) == fp_before
+        assert float(np.asarray(live.compute())) == pytest.approx(value_before)
+
+
+def test_prewarm_without_directory_is_noop():
+    with persist_context(None):
+        report = prewarm(_acc())
+    assert report == {"entries": 0, "replayed": 0, "skipped": 0, "failed": 0}
+
+
+def test_warm_start_handoff_parity(tmp_path):
+    persist = str(tmp_path / "persist")
+    snaps = str(tmp_path / "snaps")
+    os.makedirs(snaps)
+    p, t = _batch(seed=11)
+    with persist_context(persist), engine_context(True):
+        donor = _acc()
+        donor.update(p, t)
+        donor_value = float(np.asarray(donor.compute()))
+        donor_fp = state_fingerprint(donor)
+        save_state_shard(donor, shard_path(os.path.join(snaps, "snap-000001"), 0, 1))
+
+        replica = _acc()
+        report = warm_start(replica, directory=persist, snapshot_dir=snaps)
+        assert report["replayed"] >= 2
+        assert report["restored_seq"] == 1
+        # serving-identical: restored states AND byte-identical value
+        assert state_fingerprint(replica) == donor_fp
+        assert float(np.asarray(replica.compute())) == pytest.approx(donor_value)
+
+
+def test_warm_path_is_strict_guard_clean(tmp_path):
+    p, t = _batch(seed=7)
+    with persist_context(str(tmp_path)), engine_context(True):
+        seed = _acc()
+        seed.update(p, t)
+        seed.compute()
+
+        replica = _acc()
+        before = persist_state()
+        with diag_context(capacity=256) as rec, transfer_guard("strict"):
+            prewarm(replica)
+            replica.update(p, t)
+            value = replica.compute()
+            jax.block_until_ready(value)
+        after = persist_state()
+        assert rec.count("transfer.host", "transfer.blocked") == 0
+        assert after["hits"] - before["hits"] >= 2
+
+
+def test_sidecar_runs_warm_handoff_before_serving(tmp_path):
+    from torchmetrics_tpu.serve.sidecar import MetricsSidecar
+
+    p, t = _batch(seed=9)
+    with persist_context(str(tmp_path)), engine_context(True):
+        seed = _acc()
+        seed.update(p, t)
+        seed.compute()
+
+        replica = _acc()
+        sidecar = MetricsSidecar(port=0, warm_target=replica, persist_dir=str(tmp_path))
+        with sidecar:
+            assert sidecar.warm_report is not None
+            assert sidecar.warm_report["replayed"] >= 2
+            assert sidecar.warm_report["failed"] == 0

@@ -284,11 +284,11 @@ def _oom_buckets(monkeypatch, bad_buckets):
     inputs sit in one of ``bad_buckets``."""
     real = costs_mod.aot_compile
 
-    def flaky(fn, owner="", kind="", args=(), donated_bytes=0):
+    def flaky(fn, owner="", kind="", args=(), donated_bytes=0, **kw):
         for a in args:
             if getattr(a, "ndim", 0) >= 1 and getattr(a, "shape", (0,))[0] in bad_buckets:
                 raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
-        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes, **kw)
 
     monkeypatch.setattr(costs_mod, "aot_compile", flaky)
 
@@ -370,11 +370,11 @@ def test_persistent_transient_failure_demotes_after_budget(monkeypatch):
     attempts = {"n": 0}
     real = costs_mod.aot_compile
 
-    def always_oom(fn, owner="", kind="", args=(), donated_bytes=0):
+    def always_oom(fn, owner="", kind="", args=(), donated_bytes=0, **kw):
         if kind == "update":
             attempts["n"] += 1
             raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
-        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes, **kw)
 
     monkeypatch.setattr(costs_mod, "aot_compile", always_oom)
     batches = _batches([50] * (txn_mod.TRANSIENT_RETRY_BUDGET + 3), seed=13)
@@ -636,11 +636,11 @@ def test_ladder_quarantines_whole_poisoned_batch(monkeypatch):
 
     real_aot = costs_mod.aot_compile
 
-    def oom_on_big(fn, owner="", kind="", args=(), donated_bytes=0):
+    def oom_on_big(fn, owner="", kind="", args=(), donated_bytes=0, **kw):
         for a in args:
             if getattr(a, "ndim", 0) >= 1 and getattr(a, "shape", (0,))[0] == bucket:
                 raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory")
-        return real_aot(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+        return real_aot(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes, **kw)
 
     monkeypatch.setattr(costs_mod, "aot_compile", oom_on_big)
     with engine_context(True, donate=True), quarantine_context(True):
@@ -683,12 +683,12 @@ def test_ladder_success_still_charges_transient_budget(monkeypatch):
     compile_attempts = {"n": 0}
     real = costs_mod.aot_compile
 
-    def flaky(fn, owner="", kind="", args=(), donated_bytes=0):
+    def flaky(fn, owner="", kind="", args=(), donated_bytes=0, **kw):
         for a in args:
             if getattr(a, "ndim", 0) >= 1 and getattr(a, "shape", (0,))[0] == 64:
                 compile_attempts["n"] += 1
                 raise _FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
-        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes)
+        return real(fn, owner=owner, kind=kind, args=args, donated_bytes=donated_bytes, **kw)
 
     monkeypatch.setattr(costs_mod, "aot_compile", flaky)
     steps = txn_mod.TRANSIENT_RETRY_BUDGET + 2

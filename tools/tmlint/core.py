@@ -346,11 +346,22 @@ def run_lint(
     baseline_path: Optional[Path] = None,
 ) -> Dict[str, Any]:
     """Run every rule family; returns findings, baselined + stale splits."""
-    from tools.tmlint import rules_counters, rules_events, rules_knobs, rules_locks, rules_riders, rules_transfer
+    from tools.tmlint import (
+        rules_counters,
+        rules_events,
+        rules_knobs,
+        rules_locks,
+        rules_persist,
+        rules_riders,
+        rules_transfer,
+    )
 
     root = Path(root).resolve() if root is not None else Path.cwd()
     project = Project(root, paths)
-    families = (rules_transfer, rules_knobs, rules_riders, rules_counters, rules_events, rules_locks)
+    families = (
+        rules_transfer, rules_knobs, rules_riders, rules_counters, rules_events,
+        rules_locks, rules_persist,
+    )
 
     findings: List[Finding] = []
     lines_by_path: Dict[str, List[str]] = {}

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from tools.tmlint.core import Finding, Project, SourceFile
 
-_SCOPE_SUFFIXES = ("engine/scan.py", "engine/async_dispatch.py")
+_SCOPE_SUFFIXES = ("engine/scan.py", "engine/async_dispatch.py", "engine/persist.py")
 _SCOPE_DIRS = ("/serve/",)
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 

@@ -1,0 +1,116 @@
+"""TM7xx — durability discipline for the persist tier.
+
+Everything the serving story trusts across a process boundary — elastic
+snapshots (``parallel/elastic.py``) and the persistent executable cache +
+prewarm manifest (``engine/persist.py``) — rides ONE write contract: a
+durable file either exists complete or not at all. The PR-5 snapshot code
+established it by convention (``.tmp`` + flush + fsync + ``os.replace``);
+this family makes it checked:
+
+- **TM701 non-atomic durable write** — a function in the persist tier that
+  opens a file for (over)writing must, in the same function, both fsync the
+  handle (``os.fsync``) and land it with an atomic ``os.replace`` — a bare
+  ``open(final, "wb")`` leaves a torn-artifact crash window a reader can
+  observe.
+- **TM702 unflushed durable append** — an append-mode open (the manifest
+  journal) must flush AND fsync in the same function: an append that dies in
+  the page cache silently loses the signature rows a later prewarm replays.
+
+Scope: ``engine/persist.py``, ``parallel/elastic.py``, plus any file carrying
+``# tmlint: scope=persist`` (test fixtures). Read-mode opens are exempt;
+``# tmlint: disable=TM701/TM702`` with a justification marks a deliberate
+non-durable write (none exist in-tree today).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.tmlint.core import Finding, Project, SourceFile
+
+_SCOPE_SUFFIXES = ("engine/persist.py", "parallel/elastic.py")
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    if "persist" in sf.scopes:
+        return True
+    return ("/" + sf.relpath).endswith(_SCOPE_SUFFIXES)
+
+
+def _open_mode(node: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open(...)`` call; None for non-open/dynamic."""
+    fn = node.func
+    if not (isinstance(fn, ast.Name) and fn.id == "open"):
+        return None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    else:
+        mode = next((kw.value for kw in node.keywords if kw.arg == "mode"), None)
+    if mode is None:
+        return "r"  # open(path) defaults to text read
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None  # dynamic mode: out of the rule's reach
+
+
+def _calls_attr(body: ast.AST, owner: str, attr: str) -> bool:
+    for node in ast.walk(body):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == attr
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == owner
+        ):
+            return True
+    return False
+
+
+def _calls_method(body: ast.AST, attr: str) -> bool:
+    return any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == attr
+        for node in ast.walk(body)
+    )
+
+
+def check_file(project: Project, sf: SourceFile) -> List[Finding]:
+    if not _in_scope(sf):
+        return []
+    findings: List[Finding] = []
+    for fn_node, info in sf.functions.items():
+        has_replace = _calls_attr(fn_node, "os", "replace")
+        has_fsync = _calls_attr(fn_node, "os", "fsync")
+        has_flush = _calls_method(fn_node, "flush")
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            # opens inside nested defs are that function's own finding
+            if sf.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef)) is not fn_node:
+                continue
+            mode = _open_mode(node)
+            if mode is None or ("w" not in mode and "a" not in mode and "+" not in mode):
+                continue
+            if "a" in mode:
+                if (not has_flush or not has_fsync) and not sf.suppressed("TM702", node.lineno):
+                    findings.append(
+                        Finding(
+                            "TM702", sf.relpath, node.lineno,
+                            f"append-mode durable write in {info.qualname!r} without"
+                            " flush+os.fsync in the same function — a journal line"
+                            " dying in the page cache silently loses prewarm rows",
+                        )
+                    )
+            else:
+                if (not has_replace or not has_fsync) and not sf.suppressed("TM701", node.lineno):
+                    findings.append(
+                        Finding(
+                            "TM701", sf.relpath, node.lineno,
+                            f"durable write in {info.qualname!r} without the atomic"
+                            " contract (os.fsync + os.replace in the same function) —"
+                            " write to a .tmp sibling, fsync, then os.replace it in",
+                        )
+                    )
+    return findings

@@ -73,7 +73,8 @@ class ExecutableCost:
         "owner", "kind", "signature", "arg_leaves", "arg_bytes", "flops",
         "bytes_accessed", "peak_bytes", "argument_bytes", "output_bytes",
         "temp_bytes", "generated_code_bytes", "donation_savings_bytes",
-        "compile_ms", "analyses_ok",
+        "compile_ms", "compiles", "cache_hits", "deserialize_ms",
+        "time_to_first_dispatch_ms", "analyses_ok",
     )
 
     def __init__(self, owner: str, kind: str, signature: str) -> None:
@@ -90,7 +91,11 @@ class ExecutableCost:
         self.temp_bytes: Optional[int] = None
         self.generated_code_bytes: Optional[int] = None
         self.donation_savings_bytes = 0
-        self.compile_ms = 0.0
+        self.compile_ms = 0.0  # wall-time summed over compiles (see `compiles` for the divisor)
+        self.compiles = 0  # real lower+compile passes (re-compiles of a dropped entry accumulate)
+        self.cache_hits = 0  # compiles served by deserializing a persisted executable
+        self.deserialize_ms = 0.0  # wall-time summed over persistent-cache loads
+        self.time_to_first_dispatch_ms: Optional[float] = None  # latest path to a ready executable: compile (cold) or deserialize (warm)
         self.analyses_ok = False
 
     def as_dict(self) -> Dict[str, Any]:
@@ -147,30 +152,78 @@ def _harvest_cost(entry: ExecutableCost, compiled: Any) -> None:
         pass
 
 
-def aot_compile(fn: Any, owner: str, kind: str, args: Sequence[Any], donated_bytes: int = 0) -> Any:
+def aot_compile(
+    fn: Any, owner: str, kind: str, args: Sequence[Any], donated_bytes: int = 0, stats: Any = None
+) -> Any:
     """Compile ``fn`` (a ``jax.jit`` wrapper) ahead-of-time for ``args`` and
     record a ledger entry; returns the executable to dispatch with.
 
+    With the persistent cache enabled (``TORCHMETRICS_TPU_PERSIST``, see
+    ``engine/persist.py``), a matching persisted executable is deserialized
+    instead — NO ``lower()``/``compile()`` at all, the artifact carries its
+    own arg trees — and every fresh compile is serialized back for the next
+    process. The persist key extends the arg-signature digest with the args'
+    placement token, so two same-shape compiles pinned to different devices
+    or shardings never collide on one artifact; hit/miss land on ``stats``
+    (the owning :class:`~torchmetrics_tpu.engine.stats.EngineStats`) and on
+    the ledger entry's ``cache_hits``/``deserialize_ms``/
+    ``time_to_first_dispatch_ms``.
+
     Tracing/compile errors propagate unchanged — they are the caller's
     eligibility signal (the same exceptions the lazy first dispatch would
-    raise). With the ledger disabled, ``fn`` is returned untouched and the
-    lazy jit dispatch path applies.
+    raise). With the ledger disabled AND persistence off, ``fn`` is returned
+    untouched and the lazy jit dispatch path applies.
     """
-    if not costs_enabled():
+    from torchmetrics_tpu.engine import persist as _persist
+
+    persist_on = _persist.persist_dir() is not None
+    if not costs_enabled() and not persist_on:
         return fn
+    digest, leaves, arg_bytes = _arg_signature(args)
+    entry: Optional[ExecutableCost] = None
+    if costs_enabled():
+        entry = _LEDGER.get((owner, kind, digest))
+        if entry is None:
+            entry = ExecutableCost(owner, kind, digest)
+            _LEDGER[(owner, kind, digest)] = entry
+        entry.arg_leaves = leaves
+        entry.arg_bytes = arg_bytes
+        entry.donation_savings_bytes = int(donated_bytes)
+
+    persist_sig = ""
+    if persist_on:
+        from torchmetrics_tpu.parallel.sharding import placement_token
+
+        try:
+            place = placement_token(list(args))
+        except Exception:  # noqa: BLE001 — placement is a key refinement, never a gate
+            place = ""
+        persist_sig = f"{digest}/{place}"
+        t0 = perf_counter()
+        compiled = _persist.try_load_executable(owner, kind, persist_sig)
+        if compiled is not None:
+            deserialize_ms = (perf_counter() - t0) * 1e3
+            if entry is not None:
+                entry.cache_hits += 1
+                entry.deserialize_ms += deserialize_ms
+                entry.time_to_first_dispatch_ms = round(deserialize_ms, 3)
+                _harvest_cost(entry, compiled)
+            if stats is not None:
+                stats.persist_hits += 1
+            return compiled
+        if stats is not None:
+            stats.persist_misses += 1
+
     t0 = perf_counter()
     compiled = fn.lower(*args).compile()
     compile_ms = (perf_counter() - t0) * 1e3
-    digest, leaves, arg_bytes = _arg_signature(args)
-    entry = _LEDGER.get((owner, kind, digest))
-    if entry is None:
-        entry = ExecutableCost(owner, kind, digest)
-        _LEDGER[(owner, kind, digest)] = entry
-    entry.arg_leaves = leaves
-    entry.arg_bytes = arg_bytes
-    entry.donation_savings_bytes = int(donated_bytes)
-    entry.compile_ms += compile_ms  # re-compiles of a dropped entry accumulate
-    _harvest_cost(entry, compiled)
+    if entry is not None:
+        entry.compiles += 1
+        entry.compile_ms += compile_ms  # re-compiles of a dropped entry accumulate
+        entry.time_to_first_dispatch_ms = round(compile_ms, 3)
+        _harvest_cost(entry, compiled)
+    if persist_on:
+        _persist.store_executable(owner, kind, persist_sig, compiled)
     return compiled
 
 
@@ -188,7 +241,8 @@ def ledger_snapshot() -> Dict[str, Any]:
         {
           "executables": [per-executable dicts, sorted],
           "totals": {"executables", "flops", "bytes_accessed", "peak_bytes_max",
-                     "compile_ms", "donation_savings_bytes"},
+                     "compile_ms", "compiles", "cache_hits", "deserialize_ms",
+                     "donation_savings_bytes"},
           "per_owner": {owner: same totals over that owner's executables},
         }
     """
@@ -201,6 +255,9 @@ def ledger_snapshot() -> Dict[str, Any]:
             "bytes_accessed": sum(r["bytes_accessed"] or 0.0 for r in rows),
             "peak_bytes_max": max((r["peak_bytes"] or 0 for r in rows), default=0),
             "compile_ms": round(sum(r["compile_ms"] for r in rows), 3),
+            "compiles": sum(r["compiles"] for r in rows),
+            "cache_hits": sum(r["cache_hits"] for r in rows),
+            "deserialize_ms": round(sum(r["deserialize_ms"] for r in rows), 3),
             "donation_savings_bytes": sum(r["donation_savings_bytes"] for r in rows),
         }
 

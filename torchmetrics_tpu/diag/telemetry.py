@@ -97,6 +97,13 @@ UNITLESS_COUNT_FAMILIES = frozenset({
     "tm_tpu_async_submits", "tm_tpu_async_dispatches", "tm_tpu_async_joins",
     "tm_tpu_async_backpressure_waits", "tm_tpu_async_replayed_steps",
     "tm_tpu_async_prefetches", "tm_tpu_async_queue_depth",
+    # persistent executable cache (engine/persist.py, PR 17): hit / miss /
+    # store / reject / replay event counts — pure counts; the time-valued
+    # deserialize series exports as *_seconds, artifact sizes as *_bytes
+    "tm_tpu_persist_hits", "tm_tpu_persist_misses", "tm_tpu_prewarm_replays",
+    "tm_tpu_persist_stores", "tm_tpu_persist_envelope_rejects",
+    "tm_tpu_persist_corrupt_skips", "tm_tpu_persist_fallbacks",
+    "tm_tpu_persist_manifest_entries",
 })
 
 # EngineStats fields exported as monotonic counters (everything countable);
@@ -153,6 +160,9 @@ _COUNTER_HELP = {
     "shard_degrades": "shard-rule resolutions degraded to replication",
     "ingraph_syncs": "packed exchanges that rode the data axis in-graph",
     "sync_noop_plans": "packed syncs skipped wholesale (every state live-sharded)",
+    "persist_hits": "compiles served by deserializing a persisted executable",
+    "persist_misses": "compiles with no loadable persisted artifact (absent/stale/corrupt)",
+    "prewarm_replays": "manifest rows replayed by prewarm before traffic landed",
 }
 
 # exposition-convention names for counters whose field name buries the unit:
@@ -220,6 +230,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
     from torchmetrics_tpu.diag.hist import histograms_snapshot
     from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
+    from torchmetrics_tpu.engine.persist import persist_state
     from torchmetrics_tpu.engine.stats import engine_report
     from torchmetrics_tpu.parallel.resilience import resilience_snapshot
 
@@ -237,6 +248,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
         "profile": profile_snapshot(),
         "resilience": resilience_snapshot(),
         "serve": serve_state(),
+        "persist": persist_state(),
     }
 
 
@@ -346,6 +358,32 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
         "fraction of touched sketch registers/cells (saturation)",
         [({"owner": s["owner"]}, s["fill_ratio"]) for s in serve.get("sketches", [])],
     )
+
+    # persistent executable cache (engine/persist.py): store/reject/fallback
+    # counters and the deserialize wall-time. Hit/miss/replay counts ride the
+    # EngineStats auto-export above (persist_hits/persist_misses/prewarm_replays).
+    persist = snap.get("persist", {})
+    emit(f"{_PREFIX}_persist_stores_total", "counter",
+         "executables serialized into the persistent cache",
+         [({}, persist.get("stores", 0))])
+    emit(f"{_PREFIX}_persist_stored_bytes_total", "counter",
+         "serialized artifact bytes written to the persistent cache",
+         [({}, persist.get("stored_bytes", 0))])
+    emit(f"{_PREFIX}_persist_deserialize_seconds_total", "counter",
+         "wall-time spent deserializing persisted executables",
+         [({}, persist.get("deserialize_ms", 0.0) / 1e3)])
+    emit(f"{_PREFIX}_persist_envelope_rejects_total", "counter",
+         "persisted artifacts rejected for a compatibility-envelope mismatch",
+         [({}, persist.get("envelope_rejects", 0))])
+    emit(f"{_PREFIX}_persist_corrupt_skips_total", "counter",
+         "corrupt persisted artifacts/manifest lines skipped loud",
+         [({}, persist.get("corrupt_skips", 0))])
+    emit(f"{_PREFIX}_persist_fallbacks_total", "counter",
+         "persist-tier degradations (native-cache fallback, failed replays)",
+         [({}, persist.get("fallbacks", 0))])
+    emit(f"{_PREFIX}_persist_manifest_entries", "gauge",
+         "prewarm-manifest rows recorded this process",
+         [({}, persist.get("manifest_entries", 0))])
 
     # latency/size distributions as PROPER histogram exposition: cumulative
     # `_bucket` samples with `le` labels (non-empty buckets + the mandatory

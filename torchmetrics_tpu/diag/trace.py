@@ -138,6 +138,8 @@ EVENT_KINDS = frozenset({
     "serve.snapshot", "serve.snapshot.read",
     # engine-wide fallbacks + transfer guard (engine/stats.py, diag/transfer_guard.py)
     "fallback", "transfer.host", "transfer.blocked",
+    # persistent executable cache + prewarm (engine/persist.py)
+    "persist.save", "persist.load", "persist.fallback", "persist.prewarm", "persist.manifest",
 })
 
 #: env knob: "1" = on (default capacity), int > 1 = capacity, "0"/unset = off

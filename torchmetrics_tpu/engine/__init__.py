@@ -71,6 +71,15 @@ from torchmetrics_tpu.engine.numerics import (
     set_compensated,
     set_drift_rtol,
 )
+from torchmetrics_tpu.engine.persist import (
+    PersistEnvelopeError,
+    PersistIntegrityError,
+    persist_context,
+    persist_state,
+    prewarm,
+    set_persist_dir,
+    warm_start,
+)
 from torchmetrics_tpu.engine.scan import scan_context, set_scan_steps
 from torchmetrics_tpu.engine.statespec import (
     StateSpec,
@@ -92,6 +101,8 @@ __all__ = [
     "EngineStats",
     "EpochEngine",
     "FusedUpdate",
+    "PersistEnvelopeError",
+    "PersistIntegrityError",
     "QuarantinedBatchError",
     "StateSpec",
     "async_context",
@@ -100,6 +111,9 @@ __all__ = [
     "engine_context",
     "engine_enabled",
     "engine_report",
+    "persist_context",
+    "persist_state",
+    "prewarm",
     "quarantine_context",
     "quarantine_report",
     "register_state_spec",
@@ -110,6 +124,8 @@ __all__ = [
     "set_cse",
     "set_drift_rtol",
     "set_engine_enabled",
+    "set_persist_dir",
     "set_quarantine_mode",
     "set_scan_steps",
+    "warm_start",
 ]

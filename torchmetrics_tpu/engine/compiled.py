@@ -36,6 +36,7 @@ from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine import numerics as _numerics
+from torchmetrics_tpu.engine import persist as _persist
 from torchmetrics_tpu.engine import statespec as _statespec
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
@@ -688,6 +689,13 @@ class CompiledUpdate:
         if first:
             st.traces += 1
             self._cache[key] = entry
+            # prewarm manifest: one row per compiled signature (specs only —
+            # zero-filled replays re-bucket to the identical executable)
+            _persist.record_compile(
+                st.owner, "update",
+                args=inputs[: len(args)], kw=dict(zip(kw_names, inputs[len(args):])),
+                bucket=bucket,
+            )
             fp = signature_fingerprint((len(args), kw_names), state_sig, in_sig, bucket, key[-1])
             cause = _diag.attribute_retrace(fp, list(self._fingerprints.values()))
             self._fingerprints[key] = fp
@@ -847,7 +855,9 @@ class CompiledUpdate:
         # dispatch, but the Compiled handle feeds the diag cost/memory ledger
         example = (example_state, np.int32(n_pad), *inputs) if bucketed else (example_state, *inputs)
         donated = sum(_nbytes(v) for v in example_state.values()) if donate else 0
-        fn = _costs.aot_compile(fn, owner=owner, kind="update", args=example, donated_bytes=donated)
+        fn = _costs.aot_compile(
+            fn, owner=owner, kind="update", args=example, donated_bytes=donated, stats=self.stats
+        )
         step_bytes = sum(_nbytes(v) for v in example_state.values()) + sum(_nbytes(a) for a in inputs)
         return fn, donate, annotation_scope(owner, "update", key), step_bytes
 

@@ -67,6 +67,8 @@ KNOB_REGISTRY = {
     # heavy-workload kernels (PR 15): FID host-eigh fallback + BERTScore buckets
     "TORCHMETRICS_TPU_FID_HOST_EIGH": "torchmetrics_tpu.image.fid:fid_host_eigh",
     "TORCHMETRICS_TPU_BERT_BUCKETS": "torchmetrics_tpu.functional.text.bert:bert_buckets_enabled",
+    # persistent executable cache (PR 17): zero-cold-start serving
+    "TORCHMETRICS_TPU_PERSIST": "torchmetrics_tpu.engine.persist:persist_dir",
 }
 
 #: parsers that read the env key through a ``name`` PARAMETER (shared

@@ -54,6 +54,7 @@ from torchmetrics_tpu.engine.compiled import (
     completion_probe,
     holds_nested_metrics,
 )
+from torchmetrics_tpu.engine import persist as _persist
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.stats import EngineStats
 from torchmetrics_tpu.parallel import packing as _packing
@@ -490,7 +491,8 @@ def _run_fold(
         if first:
             entry = (
                 _costs.aot_compile(
-                    jax.jit(plan.make_fold()), owner=stats.owner, kind="sync-fold", args=(gathered,)
+                    jax.jit(plan.make_fold()), owner=stats.owner, kind="sync-fold",
+                    args=(gathered,), stats=stats,
                 ),
                 annotation_scope(stats.owner, "sync-fold", sig),
             )
@@ -617,7 +619,8 @@ class EpochEngine:
 
                 entry = (
                     _costs.aot_compile(
-                        jax.jit(fused), owner=owner, kind="sync-compute", args=(gathered, live)
+                        jax.jit(fused), owner=owner, kind="sync-compute", args=(gathered, live),
+                        stats=self.stats,
                     ),
                     annotation_scope(owner, "sync-compute", sig),
                 )
@@ -644,6 +647,7 @@ class EpochEngine:
             self._fused_cache[sig] = entry
             self.stats.compute_traces += 1
             self.stats.sync_fold_traces += 1
+            _persist.record_compile(self.stats.owner, "sync-compute")
             fp = _plan_fingerprint(plan, mode)
             if live:
                 # the live sharded leaves are fused-graph inputs too: their
@@ -750,7 +754,9 @@ class EpochEngine:
                     jitted = jax.jit(compute_only)
                     example = (state,)
                 entry = (
-                    _costs.aot_compile(jitted, owner=owner, kind="compute", args=example),
+                    _costs.aot_compile(
+                        jitted, owner=owner, kind="compute", args=example, stats=self.stats
+                    ),
                     annotation_scope(owner, "compute", key),
                 )
             fn, scope = entry
@@ -780,6 +786,9 @@ class EpochEngine:
         if first:
             self._compute_cache[key] = entry
             self.stats.compute_traces += 1
+            # prewarm manifest: compute rows carry no specs — prewarm replays
+            # them as one compute() per owner against the live topology
+            _persist.record_compile(self.stats.owner, "compute")
             fp = _compute_fingerprint(sig, key[1])
             # the sentinel joins the executable's pytree: a toggle must read
             # as treedef-change, not as an unattributed ("unknown") retrace

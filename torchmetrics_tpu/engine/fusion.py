@@ -32,6 +32,7 @@ from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine import numerics as _numerics
+from torchmetrics_tpu.engine import persist as _persist
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
@@ -375,6 +376,8 @@ class FusedUpdate:
         if first:
             st.traces += 1
             self._cache[key] = entry
+            # prewarm manifest: fused steps are positional-only by contract
+            _persist.record_compile(st.owner, "fused", args=inputs, bucket=bucket)
             fused_sig = tuple((name, sig) for name, sig in state_sig if name in fused_names)
             fp = self._fingerprint(fused_sig, in_sig, bucket)
             cause = _diag.attribute_retrace(fp, list(self._fingerprints.values()))
@@ -477,7 +480,10 @@ class FusedUpdate:
             getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(example_states)
         )
         donated = state_bytes if donate else 0
-        fn = _costs.aot_compile(fn, owner=self.stats.owner, kind="fused", args=example, donated_bytes=donated)
+        fn = _costs.aot_compile(
+            fn, owner=self.stats.owner, kind="fused", args=example, donated_bytes=donated,
+            stats=self.stats,
+        )
         step_bytes = state_bytes + sum(getattr(a, "nbytes", 0) for a in inputs)
         return (
             fn,

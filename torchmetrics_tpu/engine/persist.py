@@ -1,0 +1,710 @@
+"""Persistent executable cache + prewarm manifests — zero-cold-start serving.
+
+Every deploy/restart used to pay the full XLA compile bill before the first
+request: the engines lower everything through the AOT chain
+(``diag/costs.py:aot_compile``) and the ledger records seconds of
+``compile_ms`` per signature, but none of it survived the process. This module
+makes the warm state durable:
+
+- **Persistent executable cache** — each compiled :class:`jax.stages.Compiled`
+  serializes via ``jax.experimental.serialize_executable`` into an atomic
+  artifact (``.tmp`` + ``os.replace``, the ``parallel/elastic.py`` snapshot
+  contract) keyed by the existing ``(owner, kind, signature)`` fingerprint
+  extended with a **compatibility envelope** (jax/jaxlib version, backend,
+  device kind/count, mesh shape, x64 flag). A stale or cross-topology
+  artifact is a COUNTED miss, never a wrong load: envelope mismatches raise
+  :class:`PersistEnvelopeError`, corrupt payloads :class:`PersistIntegrityError`,
+  and both degrade loud (``persist.fallback`` event + counter) to a fresh
+  compile. Backends whose executables do not serialize fall back to enabling
+  JAX's native compilation cache in the same directory — recorded, once.
+- **Signature manifest** — every engine compile appends one JSON line
+  (owner, kind, signature, input specs, bucket / K-bucket coords) to
+  ``manifest.jsonl``; :func:`prewarm` replays the full signature set — bucket
+  ladder, K-buckets, fold/compute graphs — at deploy time before traffic
+  lands, loading from the persistent cache where hits exist and compiling
+  (then persisting) the rest. Replays run against zero-filled inputs with the
+  metric's live state snapshotted (device-side copies) and restored after, so
+  prewarm is value-inert.
+- **Warm-replica handoff** — :func:`warm_start` composes :func:`prewarm` with
+  :func:`~torchmetrics_tpu.parallel.elastic.restore_latest` so a replacement
+  pod is serving-identical — states restored, executables hot — in one call
+  (wired through ``serve/sidecar.py`` startup).
+
+Enablement rides ``TORCHMETRICS_TPU_PERSIST=<dir>`` (:func:`persist_dir` is
+the one registered fail-loud parser — the PR-7 env contract) or the scoped
+:func:`persist_context` / :func:`set_persist_dir` overrides. The load path is
+transfer-free by design: artifacts deserialize from disk to device without a
+single device→host read, so it runs clean under the diag STRICT guard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import zlib
+from contextlib import contextmanager
+from typing import Any, Dict, Generator, List, Optional, Sequence, Tuple
+
+from torchmetrics_tpu.diag import trace as _diag
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = [
+    "PERSIST_ENV_VAR",
+    "PersistEnvelopeError",
+    "PersistIntegrityError",
+    "compat_envelope",
+    "load_executable",
+    "load_manifest",
+    "persist_context",
+    "persist_dir",
+    "persist_state",
+    "prewarm",
+    "record_compile",
+    "reset_persist_stats",
+    "set_persist_dir",
+    "store_executable",
+    "warm_start",
+]
+
+#: env knob: a directory path enables the persistent cache; ``"0"``/``"off"``
+#: disable explicitly; an empty value fails loud (the PR-7 env contract)
+PERSIST_ENV_VAR = "TORCHMETRICS_TPU_PERSIST"
+
+#: artifact + manifest format — bumped on any layout change so an old-format
+#: file is a typed rejection, never a mis-parse
+PERSIST_FORMAT_VERSION = 1
+
+_UNSET = object()
+_dir_override: Any = _UNSET
+
+
+class PersistIntegrityError(TorchMetricsUserError):
+    """A persisted artifact is unreadable/corrupt (truncated, CRC mismatch)."""
+
+
+class PersistEnvelopeError(TorchMetricsUserError):
+    """A persisted artifact's compatibility envelope does not match this process."""
+
+
+def persist_dir() -> Optional[str]:
+    """The active persistent-cache directory, or ``None`` (persistence off).
+
+    Resolution: :func:`set_persist_dir` / :func:`persist_context` override
+    first, then ``TORCHMETRICS_TPU_PERSIST``. The env value is a directory
+    path (created on demand); ``"0"``/``"off"`` disable explicitly; an empty/
+    whitespace value raises — a half-set knob must never silently disable.
+    """
+    if _dir_override is not _UNSET:
+        return _dir_override
+    raw = os.environ.get(PERSIST_ENV_VAR)
+    if raw is None:
+        return None
+    value = raw.strip()
+    if not value:
+        raise TorchMetricsUserError(
+            f"Invalid {PERSIST_ENV_VAR}={raw!r}: expected a cache directory path"
+            " (or '0'/'off' to disable explicitly). Unset the variable to disable."
+        )
+    if value.lower() in ("0", "off"):
+        return None
+    return value
+
+
+def set_persist_dir(directory: Optional[str]) -> None:
+    """Force the cache directory process-wide; ``None`` disables, and
+    :func:`reset_persist_overrides` semantics ride ``persist_context``."""
+    global _dir_override
+    _dir_override = directory
+
+
+@contextmanager
+def persist_context(directory: Optional[str]) -> Generator[None, None, None]:
+    """Scoped persistent-cache enablement (tests, the coldstart bench)."""
+    global _dir_override
+    prev = _dir_override
+    _dir_override = directory
+    try:
+        yield
+    finally:
+        _dir_override = prev
+
+
+# ------------------------------------------------------------------ counters
+
+_LOCK = threading.Lock()
+
+#: process-wide monotonic counters (compiles can land from the async worker
+#: thread, so every bump takes the lock; the hot dispatch loop never touches
+#: these — persistence is compile-time-only machinery)
+_COUNTERS: Dict[str, float] = {  # guarded-by: _LOCK
+    "hits": 0,
+    "misses": 0,
+    "stores": 0,
+    "stored_bytes": 0,
+    "deserialize_ms": 0.0,
+    "envelope_rejects": 0,
+    "corrupt_skips": 0,
+    "fallbacks": 0,
+    "prewarm_replays": 0,
+    "manifest_entries": 0,
+}
+
+# manifest dedup: directory -> set of (owner, kind, signature) already on disk
+_MANIFEST_SEEN: Dict[str, set] = {}  # guarded-by: _LOCK
+
+# one-shot flag: the native-compilation-cache fallback engaged for this process
+_native_fallback = False
+
+
+def _bump(**deltas: float) -> None:
+    with _LOCK:
+        for key, delta in deltas.items():
+            _COUNTERS[key] += delta
+
+
+def persist_state() -> Dict[str, Any]:
+    """One JSON-serializable dict for telemetry: counters + enablement."""
+    with _LOCK:
+        out: Dict[str, Any] = dict(_COUNTERS)
+    out["deserialize_ms"] = round(out["deserialize_ms"], 3)
+    try:
+        directory = persist_dir()
+    except TorchMetricsUserError:
+        directory = None
+    out["enabled"] = directory is not None
+    out["native_fallback"] = _native_fallback
+    return out
+
+
+def reset_persist_stats() -> None:
+    """Zero the counters (``reset_engine_stats`` calls this); the on-disk
+    cache and the manifest dedup sets are durable state and stay."""
+    with _LOCK:
+        for key in _COUNTERS:
+            _COUNTERS[key] = 0.0 if key == "deserialize_ms" else 0
+
+
+# ------------------------------------------------------------------ envelope
+
+
+def compat_envelope() -> Dict[str, Any]:
+    """The compatibility envelope a persisted executable must match exactly.
+
+    Everything that can make a serialized XLA executable wrong to load:
+    jax/jaxlib version (binary format), backend platform + device kind/count
+    (target ISA + topology), the active state-mesh shape (SPMD partitioning
+    compiled into the program), and the x64 flag (dtype promotion baked into
+    the traced graph).
+    """
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "")
+    except Exception:  # noqa: BLE001 — jaxlib version is advisory metadata
+        jaxlib_version = ""
+    devices = jax.devices()
+    from torchmetrics_tpu.parallel.sharding import metric_mesh
+
+    try:
+        mesh = metric_mesh()
+    except TorchMetricsUserError:
+        mesh = None
+    mesh_shape = "" if mesh is None else "x".join(f"{k}={v}" for k, v in sorted(dict(mesh.shape).items()))
+    return {
+        "format": PERSIST_FORMAT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "",
+        "device_count": len(devices),
+        "mesh": mesh_shape,
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+def _envelope_digest(envelope: Dict[str, Any]) -> str:
+    payload = json.dumps(envelope, sort_keys=True).encode()
+    return format(zlib.crc32(payload) & 0xFFFFFFFF, "08x")
+
+
+def _artifact_path(directory: str, owner: str, kind: str, signature: str) -> str:
+    import hashlib
+
+    digest = hashlib.sha256(
+        f"{owner}|{kind}|{signature}|{_envelope_digest(compat_envelope())}".encode()
+    ).hexdigest()[:32]
+    return os.path.join(directory, "executables", f"{digest}.tmx")
+
+
+# ------------------------------------------------------------------ artifacts
+
+
+def _atomic_write(path: str, payload: bytes) -> None:
+    """The ``parallel/elastic.py`` snapshot contract: ``.tmp`` + flush +
+    fsync + ``os.replace`` — a reader never observes a torn artifact."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _enable_native_fallback(directory: str, reason: str) -> None:
+    """Serialization unsupported on this backend: enable JAX's own persistent
+    compilation cache in the same directory instead — the compile is still
+    amortized across processes, just without the manifest-driven deserialize
+    fast path. Recorded once."""
+    global _native_fallback
+    with _LOCK:
+        if _native_fallback:
+            return
+        _native_fallback = True
+    _bump(fallbacks=1)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(directory, "xla-cache"))
+    _diag.record("persist.fallback", "persist", reason=f"native-cache:{reason}")
+
+
+def store_executable(owner: str, kind: str, signature: str, compiled: Any) -> bool:
+    """Serialize + atomically persist one compiled executable; True on store.
+
+    A serialization failure (backend without ``serialize_executable`` support)
+    degrades to the native-compilation-cache fallback — counted, never raised
+    into the engine's compile path.
+    """
+    directory = persist_dir()
+    if directory is None:
+        return False
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        record = {
+            "format": PERSIST_FORMAT_VERSION,
+            "envelope": compat_envelope(),
+            "owner": owner,
+            "kind": kind,
+            "signature": signature,
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+            "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+        }
+        blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+        _atomic_write(_artifact_path(directory, owner, kind, signature), blob)
+    except Exception as exc:  # noqa: BLE001 — persistence must never fail a compile
+        _enable_native_fallback(directory, f"{type(exc).__name__}: {exc}")
+        return False
+    _bump(stores=1, stored_bytes=len(blob))
+    _diag.record("persist.save", owner, exe_kind=kind, signature=signature, bytes=len(blob))
+    return True
+
+
+def load_executable(owner: str, kind: str, signature: str) -> Optional[Any]:
+    """Load one persisted executable, or ``None`` when no artifact exists.
+
+    Raises :class:`PersistIntegrityError` (unreadable / truncated / CRC
+    mismatch / undeserializable) or :class:`PersistEnvelopeError` (format or
+    compatibility-envelope mismatch — a stale or cross-topology artifact).
+    The engine path catches both via :func:`try_load_executable`; tests call
+    this directly to assert the typed rejection.
+    """
+    directory = persist_dir()
+    if directory is None:
+        return None
+    path = _artifact_path(directory, owner, kind, signature)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as fh:
+            record = pickle.loads(fh.read())
+        if not isinstance(record, dict):
+            raise TypeError(f"artifact root is {type(record).__name__}, expected dict")
+    except Exception as exc:  # noqa: BLE001 — any unpickle failure is corruption
+        raise PersistIntegrityError(
+            f"persisted executable {os.path.basename(path)} is unreadable:"
+            f" {type(exc).__name__}: {exc}"
+        ) from exc
+    if record.get("format") != PERSIST_FORMAT_VERSION:
+        raise PersistEnvelopeError(
+            f"persisted executable {os.path.basename(path)} has format"
+            f" {record.get('format')!r}, expected {PERSIST_FORMAT_VERSION}"
+        )
+    envelope = compat_envelope()
+    if record.get("envelope") != envelope:
+        stale = {
+            key: (record.get("envelope", {}).get(key), envelope[key])
+            for key in envelope
+            if record.get("envelope", {}).get(key) != envelope[key]
+        }
+        raise PersistEnvelopeError(
+            f"persisted executable {os.path.basename(path)} was compiled for a"
+            f" different environment: {stale}"
+        )
+    payload = record.get("payload", b"")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != record.get("crc"):
+        raise PersistIntegrityError(
+            f"persisted executable {os.path.basename(path)} failed its payload CRC"
+        )
+    try:
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        return deserialize_and_load(payload, record["in_tree"], record["out_tree"])
+    except Exception as exc:  # noqa: BLE001 — an undeserializable artifact is corruption
+        raise PersistIntegrityError(
+            f"persisted executable {os.path.basename(path)} failed to deserialize:"
+            f" {type(exc).__name__}: {exc}"
+        ) from exc
+
+
+def try_load_executable(owner: str, kind: str, signature: str) -> Optional[Any]:
+    """The engine-facing load: a hit returns the executable (counted), every
+    rejection — absent, stale envelope, corrupt — degrades to ``None``
+    (a counted miss), LOUD via the flight recorder, never a wrong load."""
+    from time import perf_counter
+
+    t0 = perf_counter()
+    try:
+        compiled = load_executable(owner, kind, signature)
+    except PersistEnvelopeError as exc:
+        _bump(envelope_rejects=1, misses=1)
+        _diag.record("persist.fallback", owner, exe_kind=kind, reason=f"envelope:{exc}")
+        return None
+    except PersistIntegrityError as exc:
+        _bump(corrupt_skips=1, misses=1)
+        _diag.record("persist.fallback", owner, exe_kind=kind, reason=f"corrupt:{exc}")
+        return None
+    if compiled is None:
+        _bump(misses=1)
+        return None
+    ms = (perf_counter() - t0) * 1e3
+    _bump(hits=1, deserialize_ms=ms)
+    _diag.record("persist.load", owner, exe_kind=kind, signature=signature, deserialize_ms=round(ms, 3))
+    return compiled
+
+
+# ------------------------------------------------------------------ manifest
+
+
+def _manifest_path(directory: str) -> str:
+    return os.path.join(directory, "manifest.jsonl")
+
+
+def _spec(value: Any) -> List[Any]:
+    return [list(getattr(value, "shape", ())), str(getattr(value, "dtype", type(value).__name__))]
+
+
+def _row_signature(row: Dict[str, Any]) -> str:
+    body = json.dumps(
+        [row.get("owner"), row.get("kind"), row.get("args"), row.get("kw"),
+         row.get("bucket"), row.get("k")],
+        sort_keys=True,
+    ).encode()
+    return format(zlib.crc32(body) & 0xFFFFFFFF, "08x")
+
+
+def load_manifest(directory: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Every recorded manifest row, in append order. Corrupt lines (torn
+    writes, foreign content) are skipped LOUD — counted + recorded — so one
+    bad line can never void a whole deploy's prewarm set."""
+    directory = persist_dir() if directory is None else directory
+    if directory is None:
+        return []
+    path = _manifest_path(directory)
+    if not os.path.exists(path):
+        return []
+    rows: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+                if not isinstance(row, dict) or "owner" not in row or "kind" not in row:
+                    raise ValueError("not a manifest row")
+            except (json.JSONDecodeError, ValueError) as exc:
+                _bump(corrupt_skips=1)
+                _diag.record(
+                    "persist.fallback", "persist",
+                    reason=f"manifest-line-{lineno}:{type(exc).__name__}",
+                )
+                continue
+            rows.append(row)
+    return rows
+
+
+def record_compile(
+    owner: str,
+    kind: str,
+    args: Optional[Sequence[Any]] = None,
+    kw: Optional[Dict[str, Any]] = None,
+    bucket: Optional[int] = None,
+    k: Optional[int] = None,
+) -> None:
+    """Append one (owner, kind, signature, specs, bucket/K coords) manifest
+    row — called by each engine's first-compile success block. Dedup is
+    in-memory per directory, seeded from the on-disk manifest so restarts do
+    not re-append the rows they replay. No-op with persistence off."""
+    directory = persist_dir()
+    if directory is None:
+        return
+    row: Dict[str, Any] = {
+        "format": PERSIST_FORMAT_VERSION,
+        "owner": owner,
+        "kind": kind,
+        "args": [_spec(a) for a in args] if args is not None else None,
+        "kw": {name: _spec(v) for name, v in sorted(kw.items())} if kw else None,
+        "bucket": bucket,
+        "k": k,
+    }
+    row["sig"] = _row_signature(row)
+    dedup_key = (owner, kind, row["sig"])
+    with _LOCK:
+        seen = _MANIFEST_SEEN.get(directory)
+        if seen is None:
+            seen = _MANIFEST_SEEN[directory] = set()
+            preload = True
+        else:
+            preload = False
+    if preload:
+        for existing in load_manifest(directory):
+            seen.add((existing.get("owner"), existing.get("kind"), existing.get("sig")))
+    with _LOCK:
+        if dedup_key in seen:
+            return
+        seen.add(dedup_key)
+        os.makedirs(directory, exist_ok=True)
+        with open(_manifest_path(directory), "a") as fh:
+            fh.write(json.dumps(row, sort_keys=True) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+    _bump(manifest_entries=1)
+    _diag.record("persist.manifest", owner, exe_kind=kind, signature=row["sig"], bucket=bucket, k=k)
+
+
+# ------------------------------------------------------------------ prewarm
+
+
+def _zeros(spec: Sequence[Any]) -> Any:
+    import jax
+    import numpy as np
+
+    # device_put of a host buffer, NOT jnp.zeros: zeros-via-XLA compiles one
+    # tiny graph per unique shape, which on a replica prewarming dozens of
+    # signatures costs more than the deserializes it feeds (~10 ms each)
+    shape, dtype = spec
+    return jax.device_put(np.zeros(tuple(shape), dtype=np.dtype(dtype)))
+
+
+_RIDER_ATTRS = ("_sentinel_flags", "_quarantined_count", "_comp_residuals")
+
+
+def _snapshot_metric(metric: Any) -> Dict[str, Any]:
+    """Device-side copies of everything a replay could mutate: registered
+    states (donation-proof — ``.copy()`` allocates fresh buffers on device,
+    no host transfer), rider buffers, and the update bookkeeping."""
+
+    def _copy(value: Any) -> Any:
+        if isinstance(value, list):
+            return [_copy(v) for v in value]
+        if isinstance(value, dict):
+            return {name: _copy(v) for name, v in value.items()}
+        return value.copy() if hasattr(value, "copy") else value
+
+    saved: Dict[str, Any] = {"states": {}, "riders": {}, "absent": []}
+    for attr in metric._defaults:
+        saved["states"][attr] = _copy(getattr(metric, attr))
+    for attr in _RIDER_ATTRS:
+        if attr in metric.__dict__:
+            saved["riders"][attr] = _copy(metric.__dict__[attr])
+        else:
+            saved["absent"].append(attr)
+    saved["update_count"] = getattr(metric, "_update_count", None)
+    saved["computed"] = getattr(metric, "_computed", None)
+    return saved
+
+
+def _restore_metric(metric: Any, saved: Dict[str, Any]) -> None:
+    for attr, value in saved["states"].items():
+        setattr(metric, attr, value)
+    for attr, value in saved["riders"].items():
+        metric.__dict__[attr] = value
+    for attr in saved["absent"]:
+        metric.__dict__.pop(attr, None)
+    if saved["update_count"] is not None:
+        metric._update_count = saved["update_count"]
+    metric._computed = saved["computed"]
+
+
+def _target_metrics(obj: Any) -> List[Any]:
+    if hasattr(obj, "_defaults"):  # duck-typed Metric
+        return [obj]
+    if hasattr(obj, "_modules"):  # duck-typed MetricCollection
+        return list(obj._modules.values())
+    raise TorchMetricsUserError(
+        f"prewarm expects a Metric or MetricCollection, got {type(obj).__name__}"
+    )
+
+
+def _replay_row(obj: Any, row: Dict[str, Any], computed_owners: set) -> bool:
+    """Replay ONE manifest row against ``obj``; True when it dispatched.
+
+    update/scan rows replay through the metric's public ``update`` (scan rows
+    inside a ``scan_context(K)`` so the drain compiles the recorded K-bucket);
+    fused rows through the collection's ``update``; compute-family rows
+    (compute / sync-compute / sync-fold) through ONE ``compute()`` per owner —
+    the graphs the CURRENT topology needs, so a cross-world manifest row can
+    never force a wrong-mesh replay.
+    """
+    kind = row.get("kind")
+    owner = row.get("owner", "")
+    args = [_zeros(spec) for spec in row.get("args") or []]
+    kw = {name: _zeros(spec) for name, spec in (row.get("kw") or {}).items()}
+
+    if kind in ("update", "scan", "fused"):
+        # resolve the row's owner to a replay target: a "fused:A,B" owner
+        # names the collection's GROUP REPRESENTATIVES (engine/fusion.py
+        # builds FusedUpdate over one metric per compute group), so it
+        # matches any collection whose member types cover those names; a
+        # bare owner is a metric type name resolved through the members
+        fused_target = owner.startswith("fused:")
+        if fused_target:
+            if not hasattr(obj, "_modules"):
+                return False
+            member_types = {type(m).__name__ for m in obj._modules.values()}
+            if not set(owner[len("fused:"):].split(",")) <= member_types:
+                return False
+            target: Any = obj
+        else:
+            target = next(
+                (m for m in _target_metrics(obj) if type(m).__name__ == owner), None
+            )
+            if target is None:
+                return False
+        if kind == "scan":
+            from torchmetrics_tpu.engine.scan import flush_metrics, scan_context
+
+            kb = int(row.get("k") or 8)
+            with scan_context(k=kb):
+                for _ in range(kb):
+                    target.update(*args, **kw)
+                flush_metrics(list(_target_metrics(obj)), "prewarm")
+        else:
+            target.update(*args, **kw)
+        return True
+    if kind in ("compute", "sync-compute", "sync-fold"):
+        if owner in computed_owners:
+            return False
+        if hasattr(obj, "_modules") and owner.startswith("epoch:collection["):
+            target = obj
+        else:
+            target = next(
+                (m for m in _target_metrics(obj) if owner == f"epoch:{type(m).__name__}"),
+                None,
+            )
+        if target is None:
+            return False
+        computed_owners.add(owner)
+        target.compute()
+        return True
+    return False
+
+
+def prewarm(obj: Any, directory: Optional[str] = None, manifest: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """Replay the recorded signature manifest so every executable is hot
+    BEFORE traffic lands — persistent-cache hits deserialize in O(load),
+    misses compile once and persist for the next replica.
+
+    Value-inert: live state (registered states, rider buffers, update
+    bookkeeping) is snapshotted device-side before the replays and restored
+    after, and scan queues are flushed inside the replay scope. Failed
+    replays are counted + recorded (``persist.fallback``), never raised —
+    a half-warm replica must still serve.
+    """
+    directory = persist_dir() if directory is None else directory
+    report: Dict[str, Any] = {"entries": 0, "replayed": 0, "skipped": 0, "failed": 0}
+    if directory is None:
+        return report
+    rows = load_manifest(directory) if manifest is None else list(manifest)
+    report["entries"] = len(rows)
+    if not rows:
+        return report
+    before = persist_state()
+    metrics = _target_metrics(obj)
+    saved = [_snapshot_metric(m) for m in metrics]
+    computed_owners: set = set()
+    import warnings
+
+    with persist_context(directory):
+        try:
+            # the replay is a deliberate value-inert probe: compute-before-
+            # update style advisories would fire on every compute-family row
+            # and mean nothing here (state is snapshotted/restored around us)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", UserWarning)
+                for row in rows:
+                    try:
+                        if _replay_row(obj, row, computed_owners):
+                            report["replayed"] += 1
+                        else:
+                            report["skipped"] += 1
+                    except Exception as exc:  # noqa: BLE001 — a half-warm replica must serve
+                        report["failed"] += 1
+                        _diag.record(
+                            "persist.fallback", row.get("owner", ""),
+                            exe_kind=row.get("kind", ""), reason=f"replay:{type(exc).__name__}: {exc}",
+                        )
+        finally:
+            for m, snap in zip(metrics, saved):
+                _restore_metric(m, snap)
+    after = persist_state()
+    report["hits"] = int(after["hits"] - before["hits"])
+    report["misses"] = int(after["misses"] - before["misses"])
+    _bump(prewarm_replays=report["replayed"])
+    # attribute the replays to ONE live engine so engine_report() carries
+    # them: the collection's fused engine when fused dispatch built one,
+    # else the first member metric's compiled-update engine
+    for holder in (getattr(obj, "_fused_engine", None), *(
+        getattr(m, "_engine", None) for m in metrics
+    )):
+        if holder is not None:
+            holder.stats.prewarm_replays += report["replayed"]
+            break
+    _diag.record(
+        "persist.prewarm", type(obj).__name__,
+        entries=report["entries"], replayed=report["replayed"], skipped=report["skipped"],
+        failed=report["failed"], hits=report["hits"], misses=report["misses"],
+    )
+    return report
+
+
+def warm_start(
+    obj: Any,
+    directory: Optional[str] = None,
+    snapshot_dir: Optional[str] = None,
+    rank: int = 0,
+    world_size: int = 1,
+) -> Dict[str, Any]:
+    """Warm-replica handoff in one call: :func:`prewarm` the full executable
+    set, then :func:`~torchmetrics_tpu.parallel.elastic.restore_latest` the
+    newest durable snapshot — the replacement pod is serving-identical
+    (states restored, executables hot) before it answers its first request.
+
+    Prewarm runs FIRST so the restore lands on an already-hot compute path;
+    snapshot-restore errors propagate (they are the elastic layer's typed
+    contract), prewarm failures degrade loud per row.
+    """
+    report = prewarm(obj, directory)
+    if snapshot_dir is not None:
+        from torchmetrics_tpu.parallel.elastic import restore_latest
+
+        report["restored_seq"] = restore_latest(obj, snapshot_dir, rank=rank, world_size=world_size)
+    return report

@@ -79,6 +79,7 @@ from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.engine import bucketing, config
 from torchmetrics_tpu.engine import numerics as _numerics
+from torchmetrics_tpu.engine import persist as _persist
 from torchmetrics_tpu.engine import txn as _txn
 from torchmetrics_tpu.engine.compiled import (
     _FALLBACK,
@@ -403,7 +404,12 @@ def compile_scan(body, example_state, example_inputs: Sequence[Any], kb: int, ow
         kind="scan",
         args=(example_state, example_valid, example_pads, *example_flat),
         donated_bytes=state_bytes if donate else 0,
+        stats=stats,
     )
+    # prewarm manifest: per-step input specs + the K-bucket — prewarm replays
+    # kb zero updates inside a scan_context(kb) so the drain rebuilds this
+    # exact executable signature
+    _persist.record_compile(owner, "scan", args=list(example_inputs), k=kb)
     step_in_bytes = sum(getattr(a, "nbytes", 0) for a in example_inputs)
     return fn, donate, annotation_scope(owner, "scan", key), state_bytes, step_in_bytes
 

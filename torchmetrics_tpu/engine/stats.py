@@ -80,6 +80,10 @@ _COUNTER_FIELDS = (
     "shard_degrades",  # shard-rule resolutions degraded to replication (no mesh / indivisible dim)
     "ingraph_syncs",  # packed exchanges that rode the data axis in-graph (zero host collectives)
     "sync_noop_plans",  # packed syncs skipped wholesale: every state live-sharded, nothing to pack
+    # --- persistent executable cache (engine/persist.py): zero-cold-start serving ---
+    "persist_hits",  # compiles served by deserializing a persisted executable (no lower/compile)
+    "persist_misses",  # compiles that found no loadable artifact (absent/stale/corrupt — counted, never wrong)
+    "prewarm_replays",  # manifest rows replayed by prewarm() before traffic landed
 )
 
 
@@ -206,6 +210,7 @@ def reset_engine_stats() -> None:
     from torchmetrics_tpu.diag.hist import reset_histograms
     from torchmetrics_tpu.diag.profile import reset_profile
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
+    from torchmetrics_tpu.engine.persist import reset_persist_stats
     from torchmetrics_tpu.engine.txn import reset_quarantine
     from torchmetrics_tpu.parallel.resilience import reset_resilience
     from torchmetrics_tpu.serve.stats import reset_serve_stats
@@ -219,3 +224,4 @@ def reset_engine_stats() -> None:
     reset_profile()
     reset_resilience()
     reset_serve_stats()
+    reset_persist_stats()

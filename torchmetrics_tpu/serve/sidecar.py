@@ -115,14 +115,34 @@ class MetricsSidecar:
 
     ``port`` defaults to ``TORCHMETRICS_TPU_SERVE_PORT`` (0 → OS-assigned,
     read back from :attr:`port` after :meth:`start`).
+
+    Warm-replica handoff: pass ``warm_target`` (a Metric or MetricCollection)
+    to run :func:`~torchmetrics_tpu.engine.persist.warm_start` during
+    :meth:`start`, BEFORE the endpoint answers its first scrape — the prewarm
+    manifest replays every recorded executable signature out of the
+    persistent cache (``persist_dir`` overrides ``TORCHMETRICS_TPU_PERSIST``)
+    and ``snapshot_dir`` additionally restores the newest elastic snapshot,
+    so a replacement pod comes up serving-identical: states restored,
+    executables hot. The handoff report lands on :attr:`warm_report`.
     """
 
-    def __init__(self, port: Optional[int] = None, host: str = "127.0.0.1") -> None:
+    def __init__(
+        self,
+        port: Optional[int] = None,
+        host: str = "127.0.0.1",
+        warm_target: Any = None,
+        persist_dir: Optional[str] = None,
+        snapshot_dir: Optional[str] = None,
+    ) -> None:
         self._requested_port = _serve_stats.default_port() if port is None else int(port)
         self.host = host
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._warm_target = warm_target
+        self._persist_dir = persist_dir
+        self._snapshot_dir = snapshot_dir
+        self.warm_report: Optional[dict] = None
 
     @property
     def url(self) -> str:
@@ -133,6 +153,16 @@ class MetricsSidecar:
     def start(self) -> "MetricsSidecar":
         if self._server is not None:
             raise RuntimeError("sidecar already started")
+        if self._warm_target is not None:
+            # handoff BEFORE the socket binds: the first scrape a Prometheus
+            # server lands already sees restored states and hot executables
+            from torchmetrics_tpu.engine.persist import warm_start
+
+            self.warm_report = warm_start(
+                self._warm_target,
+                directory=self._persist_dir,
+                snapshot_dir=self._snapshot_dir,
+            )
         server = ThreadingHTTPServer((self.host, self._requested_port), _ScrapeHandler)
         server.daemon_threads = True
         self._server = server
