@@ -80,7 +80,7 @@ _SCENARIO_BYTES = {
 # every scenario block scripts/check_counters.py gates on: a run (including
 # the TPU-less micro fallback) must prove each of these completed, or the
 # gate's scenario-completeness check fails — nothing gated can skip silently
-_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart")
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "federation", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart")
 
 # the sharding scenario partitions state over a >= 4-device mesh; on a host
 # platform that needs forced virtual devices, set BEFORE jax initializes (the
@@ -1493,6 +1493,211 @@ def bench_serve():
     out["sidecar_scrape_ok"] = bool(
         "tm_tpu_serve_scrapes_total" in body and "tm_tpu_serve_tenants" in body
     )
+    return out
+
+
+def bench_federation():
+    """Federated multi-pod aggregation plane (ISSUE 18 acceptance evidence):
+
+    - **4-pod parity**: the global fold of 4 pod envelopes
+      (sum/mean/cat/HLL/heavy-hitters) equals the single-pod union-stream
+      reference — float aggregates within rel 1e-5, cat as the exact
+      multiset, HLL registers and the count-min grid + joint top-k
+      bit-exact;
+    - **byte-stable membership**: the folded state bytes are identical for
+      every arrival-order permutation of the same envelopes (canonical
+      pod-id ordering, one executable per membership);
+    - **pod churn**: one pod vanishes at the pull boundary (fault injection
+      through ``bounded_pull``) → the degraded fold EXCLUDES it with counted
+      ``federation.degraded`` events and still answers over the survivors —
+      degraded, not wrong, not hung; the pod then rejoins with a fresh
+      sequence (slot replaced, ``federation.rejoin``) after the watermark
+      dedupe rejected its replay (``federation.stale``);
+    - **0 host transfers** outside the sanctioned ``federation-ingest`` /
+      serve boundaries across the whole pull → fold → compute cycle under
+      the STRICT guard;
+    - **KLL at 10⁶**: the union stream split over the 4 pods, each pod's
+      KLL sketch folded through the aggregator — global p50/p99 within the
+      PROVEN rank-error bound vs exact ``np.quantile``. The scan-form update
+      keeps the full 10⁶ affordable even on the CPU CI image, so no micro
+      downscale exists to weaken the committed evidence.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import CatMetric, MeanMetric, SumMetric
+    from torchmetrics_tpu.diag import diag_context, transfer_guard
+    from torchmetrics_tpu.parallel.faults import RankDrop, fault_context
+    from torchmetrics_tpu.serve import (
+        CardinalitySketch,
+        FederationAggregator,
+        HeavyHitters,
+        KLLSketch,
+        pack_envelope,
+    )
+
+    out = {}
+    rng = np.random.RandomState(18)
+    n_pods = 4
+    kll_n = 1_000_000
+    out["federation_pods"] = n_pods
+    out["kll_n"] = kll_n
+
+    def make_pod():
+        pod = {
+            "sum": SumMetric(nan_strategy=0.0),
+            "mean": MeanMetric(nan_strategy=0.0),
+            "cat": CatMetric(nan_strategy=0.0),
+            "card": CardinalitySketch(p=11),
+            "hh": HeavyHitters(k=4, depth=4, width=512),
+            "kll": KLLSketch(k=256),
+        }
+        for m in pod.values():
+            m.sync_on_compute = False
+        return pod
+
+    # distinct per-pod streams; the union is the single-pod reference. Each
+    # pod plants ONE dominant id (counts 500/600/700/800) so the joint top-k
+    # fold has an unambiguous answer over the uniform noise
+    val_streams = [rng.rand(256).astype(np.float32) * 100.0 for _ in range(n_pods)]
+    id_streams = [
+        np.concatenate([np.full(500 + 100 * i, 7000 + i), rng.randint(0, 5000, 2048)])
+        for i in range(n_pods)
+    ]
+    kll_streams = [
+        rng.standard_normal(kll_n // n_pods).astype(np.float32) for _ in range(n_pods)
+    ]
+    pods = {}
+    for i in range(n_pods):
+        pod = make_pod()
+        pod["sum"].update(jnp.asarray(val_streams[i]))
+        pod["mean"].update(jnp.asarray(val_streams[i]))
+        pod["cat"].update(jnp.asarray(val_streams[i]))
+        pod["card"].update(jnp.asarray(id_streams[i]))
+        pod["hh"].update(jnp.asarray(id_streams[i]))
+        pod["kll"].update(jnp.asarray(kll_streams[i]))
+        pods[f"pod{i}"] = pod
+
+    template = make_pod()
+    agg = FederationAggregator(
+        template,
+        pods={pid: (lambda p=pod: pack_envelope(p)) for pid, pod in pods.items()},
+        retries=0,
+        staleness_s=1800.0,
+    )
+
+    # -- the full pull -> fold -> compute cycle under the STRICT guard --------
+    with diag_context(capacity=4096) as rec, transfer_guard("strict"):
+        pulled = agg.pull_round()
+        t0 = time.perf_counter()
+        agg.fold()  # compiles the membership's fold executable
+        g = agg.compute_global()  # second fold rides the cache
+        fold_elapsed = time.perf_counter() - t0
+        # replaying an already-ingested envelope must dedupe at the watermark
+        data, headers = pack_envelope(pods["pod0"])
+        stale_rejected = agg.ingest("pod0", data, headers) is False
+        out["federation_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+        out["federation_ingest_events"] = rec.count("federation.ingest")
+        out["federation_fold_events"] = rec.count("federation.fold")
+    out["federation_pull_ok"] = bool(all(pulled.values()))
+    out["federation_fold_ms"] = round(fold_elapsed * 1e3, 2)
+    out["federation_stale_skips"] = int(agg.stats.federation_stale_skips)
+    out["federation_stale_dedupe_ok"] = bool(stale_rejected and out["federation_stale_skips"] >= 1)
+
+    # -- parity vs the single-pod union-stream reference ----------------------
+    ref = make_pod()
+    all_vals = np.concatenate(val_streams)
+    all_ids = np.concatenate(id_streams)
+    for i in range(n_pods):
+        ref["card"].update(jnp.asarray(id_streams[i]))
+        ref["hh"].update(jnp.asarray(id_streams[i]))
+    sum_ok = abs(float(g["sum"]) - float(all_vals.sum())) <= 1e-5 * abs(float(all_vals.sum()))
+    mean_ok = abs(float(g["mean"]) - float(all_vals.mean())) <= 1e-5 * abs(float(all_vals.mean()))
+    cat_ok = bool(
+        np.array_equal(np.sort(np.asarray(g["cat"]).ravel()), np.sort(all_vals))
+    )
+    hll_ok = bool(float(g["card"]) == float(ref["card"].compute()))
+    folded_hh = agg.fold()["hh"]
+    topk = lambda ids, counts: sorted(  # noqa: E731 — live entries, id-sorted
+        (int(i), int(c)) for i, c in zip(np.asarray(ids), np.asarray(counts)) if i >= 0
+    )
+    hh_ok = bool(
+        np.array_equal(np.asarray(folded_hh["cms"]), np.asarray(ref["hh"].cms))
+        and topk(folded_hh["hh_ids"], folded_hh["hh_counts"])
+        == topk(ref["hh"].hh_ids, ref["hh"].hh_counts)
+    )
+    out["federation_parity_ok"] = bool(sum_ok and mean_ok and cat_ok and hll_ok and hh_ok)
+
+    # -- KLL: global quantiles within the proven bound ------------------------
+    kll_union = np.concatenate(kll_streams)
+    bound = template["kll"].rank_error_bound(kll_n)
+    global_qs = np.asarray(jax.device_get(g["kll"])).ravel()
+    rank_errs = []
+    for q, est in zip(template["kll"].qs, global_qs):
+        rank_errs.append(abs(int((kll_union <= est).sum()) - int(np.ceil(q * kll_n))))
+    out["kll_rank_err_p50"] = rank_errs[0]
+    out["kll_rank_err_p99"] = rank_errs[1]
+    out["kll_rank_err_bound"] = bound
+    out["kll_within_bound"] = bool(all(e <= bound for e in rank_errs))
+
+    # -- byte-stable fold under arrival-order permutation ---------------------
+    envelopes = {pid: pack_envelope(pod) for pid, pod in pods.items()}
+
+    def fold_in_order(order):
+        a = FederationAggregator(make_pod())
+        for pid in order:
+            data, headers = envelopes[pid]
+            a.ingest(pid, data, headers)
+        return a.fold()
+
+    orders = (list(pods), list(reversed(pods)), sorted(pods, key=hash))
+    folds = [fold_in_order(o) for o in orders]
+    stable = True
+    for other in folds[1:]:
+        for owner in folds[0]:
+            for attr, a in folds[0][owner].items():
+                b = other[owner][attr]
+                pairs = zip(a, b) if isinstance(a, list) else [(a, b)]
+                for x, y in pairs:
+                    stable = stable and np.asarray(x).tobytes() == np.asarray(y).tobytes()
+    out["federation_permutation_stable"] = bool(stable)
+
+    # -- pod churn: vanish at the pull boundary -> degraded; then rejoin ------
+    with diag_context(capacity=4096) as crec:
+        for i, pod in enumerate(pods.values()):
+            pod["sum"].update(jnp.asarray(np.float32(10.0 * (i + 1))))
+        # pod1 (canonical rank 1) drops at the pull boundary for one round
+        with fault_context(RankDrop(1, label="federation-pull*")):
+            churn = agg.pull_round()
+        degraded_round_ok = bool(
+            churn == {"pod0": True, "pod1": False, "pod2": True, "pod3": True}
+            and crec.count("federation.degraded") >= 1
+        )
+        # pod1's last VERIFIED snapshot ages out: the fold must EXCLUDE it
+        # (degraded, counted) and still answer over the survivors
+        agg._slots["pod1"].ts -= 2.0 * agg.staleness_s
+        before = agg.stats.federation_degraded_folds
+        g2 = agg.compute_global()
+        survivors = float(all_vals.sum()) + 10.0 + 30.0 + 40.0 - float(val_streams[1].sum())
+        degraded_fold_ok = bool(
+            agg.stats.federation_degraded_folds == before + 1
+            and abs(float(g2["sum"]) - survivors) <= 1e-5 * abs(survivors)
+        )
+        # rejoin: a fresh envelope replaces the slot — no double count
+        pods["pod1"]["sum"].update(jnp.asarray(np.float32(5.0)))
+        rejoin = agg.pull_round()  # survivors' unchanged envelopes dedupe stale
+        g3 = agg.compute_global()
+        rejoined_total = float(all_vals.sum()) + 10.0 + 20.0 + 30.0 + 40.0 + 5.0
+        rejoin_ok = bool(
+            rejoin["pod1"]
+            and crec.count("federation.rejoin") >= 1
+            and abs(float(g3["sum"]) - rejoined_total) <= 1e-5 * abs(rejoined_total)
+        )
+    out["federation_degraded_ok"] = degraded_round_ok and degraded_fold_ok
+    out["federation_degraded_folds"] = int(agg.stats.federation_degraded_folds)
+    out["federation_rejoin_ok"] = rejoin_ok
+    state = agg.federation_state()
+    out["federation_state_pods"] = state["pods"]
     return out
 
 
@@ -3643,6 +3848,12 @@ def main(argv=None):
             statuses["serve"] = f"error:{type(err).__name__}: {str(err)[:200]}"
 
         try:
+            extras["federation"] = bench_federation()
+            statuses["federation"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["federation"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        try:
             extras["scan"] = bench_scan(micro=not on_tpu or args.smoke)
             statuses["scan"] = "ok"
         except Exception as err:  # noqa: BLE001
@@ -3739,6 +3950,7 @@ def main(argv=None):
         statuses["txn"] = "tpu_unavailable"
         statuses["numerics"] = "tpu_unavailable"
         statuses["serve"] = "tpu_unavailable"
+        statuses["federation"] = "tpu_unavailable"
         statuses["scan"] = "tpu_unavailable"
         statuses["async"] = "tpu_unavailable"
         statuses["cse"] = "tpu_unavailable"

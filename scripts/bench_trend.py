@@ -89,6 +89,14 @@ _TRACKED = (
     ("serve", "serve_retraces_after_warmup", "max"),
     ("serve", "tenant_traces", "max"),
     ("serve", "tenant_host_transfers", "max"),
+    # federated aggregation plane (serve/federation.py + quantile.py, PR 18):
+    # fold latency and the KLL rank errors are trajectory evidence (machine-
+    # dependent; check_counters owns the parity/degraded/bound gates); host
+    # transfers outside the sanctioned boundaries must never creep above zero.
+    ("federation", "federation_fold_ms", None),
+    ("federation", "kll_rank_err_p50", None),
+    ("federation", "kll_rank_err_p99", None),
+    ("federation", "federation_host_transfers", "max"),
     # cross-metric CSE (engine/statespec.py + collections.py, PR 11): the
     # speedup and footprint fraction are trajectory evidence (check_counters
     # gates the exact counter envelope); traces/dispatches/transfers and the

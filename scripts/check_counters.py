@@ -343,6 +343,23 @@ _CHECKS = (
     ("multichip_2d", "ingraph_host_transfers", "abs", 0),  # STRICT guard held end to end
     ("multichip_2d", "placement_2d_ok", "true", None),  # class axis over "state" only
     ("multichip_2d", "scan2d_compat_ok", "true", None),  # PR-10 K=8 drain over 2-D carries
+    # federated multi-pod aggregation gates (serve/federation.py +
+    # serve/quantile.py, PR 18): 4 emulated pods fold through the packed-sync
+    # machinery byte-stably in canonical order — exact parity with the
+    # single-pod union reference, a vanished pod yields a DEGRADED (counted)
+    # fold rather than a wrong or hung value, a returning pod rejoins without
+    # double-counting (watermark dedupe proven), zero host transfers outside
+    # the sanctioned boundaries, and the merged KLL sketch answers p50/p99
+    # inside its proven rank-error bound
+    ("federation", "federation_pull_ok", "true", None),  # every pod answered round 1
+    ("federation", "federation_parity_ok", "true", None),  # fold == union-stream reference
+    ("federation", "federation_permutation_stable", "true", None),  # byte-stable fold
+    ("federation", "federation_degraded_ok", "true", None),  # vanish -> degraded, not wrong
+    ("federation", "federation_rejoin_ok", "true", None),  # rejoin without double-count
+    ("federation", "federation_stale_dedupe_ok", "true", None),  # replay rejected + counted
+    ("federation", "federation_degraded_folds", "min", 1),  # the degraded fold was counted
+    ("federation", "federation_host_transfers", "abs", 0),  # STRICT guard end to end
+    ("federation", "kll_within_bound", "true", None),  # p50/p99 <= proven rank bound
     # heavy-metric in-graph kernel gates (image/fid.py, detection/ingraph.py,
     # functional/text/bert.py, PR 15): the reference's expensive workloads run
     # engine-native — FID update+compute and the packed-route mAP hold 0
@@ -420,7 +437,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "federation", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

@@ -66,6 +66,47 @@ else
 fi
 
 echo
+echo "=== federation wiring (multi-pod aggregation plane) ==="
+# The numeric federation proofs gate in check_counters (federation scenario:
+# parity, byte-stable membership, degraded/rejoin semantics, KLL bound) and
+# the knob/event/boundary contracts gate in tmlint; this block pins the
+# WIRING neither sees from one file alone: the sidecar must serve the
+# versioned /state envelope, the aggregator must pull through the resilience
+# tier's bounded_pull (the fault-injection boundary the churn suite plants
+# on), and the KLL sketch must merge through its callable dist_reduce_fx —
+# losing any of these silently turns a federation into a single-pod view.
+federation_ok=1
+if ! grep -q '_state_response' torchmetrics_tpu/serve/sidecar.py; then
+  echo "federation: serve/sidecar.py lost the versioned /state endpoint"
+  federation_ok=0
+fi
+if ! grep -q 'bounded_pull' torchmetrics_tpu/serve/federation.py; then
+  echo "federation: serve/federation.py no longer pulls through bounded_pull"
+  federation_ok=0
+fi
+if ! grep -q 'pack_from' torchmetrics_tpu/serve/federation.py; then
+  echo "federation: serve/federation.py lost the packed-plan fold staging"
+  federation_ok=0
+fi
+if ! grep -q 'TORCHMETRICS_TPU_FEDERATION_STALENESS_S' torchmetrics_tpu/engine/config.py; then
+  echo "federation: TORCHMETRICS_TPU_FEDERATION_* knobs missing from KNOB_REGISTRY"
+  federation_ok=0
+fi
+if ! grep -q 'dist_reduce_fx=kll_merge' torchmetrics_tpu/serve/quantile.py; then
+  echo "federation: serve/quantile.py lost the callable kll_merge reduction"
+  federation_ok=0
+fi
+if ! grep -q 'federation-ingest' torchmetrics_tpu/diag/transfer_guard.py; then
+  echo "federation: the federation-ingest boundary left TRANSFER_LABELS"
+  federation_ok=0
+fi
+if [[ $federation_ok -eq 1 ]]; then
+  echo "federation wiring: ok"
+else
+  status=1
+fi
+
+echo
 echo "=== bench smoke (CPU) ==="
 # The r05 regression class: bench.py must degrade to partial JSON with explicit
 # status markers and rc=0 when no TPU exists — never die with a traceback.

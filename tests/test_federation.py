@@ -1,0 +1,481 @@
+"""Federated multi-pod aggregation plane tests (serve/federation.py):
+envelope integrity (version/CRC tamper rejection), 4-emulated-pod churn with
+fault injection at the pull boundary (degraded fold excludes the vanished pod
+with counted events; returning pod rejoins without double-counting via the
+watermark dedupe), arrival-order byte-stability, the versioned sidecar
+``/state`` endpoint (200 round-trip + typed 503), KLL quantile-sketch rank
+error bounds surviving merges, and the merge_hists geometric-bucket property.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.diag import diag_context
+from torchmetrics_tpu.diag.hist import BOUNDS, GROWTH, Histogram, merge_hists
+from torchmetrics_tpu.parallel.elastic import SnapshotIntegrityError, SnapshotVersionError
+from torchmetrics_tpu.parallel.faults import RankDrop, fault_context
+from torchmetrics_tpu.serve import (
+    CardinalitySketch,
+    FederationAggregator,
+    HeavyHitters,
+    KLLSketch,
+    MetricsSidecar,
+    TenantSlices,
+    federated_rollup,
+    pack_envelope,
+    parse_envelope,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+
+def _local(metric):
+    metric.sync_on_compute = False
+    return metric
+
+
+def _pod_metrics():
+    return {
+        "sum": _local(SumMetric()),
+        "mean": _local(MeanMetric()),
+        "cat": _local(CatMetric()),
+        "card": _local(CardinalitySketch(p=8)),
+        "hh": _local(HeavyHitters(k=8, depth=4, width=256)),
+    }
+
+
+def _template():
+    return _pod_metrics()
+
+
+def _feed(pod, vals, ids):
+    pod["sum"].update(jnp.asarray(vals))
+    pod["mean"].update(jnp.asarray(vals))
+    pod["cat"].update(jnp.asarray(vals))
+    pod["card"].update(jnp.asarray(ids))
+    pod["hh"].update(jnp.asarray(ids))
+
+
+# ------------------------------------------------------------------ envelope
+
+
+def test_envelope_round_trip():
+    pod = _pod_metrics()
+    _feed(pod, np.arange(1.0, 9.0, dtype=np.float32), np.arange(40))
+    data, headers = pack_envelope(pod)
+    env = parse_envelope(data, headers)
+    assert sorted(env.states) == sorted(pod)
+    assert env.seq == sum(m._update_count for m in pod.values())
+    np.testing.assert_array_equal(
+        np.asarray(env.states["sum"]["value"]).ravel(), [np.arange(1.0, 9.0).sum()]
+    )
+
+
+def test_envelope_crc_tamper_rejected():
+    import io
+
+    pod = {"sum": _local(SumMetric())}
+    pod["sum"].update(jnp.asarray(3.0))
+    data, headers = pack_envelope(pod)
+    # repack with one state value changed but the ORIGINAL crc stamp: the
+    # integrity check must refuse the altered payload
+    with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+        flat = {k: np.asarray(npz[k]) for k in npz.files}
+    flat["sum::value"] = flat["sum::value"] + 1.0
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    with pytest.raises(SnapshotIntegrityError, match="integrity"):
+        parse_envelope(buf.getvalue(), headers)
+    # a tampered sequence number (replay-watermark forgery) is equally loud
+    flat["sum::value"] = flat["sum::value"] - 1.0
+    flat["__seq__"] = np.asarray(999, dtype=np.int64)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    with pytest.raises(SnapshotIntegrityError, match="integrity"):
+        parse_envelope(buf.getvalue())
+
+
+def test_envelope_version_mismatch_rejected():
+    pod = {"sum": _local(SumMetric())}
+    pod["sum"].update(jnp.asarray(3.0))
+    data, headers = pack_envelope(pod)
+    bad = dict(headers)
+    bad["X-TM-Layout-Version"] = "999"
+    with pytest.raises(SnapshotVersionError):
+        parse_envelope(data, bad)
+
+
+def test_envelope_header_crc_cross_check():
+    pod = {"sum": _local(SumMetric())}
+    pod["sum"].update(jnp.asarray(3.0))
+    data, headers = pack_envelope(pod)
+    bad = dict(headers)
+    bad["X-TM-Payload-CRC"] = "0xdeadbeef"
+    with pytest.raises(SnapshotIntegrityError):
+        parse_envelope(data, bad)
+
+
+# ------------------------------------------------------------------ aggregator
+
+
+def test_global_fold_parity_with_single_stream():
+    """Fold of N pod snapshots == one pod that saw the union stream."""
+    rng = np.random.default_rng(7)
+    streams = [rng.integers(1, 100, 50).astype(np.float32) for _ in range(3)]
+    id_streams = [rng.integers(0, 500, 80) for _ in range(3)]
+    pods = {}
+    for i, (vals, ids) in enumerate(zip(streams, id_streams)):
+        pod = _pod_metrics()
+        _feed(pod, vals, ids)
+        pods[f"pod{i}"] = pod
+    agg = FederationAggregator(
+        _template(), pods={pid: (lambda p=pod: pack_envelope(p)) for pid, pod in pods.items()}
+    )
+    assert all(agg.pull_round().values())
+    g = agg.compute_global()
+    ref = _pod_metrics()
+    for vals, ids in zip(streams, id_streams):
+        _feed(ref, vals, ids)
+    all_vals = np.concatenate(streams)
+    assert float(g["sum"]) == pytest.approx(float(all_vals.sum()))
+    assert float(g["mean"]) == pytest.approx(float(all_vals.mean()))
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(g["cat"]).ravel()), np.sort(all_vals)
+    )
+    # HLL register-max fold: exactly the union sketch
+    assert float(g["card"]) == float(ref["card"].compute())
+
+
+def test_fold_byte_stable_under_arrival_order():
+    streams = [np.arange(i * 10.0, i * 10.0 + 8.0, dtype=np.float32) for i in range(3)]
+    pods = {}
+    for i, vals in enumerate(streams):
+        pod = _pod_metrics()
+        _feed(pod, vals, np.arange(i * 30, i * 30 + 30))
+        pods[f"pod{i}"] = pod
+    envelopes = {pid: pack_envelope(pod) for pid, pod in pods.items()}
+
+    def fold_in_order(order):
+        agg = FederationAggregator(_template())
+        for pid in order:
+            data, headers = envelopes[pid]
+            assert agg.ingest(pid, data, headers)
+        return agg.fold()
+
+    f1 = fold_in_order(["pod0", "pod1", "pod2"])
+    f2 = fold_in_order(["pod2", "pod0", "pod1"])
+    for owner in f1:
+        for attr, a in f1[owner].items():
+            b = f2[owner][attr]
+            if isinstance(a, list):
+                for x, y in zip(a, b):
+                    assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+            else:
+                assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), (owner, attr)
+
+
+def test_stale_snapshot_watermark_dedupe():
+    pod = _local(SumMetric())
+    pod.update(jnp.asarray(2.0))
+    data, headers = pack_envelope(pod)
+    agg = FederationAggregator(SumMetric())
+    with diag_context(capacity=128) as rec:
+        assert agg.ingest("p", data, headers) is True
+        # replaying the SAME snapshot must not fold twice
+        assert agg.ingest("p", data, headers) is False
+        assert rec.count("federation.stale") == 1
+    assert agg.stats.federation_stale_skips == 1
+    assert float(agg.compute_global()) == 2.0
+
+
+def test_pod_churn_degraded_fold_and_rejoin():
+    """4 emulated pods; one vanishes mid-round (fault injection at the pull
+    boundary) -> the degraded global fold excludes it with counted events;
+    the returning pod rejoins without double-counting."""
+    metrics = {}
+    for i, pid in enumerate(["p0", "p1", "p2", "p3"]):
+        m = _local(SumMetric())
+        m.update(jnp.asarray(float(i + 1)))
+        metrics[pid] = m
+    agg = FederationAggregator(
+        SumMetric(),
+        pods={pid: (lambda m=m: pack_envelope(m)) for pid, m in metrics.items()},
+        retries=0,
+        staleness_s=1800.0,
+    )
+    with diag_context(capacity=512) as rec:
+        assert all(agg.pull_round().values())
+        assert float(agg.compute_global()) == 10.0
+        # p2 (canonical rank 2) vanishes at the pull boundary; everyone else
+        # advances a round
+        with fault_context(RankDrop(2, label="federation-pull*")):
+            for i, pid in enumerate(["p0", "p1", "p2", "p3"]):
+                metrics[pid].update(jnp.asarray(10.0 * (i + 1)))
+            res = agg.pull_round()
+        assert res == {"p0": True, "p1": True, "p2": False, "p3": True}
+        assert rec.count("federation.degraded") >= 1
+        # p2's last VERIFIED snapshot still participates (within staleness):
+        # degraded pull, not wrong values
+        assert float(agg.compute_global()) == 11.0 + 22.0 + 3.0 + 44.0
+        # keep p2 vanished: age its round-2 snapshot past the staleness bound
+        # (backdated directly — a wall-clock sleep would race the survivors'
+        # own snapshot ages) — the fold must EXCLUDE it (degraded), not zero
+        # it and not hang
+        agg.pods.pop("p2")
+        agg._slots["p2"].ts -= 2.0 * agg.staleness_s
+        agg.pull_round()  # refreshes p0/p1/p3 only
+        before_folds = agg.stats.federation_folds
+        g = agg.compute_global()
+        assert agg.stats.federation_folds == before_folds + 1
+        assert agg.stats.federation_degraded_folds >= 1
+        assert float(g) == 11.0 + 22.0 + 44.0
+        state = agg.federation_state()
+        assert state["pods"] == 3 and state["degraded_pods"] >= 1
+        # rejoin: fresh seq replaces the slot — no double count
+        metrics["p2"].update(jnp.asarray(1000.0))
+        agg.staleness_s = 1800.0
+        data, headers = pack_envelope(metrics["p2"])
+        assert agg.ingest("p2", data, headers) is True
+        assert rec.count("federation.rejoin") >= 1
+        assert float(agg.compute_global()) == 11.0 + 22.0 + (3.0 + 30.0 + 1000.0) + 44.0
+
+
+def test_fold_with_no_pods_raises():
+    agg = FederationAggregator(SumMetric())
+    with pytest.raises(TorchMetricsUserError, match="no verified pod snapshot"):
+        agg.fold()
+
+
+def test_compensated_residuals_reanchor_at_global_tier():
+    """Envelope residuals feed the two-sum fold: the global sum is exact for
+    a stream that plain float32 accumulation would lose."""
+    from torchmetrics_tpu.engine.numerics import compensated_context
+
+    with compensated_context(True):
+        pods = {}
+        for i in range(2):
+            m = _local(SumMetric())
+            m.update(jnp.asarray(np.float32(1e8)))
+            for _ in range(5):
+                m.update(jnp.asarray(np.float32(1.0)))
+            pods[f"p{i}"] = m
+        agg = FederationAggregator(
+            SumMetric(), pods={pid: (lambda m=m: pack_envelope(m)) for pid, m in pods.items()}
+        )
+        agg.pull_round()
+        total = float(agg.compute_global())
+    # the exact union sum is 2e8+10; float32 spacing at 2e8 is 16, so the
+    # correctly-rounded representable answer is 2e8+16. Naive accumulation
+    # loses every +1.0 against the 1e8 anchor (ulp there is 8) and lands on
+    # exactly 2e8 — the re-anchored two-sum keeps the tail.
+    assert total == pytest.approx(2e8 + 10.0, abs=8.0)
+    assert abs(total - 2e8) > 4.0
+
+
+# ------------------------------------------------------------------ sidecar /state
+
+
+def test_sidecar_state_endpoint_round_trip():
+    m = _local(SumMetric())
+    m.update(jnp.asarray(5.0))
+    with MetricsSidecar(state_target=m) as sc:
+        url = f"http://{sc.host}:{sc.port}/state"
+        with urllib.request.urlopen(url) as resp:
+            assert resp.headers["X-TM-Layout-Version"] == "1"
+            assert resp.headers["X-TM-Snapshot-Seq"] == "1"
+            body = resp.read()
+        env = parse_envelope(body)
+        assert "metric" in env.states
+        # aggregator pulls the live endpoint end-to-end
+        agg = FederationAggregator(SumMetric(), pods={"pod": url})
+        assert agg.pull_round() == {"pod": True}
+        assert float(agg.compute_global()) == 5.0
+
+
+def test_sidecar_state_503_without_target():
+    with MetricsSidecar() as sc:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://{sc.host}:{sc.port}/state")
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["reason"] == "no-state-target"
+
+
+# ------------------------------------------------------------------ KLL sketch
+
+
+def _rank_err(data, est, q):
+    n = len(data)
+    return abs(int((data <= est).sum()) - int(np.ceil(q * n)))
+
+
+def test_kll_rank_error_within_proven_bound():
+    rng = np.random.default_rng(11)
+    data = rng.uniform(0.5, 1000.0, 200_000).astype(np.float32)
+    sketch = _local(KLLSketch(k=256, qs=(0.5, 0.99)))
+    for start in range(0, len(data), 8192):
+        sketch.update(jnp.asarray(data[start : start + 8192]))
+    bound = sketch.rank_error_bound(len(data))
+    for q in (0.5, 0.9, 0.99):
+        est = float(sketch.quantile(q))
+        assert _rank_err(data, est, q) <= bound, (q, est)
+    assert sketch.total_weight() == len(data)
+
+
+def test_kll_merge_preserves_bound_and_weight():
+    """dist_reduce_fx merge: the merged sketch answers for the union stream
+    within the union-n bound — and conserves total weight exactly."""
+    rng = np.random.default_rng(13)
+    parts = [rng.uniform(0.5, 100.0, 40_000).astype(np.float32) for _ in range(3)]
+    sketches = []
+    for part in parts:
+        s = _local(KLLSketch(k=128))
+        for start in range(0, len(part), 8192):
+            s.update(jnp.asarray(part[start : start + 8192]))
+        sketches.append(s)
+    from torchmetrics_tpu.serve.quantile import kll_merge
+
+    merged_state = kll_merge(jnp.stack([s.compactors for s in sketches]))
+    merged = _local(KLLSketch(k=128))
+    merged.compactors = merged_state
+    union = np.concatenate(parts)
+    assert merged.total_weight() == len(union)
+    bound = merged.rank_error_bound(len(union))
+    for q in (0.5, 0.99):
+        est = float(merged.quantile(q))
+        assert _rank_err(union, est, q) <= bound, (q, est)
+
+
+def test_kll_exact_below_capacity():
+    data = np.arange(1.0, 65.0, dtype=np.float32)
+    s = _local(KLLSketch(k=64))
+    s.update(jnp.asarray(data))
+    assert s.rank_error_bound(len(data)) == 0
+    assert float(s.quantile(0.5)) == 32.0  # sorted[ceil(0.5*64)-1]
+
+
+def test_kll_coarse_quantile_geometric_bound():
+    rng = np.random.default_rng(17)
+    data = rng.uniform(1.0, 500.0, 50_000).astype(np.float32)
+    s = _local(KLLSketch(k=64))
+    for start in range(0, len(data), 8192):
+        s.update(jnp.asarray(data[start : start + 8192]))
+    for q in (0.5, 0.9):
+        exact = float(np.quantile(data, q, method="inverted_cdf"))
+        coarse = float(s.coarse_quantile(q))
+        assert exact <= coarse * 1.0001
+        assert coarse <= exact * GROWTH * 1.0001
+
+
+def test_kll_federates_through_aggregator():
+    rng = np.random.default_rng(19)
+    parts = [rng.uniform(1.0, 100.0, 30_000).astype(np.float32) for _ in range(2)]
+    pods = {}
+    for i, part in enumerate(parts):
+        s = _local(KLLSketch(k=128))
+        for start in range(0, len(part), 8192):
+            s.update(jnp.asarray(part[start : start + 8192]))
+        pods[f"p{i}"] = s
+    agg = FederationAggregator(
+        KLLSketch(k=128), pods={pid: (lambda s=s: pack_envelope(s)) for pid, s in pods.items()}
+    )
+    agg.pull_round()
+    folded = agg.fold()
+    merged = _local(KLLSketch(k=128))
+    merged.compactors = folded["metric"]["compactors"]
+    union = np.concatenate(parts)
+    assert merged.total_weight() == len(union)
+    bound = merged.rank_error_bound(len(union))
+    est = float(merged.quantile(0.5))
+    assert _rank_err(union, est, 0.5) <= bound
+
+
+# ------------------------------------------------------------------ merge_hists
+
+
+def test_merge_hists_quantile_bound_survives_merge():
+    """Property: merged histogram == histogram of the union stream, so the
+    <= 18.92% one-sided quantile error bound survives merging."""
+    rng = np.random.default_rng(23)
+    a_vals = rng.uniform(0.5, 2000.0, 5000)
+    b_vals = rng.uniform(10.0, 50000.0, 3000)
+    a, b = Histogram(), Histogram()
+    for v in a_vals:
+        a.record(v)
+    for v in b_vals:
+        b.record(v)
+    merged = merge_hists(a, b)
+    union = np.concatenate([a_vals, b_vals])
+    ref = Histogram()
+    for v in union:
+        ref.record(v)
+    assert merged.counts == ref.counts
+    assert merged.total == len(union)
+    assert merged.min == union.min() and merged.max == union.max()
+    for q in (0.1, 0.5, 0.9, 0.99):
+        exact = float(np.quantile(union, q, method="inverted_cdf"))
+        est = merged.quantile(q)
+        assert exact <= est * 1.0001
+        assert est <= exact * GROWTH * 1.0001
+
+
+def test_merge_hists_empty_and_commutative():
+    a, b = Histogram(), Histogram()
+    for v in (1.0, 2.0, 4.0):
+        a.record(v)
+    ab, ba = merge_hists(a, b), merge_hists(b, a)
+    assert ab.counts == ba.counts == a.counts
+    assert ab.min == a.min and ab.max == a.max
+    assert merge_hists(b, Histogram()).total == 0
+
+
+# ------------------------------------------------------------------ tenant rollup
+
+
+def test_federated_rollup_exact_for_tracked_tenants():
+    s1 = _local(TenantSlices(SumMetric(nan_strategy=0.0), capacity=16, probes=4))
+    s2 = _local(TenantSlices(SumMetric(nan_strategy=0.0), capacity=16, probes=4))
+    for tid, v in [(1, 2.0), (2, 3.0), (1, 1.0)]:
+        s1.update(jnp.asarray(tid), jnp.asarray(v))
+    for tid, v in [(2, 5.0), (3, 7.0)]:
+        s2.update(jnp.asarray(tid), jnp.asarray(v))
+    roll = federated_rollup([s1, s2])
+    assert float(roll["tenants"][1]["value"]) == 3.0
+    assert float(roll["tenants"][2]["value"]) == 8.0
+    assert float(roll["tenants"][3]["value"]) == 7.0
+    assert roll["tenants"][1]["updates"] == 2
+    assert roll["spilled_updates"] == 0
+
+
+def test_federated_rollup_spill_reconciliation():
+    """A tenant that spilled on several pods surfaces with its combined
+    estimate from the merged count-min grid."""
+    caps = dict(capacity=2, probes=1, spill_k=4, spill_depth=4, spill_width=64)
+    s1 = _local(TenantSlices(SumMetric(nan_strategy=0.0), **caps))
+    s2 = _local(TenantSlices(SumMetric(nan_strategy=0.0), **caps))
+    # saturate both pods' 2-slot tables with distinct fillers, then hammer
+    # tenant 99 into the spill on each
+    for s in (s1, s2):
+        for tid in range(1, 9):
+            s.update(jnp.asarray(tid), jnp.asarray(1.0))
+    for _ in range(6):
+        s1.update(jnp.asarray(99), jnp.asarray(1.0))
+    for _ in range(4):
+        s2.update(jnp.asarray(99), jnp.asarray(1.0))
+    # precondition: the table really was full — 99 is spilled, not tracked
+    assert s1.tenant_updates(99) == 0 and s2.tenant_updates(99) == 0
+    roll = federated_rollup([s1, s2])
+    assert roll["spilled_updates"] >= 10
+    top = {e["tenant"]: e["estimate"] for e in roll["heavy_hitters"]}
+    assert top.get(99, 0) >= 10  # count-min overestimates, never under
+
+
+def test_federated_rollup_rejects_mismatched_layouts():
+    s1 = _local(TenantSlices(SumMetric(nan_strategy=0.0), capacity=16, probes=4))
+    s2 = _local(TenantSlices(MeanMetric(nan_strategy=0.0), capacity=16, probes=4))
+    with pytest.raises(TorchMetricsUserError, match="share the"):
+        federated_rollup([s1, s2])

@@ -44,6 +44,7 @@ __all__ = [
     "GROWTH",
     "Histogram",
     "histograms_snapshot",
+    "merge_hists",
     "observe",
     "reset_histograms",
 ]
@@ -130,6 +131,30 @@ class Histogram:
 
     def __repr__(self) -> str:
         return f"Histogram(n={self.total}, p50={self.quantile(0.5)}, p99={self.quantile(0.99)})"
+
+
+def merge_hists(a: Histogram, b: Histogram) -> Histogram:
+    """Merge two histograms over the shared geometric bounds.
+
+    Every histogram shares the class-level :data:`BOUNDS`, so the merge is an
+    elementwise register (bucket-count) addition plus the scalar folds —
+    commutative and associative, and exactly the histogram the union stream
+    would have produced (each sample lands in the same bucket regardless of
+    which pod recorded it). The quantile error bound is therefore unchanged by
+    merging: a merged estimate stays within ``[exact, exact * GROWTH]`` for
+    in-range samples — pinned by the property test in
+    ``tests/test_federation.py``. The cross-pod composition path for the
+    federated aggregation plane (``serve/federation.py``).
+    """
+    out = Histogram()
+    out.counts = [x + y for x, y in zip(a.counts, b.counts)]
+    out.total = a.total + b.total
+    out.sum = a.sum + b.sum
+    mins = [m for m in (a.min, b.min) if m is not None]
+    maxs = [m for m in (a.max, b.max) if m is not None]
+    out.min = min(mins) if mins else None
+    out.max = max(maxs) if maxs else None
+    return out
 
 
 # process-wide registry: (owner, kind, series) -> Histogram. Bounded by the

@@ -104,6 +104,11 @@ UNITLESS_COUNT_FAMILIES = frozenset({
     "tm_tpu_persist_stores", "tm_tpu_persist_envelope_rejects",
     "tm_tpu_persist_corrupt_skips", "tm_tpu_persist_fallbacks",
     "tm_tpu_persist_manifest_entries",
+    # federated aggregation plane (serve/federation.py, PR 18): ingest / fold /
+    # degraded / dedupe event counts and the live-pod gauge — pure counts
+    "tm_tpu_federation_ingests", "tm_tpu_federation_folds",
+    "tm_tpu_federation_degraded_folds", "tm_tpu_federation_stale_skips",
+    "tm_tpu_federation_pods", "tm_tpu_federation_degraded_pods",
 })
 
 # EngineStats fields exported as monotonic counters (everything countable);
@@ -163,6 +168,10 @@ _COUNTER_HELP = {
     "persist_hits": "compiles served by deserializing a persisted executable",
     "persist_misses": "compiles with no loadable persisted artifact (absent/stale/corrupt)",
     "prewarm_replays": "manifest rows replayed by prewarm before traffic landed",
+    "federation_ingests": "pod snapshots accepted by the federation aggregator",
+    "federation_folds": "global federation folds executed over the verified membership",
+    "federation_degraded_folds": "federation folds over a degraded (pod-excluding) membership",
+    "federation_stale_skips": "pod snapshots rejected by the federation watermark/staleness dedupe",
 }
 
 # exposition-convention names for counters whose field name buries the unit:
@@ -357,6 +366,19 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
         f"{_PREFIX}_serve_sketch_fill_ratio", "gauge",
         "fraction of touched sketch registers/cells (saturation)",
         [({"owner": s["owner"]}, s["fill_ratio"]) for s in serve.get("sketches", [])],
+    )
+    # federated aggregation plane (serve/federation.py): live/degraded pod
+    # gauges per aggregator. Ingest/fold/dedupe counts ride the EngineStats
+    # auto-export above (federation_ingests/folds/degraded_folds/stale_skips).
+    emit(
+        f"{_PREFIX}_federation_pods", "gauge",
+        "pods with a verified snapshot in the federation membership",
+        [({"owner": f["owner"]}, f["pods"]) for f in serve.get("federations", [])],
+    )
+    emit(
+        f"{_PREFIX}_federation_degraded_pods", "gauge",
+        "pods excluded from the last federation fold (stale/unreachable)",
+        [({"owner": f["owner"]}, f["degraded_pods"]) for f in serve.get("federations", [])],
     )
 
     # persistent executable cache (engine/persist.py): store/reject/fallback

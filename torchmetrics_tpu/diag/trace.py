@@ -136,6 +136,9 @@ EVENT_KINDS = frozenset({
     # serving layer (serve/)
     "serve.scrape", "serve.scrape.async", "serve.scrape.error", "serve.sidecar.start",
     "serve.snapshot", "serve.snapshot.read",
+    # federated multi-pod aggregation plane (serve/federation.py)
+    "federation.ingest", "federation.fold", "federation.degraded",
+    "federation.stale", "federation.rejoin",
     # engine-wide fallbacks + transfer guard (engine/stats.py, diag/transfer_guard.py)
     "fallback", "transfer.host", "transfer.blocked",
     # persistent executable cache + prewarm (engine/persist.py)

@@ -86,6 +86,7 @@ TRANSFER_LABELS = frozenset({
     # serving boundaries (serve/)
     "serve-setup",     # one-time np capture of nested-metric defaults (PR 9)
     "serve-scrape",    # scrape-path host reads with the snapshot retry protocol
+    "federation-ingest",  # pod-snapshot envelope (de)serialization at the aggregation tier
     # heavy-workload retained host paths (PR 15) — counted fallbacks, declared
     "fid-host-eigh",   # FID Fréchet on host LAPACK (TORCHMETRICS_TPU_FID_HOST_EIGH)
     "fid-sample-guard",  # FID's epoch-boundary <2-sample check (two scalar reads)

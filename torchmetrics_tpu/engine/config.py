@@ -69,6 +69,10 @@ KNOB_REGISTRY = {
     "TORCHMETRICS_TPU_BERT_BUCKETS": "torchmetrics_tpu.functional.text.bert:bert_buckets_enabled",
     # persistent executable cache (PR 17): zero-cold-start serving
     "TORCHMETRICS_TPU_PERSIST": "torchmetrics_tpu.engine.persist:persist_dir",
+    # federated aggregation plane (PR 18): cross-pod global folds
+    "TORCHMETRICS_TPU_FEDERATION_STALENESS_S": "torchmetrics_tpu.parallel.resilience:_env_float",
+    "TORCHMETRICS_TPU_FEDERATION_TIMEOUT_MS": "torchmetrics_tpu.parallel.resilience:_env_float",
+    "TORCHMETRICS_TPU_FEDERATION_RETRIES": "torchmetrics_tpu.serve.stats:_env_int",
 }
 
 #: parsers that read the env key through a ``name`` PARAMETER (shared

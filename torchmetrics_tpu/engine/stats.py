@@ -84,6 +84,11 @@ _COUNTER_FIELDS = (
     "persist_hits",  # compiles served by deserializing a persisted executable (no lower/compile)
     "persist_misses",  # compiles that found no loadable artifact (absent/stale/corrupt — counted, never wrong)
     "prewarm_replays",  # manifest rows replayed by prewarm() before traffic landed
+    # --- federated aggregation plane (serve/federation.py): cross-pod folds ---
+    "federation_ingests",  # pod snapshots accepted (version+CRC verified, watermark advanced)
+    "federation_folds",  # global folds executed over the verified pod membership
+    "federation_degraded_folds",  # global folds over a degraded (pod-excluding) membership
+    "federation_stale_skips",  # snapshots rejected by the watermark/staleness dedupe
 )
 
 

@@ -75,6 +75,25 @@ def _fingerprint(dims: Sequence[int]) -> int:
     return zlib.crc32(np.asarray(list(dims), dtype=np.int64).tobytes()) & 0x7FFFFFFF
 
 
+def _snapshot_cat_array(value: Any) -> Optional[Any]:
+    """Normalize a snapshot cat-state value (list or array) to a packed array.
+
+    Mirrors what the eager list path packs: elements concatenate along a
+    leading axis (scalars promote to length-1 rows). Returns ``None`` for an
+    empty list — the caller treats it as a zero-row contribution.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(value, (list, tuple)):
+        if not value:
+            return None
+        return jnp.concatenate([jnp.atleast_1d(jnp.asarray(e)) for e in value], axis=0)
+    if value is None:
+        return None
+    arr = jnp.asarray(value)
+    return arr.reshape(1) if arr.ndim == 0 else arr
+
+
 def all_gather_backbone(x: Any, label: str = "", members: Optional[Sequence[int]] = None) -> Any:
     """The host collective: one ``process_allgather`` returning ``(world, ...)``.
 
@@ -597,6 +616,48 @@ class PackedSyncPlan:
         # audit digests above already rode the sanctioned sync-audit boundary)
         return np.asarray(entries, dtype=np.int32)
 
+    def metadata_from_state(self, states: Dict[str, Dict[str, Any]]) -> Optional[np.ndarray]:
+        """:meth:`metadata_local` computed from a SNAPSHOT state-dict.
+
+        The federation aggregator (``serve/federation.py``) folds pod
+        *snapshots* — ``{owner: {attr: value}}`` dicts that arrived through the
+        verified ingest envelope — not live metrics, so the per-"rank" probe
+        entries (cat dim0s, list layouts, static-shape fingerprints) must come
+        from the provided arrays. Entry layout is identical to
+        :meth:`metadata_local` with the audit/timeline riders off (the
+        aggregation tier disables both on its plan: there is no cross-rank
+        barrier to timestamp and the divergence audit's rank-invariance
+        contract does not apply to independent pods).
+        """
+        entries: List[int] = []
+        for s in self.specs:
+            if not s.needs_meta:
+                continue
+            value = states.get(s.owner, {}).get(s.attr)
+            if s.kind == "cat":
+                arr = _snapshot_cat_array(value)
+                if arr is None or arr.size == 0:
+                    entries += [0, 0]
+                else:
+                    entries += [int(arr.shape[0]), _fingerprint(tuple(arr.shape[1:]))]
+            elif s.kind == "none-list":
+                elems = value if isinstance(value, (list, tuple)) else []
+                dims: List[int] = []
+                for e in elems:
+                    es = tuple(np.shape(e))
+                    dims.append(len(es))
+                    dims.extend(es)
+                entries += [len(elems), _fingerprint(dims)]
+            else:  # static-shape verification entry
+                shape = tuple(np.shape(value))
+                size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                entries += [size, _fingerprint(shape)]
+        if not entries:
+            return None
+        # tmlint: disable=TM101 — `entries` is a host list of python ints
+        # derived from snapshot shapes (no device buffer is read)
+        return np.asarray(entries, dtype=np.int32)
+
     # tmlint: host-only — validates the GATHERED metadata (host numpy, arrived
     # through the sanctioned sync-metadata exchange); touches no device buffer
     def finalize(self, world_meta: Optional[np.ndarray]) -> None:
@@ -776,6 +837,55 @@ class PackedSyncPlan:
                 flat = jnp.ravel(arr)
                 if flat.size < s.size:  # ragged: pad to the world max
                     flat = jnp.pad(flat, (0, s.size - flat.size))
+            else:
+                flat = jnp.ravel(jnp.asarray(val))
+            segments[s.group].append(flat)
+        return {k: jnp.concatenate(v) for k, v in segments.items() if v}
+
+    def pack_from(
+        self,
+        states: Dict[str, Dict[str, Any]],
+        residuals: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> Dict[str, Any]:
+        """:meth:`pack` over a SNAPSHOT state-dict instead of the live metrics.
+
+        Each pod's verified snapshot packs into the exact per-(role, dtype)
+        buffers the fold graph consumes, so one compiled
+        :meth:`make_fold` executable serves the aggregation tier unchanged.
+        ``residuals`` supplies the compensated-sum residual arrays per
+        ``{owner: {attr: residual}}`` (a pod snapshot carries them in its
+        envelope); an absent residual packs as zeros — the pod's value is then
+        folded as a clean anchor, which is exactly what a non-compensated pod
+        contributed.
+        """
+        import jax.numpy as jnp
+
+        if not self._finalized:
+            raise RuntimeError("finalize() must run before pack_from()")
+        segments: Dict[str, List[Any]] = {k: [] for k in self._group_sizes}
+        residuals = residuals or {}
+        for s in self.specs:
+            if not s.group or s.size == 0:
+                continue
+            if s.kind == "comp-res":
+                val = residuals.get(s.owner, {}).get(s.attr)
+                if val is None:
+                    val = jnp.zeros(s.shape, dtype=s.dtype)
+            else:
+                val = states.get(s.owner, {}).get(s.attr)
+            if s.kind == "none-list":
+                elems = val if isinstance(val, (list, tuple)) else []
+                flat = (
+                    jnp.concatenate([jnp.ravel(jnp.asarray(e)) for e in elems])
+                    if elems
+                    else jnp.zeros((0,))
+                )
+            elif s.kind == "cat":
+                arr = _snapshot_cat_array(val)
+                flat = jnp.zeros((0,), dtype=s.dtype) if arr is None else jnp.ravel(arr)
+                if flat.size < s.size:  # ragged: pad to the world max
+                    flat = jnp.pad(flat, (0, s.size - flat.size))
+                flat = flat.astype(s.dtype)
             else:
                 flat = jnp.ravel(jnp.asarray(val))
             segments[s.group].append(flat)

@@ -59,6 +59,7 @@ __all__ = [
     "ResiliencePolicy",
     "SyncFaultError",
     "bounded_collective",
+    "bounded_pull",
     "consume_straggler_hint",
     "current_policy",
     "last_straggler_rank",
@@ -371,6 +372,79 @@ def bounded_collective(
             return out
         except SyncFaultError as exc:
             exc.attempts = attempts
+            _count(f"fault:{type(exc).__name__}")
+            if not exc.retryable or attempt >= policy.retries:
+                _diag.record(
+                    "sync.fault", "", label=label, error=type(exc).__name__,
+                    rank=exc.rank, attempts=attempts, retryable=exc.retryable,
+                )
+                raise
+            _count("retries")
+            _diag.record(
+                "sync.retry", "", label=label, error=type(exc).__name__,
+                rank=exc.rank, attempt=attempts, backoff_ms=policy.backoff_ms * (2 ** attempt),
+            )
+            if policy.backoff_ms:
+                time.sleep(policy.backoff_ms * (2 ** attempt) / 1e3)
+            attempt += 1
+
+
+def bounded_pull(
+    fetch: Callable[[], Any],
+    label: str = "",
+    rank: Optional[int] = None,
+    members: Optional[Sequence[int]] = None,
+) -> Any:
+    """Run one point-to-point fetch (a federation pod pull) under the policy.
+
+    The aggregation-tier sibling of :func:`bounded_collective`: the same
+    deadline watchdog, bounded retry/backoff, typed-fault classification, and
+    fault-injection hook (``parallel/faults.py`` plants at this boundary via
+    the ``label``/``members`` contract, so pod-churn chaos rides the
+    production path). Two deliberate differences:
+
+    - A **pull is idempotent** — it reads a pod's snapshot endpoint, it does
+      not participate in an ordered collective stream — so a deadline expiry
+      that abandoned an in-flight fetch IS retried (``bounded_collective``
+      must not re-enter an abandoned collective; a re-issued GET is harmless).
+    - Untyped transport failures (socket errors, HTTP failures) classify as
+      :class:`RankUnreachableError` naming ``rank`` — not retryable: the
+      remedy is the aggregator's degraded fold over the reachable pods, the
+      exact recovery shape the degraded re-plan gives a dead rank.
+    """
+    from torchmetrics_tpu.diag import trace as _diag
+    from torchmetrics_tpu.parallel import faults as _faults
+
+    policy = current_policy()
+    attempt = 0
+    while True:
+        attempts = attempt + 1
+        try:
+            _faults.apply_before(label, members, policy.deadline_ms, attempts)
+            try:
+                if policy.deadline_ms is not None:
+                    out = _call_with_deadline(fetch, policy.deadline_ms, label, attempts)
+                else:
+                    out = fetch()
+            except SyncFaultError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — transport failure, classified below
+                raise RankUnreachableError(
+                    f"pull {label!r} failed to reach its pod"
+                    f" ({type(exc).__name__}: {exc}, attempt {attempts})",
+                    label=label,
+                    rank=rank,
+                    attempts=attempts,
+                ) from exc
+            return _faults.apply_after(label, members, out)
+        except SyncFaultError as exc:
+            exc.attempts = attempts
+            if isinstance(exc, CollectiveTimeoutError):
+                # an abandoned in-flight GET is safe to re-issue (idempotent
+                # read) — undo the watchdog's no-retry marking for pulls
+                exc.retryable = exc.retryable or exc.in_flight
+            if exc.rank is None:
+                exc.rank = rank
             _count(f"fault:{type(exc).__name__}")
             if not exc.retryable or attempt >= policy.retries:
                 _diag.record(

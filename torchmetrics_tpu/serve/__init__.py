@@ -10,32 +10,48 @@ semantics, all without host transfers in the hot loop:
 - :mod:`~torchmetrics_tpu.serve.sketch` — :class:`CardinalitySketch`
   (HLL-style distinct counting, max-merge) and :class:`HeavyHitters`
   (count-min + in-graph top-k) as fixed-memory first-class metric states;
+- :mod:`~torchmetrics_tpu.serve.quantile` — :class:`KLLSketch`: mergeable
+  deterministic quantile sketch (fixed compactor levels, in-graph update,
+  proven rank-error bound) seeded from the ``diag/hist.py`` bucket scheme;
 - :mod:`~torchmetrics_tpu.serve.tenancy` — :class:`TenantSlices`: bounded
   per-tenant slices sharing ONE executable (tenant id is data), spilling to
-  the heavy-hitter sketch past capacity;
+  the heavy-hitter sketch past capacity; :func:`federated_rollup` folds
+  per-pod views into exact global per-tenant values;
 - :mod:`~torchmetrics_tpu.serve.snapshot` — :func:`snapshot_compute`:
   ``compute()`` on a shielded state copy while updates continue;
 - :mod:`~torchmetrics_tpu.serve.sidecar` — :class:`MetricsSidecar`: the PR-4
-  Prometheus/JSONL exporters behind a threaded scrape endpoint.
+  Prometheus/JSONL exporters behind a threaded scrape endpoint, plus the
+  versioned ``/state`` snapshot-envelope surface;
+- :mod:`~torchmetrics_tpu.serve.federation` —
+  :class:`FederationAggregator`: the multi-pod aggregation plane — verified
+  envelope ingest/pull, canonical-order global folds through the packed-sync
+  machinery, degraded semantics at pod loss.
 
 See ``docs/pages/serving.md`` for semantics, error bounds, and knobs.
 """
 
+from torchmetrics_tpu.serve.federation import FederationAggregator, pack_envelope, parse_envelope
+from torchmetrics_tpu.serve.quantile import KLLSketch
 from torchmetrics_tpu.serve.sidecar import MetricsSidecar
 from torchmetrics_tpu.serve.sketch import CardinalitySketch, HeavyHitters
 from torchmetrics_tpu.serve.snapshot import StateSnapshot, snapshot_compute, take_snapshot
 from torchmetrics_tpu.serve.stats import reset_serve_stats, serve_state
-from torchmetrics_tpu.serve.tenancy import TenantSlices
+from torchmetrics_tpu.serve.tenancy import TenantSlices, federated_rollup
 from torchmetrics_tpu.serve.window import DecayedMetric, WindowedMetric
 
 __all__ = [
     "CardinalitySketch",
     "DecayedMetric",
+    "FederationAggregator",
     "HeavyHitters",
+    "KLLSketch",
     "MetricsSidecar",
     "StateSnapshot",
     "TenantSlices",
     "WindowedMetric",
+    "federated_rollup",
+    "pack_envelope",
+    "parse_envelope",
     "reset_serve_stats",
     "serve_state",
     "snapshot_compute",

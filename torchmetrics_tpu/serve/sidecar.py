@@ -9,7 +9,14 @@ stdlib only, no new dependencies — and answers:
   version a Prometheus scraper negotiates);
 - ``GET /telemetry`` → one ``telemetry_snapshot()`` as a JSON line
   (``application/json``), the JSONL tail-dashboard feed;
-- ``GET /healthz``   → liveness probe.
+- ``GET /healthz``   → liveness probe;
+- ``GET /state``     → the versioned federation envelope for the sidecar's
+  ``state_target`` metrics (``serve/federation.py``): packed snapshot bytes
+  with layout-version, payload-CRC, and snapshot-sequence headers, built on
+  the pause-free :func:`~torchmetrics_tpu.serve.snapshot.take_snapshot` —
+  answering never stalls the training thread. Until a consistent snapshot
+  exists the endpoint answers **503 with a typed JSON reason**, never an
+  empty 200 an aggregator would mistake for a zero-valued pod.
 
 Every scrape is timed into the ``serve_scrape_latency_seconds`` histogram
 family (``diag/hist.py``) and the ``tm_tpu_serve_scrapes_total`` counters;
@@ -65,8 +72,12 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
         t0 = perf_counter()
         path = self.path.split("?", 1)[0]
+        status = 200
+        extra_headers: dict = {}
         try:
-            if path in ("/metrics", "/"):
+            if path == "/state":
+                status, extra_headers, body, ctype = self._state_response()
+            elif path in ("/metrics", "/"):
                 from torchmetrics_tpu.diag.telemetry import export_prometheus
 
                 # drain-before-scrape (engine/scan.py): counters and gauges a
@@ -90,15 +101,41 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
         except Exception as exc:  # noqa: BLE001 — a scrape failure must answer, not hang
             self.send_error(500, f"{type(exc).__name__}: {exc}")
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in extra_headers.items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
         elapsed = perf_counter() - t0
         _serve_stats.note_scrape(elapsed)
         _hist.observe("sidecar", "serve", "scrape_us", round(elapsed * 1e6, 3))
-        _diag.record("serve.scrape", "sidecar", path=path, bytes=len(body))
+        _diag.record("serve.scrape", "sidecar", path=path, status=status, bytes=len(body))
+
+    def _state_response(self) -> tuple:
+        """The versioned ``/state`` endpoint: one federation envelope.
+
+        A pod that cannot yet answer CONSISTENTLY says so — ``503`` with a
+        typed JSON reason (``no-state-target`` when the sidecar serves no
+        metrics, ``snapshot-inconsistent`` when the update loop never
+        quiesced within the retry budget) — never an empty ``200`` a naive
+        aggregator would fold as a zero-valued pod.
+        """
+        from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+        target = getattr(self.server, "tm_state_target", None)
+        if target is None:
+            reason = json.dumps({"reason": "no-state-target"}) + "\n"
+            return 503, {}, reason.encode(), "application/json"
+        from torchmetrics_tpu.serve.federation import pack_envelope
+
+        try:
+            body, headers = pack_envelope(target)
+        except TorchMetricsUserError as exc:
+            reason = json.dumps({"reason": "snapshot-inconsistent", "detail": str(exc)}) + "\n"
+            return 503, {}, reason.encode(), "application/json"
+        return 200, headers, body, "application/octet-stream"
 
     def log_message(self, *_: Any) -> None:
         """Silence the default stderr access log (scrapes are periodic)."""
@@ -133,6 +170,7 @@ class MetricsSidecar:
         warm_target: Any = None,
         persist_dir: Optional[str] = None,
         snapshot_dir: Optional[str] = None,
+        state_target: Any = None,
     ) -> None:
         self._requested_port = _serve_stats.default_port() if port is None else int(port)
         self.host = host
@@ -142,6 +180,7 @@ class MetricsSidecar:
         self._warm_target = warm_target
         self._persist_dir = persist_dir
         self._snapshot_dir = snapshot_dir
+        self._state_target = state_target
         self.warm_report: Optional[dict] = None
 
     @property
@@ -165,6 +204,9 @@ class MetricsSidecar:
             )
         server = ThreadingHTTPServer((self.host, self._requested_port), _ScrapeHandler)
         server.daemon_threads = True
+        # the /state handler reads this off the server object (handler
+        # instances are per-request; the server is the shared context)
+        server.tm_state_target = self._state_target
         self._server = server
         self.port = server.server_address[1]
         self._thread = threading.Thread(

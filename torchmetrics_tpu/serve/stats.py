@@ -16,6 +16,8 @@ of silently disabling the knob.
   :class:`~torchmetrics_tpu.serve.sidecar.MetricsSidecar` (0 = ephemeral).
 - ``TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES`` — consistency-retry budget for
   :func:`~torchmetrics_tpu.serve.snapshot.take_snapshot`.
+- ``TORCHMETRICS_TPU_FEDERATION_RETRIES`` — bounded-pull retry budget for
+  :class:`~torchmetrics_tpu.serve.federation.FederationAggregator`.
 """
 
 from __future__ import annotations
@@ -28,8 +30,10 @@ from typing import Any, Dict
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 __all__ = [
+    "federation_retries",
     "note_scrape",
     "note_snapshot",
+    "register_federation",
     "register_sketch",
     "register_tenancy",
     "reset_serve_stats",
@@ -54,6 +58,7 @@ _COUNTERS: Dict[str, float] = {  # guarded-by: _LOCK
 _SEQ = iter(range(1, 1 << 62)).__next__
 _TENANCIES: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
 _SKETCHES: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
+_FEDERATIONS: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
 
 
 def register_tenancy(obj: Any) -> None:
@@ -62,6 +67,10 @@ def register_tenancy(obj: Any) -> None:
 
 def register_sketch(obj: Any) -> None:
     _SKETCHES[_SEQ()] = obj
+
+
+def register_federation(obj: Any) -> None:
+    _FEDERATIONS[_SEQ()] = obj
 
 
 def note_scrape(seconds: float) -> None:
@@ -119,6 +128,14 @@ def serve_state() -> Dict[str, Any]:
             _note_failed(owner, exc)
     out["tenancies"] = sorted(tenants, key=lambda t: t["owner"])
     out["sketches"] = sorted(sketches, key=lambda s: s["owner"])
+    federations = []
+    for seq, obj in sorted(_FEDERATIONS.items()):
+        owner = f"{type(obj).__name__}#{seq}"
+        try:
+            federations.append({"owner": owner, **obj.federation_state()})
+        except Exception as exc:  # noqa: BLE001
+            _note_failed(owner, exc)
+    out["federations"] = sorted(federations, key=lambda f: f["owner"])
     return out
 
 
@@ -154,3 +171,7 @@ def default_port() -> int:
 
 def snapshot_retries() -> int:
     return _env_int("TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES", 8, 1, 1000)
+
+
+def federation_retries() -> int:
+    return _env_int("TORCHMETRICS_TPU_FEDERATION_RETRIES", 2, 0, 100)

@@ -56,7 +56,7 @@ from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 Array = jax.Array
 
-__all__ = ["TenantSlices"]
+__all__ = ["TenantSlices", "federated_rollup"]
 
 
 class TenantSlices(Metric):
@@ -309,3 +309,108 @@ class TenantSlices(Metric):
                 for i, c in zip(ids[live].tolist(), counts[live].tolist())
             ],
         }
+
+def _host_cms_estimate(cms: np.ndarray, tenant_id: int, width: int) -> int:
+    """Host-mirror count-min query (bit-for-bit the device hash chain)."""
+    u = canon_u32_host(tenant_id)
+    return int(
+        min(int(cms[d][hash_u32_host(u, _CMS_SEEDS[d]) & (width - 1)]) for d in range(len(cms)))
+    )
+
+
+# tmlint: host-only — every device read below rides read_host's sanctioned
+# serve-scrape boundary; the folds themselves are host numpy over those views
+def federated_rollup(slices: Any) -> Dict[str, Any]:
+    """Global per-tenant rollup across pods' :class:`TenantSlices` views.
+
+    The federation fold for tenancy: given one :class:`TenantSlices` per pod
+    (or, equivalently, per-pod clones restored from verified snapshots), fold
+    the per-tenant slices **by tenant id** — NOT by slot, since each pod's
+    probe table assigned its own slots — so tracked tenants stay *exact*
+    across the fleet, with each state folded by its declared sum/max/min
+    algebra and the update counters summed.
+
+    Spilled traffic reconciles approximately but accountably: the spill
+    volumes sum exactly, the count-min grids sum elementwise (the sketch's
+    merge algebra), and the candidate heavy hitters — the union of every
+    pod's tracked spill ids — are re-estimated against the MERGED grid with
+    the host-mirror hash chain, so a tenant that spilled on several pods
+    surfaces with its combined estimate even if no single pod ranked it.
+
+    Returns ``{"tenants": {tid: {"value", "updates"}}, "spilled_updates",
+    "heavy_hitters"}`` with deterministically ordered heavy hitters
+    (estimate desc, id asc).
+    """
+    slices = list(slices)
+    if not slices:
+        raise TorchMetricsUserError(
+            "federated_rollup needs at least one TenantSlices view to fold."
+        )
+    first = slices[0]
+    base_keys = first._base_keys
+    folds = first._slot_folds
+    spill_k, spill_depth, spill_width = first._spill_geom
+    for other in slices[1:]:
+        if other._base_keys != base_keys or other._spill_geom != first._spill_geom:
+            raise TorchMetricsUserError(
+                "federated_rollup requires every pod's TenantSlices to share the"
+                " template states and spill-sketch geometry — got mismatched"
+                f" layouts ({base_keys} vs {other._base_keys})."
+            )
+    tenants: Dict[int, Dict[str, Any]] = {}
+    spilled_total = 0
+    cms_sum = np.zeros((spill_depth, spill_width), dtype=np.int64)
+    candidates: set = set()
+    for s in slices:
+        host = read_host(
+            s,
+            ("tenant_ids", "tenant_counts", "spilled", "spill_cms", "spill_ids")
+            + tuple("seg_" + k for k in base_keys),
+        )
+        table = host["tenant_ids"]
+        counts = host["tenant_counts"]
+        for slot in range(s.capacity):  # the dump row (index capacity) is spill
+            tid = int(table[slot])
+            if tid < 0:
+                continue
+            entry = tenants.get(tid)
+            if entry is None:
+                entry = tenants[tid] = {
+                    "updates": 0,
+                    "states": {key: None for key in base_keys},
+                }
+            entry["updates"] += int(counts[slot])
+            for key in base_keys:
+                row = np.asarray(host["seg_" + key][slot])
+                prev = entry["states"][key]
+                if prev is None:
+                    entry["states"][key] = row
+                else:
+                    kind = folds[key][0]
+                    entry["states"][key] = (
+                        prev + row if kind == "sum"
+                        else np.maximum(prev, row) if kind == "max"
+                        else np.minimum(prev, row)
+                    )
+        spilled_total += int(host["spilled"])
+        cms_sum += np.asarray(host["spill_cms"], dtype=np.int64)
+        ids = np.asarray(host["spill_ids"])
+        candidates.update(int(i) for i in ids[ids >= 0].tolist())
+    out_tenants: Dict[int, Dict[str, Any]] = {}
+    for tid in sorted(tenants):
+        entry = tenants[tid]
+        states = {key: jnp.asarray(v) for key, v in entry["states"].items()}
+        out_tenants[tid] = {
+            "value": run_base_compute(first.template, states),
+            "updates": entry["updates"],
+        }
+    hh = [
+        {"tenant": tid, "estimate": _host_cms_estimate(cms_sum, tid, spill_width)}
+        for tid in sorted(candidates)
+    ]
+    hh.sort(key=lambda e: (-e["estimate"], e["tenant"]))
+    return {
+        "tenants": out_tenants,
+        "spilled_updates": spilled_total,
+        "heavy_hitters": hh[:spill_k],
+    }
