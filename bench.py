@@ -80,7 +80,7 @@ _SCENARIO_BYTES = {
 # every scenario block scripts/check_counters.py gates on: a run (including
 # the TPU-less micro fallback) must prove each of these completed, or the
 # gate's scenario-completeness check fails — nothing gated can skip silently
-_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "federation", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart")
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "federation", "fleet", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart")
 
 # the sharding scenario partitions state over a >= 4-device mesh; on a host
 # platform that needs forced virtual devices, set BEFORE jax initializes (the
@@ -1698,6 +1698,164 @@ def bench_federation():
     out["federation_rejoin_ok"] = rejoin_ok
     state = agg.federation_state()
     out["federation_state_pods"] = state["pods"]
+    return out
+
+
+def bench_fleet():
+    """Fleet observability plane (ISSUE 19 acceptance evidence):
+
+    - **4-pod telemetry merge**: 4 emulated pods (callable envelope sources,
+      distinct lognormal sync-latency streams) pulled through ``bounded_pull``
+      and merged under the STRICT guard — the envelope is pure host data, so
+      the whole pull → merge → export cycle must record **0 host transfers**;
+    - **merged p99 within the paper bound**: the fleet histogram IS the
+      union-stream histogram, so the merged p99 keeps the one-sided
+      ``GROWTH = 2**0.25`` error against exact ``np.quantile`` over the
+      pooled 4-pod stream (rel err reported);
+    - **permutation-stable exposition**: the pod-labeled Prometheus text is
+      byte-identical for every ingest-order permutation of the same
+      envelopes, once the single wall-clock family
+      (``fleet_pod_staleness_seconds``) is stripped;
+    - **SLO breach → not-ready → recover**: one pod vanishes at the pull
+      boundary (fault injection), the degraded pull moves the blocking
+      ``fleet-degraded-pulls`` burn-rate SLO, and the aggregator's own
+      ``/healthz`` flips to 503 NAMING the SLO; a clean round past the fast
+      burn window recovers it back to 200 — readiness is evidence, not
+      liveness.
+    """
+    import urllib.error
+    import urllib.request
+
+    from torchmetrics_tpu.diag import diag_context, slo_context, transfer_guard
+    from torchmetrics_tpu.diag.hist import GROWTH, Histogram
+    from torchmetrics_tpu.engine.stats import _COUNTER_FIELDS, engine_report
+    from torchmetrics_tpu.parallel.faults import RankDrop, fault_context
+    from torchmetrics_tpu.serve import FleetTelemetry, MetricsSidecar, pack_telemetry
+
+    out = {}
+    rng = np.random.RandomState(19)
+    n_pods = 4
+    out["fleet_pods"] = n_pods
+
+    streams = {
+        f"pod{i}": rng.lognormal(mean=5.5 + 0.3 * i, sigma=0.6, size=2000).astype(
+            np.float64
+        )
+        for i in range(n_pods)
+    }
+
+    def snapshot(pid, seq):
+        hist = Histogram()
+        for v in streams[pid]:
+            hist.record(float(v))
+        counters = {f: 0 for f in _COUNTER_FIELDS}
+        counters["dispatches"] = 1000 + 100 * int(pid[-1])
+        return {
+            "counters": counters,
+            "reasons": {},
+            "sentinels": [],
+            "ledger_totals": {"peak_bytes_max": 1024.0 * (int(pid[-1]) + 1)},
+            "hists": {("collection", "sync", "sync_us"): hist},
+            "seq": seq,
+            "uptime_s": 60.0,
+        }
+
+    snapshots = {pid: snapshot(pid, 1) for pid in streams}
+    fleet = FleetTelemetry(
+        pods={pid: (lambda s=snap: pack_telemetry(s)) for pid, snap in snapshots.items()},
+        retries=0,
+        staleness_s=1800.0,
+    )
+
+    # -- pull -> merge -> export under the STRICT guard: 0 host transfers -----
+    with diag_context(capacity=4096) as rec, transfer_guard("strict"):
+        pulled = fleet.pull_round()
+        t0 = time.perf_counter()
+        merged = fleet.merge()
+        out["fleet_merge_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        exposition = fleet.export_prometheus()
+        out["fleet_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+        out["fleet_pull_events"] = rec.count("fleet.pull")
+        out["fleet_merge_events"] = rec.count("fleet.merge")
+    out["fleet_pull_ok"] = bool(all(pulled.values()))
+    out["fleet_counter_parity_ok"] = bool(
+        merged["counters"]["dispatches"]
+        == sum(s["counters"]["dispatches"] for s in snapshots.values())
+        and merged["ledger_totals"]["peak_bytes_max"] == 1024.0 * n_pods
+    )
+
+    # -- merged p99 within the paper's one-sided bound ------------------------
+    union = np.concatenate(list(streams.values()))
+    exact = float(np.quantile(union, 0.99, method="inverted_cdf"))
+    est = merged["histograms"]["sync_us"].quantile(0.99)
+    out["fleet_p99_exact_us"] = round(exact, 2)
+    out["fleet_p99_est_us"] = round(est, 2)
+    out["fleet_p99_rel_err"] = round(abs(est - exact) / exact, 4)
+    out["fleet_p99_within_bound"] = bool(
+        exact <= est * 1.0001 and est <= exact * GROWTH * 1.0001
+    )
+
+    # -- permutation-stable pod-labeled exposition ----------------------------
+    envelopes = {pid: pack_telemetry(snap) for pid, snap in snapshots.items()}
+
+    def strip_wallclock(text):
+        return "\n".join(
+            ln for ln in text.splitlines() if "fleet_pod_staleness_seconds" not in ln
+        )
+
+    def export_in_order(order):
+        f = FleetTelemetry(pods={pid: (lambda e=envelopes[pid]: e) for pid in order})
+        for pid in order:
+            data, headers = envelopes[pid]
+            f.ingest(pid, data, headers)
+        return strip_wallclock(f.export_prometheus())
+
+    orders = (list(snapshots), list(reversed(snapshots)), sorted(snapshots, key=hash))
+    texts = {export_in_order(o) for o in orders}
+    out["fleet_permutation_stable"] = bool(
+        len(texts) == 1 and texts.pop() == strip_wallclock(exposition)
+    )
+
+    # -- SLO breach -> /healthz 503 naming the SLO -> recovery ----------------
+    base = engine_report()
+    with slo_context(slow_s=60.0, fast_s=0.2), MetricsSidecar() as sc:
+        url = f"http://{sc.host}:{sc.port}/healthz"
+        with urllib.request.urlopen(url) as resp:  # baseline burn-rate sample
+            baseline_ready = resp.status == 200
+        # pod1 (canonical index 1) vanishes at the pull boundary: the degraded
+        # pull moves the BLOCKING fleet-degraded-pulls counter
+        with fault_context(RankDrop(1, label="fleet-pull*")):
+            for pid, snap in snapshots.items():
+                snap["seq"] = 2
+            churn = fleet.pull_round()
+        breach_named = False
+        try:
+            urllib.request.urlopen(url)
+        except urllib.error.HTTPError as err:
+            payload = json.loads(err.read())
+            breach_named = bool(
+                err.code == 503
+                and payload.get("reason") == "slo-breach"
+                and "fleet-degraded-pulls" in payload.get("slo", ())
+            )
+        out["fleet_degraded_breach_ok"] = bool(
+            baseline_ready
+            and churn == {"pod0": True, "pod1": False, "pod2": True, "pod3": True}
+            and breach_named
+        )
+        # clean rounds past the FAST burn window: readiness returns
+        for pid, snap in snapshots.items():
+            snap["seq"] = 3
+        rejoin = fleet.pull_round()
+        time.sleep(0.3)
+        with urllib.request.urlopen(url) as resp:
+            out["fleet_recovery_ok"] = bool(all(rejoin.values()) and resp.status == 200)
+    delta = engine_report()
+    out["fleet_degraded_pulls"] = int(
+        delta["fleet_degraded_pulls"] - base["fleet_degraded_pulls"]
+    )
+    out["slo_breaches"] = int(delta["slo_breaches"] - base["slo_breaches"])
+    out["slo_recoveries"] = int(delta["slo_recoveries"] - base["slo_recoveries"])
     return out
 
 
@@ -3854,6 +4012,12 @@ def main(argv=None):
             statuses["federation"] = f"error:{type(err).__name__}: {str(err)[:200]}"
 
         try:
+            extras["fleet"] = bench_fleet()
+            statuses["fleet"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["fleet"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        try:
             extras["scan"] = bench_scan(micro=not on_tpu or args.smoke)
             statuses["scan"] = "ok"
         except Exception as err:  # noqa: BLE001
@@ -3951,6 +4115,7 @@ def main(argv=None):
         statuses["numerics"] = "tpu_unavailable"
         statuses["serve"] = "tpu_unavailable"
         statuses["federation"] = "tpu_unavailable"
+        statuses["fleet"] = "tpu_unavailable"
         statuses["scan"] = "tpu_unavailable"
         statuses["async"] = "tpu_unavailable"
         statuses["cse"] = "tpu_unavailable"

@@ -97,6 +97,14 @@ _TRACKED = (
     ("federation", "kll_rank_err_p50", None),
     ("federation", "kll_rank_err_p99", None),
     ("federation", "federation_host_transfers", "max"),
+    # fleet observability plane (serve/fleet.py + diag/slo.py, PR 19): merge
+    # latency and the merged-p99 relative error are trajectory evidence
+    # (check_counters owns the bound/breach/recovery gates); host transfers
+    # in the envelope cycle and SLO breach counts must never creep.
+    ("fleet", "fleet_merge_ms", None),
+    ("fleet", "fleet_p99_rel_err", None),
+    ("fleet", "fleet_host_transfers", "max"),
+    ("fleet", "slo_breaches", None),
     # cross-metric CSE (engine/statespec.py + collections.py, PR 11): the
     # speedup and footprint fraction are trajectory evidence (check_counters
     # gates the exact counter envelope); traces/dispatches/transfers and the

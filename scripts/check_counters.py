@@ -397,6 +397,20 @@ _CHECKS = (
     ("coldstart", "coldstart_envelope_rejects", "abs", 0),  # same topology -> zero rejects
     ("coldstart", "coldstart_host_transfers", "abs", 0),  # both legs under STRICT
     ("coldstart", "values_match", "true", None),  # prewarm replay is value-inert
+    # fleet observability plane (PR 19): 4 emulated pods pulled + merged under
+    # STRICT, the merged p99 inside the paper's GROWTH bound, pod-labeled
+    # exposition byte-stable under ingest-order permutation, and the blocking
+    # fleet-degraded-pulls SLO proven to flip /healthz to 503 AND recover
+    ("fleet", "fleet_pull_ok", "true", None),  # every pod answered round 1
+    ("fleet", "fleet_counter_parity_ok", "true", None),  # sums sum, peaks max-fold
+    ("fleet", "fleet_p99_within_bound", "true", None),  # merged hist keeps the bound
+    ("fleet", "fleet_permutation_stable", "true", None),  # byte-stable exposition
+    ("fleet", "fleet_degraded_breach_ok", "true", None),  # 503 NAMES the breached SLO
+    ("fleet", "fleet_recovery_ok", "true", None),  # fast window clears -> 200
+    ("fleet", "fleet_host_transfers", "abs", 0),  # envelope cycle is host-pure
+    ("fleet", "fleet_degraded_pulls", "min", 1),  # the excluded pod was counted
+    ("fleet", "slo_breaches", "min", 1),  # the breach transition was counted
+    ("fleet", "slo_recoveries", "min", 1),  # ...and the recovery transition
 )
 
 
@@ -437,7 +451,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "federation", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "federation", "fleet", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

@@ -1,0 +1,496 @@
+"""Fleet observability plane tests (serve/fleet.py + diag/slo.py + sidecar):
+telemetry envelope integrity (CRC/version tamper rejection), type-aware fleet
+merge semantics, the merged-histogram quantile bound surviving federation,
+permutation-stable pod-labeled exposition (with hostile pod ids through the
+escaping round-trip), the declarative SLO engine's burn-rate breach/recover
+loop per-pod AND fleet-wide, and the SLO-aware sidecar readiness endpoints
+(``/healthz`` 503 naming the breached SLO, warm-start failure regression,
+``/telemetry.bin``, ``/fleet/metrics``, ``/fleet/slo``).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.diag import diag_context, slo_context
+from torchmetrics_tpu.diag.hist import (
+    BOUNDS,
+    GROWTH,
+    Histogram,
+    hist_from_arrays,
+    hist_to_arrays,
+)
+from torchmetrics_tpu.diag.slo import SLO_REGISTRY, SLOEngine, SLOSpec
+from torchmetrics_tpu.engine import reset_engine_stats
+from torchmetrics_tpu.engine.stats import _COUNTER_FIELDS, EngineStats
+from torchmetrics_tpu.parallel.elastic import SnapshotIntegrityError, SnapshotVersionError
+from torchmetrics_tpu.parallel.faults import RankDrop, fault_context
+from torchmetrics_tpu.serve import (
+    FleetTelemetry,
+    MetricsSidecar,
+    pack_telemetry,
+    parse_telemetry,
+)
+from torchmetrics_tpu.serve.federation import VERSION_HEADER
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+from tests.test_telemetry import parse_exposition, unescape_label_value
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    reset_engine_stats()
+    yield
+    reset_engine_stats()
+
+
+def _pod_snapshot(seq, sync_vals=(), counters=None, reasons=None, sentinels=(), ledger=None):
+    """A synthetic pod telemetry dict of the `local_telemetry` shape."""
+    hist = Histogram()
+    for v in sync_vals:
+        hist.record(float(v))
+    row = {f: 0 for f in _COUNTER_FIELDS}
+    row.update(counters or {})
+    base_reasons = {"fallback_reasons": {}, "retrace_causes": {}, "scan_flush_reasons": {}}
+    base_reasons.update(reasons or {})
+    return {
+        "counters": row,
+        "reasons": base_reasons,
+        "sentinels": list(sentinels),
+        "ledger_totals": dict(ledger or {}),
+        "hists": {("collection", "sync", "sync_us"): hist} if len(sync_vals) else {},
+        "seq": int(seq),
+        "uptime_s": 12.5,
+    }
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+# ------------------------------------------------------------------ envelope
+
+
+def test_hist_arrays_round_trip_including_overflow():
+    h = Histogram()
+    for v in (0.1, 3.0, 700.0, 2.0**40):  # below range, in range, overflow
+        h.record(v)
+    back = hist_from_arrays(*hist_to_arrays(h))
+    assert back.counts == h.counts and back.total == h.total
+    assert back.sum == h.sum and back.min == h.min and back.max == h.max
+    # empty histogram: min/max are None and must survive the NaN wire form
+    empty = hist_from_arrays(*hist_to_arrays(Histogram()))
+    assert empty.total == 0 and empty.min is None and empty.max is None
+
+
+def test_telemetry_envelope_round_trip():
+    snap = _pod_snapshot(
+        seq=7,
+        sync_vals=(100.0, 250.0, 900.0),
+        counters={"dispatches": 40, "eager_fallbacks": 2},
+        reasons={"fallback_reasons": {"nan_strategy": 2}},
+        sentinels=({"owner": "acc", "flags": 5},),
+        ledger={"executables": 3.0, "peak_bytes_max": 1024.0},
+    )
+    data, headers = pack_telemetry(snap)
+    tel = parse_telemetry(data, headers)
+    assert tel.seq == 7 and tel.uptime_s == 12.5
+    assert tel.counters["dispatches"] == 40 and tel.counters["eager_fallbacks"] == 2
+    assert tel.reasons["fallback_reasons"] == {"nan_strategy": 2}
+    assert tel.sentinels == [{"owner": "acc", "flags": 5}]
+    assert tel.ledger_totals == {"executables": 3.0, "peak_bytes_max": 1024.0}
+    hist = tel.hists[("collection", "sync", "sync_us")]
+    src = snap["hists"][("collection", "sync", "sync_us")]
+    assert hist.counts == src.counts and hist.total == 3
+    assert hist.min == 100.0 and hist.max == 900.0
+
+
+def test_telemetry_envelope_corruption_and_version_rejected():
+    data, headers = pack_telemetry(_pod_snapshot(seq=1, sync_vals=(50.0,)))
+    flipped = bytearray(data)
+    flipped[len(flipped) // 2] ^= 0x40
+    with pytest.raises(SnapshotIntegrityError, match="integrity|unreadable"):
+        parse_telemetry(bytes(flipped), headers)
+    # header layout-version mismatch is a typed refusal BEFORE parsing
+    bad = dict(headers)
+    bad[VERSION_HEADER] = "99"
+    with pytest.raises(SnapshotVersionError, match="refusing to guess"):
+        parse_telemetry(data, bad)
+    with pytest.raises(SnapshotIntegrityError, match="not a fleet envelope"):
+        parse_telemetry(_random_npz(), None)
+
+
+def _random_npz():
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, junk=np.arange(4))
+    return buf.getvalue()
+
+
+# ------------------------------------------------------------------ merge
+
+
+def _fleet_of(snapshots, **kw):
+    """A FleetTelemetry over callable emulated pods, all pre-ingested."""
+    pods = {pid: (lambda s=s: pack_telemetry(s)) for pid, s in snapshots.items()}
+    fleet = FleetTelemetry(pods=pods, retries=0, **kw)
+    assert all(fleet.pull_round().values())
+    return fleet
+
+
+def test_fleet_merge_type_aware_semantics():
+    fleet = _fleet_of({
+        "p0": _pod_snapshot(
+            1, counters={"dispatches": 10, "sync_degraded_folds": 1},
+            reasons={"fallback_reasons": {"nan_strategy": 2}},
+            sentinels=({"owner": "acc", "flags": 0b001},),
+            ledger={"executables": 2.0, "peak_bytes_max": 100.0},
+        ),
+        "p1": _pod_snapshot(
+            5, counters={"dispatches": 30},
+            reasons={"fallback_reasons": {"nan_strategy": 1, "dtype": 4}},
+            sentinels=({"owner": "acc", "flags": 0b100}, {"owner": "pre", "flags": 0}),
+            ledger={"executables": 3.0, "peak_bytes_max": 700.0},
+        ),
+    })
+    merged = fleet.merge()
+    assert merged["members"] == ["p0", "p1"] and merged["degraded"] == []
+    # counters sum
+    assert merged["counters"]["dispatches"] == 40
+    assert merged["counters"]["sync_degraded_folds"] == 1
+    # reason maps merge key-wise by sum
+    assert merged["reasons"]["fallback_reasons"] == {"dtype": 4, "nan_strategy": 3}
+    # sentinel bitmasks OR per owner
+    assert merged["sentinels"] == {"acc": 0b101, "pre": 0}
+    # ledger totals sum, EXCEPT peaks which fold by max
+    assert merged["ledger_totals"]["executables"] == 5.0
+    assert merged["ledger_totals"]["peak_bytes_max"] == 700.0
+    # per-pod gauges: seq lag measured against the most-advanced member
+    assert merged["pods"]["p0"]["seq_lag"] == 4 and merged["pods"]["p1"]["seq_lag"] == 0
+    assert fleet.stats.fleet_merges == 1 and fleet.stats.fleet_pulls == 2
+
+
+def test_fleet_merged_p99_within_growth_bound():
+    """The paper's bound survives federation: the merged histogram IS the
+    union-stream histogram, so fleet quantiles keep the <= 18.92% one-sided
+    error (GROWTH = 2**0.25) against the exact pooled stream."""
+    rng = np.random.default_rng(19)
+    streams = {
+        f"pod{i}": rng.lognormal(mean=5.5 + 0.3 * i, sigma=0.6, size=1500)
+        for i in range(4)
+    }
+    fleet = _fleet_of({
+        pid: _pod_snapshot(1, sync_vals=vals) for pid, vals in streams.items()
+    })
+    merged = fleet.merge()["histograms"]["sync_us"]
+    union = np.concatenate(list(streams.values()))
+    assert merged.total == len(union)
+    for q in (0.5, 0.9, 0.99):
+        exact = float(np.quantile(union, q, method="inverted_cdf"))
+        est = merged.quantile(q)
+        assert exact <= est * 1.0001
+        assert est <= exact * GROWTH * 1.0001
+
+
+def test_fleet_watermark_dedupe_and_degraded_pull():
+    snapshots = {
+        "p0": _pod_snapshot(3, counters={"dispatches": 1}),
+        "p1": _pod_snapshot(3, counters={"dispatches": 2}),
+    }
+    with diag_context(capacity=256) as rec:
+        fleet = _fleet_of(snapshots)
+        # replaying the same seq is deduped at the watermark, not re-merged
+        data, headers = pack_telemetry(snapshots["p0"])
+        assert fleet.ingest("p0", data, headers) is False
+        assert rec.count("fleet.stale") == 1
+        # p1 (canonical index 1) vanishes at the pull boundary: excluded,
+        # counted, evented — the round answers instead of raising
+        with fault_context(RankDrop(1, label="fleet-pull*")):
+            snapshots["p0"]["seq"] = 4
+            res = fleet.pull_round()
+        assert res == {"p0": True, "p1": False}
+        assert fleet.stats.fleet_degraded_pulls == 1
+        assert rec.count("fleet.degraded") >= 1
+        # p1's last VERIFIED telemetry still merges (no staleness bound set)
+        merged = fleet.merge()
+        assert merged["counters"]["dispatches"] == 3
+        # backdated past a staleness bound, p1 is excluded as degraded
+        fleet.staleness_s = 60.0
+        fleet._slots["p1"].ts -= 120.0
+        merged = fleet.merge()
+        assert merged["members"] == ["p0"] and merged["degraded"] == ["p1"]
+        assert merged["pods"]["p1"] == {"up": 0, "reason": "stale"}
+        assert fleet.fleet_state() == {"pods": 1, "degraded_pods": 1}
+
+
+def test_fleet_requires_membership():
+    with pytest.raises(TorchMetricsUserError, match="at least one pod"):
+        FleetTelemetry()
+
+
+def test_fleet_merge_with_nothing_verified_raises():
+    fleet = FleetTelemetry(pods={"p0": lambda: (_ for _ in ()).throw(RuntimeError)})
+    with pytest.raises(TorchMetricsUserError, match="no verified pod telemetry"):
+        fleet.merge()
+
+
+def test_fleet_reuses_federation_membership():
+    class _Agg:
+        pods = {"p0": "http://h0:9/state", "p1": "http://h1:9/state"}
+
+    fleet = FleetTelemetry(aggregator=_Agg())
+    assert fleet.pods == {
+        "p0": "http://h0:9/telemetry.bin",
+        "p1": "http://h1:9/telemetry.bin",
+    }
+
+
+# ------------------------------------------------------------------ exposition
+
+
+def _stable_lines(text):
+    """Exposition minus the one wall-clock family (pod telemetry age)."""
+    return "\n".join(
+        line for line in text.splitlines() if "fleet_pod_staleness_seconds" not in line
+    )
+
+
+def test_fleet_exposition_permutation_stable_and_parseable():
+    snapshots = {
+        "a-pod": _pod_snapshot(1, sync_vals=(100.0, 400.0), counters={"dispatches": 5}),
+        "z-pod": _pod_snapshot(2, sync_vals=(900.0,), counters={"dispatches": 9}),
+        "m-pod": _pod_snapshot(3, counters={"dispatches": 2, "quarantined_batches": 1}),
+    }
+    orders = (("a-pod", "z-pod", "m-pod"), ("m-pod", "a-pod", "z-pod"), ("z-pod", "m-pod", "a-pod"))
+    texts = []
+    for order in orders:
+        fleet = FleetTelemetry(
+            pods={pid: (lambda s=snapshots[pid]: pack_telemetry(s)) for pid in order},
+            retries=0,
+        )
+        for pid in order:  # ingest order = permutation under test
+            data, headers = pack_telemetry(snapshots[pid])
+            assert fleet.ingest(pid, data, headers)
+        texts.append(fleet.export_prometheus())
+    assert _stable_lines(texts[0]) == _stable_lines(texts[1]) == _stable_lines(texts[2])
+    # the full exposition (unit suffixes, label escaping, TYPE headers) passes
+    # the hardened conformance parser
+    samples, types = parse_exposition(texts[0])
+    assert samples[("tm_tpu_fleet_pods", ())] == 3
+    assert samples[("tm_tpu_dispatches_total", ('pod="a-pod"',))] == 5
+    assert samples[("tm_tpu_fleet_dispatches_total", ())] == 16
+    assert types["tm_tpu_fleet_sync_latency_seconds"] == "histogram"
+    count_key = ("tm_tpu_fleet_sync_latency_seconds_count", ())
+    assert samples[count_key] == 3  # merged across pods
+
+
+def test_fleet_exposition_escapes_hostile_pod_ids():
+    hostile = 'us-"west"\\1\n'
+    fleet = _fleet_of({hostile: _pod_snapshot(1, counters={"dispatches": 4})})
+    text = fleet.export_prometheus()
+    samples, _ = parse_exposition(text)  # hardened parser: rejects raw quotes
+    up = {
+        labels: v for (name, labels), v in samples.items() if name == "tm_tpu_fleet_pod_up"
+    }
+    (labels,) = up
+    (label,) = labels
+    assert unescape_label_value(label[len('pod="'):-1]) == hostile
+    assert up[labels] == 1
+
+
+# ------------------------------------------------------------------ SLO engine
+
+
+def test_slo_registry_specs_validate():
+    specs = {s.id: s for s in (SLOSpec.from_registry(k, v) for k, v in SLO_REGISTRY.items())}
+    assert specs["sync-latency-p99"].kind == "quantile" and specs["sync-latency-p99"].q == 0.99
+    assert specs["sync-degraded-folds"].blocking and specs["fleet-degraded-pulls"].blocking
+    assert specs["quarantine-ratio"].denominator == "dispatches"
+    with pytest.raises(TorchMetricsUserError, match="unknown kind"):
+        SLOSpec.from_registry("x", {"signal": "s", "kind": "median", "threshold": 1.0})
+    with pytest.raises(TorchMetricsUserError, match="needs 0 < q"):
+        SLOSpec.from_registry("x", {"signal": "s", "kind": "quantile", "threshold": 1.0})
+    with pytest.raises(TorchMetricsUserError, match="denominator"):
+        SLOSpec.from_registry("x", {"signal": "s", "kind": "ratio", "threshold": 1.0})
+
+
+def _inputs(counters=None, hist=None):
+    row = {f: 0 for f in _COUNTER_FIELDS}
+    row.update(counters or {})
+    return {"counters": row, "series": lambda name: hist or Histogram()}
+
+
+def test_slo_rate_breach_and_fast_window_recovery():
+    """Breach needs BOTH burn windows; recovery follows the FAST one."""
+    engine = SLOEngine("slo-test")
+    with diag_context(capacity=128) as rec, slo_context(slow_s=100.0, fast_s=10.0):
+        engine.evaluate(_inputs(), now=0.0)  # baseline: nothing moved
+        rows = engine.evaluate(_inputs({"sync_degraded_folds": 1}), now=1.0)
+        row = next(r for r in rows if r["id"] == "sync-degraded-folds")
+        assert row["breaching"] and row["fast_violates"] and row["slow_violates"]
+        assert engine.blocking_breaches() == ["sync-degraded-folds"]
+        assert engine.stats.slo_breaches == 1
+        assert rec.count("slo.breach") == 1
+        # counter stays flat past the fast window -> recovery, even though the
+        # slow window still contains the violation
+        rows = engine.evaluate(_inputs({"sync_degraded_folds": 1}), now=15.0)
+        row = next(r for r in rows if r["id"] == "sync-degraded-folds")
+        assert not row["breaching"] and row["slow_violates"]
+        assert engine.blocking_breaches() == []
+        assert engine.stats.slo_recoveries == 1
+        assert rec.count("slo.recover") == 1
+
+
+def test_slo_quantile_window_delta_measurement():
+    engine = SLOEngine("slo-q")
+    slow_hist = Histogram()
+    for v in (100.0,) * 99:  # healthy tail
+        slow_hist.record(v)
+    with slo_context(slow_s=100.0, fast_s=10.0):
+        engine.evaluate(_inputs(hist=_copy_hist(slow_hist)), now=0.0)
+        for _ in range(400):  # the p99 of the WINDOW DELTA crosses 5000 us
+            slow_hist.record(50_000.0)
+        rows = engine.evaluate(_inputs(hist=_copy_hist(slow_hist)), now=1.0)
+        row = next(r for r in rows if r["id"] == "sync-latency-p99")
+        assert row["breaching"] and row["measured"] > 5000.0
+        # non-blocking: the alerting surface moves, readiness does not
+        assert "sync-latency-p99" not in engine.blocking_breaches()
+
+
+def _copy_hist(h):
+    out = Histogram()
+    out.counts = list(h.counts)
+    out.total, out.sum, out.min, out.max = h.total, h.sum, h.min, h.max
+    return out
+
+
+def test_slo_ratio_idle_window_is_compliant():
+    engine = SLOEngine("slo-r")
+    with slo_context(slow_s=100.0, fast_s=10.0):
+        engine.evaluate(_inputs(), now=0.0)
+        # zero denominator delta: idle, compliant — NOT a division error
+        rows = engine.evaluate(_inputs({"quarantined_batches": 3}), now=1.0)
+        row = next(r for r in rows if r["id"] == "quarantine-ratio")
+        assert row["measured"] is None and not row["breaching"]
+        # window delta: 6 quarantines / 1000 dispatches = 6e-3 > 1e-3: breach
+        rows = engine.evaluate(
+            _inputs({"quarantined_batches": 6, "dispatches": 1000}), now=2.0
+        )
+        row = next(r for r in rows if r["id"] == "quarantine-ratio")
+        assert row["breaching"] and row["measured"] == pytest.approx(6e-3)
+
+
+def test_fleet_slo_breach_and_recovery_over_merged_inputs():
+    """The fleet engine judges the MERGED surface: a degraded pull flips the
+    blocking fleet-degraded-pulls SLO, and a clean round recovers it."""
+    snapshots = {
+        "p0": _pod_snapshot(1, counters={"dispatches": 1}),
+        "p1": _pod_snapshot(1, counters={"dispatches": 1}),
+    }
+    with slo_context(slow_s=100.0, fast_s=10.0):
+        fleet = _fleet_of(snapshots)
+        fleet.evaluate_slos(now=0.0)  # baseline BEFORE the fault
+        with fault_context(RankDrop(1, label="fleet-pull*")):
+            snapshots["p0"]["seq"] = 2
+            res = fleet.pull_round()
+        assert res == {"p0": True, "p1": False}
+        rows = fleet.evaluate_slos(now=1.0)
+        row = next(r for r in rows if r["id"] == "fleet-degraded-pulls")
+        assert row["breaching"] and row["blocking"]
+        assert fleet.slo.blocking_breaches() == ["fleet-degraded-pulls"]
+        # clean rounds past the fast window: the fleet recovers
+        snapshots["p0"]["seq"], snapshots["p1"]["seq"] = 3, 3
+        assert all(fleet.pull_round().values())
+        rows = fleet.evaluate_slos(now=15.0)
+        row = next(r for r in rows if r["id"] == "fleet-degraded-pulls")
+        assert not row["breaching"]
+        assert fleet.slo.blocking_breaches() == []
+
+
+# ------------------------------------------------------------------ sidecar
+
+
+def test_healthz_slo_gate_breach_names_slo_then_recovers():
+    with slo_context(slow_s=30.0, fast_s=0.05), MetricsSidecar() as sc:
+        base = f"http://{sc.host}:{sc.port}"
+        status, body, _ = _get(f"{base}/healthz")
+        assert status == 200 and body == b"ok\n"
+        # plant a blocking violation: a degraded packed sync moved the counter
+        planted = EngineStats("planted-degradation")
+        planted.sync_degraded_folds = 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/healthz")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read())
+        assert payload["reason"] == "slo-breach"
+        assert payload["slo"] == ["sync-degraded-folds"]
+        # /slo reports the same rows a scraper would alert on
+        status, body, _ = _get(f"{base}/slo")
+        rows = {r["id"]: r for r in json.loads(body)}
+        assert rows["sync-degraded-folds"]["breaching"]
+        # the counter stays flat past the FAST window: readiness returns
+        time.sleep(0.1)
+        status, body, _ = _get(f"{base}/healthz")
+        assert status == 200 and body == b"ok\n"
+        del planted
+
+
+def test_healthz_warm_start_failure_flips_readiness():
+    """Satellite regression: a failed warm-start replay must flip /healthz to
+    not-ready — a pod that is up but cold cannot advertise readiness."""
+    with MetricsSidecar() as sc:
+        base = f"http://{sc.host}:{sc.port}"
+        status, body, _ = _get(f"{base}/healthz")
+        assert status == 200
+        sc._server.tm_warm_report = {"failed": 2, "replayed": 3}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/healthz")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read())
+        assert payload == {
+            "status": "unready", "reason": "warm-start-failed", "failed": 2, "replayed": 3,
+        }
+        # recovery path: a clean report restores readiness
+        sc._server.tm_warm_report = {"failed": 0, "replayed": 5}
+        status, _, _ = _get(f"{base}/healthz")
+        assert status == 200
+
+
+def test_sidecar_serves_telemetry_bin_envelope():
+    with MetricsSidecar() as sc:
+        status, data, headers = _get(f"http://{sc.host}:{sc.port}/telemetry.bin")
+    assert status == 200
+    assert headers["Content-Type"] == "application/octet-stream"
+    tel = parse_telemetry(data, headers)
+    assert set(tel.counters) == set(_COUNTER_FIELDS)
+    assert tel.seq == sum(tel.counters.values())
+
+
+def test_sidecar_fleet_endpoints_and_typed_refusal():
+    fleet = _fleet_of({
+        "p0": _pod_snapshot(1, sync_vals=(150.0,), counters={"dispatches": 3}),
+        "p1": _pod_snapshot(1, counters={"dispatches": 4}),
+    })
+    with slo_context(slow_s=100.0, fast_s=10.0), MetricsSidecar(fleet_target=fleet) as sc:
+        base = f"http://{sc.host}:{sc.port}"
+        status, body, headers = _get(f"{base}/fleet/metrics")
+        assert status == 200 and headers["Content-Type"].startswith("text/plain")
+        samples, _ = parse_exposition(body.decode())
+        assert samples[("tm_tpu_fleet_pods", ())] == 2
+        assert samples[("tm_tpu_fleet_dispatches_total", ())] == 7
+        status, body, _ = _get(f"{base}/fleet/slo")
+        rows = {r["id"]: r for r in json.loads(body)}
+        assert set(rows) == set(SLO_REGISTRY)
+        assert not any(r["breaching"] for r in rows.values())
+    # no attached aggregator: typed 503, never an empty healthy-looking fleet
+    with MetricsSidecar() as sc:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://{sc.host}:{sc.port}/fleet/metrics")
+        assert err.value.code == 503
+        assert json.loads(err.value.read()) == {"reason": "no-fleet-target"}

@@ -314,6 +314,55 @@ def test_merge_timelines_accepts_export_json_shape():
     assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
 
 
+def _fleet_streams():
+    """2 pods × 2 ranks with per-(pod, rank) clock offsets (PR-19 fleet shape)."""
+    streams = []
+    for pod, rank, offset in (
+        ("us-west", 0, 0.0), ("us-west", 1, 250.0),
+        ("eu-hub", 0, 125.0), ("eu-hub", 1, 375.0),
+    ):
+        with diag_context() as rec:
+            m = FloatSum(compiled_update=True)
+            with engine_context(True):
+                for _ in range(2):
+                    m.update(jnp.ones((2,)))
+        streams.append({
+            "pod": pod, "rank": rank,
+            "events": rec.snapshot(), "clock_offset_us": offset,
+        })
+    return streams
+
+
+def test_merge_timelines_fleet_pod_tracks(tmp_path):
+    streams = _fleet_streams()
+    trace = merge_timelines(streams, path=str(tmp_path / "fleet.json"))
+    events = trace["traceEvents"]
+    names = {e["pid"]: e["args"]["name"] for e in events if e.get("name") == "process_name"}
+    # dense pids in canonical (pod, rank) order — two pods' rank 0 never collide
+    assert names == {
+        0: "pod eu-hub · rank 0", 1: "pod eu-hub · rank 1",
+        2: "pod us-west · rank 0", 3: "pod us-west · rank 1",
+    }
+    # per-stream clocks stay monotone after per-pod offset correction
+    for pid in names:
+        ends = [
+            e["ts"] + e.get("dur", 0.0)
+            for e in events
+            if e.get("pid") == pid and e.get("ph") in ("X", "i")
+        ]
+        assert ends and ends == sorted(ends)
+
+
+def test_merge_timelines_fleet_permutation_stable():
+    """The canonical (pod, rank) sort — not arrival order — fixes every pid:
+    any permutation of the fleet's streams serializes byte-identically."""
+    streams = _fleet_streams()
+    baseline = json.dumps(merge_timelines(streams), sort_keys=True)
+    for order in ((3, 1, 0, 2), (2, 3, 0, 1), (1, 0, 3, 2)):
+        permuted = [streams[i] for i in order]
+        assert json.dumps(merge_timelines(permuted), sort_keys=True) == baseline
+
+
 # ------------------------------------------------------------------ exposition
 
 

@@ -5,6 +5,7 @@ byte-stability + tooling satellites."""
 
 import json
 import os
+import re
 
 import jax
 import jax.numpy as jnp
@@ -111,6 +112,42 @@ def _base_of(family):
     return family[: -len("_total")] if family.endswith("_total") else family
 
 
+#: one well-formed label: name + quoted value where backslash, quote, and
+#: newline only appear as their escape sequences (the exporter's `_escape`)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+
+
+def _parse_labels(body):
+    """Strict label-body tokenizer → sorted tuple of ``key="value"`` strings.
+
+    Unlike a naive comma split, this REJECTS unescaped backslashes/quotes in
+    label values (a malformed scrape, not a parse detail to gloss over) and
+    correctly keeps commas inside quoted values within one label.
+    """
+    if not body:
+        return ()
+    out = []
+    pos = 0
+    while True:
+        match = _LABEL_RE.match(body, pos)
+        assert match is not None, (
+            f"malformed label body at {body[pos:]!r} — unescaped quote/backslash"
+            " in a label value?"
+        )
+        out.append(f'{match.group(1)}="{match.group(2)}"')
+        pos = match.end()
+        if pos == len(body):
+            break
+        assert body[pos] == ",", f"garbage between labels: {body[pos:]!r}"
+        pos += 1
+    return tuple(sorted(out))
+
+
+def unescape_label_value(raw):
+    """Invert the exporter's `_escape` (valid escapes only — parser-verified)."""
+    return raw.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
 def parse_exposition(text):
     """Minimal Prometheus text-exposition parser: {(name, labels): value}.
 
@@ -138,7 +175,7 @@ def parse_exposition(text):
             f"series {name!r} lacks a unit suffix ({UNIT_SUFFIXES}) and is not a"
             " recognised count/enum family — name new series with their unit"
         )
-        labels = tuple(sorted((match.group("labels") or "").split(","))) if match.group("labels") else ()
+        labels = _parse_labels(match.group("labels") or "")
         samples[(name, labels)] = float(match.group("value"))
     return samples, types
 
@@ -179,6 +216,32 @@ def test_prometheus_rejects_unitless_new_series():
     # unit-suffixed spellings of the same series pass
     parse_exposition("tm_tpu_new_fancy_latency_seconds 1.0\n")
     parse_exposition("tm_tpu_new_fancy_size_bytes_total 2\n")
+
+
+def test_parser_rejects_unescaped_label_values():
+    """The hardened tokenizer refuses label values whose quotes/backslashes
+    escaped the exporter's `_escape` path — a malformed scrape fails loud."""
+    with pytest.raises(AssertionError, match="malformed label|garbage between"):
+        parse_exposition('tm_tpu_dispatches_total{pod="a"b"} 1\n')
+    with pytest.raises(AssertionError, match="malformed label"):
+        parse_exposition('tm_tpu_dispatches_total{pod="a\\x"} 1\n')  # bad escape
+    # commas INSIDE a quoted value stay within one label (no naive split)
+    samples, _ = parse_exposition('tm_tpu_dispatches_total{pod="a,b",rank="0"} 1\n')
+    assert ("tm_tpu_dispatches_total", ('pod="a,b"', 'rank="0"')) in samples
+
+
+def test_hostile_label_values_roundtrip_through_escaping():
+    """exporter `_sample` escaping → hardened parser → unescape == original."""
+    from torchmetrics_tpu.diag.telemetry import _sample
+
+    hostile = 'pod-"7"\\us-east\n2'
+    line = _sample("tm_tpu_dispatches_total", {"pod": hostile}, 3)
+    samples, _ = parse_exposition(line + "\n")
+    ((_, labels),) = samples.keys()
+    (label,) = labels
+    raw = label[len('pod="'):-1]
+    assert unescape_label_value(raw) == hostile
+    assert samples[("tm_tpu_dispatches_total", labels)] == 3.0
 
 
 def test_prometheus_deterministic_and_writes_file(tmp_path):

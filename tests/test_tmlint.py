@@ -453,6 +453,100 @@ class TestEventRules:
         assert result["new"] == []
 
 
+# ------------------------------------------------------------- SLO registry
+
+
+class TestSLORules:
+    def _mini_project(self, tmp_path, registry=None, doc_tokens=("demo-latency",)):
+        registry = registry if registry is not None else textwrap.dedent(
+            """
+            SLO_REGISTRY = {
+                "demo-latency": {
+                    "signal": "sync_us",
+                    "kind": "quantile",
+                    "q": 0.99,
+                    "threshold": 5000.0,
+                    "blocking": False,
+                },
+            }
+            """
+        )
+        root = tmp_path / "proj"
+        (root / "torchmetrics_tpu" / "diag").mkdir(parents=True)
+        (root / "torchmetrics_tpu" / "engine").mkdir(parents=True)
+        (root / "docs" / "pages").mkdir(parents=True)
+        (root / "torchmetrics_tpu" / "diag" / "slo.py").write_text(registry)
+        (root / "torchmetrics_tpu" / "engine" / "stats.py").write_text(
+            '_COUNTER_FIELDS = ("dispatches", "quarantined_batches")\n'
+        )
+        (root / "torchmetrics_tpu" / "diag" / "telemetry.py").write_text(
+            textwrap.dedent(
+                """
+                _PREFIX = "tm_tpu"
+                _COUNTER_HELP = {}
+                _COUNTER_EXPORT_NAME = {}
+                _COUNTER_EXPORT_SCALE = {}
+                _HIST_SERIES = {"sync_us": ("sync_latency_seconds", 1e-6, "s")}
+                UNIT_SUFFIXES = ("_seconds", "_bytes")
+                UNITLESS_COUNT_FAMILIES = frozenset()
+                """
+            )
+        )
+        (root / "docs" / "pages" / "observability.md").write_text(
+            "\n".join(f"objective `slo:{tok}` documented here" for tok in doc_tokens) + "\n"
+        )
+        return root
+
+    def test_clean_mini_project(self, tmp_path):
+        root = self._mini_project(tmp_path)
+        result = run_lint([root / "torchmetrics_tpu"], root=root, rules={"TM801", "TM802", "TM803"})
+        assert result["new"] == []
+
+    def test_undocumented_slo_flagged(self, tmp_path):
+        root = self._mini_project(tmp_path, doc_tokens=())
+        result = run_lint([root / "torchmetrics_tpu"], root=root, rules={"TM801"})
+        assert rules_of(result["new"]) == ["TM801"]
+        assert "demo-latency" in result["new"][0].message
+
+    def test_stale_doc_token_flagged(self, tmp_path):
+        root = self._mini_project(tmp_path, doc_tokens=("demo-latency", "ghost-objective"))
+        result = run_lint([root / "torchmetrics_tpu"], root=root, rules={"TM802"})
+        assert rules_of(result["new"]) == ["TM802"]
+        assert "ghost-objective" in result["new"][0].message
+
+    def test_ghost_signal_flagged(self, tmp_path):
+        registry = textwrap.dedent(
+            """
+            SLO_REGISTRY = {
+                "demo-latency": {
+                    "signal": "no_such_series",
+                    "kind": "quantile",
+                    "q": 0.99,
+                    "threshold": 1.0,
+                    "blocking": False,
+                },
+                "demo-ratio": {
+                    "signal": "quarantined_batches",
+                    "kind": "ratio",
+                    "denominator": "no_such_counter",
+                    "threshold": 0.001,
+                    "blocking": False,
+                },
+            }
+            """
+        )
+        root = self._mini_project(tmp_path, registry=registry, doc_tokens=("demo-latency", "demo-ratio"))
+        result = run_lint([root / "torchmetrics_tpu"], root=root, rules={"TM803"})
+        assert rules_of(result["new"]) == ["TM803"]
+        messages = " ".join(f.message for f in result["new"])
+        assert "no_such_series" in messages and "no_such_counter" in messages
+        assert len(result["new"]) == 2
+
+    def test_in_tree_slo_registry_clean(self):
+        result = run_lint([PACKAGE], root=REPO_ROOT, rules={"TM801", "TM802", "TM803"})
+        assert result["new"] == []
+
+
 # ------------------------------------------------------------- lock discipline
 
 

@@ -12,6 +12,7 @@ TM301    rider-key lockstep — one spelling site for reserved pytree keys
 TM4xx    counter lockstep — EngineStats ↔ telemetry ↔ unit conventions
 TM5xx    event taxonomy — declared, documented, recorded
 TM6xx    lock discipline — guarded-by annotations on cross-thread state
+TM8xx    SLO registry — documented ids bound to real signals
 =======  ==============================================================
 
 Run ``python -m tools.tmlint torchmetrics_tpu/`` from the repo root (see
@@ -41,6 +42,9 @@ RULES = {
     "TM601": "guarded-by attribute accessed outside its lock",
     "TM602": "lock created with no guarded-by declarations",
     "TM603": "guarded-by/holds names a lock that does not exist",
+    "TM801": "registered SLO id undocumented in observability.md",
+    "TM802": "documented slo:<id> token missing from SLO_REGISTRY",
+    "TM803": "SLO spec bound to a nonexistent signal or denominator",
 }
 
 __all__ = ["Finding", "Project", "RULES", "SourceFile", "run_lint"]

@@ -353,6 +353,7 @@ def run_lint(
         rules_locks,
         rules_persist,
         rules_riders,
+        rules_slo,
         rules_transfer,
     )
 
@@ -360,7 +361,7 @@ def run_lint(
     project = Project(root, paths)
     families = (
         rules_transfer, rules_knobs, rules_riders, rules_counters, rules_events,
-        rules_locks, rules_persist,
+        rules_locks, rules_persist, rules_slo,
     )
 
     findings: List[Finding] = []

@@ -10,6 +10,7 @@ Each accessor parses the module that CANONICALLY declares a registry:
 ``RIDER_KEYS``               ``torchmetrics_tpu/engine/statespec.py``
 ``_COUNTER_FIELDS``          ``torchmetrics_tpu/engine/stats.py``
 counter/histogram export tables + unit rule  ``torchmetrics_tpu/diag/telemetry.py``
+``SLO_REGISTRY``             ``torchmetrics_tpu/diag/slo.py``
 ===========================  =================================================
 
 The mini-evaluator below resolves module-level assignments whose value is a
@@ -156,6 +157,13 @@ def telemetry_tables(project: Project) -> Dict[str, Any]:
         }
 
     return project.registry("telemetry_tables", load)
+
+
+def slo_registry(project: Project) -> Dict[str, Any]:
+    def load(p: Project):
+        return dict(_constants_of(p, "torchmetrics_tpu/diag/slo.py").get("SLO_REGISTRY", {}))
+
+    return project.registry("slo_registry", load)
 
 
 def docs_text(project: Project, rel: str) -> Optional[str]:

@@ -1,0 +1,98 @@
+"""TM8xx — the SLO-registry contract: every declared objective is documented
+and bound to a signal that actually exists.
+
+``SLO_REGISTRY`` (``diag/slo.py``) follows the KNOB_REGISTRY three-touch
+convention: an objective is *declared* in the registry, *bound* to a real
+histogram series or counter field, and *documented* as a backticked
+``slo:<id>`` token in ``docs/pages/observability.md``. Drift in any direction
+makes the readiness surface lie:
+
+- **TM801 slo-undocumented** — a registered SLO id with no ``slo:<id>`` token
+  in the observability page. An operator paged by a 503 naming that SLO has
+  no prose to read.
+- **TM802 slo-unimplemented** — a ``slo:<id>`` doc token with no registry
+  entry (documented but gone — or renamed without updating the page).
+- **TM803 slo-ghost-signal** — a spec bound to a signal that does not exist:
+  a ``quantile`` spec whose ``signal`` is not a ``_HIST_SERIES`` key, a
+  ``rate``/``ratio`` spec whose ``signal`` (or ``denominator``) is not an
+  ``EngineStats`` counter field. An SLO over a ghost signal measures nothing
+  and silently never breaches.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tools.tmlint.core import Finding, Project
+from tools.tmlint.registries import counter_fields, docs_text, slo_registry, telemetry_tables
+
+_DOCS_REL = "docs/pages/observability.md"
+_SLO_REL = "torchmetrics_tpu/diag/slo.py"
+
+#: the documentation token convention: a backticked ``slo:<id>``
+_TOKEN_RE = re.compile(r"`slo:([a-z0-9-]+)`")
+
+
+def check_project(project: Project) -> List[Finding]:
+    registry = slo_registry(project)
+    if not registry:
+        return []
+    findings: List[Finding] = []
+
+    text = docs_text(project, _DOCS_REL)
+    if text is not None:
+        documented = set(_TOKEN_RE.findall(text))
+        for slo_id in sorted(set(registry) - documented):
+            findings.append(
+                Finding(
+                    "TM801", _SLO_REL, 1,
+                    f"SLO {slo_id!r} is registered but undocumented — add a"
+                    f" `slo:{slo_id}` token (with prose) to {_DOCS_REL}",
+                )
+            )
+        for slo_id in sorted(documented - set(registry)):
+            findings.append(
+                Finding(
+                    "TM802", _DOCS_REL, 1,
+                    f"doc token `slo:{slo_id}` has no SLO_REGISTRY entry —"
+                    " register the objective in diag/slo.py or drop the stale doc",
+                )
+            )
+
+    hist_series = set(telemetry_tables(project)["hist_series"])
+    counters = set(counter_fields(project))
+    for slo_id in sorted(registry):
+        row = registry[slo_id]
+        if not isinstance(row, dict):
+            continue
+        signal = row.get("signal")
+        kind = row.get("kind")
+        if kind == "quantile":
+            if signal not in hist_series:
+                findings.append(
+                    Finding(
+                        "TM803", _SLO_REL, 1,
+                        f"SLO {slo_id!r} binds quantile signal {signal!r} which is"
+                        " not a telemetry _HIST_SERIES key — it would never measure",
+                    )
+                )
+        elif kind in ("rate", "ratio"):
+            if signal not in counters:
+                findings.append(
+                    Finding(
+                        "TM803", _SLO_REL, 1,
+                        f"SLO {slo_id!r} binds {kind} signal {signal!r} which is"
+                        " not an EngineStats counter field — it would never measure",
+                    )
+                )
+            denom = row.get("denominator")
+            if kind == "ratio" and denom not in counters:
+                findings.append(
+                    Finding(
+                        "TM803", _SLO_REL, 1,
+                        f"SLO {slo_id!r} has ratio denominator {denom!r} which is"
+                        " not an EngineStats counter field — it would never measure",
+                    )
+                )
+    return findings

@@ -40,10 +40,15 @@ Always available, near-zero overhead when off. Nine pieces:
   :func:`telemetry_snapshot`, proper ``histogram`` exposition in
   :func:`export_prometheus`.
 - :mod:`~torchmetrics_tpu.diag.timeline` — cross-rank timeline merge
-  (:func:`merge_timelines`: one Perfetto trace with per-rank process tracks)
-  and packed-sync straggler detection from barrier timestamps piggybacked on
-  the metadata gather (``sync.straggler`` events +
-  ``EngineStats.sync_straggler_flags``).
+  (:func:`merge_timelines`: one Perfetto trace with per-rank — and, for fleet
+  streams, per-pod — process tracks) and packed-sync straggler detection from
+  barrier timestamps piggybacked on the metadata gather (``sync.straggler``
+  events + ``EngineStats.sync_straggler_flags``).
+- :mod:`~torchmetrics_tpu.diag.slo` — the declarative SLO engine:
+  :data:`~torchmetrics_tpu.diag.slo.SLO_REGISTRY` objectives over existing
+  histogram series / counter fields, fast+slow burn-rate windows,
+  ``slo.breach``/``slo.recover`` transitions, and the blocking-SLO readiness
+  input the serving sidecar's ``/healthz`` consumes.
 
 See ``docs/pages/observability.md`` for the event taxonomy, the retrace-cause
 glossary, the ledger field glossary, the sentinel bit layout, and the
@@ -69,6 +74,16 @@ from torchmetrics_tpu.diag.sentinel import (
     sentinel_context,
     sentinel_report,
 )
+from torchmetrics_tpu.diag.slo import (
+    SLO_REGISTRY,
+    SLOEngine,
+    SLOSpec,
+    blocking_breaches,
+    evaluate_slos,
+    reset_slo,
+    slo_context,
+    slo_state,
+)
 from torchmetrics_tpu.diag.telemetry import export_jsonl, export_prometheus, telemetry_snapshot
 from torchmetrics_tpu.diag.trace import (
     FlightRecorder,
@@ -83,15 +98,20 @@ from torchmetrics_tpu.diag.transfer_guard import TransferGuardError, transfer_al
 
 __all__ = [
     "SENTINEL_BITS",
+    "SLO_REGISTRY",
     "FlightRecorder",
+    "SLOEngine",
+    "SLOSpec",
     "TraceEvent",
     "TransferGuardError",
     "active_recorder",
     "attribute_retrace",
     "audit_context",
+    "blocking_breaches",
     "clear_recorder",
     "diag_context",
     "diag_report",
+    "evaluate_slos",
     "export_chrome_trace",
     "export_json",
     "export_jsonl",
@@ -106,10 +126,13 @@ __all__ = [
     "reset_histograms",
     "reset_ledger",
     "reset_sentinels",
+    "reset_slo",
     "sentinel_context",
     "sentinel_report",
     "set_profile_every_n",
     "set_straggler_threshold_us",
+    "slo_context",
+    "slo_state",
     "state_footprint",
     "straggler_threshold_us",
     "telemetry_snapshot",

@@ -43,6 +43,8 @@ __all__ = [
     "BOUNDS",
     "GROWTH",
     "Histogram",
+    "hist_from_arrays",
+    "hist_to_arrays",
     "histograms_snapshot",
     "merge_hists",
     "observe",
@@ -155,6 +157,44 @@ def merge_hists(a: Histogram, b: Histogram) -> Histogram:
     out.min = min(mins) if mins else None
     out.max = max(maxs) if maxs else None
     return out
+
+
+def hist_to_arrays(hist: Histogram) -> Tuple[List[int], List[float]]:
+    """Flatten a histogram into ``(counts, [total, sum, min, max])`` lists.
+
+    The wire form of the fleet telemetry envelope (``serve/fleet.py``): the
+    counts ride as one fixed-length integer vector over the shared
+    :data:`BOUNDS`, the scalar folds as a 4-float vector with NaN standing in
+    for an unset min/max. Round-trips exactly through
+    :func:`hist_from_arrays` — bucket geometry is a class-level constant, so
+    no boundary data travels and a merged remote histogram keeps the same
+    ≤ 18.92% one-sided quantile error bound as a local one.
+    """
+    nan = float("nan")
+    meta = [
+        float(hist.total),
+        float(hist.sum),
+        nan if hist.min is None else float(hist.min),
+        nan if hist.max is None else float(hist.max),
+    ]
+    return list(hist.counts), meta
+
+
+def hist_from_arrays(counts, meta) -> Histogram:
+    """Rebuild a :class:`Histogram` from its :func:`hist_to_arrays` form."""
+    counts = [int(c) for c in counts]
+    if len(counts) != _N + 1:
+        raise ValueError(
+            f"histogram counts vector has {len(counts)} slots, expected {_N + 1}"
+            " — incompatible bucket layout"
+        )
+    hist = Histogram()
+    hist.counts = counts
+    hist.total = int(meta[0])
+    hist.sum = float(meta[1])
+    hist.min = None if float(meta[2]) != float(meta[2]) else float(meta[2])
+    hist.max = None if float(meta[3]) != float(meta[3]) else float(meta[3])
+    return hist
 
 
 # process-wide registry: (owner, kind, series) -> Histogram. Bounded by the

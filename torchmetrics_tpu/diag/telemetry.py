@@ -109,6 +109,19 @@ UNITLESS_COUNT_FAMILIES = frozenset({
     "tm_tpu_federation_ingests", "tm_tpu_federation_folds",
     "tm_tpu_federation_degraded_folds", "tm_tpu_federation_stale_skips",
     "tm_tpu_federation_pods", "tm_tpu_federation_degraded_pods",
+    # fleet observability plane (serve/fleet.py, PR 19): pull / merge /
+    # exclusion event counts, membership + per-pod liveness/watermark gauges,
+    # and the fleet-summed curated counter families — pure counts; the
+    # time-valued per-pod gauges export as *_seconds
+    "tm_tpu_fleet_pulls", "tm_tpu_fleet_merges", "tm_tpu_fleet_degraded_pulls",
+    "tm_tpu_fleet_pods", "tm_tpu_fleet_degraded_pods", "tm_tpu_fleet_pod_up",
+    "tm_tpu_fleet_pod_seq", "tm_tpu_fleet_pod_seq_lag",
+    "tm_tpu_fleet_dispatches", "tm_tpu_fleet_eager_fallbacks",
+    "tm_tpu_fleet_sync_degraded_folds", "tm_tpu_fleet_quarantined_batches",
+    # declarative SLO engine (diag/slo.py, PR 19): evaluation / transition
+    # event counts and the per-SLO compliance gauges — pure counts/booleans
+    "tm_tpu_slo_evaluations", "tm_tpu_slo_breaches", "tm_tpu_slo_recoveries",
+    "tm_tpu_slo_compliance", "tm_tpu_slo_breaching",
 })
 
 # EngineStats fields exported as monotonic counters (everything countable);
@@ -172,6 +185,12 @@ _COUNTER_HELP = {
     "federation_folds": "global federation folds executed over the verified membership",
     "federation_degraded_folds": "federation folds over a degraded (pod-excluding) membership",
     "federation_stale_skips": "pod snapshots rejected by the federation watermark/staleness dedupe",
+    "fleet_pulls": "pod telemetry envelopes accepted by the fleet aggregator",
+    "fleet_merges": "fleet-wide telemetry merges over the fresh pod membership",
+    "fleet_degraded_pulls": "pods excluded from a fleet pull/merge round (fault, stale, never pulled)",
+    "slo_evaluations": "SLO evaluation passes over the registered objectives",
+    "slo_breaches": "SLO compliance transitions into breach",
+    "slo_recoveries": "SLO compliance transitions back to healthy",
 }
 
 # exposition-convention names for counters whose field name buries the unit:
@@ -239,6 +258,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
     from torchmetrics_tpu.diag.hist import histograms_snapshot
     from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
+    from torchmetrics_tpu.diag.slo import slo_state
     from torchmetrics_tpu.engine.persist import persist_state
     from torchmetrics_tpu.engine.stats import engine_report
     from torchmetrics_tpu.parallel.resilience import resilience_snapshot
@@ -258,6 +278,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
         "resilience": resilience_snapshot(),
         "serve": serve_state(),
         "persist": persist_state(),
+        "slo": slo_state(),
     }
 
 
@@ -379,6 +400,34 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
         f"{_PREFIX}_federation_degraded_pods", "gauge",
         "pods excluded from the last federation fold (stale/unreachable)",
         [({"owner": f["owner"]}, f["degraded_pods"]) for f in serve.get("federations", [])],
+    )
+    # fleet observability plane (serve/fleet.py): membership gauges per
+    # aggregator. Pull/merge/exclusion counts ride the EngineStats auto-export
+    # above (fleet_pulls/fleet_merges/fleet_degraded_pulls); the pod-labeled
+    # per-pod series and merged tm_tpu_fleet_* families render on the fleet
+    # aggregator's own exposition (FleetTelemetry.export_prometheus).
+    emit(
+        f"{_PREFIX}_fleet_pods", "gauge",
+        "pods with fresh verified telemetry in the fleet membership",
+        [({"owner": f["owner"]}, f["pods"]) for f in serve.get("fleets", [])],
+    )
+    emit(
+        f"{_PREFIX}_fleet_degraded_pods", "gauge",
+        "pods excluded from the last fleet merge (stale/unreachable)",
+        [({"owner": f["owner"]}, f["degraded_pods"]) for f in serve.get("fleets", [])],
+    )
+    # declarative SLO engine (diag/slo.py): per-SLO compliance gauges over the
+    # local evaluator's last pass. Evaluation/transition counts ride the
+    # EngineStats auto-export (slo_evaluations/slo_breaches/slo_recoveries).
+    emit(
+        f"{_PREFIX}_slo_compliance", "gauge",
+        "1 when the SLO is compliant, 0 in breach",
+        [({"slo": row["id"]}, 0 if row["breaching"] else 1) for row in snap.get("slo", [])],
+    )
+    emit(
+        f"{_PREFIX}_slo_breaching", "gauge",
+        "1 when the SLO is in breach (blocking SLOs gate /healthz readiness)",
+        [({"slo": row["id"]}, 1 if row["breaching"] else 0) for row in snap.get("slo", [])],
     )
 
     # persistent executable cache (engine/persist.py): store/reject/fallback

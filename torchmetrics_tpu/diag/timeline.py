@@ -171,25 +171,42 @@ def merge_timelines(
             ``recorder.snapshot()``) or ``export_json``-shaped dicts;
             ``clock_offset_us`` (default 0) is subtracted from every event
             timestamp, putting all ranks on one clock (use the packed sync's
-            ``offsets_us``, or 0 for single-host emulations).
+            ``offsets_us``, or 0 for single-host emulations). A stream may
+            additionally carry ``"pod": str`` (fleet streams, PR 19) — see
+            below.
         path: optional file to additionally write the JSON to.
 
     Layout: one chrome *process* per rank (``pid = rank``, named
     ``rank <r>``), one thread track per event owner inside it (``collective``
     events get per-role tracks, same convention as ``export_chrome_trace``).
+    When ANY stream carries a ``pod`` id, the whole merge switches to fleet
+    layout: streams order canonically by ``(pod, rank)``, each gets its own
+    process track (pids are dense indexes in that order — two pods' rank 0
+    can no longer collide) named ``pod <p> · rank <r>``, so one Perfetto
+    trace shows the entire fleet. Byte-stable under pod-id permutation: the
+    canonical sort, not arrival order, fixes every pid.
     Events with a measured span render as complete ("X") slices ending at
     their (corrected) record timestamp. Output ordering is fully
     deterministic: identical inputs serialize byte-identically.
     """
     trace_events: List[Dict[str, Any]] = []
-    flat: List[Any] = []  # (ts_us, rank, seq, tid, is_span, dur, kind, data)
+    flat: List[Any] = []  # (ts_us, pid, seq, tid, is_span, dur, kind, data)
     tids: Dict[Any, int] = {}
 
-    for stream in sorted(streams, key=lambda s: int(s.get("rank", 0))):
+    fleet = any("pod" in s for s in streams)
+    ordered = sorted(
+        streams, key=lambda s: (str(s.get("pod", "")), int(s.get("rank", 0)))
+    )
+    for index, stream in enumerate(ordered):
         rank = int(stream.get("rank", 0))
+        pod = str(stream.get("pod", ""))
+        # legacy (rank-only) streams keep pid = rank; fleet streams need a
+        # dense pid because rank values repeat across pods
+        pid = index if fleet else rank
+        name = f"pod {pod} · rank {rank}" if fleet else f"rank {rank}"
         offset = float(stream.get("clock_offset_us", 0.0))
         trace_events.append(
-            {"ph": "M", "pid": rank, "name": "process_name", "args": {"name": f"rank {rank}"}}
+            {"ph": "M", "pid": pid, "name": "process_name", "args": {"name": name}}
         )
         for raw in stream.get("events", ()):
             ev = _event_fields(raw)
@@ -197,10 +214,10 @@ def merge_timelines(
                 owner = "collective:" + str(ev["data"].get("label") or "?")
             else:
                 owner = ev["owner"] or "<process>"
-            tid = tids.setdefault((rank, owner), len(tids) + 1)
+            tid = tids.setdefault((pid, owner), len(tids) + 1)
             ts = round(ev["ts_us"] - offset, 3)
             dur = float(ev["data"].get("dispatch_us", 0.0))
-            flat.append((ts, rank, ev["seq"], tid, ev["kind"], dur, ev["data"]))
+            flat.append((ts, pid, ev["seq"], tid, ev["kind"], dur, ev["data"]))
 
     for ts, rank, seq, tid, kind, dur, data in sorted(flat, key=lambda x: (x[0], x[1], x[2])):
         entry: Dict[str, Any] = {
@@ -215,9 +232,9 @@ def merge_timelines(
             entry.update(ph="i", ts=ts, s="t")
         trace_events.append(entry)
 
-    for (rank, owner), tid in sorted(tids.items(), key=lambda kv: kv[1]):
+    for (pid, owner), tid in sorted(tids.items(), key=lambda kv: kv[1]):
         trace_events.append(
-            {"ph": "M", "pid": rank, "tid": tid, "name": "thread_name", "args": {"name": owner}}
+            {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name", "args": {"name": owner}}
         )
 
     trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}

@@ -139,6 +139,10 @@ EVENT_KINDS = frozenset({
     # federated multi-pod aggregation plane (serve/federation.py)
     "federation.ingest", "federation.fold", "federation.degraded",
     "federation.stale", "federation.rejoin",
+    # fleet observability plane (serve/fleet.py): cross-pod telemetry federation
+    "fleet.pull", "fleet.merge", "fleet.degraded", "fleet.stale",
+    # declarative SLO engine (diag/slo.py): breach/recover transitions
+    "slo.breach", "slo.recover",
     # engine-wide fallbacks + transfer guard (engine/stats.py, diag/transfer_guard.py)
     "fallback", "transfer.host", "transfer.blocked",
     # persistent executable cache + prewarm (engine/persist.py)

@@ -73,6 +73,9 @@ KNOB_REGISTRY = {
     "TORCHMETRICS_TPU_FEDERATION_STALENESS_S": "torchmetrics_tpu.parallel.resilience:_env_float",
     "TORCHMETRICS_TPU_FEDERATION_TIMEOUT_MS": "torchmetrics_tpu.parallel.resilience:_env_float",
     "TORCHMETRICS_TPU_FEDERATION_RETRIES": "torchmetrics_tpu.serve.stats:_env_int",
+    # fleet observability plane + SLO engine (PR 19)
+    "TORCHMETRICS_TPU_FLEET_PULL_MS": "torchmetrics_tpu.serve.stats:_env_int",
+    "TORCHMETRICS_TPU_SLO": "torchmetrics_tpu.diag.slo:_env_slo",
 }
 
 #: parsers that read the env key through a ``name`` PARAMETER (shared

@@ -89,6 +89,14 @@ _COUNTER_FIELDS = (
     "federation_folds",  # global folds executed over the verified pod membership
     "federation_degraded_folds",  # global folds over a degraded (pod-excluding) membership
     "federation_stale_skips",  # snapshots rejected by the watermark/staleness dedupe
+    # --- fleet observability plane (serve/fleet.py): cross-pod telemetry federation ---
+    "fleet_pulls",  # pod telemetry envelopes accepted (version+CRC verified, watermark advanced)
+    "fleet_merges",  # fleet-wide telemetry merges over the fresh pod membership
+    "fleet_degraded_pulls",  # pods excluded from a pull/merge round (fault, stale, never pulled)
+    # --- declarative SLO engine (diag/slo.py): rolling-window objective evaluation ---
+    "slo_evaluations",  # SLO evaluation passes (every spec, fast+slow burn windows)
+    "slo_breaches",  # SLO compliance transitions into breach (slo.breach events)
+    "slo_recoveries",  # SLO compliance transitions back to healthy (slo.recover events)
 )
 
 
@@ -215,6 +223,7 @@ def reset_engine_stats() -> None:
     from torchmetrics_tpu.diag.hist import reset_histograms
     from torchmetrics_tpu.diag.profile import reset_profile
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
+    from torchmetrics_tpu.diag.slo import reset_slo
     from torchmetrics_tpu.engine.persist import reset_persist_stats
     from torchmetrics_tpu.engine.txn import reset_quarantine
     from torchmetrics_tpu.parallel.resilience import reset_resilience
@@ -230,3 +239,4 @@ def reset_engine_stats() -> None:
     reset_resilience()
     reset_serve_stats()
     reset_persist_stats()
+    reset_slo()

@@ -395,13 +395,16 @@ def bounded_pull(
     rank: Optional[int] = None,
     members: Optional[Sequence[int]] = None,
 ) -> Any:
-    """Run one point-to-point fetch (a federation pod pull) under the policy.
+    """Run one point-to-point fetch (a federation or fleet pod pull) under the policy.
 
     The aggregation-tier sibling of :func:`bounded_collective`: the same
     deadline watchdog, bounded retry/backoff, typed-fault classification, and
     fault-injection hook (``parallel/faults.py`` plants at this boundary via
     the ``label``/``members`` contract, so pod-churn chaos rides the
-    production path). Two deliberate differences:
+    production path). Both aggregation planes pull through here — state
+    envelopes on ``federation-pull:<pod>`` labels (``serve/federation.py``)
+    and telemetry envelopes on ``fleet-pull:<pod>`` labels
+    (``serve/fleet.py``). Two deliberate differences:
 
     - A **pull is idempotent** — it reads a pod's snapshot endpoint, it does
       not participate in an ordered collective stream — so a deadline expiry

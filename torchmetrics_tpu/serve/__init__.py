@@ -25,12 +25,18 @@ semantics, all without host transfers in the hot loop:
 - :mod:`~torchmetrics_tpu.serve.federation` —
   :class:`FederationAggregator`: the multi-pod aggregation plane — verified
   envelope ingest/pull, canonical-order global folds through the packed-sync
-  machinery, degraded semantics at pod loss.
+  machinery, degraded semantics at pod loss;
+- :mod:`~torchmetrics_tpu.serve.fleet` — :class:`FleetTelemetry`: the fleet
+  observability plane — every pod's counters/histograms/sentinels pulled as
+  verified ``/telemetry.bin`` envelopes, merged bound-preservingly
+  (``merge_hists``), exposed as pod-labeled + ``tm_tpu_fleet_*`` exposition
+  and fleet-wide SLO evaluation (``diag/slo.py``).
 
 See ``docs/pages/serving.md`` for semantics, error bounds, and knobs.
 """
 
 from torchmetrics_tpu.serve.federation import FederationAggregator, pack_envelope, parse_envelope
+from torchmetrics_tpu.serve.fleet import FleetTelemetry, pack_telemetry, parse_telemetry
 from torchmetrics_tpu.serve.quantile import KLLSketch
 from torchmetrics_tpu.serve.sidecar import MetricsSidecar
 from torchmetrics_tpu.serve.sketch import CardinalitySketch, HeavyHitters
@@ -43,6 +49,7 @@ __all__ = [
     "CardinalitySketch",
     "DecayedMetric",
     "FederationAggregator",
+    "FleetTelemetry",
     "HeavyHitters",
     "KLLSketch",
     "MetricsSidecar",
@@ -51,7 +58,9 @@ __all__ = [
     "WindowedMetric",
     "federated_rollup",
     "pack_envelope",
+    "pack_telemetry",
     "parse_envelope",
+    "parse_telemetry",
     "reset_serve_stats",
     "serve_state",
     "snapshot_compute",

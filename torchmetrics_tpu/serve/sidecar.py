@@ -9,14 +9,29 @@ stdlib only, no new dependencies — and answers:
   version a Prometheus scraper negotiates);
 - ``GET /telemetry`` → one ``telemetry_snapshot()`` as a JSON line
   (``application/json``), the JSONL tail-dashboard feed;
-- ``GET /healthz``   → liveness probe;
+- ``GET /healthz``   → READINESS, not unconditional liveness: ``200 ok`` only
+  when the warm-start handoff (if any) fully replayed AND no *blocking* SLO
+  (``diag/slo.py``) is in breach — otherwise ``503`` with a JSON body naming
+  the reason and the breaching SLO, so an orchestrator's readiness probe
+  drains traffic from a pod that is up but failing its objectives;
+- ``GET /slo``       → one SLO evaluation pass + the per-spec compliance rows
+  (``application/json``);
 - ``GET /state``     → the versioned federation envelope for the sidecar's
   ``state_target`` metrics (``serve/federation.py``): packed snapshot bytes
   with layout-version, payload-CRC, and snapshot-sequence headers, built on
   the pause-free :func:`~torchmetrics_tpu.serve.snapshot.take_snapshot` —
   answering never stalls the training thread. Until a consistent snapshot
   exists the endpoint answers **503 with a typed JSON reason**, never an
-  empty 200 an aggregator would mistake for a zero-valued pod.
+  empty 200 an aggregator would mistake for a zero-valued pod;
+- ``GET /telemetry.bin`` → the versioned fleet TELEMETRY envelope
+  (``serve/fleet.py``): this pod's counters + histogram registries +
+  sentinel bits + ledger rollup, CRC/version/seq stamped exactly like
+  ``/state`` — what a :class:`~torchmetrics_tpu.serve.fleet.FleetTelemetry`
+  aggregator pulls;
+- ``GET /fleet/metrics`` / ``GET /fleet/slo`` → the FLEET-side surfaces when
+  a fleet aggregator is attached (``fleet_target``): the merged pod-labeled
+  exposition, and an SLO evaluation over the merged fleet inputs. Without an
+  attached aggregator both answer ``503 {"reason": "no-fleet-target"}``.
 
 Every scrape is timed into the ``serve_scrape_latency_seconds`` histogram
 family (``diag/hist.py``) and the ``tm_tpu_serve_scrapes_total`` counters;
@@ -94,7 +109,21 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
                 body = (json.dumps(telemetry_snapshot(), sort_keys=True, default=str) + "\n").encode()
                 ctype = "application/json"
             elif path == "/healthz":
-                body, ctype = b"ok\n", "text/plain"
+                status, body, ctype = self._healthz_response()
+            elif path == "/slo":
+                from torchmetrics_tpu.diag.slo import evaluate_slos
+
+                body = (json.dumps(evaluate_slos(), sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+            elif path == "/telemetry.bin":
+                from torchmetrics_tpu.serve.fleet import pack_telemetry
+
+                body, extra_headers = pack_telemetry()
+                ctype = "application/octet-stream"
+            elif path == "/fleet/metrics":
+                status, body, ctype = self._fleet_response("metrics")
+            elif path == "/fleet/slo":
+                status, body, ctype = self._fleet_response("slo")
             else:
                 self.send_error(404, "unknown scrape path")
                 return
@@ -137,6 +166,55 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
             return 503, {}, reason.encode(), "application/json"
         return 200, headers, body, "application/octet-stream"
 
+    def _healthz_response(self) -> tuple:
+        """Readiness over warm-start status + blocking SLOs.
+
+        Failure modes answer ``503`` with a JSON body NAMING the cause — a
+        warm handoff that failed to replay (``warm-start-failed``, the pod is
+        up but cold and possibly state-less) or a blocking SLO in breach
+        (``slo-breach`` with the breaching ids) — so an orchestrator can
+        drain traffic for the right reason. Liveness is the socket answering
+        at all; readiness is this body.
+        """
+        warm = getattr(self.server, "tm_warm_report", None)
+        if warm and int(warm.get("failed", 0)) > 0:
+            body = json.dumps({
+                "status": "unready",
+                "reason": "warm-start-failed",
+                "failed": int(warm.get("failed", 0)),
+                "replayed": int(warm.get("replayed", 0)),
+            }, sort_keys=True) + "\n"
+            return 503, body.encode(), "application/json"
+        from torchmetrics_tpu.diag.slo import blocking_breaches, evaluate_slos, slo_enabled
+
+        if slo_enabled():
+            evaluate_slos()
+            breaching = blocking_breaches()
+            if breaching:
+                body = json.dumps({
+                    "status": "unready",
+                    "reason": "slo-breach",
+                    "slo": breaching,
+                }, sort_keys=True) + "\n"
+                return 503, body.encode(), "application/json"
+        return 200, b"ok\n", "text/plain"
+
+    def _fleet_response(self, view: str) -> tuple:
+        """The fleet-side surfaces: merged exposition or fleet SLO rows.
+
+        Mirrors the ``/state`` contract — no attached aggregator is a typed
+        ``503 no-fleet-target`` refusal, never an empty fleet pretending to
+        be a healthy one.
+        """
+        fleet = getattr(self.server, "tm_fleet_target", None)
+        if fleet is None:
+            reason = json.dumps({"reason": "no-fleet-target"}) + "\n"
+            return 503, reason.encode(), "application/json"
+        if view == "metrics":
+            return 200, fleet.export_prometheus().encode(), PROMETHEUS_CONTENT_TYPE
+        rows = fleet.evaluate_slos()
+        return 200, (json.dumps(rows, sort_keys=True) + "\n").encode(), "application/json"
+
     def log_message(self, *_: Any) -> None:
         """Silence the default stderr access log (scrapes are periodic)."""
 
@@ -171,6 +249,7 @@ class MetricsSidecar:
         persist_dir: Optional[str] = None,
         snapshot_dir: Optional[str] = None,
         state_target: Any = None,
+        fleet_target: Any = None,
     ) -> None:
         self._requested_port = _serve_stats.default_port() if port is None else int(port)
         self.host = host
@@ -181,6 +260,7 @@ class MetricsSidecar:
         self._persist_dir = persist_dir
         self._snapshot_dir = snapshot_dir
         self._state_target = state_target
+        self._fleet_target = fleet_target
         self.warm_report: Optional[dict] = None
 
     @property
@@ -204,9 +284,13 @@ class MetricsSidecar:
             )
         server = ThreadingHTTPServer((self.host, self._requested_port), _ScrapeHandler)
         server.daemon_threads = True
-        # the /state handler reads this off the server object (handler
-        # instances are per-request; the server is the shared context)
+        # the /state, /healthz, and /fleet/* handlers read these off the
+        # server object (handler instances are per-request; the server is the
+        # shared context) — a failed warm handoff must flip readiness, not
+        # hide inside warm_report
         server.tm_state_target = self._state_target
+        server.tm_fleet_target = self._fleet_target
+        server.tm_warm_report = self.warm_report
         self._server = server
         self.port = server.server_address[1]
         self._thread = threading.Thread(

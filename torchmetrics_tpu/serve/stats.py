@@ -18,6 +18,9 @@ of silently disabling the knob.
   :func:`~torchmetrics_tpu.serve.snapshot.take_snapshot`.
 - ``TORCHMETRICS_TPU_FEDERATION_RETRIES`` — bounded-pull retry budget for
   :class:`~torchmetrics_tpu.serve.federation.FederationAggregator`.
+- ``TORCHMETRICS_TPU_FLEET_PULL_MS`` — per-pull deadline (ms) for
+  :class:`~torchmetrics_tpu.serve.fleet.FleetTelemetry` telemetry rounds
+  (unset/0 = no deadline).
 """
 
 from __future__ import annotations
@@ -31,9 +34,11 @@ from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 __all__ = [
     "federation_retries",
+    "fleet_pull_ms",
     "note_scrape",
     "note_snapshot",
     "register_federation",
+    "register_fleet",
     "register_sketch",
     "register_tenancy",
     "reset_serve_stats",
@@ -59,6 +64,7 @@ _SEQ = iter(range(1, 1 << 62)).__next__
 _TENANCIES: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
 _SKETCHES: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
 _FEDERATIONS: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
+_FLEETS: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
 
 
 def register_tenancy(obj: Any) -> None:
@@ -71,6 +77,10 @@ def register_sketch(obj: Any) -> None:
 
 def register_federation(obj: Any) -> None:
     _FEDERATIONS[_SEQ()] = obj
+
+
+def register_fleet(obj: Any) -> None:
+    _FLEETS[_SEQ()] = obj
 
 
 def note_scrape(seconds: float) -> None:
@@ -136,6 +146,14 @@ def serve_state() -> Dict[str, Any]:
         except Exception as exc:  # noqa: BLE001
             _note_failed(owner, exc)
     out["federations"] = sorted(federations, key=lambda f: f["owner"])
+    fleets = []
+    for seq, obj in sorted(_FLEETS.items()):
+        owner = f"{type(obj).__name__}#{seq}"
+        try:
+            fleets.append({"owner": owner, **obj.fleet_state()})
+        except Exception as exc:  # noqa: BLE001
+            _note_failed(owner, exc)
+    out["fleets"] = sorted(fleets, key=lambda f: f["owner"])
     return out
 
 
@@ -175,3 +193,9 @@ def snapshot_retries() -> int:
 
 def federation_retries() -> int:
     return _env_int("TORCHMETRICS_TPU_FEDERATION_RETRIES", 2, 0, 100)
+
+
+def fleet_pull_ms() -> "float | None":
+    """Per-pull deadline (ms) for fleet telemetry rounds; None = no deadline."""
+    value = _env_int("TORCHMETRICS_TPU_FLEET_PULL_MS", 0, 0, 86_400_000)
+    return float(value) if value else None
