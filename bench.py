@@ -80,7 +80,7 @@ _SCENARIO_BYTES = {
 # every scenario block scripts/check_counters.py gates on: a run (including
 # the TPU-less micro fallback) must prove each of these completed, or the
 # gate's scenario-completeness check fails — nothing gated can skip silently
-_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "federation", "fleet", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart")
+_GATED_SCENARIOS = ("engine", "epoch", "txn", "numerics", "serve", "federation", "fleet", "lineage", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart")
 
 # the sharding scenario partitions state over a >= 4-device mesh; on a host
 # platform that needs forced virtual devices, set BEFORE jax initializes (the
@@ -1854,6 +1854,204 @@ def bench_fleet():
     out["fleet_degraded_pulls"] = int(
         delta["fleet_degraded_pulls"] - base["fleet_degraded_pulls"]
     )
+    out["slo_breaches"] = int(delta["slo_breaches"] - base["slo_breaches"])
+    out["slo_recoveries"] = int(delta["slo_recoveries"] - base["slo_recoveries"])
+    return out
+
+
+def bench_lineage(micro=False):
+    """Value provenance & freshness plane (ISSUE 20 acceptance evidence):
+
+    - **watermark exactness under K=8 scan + async**: a STRICT-guarded hot
+      loop with background drains, one planted poisoned (NaN) batch under
+      quarantine — the mid-stream provenance staleness equals the engine's
+      own enqueued-minus-folded backlog exactly, the post-compute watermark
+      equals steps-folded exactly, the quarantined batch is counted
+      **excluded** (not silently absorbed), with 0 host transfers and 0 warm
+      retraces on the provenance-bearing path;
+    - **coverage attestation**: a planted degraded federation fold (3 of 4
+      known pods ingested) stamps coverage NAMING the excluded pod and its
+      reason — 3/4 pods is visibly 3/4;
+    - **freshness SLO → readiness**: a planted stale owner (64 steps
+      enqueued, none folded) breaches the blocking ``value-freshness``
+      objective and flips ``/healthz`` to 503 naming the owner AND its
+      staleness; the fold catching up recovers it past the fast burn window;
+    - **off-switch byte identity**: the same stream with lineage disabled
+      produces byte-identical states and zero lineage events — provenance is
+      evidence, never a perturbation.
+    """
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.diag import diag_context, slo_context, transfer_guard
+    from torchmetrics_tpu.diag import lineage as lineage_mod
+    from torchmetrics_tpu.engine import (
+        async_context,
+        engine_context,
+        quarantine_context,
+        scan_context,
+    )
+    from torchmetrics_tpu.engine.stats import engine_report
+    from torchmetrics_tpu.engine.txn import read_quarantine
+    from torchmetrics_tpu.serve import MetricsSidecar
+
+    batch, classes = 8, 10
+    steps = 64 if micro else 192  # multiple of K=8: aligned drains, no tail
+    owner = "MulticlassAccuracy"
+
+    key = jax.random.PRNGKey(20)
+    preds = jax.random.normal(key, (batch, classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, classes, dtype=jnp.int32)
+    nan_preds = jnp.asarray(np.full((batch, classes), np.nan, np.float32))
+
+    def build():
+        return MulticlassAccuracy(classes, average="micro", validate_args=False)
+
+    def block(m):
+        jax.block_until_ready([getattr(m, s) for s in m._defaults])
+
+    out = {"lineage_steps": steps}
+    base = engine_report()
+
+    # -- watermark exactness: K=8 scan + async + quarantine, STRICT guard -----
+    with engine_context(True, donate=True), scan_context(8), async_context(), \
+            quarantine_context(True):
+        m = build()
+        for i in range(24):  # warm every executable (incl. the poisoned path)
+            m.update(nan_preds if i == 12 else preds, target)
+        m.compute()
+        block(m)
+        m.reset()
+        lineage_mod.reset_lineage()
+        st = m._engine.stats
+        warm_traces = st.traces
+        warm_folded = st.scan_steps_folded  # the warm phase folded through scan too
+        poison_step = steps // 2
+        with diag_context(capacity=8192) as rec, transfer_guard("strict"):
+            for i in range(steps):
+                m.update(nan_preds if i == poison_step else preds, target)
+            mid = lineage_mod.provenance_of(owner)
+            # background drains race a stricter mid-stream equality against
+            # the engine counter; the race-free mid facts are the bounds, and
+            # the exactness proof is the post-join watermark + counter below
+            mid_exact = bool(
+                mid is not None
+                and mid.steps_enqueued == steps
+                and 0 <= mid.steps_folded <= steps
+                and mid.staleness_steps == mid.steps_enqueued - mid.steps_folded
+            )
+            out["lineage_staleness_mid"] = int(mid.staleness_steps if mid else -1)
+            out["lineage_host_transfers"] = rec.count("transfer.host", "transfer.blocked")
+            out["lineage_span_events"] = sum(
+                1 for ev in rec.snapshot() if "lineage" in ev.data
+            )
+        value = m.compute()
+        block(m)
+        quarantined = read_quarantine(m)["count"]
+        final = m._provenance
+        out["lineage_retraces_after_warmup"] = st.traces - warm_traces
+        out["lineage_quarantined_excluded"] = int(final.excluded.get("quarantined", 0))
+        out["lineage_watermark_exact_ok"] = bool(
+            mid_exact
+            and quarantined == 1
+            and final.where == "compute"
+            and final.steps_enqueued == final.steps_folded == final.steps_observed == steps
+            and final.staleness_steps == 0
+            and st.scan_steps_folded - warm_folded == steps  # the engine's own fold counter agrees
+        )
+        out["lineage_value"] = round(float(np.asarray(value)), 6)
+
+    # -- coverage attestation: degraded federation fold names the pod ---------
+    from torchmetrics_tpu.serve.federation import FederationAggregator, pack_envelope
+
+    with engine_context(True):
+        tmpl = build()
+        agg = FederationAggregator(
+            tmpl, pods={pid: None for pid in ("p0", "p1", "p2", "p3")}, staleness_s=None
+        )
+        for i, pid in enumerate(("p0", "p1", "p2")):  # p3 never answers
+            pod_m = build()
+            rng = np.random.RandomState(30 + i)
+            for _ in range(2):
+                pod_m.update(
+                    jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+                    jnp.asarray(rng.randint(0, classes, batch).astype(np.int32)),
+                )
+            data, headers = pack_envelope(pod_m)
+            agg.ingest(pid, data, headers)
+        agg.fold()
+        stamp = agg.last_coverage
+    out["lineage_coverage_ok"] = bool(
+        stamp is not None
+        and stamp["members"] == ["p0", "p1", "p2"]
+        and stamp["excluded"] == [{"id": "p3", "reason": "missing"}]
+        and stamp["complete"] is False
+    )
+
+    # -- freshness SLO: stale owner -> /healthz 503 naming it -> recovery -----
+    with slo_context(slow_s=60.0, fast_s=0.2), MetricsSidecar(port=0) as sc:
+        url = f"http://{sc.host}:{sc.port}/healthz"
+        with urllib.request.urlopen(url) as resp:  # baseline burn-rate sample
+            baseline_ready = resp.status == 200
+        lineage_mod.note_enqueued("StaleOwner", steps=64)
+        for _ in range(200):  # the staleness p99 window delta crosses the bound
+            lineage_mod.note_observed("StaleOwner", "scrape")
+        breach_named = False
+        try:
+            urllib.request.urlopen(url)
+        except urllib.error.HTTPError as err:
+            payload = json.loads(err.read())
+            breach_named = bool(
+                err.code == 503
+                and payload.get("reason") == "slo-breach"
+                and "value-freshness" in payload.get("slo", ())
+                and payload.get("stale_owner") == "StaleOwner"
+                and payload.get("staleness_steps") == 64
+            )
+        out["lineage_breach_ok"] = bool(baseline_ready and breach_named)
+        lineage_mod.note_folded("StaleOwner", 64)  # the fold catches up
+        time.sleep(0.3)
+        with urllib.request.urlopen(url) as resp:
+            out["lineage_recovery_ok"] = bool(resp.status == 200)
+    lineage_mod.reset_lineage()
+
+    # -- off-switch: byte-identical states, zero lineage events ---------------
+    rng = np.random.RandomState(11)
+    stream = [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch).astype(np.int32)),
+        )
+        for _ in range(24)
+    ]
+
+    def run_stream(enabled):
+        with lineage_mod.lineage_context(enabled):
+            with engine_context(True, donate=True), scan_context(8), \
+                    diag_context(capacity=2048) as rec2:
+                m2 = build()
+                for p, t in stream:
+                    m2.update(p, t)
+                m2.compute()
+                states = {s: np.asarray(getattr(m2, s)).tobytes() for s in m2._defaults}
+                silent = rec2.count("lineage.observe") == 0 and all(
+                    "lineage" not in ev.data for ev in rec2.snapshot()
+                )
+        return states, silent
+
+    on_states, _ = run_stream(True)
+    off_states, off_silent = run_stream(False)
+    out["lineage_off_identical_ok"] = bool(
+        off_silent and on_states == off_states
+    )
+
+    delta = engine_report()
+    for field in ("lineage_records", "lineage_spans", "lineage_coverage_folds"):
+        out[field] = int(delta[field] - base[field])
     out["slo_breaches"] = int(delta["slo_breaches"] - base["slo_breaches"])
     out["slo_recoveries"] = int(delta["slo_recoveries"] - base["slo_recoveries"])
     return out
@@ -4016,6 +4214,12 @@ def main(argv=None):
             statuses["fleet"] = "ok"
         except Exception as err:  # noqa: BLE001
             statuses["fleet"] = f"error:{type(err).__name__}: {str(err)[:200]}"
+
+        try:
+            extras["lineage"] = bench_lineage(micro=not on_tpu or args.smoke)
+            statuses["lineage"] = "ok"
+        except Exception as err:  # noqa: BLE001
+            statuses["lineage"] = f"error:{type(err).__name__}: {str(err)[:200]}"
 
         try:
             extras["scan"] = bench_scan(micro=not on_tpu or args.smoke)

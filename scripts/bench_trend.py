@@ -105,6 +105,16 @@ _TRACKED = (
     ("fleet", "fleet_p99_rel_err", None),
     ("fleet", "fleet_host_transfers", "max"),
     ("fleet", "slo_breaches", None),
+    # value provenance & freshness plane (diag/lineage.py, PR 20): record /
+    # span / mid-stream-staleness volumes are trajectory evidence (check_
+    # counters owns the watermark/coverage/breach/off-identity gates); host
+    # transfers and warm retraces on the provenance-bearing STRICT hot loop
+    # must never creep above zero.
+    ("lineage", "lineage_records", None),
+    ("lineage", "lineage_spans", None),
+    ("lineage", "lineage_staleness_mid", None),
+    ("lineage", "lineage_host_transfers", "max"),
+    ("lineage", "lineage_retraces_after_warmup", "max"),
     # cross-metric CSE (engine/statespec.py + collections.py, PR 11): the
     # speedup and footprint fraction are trajectory evidence (check_counters
     # gates the exact counter envelope); traces/dispatches/transfers and the

@@ -411,6 +411,24 @@ _CHECKS = (
     ("fleet", "fleet_degraded_pulls", "min", 1),  # the excluded pod was counted
     ("fleet", "slo_breaches", "min", 1),  # the breach transition was counted
     ("fleet", "slo_recoveries", "min", 1),  # ...and the recovery transition
+    # value provenance & freshness plane (PR 20): the STRICT-guarded K=8
+    # scan + async hot loop's observation watermark equals steps-folded
+    # exactly (quarantined batch counted EXCLUDED, not absorbed), the planted
+    # degraded federation fold names the excluded pod on its coverage stamp,
+    # the planted stale owner breaches value-freshness -> /healthz 503 naming
+    # owner + staleness -> recovers, and lineage-off is byte-identical.
+    ("lineage", "lineage_watermark_exact_ok", "true", None),  # watermark == steps folded
+    ("lineage", "lineage_quarantined_excluded", "min", 1),  # the poison counted excluded
+    ("lineage", "lineage_coverage_ok", "true", None),  # stamp NAMES the excluded pod
+    ("lineage", "lineage_breach_ok", "true", None),  # 503 names owner + staleness
+    ("lineage", "lineage_recovery_ok", "true", None),  # fold catches up -> 200
+    ("lineage", "lineage_off_identical_ok", "true", None),  # off = byte-identical + silent
+    ("lineage", "lineage_host_transfers", "abs", 0),  # provenance is host-pure
+    ("lineage", "lineage_retraces_after_warmup", "max", 0),  # spans don't retrace
+    ("lineage", "lineage_span_events", "min", 1),  # spans rode the event stream
+    ("lineage", "lineage_coverage_folds", "min", 1),  # the attestation was counted
+    ("lineage", "slo_breaches", "min", 1),  # the freshness breach transitioned
+    ("lineage", "slo_recoveries", "min", 1),  # ...and recovered
 )
 
 
@@ -451,7 +469,7 @@ def check(fresh: dict, baseline: dict) -> int:
     failures = []
     rows = []
     statuses = fresh.get("statuses", {})
-    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "federation", "fleet", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
+    for scenario in ("engine", "epoch", "txn", "numerics", "serve", "federation", "fleet", "lineage", "scan", "async", "cse", "sharding", "multichip_2d", "heavy", "coldstart"):
         status = statuses.get(scenario, "missing")
         if status != "ok":
             failures.append(f"scenario {scenario!r} did not complete: {status}")

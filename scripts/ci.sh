@@ -107,6 +107,30 @@ else
 fi
 
 echo
+echo "=== diag dump smoke (postmortem CLI) ==="
+# The one-shot observability dump (diag report + telemetry + ledger +
+# provenance) must keep working as a CLI — it is the documented postmortem
+# entry point, and nothing else imports it, so only this smoke would notice.
+DUMP_OUT=$(mktemp)
+if ! JAX_PLATFORMS=cpu python scripts/diag_dump.py --demo > "$DUMP_OUT"; then
+  echo "diag dump: FAILED (postmortem CLI must exit 0 on the demo workload)"
+  status=1
+elif ! python - "$DUMP_OUT" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+missing = {"report", "telemetry", "ledger", "provenance", "demo"} - set(doc)
+assert not missing, f"dump missing surfaces: {sorted(missing)}"
+assert doc["demo"]["provenance"]["steps_folded"] > 0, "demo folded nothing"
+PY
+then
+  echo "diag dump: FAILED (dump missing a surface — see assertion above)"
+  status=1
+else
+  echo "diag dump: ok (all four surfaces + demo provenance present)"
+fi
+rm -f "$DUMP_OUT"
+
+echo
 echo "=== bench smoke (CPU) ==="
 # The r05 regression class: bench.py must degrade to partial JSON with explicit
 # status markers and rc=0 when no TPU exists — never die with a traceback.

@@ -1,0 +1,389 @@
+"""Value provenance & freshness plane tests (diag/lineage.py): watermark
+exactness under scan/async, exclusion accounting (quarantine / replay /
+discard), causal spans on the event stream + timeline flow arrows, coverage
+attestation at the fold sites, envelope header stamps, the freshness SLO's
+/healthz gate, and the lineage-off byte-identity contract."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.diag import diag_context, transfer_guard
+from torchmetrics_tpu.diag import lineage as lineage_mod
+from torchmetrics_tpu.diag.lineage import (
+    LINEAGE_HEADER,
+    decode_lineage_header,
+    lineage_context,
+    lineage_enabled,
+    lineage_snapshot,
+    provenance_of,
+    reset_lineage,
+    stalest_owner,
+)
+from torchmetrics_tpu.diag.slo import slo_context
+from torchmetrics_tpu.engine import (
+    async_context,
+    engine_context,
+    quarantine_context,
+    scan_context,
+)
+from torchmetrics_tpu.engine import txn as txn_mod
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+NUM_CLASSES = 5
+OWNER = "MulticlassAccuracy"
+
+
+def _batches(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.rand(n, NUM_CLASSES).astype(np.float32)),
+         jnp.asarray(rng.randint(0, NUM_CLASSES, n).astype(np.int32)))
+        for n in sizes
+    ]
+
+
+def _acc(**kw):
+    return MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False, **kw)
+
+
+def _states(m):
+    return {k: np.asarray(getattr(m, k)) for k in m._defaults}
+
+
+def _http_get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    reset_lineage()
+    yield
+    reset_lineage()
+
+
+# ---------------------------------------------------------------- knob
+
+
+def test_env_var_fail_loud(monkeypatch):
+    """Invalid TORCHMETRICS_TPU_LINEAGE values raise instead of silently
+    disabling the evidence surface."""
+    for bad in ("banana", "2", "yes"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_LINEAGE", bad)
+        with pytest.raises(TorchMetricsUserError):
+            lineage_enabled()
+    for on in ("", "1", "on"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_LINEAGE", on)
+        assert lineage_enabled() is True
+    for off in ("0", "off"):
+        monkeypatch.setenv("TORCHMETRICS_TPU_LINEAGE", off)
+        assert lineage_enabled() is False
+    monkeypatch.delenv("TORCHMETRICS_TPU_LINEAGE", raising=False)
+    assert lineage_enabled() is True  # default ON: provenance is passive
+    with lineage_context(False):
+        assert lineage_enabled() is False  # the override wins
+
+
+# ---------------------------------------------------------------- watermarks
+
+
+def test_scan_watermark_exactly_equals_steps_folded():
+    """The tentpole invariant: mid-stream, the provenance ledger counts the
+    enqueued-but-undrained backlog as staleness; at observation (compute) the
+    watermark equals steps-folded exactly and staleness is zero."""
+    stream = _batches([8] * 10, seed=3)
+    with engine_context(True, donate=True), scan_context(4):
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+        st = m._engine.stats
+        mid = provenance_of(OWNER)
+        assert mid.steps_enqueued == 10
+        assert mid.steps_folded == st.scan_steps_folded
+        assert mid.staleness_steps == 10 - st.scan_steps_folded
+        if mid.staleness_steps:
+            assert mid.staleness_us > 0.0  # the wall bound dates the backlog
+        m.compute()
+        rec = m._provenance  # attached by the compute observation
+        assert rec.where == "compute"
+        assert rec.steps_enqueued == rec.steps_folded == rec.steps_observed == 10
+        assert rec.staleness_steps == 0 and rec.staleness_us == 0.0
+        assert st.scan_steps_folded == 10
+
+
+def test_quarantined_batch_counted_as_excluded():
+    """A poisoned batch folds as a rollback: the watermark advances (the step
+    was processed) but the quarantine read marks it excluded — the value
+    visibly does not cover it."""
+    batches = _batches([16] * 4, seed=4)
+    bad = batches[2][0].at[0, 0].set(jnp.nan)
+    with engine_context(True, donate=True), scan_context(2), quarantine_context(True):
+        m = _acc()
+        for i, (p, t) in enumerate(batches):
+            m.update(bad if i == 2 else p, t)
+        m.compute()
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        rec = provenance_of(OWNER)
+        assert rec.steps_enqueued == rec.steps_folded == 4
+        assert rec.excluded.get("quarantined") == 1
+        # delta discipline: a second read (and an aligned watermark) must not
+        # double-count the exclusion — the mark_reported composition
+        txn_mod.mark_reported(m)
+        assert txn_mod.read_quarantine(m)["count"] == 1
+        assert provenance_of(OWNER).excluded.get("quarantined") == 1
+
+
+def test_discard_realigns_watermark_as_excluded():
+    """discard() drops pending steps: they will never fold, so they advance
+    the fold watermark (no phantom staleness) and count as 'discarded'."""
+    stream = _batches([8] * 5, seed=5)
+    with engine_context(True, donate=True), scan_context(4):
+        m = _acc()
+        for p, t in stream:
+            m.update(p, t)
+        backlog = provenance_of(OWNER).staleness_steps
+        assert backlog > 0
+        from torchmetrics_tpu.engine.scan import discard_metric
+
+        discard_metric(m, "test-discard")
+        rec = provenance_of(OWNER)
+        assert rec.staleness_steps == 0
+        assert rec.excluded.get("discarded") == backlog
+        assert stalest_owner() is None  # realigned: nobody is behind
+
+
+# ---------------------------------------------------------------- async + scrape
+
+
+def test_concurrent_scrape_vs_async_drain_watermark():
+    """Satellite: concurrent sidecar scrapes against a STRICT-guarded async
+    hot loop — every scrape's observation reflects exactly the steps folded
+    at its join, and the final ledger shows zero staleness and zero host
+    transfers on the update path."""
+    from torchmetrics_tpu.serve.sidecar import MetricsSidecar
+
+    steps = 120
+    stream = _batches([8] * steps, seed=6)
+    with engine_context(True, donate=True):
+        m = _acc()
+        for p, t in stream[:16]:  # warm executables outside the guard
+            m.update(p, t)
+        m.reset()
+        reset_lineage()
+        with scan_context(8), async_context():
+            stop = threading.Event()
+            errors = []
+
+            def scraper(port):
+                while not stop.is_set():
+                    try:
+                        status, _, _ = _http_get(port, "/metrics")
+                        assert status == 200
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(exc)
+                        return
+                    time.sleep(0.002)
+
+            with MetricsSidecar(port=0) as sidecar:
+                thread = threading.Thread(target=scraper, args=(sidecar.port,))
+                thread.start()
+                try:
+                    with transfer_guard("strict"):
+                        for p, t in stream:
+                            m.update(p, t)
+                finally:
+                    stop.set()
+                    thread.join(timeout=10)
+                assert not errors, errors
+                # the final scrape joins the drain and observes the ledger
+                status, body, _ = _http_get(sidecar.port, "/metrics")
+                assert status == 200
+            rec = provenance_of(OWNER)
+            assert rec.steps_enqueued == rec.steps_folded == steps
+            assert rec.steps_observed == steps  # the scrape observed post-join
+            assert rec.staleness_steps == 0
+            assert b"tm_tpu_staleness_steps" in body
+            assert b"tm_tpu_lineage_records_total" in body
+            m.compute()
+
+
+def test_async_events_carry_lineage_span_to_flow_arrows():
+    """Causal spans ride the EXISTING event kinds as a ``lineage`` data key;
+    merge_timelines renders one flow arrow chain per span id."""
+    from torchmetrics_tpu.diag import merge_timelines
+
+    stream = _batches([8] * 8, seed=7)
+    with engine_context(True, donate=True), scan_context(4), async_context():
+        m = _acc()
+        for p, t in stream:  # warm the executables: async engages on warm keys
+            m.update(p, t)
+        m.reset()
+        with diag_context(capacity=256) as rec:
+            for p, t in stream:
+                m.update(p, t)
+            m.compute()
+            events = rec.snapshot()
+    spans = {ev.data["lineage"] for ev in events if "lineage" in ev.data}
+    assert spans, "no event carried a span id"
+    kinds_with_span = {ev.kind for ev in events if "lineage" in ev.data}
+    assert "async.enqueue" in kinds_with_span or "async.drain" in kinds_with_span
+    trace = merge_timelines([{"rank": 0, "events": events}])
+    flows = [e for e in trace["traceEvents"] if e.get("cat") == "lineage"]
+    assert flows, "no flow arrows rendered"
+    by_id = {}
+    for f in flows:
+        by_id.setdefault(f["id"], []).append(f["ph"])
+    for span_id, phases in by_id.items():
+        assert phases[0] == "s", (span_id, phases)  # one start per chain
+        assert all(ph == "f" for ph in phases[1:]), (span_id, phases)
+
+
+# ---------------------------------------------------------------- coverage
+
+
+def test_federation_fold_coverage_names_excluded_pod():
+    """A degraded federation fold stamps coverage: members + seqs in, the
+    excluded pod named with its reason — 3/4 pods is visibly 3/4."""
+    from torchmetrics_tpu.serve.federation import FederationAggregator, pack_envelope
+
+    with engine_context(True):
+        tmpl = _acc()
+        pods = {}
+        for i, pid in enumerate(("p0", "p1", "p2")):
+            m = _acc()
+            for p, t in _batches([8] * 2, seed=20 + i):
+                m.update(p, t)
+            pods[pid] = pack_envelope(m)
+        agg = FederationAggregator(
+            tmpl, pods={pid: None for pid in ("p0", "p1", "p2", "p3")}, staleness_s=None
+        )
+        for pid, (data, headers) in pods.items():
+            assert agg.ingest(pid, data, headers)
+        agg.fold()
+        stamp = agg.last_coverage
+        assert stamp["members"] == ["p0", "p1", "p2"]
+        assert stamp["excluded"] == [{"id": "p3", "reason": "missing"}]
+        assert stamp["complete"] is False
+        assert sorted(stamp["seqs"]) == ["p0", "p1", "p2"]
+        # the stamp lands on the ledger under the "federation" owner
+        assert lineage_snapshot()["owners"]["federation"]["coverage"] == stamp
+
+
+def test_state_envelope_carries_lineage_header():
+    """pack_envelope stamps X-TM-Lineage: the per-owner provenance rows ride
+    the HTTP surface and decode back to the snapshot's own record."""
+    from torchmetrics_tpu.serve.federation import pack_envelope
+
+    with engine_context(True), scan_context(2):
+        m = _acc()
+        for p, t in _batches([8] * 4, seed=8):
+            m.update(p, t)
+        _data, headers = pack_envelope(m)
+    assert LINEAGE_HEADER in headers
+    rows = decode_lineage_header(headers[LINEAGE_HEADER])
+    assert len(rows) == 1 and rows[0]["owner"] == OWNER
+    assert rows[0]["where"] == "snapshot"
+    assert rows[0]["steps_folded"] == 4 and rows[0]["staleness_steps"] == 0
+    with pytest.raises(TorchMetricsUserError):
+        decode_lineage_header('{"owner": "not-a-list"}')
+
+
+def test_fleet_merge_attaches_coverage_stamp():
+    """The fleet merge result carries its own coverage attestation."""
+    from torchmetrics_tpu.serve.fleet import FleetTelemetry, pack_telemetry
+
+    fleet = FleetTelemetry(pods={"p0": None, "p1": None}, staleness_s=None)
+    data, headers = pack_telemetry(seq=1)
+    assert fleet.ingest("p0", data, headers)
+    merged = fleet.merge()
+    cov = merged["coverage"]
+    assert cov["members"] == ["p0"]
+    assert cov["excluded"] == [{"id": "p1", "reason": "missing"}]
+    assert cov["complete"] is False
+    assert cov["seqs"] == {"p0": 1}
+
+
+# ---------------------------------------------------------------- freshness SLO
+
+
+def test_stale_owner_breaches_freshness_slo_and_healthz_recovers():
+    """Acceptance: a planted stale owner breaches value-freshness, /healthz
+    answers 503 naming the owner + staleness, and recovers once the fold
+    catches up and the fast window passes clean."""
+    from torchmetrics_tpu.serve.sidecar import MetricsSidecar
+
+    with slo_context(slow_s=30.0, fast_s=0.05), MetricsSidecar(port=0) as sc:
+        status, body, _ = _http_get(sc.port, "/healthz")
+        assert status == 200 and body == b"ok\n"  # baseline evaluation
+        # plant the stale pod: 64 steps enqueued, none folded, repeatedly
+        # observed — the staleness_steps p99 window delta crosses 32
+        lineage_mod.note_enqueued("StaleMetric", steps=64)
+        for _ in range(200):
+            lineage_mod.note_observed("StaleMetric", "scrape")
+        status, body, _ = _http_get(sc.port, "/healthz")
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["reason"] == "slo-breach"
+        assert "value-freshness" in payload["slo"]
+        assert payload["stale_owner"] == "StaleMetric"
+        assert payload["staleness_steps"] == 64
+        assert payload["staleness_seconds"] >= 0.0
+        # recovery: the fold catches up, the histogram stays flat past the
+        # fast window, and readiness returns
+        lineage_mod.note_folded("StaleMetric", 64)
+        time.sleep(0.1)
+        status, body, _ = _http_get(sc.port, "/healthz")
+        assert status == 200 and body == b"ok\n"
+
+
+# ---------------------------------------------------------------- off contract
+
+
+def test_lineage_off_paths_byte_identical_and_silent():
+    """With the plane off: no ledger, no records, no extra event data — the
+    unsampled path is byte-identical to the provenance-bearing one."""
+    stream = _batches([8] * 8, seed=9)
+    with lineage_context(False):
+        with engine_context(True, donate=True), scan_context(4), \
+                diag_context(capacity=256) as rec:
+            m_off = _acc()
+            for p, t in stream:
+                m_off.update(p, t)
+            m_off.compute()
+            off_states = _states(m_off)
+            assert lineage_snapshot() == {"enabled": False, "owners": {}}
+            assert provenance_of(OWNER) is None
+            assert stalest_owner() is None
+            assert not hasattr(m_off, "_provenance")
+            assert all("lineage" not in ev.data for ev in rec.snapshot())
+            assert rec.count("lineage.observe") == 0
+    with engine_context(True, donate=True), scan_context(4):
+        m_on = _acc()
+        for p, t in stream:
+            m_on.update(p, t)
+        m_on.compute()
+        on_states = _states(m_on)
+    for k in on_states:
+        assert off_states[k].tobytes() == on_states[k].tobytes(), k
+
+
+def test_reset_lineage_clears_ledger_spans_and_coverage():
+    lineage_mod.note_enqueued("X", steps=3)
+    lineage_mod.note_coverage("X", ["a", "b"], excluded=[("c", "stale")])
+    lineage_mod.note_observed("X", "scrape")
+    assert lineage_snapshot()["owners"]
+    reset_lineage()
+    assert lineage_snapshot() == {"enabled": True, "owners": {}}
+    assert provenance_of("X") is None

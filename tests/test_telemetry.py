@@ -577,3 +577,81 @@ def test_sentinel_bits_documented_and_disjoint():
     assert len(bits) == len(set(bits))
     for a in bits:
         assert a & (a - 1) == 0  # single-bit masks only
+
+
+# ------------------------------------------------------------------ build info
+
+
+def test_build_info_gauge_present_with_runtime_identity():
+    """Satellite: the exposition leads with ONE tm_tpu_build_info sample whose
+    labels carry the package/jax/jaxlib versions, backend, and device identity
+    — and the whole page still parses through the hardened tokenizer."""
+    import jax as _jax
+
+    from torchmetrics_tpu.__about__ import __version__
+
+    text = export_prometheus()
+    samples, helps = parse_exposition(text)
+    rows = [(k, v) for k, v in samples.items() if k[0] == "tm_tpu_build_info"]
+    assert len(rows) == 1
+    (name, labels), value = rows[0]
+    assert value == 1.0
+    by_key = {lab.split("=", 1)[0]: lab.split("=", 1)[1].strip('"') for lab in labels}
+    assert by_key["version"] == __version__
+    assert by_key["jax"] == _jax.__version__
+    assert by_key["backend"] == _jax.default_backend()
+    assert int(by_key["device_count"]) == _jax.device_count()
+    assert "jaxlib" in by_key and "device_kind" in by_key and "mesh" in by_key
+    assert "tm_tpu_build_info" in helps
+
+
+def test_build_info_hostile_label_values_escape_clean(monkeypatch):
+    """Hostile runtime identity strings (quotes, backslashes, newlines in a
+    device kind) must escape through _sample and reparse to the original."""
+    from torchmetrics_tpu.diag import telemetry as telemetry_mod
+
+    hostile = {
+        "version": '1.0"rc\\0',
+        "jax": "0.0\n0",
+        "jaxlib": "x",
+        "backend": 'cpu"',
+        "device_kind": 'TPU v9 "lite"\\beta\nrev2',
+        "device_count": "8",
+        "mesh": 'data=4,"model"=2',
+    }
+    monkeypatch.setattr(telemetry_mod, "_build_info_labels", lambda: dict(hostile))
+    text = export_prometheus()
+    samples, _ = parse_exposition(text)  # every line tokenizes — nothing leaked
+    ((name, labels), value) = next(
+        ((k, v) for k, v in samples.items() if k[0] == "tm_tpu_build_info")
+    )
+    assert value == 1.0
+    parsed = {}
+    for lab in labels:
+        key, raw = lab.split("=", 1)
+        parsed[key] = unescape_label_value(raw[1:-1])  # strip ONE quote pair
+    assert parsed == hostile
+
+
+# ------------------------------------------------------------------ provenance lockstep
+
+
+def test_reset_clears_lineage_watermarks_and_counters():
+    """Satellite regression: reset_engine_stats AND diag_report(reset=True)
+    both clear the provenance ledger — a stale watermark would attribute the
+    previous scenario's backlog to the fresh run as phantom staleness."""
+    from torchmetrics_tpu.diag.lineage import lineage_snapshot, note_enqueued, note_observed
+
+    note_enqueued("ResetProbe", steps=5)
+    note_observed("ResetProbe", "scrape")
+    assert lineage_snapshot()["owners"]["ResetProbe"]["staleness_steps"] == 5
+    assert engine_report()["lineage_records"] >= 1
+    reset_engine_stats()
+    assert lineage_snapshot()["owners"] == {}
+    assert engine_report().get("lineage_records", 0) == 0
+
+    note_enqueued("ResetProbe", steps=2)
+    report = diag_report(reset=True)
+    assert report["provenance"]["owners"]["ResetProbe"]["staleness_steps"] == 2
+    assert lineage_snapshot()["owners"] == {}  # the reset report cleared it
+    assert telemetry_snapshot()["provenance"]["owners"] == {}

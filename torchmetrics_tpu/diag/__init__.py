@@ -1,7 +1,7 @@
 """Diagnostics subsystem — flight recorder, transfer guard, telemetry,
 profiling layer.
 
-Always available, near-zero overhead when off. Nine pieces:
+Always available, near-zero overhead when off. Eleven pieces:
 
 - :mod:`~torchmetrics_tpu.diag.trace` — a contextvar-scoped ring-buffer flight
   recorder of structured engine events (dispatches, traces and retraces *with
@@ -49,6 +49,15 @@ Always available, near-zero overhead when off. Nine pieces:
   histogram series / counter fields, fast+slow burn-rate windows,
   ``slo.breach``/``slo.recover`` transitions, and the blocking-SLO readiness
   input the serving sidecar's ``/healthz`` consumes.
+- :mod:`~torchmetrics_tpu.diag.lineage` — the value provenance & freshness
+  plane: per-owner enqueue/fold/observe watermarks, staleness bounds (steps
+  AND wall-µs behind, host-side only), exclusion accounting (quarantined /
+  replayed / discarded steps), causal span ids that ride the flight recorder
+  into cross-rank flow arrows, and coverage stamps attesting what a degraded
+  sync / federation fold / fleet merge actually includes. Every observation
+  (:func:`~torchmetrics_tpu.diag.lineage.observe_metric`) yields a
+  :class:`~torchmetrics_tpu.diag.lineage.ValueProvenance` record; the
+  ``value-freshness`` SLO turns a stale pod into a named ``/healthz`` 503.
 
 See ``docs/pages/observability.md`` for the event taxonomy, the retrace-cause
 glossary, the ledger field glossary, the sentinel bit layout, and the
@@ -57,6 +66,17 @@ Prometheus scrape example.
 
 from torchmetrics_tpu.diag.costs import ledger_snapshot, reset_ledger, state_footprint
 from torchmetrics_tpu.diag.hist import histograms_snapshot, reset_histograms
+from torchmetrics_tpu.diag.lineage import (
+    LINEAGE_HEADER,
+    ValueProvenance,
+    lineage_context,
+    lineage_enabled,
+    lineage_snapshot,
+    observe_metric,
+    provenance_of,
+    reset_lineage,
+    stalest_owner,
+)
 from torchmetrics_tpu.diag.profile import (
     profile_context,
     profile_snapshot,
@@ -97,6 +117,7 @@ from torchmetrics_tpu.diag.trace import (
 from torchmetrics_tpu.diag.transfer_guard import TransferGuardError, transfer_allowed, transfer_guard
 
 __all__ = [
+    "LINEAGE_HEADER",
     "SENTINEL_BITS",
     "SLO_REGISTRY",
     "FlightRecorder",
@@ -104,6 +125,7 @@ __all__ = [
     "SLOSpec",
     "TraceEvent",
     "TransferGuardError",
+    "ValueProvenance",
     "active_recorder",
     "attribute_retrace",
     "audit_context",
@@ -118,13 +140,19 @@ __all__ = [
     "export_prometheus",
     "histograms_snapshot",
     "ledger_snapshot",
+    "lineage_context",
+    "lineage_enabled",
+    "lineage_snapshot",
     "merge_timelines",
+    "observe_metric",
     "profile_context",
     "profile_snapshot",
+    "provenance_of",
     "read_sentinel",
     "record",
     "reset_histograms",
     "reset_ledger",
+    "reset_lineage",
     "reset_sentinels",
     "reset_slo",
     "sentinel_context",
@@ -133,6 +161,7 @@ __all__ = [
     "set_straggler_threshold_us",
     "slo_context",
     "slo_state",
+    "stalest_owner",
     "state_footprint",
     "straggler_threshold_us",
     "telemetry_snapshot",

@@ -123,6 +123,7 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
             collective_bytes += int(ev.data.get("bytes", 0))
     from torchmetrics_tpu.diag.costs import ledger_snapshot
     from torchmetrics_tpu.diag.hist import histograms_snapshot
+    from torchmetrics_tpu.diag.lineage import lineage_snapshot
     from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
 
@@ -147,10 +148,12 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         "sentinels": sentinel_report(),
         "histograms": histograms_snapshot(),
         "profile": profile_snapshot(),
+        "provenance": lineage_snapshot(),
     }
     if reset:
         from torchmetrics_tpu.diag.costs import reset_ledger
         from torchmetrics_tpu.diag.hist import reset_histograms
+        from torchmetrics_tpu.diag.lineage import reset_lineage
         from torchmetrics_tpu.diag.profile import reset_profile
         from torchmetrics_tpu.diag.sentinel import reset_sentinels
 
@@ -161,6 +164,9 @@ def diag_report(recorder: Optional[FlightRecorder] = None, reset: bool = False) 
         reset_sentinels()
         reset_histograms()
         reset_profile()
+        # lockstep with reset_engine_stats: a stale watermark would attribute
+        # the previous run's backlog to the fresh one as phantom staleness
+        reset_lineage()
     return out
 
 

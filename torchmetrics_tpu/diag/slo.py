@@ -125,6 +125,28 @@ SLO_REGISTRY = {
         "threshold": 0.0,
         "blocking": True,
     },
+    # value-freshness objective (diag/lineage.py): p99 steps-behind at
+    # observation time. A pod whose observed values trail their enqueue
+    # watermark by more than 32 steps is serving stale answers — blocking, so
+    # /healthz drains it (naming the stale owner) until the fold catches up
+    "value-freshness": {
+        "signal": "staleness_steps",
+        "kind": "quantile",
+        "q": 0.99,
+        "threshold": 32.0,
+        "blocking": True,
+    },
+    # wall-clock companion bound: p99 age of the oldest unfolded enqueue at
+    # observation time, in µs (5e6 = 5 s). Advisory — step-lag is the
+    # authoritative freshness signal; this catches a stalled drain thread
+    # whose step-lag is small but old
+    "value-staleness-wall": {
+        "signal": "staleness_us",
+        "kind": "quantile",
+        "q": 0.99,
+        "threshold": 5000000.0,
+        "blocking": False,
+    },
 }
 
 _KINDS = ("quantile", "rate", "ratio")

@@ -122,6 +122,14 @@ UNITLESS_COUNT_FAMILIES = frozenset({
     # event counts and the per-SLO compliance gauges — pure counts/booleans
     "tm_tpu_slo_evaluations", "tm_tpu_slo_breaches", "tm_tpu_slo_recoveries",
     "tm_tpu_slo_compliance", "tm_tpu_slo_breaching",
+    # value provenance & freshness plane (diag/lineage.py, PR 20): record /
+    # span / attestation event counts and the steps-behind staleness histogram
+    # — pure counts; the wall-time staleness series exports as *_seconds
+    "tm_tpu_lineage_records", "tm_tpu_lineage_spans",
+    "tm_tpu_lineage_coverage_folds", "tm_tpu_staleness_steps",
+    # build-identity info gauge: constant 1, all content in the labels
+    # (the standard `*_build_info` dashboard join key)
+    "tm_tpu_build_info",
 })
 
 # EngineStats fields exported as monotonic counters (everything countable);
@@ -191,6 +199,9 @@ _COUNTER_HELP = {
     "slo_evaluations": "SLO evaluation passes over the registered objectives",
     "slo_breaches": "SLO compliance transitions into breach",
     "slo_recoveries": "SLO compliance transitions back to healthy",
+    "lineage_records": "ValueProvenance records built at observation sites",
+    "lineage_spans": "causal lineage spans opened at enqueue (one per drain generation)",
+    "lineage_coverage_folds": "coverage attestations stamped at fold/merge sites",
 }
 
 # exposition-convention names for counters whose field name buries the unit:
@@ -224,6 +235,11 @@ _HIST_SERIES = {
     # allowlisted unitless, like the scan step counters)
     "enqueue_us": ("async_enqueue_latency_seconds", 1e-6, "caller-side cost of one async scan enqueue"),
     "depth": ("async_queue_depth", 1.0, "in-flight buffers pending behind the background drain worker"),
+    # value provenance & freshness plane (diag/lineage.py): per-observation
+    # staleness bounds. Steps-behind is a pure count (allowlisted unitless,
+    # like the queue depth); the wall bound exports in seconds.
+    "staleness_steps": ("staleness_steps", 1.0, "enqueued-but-unfolded steps behind at observation time"),
+    "staleness_us": ("staleness_seconds", 1e-6, "wall-clock bound on observed-value age (oldest unfolded enqueue)"),
 }
 
 
@@ -256,6 +272,7 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
     """
     from torchmetrics_tpu.diag.costs import ledger_snapshot
     from torchmetrics_tpu.diag.hist import histograms_snapshot
+    from torchmetrics_tpu.diag.lineage import lineage_snapshot
     from torchmetrics_tpu.diag.profile import profile_snapshot
     from torchmetrics_tpu.diag.sentinel import sentinel_report
     from torchmetrics_tpu.diag.slo import slo_state
@@ -279,6 +296,44 @@ def telemetry_snapshot(recorder: Optional[FlightRecorder] = None) -> Dict[str, A
         "serve": serve_state(),
         "persist": persist_state(),
         "slo": slo_state(),
+        "provenance": lineage_snapshot(),
+    }
+
+
+def _build_info_labels() -> Dict[str, str]:
+    """Label set for the ``tm_tpu_build_info`` gauge (value is always 1).
+
+    The standard dashboard join key: package + jax/jaxlib versions, backend,
+    device identity, and the active state-mesh shape ride as label values
+    (escaped by :func:`_sample` — versions can carry ``+local`` build metadata
+    and device kinds are vendor strings, so nothing here is trusted to be
+    exposition-clean). Kept as its own function so tests can monkeypatch
+    hostile values through the full render path.
+    """
+    import jax
+
+    from torchmetrics_tpu.__about__ import __version__
+    from torchmetrics_tpu.parallel.sharding import metric_mesh
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None) or jaxlib.version.__version__
+    except Exception:
+        jaxlib_version = ""
+    devices = jax.devices()
+    mesh = metric_mesh()
+    mesh_shape = ""
+    if mesh is not None:
+        mesh_shape = ",".join(f"{axis}={size}" for axis, size in dict(mesh.shape).items())
+    return {
+        "version": __version__,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "",
+        "device_count": str(len(devices)),
+        "mesh": mesh_shape,
     }
 
 
@@ -301,6 +356,10 @@ def export_prometheus(path: Optional[str] = None, snapshot: Optional[Dict[str, A
         for labels, value in samples:
             lines.append(_sample(name, labels, value))
 
+    # build-identity join key first: constant 1, all content in the labels
+    emit(f"{_PREFIX}_build_info", "gauge",
+         "build/runtime identity (version, jax/jaxlib, backend, devices, mesh)",
+         [(_build_info_labels(), 1)])
     for field in sorted(_COUNTER_HELP):
         if field in counters:
             scaled = _COUNTER_EXPORT_SCALE.get(field)

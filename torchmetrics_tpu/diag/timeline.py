@@ -219,6 +219,7 @@ def merge_timelines(
             dur = float(ev["data"].get("dispatch_us", 0.0))
             flat.append((ts, pid, ev["seq"], tid, ev["kind"], dur, ev["data"]))
 
+    flow_seen: Dict[Any, bool] = {}  # lineage span id -> emitted a start yet
     for ts, rank, seq, tid, kind, dur, data in sorted(flat, key=lambda x: (x[0], x[1], x[2])):
         entry: Dict[str, Any] = {
             "name": kind,
@@ -231,6 +232,23 @@ def merge_timelines(
         else:
             entry.update(ph="i", ts=ts, s="t")
         trace_events.append(entry)
+        span = data.get("lineage")
+        if span is not None:
+            # causal flow arrows (diag/lineage.py): every event stamped with
+            # the same lineage span id chains enqueue → drain → join → observe
+            # across thread AND process tracks — "s" opens the arrow at the
+            # first occurrence in merged order, "f"/bp="e" binds each later
+            # occurrence, so Perfetto draws the value's whole causal path
+            flow: Dict[str, Any] = {
+                "name": "lineage", "cat": "lineage", "id": int(span),
+                "pid": rank, "tid": tid, "ts": entry["ts"],
+            }
+            if flow_seen.setdefault(span, False):
+                flow.update(ph="f", bp="e")
+            else:
+                flow_seen[span] = True
+                flow["ph"] = "s"
+            trace_events.append(flow)
 
     for (pid, owner), tid in sorted(tids.items(), key=lambda kv: kv[1]):
         trace_events.append(

@@ -147,6 +147,8 @@ EVENT_KINDS = frozenset({
     "fallback", "transfer.host", "transfer.blocked",
     # persistent executable cache + prewarm (engine/persist.py)
     "persist.save", "persist.load", "persist.fallback", "persist.prewarm", "persist.manifest",
+    # value provenance & freshness plane (diag/lineage.py)
+    "lineage.observe", "lineage.coverage",
 })
 
 #: env knob: "1" = on (default capacity), int > 1 = capacity, "0"/unset = off

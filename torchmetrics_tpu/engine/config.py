@@ -76,6 +76,8 @@ KNOB_REGISTRY = {
     # fleet observability plane + SLO engine (PR 19)
     "TORCHMETRICS_TPU_FLEET_PULL_MS": "torchmetrics_tpu.serve.stats:_env_int",
     "TORCHMETRICS_TPU_SLO": "torchmetrics_tpu.diag.slo:_env_slo",
+    # value provenance & freshness plane (PR 20)
+    "TORCHMETRICS_TPU_LINEAGE": "torchmetrics_tpu.diag.lineage:lineage_enabled",
 }
 
 #: parsers that read the env key through a ``name`` PARAMETER (shared

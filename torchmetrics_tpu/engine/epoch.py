@@ -41,6 +41,7 @@ import numpy as np
 
 from torchmetrics_tpu.diag import costs as _costs
 from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
@@ -79,6 +80,21 @@ def _note_async_sync(stats: EngineStats) -> None:
     from torchmetrics_tpu.engine.async_dispatch import note_epoch_sync
 
     note_epoch_sync(stats)
+
+
+def _note_plan_coverage(stats: EngineStats, plan: "PackedSyncPlan") -> None:
+    """Attest a packed sync's membership when it did NOT cover the full world.
+
+    Complete full-world folds stay silent (nothing to attest); a degraded
+    re-plan or a process-group subset stamps who contributed and who was
+    excluded, so later observations of the synced value carry the membership.
+    """
+    if plan.degraded or len(plan.members) != plan.world_size:
+        _lineage.note_coverage(
+            stats.owner,
+            plan.members,
+            excluded=[(r, "sync-fault") for r in plan.excluded_ranks],
+        )
 
 
 def traced_compute(metric: Any, state: Dict[str, Any]) -> Any:
@@ -563,6 +579,7 @@ class EpochEngine:
         _write_synced(self._metric, folded.get("", {}), plan, "")
         self.stats.packed_syncs += 1
         _note_async_sync(self.stats)
+        _note_plan_coverage(self.stats, plan)
         return True
 
     def sync_and_compute(self, process_group: Optional[Sequence[int]] = None):
@@ -678,10 +695,16 @@ class EpochEngine:
         device_us = None
         if profiling and not first:
             device_us = completion_probe(value, self.stats.owner, "compute", self.stats, t_dispatch)
+        _note_plan_coverage(self.stats, plan)
+        # the fused sync→compute result is an OBSERVATION: stamp what it
+        # covers (watermarks + any degraded membership) before it returns
+        partial = plan.degraded or len(plan.members) != plan.world_size
+        prov = _lineage.observe_metric(m, "compute", coverage=plan.coverage() if partial else None)
         if rec is not None:
+            span = {} if prov is None or prov.span is None else {"lineage": prov.span}
             rec.record(
                 "compute.dispatch", self.stats.owner,
-                dispatch_us=dispatch_us, fused=True, cached=not first,
+                dispatch_us=dispatch_us, fused=True, cached=not first, **span,
             )
             if device_us is not None:
                 rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)
@@ -697,6 +720,7 @@ class EpochEngine:
         _write_synced(self._metric, folded.get("", {}), plan, "")
         self.stats.packed_syncs += 1
         _note_async_sync(self.stats)
+        _note_plan_coverage(self.stats, plan)
         return (NO_VALUE,)
 
     # ------------------------------------------------------------------ compute
@@ -812,10 +836,13 @@ class EpochEngine:
         device_us = None
         if profiling and not first:
             device_us = completion_probe(value, self.stats.owner, "compute", self.stats, t_dispatch)
+        # a cached compute result is an OBSERVATION of the folded watermark
+        prov = _lineage.observe_metric(m, "compute")
         if rec is not None:
+            span = {} if prov is None or prov.span is None else {"lineage": prov.span}
             rec.record(
                 "compute.dispatch", self.stats.owner,
-                dispatch_us=dispatch_us, fused=False, cached=not first,
+                dispatch_us=dispatch_us, fused=False, cached=not first, **span,
             )
             if device_us is not None:
                 rec.record("compute.probe", self.stats.owner, dispatch_us=dispatch_us, device_us=device_us)

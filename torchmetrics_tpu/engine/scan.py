@@ -74,6 +74,7 @@ import numpy as np
 
 from torchmetrics_tpu.diag import costs as _costs
 from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import profile as _profile
 from torchmetrics_tpu.diag import sentinel as _sentinel
 from torchmetrics_tpu.diag import trace as _diag
@@ -456,7 +457,7 @@ class _DrainWork:
 
     __slots__ = (
         "queue", "pending", "qkey", "k", "names", "reason",
-        "done", "ctx", "replay", "error", "first_wait_t",
+        "done", "ctx", "replay", "error", "first_wait_t", "lineage",
     )
 
     def __init__(self, queue: "_ScanQueue", pending, qkey, k: int, names, reason: str) -> None:
@@ -471,6 +472,7 @@ class _DrainWork:
         self.replay = False  # worker handed the payload back for caller replay
         self.error = None  # exception to re-raise at the join (state consumed)
         self.first_wait_t: Optional[float] = None
+        self.lineage: Optional[int] = None  # causal span id, stamped at swap
 
 
 class _ScanQueue:
@@ -556,6 +558,12 @@ class _ScanQueue:
         """Member-name freeze for a work item (fused queues override)."""
         return None
 
+    def _note_discarded(self, names, steps: int) -> None:
+        """Realign the provenance watermark for dropped payloads (discard
+        path): the steps will never fold, so they stop counting as staleness
+        but stay on the record as a ``discarded`` exclusion."""
+        _lineage.note_discarded(self.stats.owner, steps)
+
     def _post_drain(self) -> None:
         """Hook after a successful drain (view re-anchoring for collections)."""
         cb = self.on_drain
@@ -630,7 +638,12 @@ class _ScanQueue:
         """
         self.join_async(reason, collect=False)
         with self._lock:
-            n = len(self._pending) + sum(len(w.pending) for w in self._failed)
+            # per-source step counts: failed hand-backs keep their frozen
+            # member names (the fused watermark must realign the members the
+            # steps were actually enqueued for)
+            drops = [(self._names_snapshot(), len(self._pending))]
+            drops += [(w.names, len(w.pending)) for w in self._failed]
+            n = sum(steps for _, steps in drops)
             self._failed.clear()
             self._poisoned = False
             if not n:
@@ -639,6 +652,9 @@ class _ScanQueue:
         st = self.stats
         st.scan_flushes += 1
         st.scan_flush_reasons[reason] += 1
+        for names, steps in drops:
+            if steps:
+                self._note_discarded(names, steps)
         _diag.record("scan.flush", st.owner, reason=reason, steps=n, discarded=True)
         return n
 
@@ -715,10 +731,18 @@ class _ScanQueue:
         st = self.stats
         st.scan_flushes += 1
         st.scan_flush_reasons[reason] += 1
+        # the open causal span leaves the queue with the buffer: the id links
+        # this swap's enqueues to the drain/join events that settle them
+        span = _lineage.take_span(st.owner)
         rec = _diag.active_recorder()
         if rec is not None:
-            rec.record("scan.flush", st.owner, reason=reason, steps=n)
-        return _DrainWork(self, pending, self._qkey, self._k, self._names_snapshot(), reason)
+            if span is not None:
+                rec.record("scan.flush", st.owner, reason=reason, steps=n, lineage=span)
+            else:
+                rec.record("scan.flush", st.owner, reason=reason, steps=n)
+        work = _DrainWork(self, pending, self._qkey, self._k, self._names_snapshot(), reason)
+        work.lineage = span
+        return work
 
     # tmlint: holds(_drain_mutex)
     def _execute_work(self, work: _DrainWork, allow_compile: bool = True) -> bool:
@@ -836,11 +860,12 @@ class _ScanQueue:
         if profiling and not first:
             device_us = completion_probe(out, st.owner, "scan", st, t_dispatch)
         if rec is not None:
+            span = {} if work.lineage is None else {"lineage": work.lineage}
             rec.record(
                 "update.scan", st.owner,
                 dispatch_us=dispatch_us, steps=n, k=work.k, k_bucket=kb,
                 pad_steps=pad, bytes=bytes_moved, donated=donate,
-                cached=not first, reason=work.reason,
+                cached=not first, reason=work.reason, **span,
             )
             if device_us is not None:
                 rec.record("update.scan.probe", st.owner, dispatch_us=dispatch_us, device_us=device_us)
@@ -936,9 +961,10 @@ class _ScanQueue:
             # drain (1 = pure double buffering, `limit` = backpressure ceiling)
             _hist.observe(st.owner, "async", "depth", float(depth))
             if rec is not None:
+                span = {} if work.lineage is None else {"lineage": work.lineage}
                 rec.record(
                     "async.enqueue", st.owner,
-                    steps=len(work.pending), depth=depth, reason=work.reason,
+                    steps=len(work.pending), depth=depth, reason=work.reason, **span,
                 )
         _async.submit(work)
 
@@ -1020,10 +1046,11 @@ class _ScanQueue:
             self._post_pending = True
         rec = _diag.active_recorder()
         if rec is not None:
+            span = {} if work.lineage is None else {"lineage": work.lineage}
             rec.record(
                 "async.drain", st.owner,
                 dispatch_us=exec_us, overlap_us=overlap_us,
-                steps=len(work.pending), reason=work.reason,
+                steps=len(work.pending), reason=work.reason, **span,
             )
 
     def join_async(self, reason: str, collect: bool = True) -> int:
@@ -1038,6 +1065,7 @@ class _ScanQueue:
         settled = 0
         waited = False
         t0 = 0.0
+        last_span: Optional[int] = None
         while True:
             with self._lock:
                 while self._inflight and self._inflight[0].done.is_set():
@@ -1055,6 +1083,8 @@ class _ScanQueue:
                 # failed buffers count ONCE — at their replay in
                 # _collect_failed below, not here
                 settled += len(work.pending)
+                if work.lineage is not None:
+                    last_span = work.lineage
         st = self.stats
         if waited:
             wait_us = round((perf_counter() - t0) * 1e6, 3)
@@ -1062,7 +1092,8 @@ class _ScanQueue:
             st.async_join_wait_us += int(wait_us)
             rec = _diag.active_recorder()
             if rec is not None:
-                rec.record("async.join", st.owner, reason=reason, steps=settled, wait_us=wait_us)
+                span = {} if last_span is None else {"lineage": last_span}
+                rec.record("async.join", st.owner, reason=reason, steps=settled, wait_us=wait_us, **span)
         if collect:
             settled += self._collect_failed()
         with self._lock:
@@ -1173,6 +1204,7 @@ class MetricScan(_ScanQueue):
             if self._async_limit:
                 inputs = self._prefetch(inputs)
             self._pending.append((args, kwargs, tuple(inputs), n_pad))
+            _lineage.note_enqueued(st.owner)
             if len(self._pending) >= k:
                 self._flush_point_locked("k-reached", asyncable=True)
             return True
@@ -1210,6 +1242,7 @@ class MetricScan(_ScanQueue):
         if self._async_limit:
             inputs = self._prefetch(inputs)
         self._pending.append((args, kwargs, tuple(inputs), n_pad))
+        _lineage.note_enqueued(st.owner)
         if len(self._pending) >= k:
             self._flush_point_locked("k-reached", asyncable=True)
         return True
@@ -1250,6 +1283,7 @@ class MetricScan(_ScanQueue):
         st = self.stats
         st.metrics_updated += steps
         write_member_state(m, out, steps, st)
+        _lineage.note_folded(st.owner, steps)
         if probing:
             _numerics.maybe_drift_probe(m, st)
 
@@ -1260,6 +1294,11 @@ class MetricScan(_ScanQueue):
         for args, kwargs, _, _ in pending:
             if not eng.step(args, kwargs):
                 m._run_eager_update(args, kwargs)
+        # replayed steps DID apply (eagerly) — they advance the fold
+        # watermark, but the record flags them: they skipped the attested
+        # single-dispatch scan path
+        _lineage.note_folded(self.stats.owner, len(pending))
+        _lineage.note_excluded(self.stats.owner, "replayed", len(pending))
 
     def _fingerprint(self, state_sig, kb: int, device: str, qkey) -> Dict[str, Any]:
         bucketed, n_args, kw_names, in_sig, bucket = qkey
@@ -1350,6 +1389,10 @@ class FusedScan(_ScanQueue):
                 m._computed = None
                 m._update_count += 1
                 handled.add(name)
+                # per-member watermark (observation sites key by type name);
+                # the causal span lives on the QUEUE owner, opened below
+                _lineage.note_enqueued(type(m).__name__, span=False)
+        _lineage.open_span(st.owner)
         if len(self._pending) >= k:
             self._flush_point_locked("k-reached", asyncable=True)
         return handled
@@ -1403,6 +1446,7 @@ class FusedScan(_ScanQueue):
         for name, m in self._members(names):
             st.metrics_updated += steps
             residual_out = write_member_state(m, out[name], steps, st)
+            _lineage.note_folded(type(m).__name__, steps)
             if probing and residual_out is not None:
                 _numerics.maybe_drift_probe(m, st, owner=f"{st.owner}:{name}")
 
@@ -1411,6 +1455,13 @@ class FusedScan(_ScanQueue):
         for args, _, _, _ in pending:
             for _, m in self._members(names):
                 m._run_eager_update(args, {})
+        for _, m in self._members(names):
+            _lineage.note_folded(type(m).__name__, len(pending))
+            _lineage.note_excluded(type(m).__name__, "replayed", len(pending))
+
+    def _note_discarded(self, names, steps: int) -> None:
+        for _, m in self._members(names):
+            _lineage.note_discarded(type(m).__name__, steps)
 
     def _fingerprint(self, state_sig, kb: int, device: str, qkey) -> Dict[str, Any]:
         bucketed, in_sig, bucket, _ = qkey

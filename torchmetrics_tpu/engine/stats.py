@@ -97,6 +97,10 @@ _COUNTER_FIELDS = (
     "slo_evaluations",  # SLO evaluation passes (every spec, fast+slow burn windows)
     "slo_breaches",  # SLO compliance transitions into breach (slo.breach events)
     "slo_recoveries",  # SLO compliance transitions back to healthy (slo.recover events)
+    # --- value provenance & freshness plane (diag/lineage.py) ---
+    "lineage_records",  # ValueProvenance records built at observation sites
+    "lineage_spans",  # causal spans opened at enqueue (one per drain generation)
+    "lineage_coverage_folds",  # coverage attestations stamped at fold/merge sites
 )
 
 
@@ -221,6 +225,7 @@ def reset_engine_stats() -> None:
     """
     from torchmetrics_tpu.diag.costs import reset_ledger
     from torchmetrics_tpu.diag.hist import reset_histograms
+    from torchmetrics_tpu.diag.lineage import reset_lineage
     from torchmetrics_tpu.diag.profile import reset_profile
     from torchmetrics_tpu.diag.sentinel import reset_sentinels
     from torchmetrics_tpu.diag.slo import reset_slo
@@ -240,3 +245,4 @@ def reset_engine_stats() -> None:
     reset_serve_stats()
     reset_persist_stats()
     reset_slo()
+    reset_lineage()

@@ -58,6 +58,7 @@ from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tup
 
 import numpy as np
 
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
@@ -544,6 +545,9 @@ def read_quarantine(metric: Any) -> Dict[str, Any]:
         st = _stats_for(metric)
         st.quarantined_batches += total - reported
         _diag.record("update.quarantine", type(metric).__name__, count=total - reported, total=total)
+        # provenance: quarantined batches were skipped in-graph — the value
+        # an observer reads does NOT cover them
+        _lineage.note_excluded(type(metric).__name__, "quarantined", total - reported)
     if total != reported:
         metric._quarantine_reported = total
     return {"owner": type(metric).__name__, "count": total}

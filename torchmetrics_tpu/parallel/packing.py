@@ -893,6 +893,22 @@ class PackedSyncPlan:
 
     # ------------------------------------------------------------------ fold
 
+    def coverage(self) -> Dict[str, Any]:
+        """Membership attestation for values folded through this plan.
+
+        The shape the provenance plane (``diag/lineage.py``) stamps on
+        observations: who contributed, who was excluded by a degraded
+        re-plan, and whether the fold covered the full world. Pure read of
+        plan markers — no device access.
+        """
+        return {
+            "members": [str(r) for r in self.members],
+            "world_size": self.world_size,
+            "degraded": self.degraded,
+            "excluded": [{"id": str(r), "reason": "sync-fault"} for r in self.excluded_ranks],
+            "complete": not self.degraded and len(self.members) == self.world_size,
+        }
+
     def signature(self) -> Tuple:
         """Cache key for the fold executable: full static layout + world geometry."""
         return (

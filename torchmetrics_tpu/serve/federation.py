@@ -58,6 +58,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.diag.transfer_guard import transfer_allowed
 from torchmetrics_tpu.engine.stats import EngineStats
@@ -148,9 +149,12 @@ def pack_envelope(metrics: Any, seq: Optional[int] = None) -> Tuple[bytes, Dict[
     flat: Dict[str, np.ndarray] = {}
     meta: Dict[str, Any] = {"owners": {}}
     total_updates = 0
+    provenance_rows = []
     for owner in sorted(metric_map):
         snap = take_snapshot(metric_map[owner])
         total_updates += snap.update_count
+        if snap.provenance:
+            provenance_rows.append(snap.provenance)
         attrs_meta: Dict[str, Any] = {}
         # the npz write below is the actual device->host materialization of
         # the snapshot copies — the sanctioned aggregation-tier boundary
@@ -186,6 +190,11 @@ def pack_envelope(metrics: Any, seq: Optional[int] = None) -> Tuple[bytes, Dict[
         CRC_HEADER: f"{crc:#010x}",
         SEQ_HEADER: str(seq),
     }
+    if provenance_rows:
+        # per-owner watermarks ride the envelope out-of-band: an aggregator
+        # (or a human with curl -I) can audit what the payload covers without
+        # parsing the npz
+        headers[_lineage.LINEAGE_HEADER] = _lineage.encode_lineage_header(provenance_rows)
     return buf.getvalue(), headers
 
 
@@ -343,6 +352,9 @@ class FederationAggregator:
         self._last_degraded = 0  # guarded-by: _lock
         self._fold_cache: Dict[Tuple, Any] = {}  # guarded-by: _lock — jitted folds
         self._scratch: Dict[str, Any] = {}  # guarded-by: _lock — compute clones
+        #: coverage stamp of the last fold (diag/lineage.py ``note_coverage``
+        #: form) — who the global value includes, who it excludes, and why
+        self.last_coverage: Optional[Dict[str, Any]] = None
         _serve_stats.register_federation(self)
 
     # ------------------------------------------------------------------ ingest
@@ -543,6 +555,16 @@ class FederationAggregator:
                 self.stats.federation_degraded_folds += 1
         for pid, reason in excluded:
             _diag.record("federation.degraded", "federation", pod=pid, reason=reason)
+        # coverage attestation: the stamp names exactly who this global value
+        # folded (pod ids + their snapshot seqs) and who it excluded and why —
+        # a degraded 3/4-pod fold is visibly a 3/4-pod value, never a silent 4/4
+        stamp = _lineage.note_coverage(
+            "federation",
+            members,
+            seqs={pid: fresh[pid].envelope.seq for pid in members},
+            excluded=excluded,
+        )
+        self.last_coverage = stamp
         _diag.record(
             "federation.fold", "federation",
             pods=len(members), degraded=len(excluded), members=",".join(members),

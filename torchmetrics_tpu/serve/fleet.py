@@ -61,6 +61,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.diag.hist import (
     Histogram,
@@ -195,6 +196,12 @@ def pack_telemetry(
         CRC_HEADER: f"{crc:#010x}",
         SEQ_HEADER: str(env_seq),
     }
+    rows = [r for r in _lineage.lineage_snapshot()["owners"].values()]
+    if rows:
+        # the telemetry envelope carries this pod's provenance ledger as a
+        # header stamp — the fleet aggregator (or curl -I) audits per-owner
+        # freshness without unpacking the npz
+        headers[_lineage.LINEAGE_HEADER] = _lineage.encode_lineage_header(rows)
     return buf.getvalue(), headers
 
 
@@ -483,6 +490,15 @@ class FleetTelemetry:
             )
         for pid, reason in excluded:
             _diag.record("fleet.degraded", "fleet", pod=pid, reason=reason)
+        # coverage attestation: the merged view carries its own membership
+        # stamp (pods + telemetry seqs in, exclusions + reasons out) — a
+        # 3/4-pod fleet number is visibly a 3/4-pod number
+        coverage = _lineage.note_coverage(
+            "fleet",
+            members,
+            seqs={pid: fresh[pid].telemetry.seq for pid in members},
+            excluded=excluded,
+        )
         _diag.record(
             "fleet.merge", "fleet",
             pods=len(members), degraded=len(excluded), members=",".join(members),
@@ -496,6 +512,7 @@ class FleetTelemetry:
             "sentinels": dict(sorted(sentinels.items())),
             "ledger_totals": dict(sorted(ledger.items())),
             "histograms": series_hists,
+            "coverage": coverage or {},
         }
 
     # ------------------------------------------------------------------ SLOs

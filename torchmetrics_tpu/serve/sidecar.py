@@ -49,6 +49,7 @@ from time import perf_counter
 from typing import Any, Optional
 
 from torchmetrics_tpu.diag import hist as _hist
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.serve import stats as _serve_stats
 
@@ -79,6 +80,9 @@ def _scrape_flush() -> None:
         # narrate the pause-free route: the steps this scrape waited out rode
         # the background worker, not this thread's dispatch
         _diag.record("serve.scrape.async", "sidecar", drained=drained)
+    # the scrape observes every owner: after the drain+join above, each
+    # watermark's folded count is exactly the steps this export reflects
+    _lineage.observe_all("scrape")
 
 
 class _ScrapeHandler(BaseHTTPRequestHandler):
@@ -191,11 +195,22 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
             evaluate_slos()
             breaching = blocking_breaches()
             if breaching:
-                body = json.dumps({
+                payload = {
                     "status": "unready",
                     "reason": "slo-breach",
                     "slo": breaching,
-                }, sort_keys=True) + "\n"
+                }
+                if "value-freshness" in breaching:
+                    # name the owner serving stale values, not just the SLO id:
+                    # an operator draining this pod needs to know WHICH metric's
+                    # fold watermark fell behind and by how much
+                    stale = _lineage.stalest_owner()
+                    if stale is not None:
+                        owner, behind, wall_us = stale
+                        payload["stale_owner"] = owner
+                        payload["staleness_steps"] = int(behind)
+                        payload["staleness_seconds"] = round(wall_us * 1e-6, 6)
+                body = json.dumps(payload, sort_keys=True) + "\n"
                 return 503, body.encode(), "application/json"
         return 200, b"ok\n", "text/plain"
 

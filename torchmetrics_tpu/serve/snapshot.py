@@ -31,6 +31,7 @@ from typing import Any, Dict
 
 import jax.numpy as jnp
 
+from torchmetrics_tpu.diag import lineage as _lineage
 from torchmetrics_tpu.diag import trace as _diag
 from torchmetrics_tpu.serve import stats as _serve_stats
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
@@ -55,6 +56,9 @@ class StateSnapshot:
     update_count: int
     retries: int = 0
     extras: Dict[str, Any] = field(default_factory=dict)
+    #: what the snapshot covers (diag/lineage.py ``ValueProvenance.as_dict()``
+    #: form); empty when the provenance plane is off
+    provenance: Dict[str, Any] = field(default_factory=dict)
 
 
 def _copy_leaf(value: Any) -> Any:
@@ -114,7 +118,13 @@ def take_snapshot(metric: Any) -> StateSnapshot:
             update_count=int(watermark), retries=attempt,
         )
         _serve_stats.note_snapshot(attempt)
-        return StateSnapshot(state=copies, update_count=int(watermark), retries=attempt, extras=extras)
+        # the snapshot IS an observation: the queue flushed above, so the
+        # record attests exactly what the copied state covers
+        record = _lineage.observe_metric(metric, "snapshot")
+        return StateSnapshot(
+            state=copies, update_count=int(watermark), retries=attempt, extras=extras,
+            provenance=record.as_dict() if record is not None else {},
+        )
     raise TorchMetricsUserError(
         f"Could not take a consistent snapshot of {type(metric).__name__} within"
         f" {budget} attempts (TORCHMETRICS_TPU_SERVE_SNAPSHOT_RETRIES); the update"
@@ -146,6 +156,7 @@ def read_host(metric: Any, attrs: Any, index: Any = None) -> Dict[str, Any]:
     # flush-on-observation (engine/scan.py): the scrape views (tenant tables,
     # sketch registers, ring clocks) must reflect every enqueued step
     flush_metric(metric, "observation:scrape")
+    _lineage.observe_metric(metric, "scrape")
     attrs = tuple(attrs)
     budget = _serve_stats.snapshot_retries()
     last_exc: Any = None
@@ -224,10 +235,12 @@ def snapshot_compute(metric: Any, snapshot: StateSnapshot = None) -> Any:
         finally:
             scratch.__dict__.clear()
             scratch.__dict__.update(prior)
+    span = snapshot.provenance.get("span") if snapshot.provenance else None
     _diag.record(
         "serve.snapshot.read", type(metric).__name__,
         update_count=snapshot.update_count,
         updates_between=int(metric._update_count) - snapshot.update_count,
         compute_us=round((perf_counter() - t0) * 1e6, 3),
+        **({} if span is None else {"lineage": span}),
     )
     return value
